@@ -1,0 +1,4 @@
+from .comm import (all_gather, all_reduce, all_to_all, axis_index, barrier, broadcast,
+                   broadcast_host_data, configure, get_comms_logger, get_local_rank, get_rank,
+                   get_world_size, init_distributed, is_initialized, log_summary, ppermute,
+                   reduce_scatter, send_next_recv_prev, send_prev_recv_next)

@@ -1,0 +1,137 @@
+"""Device mesh topology: the TPU-native replacement for process groups.
+
+The reference builds arbitrary rank-subset process groups
+(``deepspeed/utils/groups.py``, ``runtime/pipe/topology.py:12``
+``ProcessTopology``). On TPU, groups are *named mesh axes* of a
+``jax.sharding.Mesh``; a collective "over the data-parallel group" is a
+collective over the ``dp`` axis (or the ``('dp_outer','ep')`` axis tuple when
+expert parallelism splits it).
+
+Axis order is chosen for ICI locality: ``pp`` outermost (cross-slice / DCN
+friendly), then data parallel, then sequence parallel, with ``tp`` innermost
+(fastest-varying → physically adjacent chips).
+"""
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# canonical axis names
+PP_AXIS = "pp"
+DP_OUTER_AXIS = "dp_outer"
+EP_AXIS = "ep"
+SP_AXIS = "sp"
+TP_AXIS = "tp"
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologySpec:
+    """Logical parallelism degrees. dp is inferred from the device count."""
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+    dp: Optional[int] = None  # None => infer
+
+    def resolve_dp(self, n_devices: int) -> int:
+        denom = self.pp * self.sp * self.tp
+        if n_devices % denom != 0:
+            raise ValueError(f"world size {n_devices} not divisible by pp*sp*tp={denom}")
+        dp = n_devices // denom
+        if self.dp is not None and self.dp != dp:
+            raise ValueError(f"data_parallel_size={self.dp} inconsistent with "
+                             f"world={n_devices}, pp*sp*tp={denom}")
+        if dp % self.ep != 0:
+            raise ValueError(f"expert parallel size {self.ep} must divide dp size {dp}")
+        return dp
+
+
+class Topology:
+    """A resolved mesh topology.
+
+    Mesh axes: ``(pp, dp_outer, ep, sp, tp)`` — always all five, size-1 axes
+    included, so sharding rules can be written once. The data-parallel "group"
+    is the axis pair ``(dp_outer, ep)``.
+    """
+
+    def __init__(self, spec: TopologySpec = TopologySpec(),
+                 devices: Optional[Sequence[jax.Device]] = None):
+        if devices is None:
+            devices = jax.devices()
+        self.spec = spec
+        self.n_devices = len(devices)
+        dp = spec.resolve_dp(self.n_devices)
+        self.pp_size, self.sp_size, self.tp_size = spec.pp, spec.sp, spec.tp
+        self.ep_size = spec.ep
+        self.dp_size = dp
+        self.dp_outer_size = dp // spec.ep
+
+        shape = (spec.pp, self.dp_outer_size, spec.ep, spec.sp, spec.tp)
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+        except Exception:
+            dev_array = np.asarray(list(devices)).reshape(shape)
+        self.mesh = Mesh(dev_array,
+                         axis_names=(PP_AXIS, DP_OUTER_AXIS, EP_AXIS, SP_AXIS, TP_AXIS))
+
+    # ---- group-like accessors (reference: deepspeed/utils/groups.py) -----
+    @property
+    def dp_axes(self) -> Tuple[str, ...]:
+        return (DP_OUTER_AXIS, EP_AXIS)
+
+    @property
+    def fsdp_axes(self) -> Tuple[str, ...]:
+        """Axes over which ZeRO shards params/grads/optimizer state.
+
+        Sequence-parallel ranks replicate data-parallel state in the reference
+        (Ulysses composes with ZeRO-3 via ``seq_data_parallel_group``,
+        ``engine.py:1198``) — so ZeRO shards over dp *and* sp axes to match.
+        """
+        return (DP_OUTER_AXIS, EP_AXIS, SP_AXIS)
+
+    @property
+    def all_axes(self) -> Tuple[str, ...]:
+        return (PP_AXIS, DP_OUTER_AXIS, EP_AXIS, SP_AXIS, TP_AXIS)
+
+    def axis_size(self, *names: str) -> int:
+        s = 1
+        for n in names:
+            s *= self.mesh.shape[n]
+        return s
+
+    def sharding(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def __repr__(self):
+        return (f"Topology(pp={self.pp_size}, dp={self.dp_size} (outer={self.dp_outer_size},"
+                f" ep={self.ep_size}), sp={self.sp_size}, tp={self.tp_size},"
+                f" devices={self.n_devices})")
+
+
+# Global topology, set by initialize() (reference: groups module globals).
+_TOPOLOGY: Optional[Topology] = None
+
+
+def set_topology(topo: Topology) -> None:
+    global _TOPOLOGY
+    _TOPOLOGY = topo
+
+
+def get_topology() -> Topology:
+    global _TOPOLOGY
+    if _TOPOLOGY is None:
+        _TOPOLOGY = Topology()
+    return _TOPOLOGY
+
+
+def reset_topology() -> None:
+    global _TOPOLOGY
+    _TOPOLOGY = None

@@ -1,0 +1,460 @@
+"""Typed configuration tree.
+
+TPU-native re-design of the reference's JSON config system
+(``runtime/config.py:706`` ``DeepSpeedConfig`` and the per-subsystem pydantic
+models). Keeps the same knob vocabulary — ``train_batch_size``,
+``train_micro_batch_size_per_gpu``, ``gradient_accumulation_steps``,
+``optimizer``, ``scheduler``, ``fp16``/``bf16``, ``zero_optimization``,
+``gradient_clipping``, ``pipeline``, ``moe``, ``sequence_parallel_size``,
+``tensor_parallel`` — so a DeepSpeed JSON config ports with minimal edits.
+"""
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Union
+
+from .config_utils import AUTO, ConfigError, ConfigModel, register_config
+
+# ---------------------------------------------------------------------------
+# Precision
+# ---------------------------------------------------------------------------
+
+
+@register_config
+@dataclass
+class FP16Config(ConfigModel):
+    """fp16 + dynamic loss scaling (reference ``runtime/fp16/loss_scaler.py:91``)."""
+    enabled: bool = False
+    loss_scale: float = 0.0  # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+    auto_cast: bool = False
+
+
+@register_config
+@dataclass
+class BF16Config(ConfigModel):
+    enabled: bool = False
+    # keep fp32 master weights + fp32 grad accumulation (reference bf16_optimizer.py:34)
+    master_weights: bool = True
+
+
+# ---------------------------------------------------------------------------
+# Optimizer / scheduler
+# ---------------------------------------------------------------------------
+
+
+@register_config
+@dataclass
+class OptimizerConfig(ConfigModel):
+    type: str = "adamw"
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+@register_config
+@dataclass
+class SchedulerConfig(ConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO
+# ---------------------------------------------------------------------------
+
+
+@register_config
+@dataclass
+class OffloadOptimizerConfig(ConfigModel):
+    """Reference ``runtime/zero/offload_config.py``. ``device`` in {none,cpu,nvme}."""
+    device: str = "none"
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = True
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = 1.0
+
+
+@register_config
+@dataclass
+class OffloadParamConfig(ConfigModel):
+    device: str = "none"
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    max_in_cpu: int = 1_000_000_000
+    pin_memory: bool = True
+
+
+@register_config
+@dataclass
+class ZeroConfig(ConfigModel):
+    """ZeRO knobs (reference ``runtime/zero/config.py:84``).
+
+    On TPU the stages lower to sharding rules over the ``dp`` mesh axis:
+      stage 0 — replicate everything, psum grads
+      stage 1 — shard optimizer state
+      stage 2 — + reduce_scatter grads (grads materialized sharded)
+      stage 3 — + shard parameters, allgather-on-use
+    """
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: bool = True
+    round_robin_gradients: bool = False
+    offload_optimizer: OffloadOptimizerConfig = field(default_factory=OffloadOptimizerConfig)
+    offload_param: OffloadParamConfig = field(default_factory=OffloadParamConfig)
+    sub_group_size: int = 1_000_000_000
+    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_max_reuse_distance: int = 1_000_000_000
+    stage3_prefetch_bucket_size: int = 50_000_000
+    stage3_param_persistence_threshold: int = 100_000
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    stage3_module_granularity_threshold: int = 0
+    # ZeRO++ (hpZ secondary shard / quantized weights / quantized gradients)
+    zero_hpz_partition_size: int = 1
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+    # MiCS-style sub-group sharding: shard params over groups of this size (<= dp size)
+    mics_shard_size: int = -1
+    mics_hierarchical_params_gather: bool = False
+    elastic_checkpoint: bool = False
+    ignore_unused_parameters: bool = True
+
+
+# ---------------------------------------------------------------------------
+# Parallel topology
+# ---------------------------------------------------------------------------
+
+
+@register_config
+@dataclass
+class PipelineConfig(ConfigModel):
+    """Pipeline parallelism (reference ``runtime/pipe/``)."""
+    stages: int = 1
+    partition_method: str = "parameters"  # uniform | parameters | type:<regex>
+    micro_batches: Optional[int] = None  # default = gradient_accumulation_steps
+    activation_checkpoint_interval: int = 0
+    schedule: str = "1f1b"  # 1f1b | gpipe
+
+
+@register_config
+@dataclass
+class TensorParallelConfig(ConfigModel):
+    """Training tensor parallelism (reference AutoTP / external mpu)."""
+    enabled: bool = False
+    tp_size: int = 1
+
+
+@register_config
+@dataclass
+class MoEConfig(ConfigModel):
+    """Expert parallelism (reference ``deepspeed/moe/``)."""
+    enabled: bool = False
+    ep_size: int = 1
+    num_experts: int = 1
+    top_k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None  # None | 'Jitter' | 'RSample'
+    drop_tokens: bool = True
+    use_residual: bool = False
+    aux_loss_weight: float = 0.01
+
+
+# ---------------------------------------------------------------------------
+# Diagnostics / aux subsystems
+# ---------------------------------------------------------------------------
+
+
+@register_config
+@dataclass
+class FlopsProfilerConfig(ConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+@register_config
+@dataclass
+class CommsLoggerConfig(ConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = field(default_factory=list)
+
+
+@register_config
+@dataclass
+class TensorBoardConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+
+
+@register_config
+@dataclass
+class WandbConfig(ConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed_tpu"
+
+
+@register_config
+@dataclass
+class CSVConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+
+
+@register_config
+@dataclass
+class MonitorConfig(ConfigModel):
+    tensorboard: TensorBoardConfig = field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = field(default_factory=CSVConfig)
+
+
+@register_config
+@dataclass
+class ActivationCheckpointingConfig(ConfigModel):
+    """Rematerialization knobs; maps to jax.checkpoint policies."""
+    partition_activations: bool = False
+    number_checkpoints: Optional[int] = None
+    contiguous_memory_optimization: bool = False
+    cpu_checkpointing: bool = False
+    profile: bool = False
+    # jax-native: remat policy name ('nothing_saveable','dots_saveable',...)
+    policy: Optional[str] = None
+
+
+@register_config
+@dataclass
+class ElasticityConfig(ConfigModel):
+    """Elastic batch config (reference ``elasticity/elasticity.py:233``)."""
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.2
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+
+
+@register_config
+@dataclass
+class CompressionConfig(ConfigModel):
+    """QAT / pruning knobs (reference ``compression/``)."""
+    weight_quantization: Dict[str, Any] = field(default_factory=dict)
+    activation_quantization: Dict[str, Any] = field(default_factory=dict)
+    sparse_pruning: Dict[str, Any] = field(default_factory=dict)
+    row_pruning: Dict[str, Any] = field(default_factory=dict)
+    head_pruning: Dict[str, Any] = field(default_factory=dict)
+    channel_pruning: Dict[str, Any] = field(default_factory=dict)
+    layer_reduction: Dict[str, Any] = field(default_factory=dict)
+
+
+@register_config
+@dataclass
+class DataEfficiencyConfig(ConfigModel):
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: Dict[str, Any] = field(default_factory=dict)
+    data_routing: Dict[str, Any] = field(default_factory=dict)
+
+
+@register_config
+@dataclass
+class AutotuningConfig(ConfigModel):
+    enabled: bool = False
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    metric: str = "throughput"
+    start_profile_step: int = 3
+    end_profile_step: int = 5
+    fast: bool = True
+    max_train_batch_size: Optional[int] = None
+    mp_size: int = 1
+    num_tuning_micro_batch_sizes: int = 3
+    tuner_type: str = "gridsearch"
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+
+
+@register_config
+@dataclass
+class CheckpointConfig(ConfigModel):
+    tag_validation: str = "Warn"  # Ignore | Warn | Fail
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = field(default_factory=dict)
+    async_save: bool = False
+
+
+@register_config
+@dataclass
+class AIOConfig(ConfigModel):
+    """Host async-IO knobs for the NVMe offload tier (reference ``csrc/aio``)."""
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+# ---------------------------------------------------------------------------
+# Root config
+# ---------------------------------------------------------------------------
+
+
+@register_config
+@dataclass
+class DeepSpeedTPUConfig(ConfigModel):
+    """Root config (reference ``DeepSpeedConfig``, ``runtime/config.py:706``)."""
+
+    train_batch_size: Union[int, str, None] = None
+    train_micro_batch_size_per_gpu: Union[int, str, None] = None
+    gradient_accumulation_steps: Union[int, str, None] = None
+
+    steps_per_print: int = 10
+    wall_clock_breakdown: bool = False
+    dump_state: bool = False
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    gradient_clipping: float = 0.0
+    disable_allgather: bool = False
+
+    seed: int = 42
+
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    fp16: FP16Config = field(default_factory=FP16Config)
+    bf16: BF16Config = field(default_factory=BF16Config)
+    zero_optimization: ZeroConfig = field(default_factory=ZeroConfig)
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
+    moe: MoEConfig = field(default_factory=MoEConfig)
+
+    # topology: sizes multiply to world size; dp is inferred
+    sequence_parallel_size: int = 1
+    data_parallel_size: Optional[int] = None
+
+    activation_checkpointing: ActivationCheckpointingConfig = field(
+        default_factory=ActivationCheckpointingConfig)
+    flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
+    comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
+    monitor: MonitorConfig = field(default_factory=MonitorConfig)
+    tensorboard: TensorBoardConfig = field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = field(default_factory=CSVConfig)
+    elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
+    compression_training: CompressionConfig = field(default_factory=CompressionConfig)
+    data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
+    autotuning: AutotuningConfig = field(default_factory=AutotuningConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    aio: AIOConfig = field(default_factory=AIOConfig)
+
+    # free-form escape hatch for experiments
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    _DEPRECATED_KEYS = {
+        "train_micro_batch_size_per_device": "train_micro_batch_size_per_gpu",
+        "zero_allow_untested_optimizer": None,
+        "zero_force_ds_cpu_optimizer": None,
+        "memory_breakdown": None,
+        "communication_data_type": None,
+        "amp": None,
+    }
+
+    # ------------------------------------------------------------------
+    def __post_init__(self):
+        # Keep the raw user-specified triangle so finalize() can re-resolve at
+        # the true dp world size without conflicting with defaults filled here.
+        self._user_batch = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
+                            self.gradient_accumulation_steps)
+        self._resolve_batch_sizes()
+
+    def _resolve_batch_sizes(self, world_dp_size: int = 1):
+        """Reference ``config.py`` batch-size triangle: tbs = mbs * gas * dp."""
+        raw_tbs, raw_mbs, raw_gas = self._user_batch
+        tbs = raw_tbs if isinstance(raw_tbs, int) else None
+        mbs = raw_mbs if isinstance(raw_mbs, int) else None
+        gas = raw_gas if isinstance(raw_gas, int) else None
+        if tbs and mbs and gas:
+            if tbs != mbs * gas * world_dp_size:
+                raise ConfigError(
+                    f"train_batch_size({tbs}) != micro_batch({mbs}) * gas({gas}) * dp({world_dp_size})")
+        elif tbs and mbs:
+            gas = tbs // (mbs * world_dp_size)
+        elif tbs and gas:
+            mbs = tbs // (gas * world_dp_size)
+        elif mbs and gas:
+            tbs = mbs * gas * world_dp_size
+        elif tbs:
+            mbs = max(1, tbs // world_dp_size)
+            gas = tbs // (mbs * world_dp_size)
+        elif mbs:
+            gas = 1
+            tbs = mbs * world_dp_size
+        else:
+            mbs, gas = 1, 1
+            tbs = world_dp_size
+        if not (tbs and mbs and gas) or tbs != mbs * gas * world_dp_size:
+            raise ConfigError(
+                f"Inconsistent batch config: train_batch_size={tbs}, micro={mbs}, gas={gas}, "
+                f"dp={world_dp_size}")
+        self.train_batch_size = tbs
+        self.train_micro_batch_size_per_gpu = mbs
+        self.gradient_accumulation_steps = gas
+
+    def finalize(self, world_dp_size: int) -> "DeepSpeedTPUConfig":
+        """Re-resolve batch sizes once the dp world size is known."""
+        self._resolve_batch_sizes(world_dp_size)
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ConfigError("fp16 and bf16 cannot both be enabled")
+        return self
+
+    # convenience ------------------------------------------------------
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        if self.fp16.enabled:
+            return jnp.float16
+        return jnp.float32
+
+    @property
+    def zero_stage(self) -> int:
+        return self.zero_optimization.stage
+
+
+def load_config(config: Union[str, Mapping[str, Any], DeepSpeedTPUConfig, None]) -> DeepSpeedTPUConfig:
+    """Accept a path to a JSON file, a dict, an existing config, or None."""
+    if config is None:
+        return DeepSpeedTPUConfig()
+    if isinstance(config, DeepSpeedTPUConfig):
+        return config
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    return DeepSpeedTPUConfig.from_dict(config)

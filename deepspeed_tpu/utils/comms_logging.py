@@ -1,0 +1,117 @@
+"""Comms ledger: per-op counts/sizes/latency/bandwidth.
+
+Reference: ``CommsLogger`` (``deepspeed/utils/comms_logging.py:67``) and the
+``timed_op`` wrapper (``comm/comm.py:101``). On TPU, collectives issued inside
+``jit`` are fused by XLA and cannot be individually timed at run time; instead
+we record them at **trace time** (shapes are static, so message sizes are
+exact) and time eager ops for real. ``log_summary`` prints the same
+count/size/latency/algbw/busbw table the reference does.
+"""
+
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+
+def get_msg_size(nbytes: int) -> str:
+    for unit in ["B", "KB", "MB", "GB", "TB"]:
+        if nbytes < 1024:
+            return f"{nbytes:.2f} {unit}"
+        nbytes /= 1024
+    return f"{nbytes:.2f} PB"
+
+
+def calc_bw(op_name: str, size_bytes: int, duration_s: float, n: int):
+    """Algorithm / bus bandwidth in GB/s (NCCL-tests conventions, as in the
+    reference ``comms_logging.get_bw``)."""
+    if duration_s <= 0:
+        return 0.0, 0.0
+    algbw = size_bytes / duration_s / 1e9
+    if "all_to_all" in op_name:
+        busbw = algbw * ((n - 1) / n)
+    elif "all_gather" in op_name or "reduce_scatter" in op_name:
+        busbw = algbw * ((n - 1) / n)
+    elif "all_reduce" in op_name:
+        busbw = algbw * (2 * (n - 1) / n)
+    else:  # broadcast, send/recv, barrier
+        busbw = algbw
+    return algbw, busbw
+
+
+class CommsLogger:
+    def __init__(self, enabled: bool = False, verbose: bool = False, debug: bool = False,
+                 prof_all: bool = True, prof_ops: Optional[List[str]] = None):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.debug = debug
+        self.prof_all = prof_all
+        self.prof_ops = prof_ops or []
+        # op_name -> msg_size -> [count, total_latency_s, traced_count]
+        self.comms_dict: Dict[str, Dict[int, List[float]]] = defaultdict(lambda: defaultdict(lambda: [0, 0.0, 0]))
+
+    def configure(self, enabled=None, verbose=None, prof_all=None, prof_ops=None, debug=None):
+        if enabled is not None:
+            self.enabled = enabled
+        if verbose is not None:
+            self.verbose = verbose
+        if prof_all is not None:
+            self.prof_all = prof_all
+        if prof_ops is not None:
+            self.prof_ops = prof_ops
+        if debug is not None:
+            self.debug = debug
+
+    def _should_log(self, op_name: str) -> bool:
+        if not self.enabled:
+            return False
+        return self.prof_all or op_name in self.prof_ops
+
+    def append(self, op_name: str, size_bytes: int, latency_s: float = 0.0, traced: bool = False):
+        if not self._should_log(op_name):
+            return
+        rec = self.comms_dict[op_name][size_bytes]
+        rec[0] += 1
+        rec[1] += latency_s
+        rec[2] += 1 if traced else 0
+        if self.verbose:
+            from .logging import logger
+
+            kind = "traced" if traced else f"{latency_s*1e3:.2f} ms"
+            logger.info(f"comm op: {op_name} | size: {get_msg_size(size_bytes)} | {kind}")
+
+    def log_summary(self, world_size: int = 1, show_straggler: bool = False) -> str:
+        lines = []
+        header = f"{'Comm op':<28}{'Message size':<16}{'Count':<8}{'Total lat(ms)':<15}{'Avg lat(ms)':<13}{'algbw(GB/s)':<13}{'busbw(GB/s)':<13}"
+        lines.append(header)
+        lines.append("-" * len(header))
+        for op_name, sizes in sorted(self.comms_dict.items()):
+            for size, (count, total_lat, traced) in sorted(sizes.items()):
+                timed_count = count - traced
+                avg = total_lat / timed_count if timed_count else 0.0
+                algbw, busbw = calc_bw(op_name, size, avg, world_size)
+                note = f"(+{traced} traced)" if traced else ""
+                lines.append(f"{op_name:<28}{get_msg_size(size):<16}{count:<8}"
+                             f"{total_lat*1e3:<15.2f}{avg*1e3:<13.3f}{algbw:<13.2f}{busbw:<13.2f}{note}")
+        out = "\n".join(lines)
+        print(out, flush=True)
+        return out
+
+    def reset(self):
+        self.comms_dict.clear()
+
+
+class timed_op:
+    """Context manager timing an eager collective and appending to the ledger."""
+
+    def __init__(self, ledger: CommsLogger, op_name: str, size_bytes: int):
+        self.ledger = ledger
+        self.op_name = op_name
+        self.size_bytes = size_bytes
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.ledger.append(self.op_name, self.size_bytes, time.perf_counter() - self.t0)
+        return False

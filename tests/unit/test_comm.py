@@ -1,0 +1,134 @@
+"""Collectives over a virtual 8-device CPU mesh (reference: tests/unit/comm/test_dist.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.parallel import Topology, TopologySpec
+
+
+@pytest.fixture
+def topo8():
+    return Topology(TopologySpec())  # all 8 devices on the dp axis
+
+
+def test_topology_shapes():
+    t = Topology(TopologySpec(pp=2, tp=2))
+    assert t.dp_size == 2 and t.pp_size == 2 and t.tp_size == 2
+    assert t.mesh.shape["pp"] == 2 and t.mesh.shape["tp"] == 2
+
+
+def test_topology_ep_splits_dp():
+    t = Topology(TopologySpec(ep=4))
+    assert t.dp_size == 8 and t.ep_size == 4 and t.dp_outer_size == 2
+
+
+def test_bad_topology_raises():
+    with pytest.raises(ValueError):
+        Topology(TopologySpec(pp=3))  # 8 % 3 != 0
+
+
+def test_all_reduce(topo8):
+    mesh = topo8.mesh
+
+    @jax.jit
+    def f(x):
+        def body(x):
+            return dist.all_reduce(x, axis=topo8.dp_axes)
+
+        return shard_map(body, mesh=mesh, in_specs=P(("dp_outer", "ep")), out_specs=P(("dp_outer", "ep")))(x)
+
+    x = jnp.arange(8.0).reshape(8, 1)
+    out = f(x)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 28.0))
+
+
+def test_all_gather_reduce_scatter_roundtrip(topo8):
+    mesh = topo8.mesh
+    x = jnp.arange(16.0).reshape(8, 2)
+
+    @jax.jit
+    def f(x):
+        def body(x):
+            g = dist.all_gather(x, axis=topo8.dp_axes)  # (8,2) on every rank
+            return dist.reduce_scatter(g, axis=topo8.dp_axes)  # back to (1,2), x * 8
+
+        return shard_map(body, mesh=mesh, in_specs=P(("dp_outer", "ep")), out_specs=P(("dp_outer", "ep")))(x)
+
+    np.testing.assert_allclose(np.asarray(f(x)), np.asarray(x) * 8)
+
+
+def test_broadcast(topo8):
+    mesh = topo8.mesh
+
+    @jax.jit
+    def f(x):
+        def body(x):
+            return dist.broadcast(x, axis=topo8.dp_axes, src=3)
+
+        return shard_map(body, mesh=mesh, in_specs=P(("dp_outer", "ep")), out_specs=P(("dp_outer", "ep")))(x)
+
+    x = jnp.arange(8.0).reshape(8, 1)
+    np.testing.assert_allclose(np.asarray(f(x)), np.full((8, 1), 3.0))
+
+
+def test_all_to_all():
+    t = Topology(TopologySpec(ep=8))
+    mesh = t.mesh
+    # 8 ranks, each with (8, 4) -> transpose block layout
+    x = jnp.arange(8 * 8 * 4.0).reshape(64, 4)
+
+    @jax.jit
+    def f(x):
+        def body(x):
+            return dist.all_to_all(x, axis="ep", split_dim=0, concat_dim=0)
+
+        return shard_map(body, mesh=mesh, in_specs=P(("dp_outer", "ep")), out_specs=P(("dp_outer", "ep")))(x)
+
+    out = np.asarray(f(x)).reshape(8, 8, 4)
+    ref = np.asarray(x).reshape(8, 8, 4).transpose(1, 0, 2)
+    np.testing.assert_allclose(out, ref)
+
+
+def test_ppermute_ring():
+    t = Topology(TopologySpec(pp=8))
+    mesh = t.mesh
+    x = jnp.arange(8.0).reshape(8, 1)
+
+    @jax.jit
+    def f(x):
+        def body(x):
+            return dist.send_next_recv_prev(x, axis="pp")
+
+        return shard_map(body, mesh=mesh, in_specs=P("pp"), out_specs=P("pp"))(x)
+
+    np.testing.assert_allclose(np.asarray(f(x)).ravel(), np.roll(np.arange(8.0), 1))
+
+
+def test_comms_logger_traced():
+    logger = dist.get_comms_logger()
+    logger.configure(enabled=True)
+    logger.reset()
+    t = Topology(TopologySpec())
+    mesh = t.mesh
+
+    @jax.jit
+    def f(x):
+        def body(x):
+            return dist.all_reduce(x, axis=("dp_outer", "ep"))
+
+        return shard_map(body, mesh=mesh, in_specs=P(("dp_outer", "ep")), out_specs=P(("dp_outer", "ep")))(x)
+
+    f(jnp.ones((8, 128), jnp.float32))
+    assert "all_reduce" in logger.comms_dict
+    sizes = logger.comms_dict["all_reduce"]
+    assert 128 * 4 in sizes  # per-shard bytes: (1,128) fp32
+    logger.configure(enabled=False)
+
+
+def test_world_size():
+    assert dist.get_world_size() == 8

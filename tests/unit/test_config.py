@@ -1,0 +1,61 @@
+import pytest
+
+from deepspeed_tpu.runtime.config import (ConfigError, DeepSpeedTPUConfig, load_config)
+
+
+def test_default_config():
+    cfg = load_config(None)
+    assert cfg.zero_stage == 0
+    assert cfg.train_micro_batch_size_per_gpu == 1
+
+
+def test_batch_size_triangle():
+    cfg = load_config({"train_batch_size": 32, "gradient_accumulation_steps": 4})
+    cfg.finalize(world_dp_size=2)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_size_mismatch_raises():
+    with pytest.raises(ConfigError):
+        load_config({
+            "train_batch_size": 32,
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 4,
+        }).finalize(world_dp_size=4)
+
+
+def test_nested_zero_config():
+    cfg = load_config({
+        "zero_optimization": {
+            "stage": 3,
+            "offload_optimizer": {"device": "cpu"},
+        },
+        "bf16": {"enabled": True},
+    })
+    assert cfg.zero_stage == 3
+    assert cfg.zero_optimization.offload_optimizer.device == "cpu"
+    import jax.numpy as jnp
+    assert cfg.compute_dtype == jnp.bfloat16
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ConfigError):
+        load_config({"zero_optimization": {"stage": 1, "bogus_key": True}})
+
+
+def test_deprecated_key_remap():
+    cfg = load_config({"train_micro_batch_size_per_device": 8})
+    assert cfg.train_micro_batch_size_per_gpu == 8
+
+
+def test_fp16_bf16_conflict():
+    with pytest.raises(ConfigError):
+        load_config({"fp16": {"enabled": True}, "bf16": {"enabled": True}}).finalize(1)
+
+
+def test_roundtrip_dict():
+    cfg = load_config({"gradient_clipping": 1.0, "optimizer": {"type": "adam", "params": {"lr": 1e-3}}})
+    d = cfg.to_dict()
+    assert d["gradient_clipping"] == 1.0
+    assert d["optimizer"]["type"] == "adam"
