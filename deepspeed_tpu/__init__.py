@@ -1,0 +1,24 @@
+"""deepspeed_tpu — a TPU-native training & inference framework.
+
+Brand-new JAX/XLA/Pallas implementation of the capability set of DeepSpeed
+(reference layout mapped in SURVEY.md): ZeRO 0-3 as sharding rules, pipeline /
+tensor / expert / Ulysses-sequence parallelism over named mesh axes, a
+``deepspeed.comm``-shaped collectives facade lowering to XLA collectives, fused
+Pallas kernels, universal checkpointing, and the surrounding launcher /
+profiler / monitor toolchain.
+"""
+
+from . import comm
+from .parallel.topology import Topology, TopologySpec, get_topology, set_topology
+from .runtime.config import DeepSpeedTPUConfig, load_config
+from .runtime.engine import DeepSpeedTPUEngine, TrainState, initialize
+from .version import __version__
+
+init_distributed = comm.init_distributed
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Reference ``deepspeed.init_inference`` (``deepspeed/__init__.py:291``)."""
+    from .inference.engine import InferenceEngine
+
+    return InferenceEngine(model=model, config=config, **kwargs)

@@ -1,0 +1,94 @@
+"""Data loading utilities.
+
+Reference: ``DeepSpeedDataLoader`` + ``RepeatingLoader``
+(``runtime/dataloader.py:41,:17``). On TPU the loader yields *global* batches
+(numpy/jnp pytrees); the engine shards them over the dp/sp mesh axes at
+dispatch, so there is no per-rank DistributedSampler — every host feeds its
+local shard of the global array via ``jax.make_array_from_process_local_data``
+in multi-host runs.
+"""
+
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart on StopIteration (reference ``:17``)."""
+
+    def __init__(self, loader: Iterable):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedDataLoader:
+    """Minimal batch loader over an indexable dataset of pytrees.
+
+    Supports a ``collate_fn`` and curriculum hooks (``data_pipeline``): when a
+    ``curriculum_fn`` is set, it maps ``(epoch, step) -> effective seq length``
+    and the loader truncates sequence-like leaves accordingly (legacy
+    curriculum learning, reference ``curriculum_scheduler.py:11``).
+    """
+
+    def __init__(self, dataset, batch_size: int, shuffle: bool = True, seed: int = 0,
+                 collate_fn: Optional[Callable] = None, drop_last: bool = True,
+                 curriculum_fn: Optional[Callable] = None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.collate_fn = collate_fn or _default_collate
+        self.drop_last = drop_last
+        self.curriculum_fn = curriculum_fn
+        self.epoch = 0
+        self.global_step = 0
+        n = len(dataset)
+        self.len = n // batch_size if drop_last else (n + batch_size - 1) // batch_size
+
+    def __len__(self):
+        return self.len
+
+    def __iter__(self) -> Iterator[Any]:
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        for i in range(self.len):
+            idx = order[i * self.batch_size:(i + 1) * self.batch_size]
+            batch = self.collate_fn([self.dataset[int(j)] for j in idx])
+            if self.curriculum_fn is not None:
+                seqlen = int(self.curriculum_fn(self.epoch, self.global_step))
+                batch = _truncate_seq(batch, seqlen)
+            self.global_step += 1
+            yield batch
+        self.epoch += 1
+
+
+def _default_collate(items):
+    first = items[0]
+    if isinstance(first, dict):
+        return {k: np.stack([it[k] for it in items]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(np.stack([it[i] for it in items]) for i in range(len(first)))
+    return np.stack(items)
+
+
+def _truncate_seq(batch, seqlen: int):
+    def trunc(x):
+        if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[1] > seqlen:
+            return x[:, :seqlen]
+        return x
+
+    return jax.tree.map(trunc, batch)

@@ -1,0 +1,59 @@
+"""Dynamic loss scaling as functional state.
+
+Reference: ``DynamicLossScaler`` (``runtime/fp16/loss_scaler.py:91``) — the
+mutable scaler becomes a small pytree updated inside the compiled train step
+with ``jnp.where`` (no Python branching), so overflow-skip steps stay on
+device.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray          # f32 scalar
+    good_steps: jnp.ndarray     # i32 consecutive non-overflow steps
+    hysteresis: jnp.ndarray     # i32 remaining tolerated overflows before backoff
+
+
+def make_loss_scale_state(initial_scale_power: int = 16, static_scale: float = 0.0,
+                          hysteresis: int = 2) -> LossScaleState:
+    scale = static_scale if static_scale > 0 else 2.0 ** initial_scale_power
+    return LossScaleState(scale=jnp.asarray(scale, jnp.float32),
+                          good_steps=jnp.zeros([], jnp.int32),
+                          hysteresis=jnp.asarray(hysteresis, jnp.int32))
+
+
+def has_overflow(grads) -> jnp.ndarray:
+    """Global non-finite check over a grad pytree (reference ``CheckOverflow``,
+    ``runtime/utils.py:181``)."""
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return jnp.zeros([], jnp.bool_)
+    flags = [~jnp.isfinite(g.astype(jnp.float32)).all() for g in leaves]
+    return jnp.stack(flags).any()
+
+
+def update_loss_scale(state: LossScaleState, overflow: jnp.ndarray, *,
+                      dynamic: bool = True, scale_window: int = 1000,
+                      scale_factor: float = 2.0, min_scale: float = 1.0,
+                      max_hysteresis: int = 2,
+                      consecutive_hysteresis: bool = False) -> LossScaleState:
+    """One step of the reference's update_scale logic (loss_scaler.py:91),
+    branch-free."""
+    if not dynamic:
+        return state
+    hys_exhausted = state.hysteresis <= 1
+    backoff_scale = jnp.maximum(state.scale / scale_factor, min_scale)
+    new_scale = jnp.where(overflow & hys_exhausted, backoff_scale, state.scale)
+    new_hys = jnp.where(overflow & ~hys_exhausted, state.hysteresis - 1, state.hysteresis)
+    good = jnp.where(overflow, 0, state.good_steps + 1)
+    grow = (~overflow) & (good % scale_window == 0) & (good > 0)
+    new_scale = jnp.where(grow, new_scale * scale_factor, new_scale)
+    # Reference loss_scaler.py:194-201: consecutive_hysteresis replenishes on
+    # every good step; otherwise hysteresis replenishes only at growth windows.
+    replenish = (~overflow) if consecutive_hysteresis else grow
+    new_hys = jnp.where(replenish, jnp.asarray(max_hysteresis, jnp.int32), new_hys)
+    return LossScaleState(scale=new_scale, good_steps=good, hysteresis=new_hys)

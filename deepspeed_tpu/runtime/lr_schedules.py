@@ -1,0 +1,128 @@
+"""LR schedules (reference ``runtime/lr_schedules.py``).
+
+Each builder returns ``step -> lr`` as a jnp-traceable callable so schedules
+can live inside the compiled train step; the reference's per-step Python
+scheduler ``step()`` loop collapses into a pure function of the step counter.
+
+Reference classes: ``LRRangeTest:273``, ``OneCycle:371``, ``WarmupLR:633``,
+``WarmupDecayLR:723``, ``WarmupCosineLR:774``.
+"""
+
+import math
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+WARMUP_COSINE_LR = "WarmupCosineLR"
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR, WARMUP_COSINE_LR]
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3,
+                  lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False, **_) -> Schedule:
+    def sched(step):
+        s = jnp.maximum(step.astype(jnp.float32) - 1, 0.0)
+        interval = s / lr_range_test_step_size
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return sched
+
+
+def one_cycle(cycle_min_lr: float = 1e-3, cycle_max_lr: float = 1e-2,
+              cycle_first_step_size: int = 2000, cycle_second_step_size: Optional[int] = None,
+              decay_step_size: int = 0, decay_lr_rate: float = 0.0, **_) -> Schedule:
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    total_cycle = cycle_first_step_size + second
+
+    def sched(step):
+        s = jnp.maximum(step.astype(jnp.float32) - 1, 0.0)
+        up = jnp.clip(s / cycle_first_step_size, 0.0, 1.0)
+        down = jnp.clip((s - cycle_first_step_size) / second, 0.0, 1.0)
+        in_cycle_lr = jnp.where(s <= cycle_first_step_size,
+                                cycle_min_lr + (cycle_max_lr - cycle_min_lr) * up,
+                                cycle_max_lr - (cycle_max_lr - cycle_min_lr) * down)
+        if decay_step_size > 0:
+            decay_steps = jnp.maximum(s - total_cycle, 0.0) / decay_step_size
+            decayed = cycle_min_lr / (1.0 + decay_steps * decay_lr_rate)
+            return jnp.where(s > total_cycle, decayed, in_cycle_lr)
+        return in_cycle_lr
+
+    return sched
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 1e-3,
+              warmup_num_steps: int = 1000, warmup_type: str = "log", **_) -> Schedule:
+    warmup_num_steps = max(2, warmup_num_steps)
+
+    def sched(step):
+        s = jnp.clip(step.astype(jnp.float32), 1.0, float(warmup_num_steps))
+        if warmup_type == "log":
+            gamma = jnp.log(s) / math.log(warmup_num_steps)
+        else:
+            gamma = s / warmup_num_steps
+        lr = warmup_min_lr + (warmup_max_lr - warmup_min_lr) * gamma
+        return jnp.where(step >= warmup_num_steps, warmup_max_lr, lr)
+
+    return sched
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 1e-3, warmup_num_steps: int = 1000,
+                    warmup_type: str = "log", **_) -> Schedule:
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+    warmup_num_steps_ = max(2, warmup_num_steps)
+
+    def sched(step):
+        s = step.astype(jnp.float32)
+        decay = jnp.clip((total_num_steps - s) / max(1.0, total_num_steps - warmup_num_steps_),
+                         0.0, 1.0)
+        return jnp.where(s < warmup_num_steps_, base(step), warmup_max_lr * decay)
+
+    return sched
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.01,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 0.0001,
+                     warmup_type: str = "linear", base_lr: float = 1.0, **_) -> Schedule:
+    warmup_num_steps_ = max(2, warmup_num_steps)
+
+    def sched(step):
+        s = jnp.clip(step.astype(jnp.float32), 1.0, None)
+        if warmup_type == "log":
+            gamma = jnp.log(jnp.clip(s, 1.0, warmup_num_steps_)) / math.log(warmup_num_steps_)
+        else:
+            gamma = jnp.clip(s / warmup_num_steps_, 0.0, 1.0)
+        warm = warmup_min_ratio + (1.0 - warmup_min_ratio) * gamma
+        progress = jnp.clip((s - warmup_num_steps_) / max(1.0, total_num_steps - warmup_num_steps_),
+                            0.0, 1.0)
+        cos_ratio = cos_min_ratio + (1.0 - cos_min_ratio) * 0.5 * (1.0 + jnp.cos(jnp.pi * progress))
+        return base_lr * jnp.where(s < warmup_num_steps_, warm, cos_ratio)
+
+    return sched
+
+
+def build_lr_schedule(sched_type: Optional[str], params: dict, base_lr: float = 1e-3) -> Schedule:
+    """Config ``scheduler`` section -> schedule callable. ``None`` -> constant
+    base_lr (the optimizer's own lr)."""
+    if sched_type is None:
+        return lambda step: jnp.asarray(base_lr, jnp.float32)
+    if sched_type == LR_RANGE_TEST:
+        return lr_range_test(**params)
+    if sched_type == ONE_CYCLE:
+        return one_cycle(**params)
+    if sched_type == WARMUP_LR:
+        return warmup_lr(**params)
+    if sched_type == WARMUP_DECAY_LR:
+        return warmup_decay_lr(**params)
+    if sched_type == WARMUP_COSINE_LR:
+        return warmup_cosine_lr(**params)
+    raise ValueError(f"Unknown scheduler type {sched_type}; valid: {VALID_LR_SCHEDULES}")

@@ -1,0 +1,140 @@
+"""ZeRO as sharding rules.
+
+The reference implements ZeRO with explicit partition bookkeeping, grad-hook
+bucketing, and stream-overlapped collectives (``runtime/zero/stage_1_and_2.py``,
+``stage3.py``, ``partition_parameters.py``). On TPU the same memory layout is
+expressed declaratively: a ``PartitionSpec`` per tensor over the mesh, and XLA
+inserts + schedules (prefetches, overlaps) the allgathers/reduce-scatters the
+hooks performed imperatively.
+
+Stage semantics (all over the "fsdp" axes = dp_outer × ep × sp):
+  0: replicate params, grads, optimizer state (plain DP)
+  1: shard optimizer state (+ fp32 master params — they are optimizer state)
+  2: + accumulated gradients sharded (reduce_scatter materialization)
+  3: + parameters sharded (allgather-on-use, scheduled by XLA)
+
+MiCS (``zero/mics.py:64``) maps to sharding over a *subset* of the fsdp axes —
+shard over ep only (size = mics_shard_size) and replicate over dp_outer — the
+hierarchical allgather then naturally rides the inner axis first.
+
+Model-parallel dims (tp / expert ep) come in via a user/model-provided spec
+tree; ZeRO claims the largest *free* dim divisible by the fsdp axis size, and
+falls back to replication for small/indivisible params (the analogue of
+stage3's ``param_persistence_threshold``).
+"""
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...parallel.topology import Topology
+
+
+def _spec_tuple(spec: Optional[P], ndim: int) -> Tuple:
+    t = tuple(spec) if spec is not None else ()
+    return t + (None,) * (ndim - len(t))
+
+
+def _axes_in_spec(spec: Tuple) -> set:
+    used = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return used
+
+
+def shard_param_spec(shape: Sequence[int],
+                     base_spec: Optional[P],
+                     shard_axes: Tuple[str, ...],
+                     axis_size: int,
+                     min_size_to_shard: int = 2 ** 11) -> P:
+    """Add ZeRO sharding over ``shard_axes`` to ``base_spec``.
+
+    Picks the largest dim divisible by ``axis_size`` that the base (model
+    parallel) spec leaves free, preferring earlier dims on ties. Params smaller
+    than ``min_size_to_shard`` stay as-is (persistent-param analogue of
+    ``stage3_param_persistence_threshold``).
+    """
+    ndim = len(shape)
+    base = _spec_tuple(base_spec, ndim)
+    if axis_size == 1 or int(np.prod(shape or (1,))) < min_size_to_shard:
+        return P(*base)
+    used = _axes_in_spec(base)
+    if set(shard_axes) & used:
+        return P(*base)  # already sharded over (some of) these axes by the model
+    best = -1
+    best_size = 0
+    for d in range(ndim):
+        if base[d] is None and shape[d] % axis_size == 0 and shape[d] > best_size:
+            best, best_size = d, shape[d]
+    if best < 0:
+        return P(*base)
+    new = list(base)
+    new[best] = shard_axes if len(shard_axes) > 1 else shard_axes[0]
+    return P(*new)
+
+
+class ZeroShardingRules:
+    """Resolved sharding policy for one engine instance."""
+
+    def __init__(self, stage: int, topo: Topology, *,
+                 mics_shard_size: int = -1,
+                 min_size_to_shard: int = 2 ** 11):
+        self.stage = stage
+        self.topo = topo
+        self.min_size_to_shard = min_size_to_shard
+        # MiCS: restrict the sharding group to the inner (ep) axis slice
+        if mics_shard_size and mics_shard_size > 0:
+            if topo.ep_size != mics_shard_size:
+                raise ValueError(
+                    "MiCS shard size is expressed by sizing the ep axis: set "
+                    f"TopologySpec(ep={mics_shard_size}); got ep={topo.ep_size}")
+            self.fsdp_axes: Tuple[str, ...] = ("ep",)
+        else:
+            self.fsdp_axes = tuple(topo.fsdp_axes)
+        self.fsdp_size = topo.axis_size(*self.fsdp_axes)
+
+    # -- per-tensor specs ------------------------------------------------
+    def param_spec(self, shape, base_spec: Optional[P]) -> P:
+        if self.stage >= 3:
+            return shard_param_spec(shape, base_spec, self.fsdp_axes, self.fsdp_size,
+                                    self.min_size_to_shard)
+        return P(*_spec_tuple(base_spec, len(shape)))
+
+    def opt_state_spec(self, shape, base_spec: Optional[P]) -> P:
+        if self.stage >= 1:
+            return shard_param_spec(shape, base_spec, self.fsdp_axes, self.fsdp_size,
+                                    self.min_size_to_shard)
+        return P(*_spec_tuple(base_spec, len(shape)))
+
+    def grad_accum_spec(self, shape, base_spec: Optional[P]) -> P:
+        if self.stage >= 2:
+            return shard_param_spec(shape, base_spec, self.fsdp_axes, self.fsdp_size,
+                                    self.min_size_to_shard)
+        return P(*_spec_tuple(base_spec, len(shape)))
+
+    # -- tree-level helpers ----------------------------------------------
+    def param_spec_tree(self, params, base_specs=None):
+        return self._map_tree(params, base_specs, self.param_spec)
+
+    def opt_spec_tree(self, params, base_specs=None):
+        return self._map_tree(params, base_specs, self.opt_state_spec)
+
+    def grad_spec_tree(self, params, base_specs=None):
+        return self._map_tree(params, base_specs, self.grad_accum_spec)
+
+    def _map_tree(self, params, base_specs, fn):
+        if base_specs is None:
+            return jax.tree.map(lambda p: fn(p.shape, None), params)
+        return jax.tree.map(lambda p, s: fn(p.shape, s), params, base_specs,
+                            is_leaf=lambda x: x is None or isinstance(x, P))
+
+    def shardings(self, spec_tree):
+        return jax.tree.map(lambda s: NamedSharding(self.topo.mesh, s), spec_tree,
+                            is_leaf=lambda x: isinstance(x, P))

@@ -1,0 +1,40 @@
+"""Tiny fixture models (analogue of reference tests/unit/simple_model.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_simple_params(hidden=64, nlayers=3, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    params = {}
+    for i in range(nlayers):
+        params[f"layer_{i}"] = {
+            "w": jnp.asarray(rng.normal(0, 0.05, size=(hidden, hidden)), dtype),
+            "b": jnp.zeros((hidden,), dtype),
+        }
+    params["head"] = {"w": jnp.asarray(rng.normal(0, 0.05, size=(hidden, 1)), dtype)}
+    return params
+
+
+def simple_loss(params, batch):
+    """MLP regression loss. batch = (x [B,H], y [B,1])."""
+    x, y = batch["x"], batch["y"]
+    h = x
+    nlayers = len([k for k in params if k.startswith("layer_")])
+    for i in range(nlayers):
+        p = params[f"layer_{i}"]
+        h = jnp.tanh(h @ p["w"] + p["b"])
+    pred = h @ params["head"]["w"]
+    return jnp.mean((pred - y.astype(pred.dtype)) ** 2)
+
+
+def random_batches(n, batch_size, hidden=64, seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(hidden, 1)).astype(np.float32)
+    out = []
+    for _ in range(n):
+        x = rng.normal(size=(batch_size, hidden)).astype(np.float32)
+        y = x @ w_true + 0.01 * rng.normal(size=(batch_size, 1)).astype(np.float32)
+        out.append({"x": jnp.asarray(x), "y": jnp.asarray(y)})
+    return out

@@ -1,0 +1,151 @@
+"""Engine end-to-end on the virtual 8-device mesh (analogue of
+reference tests/unit/runtime/zero/test_zero.py tiny-model runs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.parallel import Topology, TopologySpec
+
+from .simple_model import make_simple_params, random_batches, simple_loss
+
+HIDDEN = 64
+
+
+def _make_engine(zero_stage=0, extra_cfg=None, topology=None, gas=1, mbs=8, **kw):
+    cfg = {
+        "train_micro_batch_size_per_gpu": mbs,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": zero_stage},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 1000,
+    }
+    if extra_cfg:
+        for k, v in extra_cfg.items():
+            if isinstance(v, dict) and k in cfg:
+                cfg[k].update(v)
+            else:
+                cfg[k] = v
+    params = make_simple_params(HIDDEN)
+    engine, _, _, _ = ds.initialize(model=simple_loss, model_parameters=params, config=cfg,
+                                    topology=topology, **kw)
+    return engine
+
+
+def _train(engine, steps=10, gas=1, seed=0, batch_size=64):
+    batches = random_batches(steps * gas, batch_size // gas if gas > 1 else batch_size, HIDDEN,
+                             seed=seed)
+    losses = []
+    for s in range(steps):
+        if gas > 1:
+            mb = batches[s * gas:(s + 1) * gas]
+            batch = jax.tree.map(lambda *xs: jnp.stack(xs), *mb)
+        else:
+            batch = batches[s]
+        losses.append(engine.train_batch(batch))
+    return losses
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stages_converge(stage):
+    engine = _make_engine(zero_stage=stage)
+    losses = _train(engine, steps=15)
+    assert losses[-1] < losses[0] * 0.5, f"stage {stage} not converging: {losses}"
+
+
+def test_zero_stage_parity():
+    """All ZeRO stages must be numerically equivalent (same losses) — the TPU
+    analogue of the reference's cross-stage consistency tests."""
+    ref = None
+    for stage in [0, 1, 2, 3]:
+        engine = _make_engine(zero_stage=stage)
+        losses = np.asarray(_train(engine, steps=8))
+        if ref is None:
+            ref = losses
+        else:
+            np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_zero3_params_are_sharded():
+    topo = Topology(TopologySpec())
+    engine = _make_engine(zero_stage=3, topology=topo)
+    w = engine.state.params["layer_0"]["w"]  # (64, 64): dim0 divisible by 8
+    shard_shape = w.sharding.shard_shape(w.shape)
+    assert shard_shape != w.shape, "stage-3 params should be sharded over fsdp axes"
+    m = engine.state.opt_state.exp_avg["layer_0"]["w"]
+    assert m.sharding.shard_shape(m.shape) != m.shape
+
+
+def test_zero1_opt_sharded_params_replicated():
+    engine = _make_engine(zero_stage=1)
+    w = engine.state.params["layer_0"]["w"]
+    assert w.sharding.shard_shape(w.shape) == w.shape  # replicated
+    m = engine.state.opt_state.exp_avg["layer_0"]["w"]
+    assert m.sharding.shard_shape(m.shape) != m.shape  # sharded
+
+
+def test_gas_equivalence():
+    """gas=4 x mbs=2 must match gas=1 x mbs=8 (reference GAS semantics)."""
+    e1 = _make_engine(zero_stage=1, gas=1, mbs=64)
+    l1 = _train(e1, steps=12, gas=1, batch_size=64)
+    e2 = _make_engine(zero_stage=1, gas=4, mbs=16)
+    l2 = _train(e2, steps=12, gas=4, batch_size=64)
+    # same data overall; per-step losses are means over different groupings, so
+    # compare trajectories loosely but ensure both learn
+    assert l2[-1] < l2[0] * 0.7 and l1[-1] < l1[0] * 0.7
+
+
+def test_compat_forward_backward_step():
+    """Imperative forward/backward/step path matches the fused train_batch."""
+    fused = _make_engine(zero_stage=1)
+    compat = _make_engine(zero_stage=1)
+    batches = random_batches(6, 8, HIDDEN, seed=3)
+    fused_losses = [fused.train_batch(b) for b in batches]
+    compat_losses = []
+    for b in batches:
+        compat_losses.append(compat.backward(batch=b))
+        compat.step()
+    np.testing.assert_allclose(fused_losses, compat_losses, rtol=1e-4, atol=1e-5)
+    assert compat.global_steps == 6
+
+
+def test_fp16_dynamic_loss_scale_skips():
+    engine = _make_engine(zero_stage=0, extra_cfg={
+        "fp16": {"enabled": True, "initial_scale_power": 32}})  # absurd scale -> overflow
+    batch = random_batches(1, 8, HIDDEN)[0]
+    engine.train_batch(batch)  # overflow 1: tolerated by hysteresis=2
+    assert engine.skipped_steps >= 1
+    engine.train_batch(batch)  # overflow 2: hysteresis exhausted -> backoff
+    assert engine.loss_scale < 2.0 ** 32
+
+
+def test_bf16_training():
+    engine = _make_engine(zero_stage=2, extra_cfg={"bf16": {"enabled": True}})
+    losses = _train(engine, steps=10)
+    assert losses[-1] < losses[0] * 0.7
+    # fp32 master weights preserved
+    assert engine.state.params["layer_0"]["w"].dtype == jnp.float32
+
+
+def test_lr_scheduler_integration():
+    engine = _make_engine(zero_stage=0, extra_cfg={
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-2,
+                                 "warmup_num_steps": 10, "warmup_type": "linear"}}})
+    batch = random_batches(1, 8, HIDDEN)[0]
+    engine.train_batch(batch)
+    first_lr = engine._last_metrics["lr"]
+    for _ in range(5):
+        engine.train_batch(batch)
+    assert engine._last_metrics["lr"] > first_lr
+
+
+def test_topology_tp_axis_free():
+    """Engine trains with a tp/sp-carved mesh even when the model ignores tp."""
+    topo = Topology(TopologySpec(tp=2))
+    engine = _make_engine(zero_stage=3, topology=topo)
+    losses = _train(engine, steps=8)
+    assert losses[-1] < losses[0] * 0.6

@@ -1,0 +1,77 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (build_lr_schedule, one_cycle, warmup_cosine_lr,
+                                                warmup_decay_lr, warmup_lr)
+from deepspeed_tpu.runtime.loss_scaler import (LossScaleState, has_overflow,
+                                               make_loss_scale_state, update_loss_scale)
+
+
+def steps(n):
+    return jnp.arange(1, n + 1)
+
+
+def test_warmup_lr_linear():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=10, warmup_type="linear")
+    lrs = np.asarray(s(steps(20)))
+    np.testing.assert_allclose(lrs[4], 0.5, atol=1e-6)
+    np.testing.assert_allclose(lrs[10:], 1.0)
+    assert np.all(np.diff(lrs[:10]) >= 0)
+
+
+def test_warmup_decay_hits_zero():
+    s = warmup_decay_lr(total_num_steps=100, warmup_max_lr=1.0, warmup_num_steps=10,
+                        warmup_type="linear")
+    lrs = np.asarray(s(steps(100)))
+    assert lrs.max() <= 1.0 + 1e-6
+    np.testing.assert_allclose(lrs[-1], 0.0, atol=2e-2)
+
+
+def test_warmup_cosine():
+    s = warmup_cosine_lr(total_num_steps=100, warmup_num_steps=10, base_lr=2.0)
+    lrs = np.asarray(s(steps(100)))
+    assert lrs[9] <= 2.0 + 1e-5
+    assert lrs[-1] < 0.01
+
+
+def test_one_cycle_shape():
+    s = one_cycle(cycle_min_lr=0.1, cycle_max_lr=1.0, cycle_first_step_size=10)
+    lrs = np.asarray(s(steps(30)))
+    peak = np.argmax(lrs)
+    assert 8 <= peak <= 11
+    np.testing.assert_allclose(lrs.max(), 1.0, atol=0.05)
+
+
+def test_build_unknown_raises():
+    with pytest.raises(ValueError):
+        build_lr_schedule("Bogus", {})
+
+
+def test_loss_scaler_overflow_backoff():
+    st = make_loss_scale_state(initial_scale_power=4, hysteresis=1)
+    assert float(st.scale) == 16.0
+    st = update_loss_scale(st, jnp.asarray(True), min_scale=1.0, max_hysteresis=1)
+    assert float(st.scale) == 8.0
+
+
+def test_loss_scaler_hysteresis():
+    st = make_loss_scale_state(initial_scale_power=4, hysteresis=2)
+    st = update_loss_scale(st, jnp.asarray(True), max_hysteresis=2)
+    assert float(st.scale) == 16.0 and int(st.hysteresis) == 1  # tolerated
+    st = update_loss_scale(st, jnp.asarray(True), max_hysteresis=2)
+    assert float(st.scale) == 8.0  # now backed off
+
+
+def test_loss_scaler_growth():
+    st = make_loss_scale_state(initial_scale_power=2, hysteresis=1)
+    for _ in range(4):
+        st = update_loss_scale(st, jnp.asarray(False), scale_window=2, max_hysteresis=1)
+    assert float(st.scale) == 16.0  # grew twice: 4 -> 8 -> 16
+
+
+def test_has_overflow():
+    good = {"a": jnp.ones((3,)), "b": jnp.zeros((2,))}
+    bad = {"a": jnp.asarray([1.0, jnp.inf]), "b": jnp.zeros((2,))}
+    assert not bool(has_overflow(good))
+    assert bool(has_overflow(bad))

@@ -1,0 +1,98 @@
+"""Optimizer golden tests vs torch reference (the analogue of tests/unit/ops/adam)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.optimizers import (adagrad, build_optimizer, fused_adam, fused_lamb,
+                                          fused_lion)
+
+
+def _run_steps(tx, params, grads_list):
+    state = tx.init(params)
+    for g in grads_list:
+        updates, state = tx.update(g, state, params)
+        params = jax.tree.map(lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
+                              params, updates)
+    return params
+
+
+def test_adamw_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(0)
+    w0 = rng.normal(size=(4, 8)).astype(np.float32)
+    grads = [rng.normal(size=(4, 8)).astype(np.float32) for _ in range(5)]
+
+    tw = torch.nn.Parameter(torch.tensor(w0))
+    opt = torch.optim.AdamW([tw], lr=1e-2, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.01)
+    for g in grads:
+        tw.grad = torch.tensor(g)
+        opt.step()
+
+    tx = fused_adam(lr=1e-2, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.01, adam_w_mode=True)
+    jp = _run_steps(tx, {"w": jnp.asarray(w0)}, [{"w": jnp.asarray(g)} for g in grads])
+    np.testing.assert_allclose(np.asarray(jp["w"]), tw.detach().numpy(), rtol=2e-5, atol=2e-6)
+
+
+def test_plain_adam_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(1)
+    w0 = rng.normal(size=(16,)).astype(np.float32)
+    grads = [rng.normal(size=(16,)).astype(np.float32) for _ in range(3)]
+
+    tw = torch.nn.Parameter(torch.tensor(w0))
+    opt = torch.optim.Adam([tw], lr=1e-3, weight_decay=0.1)
+    for g in grads:
+        tw.grad = torch.tensor(g)
+        opt.step()
+
+    tx = fused_adam(lr=1e-3, weight_decay=0.1, adam_w_mode=False)
+    jp = _run_steps(tx, {"w": jnp.asarray(w0)}, [{"w": jnp.asarray(g)} for g in grads])
+    np.testing.assert_allclose(np.asarray(jp["w"]), tw.detach().numpy(), rtol=2e-5, atol=2e-6)
+
+
+def test_lamb_trust_ratio_bounds():
+    tx = fused_lamb(lr=1e-2, min_coeff=0.5, max_coeff=2.0)
+    params = {"w": jnp.ones((8, 8)) * 10.0}
+    state = tx.init(params)
+    updates, _ = tx.update({"w": jnp.ones((8, 8)) * 1e-6}, state, params)
+    # tiny grad -> huge trust ratio, must clip at max_coeff
+    assert np.all(np.isfinite(np.asarray(updates["w"])))
+
+
+def test_lion_sign_update():
+    tx = fused_lion(lr=1e-2, betas=(0.9, 0.99), weight_decay=0.0)
+    params = {"w": jnp.zeros((4,))}
+    state = tx.init(params)
+    updates, _ = tx.update({"w": jnp.asarray([5.0, -3.0, 0.5, -0.1])}, state, params)
+    np.testing.assert_allclose(np.asarray(updates["w"]),
+                               [-1e-2, 1e-2, -1e-2, 1e-2], rtol=1e-6)
+
+
+def test_adagrad_accumulates():
+    tx = adagrad(lr=1.0, eps=0.0)
+    params = {"w": jnp.zeros((2,))}
+    state = tx.init(params)
+    g = {"w": jnp.asarray([3.0, 4.0])}
+    u1, state = tx.update(g, state, params)
+    np.testing.assert_allclose(np.asarray(u1["w"]), [-1.0, -1.0])
+    u2, state = tx.update(g, state, params)
+    np.testing.assert_allclose(np.asarray(u2["w"]), [-1 / np.sqrt(2), -1 / np.sqrt(2)], rtol=1e-6)
+
+
+def test_registry_names():
+    for name in ["Adam", "AdamW", "FusedAdam", "cpu_adam", "Lamb", "Lion", "Adagrad", "SGD"]:
+        tx = build_optimizer(name, {"lr": 1e-3})
+        assert hasattr(tx, "init") and hasattr(tx, "update")
+    with pytest.raises(ValueError):
+        build_optimizer("rmsprop_bogus")
+
+
+def test_bf16_params_fp32_state():
+    tx = fused_adam(lr=1e-2)
+    params = {"w": jnp.ones((4,), jnp.bfloat16)}
+    state = tx.init(params)
+    assert state.exp_avg["w"].dtype == jnp.float32
+    updates, state = tx.update({"w": jnp.ones((4,), jnp.bfloat16)}, state, params)
+    assert updates["w"].dtype == jnp.float32
