@@ -1,0 +1,187 @@
+"""Checkpoint save/load + universal (reshardable) checkpoints.
+
+Reference: engine ``save_checkpoint``/``load_checkpoint``
+(``runtime/engine.py:3140,2794``), the pluggable ``CheckpointEngine``
+(``runtime/checkpoint_engine/checkpoint_engine.py:9``), and the universal
+checkpoint pipeline (``checkpoint/ds_to_universal.py``).
+
+TPU-native design: orbax stores every array as a *logical global* tensor
+regardless of how it was sharded in memory, so a checkpoint written at one
+(dp, tp, pp, sp) topology restores under any other simply by passing the new
+shardings — the reference's per-rank ``zero_pp_rank_*`` shard files and the
+offline extract/merge reshard pipeline collapse into the storage format
+itself. ``zero_to_fp32`` (offline consolidation, reference
+``utils/zero_to_fp32.py``) becomes a read-with-replicated-sharding.
+
+Layout under ``<save_dir>/<tag>/``:
+  ``state/``        orbax pytree of TrainState (params, opt, loss scale, step)
+  ``metadata.json`` config snapshot, topology, client_state
+``<save_dir>/latest`` holds the most recent tag (reference tag file).
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+
+try:
+    import orbax.checkpoint as ocp
+except ImportError:  # pragma: no cover
+    ocp = None
+
+
+class CheckpointEngine:
+    """Pluggable storage backend (reference ``CheckpointEngine`` ABC)."""
+
+    def save(self, tree: Any, path: str):
+        raise NotImplementedError
+
+    def load(self, path: str, template: Any = None, shardings: Any = None) -> Any:
+        raise NotImplementedError
+
+    def wait(self):
+        pass
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """Default engine (analogue of ``TorchCheckpointEngine``); ``use_async``
+    gives background writes like the reference's Nebula/DataStates async tier."""
+
+    def __init__(self, use_async: bool = False):
+        self.use_async = use_async
+        self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler()) if use_async \
+            else ocp.Checkpointer(ocp.StandardCheckpointHandler())
+
+    def save(self, tree: Any, path: str):
+        self._ckptr.save(path, args=ocp.args.StandardSave(tree), force=True)
+
+    def load(self, path: str, template: Any = None, shardings: Any = None) -> Any:
+        if template is not None and shardings is not None:
+            abstract = jax.tree.map(
+                lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+                template, shardings)
+            return self._ckptr.restore(path, args=ocp.args.StandardRestore(abstract))
+        return self._ckptr.restore(path)
+
+    def wait(self):
+        if self.use_async:
+            self._ckptr.wait_until_finished()
+
+
+def _state_to_tree(engine) -> Dict[str, Any]:
+    s = engine.state
+    return {"step": s.step, "params": s.params, "opt_state": s.opt_state,
+            "loss_scale": {"scale": s.loss_scale.scale, "good_steps": s.loss_scale.good_steps,
+                           "hysteresis": s.loss_scale.hysteresis}}
+
+
+def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                    client_state: Optional[dict] = None, save_latest: bool = True):
+    """Reference ``engine.save_checkpoint:3140``. Collective: every process
+    must call it (orbax coordinates multi-host writes)."""
+    tag = tag if tag is not None else f"global_step{engine.global_steps}"
+    path = os.path.join(os.path.abspath(save_dir), str(tag))
+    ck = _get_ckpt_engine(engine)
+    ck.save(_state_to_tree(engine), os.path.join(path, "state"))
+    meta = {
+        "tag": str(tag),
+        "global_steps": engine.global_steps,
+        "skipped_steps": engine.skipped_steps,
+        "config": engine.config.to_dict(),
+        "topology": {"pp": engine.topo.pp_size, "dp": engine.topo.dp_size,
+                     "ep": engine.topo.ep_size, "sp": engine.topo.sp_size,
+                     "tp": engine.topo.tp_size},
+        "client_state": client_state or {},
+    }
+    if jax.process_index() == 0:
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+        if save_latest:
+            with open(os.path.join(os.path.abspath(save_dir), "latest"), "w") as f:
+                f.write(str(tag))
+    log_dist(f"saved checkpoint {path}")
+    return path
+
+
+def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                    load_optimizer_states: bool = True, load_lr_scheduler_states: bool = True,
+                    load_module_only: bool = False):
+    """Reference ``engine.load_checkpoint:2794``. Resharding to the *current*
+    topology is automatic (universal-checkpoint semantics, reference
+    ``load_universal_checkpoint`` flag ``engine.py:867``): the stored global
+    arrays are re-laid-out onto this engine's shardings."""
+    load_dir = os.path.abspath(load_dir)
+    if tag is None:
+        latest_path = os.path.join(load_dir, "latest")
+        if not os.path.exists(latest_path):
+            logger.warning(f"no 'latest' file in {load_dir}; nothing loaded")
+            return None, {}
+        tag = open(latest_path).read().strip()
+    path = os.path.join(load_dir, str(tag))
+    ck = _get_ckpt_engine(engine)
+
+    template = _state_to_tree(engine)
+    shardings = jax.tree.map(lambda x: x.sharding, template)
+    tree = ck.load(os.path.join(path, "state"), template=template, shardings=shardings)
+
+    from ..runtime.engine import TrainState
+    from ..runtime.loss_scaler import LossScaleState
+
+    ls = LossScaleState(scale=tree["loss_scale"]["scale"],
+                        good_steps=tree["loss_scale"]["good_steps"],
+                        hysteresis=tree["loss_scale"]["hysteresis"])
+    if load_module_only or not load_optimizer_states:
+        opt_state = engine.state.opt_state
+        step = engine.state.step
+        ls = engine.state.loss_scale
+    else:
+        opt_state, step = tree["opt_state"], tree["step"]
+    engine.state = TrainState(step=step, params=tree["params"], opt_state=opt_state,
+                              loss_scale=ls)
+
+    meta_path = os.path.join(path, "metadata.json")
+    meta = json.load(open(meta_path)) if os.path.exists(meta_path) else {}
+    engine.global_steps = meta.get("global_steps", int(np.asarray(step)))
+    engine.skipped_steps = meta.get("skipped_steps", 0)
+    log_dist(f"loaded checkpoint {path} (saved at topology {meta.get('topology')})")
+    return path, meta.get("client_state", {})
+
+
+def _get_ckpt_engine(engine) -> CheckpointEngine:
+    if getattr(engine, "_ckpt_engine", None) is None:
+        engine._ckpt_engine = OrbaxCheckpointEngine(
+            use_async=engine.config.checkpoint.async_save)
+    return engine._ckpt_engine
+
+
+# ---------------------------------------------------------------------------
+# Offline tools
+# ---------------------------------------------------------------------------
+
+
+def zero_to_fp32(checkpoint_dir: str, output_file: Optional[str] = None, tag: Optional[str] = None):
+    """Consolidate a checkpoint into a flat fp32 numpy ``.npz`` of params
+    (reference ``utils/zero_to_fp32.py`` — there it must merge ZeRO shard
+    files; here the store is already logical-global, so this is a read)."""
+    checkpoint_dir = os.path.abspath(checkpoint_dir)
+    if tag is None:
+        tag = open(os.path.join(checkpoint_dir, "latest")).read().strip()
+    path = os.path.join(checkpoint_dir, str(tag), "state")
+    ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
+    tree = ckptr.restore(path)
+    params = tree["params"]
+    flat = {"/".join(map(str, [getattr(e, 'key', e) for e in kp])): np.asarray(v, np.float32)
+            for kp, v in jax.tree_util.tree_flatten_with_path(params)[0]}
+    if output_file:
+        np.savez(output_file, **flat)
+        logger.info(f"wrote {len(flat)} fp32 tensors to {output_file}")
+    return flat
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str, tag: Optional[str] = None):
+    """Reference ``get_fp32_state_dict_from_zero_checkpoint`` API."""
+    return zero_to_fp32(checkpoint_dir, output_file=None, tag=tag)
