@@ -59,11 +59,17 @@ class OrbaxCheckpointEngine(CheckpointEngine):
     def save(self, tree: Any, path: str):
         self._ckptr.save(path, args=ocp.args.StandardSave(tree), force=True)
 
-    def load(self, path: str, template: Any = None, shardings: Any = None) -> Any:
+    def load(self, path: str, template: Any = None, shardings: Any = None,
+             partial: bool = False) -> Any:
         if template is not None and shardings is not None:
             abstract = jax.tree.map(
                 lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
                 template, shardings)
+            if partial:  # restore a subtree only (skips reading dropped keys)
+                ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+                restore_args = ocp.checkpoint_utils.construct_restore_args(abstract)
+                return ckptr.restore(path, args=ocp.args.PyTreeRestore(
+                    item=abstract, restore_args=restore_args, partial_restore=True))
             return self._ckptr.restore(path, args=ocp.args.StandardRestore(abstract))
         return self._ckptr.restore(path)
 
@@ -87,6 +93,9 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     path = os.path.join(os.path.abspath(save_dir), str(tag))
     ck = _get_ckpt_engine(engine)
     ck.save(_state_to_tree(engine), os.path.join(path, "state"))
+    # 'latest' must only ever point at a durable checkpoint: an async save
+    # returns before the write lands, so block before committing the pointer.
+    ck.wait()
     meta = {
         "tag": str(tag),
         "global_steps": engine.global_steps,
@@ -124,22 +133,26 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     path = os.path.join(load_dir, str(tag))
     ck = _get_ckpt_engine(engine)
 
+    params_only = load_module_only or not load_optimizer_states
     template = _state_to_tree(engine)
+    if params_only:  # don't read + reshard ~2x-params of optimizer state just to drop it
+        template = {"params": template["params"]}
     shardings = jax.tree.map(lambda x: x.sharding, template)
-    tree = ck.load(os.path.join(path, "state"), template=template, shardings=shardings)
+    tree = ck.load(os.path.join(path, "state"), template=template, shardings=shardings,
+                   partial=params_only)
 
     from ..runtime.engine import TrainState
     from ..runtime.loss_scaler import LossScaleState
 
-    ls = LossScaleState(scale=tree["loss_scale"]["scale"],
-                        good_steps=tree["loss_scale"]["good_steps"],
-                        hysteresis=tree["loss_scale"]["hysteresis"])
-    if load_module_only or not load_optimizer_states:
+    if params_only:
         opt_state = engine.state.opt_state
         step = engine.state.step
         ls = engine.state.loss_scale
     else:
         opt_state, step = tree["opt_state"], tree["step"]
+        ls = LossScaleState(scale=tree["loss_scale"]["scale"],
+                            good_steps=tree["loss_scale"]["good_steps"],
+                            hysteresis=tree["loss_scale"]["hysteresis"])
     engine.state = TrainState(step=step, params=tree["params"], opt_state=opt_state,
                               loss_scale=ls)
 

@@ -1,0 +1,377 @@
+"""Config-driven decoder transformer covering the reference's model families.
+
+The reference ships per-architecture injection containers
+(``module_inject/containers/{gpt2,llama,llama2,...}``) and fused CUDA layers
+(``DeepSpeedTransformerLayer``, ``ops/transformer/transformer.py:296``). Here
+one flax module family covers GPT-2 (learned positions, LayerNorm, GELU),
+Llama/Mistral (RoPE, RMSNorm, SwiGLU, GQA), and Mixtral (MoE blocks), designed
+TPU-first:
+
+* matmuls stay large + bf16 (MXU), logits in fp32;
+* tensor parallelism is Megatron-style column/row sharding expressed as
+  PartitionSpecs (``param_specs``) — XLA inserts the TP collectives;
+* sequence parallelism (Ulysses) wraps the attention core with head-scatter /
+  seq-gather all-to-alls (``sequence/layer.py``);
+* per-layer rematerialization via ``jax.checkpoint`` replaces the reference's
+  activation-checkpointing runtime (``runtime/activation_checkpointing``).
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    intermediate_size: int = 1376
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: Optional[int] = None          # GQA; None -> = num_heads
+    max_seq_len: int = 2048
+    # family switches
+    norm: str = "rmsnorm"                       # rmsnorm (llama) | layernorm (gpt2)
+    activation: str = "swiglu"                  # swiglu (llama) | gelu (gpt2)
+    position: str = "rope"                      # rope (llama) | learned (gpt2)
+    tie_embeddings: bool = False
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dropout: float = 0.0
+    # MoE (mixtral): replace the MLP every `moe_every` layers
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 0.01
+    # execution
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    remat_policy: Optional[str] = None
+    sequence_parallel: bool = False             # Ulysses over the 'sp' axis
+    attn_impl: str = "auto"                     # auto | xla | flash (pallas)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self):
+        return self.num_kv_heads or self.num_heads
+
+
+def _norm(cfg, name):
+    if cfg.norm == "rmsnorm":
+        return nn.RMSNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name=name)
+    return nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name=name)
+
+
+def rope_table(seq_len: int, head_dim: int, theta: float):
+    pos = np.arange(seq_len)
+    freqs = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+    angles = np.outer(pos, freqs)
+    return jnp.asarray(np.cos(angles)), jnp.asarray(np.sin(angles))
+
+
+def apply_rope(x, cos, sin, positions=None):
+    """x: [B, S, H, D]; rotate pairs (even, odd) halves interleaved-free."""
+    if positions is None:
+        cos_p = cos[None, :x.shape[1], None, :]
+        sin_p = sin[None, :x.shape[1], None, :]
+    else:
+        cos_p = cos[positions][:, :, None, :]
+        sin_p = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * cos_p - x2 * sin_p, x2 * cos_p + x1 * sin_p], axis=-1)
+    return out.astype(x.dtype)
+
+
+def attention_core(q, k, v, *, causal: bool = True, impl: str = "auto",
+                   positions_q=None, positions_kv=None):
+    """[B, S, H, D] attention. ``flash`` uses the Pallas kernel on TPU;
+    ``xla`` is the jnp reference (fused well by XLA on small shapes)."""
+    if impl == "flash":
+        from ..ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    # GQA: repeat kv heads
+    if k.shape[2] != h:
+        rep = h // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / np.sqrt(d)
+    # fp32 accumulation off the MXU (free on TPU), so softmax sees full precision
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        pq = positions_q if positions_q is not None else jnp.arange(sq)[:, None]
+        pk = positions_kv if positions_kv is not None else jnp.arange(skv)[None, :]
+        mask = pq >= pk  # [sq, skv]
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic=True):
+        cfg = self.cfg
+        h, hk, d = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        dense = partial(nn.DenseGeneral, use_bias=(cfg.norm == "layernorm"),
+                        dtype=cfg.dtype, param_dtype=jnp.float32)
+        q = dense(features=(h, d), name="q_proj")(x)
+        k = dense(features=(hk, d), name="k_proj")(x)
+        v = dense(features=(hk, d), name="v_proj")(x)
+
+        if cfg.position == "rope":
+            cos, sin = rope_table(cfg.max_seq_len, d, cfg.rope_theta)
+
+        impl = "xla" if cfg.attn_impl == "auto" else cfg.attn_impl
+
+        # Ulysses only in real execution: flax init traces tiny batches that
+        # need not divide the mesh, and attention adds no params anyway.
+        if cfg.sequence_parallel and not self.is_initializing():
+            from ..sequence.layer import ulysses_attention
+
+            def local_attn(q_, k_, v_, pos):
+                if cfg.position == "rope":
+                    q_ = apply_rope(q_, cos, sin, pos)
+                    k_ = apply_rope(k_, cos, sin, pos)
+                return attention_core(q_, k_, v_, causal=True, impl=impl)
+
+            out = ulysses_attention(local_attn, q, k, v)
+        else:
+            if cfg.position == "rope":
+                q = apply_rope(q, cos, sin)
+                k = apply_rope(k, cos, sin)
+            out = attention_core(q, k, v, causal=True, impl=impl)
+
+        out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                              use_bias=(cfg.norm == "layernorm"), dtype=cfg.dtype,
+                              param_dtype=jnp.float32, name="o_proj")(out)
+        if cfg.dropout > 0 and not deterministic:
+            out = nn.Dropout(rate=cfg.dropout)(out, deterministic=False)
+        return out
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        bias = cfg.norm == "layernorm"
+        if cfg.activation == "swiglu":
+            gate = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype,
+                            param_dtype=jnp.float32, name="gate_proj")(x)
+            up = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype,
+                          param_dtype=jnp.float32, name="up_proj")(x)
+            hidden = nn.silu(gate) * up
+        else:
+            hidden = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype,
+                              param_dtype=jnp.float32, name="up_proj")(x)
+            hidden = nn.gelu(hidden)
+        return nn.Dense(cfg.hidden_size, use_bias=bias, dtype=cfg.dtype,
+                        param_dtype=jnp.float32, name="down_proj")(hidden)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    layer_idx: int = 0
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):  # positional for nn.remat static_argnums
+        cfg = self.cfg
+        y = _norm(cfg, "attn_norm")(x)
+        x = x + Attention(cfg, name="attn")(y, deterministic=deterministic)
+        y = _norm(cfg, "mlp_norm")(x)
+        use_moe = cfg.num_experts > 0 and (self.layer_idx % cfg.moe_every == 0)
+        if use_moe:
+            from ..moe.layer import MoEBlock
+
+            mlp_out, aux = MoEBlock(cfg, name="moe")(y)
+            self.sow("intermediates", "moe_aux_loss", aux)
+        else:
+            mlp_out = MLP(cfg, name="mlp")(y)
+        return x + mlp_out
+
+
+class TransformerLM(nn.Module):
+    """Causal LM. ``__call__(tokens [B,S]) -> logits [B,S,V] (fp32)``."""
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, deterministic=True):
+        cfg = self.cfg
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="embed")
+        x = embed(tokens)
+        if cfg.position == "learned":
+            pos_emb = self.param("pos_embed", nn.initializers.normal(0.02),
+                                 (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
+            x = x + pos_emb[None, :x.shape[1]].astype(cfg.dtype)
+
+        block = Block
+        if cfg.remat:
+            policy = None
+            if cfg.remat_policy:
+                policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
+            block = nn.remat(Block, policy=policy, static_argnums=(2,))
+        for i in range(cfg.num_layers):
+            x = block(cfg, i, name=f"layer_{i}")(x, deterministic)
+        x = _norm(cfg, "final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                              param_dtype=jnp.float32, name="lm_head")(x.astype(jnp.float32))
+        return logits
+
+
+# ---------------------------------------------------------------------------
+# Loss + init + TP specs
+# ---------------------------------------------------------------------------
+
+
+def causal_lm_loss(logits, tokens, loss_mask=None, z_loss: float = 0.0):
+    """Next-token cross entropy; ignores the final position."""
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    tgt_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - tgt_logit
+    if z_loss > 0:
+        nll = nll + z_loss * jnp.square(logz)
+    if loss_mask is not None:
+        m = loss_mask[:, 1:].astype(nll.dtype)
+        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.mean(nll)
+
+
+def make_loss_fn(model: TransformerLM):
+    """Engine-compatible ``loss = f(params, batch, rng)``; adds MoE aux loss."""
+    cfg = model.cfg
+
+    def loss_fn(params, batch, rng=None):
+        tokens = batch["tokens"] if isinstance(batch, dict) else batch
+        mask = batch.get("loss_mask") if isinstance(batch, dict) else None
+        kwargs = {}
+        deterministic = True
+        if rng is not None and cfg.dropout > 0:
+            kwargs["rngs"] = {"dropout": rng}
+            deterministic = False
+        if cfg.num_experts > 0:
+            logits, mod_vars = model.apply({"params": params}, tokens,
+                                           deterministic=deterministic,
+                                           mutable=["intermediates"], **kwargs)
+            flat = jax.tree_util.tree_flatten_with_path(mod_vars.get("intermediates", {}))[0]
+            aux_losses = [leaf for path, leaf in flat
+                          if any("moe_aux_loss" in str(getattr(e, "key", e)) for e in path)]
+            aux = sum(aux_losses) / max(len(aux_losses), 1) if aux_losses else 0.0
+            return causal_lm_loss(logits, tokens, mask) + aux
+        logits = model.apply({"params": params}, tokens, deterministic=deterministic, **kwargs)
+        return causal_lm_loss(logits, tokens, mask)
+
+    return loss_fn
+
+
+def init_params(model: TransformerLM, seed: int = 0, batch: int = 2, seq: Optional[int] = None):
+    seq = seq or min(model.cfg.max_seq_len, 128)
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    return model.init(jax.random.PRNGKey(seed), tokens)["params"]
+
+
+def param_specs(params, tp_axis: str = "tp") -> Any:
+    """Megatron-style TP PartitionSpecs by parameter path (reference AutoTP
+    ``module_inject/auto_tp.py:189`` infers the same split from layer names):
+    q/k/v/gate/up column-parallel (shard output dim), o/down row-parallel
+    (shard input dim), embeddings sharded over vocab/hidden, experts over 'ep'.
+    """
+
+    def spec_for(path_keys, leaf):
+        path = "/".join(path_keys)
+        is_bias = path_keys[-1] == "bias"
+        nd = leaf.ndim
+        if "expert" in path:  # MoE expert stacks: [E, ...] over ep
+            if "down_proj" in path:
+                return P("ep", tp_axis, None)
+            if nd >= 3:
+                return P("ep", None, tp_axis)
+            return P("ep")
+        if any(k in path for k in ("q_proj", "k_proj", "v_proj")):
+            if is_bias:  # [H, Dh]: shard heads like the kernel
+                return P(tp_axis, None) if nd == 2 else P(tp_axis)
+            # DenseGeneral kernel [D, H, Dh]: shard heads (column-parallel)
+            return P(None, tp_axis, None) if nd == 3 else P(None, tp_axis)
+        if "gate_proj" in path or "up_proj" in path:
+            if is_bias:  # [F]: shards with the column-parallel output dim
+                return P(tp_axis)
+            return P(None, tp_axis) if nd == 2 else P(tp_axis)
+        if "o_proj" in path:
+            if is_bias:  # [D]: row-parallel output is replicated
+                return P(None)
+            # DenseGeneral kernel [H, Dh, D]: shard heads (row-parallel)
+            return P(tp_axis, None, None) if nd == 3 else P(tp_axis, None)
+        if "down_proj" in path:
+            if is_bias:
+                return P(None)
+            return P(tp_axis, None) if nd == 2 else P()
+        if not is_bias and "embed" in path and nd == 2:
+            return P(None, tp_axis)
+        if not is_bias and "lm_head" in path and nd == 2:
+            return P(None, tp_axis)
+        return P(*([None] * nd))
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = []
+    for kp, leaf in flat:
+        keys = [str(getattr(e, "key", getattr(e, "name", e))) for e in kp]
+        specs.append(spec_for(keys, leaf))
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+# ---------------------------------------------------------------------------
+# Family presets (reference model-implementations inventory, SURVEY.md §2.6)
+# ---------------------------------------------------------------------------
+
+
+def gpt2_config(size: str = "small", **overrides) -> TransformerConfig:
+    dims = {"small": (768, 12, 12), "medium": (1024, 24, 16), "large": (1280, 36, 20),
+            "xl": (1600, 48, 25)}[size]
+    d, l, h = dims
+    base = dict(vocab_size=50257, hidden_size=d, intermediate_size=4 * d, num_layers=l,
+                num_heads=h, max_seq_len=1024, norm="layernorm", activation="gelu",
+                position="learned", tie_embeddings=True)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def llama_config(size: str = "7b", **overrides) -> TransformerConfig:
+    dims = {"tiny": (256, 4, 8, 8, 688), "1b": (2048, 22, 32, 4, 5632),
+            "7b": (4096, 32, 32, 32, 11008), "13b": (5120, 40, 40, 40, 13824)}[size]
+    d, l, h, hk, f = dims
+    base = dict(vocab_size=32000, hidden_size=d, intermediate_size=f, num_layers=l,
+                num_heads=h, num_kv_heads=hk, max_seq_len=4096, norm="rmsnorm",
+                activation="swiglu", position="rope")
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def mixtral_config(size: str = "tiny", **overrides) -> TransformerConfig:
+    dims = {"tiny": (256, 4, 8, 8, 512, 4), "8x7b": (4096, 32, 32, 8, 14336, 8)}[size]
+    d, l, h, hk, f, e = dims
+    base = dict(vocab_size=32000, hidden_size=d, intermediate_size=f, num_layers=l,
+                num_heads=h, num_kv_heads=hk, max_seq_len=4096, norm="rmsnorm",
+                activation="swiglu", position="rope", num_experts=e, moe_top_k=2)
+    base.update(overrides)
+    return TransformerConfig(**base)

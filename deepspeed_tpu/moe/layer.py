@@ -1,0 +1,72 @@
+"""MoE block module (reference ``MoE``, ``deepspeed/moe/layer.py:17`` +
+``MOELayer``, ``sharded_moe.py:533``).
+
+Expert parallelism TPU-style: expert weights are stacked ``[E, ...]`` arrays
+sharded over the ``ep`` mesh axis (see ``models/transformer.py::param_specs``);
+dispatching tokens to experts is an einsum into expert-major layout with a
+sharding constraint, which XLA lowers to the same all-to-all pattern the
+reference issues via ``_AllToAll`` (``sharded_moe.py:96``). Expert-vs-dense
+gradient separation (reference ``engine._reduce_expert_gradients:2510``) is
+automatic: expert params are sharded over ``ep``, so SPMD autodiff reduces
+their grads only over the remaining data axes.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from .sharded_moe import compute_capacity, moe_combine, moe_dispatch, topk_gating
+
+
+def _constrain(x, spec):
+    try:
+        from ..parallel.topology import get_topology
+
+        topo = get_topology()
+        if topo.n_devices > 1:
+            return jax.lax.with_sharding_constraint(
+                x, jax.sharding.NamedSharding(topo.mesh, spec))
+    except Exception:
+        pass
+    return x
+
+
+class MoEBlock(nn.Module):
+    """Drop-in MLP replacement returning ``(out, aux_loss)``."""
+    cfg: object  # TransformerConfig
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        cfg = self.cfg
+        g, s, d = x.shape
+        e, k = cfg.num_experts, cfg.moe_top_k
+        f = cfg.intermediate_size
+        capacity = compute_capacity(k, s, e, cfg.moe_capacity_factor)
+
+        # router in fp32 (reference TopKGate keeps the gate fp32)
+        router = nn.Dense(e, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
+                          name="router")
+        logits = router(x.astype(jnp.float32))
+        dispatch, combine, aux = topk_gating(logits, k, capacity)
+
+        # expert-major dispatch: [E, G, C, D], experts over the ep axis
+        expert_in = moe_dispatch(x, dispatch)
+        expert_in = _constrain(expert_in, P("ep", ("dp_outer",), None, None))
+
+        init = nn.initializers.lecun_normal()
+        w_gate = self.param("expert_gate_proj", init, (e, d, f), jnp.float32)
+        w_up = self.param("expert_up_proj", init, (e, d, f), jnp.float32)
+        w_down = self.param("expert_down_proj", init, (e, f, d), jnp.float32)
+
+        h = jnp.einsum("egcd,edf->egcf", expert_in, w_gate.astype(x.dtype))
+        u = jnp.einsum("egcd,edf->egcf", expert_in, w_up.astype(x.dtype))
+        h = nn.silu(h) * u
+        out = jnp.einsum("egcf,efd->egcd", h, w_down.astype(x.dtype))
+        out = _constrain(out, P("ep", ("dp_outer",), None, None))
+
+        y = moe_combine(out, combine)
+        y = _constrain(y, P(("dp_outer", "ep"), None, None))
+        return y.astype(x.dtype), aux * cfg.moe_aux_loss_weight

@@ -1,0 +1,85 @@
+"""MoE gating + dispatch math (GShard-style).
+
+Reference: ``deepspeed/moe/sharded_moe.py`` — ``top1gating:183``,
+``top2gating:290``, ``topkgating:374``, ``MOELayer:533`` with einsum dispatch
+around all-to-alls. The gating math is pure tensor algebra and carries over;
+the *dispatch* is TPU-native: instead of explicit ``_AllToAll`` autograd ops,
+expert-major tensors get sharding constraints (groups over dp, experts over
+the ``ep`` mesh axis) and XLA lowers the resharding to ICI all-to-alls.
+
+Shapes follow GShard: tokens [G, S, D] (G groups = batch), gates [G, S, E],
+dispatch/combine [G, S, E, C] with static capacity C.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def compute_capacity(k: int, tokens_per_group: int, num_experts: int,
+                     capacity_factor: float, min_capacity: int = 4) -> int:
+    cap = int(np.ceil(k * tokens_per_group * capacity_factor / num_experts))
+    return max(cap, min_capacity)
+
+
+def topk_gating(logits: jnp.ndarray, k: int, capacity: int,
+                rng: Optional[jax.Array] = None,
+                noisy_gate_policy: Optional[str] = None,
+                drop_tokens: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Generic top-k gating with capacity (covers reference top1/top2/topk).
+
+    Returns (dispatch [G,S,E,C] bool, combine [G,S,E,C] f32, aux_loss scalar).
+    """
+    g, s, e = logits.shape
+    logits = logits.astype(jnp.float32)
+    if noisy_gate_policy == "RSample" and rng is not None:
+        logits = logits + jax.random.normal(rng, logits.shape) / e
+    gates = jax.nn.softmax(logits, axis=-1)  # [G,S,E]
+
+    # aux load-balance loss from the top-1 assignment (reference top1gating:183)
+    top1 = jnp.argmax(gates, axis=-1)
+    me = jnp.mean(gates, axis=1)                            # [G,E] mean prob
+    ce = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=1)  # fraction
+    aux_loss = jnp.mean(jnp.sum(me * ce, axis=-1)) * e
+
+    remaining = gates
+    committed = jnp.zeros((g, 1, e), jnp.float32)  # tokens assigned per expert so far
+    dispatch = jnp.zeros((g, s, e, capacity), jnp.bool_)
+    combine = jnp.zeros((g, s, e, capacity), jnp.float32)
+    denom = jnp.zeros((g, s), jnp.float32)
+
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)                # [G,S]
+        mask = jax.nn.one_hot(idx, e, dtype=jnp.float32)    # [G,S,E]
+        gate_k = jnp.sum(gates * mask, axis=-1)             # [G,S]
+        # capacity slot = tokens assigned to this expert earlier in this round
+        # + total committed in previous rounds (reference top2gating locations2
+        # offset by sum(mask1))
+        pos_in_expert = jnp.cumsum(mask, axis=1) - mask + committed  # [G,S,E]
+        pos = jnp.sum(pos_in_expert * mask, axis=-1)        # [G,S]
+        keep = pos < capacity if drop_tokens else jnp.ones_like(pos, jnp.bool_)
+        pos_c = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+        slot = mask[..., None] * pos_c[:, :, None, :] * keep[:, :, None, None]  # [G,S,E,C]
+        dispatch = dispatch | (slot > 0)
+        combine = combine + slot * gate_k[:, :, None, None]
+        denom = denom + gate_k * keep
+        committed = committed + jnp.sum(mask, axis=1, keepdims=True)
+        remaining = remaining * (1.0 - mask)
+
+    # renormalize combine weights over the k selected experts (reference
+    # top2gating denominator)
+    combine = combine / jnp.maximum(denom, 1e-9)[:, :, None, None]
+    return dispatch, combine, aux_loss
+
+
+def moe_dispatch(x: jnp.ndarray, dispatch: jnp.ndarray) -> jnp.ndarray:
+    """tokens [G,S,D] x dispatch [G,S,E,C] -> expert inputs [E, G, C, D].
+    Expert-major layout so the 'ep' sharding sits on dim 0."""
+    return jnp.einsum("gsec,gsd->egcd", dispatch.astype(x.dtype), x)
+
+
+def moe_combine(expert_out: jnp.ndarray, combine: jnp.ndarray) -> jnp.ndarray:
+    """expert outputs [E,G,C,D] x combine [G,S,E,C] -> tokens [G,S,D]."""
+    return jnp.einsum("egcd,gsec->gsd", expert_out, combine.astype(expert_out.dtype))

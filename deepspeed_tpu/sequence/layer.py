@@ -1,0 +1,71 @@
+"""Ulysses sequence parallelism.
+
+Reference: ``DistributedAttention`` (``deepspeed/sequence/layer.py:271``) and
+``single_all_to_all:153`` — scatter heads / gather sequence with an all-to-all
+before any local attention, reverse after. On TPU the all-to-all is a native
+ICI collective (``lax.all_to_all`` over the ``sp`` mesh axis inside
+``shard_map``); comm volume stays O(N/P) per the Ulysses design.
+
+GQA/uneven heads (reference ``uneven_heads_all2all:43``): when kv heads don't
+divide the sp degree they are replicated up to the q-head count before the
+exchange.
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.topology import SP_AXIS, get_topology
+
+
+def _all_to_all_heads_to_seq(x, sp: int):
+    """[B, S/sp, H, D] -> [B, S, H/sp, D] over the sp axis."""
+    return jax.lax.all_to_all(x, SP_AXIS, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _all_to_all_seq_to_heads(x, sp: int):
+    """[B, S, H/sp, D] -> [B, S/sp, H, D]."""
+    return jax.lax.all_to_all(x, SP_AXIS, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(local_attn: Callable, q, k, v):
+    """Run ``local_attn(q, k, v, positions)`` under Ulysses SP.
+
+    Inputs are global ``[B, S, H, D]`` arrays whose S dim is sharded over the
+    ``sp`` mesh axis by the engine's batch spec. Inside the shard_map each rank
+    holds ``S/sp`` of the sequence with all heads; after the exchange it holds
+    the full sequence with ``H/sp`` heads — any local attention (including the
+    Pallas flash kernel) then works unchanged, with global positions.
+    """
+    topo = get_topology()
+    sp = topo.sp_size
+    if sp == 1:
+        return local_attn(q, k, v, None)
+
+    h, hk = q.shape[2], k.shape[2]
+    if hk % sp != 0:  # GQA uneven heads: replicate kv up to q heads
+        rep = h // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if h % sp != 0:
+        raise ValueError(f"num_heads={h} must be divisible by sp={sp}")
+
+    mesh = topo.mesh
+    dp = topo.dp_axes
+    # Compose with TP: heads arrive column-parallel over 'tp'; keep them
+    # sharded through the exchange so no tp all-gather is forced.
+    tp = topo.tp_size
+    heads_axis = "tp" if (tp > 1 and h % (sp * tp) == 0 and k.shape[2] % (sp * tp) == 0) else None
+    io_spec = P(dp, SP_AXIS, heads_axis, None)
+
+    def body(q_, k_, v_):
+        qg = _all_to_all_heads_to_seq(q_, sp)
+        kg = _all_to_all_heads_to_seq(k_, sp)
+        vg = _all_to_all_heads_to_seq(v_, sp)
+        out = local_attn(qg, kg, vg, None)  # full seq -> global positions
+        return _all_to_all_seq_to_heads(out, sp)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(io_spec, io_spec, io_spec),
+                         out_specs=io_spec, check_vma=False)(q, k, v)
