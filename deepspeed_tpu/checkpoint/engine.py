@@ -93,9 +93,6 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     path = os.path.join(os.path.abspath(save_dir), str(tag))
     ck = _get_ckpt_engine(engine)
     ck.save(_state_to_tree(engine), os.path.join(path, "state"))
-    # 'latest' must only ever point at a durable checkpoint: an async save
-    # returns before the write lands, so block before committing the pointer.
-    ck.wait()
     meta = {
         "tag": str(tag),
         "global_steps": engine.global_steps,
@@ -106,13 +103,31 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                      "tp": engine.topo.tp_size},
         "client_state": client_state or {},
     }
-    if jax.process_index() == 0:
-        with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump(meta, f, indent=2, default=str)
-        if save_latest:
-            with open(os.path.join(os.path.abspath(save_dir), "latest"), "w") as f:
-                f.write(str(tag))
-    log_dist(f"saved checkpoint {path}")
+
+    def _commit():
+        # 'latest' must only ever point at a durable checkpoint: wait for the
+        # array write to land before committing the pointer. Runs on a
+        # background thread for async saves so training overlaps the write.
+        ck.wait()
+        if jax.process_index() == 0:
+            with open(os.path.join(path, "metadata.json"), "w") as f:
+                json.dump(meta, f, indent=2, default=str)
+            if save_latest:
+                with open(os.path.join(os.path.abspath(save_dir), "latest"), "w") as f:
+                    f.write(str(tag))
+        log_dist(f"saved checkpoint {path}")
+
+    if getattr(ck, "use_async", False):
+        import threading
+
+        prev = getattr(engine, "_ckpt_commit_thread", None)
+        if prev is not None and prev.is_alive():
+            prev.join()  # serialize commits so 'latest' ordering is preserved
+        t = threading.Thread(target=_commit, daemon=False)
+        t.start()
+        engine._ckpt_commit_thread = t
+    else:
+        _commit()
     return path
 
 
@@ -124,6 +139,9 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     ``load_universal_checkpoint`` flag ``engine.py:867``): the stored global
     arrays are re-laid-out onto this engine's shardings."""
     load_dir = os.path.abspath(load_dir)
+    pending = getattr(engine, "_ckpt_commit_thread", None)
+    if pending is not None and pending.is_alive():
+        pending.join()  # an in-flight async save must land before we read 'latest'
     if tag is None:
         latest_path = os.path.join(load_dir, "latest")
         if not os.path.exists(latest_path):

@@ -136,7 +136,12 @@ class Attention(nn.Module):
         if cfg.position == "rope":
             cos, sin = rope_table(cfg.max_seq_len, d, cfg.rope_theta)
 
-        impl = "xla" if cfg.attn_impl == "auto" else cfg.attn_impl
+        impl = cfg.attn_impl
+        if impl == "auto":
+            # flash on real accelerators when the seq tiles cleanly; the XLA
+            # reference (O(S^2) logits) on CPU tests and odd shapes
+            seq = x.shape[1]
+            impl = "flash" if (jax.default_backend() != "cpu" and seq % 128 == 0) else "xla"
 
         # Ulysses only in real execution: flax init traces tiny batches that
         # need not divide the mesh, and attention adds no params anyway.

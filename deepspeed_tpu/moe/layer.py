@@ -21,16 +21,18 @@ from jax.sharding import PartitionSpec as P
 from .sharded_moe import compute_capacity, moe_combine, moe_dispatch, topk_gating
 
 
-def _constrain(x, spec):
-    try:
-        from ..parallel.topology import get_topology
+def _constrain(x, spec, skip: bool = False):
+    """Sharding constraint on the dispatch layout. ``skip`` during flax init,
+    where trace shapes need not divide the mesh; real misconfigurations (bad
+    axis names, indivisible expert counts) propagate."""
+    if skip:
+        return x
+    from ..parallel.topology import get_topology
 
-        topo = get_topology()
-        if topo.n_devices > 1:
-            return jax.lax.with_sharding_constraint(
-                x, jax.sharding.NamedSharding(topo.mesh, spec))
-    except Exception:
-        pass
+    topo = get_topology()
+    if topo.n_devices > 1:
+        return jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(topo.mesh, spec))
     return x
 
 
@@ -53,8 +55,9 @@ class MoEBlock(nn.Module):
         dispatch, combine, aux = topk_gating(logits, k, capacity)
 
         # expert-major dispatch: [E, G, C, D], experts over the ep axis
+        skip = self.is_initializing()
         expert_in = moe_dispatch(x, dispatch)
-        expert_in = _constrain(expert_in, P("ep", ("dp_outer",), None, None))
+        expert_in = _constrain(expert_in, P("ep", ("dp_outer",), None, None), skip)
 
         init = nn.initializers.lecun_normal()
         w_gate = self.param("expert_gate_proj", init, (e, d, f), jnp.float32)
@@ -65,8 +68,8 @@ class MoEBlock(nn.Module):
         u = jnp.einsum("egcd,edf->egcf", expert_in, w_up.astype(x.dtype))
         h = nn.silu(h) * u
         out = jnp.einsum("egcf,efd->egcd", h, w_down.astype(x.dtype))
-        out = _constrain(out, P("ep", ("dp_outer",), None, None))
+        out = _constrain(out, P("ep", ("dp_outer",), None, None), skip)
 
         y = moe_combine(out, combine)
-        y = _constrain(y, P(("dp_outer", "ep"), None, None))
+        y = _constrain(y, P(("dp_outer", "ep"), None, None), skip)
         return y.astype(x.dtype), aux * cfg.moe_aux_loss_weight

@@ -1,0 +1,328 @@
+"""Flash attention for TPU in Pallas (forward + backward).
+
+Replaces the reference's fused CUDA attention kernels
+(``csrc/transformer/*.cu`` training softmax/attention and the inference
+``blocked_flash`` family, SURVEY.md §2.5) with the online-softmax tiling
+scheme mapped to TPU: q/k/v blocks staged HBM→VMEM by the Pallas pipeline,
+logits computed on the MXU with fp32 accumulation, running (max, sum, acc)
+carried in VMEM scratch across the innermost (kv) grid dimension.
+
+Backward is the standard two-kernel scheme: residuals are ``(q, k, v, o, L)``
+where ``L = m + log(l)`` is the per-row logsumexp; one kernel accumulates
+dk/dv over q blocks, one accumulates dq over kv blocks.
+
+Layout convention: ``[B, S, H, D]`` at the API (matching
+``models/transformer.py``), transposed to ``[B, H, S, D]`` internally.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, acc_sc, m_sc, l_sc, *,
+                causal: bool, sm_scale: float, block_q: int, block_k: int):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale       # [Bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)                  # [Bk, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [Bq, Bk]
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_sc[:, :1]                                  # [Bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)             # [Bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                                # [Bq, Bk]
+        alpha = jnp.exp(m_prev - m_new)                       # [Bq, 1]
+        l_new = alpha * l_sc[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)                   # [Bk, D]
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_sc[:] = acc_sc[:] * alpha + pv
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    if causal:  # skip blocks fully above the diagonal
+        pl.when(ik * block_k <= iq * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_sc[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_sc[:] / safe_l).astype(o_ref.dtype)
+        # logsumexp residual for backward, lane-replicated (TPU tiling needs a
+        # 128-lane minor dim; official jax flash kernel uses the same layout)
+        l_ref[0, 0] = jnp.broadcast_to(m_sc[:, :1] + jnp.log(safe_l), l_ref.shape[2:])
+
+
+def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"seq lengths ({sq},{sk}) must be multiples of the block sizes "
+                         f"({block_q},{block_k}); pad the sequence")
+    nq, nk = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
+
+    grid = (b, h, nq, nk)
+    kernel = functools.partial(_fwd_kernel, causal=causal, sm_scale=sm_scale,
+                               block_q=block_q, block_k=block_k)
+    o, L = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, L
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, delta_ref,
+                     dk_ref, dv_ref, dk_sc, dv_sc, *,
+                     causal: bool, sm_scale: float, block_q: int, block_k: int):
+    ik, iq = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale        # [Bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)                   # [Bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)                 # [Bq, D]
+        L = l_ref[0, 0][:, :1]                                # [Bq, 1]
+        delta = delta_ref[0, 0][:, :1]                        # [Bq, 1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [Bq, Bk]
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - L)                                    # [Bq, Bk]
+        # dv += p^T @ do
+        dv_sc[:] = dv_sc[:] + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                                  preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)  # [Bq, Bk]
+        ds = p * (dp - delta)                                 # [Bq, Bk]
+        # dk += ds^T @ q (q already has sm_scale folded in)
+        dk_sc[:] = dk_sc[:] + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                                  preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(ik * block_k <= iq * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, delta_ref,
+                   dq_ref, dq_sc, *,
+                   causal: bool, sm_scale: float, block_q: int, block_k: int):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        L = l_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - L)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_sc[:] = dq_sc[:] + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                                  preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(ik * block_k <= iq * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = (dq_sc[:] * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_backward(res, g, causal, sm_scale, block_q, block_k, interpret):
+    q, k, v, o, L = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    nq, nk = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
+
+    do = g.astype(jnp.float32)
+    delta = jnp.sum(do * o.astype(jnp.float32), axis=-1)  # [B,H,Sq]
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
+
+    # dk/dv: grid (b, h, nk, nq) — q innermost
+    dkdv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),  # q
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),  # k
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),  # v
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),  # do
+            pl.BlockSpec((1, 1, block_q, 128), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),  # L
+            pl.BlockSpec((1, 1, block_q, 128), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do.astype(q.dtype), L, delta)
+    dk, dv = dkdv
+
+    dq, = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k),
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sq, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do.astype(q.dtype), L, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API with custom VJP
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    o, _ = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    o, L = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, L)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    return _flash_backward(res, g, causal, sm_scale, block_q, block_k, interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+                    interpret: Optional[bool] = None):
+    """Flash attention over ``[B, S, H, D]`` tensors (GQA: kv heads repeated).
+
+    ``interpret=None`` auto-selects interpreter mode off-TPU so the same tests
+    run on the CPU mesh (the parity-test pattern of reference
+    ``tests/unit/ops``)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    h, hk = q.shape[2], k.shape[2]
+    if hk != h:  # GQA
+        rep = h // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    # [B,S,H,D] -> [B,H,S,D]
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    o = _flash(qt, kt, vt, causal, float(sm_scale), block_q, block_k, interpret)
+    return jnp.swapaxes(o, 1, 2)

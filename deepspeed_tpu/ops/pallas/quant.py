@@ -1,0 +1,151 @@
+"""Block int8 quantization kernels.
+
+Replaces the reference's CUDA quantization library (``csrc/quantization/*`` —
+block quantize/dequantize, quantized reduction for ZeRO++ qgZ, swizzled
+layouts for hierarchical all-to-all, SURVEY.md §2.5). TPU design per the
+EQuARX pattern (PAPERS.md): per-block absmax scales, int8 payloads, fp32
+scales side tensor; collectives then ride ICI at ~1/4 the bytes and
+dequantize-on-arrival.
+
+Layout: input flattened to ``[blocks, block_size]``; one scale per block.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK = 2048  # elements per quantization block (16 (32,128)-lanes rows of int8)
+
+
+def _interp(interpret):
+    return jax.default_backend() == "cpu" if interpret is None else interpret
+
+
+TILE_BLOCKS = 16  # quant blocks per kernel invocation (16*2048 f32 = 128 KB)
+
+
+def _quant_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[:]
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)            # [rows, 1]
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    q_ref[:] = q
+    s_ref[:] = jnp.broadcast_to(scale, s_ref.shape)
+
+
+def _dequant_kernel(q_ref, s_ref, x_ref):
+    x_ref[:] = q_ref[:].astype(jnp.float32) * s_ref[:, :1]
+
+
+def _tile_rows(nb: int) -> int:
+    t = min(TILE_BLOCKS, nb)
+    while nb % t:
+        t -= 1
+    return t
+
+
+def quantize_int8(x: jnp.ndarray, block: int = BLOCK,
+                  interpret=None) -> Tuple[jnp.ndarray, jnp.ndarray, tuple]:
+    """-> (int8 values [nb, block], fp32 scales [nb, 128], original shape).
+    Scales are lane-replicated (nb, 128) for TPU tiling; column 0 is
+    authoritative. Gridded so arbitrarily large tensors stream through VMEM."""
+    shape = x.shape
+    n = int(np.prod(shape)) if shape else 1
+    nb = -(-n // block)
+    flat = jnp.pad(jnp.ravel(x).astype(jnp.float32), (0, nb * block - n))
+    x2 = flat.reshape(nb, block)
+    t = _tile_rows(nb)
+    q, s = pl.pallas_call(
+        _quant_kernel,
+        grid=(nb // t,),
+        in_specs=[pl.BlockSpec((t, block), lambda i: (i, 0), memory_space=pltpu.VMEM)],
+        out_specs=[pl.BlockSpec((t, block), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                   pl.BlockSpec((t, 128), lambda i: (i, 0), memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((nb, block), jnp.int8),
+                   jax.ShapeDtypeStruct((nb, 128), jnp.float32)],
+        interpret=_interp(interpret),
+    )(x2)
+    return q, s, shape
+
+
+def dequantize_int8(q: jnp.ndarray, s: jnp.ndarray, shape, dtype=jnp.float32,
+                    interpret=None) -> jnp.ndarray:
+    n = int(np.prod(shape)) if shape else 1
+    nb, block = q.shape
+    if s.shape[-1] == 1:  # wire format carries one lane; restore tiling locally
+        s = jnp.broadcast_to(s, (nb, 128))
+    t = _tile_rows(nb)
+    x2 = pl.pallas_call(
+        _dequant_kernel,
+        grid=(nb // t,),
+        in_specs=[pl.BlockSpec((t, block), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                  pl.BlockSpec((t, 128), lambda i: (i, 0), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((t, block), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(q.shape, jnp.float32),
+        interpret=_interp(interpret),
+    )(q, s)
+    return x2.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Quantized collectives (ZeRO++ qwZ / qgZ equivalents)
+# ---------------------------------------------------------------------------
+
+
+def quantized_all_gather(x, axis, block: int = BLOCK):
+    """qwZ-style allgather: int8 payload + scales over the wire (reference
+    quantized weight allgather, ``partition_parameters.py:761``
+    ``CUDAQuantizer``). Call inside shard_map; returns ``[world, *x.shape]``."""
+    from ... import comm as dist
+
+    q, s, shape = quantize_int8(x, block)
+    nb = q.shape[0]
+    qg = dist.all_gather(q, axis=axis, tiled=False)           # [world, nb, block]
+    sg = dist.all_gather(s[:, :1], axis=axis, tiled=False)    # [world, nb, 1] — one lane on the wire
+    world = qg.shape[0]
+    n = int(np.prod(shape))
+    deq = dequantize_int8(qg.reshape(world * nb, block), sg.reshape(world * nb, 1),
+                          (world * nb * block,))
+    return deq.reshape(world, nb * block)[:, :n].reshape((world,) + tuple(shape))
+
+
+def quantized_reduce_scatter(x, axis, block: int = BLOCK):
+    """qgZ-flavored gradient reduction: quantize the local full-size grad,
+    all-to-all the int8 shards, dequantize and mean locally (reference qgZ
+    quantized grad all-to-all, ``engine.py:1193``; quant_reduce.cu). The
+    result is this rank's shard of the mean, fp32.
+
+    Requires ``x.size`` divisible by the axis size; caller pads.
+    """
+    from ... import comm as dist
+
+    world = jax.lax.axis_size(axis)
+    n = int(np.prod(x.shape))
+    if n % world:
+        raise ValueError(f"size {n} not divisible by axis size {world}")
+    shard = n // world
+    # block boundaries must align with shard boundaries so each rank's blocks
+    # are contiguous in the [nb, block] layout
+    if shard % block != 0:
+        if shard % 128 == 0:
+            block = 128
+        else:
+            raise ValueError(f"shard size {shard} must be a multiple of 128")
+    # lay out as [world, shard] so the all-to-all exchanges equal shards
+    parts = jnp.reshape(x.astype(jnp.float32), (world, shard))
+    q, s, _ = quantize_int8(parts, block)              # [nb, block] covering all parts
+    nb_per = q.shape[0] // world
+    q = q.reshape(world, nb_per, block)
+    s1 = s[:, :1].reshape(world, nb_per, 1)  # one scale lane over the wire
+    qt = dist.all_to_all(q, axis=axis, split_dim=0, concat_dim=0, tiled=False)
+    st = dist.all_to_all(s1, axis=axis, split_dim=0, concat_dim=0, tiled=False)
+    deq = dequantize_int8(qt.reshape(world * nb_per, block),
+                          st.reshape(world * nb_per, 1),
+                          (world * nb_per * block,))
+    deq = deq.reshape(world, nb_per * block)[:, :shard]
+    return jnp.mean(deq, axis=0)

@@ -83,3 +83,16 @@ def test_load_module_only(tmp_path):
     np.testing.assert_allclose(np.asarray(e2.state.params["head"]["w"]),
                                np.asarray(e1.state.params["head"]["w"]), rtol=1e-6)
     assert int(np.asarray(e2.state.opt_state.step)) == 0  # optimizer untouched
+
+
+def test_async_save_roundtrip(tmp_path):
+    e1 = _engine(1)
+    e1.config.checkpoint.async_save = True
+    batches = random_batches(4, 8, HIDDEN)
+    for b in batches[:2]:
+        e1.train_batch(b)
+    e1.save_checkpoint(str(tmp_path / "a"))  # returns promptly; commit in background
+    e2 = _engine(1)
+    e2.load_checkpoint(str(tmp_path / "a"))  # must see the committed 'latest'
+    np.testing.assert_allclose(np.asarray(e2.state.params["head"]["w"]),
+                               np.asarray(e1.state.params["head"]["w"]), rtol=1e-6)
