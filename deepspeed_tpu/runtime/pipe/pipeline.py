@@ -1,0 +1,210 @@
+"""Pipeline parallelism, TPU-native.
+
+Reference: ``PipelineModule``/``PipelineEngine``
+(``runtime/pipe/module.py:86``, ``engine.py:61``) run an imperative 1F1B
+instruction schedule (``schedule.py:189``) with eager NCCL p2p sends between
+stage processes. Under XLA there is no eager p2p: the whole pipeline is one
+SPMD program over the ``pp`` mesh axis in which activations circulate via
+``lax.ppermute`` — microbatch ``m`` occupies stage ``s`` at step ``m + s``,
+giving the same fill/drain bubble as GPipe (``(P-1)/M`` overhead), and
+reverse-mode autodiff of the circulating loop *is* the backward pipeline, so
+1F1B-style interleaving falls out of XLA's schedule rather than an
+instruction list.
+
+Composition: the engine's gradient-accumulation microbatches become the
+pipeline microbatches (as in the reference, where ``train_batch`` consumes
+``gas`` microbatches through the pipe).
+
+Weight layout: per-layer params stacked on a leading dim, reshaped
+``[P, L/P, ...]`` and sharded over ``pp`` — each stage holds only its layers
+(the analogue of ``PipelineModule`` partitioning). Tied embeddings: the
+embed/head params live replicated over ``pp``; their gradient contributions
+are psum'd over the axis, which is exactly the reference's tied-weight
+allreduce (``_exec_reduce_tied_grads``, pipe/engine.py:275).
+"""
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ...parallel.topology import PP_AXIS, get_topology
+
+
+def partition_balanced(weights, num_parts: int):
+    """Greedy prefix-sum balance of layer weights into contiguous parts
+    (reference ``partition_balanced``, ``runtime/utils.py:583``). Returns
+    part boundaries [num_parts + 1]."""
+    weights = np.asarray(weights, np.float64)
+    if num_parts > len(weights):
+        raise ValueError(f"cannot split {len(weights)} layers into {num_parts} parts")
+    total = weights.sum()
+    cum = np.concatenate([[0.0], np.cumsum(weights)])
+    bounds = [0]
+    for p in range(1, num_parts):
+        target = total * p / num_parts
+        idx = int(np.searchsorted(cum, target))
+        idx = max(bounds[-1] + 1, min(idx, len(weights) - (num_parts - p)))
+        bounds.append(idx)
+    bounds.append(len(weights))
+    return bounds
+
+
+def spmd_pipeline(stage_fn: Callable, stage_params: Any, microbatches: jnp.ndarray,
+                  *, last_stage_fn: Optional[Callable] = None,
+                  first_stage_fn: Optional[Callable] = None,
+                  extra_params: Any = None):
+    """Run the circulating-microbatch pipeline. Call INSIDE shard_map over pp.
+
+    stage_fn(stage_params, x) -> x            applied at every stage
+    first_stage_fn(extra, mb) -> x            stage 0 input transform (embed)
+    last_stage_fn(extra, x, mb) -> per-mb output (e.g. loss scalar)
+    microbatches: [M, ...] (replicated across pp)
+
+    Returns [M, ...] of last-stage outputs (psum'd over pp so every rank holds
+    them).
+    """
+    stage = lax.axis_index(PP_AXIS)
+    n_stages = lax.axis_size(PP_AXIS)
+    m = jax.tree.leaves(microbatches)[0].shape[0]
+    total = m + n_stages - 1
+
+    def embed(mb):
+        return first_stage_fn(extra_params, mb) if first_stage_fn else mb
+
+    x0 = embed(jax.tree.map(lambda a: a[0], microbatches))
+    buf_shape = jax.eval_shape(lambda p, x: stage_fn(p, x), stage_params, x0)
+    recv = jnp.zeros(buf_shape.shape, buf_shape.dtype)
+
+    def head(x, mb):
+        return last_stage_fn(extra_params, x, mb) if last_stage_fn else x
+
+    out0 = jax.eval_shape(head, recv, jax.tree.map(lambda a: a[0], microbatches))
+    outputs = jnp.zeros((m,) + out0.shape, out0.dtype)
+
+    def step(t, carry):
+        recv, outputs = carry
+        mb_in_idx = jnp.clip(t, 0, m - 1)
+        mb = jax.tree.map(lambda a: a[mb_in_idx], microbatches)
+        x_in = jnp.where(stage == 0,
+                         embed(mb).astype(recv.dtype),
+                         recv)
+        y = stage_fn(stage_params, x_in)
+        # last stage emits microbatch t - (P-1)
+        out_idx = t - (n_stages - 1)
+        is_emitting = (stage == n_stages - 1) & (out_idx >= 0)
+        o = head(y, jax.tree.map(lambda a: a[jnp.clip(out_idx, 0, m - 1)], microbatches))
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(is_emitting, o, outputs[jnp.clip(out_idx, 0, m - 1)]),
+            jnp.clip(out_idx, 0, m - 1), 0)
+        # circulate: stage s -> s+1 (last stage's send is discarded at stage 0)
+        recv = lax.ppermute(y, PP_AXIS,
+                            [(i, (i + 1) % n_stages) for i in range(n_stages)])
+        return recv, outputs
+
+    recv, outputs = lax.fori_loop(0, total, step, (recv, outputs))
+    # every rank returns the outputs: only the last stage's slots are real;
+    # psum with masking broadcasts them (tied-grad allreduce in reverse-mode)
+    outputs = jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs))
+    return lax.psum(outputs, PP_AXIS)
+
+
+def make_pipeline_loss_fn(embed_fn: Callable, block_fn: Callable, head_loss_fn: Callable,
+                          *, num_layers: int, num_stages: int, num_microbatches: int):
+    """Build an engine-compatible ``loss = f(params, batch)`` running an SPMD
+    pipeline (the analogue of wrapping a model in ``PipelineModule``).
+
+    params structure: {"embed": ..., "blocks": <stacked [L, ...]>, "head": ...}
+    block_fn(block_params, x) -> x applies ONE layer given its [L]-indexed slice.
+    """
+    if num_layers % num_stages:
+        raise ValueError(f"num_layers={num_layers} must divide into {num_stages} stages")
+    layers_per_stage = num_layers // num_stages
+
+    def stage_fn(stage_blocks, x):
+        def body(x, layer_params):
+            return block_fn(layer_params, x), None
+
+        y, _ = lax.scan(body, x, stage_blocks)
+        return y
+
+    def loss_fn(params, batch):
+        topo = get_topology()
+        if topo.pp_size != num_stages:
+            raise ValueError(
+                f"pipeline was built for {num_stages} stages but the mesh has "
+                f"pp={topo.pp_size}; a mismatch would silently drop layers")
+        mesh = topo.mesh
+        dp = topo.dp_axes
+
+        def split_mb(leaf):
+            b = leaf.shape[0]
+            if b % num_microbatches:
+                raise ValueError(f"batch {b} not divisible by {num_microbatches} microbatches")
+            return leaf.reshape((num_microbatches, b // num_microbatches) + leaf.shape[1:])
+
+        mbs = jax.tree.map(split_mb, batch)
+
+        def reshape_blocks(leaf):
+            return leaf.reshape((num_stages, layers_per_stage) + leaf.shape[1:])
+
+        blocks = jax.tree.map(reshape_blocks, params["blocks"])
+
+        def pipe_body(blocks_, embed_, head_, mbs_):
+            losses = spmd_pipeline(
+                stage_fn, jax.tree.map(lambda a: a[0], blocks_), mbs_,
+                first_stage_fn=lambda extra, mb: embed_fn(extra["embed"], mb),
+                last_stage_fn=lambda extra, x, mb: head_loss_fn(extra["head"], x, mb),
+                extra_params={"embed": embed_, "head": head_})
+            # per-mb losses are local-batch-shard means; average over dp here
+            # (the grads' dp reduction follows from reverse-mode of this pmean)
+            return lax.pmean(losses, dp)
+
+        blocks_spec = jax.tree.map(lambda _: P(PP_AXIS), blocks)
+        rep = jax.tree.map(lambda _: P(), params["embed"])
+        rep_h = jax.tree.map(lambda _: P(), params["head"])
+        mb_spec = jax.tree.map(lambda _: P(None, dp), mbs)
+        losses = jax.shard_map(
+            pipe_body, mesh=mesh,
+            in_specs=(blocks_spec, rep, rep_h, mb_spec),
+            out_specs=P(),
+            axis_names={PP_AXIS} | set(dp),
+            check_vma=False)(blocks, params["embed"], params["head"], mbs)
+        return jnp.mean(losses)
+
+    # metadata for initialize() to cross-check against PipelineConfig
+    loss_fn._pipeline_meta = {"num_stages": num_stages,
+                              "num_microbatches": num_microbatches,
+                              "num_layers": num_layers}
+    return loss_fn
+
+
+def from_pipeline_config(embed_fn, block_fn, head_loss_fn, *, num_layers: int, config):
+    """Build the pipeline loss from a DeepSpeedTPUConfig (wires the reference
+    config keys: ``pipeline.stages``, ``pipeline.micro_batches`` with the
+    reference default of ``gradient_accumulation_steps``)."""
+    stages = config.pipeline.stages
+    micro = config.pipeline.micro_batches or config.gradient_accumulation_steps or 1
+    return make_pipeline_loss_fn(embed_fn, block_fn, head_loss_fn,
+                                 num_layers=num_layers, num_stages=stages,
+                                 num_microbatches=micro)
+
+
+def pipeline_param_specs(params, topo=None) -> Any:
+    """PartitionSpec tree for pipeline params: blocks sharded over pp on the
+    stacked dim, embed/head replicated (ZeRO adds dp sharding on top)."""
+    if topo is not None:
+        n_layers = jax.tree.leaves(params["blocks"])[0].shape[0]
+        if n_layers % topo.pp_size:
+            raise ValueError(f"{n_layers} layers not divisible by pp={topo.pp_size}")
+    return {
+        "embed": jax.tree.map(lambda _: None, params["embed"]),
+        "blocks": jax.tree.map(lambda p: P(PP_AXIS) if p.ndim >= 1 else P(),
+                               params["blocks"]),
+        "head": jax.tree.map(lambda _: None, params["head"]),
+    }
