@@ -1,0 +1,114 @@
+"""Headline benchmark: Llama-family decoder, ZeRO-3 + bf16 training MFU.
+
+Driver metric (BASELINE.json): tokens/sec/chip + MFU for Llama-class ZeRO-3
+training; target >50% MFU. On a single chip we run the largest Llama-style
+model that fits one chip's training state (params + fp32 master + Adam m/v)
+and report model FLOPs utilisation. On CPU (no TPU attached) a tiny config
+runs so the line is still produced.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TARGET_MFU = 0.50  # BASELINE.json north-star: >50% MFU
+
+# bf16 peak FLOP/s per chip by device kind (public spec sheets)
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    if device.platform == "tpu":
+        return 197e12
+    return 5e11  # generous CPU estimate so the CPU smoke-run stays sane
+
+
+def model_flops_per_token(cfg, seq: int, n_params: int) -> float:
+    # 6*N for the dense matmuls (fwd+bwd) + attention term 12*L*h*S
+    return 6.0 * n_params + 12.0 * cfg.num_layers * cfg.hidden_size * seq
+
+
+def main():
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.transformer import (TransformerLM, init_params,
+                                                  llama_config, make_loss_fn)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        # ~460M-param Llama shape: fits one chip with fp32 master + Adam state
+        cfg = llama_config("7b", num_layers=12, hidden_size=1536,
+                           intermediate_size=4096, num_heads=12, num_kv_heads=12,
+                           vocab_size=32000, max_seq_len=2048, dtype=jnp.bfloat16,
+                           remat=True)
+        batch, seq, steps, warmup = 8, 2048, 20, 3
+    else:
+        cfg = llama_config("7b", num_layers=2, hidden_size=128,
+                           intermediate_size=256, num_heads=4, num_kv_heads=4,
+                           vocab_size=1024, max_seq_len=128, dtype=jnp.float32)
+        batch, seq, steps, warmup = 4, 128, 5, 2
+
+    model = TransformerLM(cfg)
+    params = init_params(model, batch=1, seq=seq)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+    engine, *_ = ds.initialize(
+        model=make_loss_fn(model), model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": batch,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": 3},
+                "bf16": {"enabled": bool(on_tpu)},
+                "gradient_clipping": 1.0,
+                "steps_per_print": 10**9})
+
+    rng = np.random.default_rng(0)
+    def make_batch():
+        toks = rng.integers(0, cfg.vocab_size, size=(batch, seq))
+        return {"tokens": jnp.asarray(toks, jnp.int32)}
+
+    for _ in range(warmup):  # compile + settle
+        engine.train_batch(make_batch())
+    jax.block_until_ready(engine.state.params)
+
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(steps):
+        loss = engine.train_batch(make_batch())
+    jax.block_until_ready(engine.state.params)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    flops = model_flops_per_token(cfg, seq, n_params) * tokens_per_sec
+    mfu = flops / peak_flops(dev)
+
+    print(json.dumps({
+        "metric": "llama_zero3_bf16_mfu" if on_tpu else "llama_zero3_mfu_cpu_smoke",
+        "value": round(mfu, 4),
+        "unit": "MFU",
+        "vs_baseline": round(mfu / TARGET_MFU, 4),
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "n_params": n_params,
+        "device": getattr(dev, "device_kind", dev.platform),
+        "final_loss": float(loss) if loss is not None else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()
