@@ -94,7 +94,8 @@ def main():
     jax.block_until_ready(engine.state.params)
     dt = time.perf_counter() - t0
 
-    tokens_per_sec = batch * seq * steps / dt
+    n_chips = len(jax.devices())
+    tokens_per_sec = batch * seq * steps / dt / n_chips  # per-chip
     flops = model_flops_per_token(cfg, seq, n_params) * tokens_per_sec
     mfu = flops / peak_flops(dev)
 

@@ -1,0 +1,12 @@
+from .abstract_accelerator import DeepSpeedAccelerator
+from .real_accelerator import get_accelerator, set_accelerator, set_accelerator_by_name
+from .tpu_accelerator import CPU_Accelerator, TPU_Accelerator
+
+__all__ = [
+    "DeepSpeedAccelerator",
+    "TPU_Accelerator",
+    "CPU_Accelerator",
+    "get_accelerator",
+    "set_accelerator",
+    "set_accelerator_by_name",
+]

@@ -1,0 +1,148 @@
+"""Hardware abstraction layer.
+
+TPU-native re-design of the reference accelerator ABC
+(``accelerator/abstract_accelerator.py:10`` ``DeepSpeedAccelerator``). The
+reference abstracts CUDA/XPU/HPU/... behind one interface (device handles,
+streams, memory stats, op-builder dispatch, comm backend name); here the same
+interface vocabulary is kept but mapped onto JAX/XLA concepts: devices are
+``jax.Device`` objects, "streams" are XLA's async dispatch (no-ops), memory
+stats come from ``device.memory_stats()``, and profiler ranges map to
+``jax.profiler`` trace annotations.
+"""
+
+import abc
+from typing import Any, Dict, List, Optional
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    """Interface every accelerator implements (reference
+    ``abstract_accelerator.py:10``)."""
+
+    _name: str = "abstract"
+    _communication_backend_name: str = "tccl"
+
+    # --- device APIs ---------------------------------------------------
+    @abc.abstractmethod
+    def devices(self) -> List[Any]:
+        ...
+
+    @abc.abstractmethod
+    def local_devices(self) -> List[Any]:
+        ...
+
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return self._name
+        return f"{self._name}:{device_index}"
+
+    def device_count(self) -> int:
+        return len(self.devices())
+
+    def local_device_count(self) -> int:
+        return len(self.local_devices())
+
+    def current_device(self):
+        return self.local_devices()[0]
+
+    def current_device_name(self) -> str:
+        return self.device_name(0)
+
+    def set_device(self, device_index: int) -> None:
+        # XLA addresses all local devices from one process; there is no
+        # per-process "current device" cursor to move (reference sets the CUDA
+        # device per local rank, ``cuda_accelerator.py``).
+        pass
+
+    def is_available(self) -> bool:
+        try:
+            return self.device_count() > 0
+        except Exception:
+            return False
+
+    # --- synchronization / streams ------------------------------------
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        """Block until all dispatched work completes (reference
+        ``torch.cuda.synchronize``). XLA is async-dispatch; this drains it."""
+        import jax
+
+        (jax.device_put(0) + 0).block_until_ready()
+
+    def stream(self, stream=None):
+        # XLA schedules its own streams; expose a null context for API compat.
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    default_stream = stream
+    current_stream = stream
+
+    # --- RNG -----------------------------------------------------------
+    def manual_seed(self, seed: int):
+        import jax
+
+        return jax.random.PRNGKey(seed)
+
+    manual_seed_all = manual_seed
+
+    # --- memory --------------------------------------------------------
+    def memory_stats(self, device_index: int = 0) -> Dict[str, int]:
+        dev = self.local_devices()[device_index]
+        stats = getattr(dev, "memory_stats", lambda: None)()
+        return dict(stats) if stats else {}
+
+    def memory_allocated(self, device_index: int = 0) -> int:
+        return self.memory_stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index: int = 0) -> int:
+        return self.memory_stats(device_index).get("peak_bytes_in_use", 0)
+
+    def total_memory(self, device_index: int = 0) -> int:
+        return self.memory_stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index: int = 0) -> int:
+        s = self.memory_stats(device_index)
+        return max(0, s.get("bytes_limit", 0) - s.get("bytes_in_use", 0))
+
+    def reset_peak_memory_stats(self, device_index: int = 0) -> None:
+        pass  # XLA exposes no reset; peak is per-allocator lifetime
+
+    def empty_cache(self) -> None:
+        pass
+
+    # --- dtype support -------------------------------------------------
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8]
+
+    # --- profiler ranges (reference NVTX, abstract_accelerator.py:190) --
+    def range_push(self, msg: str):
+        import jax
+
+        ctx = jax.profiler.TraceAnnotation(msg)
+        ctx.__enter__()
+        self._range_stack = getattr(self, "_range_stack", [])
+        self._range_stack.append(ctx)
+
+    def range_pop(self):
+        stack = getattr(self, "_range_stack", [])
+        if stack:
+            stack.pop().__exit__(None, None, None)
+
+    # --- comm / misc ---------------------------------------------------
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name
+
+    def device_platform(self) -> str:
+        return self._name
+
+    def on_accelerator(self, x) -> bool:
+        import jax
+
+        return isinstance(x, jax.Array)

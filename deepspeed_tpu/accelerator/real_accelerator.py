@@ -1,0 +1,47 @@
+"""Runtime accelerator selection.
+
+Reference: ``accelerator/real_accelerator.py:51-135`` — picks the concrete
+accelerator from the ``DS_ACCELERATOR`` env var or by probing the runtime.
+Here the probe order is TPU → GPU(jax) → CPU; ``DSTPU_ACCELERATOR`` (and the
+reference's ``DS_ACCELERATOR`` spelling, accepted for compat) forces one.
+"""
+
+import os
+from typing import Optional
+
+from .abstract_accelerator import DeepSpeedAccelerator
+from .tpu_accelerator import CPU_Accelerator, TPU_Accelerator
+
+_accelerator: Optional[DeepSpeedAccelerator] = None
+
+
+def _detect() -> DeepSpeedAccelerator:
+    name = os.environ.get("DSTPU_ACCELERATOR") or os.environ.get("DS_ACCELERATOR")
+    if name:
+        return set_accelerator_by_name(name)
+    tpu = TPU_Accelerator()
+    if tpu.is_available():
+        return tpu
+    return CPU_Accelerator()
+
+
+def set_accelerator_by_name(name: str) -> DeepSpeedAccelerator:
+    name = name.lower()
+    if name == "tpu":
+        return TPU_Accelerator()
+    if name == "cpu":
+        return CPU_Accelerator()
+    raise ValueError(f"unknown accelerator '{name}' (expected 'tpu' or 'cpu')")
+
+
+def get_accelerator() -> DeepSpeedAccelerator:
+    """The process-wide accelerator (reference ``get_accelerator()``)."""
+    global _accelerator
+    if _accelerator is None:
+        _accelerator = _detect()
+    return _accelerator
+
+
+def set_accelerator(accel: DeepSpeedAccelerator) -> None:
+    global _accelerator
+    _accelerator = accel

@@ -1,0 +1,50 @@
+"""TPU accelerator (the primary runtime; reference ``cuda_accelerator.py``)."""
+
+from typing import List
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+    _name = "tpu"
+    _communication_backend_name = "tccl"  # XLA collectives over ICI/DCN
+
+    def devices(self) -> List:
+        import jax
+
+        return jax.devices("tpu")
+
+    def local_devices(self) -> List:
+        import jax
+
+        return [d for d in jax.local_devices() if d.platform == "tpu"]
+
+    def is_available(self) -> bool:
+        try:
+            return len(self.devices()) > 0
+        except RuntimeError:
+            return False
+
+
+class CPU_Accelerator(DeepSpeedAccelerator):
+    """Host fallback (reference ``cpu_accelerator.py``); used for tests and
+    the virtual-mesh CI mode."""
+
+    _name = "cpu"
+    _communication_backend_name = "gloo"
+
+    def devices(self) -> List:
+        import jax
+
+        return jax.devices("cpu")
+
+    def local_devices(self) -> List:
+        import jax
+
+        return [d for d in jax.local_devices() if d.platform == "cpu"]
+
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def memory_stats(self, device_index: int = 0):
+        return {}

@@ -1,0 +1,94 @@
+"""Environment / compatibility report (reference ``deepspeed/env_report.py``,
+surfaced by the ``ds_report`` CLI).
+
+Reports JAX/XLA versions, visible devices, Pallas kernel availability (the
+TPU analogue of the reference's per-op ``is_compatible()`` table built by
+``op_builder/``), and the native host-IO library build status.
+"""
+
+import importlib
+import platform
+import sys
+from typing import List, Tuple
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def op_compatibility() -> List[Tuple[str, bool, str]]:
+    """Per-op availability table (analogue of ``ds_report``'s op table; each
+    row is a Pallas/native op from ``deepspeed_tpu/ops``)."""
+    rows = []
+
+    def probe(name, fn):
+        try:
+            fn()
+            rows.append((name, True, ""))
+        except Exception as e:  # pragma: no cover - env specific
+            rows.append((name, False, str(e).splitlines()[0][:60]))
+
+    probe("pallas.flash_attention",
+          lambda: importlib.import_module("deepspeed_tpu.ops.pallas.flash_attention"))
+    probe("pallas.fused_adam",
+          lambda: importlib.import_module("deepspeed_tpu.ops.pallas.fused_adam"))
+    probe("pallas.quantizer",
+          lambda: importlib.import_module("deepspeed_tpu.ops.pallas.quant"))
+    probe("optimizers (adam/lamb/lion/adagrad)",
+          lambda: importlib.import_module("deepspeed_tpu.ops.optimizers"))
+
+    def _aio():
+        from deepspeed_tpu.ops.aio import AsyncIOBuilder
+
+        if not AsyncIOBuilder().is_compatible():
+            raise RuntimeError("native aio library not built")
+
+    probe("async_io (native)", _aio)
+    return rows
+
+
+def collect_env() -> dict:
+    info = {
+        "python": sys.version.split()[0],
+        "platform": platform.platform(),
+    }
+    try:
+        import jax
+
+        info["jax"] = jax.__version__
+        try:
+            info["devices"] = [str(d) for d in jax.devices()]
+            info["default_backend"] = jax.default_backend()
+        except RuntimeError as e:
+            info["devices"] = []
+            info["default_backend"] = f"unavailable ({e})"
+    except ImportError:
+        info["jax"] = "not installed"
+    for mod in ("flax", "optax", "orbax.checkpoint", "numpy"):
+        try:
+            m = importlib.import_module(mod)
+            info[mod] = getattr(m, "__version__", "?")
+        except ImportError:
+            info[mod] = "not installed"
+    from .version import __version__
+
+    info["deepspeed_tpu"] = __version__
+    return info
+
+
+def main(args=None):  # pragma: no cover - CLI
+    """``ds_report`` entry point."""
+    print("-" * 66)
+    print("DeepSpeed-TPU C++/Pallas op report")
+    print("-" * 66)
+    for name, ok, note in op_compatibility():
+        status = GREEN_OK if ok else RED_NO
+        print(f"{name:.<48} {status} {note}")
+    print("-" * 66)
+    print("DeepSpeed-TPU general environment info:")
+    for k, v in collect_env().items():
+        print(f"{k:.<24} {v}")
+    print("-" * 66)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,3 @@
+from .monitor import MonitorMaster, TensorBoardMonitor, WandbMonitor, csvMonitor
+
+__all__ = ["MonitorMaster", "TensorBoardMonitor", "WandbMonitor", "csvMonitor"]

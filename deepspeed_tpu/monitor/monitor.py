@@ -1,0 +1,132 @@
+"""Experiment monitoring fan-out.
+
+Reference: ``MonitorMaster`` (``monitor/monitor.py:30``) dispatches scalar
+events to TensorBoard / W&B / Comet / CSV writers, rank-0 only. Same design
+here; "rank 0" is ``jax.process_index() == 0``.
+
+Events are ``(name, value, step)`` tuples — the reference's
+``write_events`` contract (``engine.py:2029-2037``).
+"""
+
+import os
+from typing import Any, List, Optional, Sequence, Tuple
+
+Event = Tuple[str, Any, int]
+
+
+def _is_rank_0() -> bool:
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+class Monitor:
+    def __init__(self, config):
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    """Reference ``monitor/tensorboard.py:13``. Uses torch's SummaryWriter
+    when tensorboard is importable; silently disables otherwise."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        if not (self.enabled and _is_rank_0()):
+            self.enabled = False
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            log_dir = os.path.join(config.output_path or "./runs", config.job_name)
+            os.makedirs(log_dir, exist_ok=True)
+            self.summary_writer = SummaryWriter(log_dir=log_dir)
+        except Exception:
+            self.enabled = False
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        if not self.enabled or self.summary_writer is None:
+            return
+        for name, value, step in event_list:
+            if value is None:
+                continue
+            self.summary_writer.add_scalar(name, float(value), int(step))
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    """Reference ``monitor/wandb.py:12``; import-gated."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._wandb = None
+        if not (self.enabled and _is_rank_0()):
+            self.enabled = False
+            return
+        try:
+            import wandb
+
+            wandb.init(project=config.project, group=config.group, entity=config.team)
+            self._wandb = wandb
+        except Exception:
+            self.enabled = False
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        if not self.enabled or self._wandb is None:
+            return
+        for name, value, step in event_list:
+            if value is not None:
+                self._wandb.log({name: value}, step=int(step))
+
+
+class csvMonitor(Monitor):
+    """Reference ``monitor/csv_monitor.py:12`` — one CSV file per metric name."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.filenames = {}
+        if not (self.enabled and _is_rank_0()):
+            self.enabled = False
+            return
+        self.log_dir = os.path.join(config.output_path or "./csv_logs", config.job_name)
+        os.makedirs(self.log_dir, exist_ok=True)
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            if value is None:
+                continue
+            fname = self.filenames.get(name)
+            if fname is None:
+                safe = name.replace("/", "_")
+                fname = os.path.join(self.log_dir, f"{safe}.csv")
+                self.filenames[name] = fname
+                with open(fname, "a") as f:
+                    f.write("step,value\n")
+            with open(fname, "a") as f:
+                f.write(f"{int(step)},{value}\n")
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to every enabled writer (reference ``monitor/monitor.py:30``)."""
+
+    def __init__(self, monitor_config):
+        self.monitors: List[Monitor] = [
+            TensorBoardMonitor(monitor_config.tensorboard),
+            WandbMonitor(monitor_config.wandb),
+            csvMonitor(monitor_config.csv_monitor),
+        ]
+        self.monitors = [m for m in self.monitors if m.enabled]
+        self.enabled = bool(self.monitors)
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        if _is_rank_0():
+            for m in self.monitors:
+                m.write_events(event_list)

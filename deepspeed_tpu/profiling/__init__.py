@@ -1,0 +1,8 @@
+from .flops_profiler import (FlopsProfiler, count_flops, get_model_profile,
+                             params_count, xla_cost_analysis)
+from .timer import SynchronizedWallClockTimer, ThroughputTimer
+
+__all__ = [
+    "FlopsProfiler", "count_flops", "get_model_profile", "params_count",
+    "xla_cost_analysis", "SynchronizedWallClockTimer", "ThroughputTimer",
+]

@@ -1,0 +1,235 @@
+"""FLOPS profiler.
+
+Reference: ``FlopsProfiler`` (``profiling/flops_profiler/profiler.py:29``)
+monkey-patches ``torch.nn.functional`` with flop-counting shims and prints a
+per-module latency/FLOPs/params tree. The TPU-native design needs no patching:
+a traced jaxpr *is* the op graph, so we
+
+  1. walk the jaxpr and count FLOPs analytically per primitive (dot_general,
+     conv, elementwise, reductions), descending into pjit/scan/cond/remat with
+     correct trip-count multipliers, and
+  2. aggregate per ``jax.named_scope`` frame — the module tree — giving the
+     same depth-limited breakdown the reference prints, plus
+  3. optionally cross-check against XLA's own compiled ``cost_analysis()``.
+"""
+
+from collections import defaultdict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax import core as jcore
+
+
+def _size(aval) -> int:
+    try:
+        return int(np.prod(aval.shape))
+    except Exception:
+        return 0
+
+
+def _dot_general_flops(eqn) -> int:
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    batch = int(np.prod([lhs.shape[d] for d in lb])) if lb else 1
+    k = int(np.prod([lhs.shape[d] for d in lc])) if lc else 1
+    m = int(np.prod([lhs.shape[d] for d in range(len(lhs.shape))
+                     if d not in lc and d not in lb]))
+    n = int(np.prod([rhs.shape[d] for d in range(len(rhs.shape))
+                     if d not in rc and d not in rb]))
+    return 2 * batch * m * n * k
+
+
+def _conv_flops(eqn) -> int:
+    rhs = eqn.invars[1].aval
+    out = eqn.outvars[0].aval
+    dn = eqn.params["dimension_numbers"]
+    groups = eqn.params.get("feature_group_count", 1)
+    kernel_elems = int(np.prod(rhs.shape)) // max(1, groups)
+    # per output element: one MAC per (kernel spatial x in-channels/group)
+    out_elems = _size(out)
+    in_ch_factor = kernel_elems // max(1, rhs.shape[dn.rhs_spec[0]])
+    return 2 * out_elems * in_ch_factor
+
+
+_ELEMENTWISE = {
+    "add", "sub", "mul", "div", "max", "min", "pow", "exp", "log", "tanh",
+    "logistic", "rsqrt", "sqrt", "neg", "abs", "sign", "floor", "ceil",
+    "erf", "erf_inv", "expm1", "log1p", "sin", "cos", "integer_pow",
+    "add_any", "and", "or", "xor", "not", "select_n", "clamp", "nextafter",
+    "rem", "atan2", "cbrt", "square",
+}
+_REDUCTION = {"reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+              "reduce_and", "reduce_or", "argmax", "argmin", "reduce_precision",
+              "cumsum", "cummax", "cummin", "cumprod"}
+_FREE = {"broadcast_in_dim", "reshape", "transpose", "squeeze", "slice",
+         "dynamic_slice", "dynamic_update_slice", "concatenate", "pad",
+         "convert_element_type", "bitcast_convert_type", "gather", "scatter",
+         "scatter-add", "rev", "iota", "copy", "device_put", "stop_gradient",
+         "eq", "ne", "lt", "le", "gt", "ge", "is_finite", "sharding_constraint"}
+
+
+def _eqn_flops(eqn, scope_acc, scope: str, mult: int) -> int:
+    """FLOPs for one eqn; recurses into sub-jaxprs with trip multipliers."""
+    prim = eqn.primitive.name
+    if prim in ("pjit", "closed_call", "core_call", "custom_jvp_call",
+                "custom_vjp_call", "custom_vjp_call_jaxpr", "remat", "checkpoint",
+                "custom_lin", "c_jit"):
+        inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+        if inner is None:
+            return 0
+        name = eqn.params.get("name", "")
+        sub_scope = f"{scope}/{name}" if name and name != "<lambda>" else scope
+        return _jaxpr_flops(getattr(inner, "jaxpr", inner), scope_acc, sub_scope, mult)
+    if prim == "scan":
+        inner = eqn.params["jaxpr"]
+        length = eqn.params.get("length", 1)
+        return _jaxpr_flops(inner.jaxpr, scope_acc, f"{scope}/scan", mult * length)
+    if prim == "while":
+        inner = eqn.params["body_jaxpr"]
+        # trip count is dynamic; count one iteration (documented caveat)
+        return _jaxpr_flops(inner.jaxpr, scope_acc, f"{scope}/while", mult)
+    if prim == "cond":
+        branches = eqn.params["branches"]
+        return max((_jaxpr_flops(b.jaxpr, scope_acc, f"{scope}/cond", mult)
+                    for b in branches), default=0)
+    if prim == "dot_general":
+        f = _dot_general_flops(eqn)
+    elif prim == "conv_general_dilated":
+        f = _conv_flops(eqn)
+    elif prim in _ELEMENTWISE:
+        f = _size(eqn.outvars[0].aval)
+    elif prim in _REDUCTION:
+        f = _size(eqn.invars[0].aval)
+    elif prim in ("psum", "all_gather", "reduce_scatter", "all_to_all", "ppermute"):
+        f = 0  # communication, not FLOPs — the comms logger ledgers these
+    elif prim in _FREE:
+        f = 0
+    else:
+        f = _size(eqn.outvars[0].aval) if eqn.outvars else 0
+    f *= mult
+    scope_acc[scope or "<top>"] += f
+    return f
+
+
+def _jaxpr_flops(jaxpr, scope_acc, scope: str, mult: int) -> int:
+    total = 0
+    for eqn in jaxpr.eqns:
+        frames = []
+        try:
+            frames = [f for f in str(eqn.source_info.name_stack).split("/") if f]
+        except Exception:
+            pass
+        eqn_scope = "/".join([s for s in scope.split("/") if s] + frames)
+        total += _eqn_flops(eqn, scope_acc, eqn_scope, mult)
+    return total
+
+
+def count_flops(fn: Callable, *args, **kwargs) -> Tuple[int, Dict[str, int]]:
+    """Analytic FLOP count of ``fn(*args)`` plus a per-named-scope breakdown."""
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    scope_acc: Dict[str, int] = defaultdict(int)
+    total = _jaxpr_flops(closed.jaxpr, scope_acc, "", 1)
+    return total, dict(scope_acc)
+
+
+def params_count(params: Any) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params)
+               if hasattr(x, "shape"))
+
+
+def xla_cost_analysis(fn: Callable, *args, **kwargs) -> Dict[str, float]:
+    """XLA's own post-optimization cost model (flops, bytes accessed)."""
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
+def number_to_string(num, units=None, precision=2):
+    for scale, unit in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if (units is None and abs(num) >= scale) or units == unit:
+            return f"{num / scale:.{precision}f} {unit}"
+    return f"{num:.{precision}f}"
+
+
+def flops_to_string(flops, units=None, precision=2):
+    return number_to_string(flops, units, precision) + "FLOPs"
+
+
+def params_to_string(n, units=None, precision=2):
+    return number_to_string(n, units, precision)
+
+
+class FlopsProfiler:
+    """Engine-attached profiler (reference ``profiling/flops_profiler/
+    profiler.py:29``; engine hook at ``engine.py:1877`` fires on
+    ``profile_step``)."""
+
+    def __init__(self, config=None):
+        self.config = config
+        self.total_flops = 0
+        self.scopes: Dict[str, int] = {}
+        self.total_params = 0
+        self.step_time = 0.0
+
+    def profile(self, fn: Callable, args: tuple, params: Any = None,
+                step_time: float = 0.0):
+        self.total_flops, self.scopes = count_flops(fn, *args)
+        self.total_params = params_count(params) if params is not None else 0
+        self.step_time = step_time
+        return self.total_flops
+
+    def get_total_flops(self, as_string=False):
+        return flops_to_string(self.total_flops) if as_string else self.total_flops
+
+    def get_total_params(self, as_string=False):
+        return params_to_string(self.total_params) if as_string else self.total_params
+
+    def print_model_profile(self, depth: int = -1, top_modules: int = 3,
+                            output_file: Optional[str] = None):
+        import sys
+
+        out = open(output_file, "w") if output_file else sys.stdout
+        print("-" * 60, file=out)
+        print("DeepSpeed-TPU Flops Profiler", file=out)
+        print(f"params:               {params_to_string(self.total_params)}", file=out)
+        print(f"fwd (+bwd) FLOPs:     {flops_to_string(self.total_flops)}", file=out)
+        if self.step_time > 0:
+            print(f"step latency:         {self.step_time * 1e3:.2f} ms", file=out)
+            print(f"achieved throughput:  "
+                  f"{flops_to_string(self.total_flops / self.step_time)}/s", file=out)
+        items = sorted(self.scopes.items(), key=lambda kv: -kv[1])
+        print("per-scope breakdown (named_scope tree):", file=out)
+        shown = 0
+        for scope, f in items:
+            d = scope.count("/") + 1
+            if depth != -1 and d > depth:
+                continue
+            if f == 0:
+                continue
+            print(f"  {scope or '<top>'}: {flops_to_string(f)} "
+                  f"({100.0 * f / max(1, self.total_flops):.1f}%)", file=out)
+            shown += 1
+            if shown >= max(top_modules, 20):
+                break
+        print("-" * 60, file=out)
+        if output_file:
+            out.close()
+
+
+def get_model_profile(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+                      params: Any = None, print_profile: bool = True,
+                      as_string: bool = True):
+    """One-shot API (reference ``get_model_profile``): returns
+    ``(flops, macs, params)``."""
+    prof = FlopsProfiler()
+    prof.profile(lambda *a: fn(*a, **(kwargs or {})), args, params=params)
+    if print_profile:
+        prof.print_model_profile()
+    flops = prof.get_total_flops(as_string)
+    macs = (flops_to_string(prof.total_flops // 2) if as_string
+            else prof.total_flops // 2)
+    nparams = prof.get_total_params(as_string)
+    return flops, macs, nparams

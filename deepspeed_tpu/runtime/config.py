@@ -448,13 +448,23 @@ class DeepSpeedTPUConfig(ConfigModel):
         return self.zero_optimization.stage
 
 
+def _fold_monitor_keys(cfg: DeepSpeedTPUConfig) -> DeepSpeedTPUConfig:
+    # The reference accepts monitor configs both top-level ("tensorboard": {...})
+    # and the MonitorConfig grouping; fold top-level into cfg.monitor (idempotent).
+    for key in ("tensorboard", "wandb", "csv_monitor"):
+        top = getattr(cfg, key)
+        if top.enabled and not getattr(cfg.monitor, key).enabled:
+            setattr(cfg.monitor, key, top)
+    return cfg
+
+
 def load_config(config: Union[str, Mapping[str, Any], DeepSpeedTPUConfig, None]) -> DeepSpeedTPUConfig:
     """Accept a path to a JSON file, a dict, an existing config, or None."""
     if config is None:
         return DeepSpeedTPUConfig()
     if isinstance(config, DeepSpeedTPUConfig):
-        return config
+        return _fold_monitor_keys(config)
     if isinstance(config, str):
         with open(config) as f:
             config = json.load(f)
-    return DeepSpeedTPUConfig.from_dict(config)
+    return _fold_monitor_keys(DeepSpeedTPUConfig.from_dict(config))

@@ -165,6 +165,13 @@ class DeepSpeedTPUEngine:
         self.skipped_steps = 0
         self._last_metrics: Dict[str, float] = {}
         self.monitor = None
+        if any(m.enabled for m in (config.monitor.tensorboard, config.monitor.wandb,
+                                   config.monitor.csv_monitor)):
+            from ..monitor import MonitorMaster
+
+            self.monitor = MonitorMaster(config.monitor)
+        self.flops_profiler = None
+        self._last_batch = None
         self._step_times = []
         log_dist(f"engine initialized: {self.topo}, zero_stage={zc.stage}, "
                  f"gas={self.gas}, micro_bs={self.micro_batch_size}, "
@@ -321,6 +328,7 @@ class DeepSpeedTPUEngine:
         if batch is None:
             batch = _draw_from_iter(data_iter, self.gas)
         batch = self._shape_batch(batch)
+        self._last_batch = batch  # reference only; sliced lazily by flops_profile
         self._rng, step_rng = jax.random.split(self._rng)
         t0 = time.perf_counter()
         self.state, metrics = self._train_step(self.state, batch, step_rng)
@@ -450,6 +458,34 @@ class DeepSpeedTPUEngine:
                   self.global_steps * self.train_batch_size),
                  (f"Train/Samples/lr", self._last_metrics.get("lr"),
                   self.global_steps * self.train_batch_size)])
+        fp_cfg = self.config.flops_profiler
+        if fp_cfg.enabled and self.global_steps == fp_cfg.profile_step:
+            self.flops_profile(output_file=fp_cfg.output_file,
+                               top_modules=fp_cfg.top_modules,
+                               depth=fp_cfg.module_depth)
+
+    def flops_profile(self, batch=None, output_file=None, top_modules: int = 3,
+                      depth: int = -1):
+        """Profile one microbatch's loss FLOPs per named scope (reference
+        engine hook ``engine.py:1877`` → ``FlopsProfiler``). fwd+bwd+update
+        FLOPs ≈ 3× the forward count reported here."""
+        from ..profiling import FlopsProfiler
+
+        prof = FlopsProfiler(self.config.flops_profiler)
+        if batch is None and self._last_batch is not None:
+            batch = jax.tree.map(lambda x: x[0], self._last_batch)
+        if batch is None:
+            logger.warning("flops_profile: no batch seen yet")
+            return None
+        self._rng, r = jax.random.split(self._rng)
+        step_time = float(np.mean(self._step_times[-5:])) if self._step_times else 0.0
+        prof.profile(lambda p, b: self._loss(p, b, r)[0],
+                     (self.state.params, batch), params=self.state.params,
+                     step_time=step_time)
+        prof.print_model_profile(depth=depth, top_modules=top_modules,
+                                 output_file=output_file)
+        self.flops_profiler = prof
+        return prof.total_flops
 
     # ------------------------------------------------------------------
     @property

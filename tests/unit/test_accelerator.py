@@ -1,0 +1,57 @@
+"""Accelerator abstraction + env report (reference ``accelerator/`` and
+``deepspeed/env_report.py``)."""
+
+import deepspeed_tpu
+from deepspeed_tpu.accelerator import (CPU_Accelerator, get_accelerator,
+                                       set_accelerator, set_accelerator_by_name)
+
+
+def test_get_accelerator_returns_available_device():
+    acc = get_accelerator()
+    assert acc.is_available()
+    assert acc.device_count() >= 1
+    assert acc.local_device_count() >= 1
+
+
+def test_device_names():
+    acc = get_accelerator()
+    assert acc.device_name(3).endswith(":3")
+    assert acc.device_name() in ("tpu", "cpu")
+
+
+def test_dtype_support_and_sync():
+    acc = get_accelerator()
+    assert acc.is_bf16_supported()
+    acc.synchronize()  # must not raise
+
+
+def test_range_push_pop_balanced():
+    acc = get_accelerator()
+    acc.range_push("outer")
+    acc.range_push("inner")
+    acc.range_pop()
+    acc.range_pop()
+    acc.range_pop()  # extra pop is a no-op
+
+
+def test_set_accelerator_by_name_roundtrip():
+    old = get_accelerator()
+    try:
+        cpu = set_accelerator_by_name("cpu")
+        set_accelerator(cpu)
+        assert get_accelerator().device_name() == "cpu"
+        assert isinstance(get_accelerator(), CPU_Accelerator)
+    finally:
+        set_accelerator(old)
+
+
+def test_env_report_collects():
+    from deepspeed_tpu.env_report import collect_env, op_compatibility
+
+    env = collect_env()
+    assert "jax" in env and "deepspeed_tpu" in env
+    rows = op_compatibility()
+    names = [r[0] for r in rows]
+    assert "pallas.flash_attention" in names
+    # the pure-jax ops must always be compatible
+    assert all(ok for name, ok, _ in rows if name.startswith("pallas"))

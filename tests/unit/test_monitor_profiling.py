@@ -1,0 +1,129 @@
+"""Monitor fan-out + flops profiler + timers (reference ``monitor/``,
+``profiling/flops_profiler/``, ``utils/timer.py``)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.monitor import MonitorMaster, csvMonitor
+from deepspeed_tpu.profiling import (FlopsProfiler, SynchronizedWallClockTimer,
+                                     ThroughputTimer, count_flops,
+                                     get_model_profile, params_count)
+from deepspeed_tpu.runtime.config import load_config
+
+
+def test_csv_monitor_writes_files(tmp_path):
+    cfg = load_config({
+        "train_batch_size": 8,
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "job"},
+    })
+    master = MonitorMaster(cfg.monitor)
+    assert master.enabled
+    master.write_events([("Train/loss", 1.5, 1), ("Train/loss", 1.2, 2),
+                         ("Train/lr", 0.1, 1)])
+    files = os.listdir(tmp_path / "job")
+    assert "Train_loss.csv" in files and "Train_lr.csv" in files
+    lines = (tmp_path / "job" / "Train_loss.csv").read_text().strip().splitlines()
+    assert lines[0] == "step,value" and lines[1] == "1,1.5"
+
+
+def test_monitor_disabled_by_default():
+    cfg = load_config({"train_batch_size": 8})
+    master = MonitorMaster(cfg.monitor)
+    assert not master.enabled
+    master.write_events([("x", 1.0, 1)])  # no-op, must not raise
+
+
+def test_count_flops_matmul_exact():
+    def f(x, w):
+        return jnp.sum(x @ w)
+
+    x, w = jnp.ones((16, 128)), jnp.ones((128, 64))
+    total, _ = count_flops(f, x, w)
+    # matmul 2*16*128*64 + reduce 16*64
+    assert total == 2 * 16 * 128 * 64 + 16 * 64
+
+
+def test_count_flops_scan_multiplier():
+    w = jnp.ones((32, 32))
+
+    def f(x):
+        def body(c, _):
+            return jnp.tanh(c @ w), None
+
+        y, _ = jax.lax.scan(body, x, None, length=5)
+        return jnp.sum(y)
+
+    total, _ = count_flops(f, jnp.ones((4, 32)))
+    per_iter = 2 * 4 * 32 * 32 + 4 * 32
+    assert total == 5 * per_iter + 4 * 32
+
+
+def test_named_scope_breakdown():
+    def f(x, w1, w2):
+        with jax.named_scope("blk0"):
+            x = x @ w1
+        with jax.named_scope("blk1"):
+            x = x @ w2
+        return jnp.sum(x)
+
+    x = jnp.ones((8, 32))
+    total, scopes = count_flops(f, x, jnp.ones((32, 32)), jnp.ones((32, 32)))
+    assert scopes["blk0"] == scopes["blk1"] == 2 * 8 * 32 * 32
+    assert total == scopes["blk0"] + scopes["blk1"] + 8 * 32
+
+
+def test_get_model_profile_api(capsys):
+    def f(x, w):
+        return jnp.sum(x @ w)
+
+    flops, macs, nparams = get_model_profile(
+        f, args=(jnp.ones((4, 8)), jnp.ones((8, 8))),
+        params={"w": np.ones((8, 8))}, as_string=False)
+    assert flops == 2 * 4 * 8 * 8 + 4 * 8
+    assert macs == flops // 2
+    assert nparams == 64
+    assert "Flops Profiler" in capsys.readouterr().out
+
+
+def test_params_count_tree():
+    tree = {"a": np.ones((3, 4)), "b": {"c": np.ones(7)}}
+    assert params_count(tree) == 19
+
+
+def test_wallclock_timer_records():
+    timers = SynchronizedWallClockTimer()
+    t = timers("fwd")
+    t.start()
+    t.stop()
+    assert len(t.elapsed_records) == 1
+    assert t.elapsed() >= 0.0
+    assert t.elapsed_records == []  # reset by elapsed()
+
+
+def test_throughput_timer_samples_per_sec():
+    tt = ThroughputTimer(batch_size=32, start_step=0)
+    for _ in range(3):
+        tt.start()
+        tt.stop()
+    assert tt.global_step_count == 3
+    assert tt.avg_samples_per_sec() > 0
+
+
+def test_engine_flops_profile_hook():
+    from tests.unit.simple_model import make_simple_params, random_batches, simple_loss
+
+    import deepspeed_tpu as ds
+
+    params = make_simple_params(hidden=16)
+    engine, *_ = ds.initialize(
+        model=simple_loss, model_parameters=params,
+        config={"train_batch_size": 8, "optimizer": {"type": "adam"}})
+    batch = random_batches(1, 8, hidden=16)[0]
+    engine.train_batch(batch)
+    flops = engine.flops_profile()
+    assert flops and flops > 0
