@@ -17,21 +17,33 @@ _accelerator: Optional[DeepSpeedAccelerator] = None
 
 def _detect() -> DeepSpeedAccelerator:
     name = os.environ.get("DSTPU_ACCELERATOR") or os.environ.get("DS_ACCELERATOR")
-    if name:
-        return set_accelerator_by_name(name)
+    if name and name.lower() in ("tpu", "cpu"):
+        return _by_name(name)
+    if name:  # e.g. DS_ACCELERATOR=cuda left over from a reference deployment
+        import warnings
+
+        warnings.warn(f"DS_ACCELERATOR='{name}' is not a TPU-framework accelerator; "
+                      f"probing tpu→cpu instead")
     tpu = TPU_Accelerator()
     if tpu.is_available():
         return tpu
     return CPU_Accelerator()
 
 
-def set_accelerator_by_name(name: str) -> DeepSpeedAccelerator:
+def _by_name(name: str) -> DeepSpeedAccelerator:
     name = name.lower()
     if name == "tpu":
         return TPU_Accelerator()
     if name == "cpu":
         return CPU_Accelerator()
     raise ValueError(f"unknown accelerator '{name}' (expected 'tpu' or 'cpu')")
+
+
+def set_accelerator_by_name(name: str) -> DeepSpeedAccelerator:
+    """Build the named accelerator and install it process-wide."""
+    global _accelerator
+    _accelerator = _by_name(name)
+    return _accelerator
 
 
 def get_accelerator() -> DeepSpeedAccelerator:

@@ -1,0 +1,6 @@
+from .config import DeepSpeedInferenceConfig, GenerationConfig
+from .engine import InferenceEngine, init_inference
+from .hf import config_from_hf, params_from_hf
+
+__all__ = ["DeepSpeedInferenceConfig", "GenerationConfig", "InferenceEngine",
+           "init_inference", "config_from_hf", "params_from_hf"]

@@ -1,0 +1,50 @@
+"""Inference config (reference ``inference/config.py``
+``DeepSpeedInferenceConfig``). Same knob vocabulary: dtype, tensor_parallel,
+max_out_tokens, replace_with_kernel_inject; generation knobs added for the
+TPU engine's jitted sampling loop."""
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ..runtime.config_utils import ConfigModel, register_config
+
+
+@register_config
+@dataclass
+class InferenceTPConfig(ConfigModel):
+    tp_size: int = 1
+    enabled: bool = True
+
+
+@register_config
+@dataclass
+class GenerationConfig(ConfigModel):
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0          # 0 = disabled
+    top_p: float = 1.0      # 1.0 = disabled
+    max_new_tokens: int = 128
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+
+
+@register_config
+@dataclass
+class DeepSpeedInferenceConfig(ConfigModel):
+    dtype: str = "bfloat16"                 # compute/cache dtype
+    tensor_parallel: InferenceTPConfig = field(default_factory=InferenceTPConfig)
+    max_out_tokens: int = 1024              # KV cache capacity (prompt + gen)
+    min_out_tokens: int = 1
+    replace_with_kernel_inject: bool = False  # use Pallas flash/fused kernels
+    generation: GenerationConfig = field(default_factory=GenerationConfig)
+    # quantization (reference MoQ / weight-only int8): applied to matmul weights
+    quantize_weights: bool = False
+    quantize_block: int = 256
+
+    @property
+    def jnp_dtype(self):
+        return {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+                "float16": jnp.float16, "fp16": jnp.float16,
+                "float32": jnp.float32, "fp32": jnp.float32}[self.dtype]

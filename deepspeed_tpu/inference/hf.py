@@ -1,0 +1,168 @@
+"""HuggingFace checkpoint ingestion.
+
+Plays the role of the reference's injection policies + TP-aware checkpoint
+loading (``module_inject/replace_policy.py``, ``module_inject/
+load_checkpoint.py``, ``runtime/state_dict_factory.py``): map a HF
+architecture to our ``TransformerConfig`` and convert its torch state_dict
+into the flax params pytree, after which ``InferenceEngine`` shards it over
+the mesh (the TP slicing the reference does tensor-by-tensor is just a
+``device_put`` with PartitionSpecs here).
+
+Supported families (reference containers ``module_inject/containers/*``):
+llama/llama2/mistral (RoPE+GQA+SwiGLU), gpt2 (learned pos, GELU), and
+mixtral (MoE) — one converter per weight-naming scheme.
+"""
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import TransformerConfig
+
+
+def _t(x) -> np.ndarray:
+    # torch tensor -> numpy (cpu)
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().float().numpy()
+    return np.asarray(x)
+
+
+def config_from_hf(hf_config) -> TransformerConfig:
+    """Map a HF config object/dict to ``TransformerConfig`` (reference policy
+    matching in ``replace_policy.py``)."""
+    d = hf_config if isinstance(hf_config, dict) else hf_config.to_dict()
+    mt = d.get("model_type", "")
+    if mt in ("llama", "mistral", "mixtral"):
+        cfg = dict(
+            vocab_size=d["vocab_size"], hidden_size=d["hidden_size"],
+            intermediate_size=d["intermediate_size"],
+            num_layers=d["num_hidden_layers"], num_heads=d["num_attention_heads"],
+            num_kv_heads=d.get("num_key_value_heads", d["num_attention_heads"]),
+            max_seq_len=d.get("max_position_embeddings", 4096),
+            norm="rmsnorm", activation="swiglu", position="rope",
+            rope_theta=d.get("rope_theta", 10000.0),
+            norm_eps=d.get("rms_norm_eps", 1e-6),
+            tie_embeddings=d.get("tie_word_embeddings", False))
+        if mt == "mixtral":
+            cfg.update(num_experts=d.get("num_local_experts", 8),
+                       moe_top_k=d.get("num_experts_per_tok", 2))
+        return TransformerConfig(**cfg)
+    if mt == "gpt2":
+        return TransformerConfig(
+            vocab_size=d["vocab_size"], hidden_size=d["n_embd"],
+            intermediate_size=d.get("n_inner") or 4 * d["n_embd"],
+            num_layers=d["n_layer"], num_heads=d["n_head"],
+            max_seq_len=d["n_positions"], norm="layernorm", activation="gelu",
+            position="learned", norm_eps=d.get("layer_norm_epsilon", 1e-5),
+            tie_embeddings=True)
+    raise ValueError(f"unsupported HF model_type '{mt}' "
+                     f"(supported: llama, mistral, mixtral, gpt2)")
+
+
+def _llama_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
+    h, hk, dh, dm = cfg.num_heads, cfg.kv_heads, cfg.head_dim, cfg.hidden_size
+    p: Dict[str, Any] = {"embed": {"embedding": _t(sd["model.embed_tokens.weight"])}}
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        layer = {
+            "attn": {
+                "q_proj": {"kernel": _t(sd[pre + "self_attn.q_proj.weight"]).T
+                           .reshape(dm, h, dh)},
+                "k_proj": {"kernel": _t(sd[pre + "self_attn.k_proj.weight"]).T
+                           .reshape(dm, hk, dh)},
+                "v_proj": {"kernel": _t(sd[pre + "self_attn.v_proj.weight"]).T
+                           .reshape(dm, hk, dh)},
+                "o_proj": {"kernel": _t(sd[pre + "self_attn.o_proj.weight"]).T
+                           .reshape(h, dh, dm)},
+            },
+            "attn_norm": {"scale": _t(sd[pre + "input_layernorm.weight"])},
+            "mlp_norm": {"scale": _t(sd[pre + "post_attention_layernorm.weight"])},
+        }
+        if cfg.num_experts > 0 and (i % cfg.moe_every == 0):
+            gate = _t(sd[pre + "block_sparse_moe.gate.weight"]).T
+            ws, vs, w2s = [], [], []
+            for e in range(cfg.num_experts):
+                ep = pre + f"block_sparse_moe.experts.{e}."
+                ws.append(_t(sd[ep + "w1.weight"]).T)   # gate_proj [D,F]
+                vs.append(_t(sd[ep + "w3.weight"]).T)   # up_proj
+                w2s.append(_t(sd[ep + "w2.weight"]).T)  # down_proj [F,D]
+            layer["moe"] = {
+                "router": {"kernel": gate},
+                "expert_gate_proj": np.stack(ws),
+                "expert_up_proj": np.stack(vs),
+                "expert_down_proj": np.stack(w2s),
+            }
+        else:
+            layer["mlp"] = {
+                "gate_proj": {"kernel": _t(sd[pre + "mlp.gate_proj.weight"]).T},
+                "up_proj": {"kernel": _t(sd[pre + "mlp.up_proj.weight"]).T},
+                "down_proj": {"kernel": _t(sd[pre + "mlp.down_proj.weight"]).T},
+            }
+        p[f"layer_{i}"] = layer
+    p["final_norm"] = {"scale": _t(sd["model.norm.weight"])}
+    if not cfg.tie_embeddings:
+        p["lm_head"] = {"kernel": _t(sd["lm_head.weight"]).T}
+    return p
+
+
+def _gpt2_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
+    h, dh, dm = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    p: Dict[str, Any] = {
+        "embed": {"embedding": _t(sd["transformer.wte.weight"])},
+        "pos_embed": _t(sd["transformer.wpe.weight"]),
+    }
+    for i in range(cfg.num_layers):
+        pre = f"transformer.h.{i}."
+        # HF GPT-2 Conv1D stores [in, out]; qkv fused along out
+        w = _t(sd[pre + "attn.c_attn.weight"])    # [D, 3D]
+        b = _t(sd[pre + "attn.c_attn.bias"])      # [3D]
+        qw, kw, vw = np.split(w, 3, axis=1)
+        qb, kb, vb = np.split(b, 3)
+        proj_w = _t(sd[pre + "attn.c_proj.weight"])  # [D, D]
+        p[f"layer_{i}"] = {
+            "attn": {
+                "q_proj": {"kernel": qw.reshape(dm, h, dh), "bias": qb.reshape(h, dh)},
+                "k_proj": {"kernel": kw.reshape(dm, h, dh), "bias": kb.reshape(h, dh)},
+                "v_proj": {"kernel": vw.reshape(dm, h, dh), "bias": vb.reshape(h, dh)},
+                "o_proj": {"kernel": proj_w.reshape(h, dh, dm),
+                           "bias": _t(sd[pre + "attn.c_proj.bias"])},
+            },
+            "attn_norm": {"scale": _t(sd[pre + "ln_1.weight"]),
+                          "bias": _t(sd[pre + "ln_1.bias"])},
+            "mlp_norm": {"scale": _t(sd[pre + "ln_2.weight"]),
+                         "bias": _t(sd[pre + "ln_2.bias"])},
+            "mlp": {
+                "up_proj": {"kernel": _t(sd[pre + "mlp.c_fc.weight"]),
+                            "bias": _t(sd[pre + "mlp.c_fc.bias"])},
+                "down_proj": {"kernel": _t(sd[pre + "mlp.c_proj.weight"]),
+                              "bias": _t(sd[pre + "mlp.c_proj.bias"])},
+            },
+        }
+    p["final_norm"] = {"scale": _t(sd["transformer.ln_f.weight"]),
+                       "bias": _t(sd["transformer.ln_f.bias"])}
+    return p
+
+
+def params_from_hf(model_or_state_dict, hf_config=None):
+    """Convert a HF model (or its state_dict + config) → ``(TransformerConfig,
+    params)`` ready for ``InferenceEngine`` / the training engine."""
+    if hasattr(model_or_state_dict, "state_dict"):
+        sd = model_or_state_dict.state_dict()
+        hf_config = hf_config or model_or_state_dict.config
+    else:
+        sd = dict(model_or_state_dict)
+        if hf_config is None:
+            raise ValueError("pass hf_config when giving a raw state_dict")
+    cfg = config_from_hf(hf_config)
+    if cfg.position == "rope":
+        params = _llama_params(sd, cfg)
+    else:
+        params = _gpt2_params(sd, cfg)
+    return cfg, _to_jnp(params)
+
+
+def _to_jnp(tree):
+    import jax
+
+    return jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), tree)

@@ -120,11 +120,40 @@ def attention_core(q, k, v, *, causal: bool = True, impl: str = "auto",
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _update_cache(cache_kv, new_kv, cache_index):
+    """Write ``new_kv [B,S,Hk,D]`` into ``cache_kv [B,M,Hk,D]`` at per-sequence
+    offsets ``cache_index [B]`` (the v1 inference KV-cache append; reference
+    fused attention kernels do this in-place, ``csrc/transformer/inference``)."""
+    def upd(c, n, i):
+        return jax.lax.dynamic_update_slice(c, n.astype(c.dtype), (i, 0, 0))
+
+    return jax.vmap(upd)(cache_kv, new_kv, cache_index)
+
+
+def cached_attention(q, k_cache, v_cache, q_pos):
+    """Decode attention over the full KV cache with per-sequence validity:
+    cache slot j attends iff ``j <= q_pos`` (absolute position), which also
+    masks unwritten slots. q: [B,S,H,D]; caches: [B,M,Hk,D]; q_pos: [B,S]."""
+    b, s, h, d = q.shape
+    m, hk = k_cache.shape[1], k_cache.shape[2]
+    if hk != h:
+        rep = h // hk
+        k_cache = jnp.repeat(k_cache, rep, axis=2)
+        v_cache = jnp.repeat(v_cache, rep, axis=2)
+    scale = 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache.astype(q.dtype),
+                        preferred_element_type=jnp.float32) * scale
+    mask = jnp.arange(m)[None, None, None, :] <= q_pos[:, None, :, None]
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache.astype(q.dtype))
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, *, deterministic=True):
+    def __call__(self, x, *, deterministic=True, cache=None, cache_index=None):
         cfg = self.cfg
         h, hk, d = cfg.num_heads, cfg.kv_heads, cfg.head_dim
         dense = partial(nn.DenseGeneral, use_bias=(cfg.norm == "layernorm"),
@@ -135,6 +164,20 @@ class Attention(nn.Module):
 
         if cfg.position == "rope":
             cos, sin = rope_table(cfg.max_seq_len, d, cfg.rope_theta)
+
+        if cache is not None:
+            # incremental decoding path (inference v1 engine)
+            positions = cache_index[:, None] + jnp.arange(x.shape[1])[None, :]
+            if cfg.position == "rope":
+                q = apply_rope(q, cos, sin, positions)
+                k = apply_rope(k, cos, sin, positions)
+            new_cache = {"k": _update_cache(cache["k"], k, cache_index),
+                         "v": _update_cache(cache["v"], v, cache_index)}
+            out = cached_attention(q, new_cache["k"], new_cache["v"], positions)
+            out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                                  use_bias=(cfg.norm == "layernorm"), dtype=cfg.dtype,
+                                  param_dtype=jnp.float32, name="o_proj")(out)
+            return out, new_cache
 
         impl = cfg.attn_impl
         if impl == "auto":
@@ -195,10 +238,17 @@ class Block(nn.Module):
     layer_idx: int = 0
 
     @nn.compact
-    def __call__(self, x, deterministic=True):  # positional for nn.remat static_argnums
+    def __call__(self, x, deterministic=True, cache=None, cache_index=None):
+        # (x, deterministic) stay positional for nn.remat static_argnums
         cfg = self.cfg
         y = _norm(cfg, "attn_norm")(x)
-        x = x + Attention(cfg, name="attn")(y, deterministic=deterministic)
+        attn = Attention(cfg, name="attn")
+        if cache is not None:
+            attn_out, new_cache = attn(y, deterministic=deterministic,
+                                       cache=cache, cache_index=cache_index)
+        else:
+            attn_out, new_cache = attn(y, deterministic=deterministic), None
+        x = x + attn_out
         y = _norm(cfg, "mlp_norm")(x)
         use_moe = cfg.num_experts > 0 and (self.layer_idx % cfg.moe_every == 0)
         if use_moe:
@@ -208,7 +258,8 @@ class Block(nn.Module):
             self.sow("intermediates", "moe_aux_loss", aux)
         else:
             mlp_out = MLP(cfg, name="mlp")(y)
-        return x + mlp_out
+        out = x + mlp_out
+        return (out, new_cache) if cache is not None else out
 
 
 class TransformerLM(nn.Module):
@@ -216,7 +267,10 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, *, deterministic=True):
+    def __call__(self, tokens, *, deterministic=True, cache=None, cache_index=None):
+        """Training/eval: ``logits = __call__(tokens)``. Incremental decode
+        (inference v1): pass ``cache`` (see ``init_kv_cache``) + per-sequence
+        write offsets ``cache_index [B]`` → ``(logits, new_cache)``."""
         cfg = self.cfg
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                          param_dtype=jnp.float32, name="embed")
@@ -224,23 +278,50 @@ class TransformerLM(nn.Module):
         if cfg.position == "learned":
             pos_emb = self.param("pos_embed", nn.initializers.normal(0.02),
                                  (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
-            x = x + pos_emb[None, :x.shape[1]].astype(cfg.dtype)
+            if cache is not None:
+                positions = cache_index[:, None] + jnp.arange(tokens.shape[1])[None, :]
+                x = x + pos_emb[positions].astype(cfg.dtype)
+            else:
+                x = x + pos_emb[None, :x.shape[1]].astype(cfg.dtype)
 
         block = Block
-        if cfg.remat:
+        if cfg.remat and cache is None:
             policy = None
             if cfg.remat_policy:
                 policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
             block = nn.remat(Block, policy=policy, static_argnums=(2,))
+        new_cache = {}
         for i in range(cfg.num_layers):
-            x = block(cfg, i, name=f"layer_{i}")(x, deterministic)
+            name = f"layer_{i}"
+            if cache is not None:
+                x, new_cache[name] = block(cfg, i, name=name)(
+                    x, deterministic, cache=cache[name], cache_index=cache_index)
+            else:
+                x = block(cfg, i, name=name)(x, deterministic)
         x = _norm(cfg, "final_norm")(x)
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
         else:
             logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                               param_dtype=jnp.float32, name="lm_head")(x.astype(jnp.float32))
-        return logits
+        return (logits, new_cache) if cache is not None else logits
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: Optional[int] = None,
+                  dtype=None):
+    """Dense per-layer KV cache ``{layer_i: {k,v: [B, M, Hk, D]}}`` (the v1
+    inference cache; the paged/v2 cache lives in ``inference/v2/ragged``)."""
+    m = max_len or cfg.max_seq_len
+    dt = dtype or cfg.dtype
+    shape = (batch, m, cfg.kv_heads, cfg.head_dim)
+    return {f"layer_{i}": {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+            for i in range(cfg.num_layers)}
+
+
+def kv_cache_specs(cfg: TransformerConfig, tp_axis: str = "tp", dp_axis=None):
+    """PartitionSpecs for the v1 cache: batch over dp, kv heads over tp."""
+    spec = P(dp_axis, None, tp_axis, None)
+    return {f"layer_{i}": {"k": spec, "v": spec} for i in range(cfg.num_layers)}
 
 
 # ---------------------------------------------------------------------------

@@ -23,16 +23,18 @@ from .sharded_moe import compute_capacity, moe_combine, moe_dispatch, topk_gatin
 
 def _constrain(x, spec, skip: bool = False):
     """Sharding constraint on the dispatch layout. ``skip`` during flax init,
-    where trace shapes need not divide the mesh; real misconfigurations (bad
-    axis names, indivisible expert counts) propagate."""
+    where trace shapes need not divide the mesh. Per-dimension, the constraint
+    is dropped (→ replicated) when the dim doesn't divide its mesh axes — e.g.
+    tiny inference batches over a large dp axis."""
     if skip:
         return x
     from ..parallel.topology import get_topology
 
     topo = get_topology()
     if topo.n_devices > 1:
+        eff = topo.filter_spec(spec, x.shape)
         return jax.lax.with_sharding_constraint(
-            x, jax.sharding.NamedSharding(topo.mesh, spec))
+            x, jax.sharding.NamedSharding(topo.mesh, eff))
     return x
 
 

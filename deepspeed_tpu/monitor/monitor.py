@@ -108,8 +108,9 @@ class csvMonitor(Monitor):
                 safe = name.replace("/", "_")
                 fname = os.path.join(self.log_dir, f"{safe}.csv")
                 self.filenames[name] = fname
-                with open(fname, "a") as f:
-                    f.write("step,value\n")
+                if not os.path.exists(fname):  # restart appends, no dup header
+                    with open(fname, "a") as f:
+                        f.write("step,value\n")
             with open(fname, "a") as f:
                 f.write(f"{int(step)},{value}\n")
 

@@ -107,6 +107,25 @@ class Topology:
     def sharding(self, spec: P) -> NamedSharding:
         return NamedSharding(self.mesh, spec)
 
+    def filter_spec(self, spec: P, shape) -> P:
+        """Drop spec entries whose dim doesn't divide the mesh axes — e.g.
+        GQA kv-head dims smaller than tp (reference AutoTP replicates such
+        weights, ``module_inject/tp_shard.py``)."""
+        entries = list(spec) + [None] * (len(shape) - len(spec))
+
+        def ok(i, entry):
+            if entry is None:
+                return False
+            names = entry if isinstance(entry, tuple) else (entry,)
+            return shape[i] % self.axis_size(*names) == 0
+
+        return P(*[e if ok(i, e) else None for i, e in enumerate(entries)])
+
+    def filter_spec_tree(self, spec_tree, tree):
+        """``filter_spec`` over a pytree of PartitionSpecs + matching arrays."""
+        return jax.tree.map(lambda s, x: self.filter_spec(s, x.shape), spec_tree, tree,
+                            is_leaf=lambda x: isinstance(x, P))
+
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 

@@ -90,9 +90,16 @@ def _eqn_flops(eqn, scope_acc, scope: str, mult: int) -> int:
         # trip count is dynamic; count one iteration (documented caveat)
         return _jaxpr_flops(inner.jaxpr, scope_acc, f"{scope}/while", mult)
     if prim == "cond":
-        branches = eqn.params["branches"]
-        return max((_jaxpr_flops(b.jaxpr, scope_acc, f"{scope}/cond", mult)
-                    for b in branches), default=0)
+        # count only the most expensive branch, in total AND per-scope
+        best_total, best_acc = 0, {}
+        for b in eqn.params["branches"]:
+            acc = defaultdict(int)
+            t = _jaxpr_flops(b.jaxpr, acc, f"{scope}/cond", mult)
+            if t >= best_total:
+                best_total, best_acc = t, acc
+        for k, v in best_acc.items():
+            scope_acc[k] += v
+        return best_total
     if prim == "dot_general":
         f = _dot_general_flops(eqn)
     elif prim == "conv_general_dilated":
@@ -202,6 +209,7 @@ class FlopsProfiler:
                   f"{flops_to_string(self.total_flops / self.step_time)}/s", file=out)
         items = sorted(self.scopes.items(), key=lambda kv: -kv[1])
         print("per-scope breakdown (named_scope tree):", file=out)
+        limit = top_modules if top_modules and top_modules > 0 else len(items)
         shown = 0
         for scope, f in items:
             d = scope.count("/") + 1
@@ -212,7 +220,7 @@ class FlopsProfiler:
             print(f"  {scope or '<top>'}: {flops_to_string(f)} "
                   f"({100.0 * f / max(1, self.total_flops):.1f}%)", file=out)
             shown += 1
-            if shown >= max(top_modules, 20):
+            if shown >= limit:
                 break
         print("-" * 60, file=out)
         if output_file:

@@ -451,10 +451,12 @@ class DeepSpeedTPUConfig(ConfigModel):
 def _fold_monitor_keys(cfg: DeepSpeedTPUConfig) -> DeepSpeedTPUConfig:
     # The reference accepts monitor configs both top-level ("tensorboard": {...})
     # and the MonitorConfig grouping; fold top-level into cfg.monitor (idempotent).
+    import copy
+
     for key in ("tensorboard", "wandb", "csv_monitor"):
         top = getattr(cfg, key)
         if top.enabled and not getattr(cfg.monitor, key).enabled:
-            setattr(cfg.monitor, key, top)
+            setattr(cfg.monitor, key, copy.deepcopy(top))
     return cfg
 
 
