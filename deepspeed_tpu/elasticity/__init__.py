@@ -1,0 +1,17 @@
+"""Elasticity: elastic batch-size math + restart supervision.
+
+Reference: ``deepspeed/elasticity/`` — config (``config.py``), batch/chip
+compatibility solver (``elasticity.py:233``), torchelastic agent
+(``elastic_agent.py:32``; here, launcher-level supervision in
+``launcher/launch.py:_supervise``).
+"""
+
+from .elasticity import (ElasticityConfig, ElasticityConfigError, ElasticityError,
+                         ElasticityIncompatibleWorldSize, compute_elastic_config,
+                         get_compatible_chips, valid_chip_counts)
+
+__all__ = [
+    "ElasticityConfig", "ElasticityConfigError", "ElasticityError",
+    "ElasticityIncompatibleWorldSize", "compute_elastic_config",
+    "get_compatible_chips", "valid_chip_counts",
+]

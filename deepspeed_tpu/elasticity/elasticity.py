@@ -1,0 +1,140 @@
+"""Elastic training: batch-size / chip-count compatibility math.
+
+Analogue of the reference elasticity module (``deepspeed/elasticity/
+elasticity.py:233`` ``compute_elastic_config``): given an acceptable batch
+ceiling and candidate micro-batch sizes, choose one global batch size that
+stays valid across a whole range of chip counts, so a job can be rescaled
+(slice shrink/grow, preemption) without retuning hyperparameters. Runtime
+recovery is checkpoint-based restart (launcher ``--elastic_training``
+supervision + UCP resharding in ``checkpoint/``).
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+@dataclass
+class ElasticityConfig:
+    """Typed view of the ``elasticity`` config block (reference
+    ``elasticity/config.py``)."""
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = field(default_factory=lambda: [2, 4, 6])
+    min_chips: int = 1
+    max_chips: int = 10000
+    min_time: int = 0
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    version: float = 0.2
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "ElasticityConfig":
+        d = dict(d)
+        # accept the reference's GPU-flavored key names
+        renames = {"min_gpus": "min_chips", "max_gpus": "max_chips"}
+        for old, new in renames.items():
+            if old in d:
+                d[new] = d.pop(old)
+        known = {f for f in cls.__dataclass_fields__}
+        cfg = cls(**{k: v for k, v in d.items() if k in known})
+        if cfg.max_train_batch_size < 1:
+            raise ElasticityConfigError("max_train_batch_size must be >= 1")
+        if not cfg.micro_batch_sizes or any(m < 1 for m in cfg.micro_batch_sizes):
+            raise ElasticityConfigError(f"bad micro_batch_sizes {cfg.micro_batch_sizes}")
+        if cfg.min_chips < 1 or cfg.max_chips < cfg.min_chips:
+            raise ElasticityConfigError(
+                f"bad chip range [{cfg.min_chips}, {cfg.max_chips}]")
+        return cfg
+
+
+def valid_chip_counts(batch_size: int, micro_batches: List[int], min_chips: int,
+                      max_chips: int) -> List[int]:
+    """Chip counts ``c`` for which some micro-batch ``m`` gives an integer
+    gradient-accumulation: ``batch_size % (m * c) == 0``. No ``c`` beyond
+    ``batch_size // min(micro_batches)`` can qualify, so the scan is bounded
+    there rather than at ``max_chips``."""
+    out = []
+    hi = min(max_chips, batch_size // min(micro_batches))
+    for c in range(min_chips, hi + 1):
+        if any(batch_size % (m * c) == 0 for m in micro_batches):
+            out.append(c)
+    return out
+
+
+def _candidate_batch_sizes(max_batch: int, micro_batches: List[int]) -> List[int]:
+    cands = set()
+    for m in micro_batches:
+        cands.update(range(m, max_batch + 1, m))
+    return sorted(cands)
+
+
+def get_compatible_chips(max_batch: int, micro_batches: List[int], min_chips: int,
+                         max_chips: int,
+                         prefer_larger: bool = True) -> Tuple[int, List[int]]:
+    """Pick the batch size maximizing the number of valid chip counts
+    (reference v0.1/v0.2 algorithms, ``elasticity.py:83,126``); ties broken
+    toward larger (or smaller) batch per ``prefer_larger``."""
+    best: Tuple[int, List[int]] = (0, [])
+    best_score = -1
+    for b in _candidate_batch_sizes(max_batch, micro_batches):
+        valid = valid_chip_counts(b, micro_batches, min_chips, max_chips)
+        score = len(valid)
+        better = score > best_score or (
+            score == best_score and ((b > best[0]) if prefer_larger else (b < best[0])))
+        if better:
+            best, best_score = (b, valid), score
+    if best_score <= 0:
+        raise ElasticityError(
+            f"no batch size <= {max_batch} is divisible by any micro-batch in "
+            f"{micro_batches} over chips [{min_chips}, {max_chips}]")
+    return best
+
+
+def compute_elastic_config(ds_config: Dict, world_size: int = 0
+                           ) -> Tuple[int, List[int], Optional[int]]:
+    """Resolve (final_batch_size, valid_chip_counts, micro_batch_for_world).
+
+    Reference ``compute_elastic_config`` (``elasticity/elasticity.py:233``):
+    ``world_size=0`` resolves only the schedule; a concrete world size also
+    picks the largest micro-batch that divides ``final_batch / world``.
+    """
+    if isinstance(ds_config, ElasticityConfig):
+        cfg = ds_config
+    else:
+        block = ds_config.get("elasticity")
+        if block is None:
+            raise ElasticityConfigError("config has no 'elasticity' section")
+        cfg = block if isinstance(block, ElasticityConfig) else ElasticityConfig.from_dict(block)
+    if isinstance(cfg, ElasticityConfig) and not cfg.enabled:
+        raise ElasticityConfigError("elasticity is not enabled "
+                                    "(set elasticity.enabled = true)")
+    final_batch, valid = get_compatible_chips(cfg.max_train_batch_size,
+                                              sorted(set(cfg.micro_batch_sizes)),
+                                              cfg.min_chips, cfg.max_chips,
+                                              prefer_larger=cfg.prefer_larger_batch)
+    micro = None
+    if world_size > 0:
+        if world_size not in valid:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} not in the valid set for elastic batch "
+                f"{final_batch}: {valid[:16]}{'...' if len(valid) > 16 else ''}")
+        per_chip = final_batch // world_size
+        fits = [m for m in cfg.micro_batch_sizes if per_chip % m == 0]
+        micro = max(fits) if fits else None
+        if micro is None:
+            raise ElasticityIncompatibleWorldSize(
+                f"no micro-batch in {cfg.micro_batch_sizes} divides "
+                f"per-chip batch {per_chip}")
+    return final_batch, valid, micro

@@ -18,6 +18,7 @@ Here the whole pipeline is compiler-driven:
   query can attend to them).
 """
 
+from contextlib import contextmanager
 from functools import partial
 from typing import Any, Optional
 
@@ -31,6 +32,21 @@ from ..models.transformer import (TransformerLM, init_kv_cache, kv_cache_specs,
 from ..parallel.topology import Topology, TopologySpec
 from ..utils.logging import log_dist
 from .config import DeepSpeedInferenceConfig
+
+
+@contextmanager
+def _use_topology(topo):
+    """Temporarily install ``topo`` as the process topology for tracing, then
+    restore the previous one — a coexisting training engine must not see the
+    inference mesh via ``get_topology()``."""
+    from ..parallel import topology as topo_mod
+
+    prev = topo_mod._TOPOLOGY
+    topo_mod.set_topology(topo)
+    try:
+        yield
+    finally:
+        topo_mod._TOPOLOGY = prev
 
 
 def _sample_fn(gen_cfg):
@@ -133,10 +149,6 @@ class InferenceEngine:
         key = ("forward", tokens.shape[0])
         fn = self._compiled.get(key)
         if fn is None:
-            from ..parallel.topology import set_topology
-
-            set_topology(self.topo)
-
             @partial(jax.jit,
                      in_shardings=(self._param_shardings,
                                    self._batch_sharding(tokens.shape[0])))
@@ -144,7 +156,8 @@ class InferenceEngine:
                 return self.model.apply({"params": params}, toks)
 
             fn = self._compiled[key] = fwd
-        return fn(self.params, tokens)
+        with _use_topology(self.topo):  # jit traces on first call
+            return fn(self.params, tokens)
 
     __call__ = forward
 
@@ -160,9 +173,15 @@ class InferenceEngine:
         gen = self.config.generation
         if gen_overrides:
             gen = type(gen)(**{**gen.to_dict(), **gen_overrides})
-        max_new = max_new_tokens or gen.max_new_tokens
+        max_new = gen.max_new_tokens if max_new_tokens is None else max_new_tokens
         tokens = jnp.asarray(tokens, jnp.int32)
         b, s = tokens.shape
+        if max_new == 0:
+            return np.zeros((b, 0), np.int32)
+        if self.max_tokens - s < self.config.min_out_tokens:
+            raise ValueError(f"prompt {s} leaves less than min_out_tokens="
+                             f"{self.config.min_out_tokens} of KV capacity "
+                             f"{self.max_tokens}")
         if s + max_new > self.max_tokens:
             raise ValueError(f"prompt {s} + max_new {max_new} exceeds KV capacity "
                              f"{self.max_tokens} (raise max_out_tokens)")
@@ -177,15 +196,13 @@ class InferenceEngine:
         if fn is None:
             fn = self._build_generate(b, max_new, gen)
             self._compiled[key] = fn
-        return np.asarray(fn(self.params, tokens, prompt_lengths, rng))
+        with _use_topology(self.topo):  # jit traces on first call
+            return np.asarray(fn(self.params, tokens, prompt_lengths, rng))
 
     def _build_generate(self, batch: int, max_new: int, gen):
         cfg, model = self.cfg, self.model
         sample = _sample_fn(gen)
         eos = gen.eos_token_id
-        from ..parallel.topology import set_topology
-
-        set_topology(self.topo)
         cache_sh = self._cache_shardings(batch)
 
         def run(params, tokens, lengths, rng):
@@ -224,17 +241,25 @@ def init_inference(model: TransformerLM = None, model_parameters: Any = None,
                    config=None, topology: Optional[Topology] = None, **kwargs):
     """Reference ``deepspeed.init_inference`` (``deepspeed/__init__.py:291``):
     accepts a dict/DeepSpeedInferenceConfig plus legacy kwargs
-    (``mp_size``/``tensor_parallel``/``dtype``/``replace_with_kernel_inject``)."""
+    (``mp_size``/``tensor_parallel``/``dtype``/``replace_with_kernel_inject``).
+    Unknown kwargs raise; the caller's config dict is never mutated."""
+    import copy
+
     if isinstance(config, DeepSpeedInferenceConfig):
-        cfg = config
+        d = config.to_dict()
     else:
-        d = dict(config or {})
-        if "mp_size" in d:  # legacy alias for tensor_parallel.tp_size
-            d.setdefault("tensor_parallel", {})["tp_size"] = d.pop("mp_size")
-        for k in ("dtype", "replace_with_kernel_inject", "max_out_tokens"):
-            if k in kwargs:
-                d[k] = kwargs.pop(k)
-        if "mp_size" in kwargs:
-            d.setdefault("tensor_parallel", {})["tp_size"] = kwargs.pop("mp_size")
-        cfg = DeepSpeedInferenceConfig.from_dict(d)
+        d = copy.deepcopy(dict(config or {}))
+    if "mp_size" in d:  # legacy alias for tensor_parallel.tp_size
+        d.setdefault("tensor_parallel", {})["tp_size"] = d.pop("mp_size")
+    for k in ("dtype", "replace_with_kernel_inject", "max_out_tokens",
+              "min_out_tokens", "quantize_weights"):
+        if k in kwargs:
+            d[k] = kwargs.pop(k)
+    if "mp_size" in kwargs:
+        d.setdefault("tensor_parallel", {})["tp_size"] = kwargs.pop("mp_size")
+    if "tensor_parallel" in kwargs:
+        d["tensor_parallel"] = kwargs.pop("tensor_parallel")
+    if kwargs:
+        raise TypeError(f"init_inference got unknown kwargs: {sorted(kwargs)}")
+    cfg = DeepSpeedInferenceConfig.from_dict(d)
     return InferenceEngine(model, model_parameters, cfg, topology=topology)

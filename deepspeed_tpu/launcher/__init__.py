@@ -1,0 +1,9 @@
+"""Launcher: ``dstpu`` CLI, per-host launch, multinode runners.
+
+Reference: ``deepspeed/launcher/`` (``runner.py:419`` CLI, ``launch.py:133``
+per-node spawn, ``multinode_runner.py`` pdsh/mpi/slurm fanout).
+"""
+
+from .runner import fetch_hostfile, main, parse_args, parse_inclusion_exclusion
+
+__all__ = ["fetch_hostfile", "main", "parse_args", "parse_inclusion_exclusion"]

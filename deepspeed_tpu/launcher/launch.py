@@ -1,0 +1,107 @@
+"""Per-host launcher: run (and optionally supervise) the user script.
+
+Analogue of the reference per-node launcher (``deepspeed/launcher/launch.py:133``),
+which forks one process per GPU, wires RANK/LOCAL_RANK/MASTER_*, and handles
+signals. On TPU one process per host owns all local chips, so the local unit
+is a single child process with the ``DSTPU_*`` bootstrap env; elastic mode
+supervises it and restarts on failure (reference ``DSElasticAgent._invoke_run``,
+``elasticity/elastic_agent.py:127``).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from ..utils.logging import logger
+from .multinode_runner import DEFAULT_COORDINATOR_PORT
+
+
+def build_child_env(args, extra=None):
+    env = dict(os.environ)
+    for kv in getattr(args, "export", []) or []:
+        k, _, v = kv.partition("=")
+        env[k] = v
+    env.setdefault("DSTPU_NUM_PROCESSES", "1")
+    env.setdefault("DSTPU_PROCESS_ID", "0")
+    if args.master_addr:
+        port = args.master_port or DEFAULT_COORDINATOR_PORT
+        env.setdefault("DSTPU_COORDINATOR", f"{args.master_addr}:{port}")
+    if extra:
+        env.update(extra)
+    return env
+
+
+def launch_local(args) -> int:
+    cmd = [args.python_exec, "-u", args.user_script] + list(args.user_args)
+    env = build_child_env(args)
+    if args.elastic_training:
+        return _supervise(cmd, env, max_restarts=args.max_restarts)
+    return _run_once(cmd, env)
+
+
+def _run_once(cmd: List[str], env) -> int:
+    proc = subprocess.Popen(cmd, env=env)
+    _forward_signals(proc)
+    return proc.wait()
+
+
+def _supervise(cmd: List[str], env, max_restarts: int = 100,
+               min_uptime_s: float = 10.0, backoff_s: float = 3.0) -> int:
+    """Restart-on-failure supervision (elastic agent). A child that exits
+    non-zero is relaunched (with backoff) up to ``max_restarts`` times;
+    crashes after a healthy uptime reset the restart budget — matching the
+    membership-change restart loop of the reference elastic agent. A
+    SIGINT/SIGTERM delivered to the supervisor terminates the job instead of
+    triggering a restart."""
+    restarts = 0
+    stop_requested = []
+    while True:
+        start = time.time()
+        proc = subprocess.Popen(cmd, env=env)
+        _forward_signals(proc, stop_requested)
+        rc = proc.wait()
+        uptime = time.time() - start
+        if rc == 0:
+            return 0
+        if stop_requested:
+            logger.info(f"worker stopped by signal {stop_requested[0]}; not restarting")
+            return rc
+        if uptime > min_uptime_s:
+            restarts = 0
+        restarts += 1
+        if restarts > max_restarts:
+            logger.error(f"worker failed rc={rc}; restart budget exhausted")
+            return rc
+        logger.warning(f"worker failed rc={rc} after {uptime:.1f}s; "
+                       f"restart {restarts}/{max_restarts} in {backoff_s}s")
+        time.sleep(backoff_s)
+
+
+def _forward_signals(proc: subprocess.Popen, stop_flag: Optional[list] = None):
+    def handler(signum, frame):
+        if stop_flag is not None:
+            stop_flag.append(signum)
+        try:
+            proc.send_signal(signum)
+        except ProcessLookupError:
+            pass
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, handler)
+        except ValueError:  # not main thread (tests)
+            pass
+
+
+def main(argv=None):  # pragma: no cover - CLI shim
+    from .runner import parse_args
+
+    args = parse_args(argv)
+    sys.exit(launch_local(args))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,181 @@
+"""``dstpu`` CLI — the launcher front-end.
+
+TPU-native analogue of the reference ``deepspeed`` CLI
+(``deepspeed/launcher/runner.py:419``): parse a hostfile, apply
+``--include``/``--exclude`` filters, then either exec the local per-host
+launcher (single node) or fan out over a multinode runner (ssh/pdsh/mpirun/
+srun). The per-host unit is one Python process that owns all local TPU chips
+and joins the ``jax.distributed`` coordinator (vs the reference's
+process-per-GPU model).
+"""
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, Optional
+
+from ..utils.logging import logger
+from .multinode_runner import DEFAULT_COORDINATOR_PORT, get_runner
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        prog="dstpu",
+        description="deepspeed_tpu launcher (reference `deepspeed` CLI)")
+    parser.add_argument("-H", "--hostfile", type=str, default="/job/hostfile",
+                        help="hostfile: lines of '<hostname> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="host filter, e.g. 'worker-0@worker-1' (reference include syntax)")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="hosts to drop, e.g. 'worker-2'")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="cap the number of hosts used")
+    parser.add_argument("--master_addr", type=str, default=None,
+                        help="jax.distributed coordinator address (default: first host)")
+    parser.add_argument("--master_port", type=int, default=None,
+                        help=f"coordinator port (default {DEFAULT_COORDINATOR_PORT})")
+    parser.add_argument("--launcher", type=str, default="ssh",
+                        choices=["ssh", "pdsh", "openmpi", "slurm"],
+                        help="multinode fanout backend")
+    parser.add_argument("--force_multi", action="store_true",
+                        help="treat a 1-host pool as multinode (still sets bootstrap env)")
+    parser.add_argument("--elastic_training", action="store_true",
+                        help="supervise and restart the local worker on failure")
+    parser.add_argument("--max_restarts", type=int, default=100)
+    parser.add_argument("--python_exec", type=str, default=sys.executable)
+    parser.add_argument("--export", action="append", default=[],
+                        help="KEY=VALUE env to forward to workers (repeatable)")
+    parser.add_argument("--autotuning", type=str, default="",
+                        choices=["", "tune", "run"],
+                        help="run the autotuner before/instead of training")
+    parser.add_argument("user_script", type=str, help="user training script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(path: str) -> Optional[Dict[str, int]]:
+    """Parse '<host> slots=<n>' lines (reference ``fetch_hostfile``,
+    ``launcher/runner.py:213``). Returns None when the file is absent."""
+    if not os.path.isfile(path):
+        return None
+    pool: "OrderedDict[str, int]" = OrderedDict()
+    with open(path) as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.split("#")[0].strip()
+            if not line:
+                continue
+            m = re.match(r"^(\S+)(?:\s+slots=(\d+))?$", line)
+            if m is None:
+                raise ValueError(f"{path}:{lineno}: malformed hostfile line {raw!r}")
+            host, slots = m.group(1), int(m.group(2) or 1)
+            if host in pool:
+                raise ValueError(f"{path}:{lineno}: duplicate host {host}")
+            pool[host] = slots
+    return pool or None
+
+
+def parse_inclusion_exclusion(resource_pool: Dict[str, int], include: str,
+                              exclude: str) -> Dict[str, int]:
+    """Apply include/exclude host filters (reference ``parse_resource_filter``,
+    ``launcher/runner.py:293``). Syntax: hosts separated by '@'; an optional
+    ':a,b' slot-list narrows a host's slots (kept for hostfile compatibility,
+    slots on TPU are whole-host)."""
+
+    def parse_filter(s):
+        out = OrderedDict()
+        for term in filter(None, s.split("@")):
+            host, _, slots = term.partition(":")
+            out[host.strip()] = [int(x) for x in slots.split(",")] if slots else None
+        return out
+
+    inc, exc = parse_filter(include), parse_filter(exclude)
+    if inc and exc:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    for host in list(inc) + list(exc):
+        if host not in resource_pool:
+            raise ValueError(f"filtered host {host!r} not in hostfile")
+    active = OrderedDict()
+    for host, slots in resource_pool.items():
+        if inc:
+            if host not in inc:
+                continue
+            sel = inc[host]
+            active[host] = len(sel) if sel else slots
+        elif host in exc:
+            sel = exc[host]
+            if sel:  # partial exclusion keeps the host with fewer slots
+                remaining = slots - len(sel)
+                if remaining > 0:
+                    active[host] = remaining
+        else:
+            active[host] = slots
+    if not active:
+        raise ValueError("no hosts left after include/exclude filtering")
+    return active
+
+
+def encode_world_info(resource_pool: Dict[str, int]) -> str:
+    import base64
+    import json
+
+    return base64.urlsafe_b64encode(json.dumps(resource_pool).encode()).decode()
+
+
+def _is_local_host(host: str) -> bool:
+    import socket
+
+    local = {"localhost", "127.0.0.1", socket.gethostname()}
+    try:
+        local.add(socket.getfqdn())
+    except OSError:  # pragma: no cover
+        pass
+    return host in local
+
+
+def main(args=None):
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    if args.autotuning:
+        try:
+            from ..autotuning.autotuner import run_autotuning
+        except ImportError as e:
+            raise RuntimeError(f"autotuning support unavailable: {e}") from e
+        return run_autotuning(args)
+
+    active = None
+    if resource_pool is not None:
+        active = parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
+        if args.num_nodes > 0:
+            active = OrderedDict(list(active.items())[:args.num_nodes])
+
+    if active is None or (len(active) == 1 and not args.force_multi
+                          and _is_local_host(next(iter(active)))):
+        # single node: exec the per-host launcher locally
+        from .launch import launch_local
+
+        return launch_local(args)
+    env = {}
+    for kv in args.export:
+        k, _, v = kv.partition("=")
+        env[k] = v
+    runner = get_runner(args.launcher, args, active)
+    for k, v in env.items():
+        runner.add_export(k, v)
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend '{args.launcher}' not available on PATH")
+    logger.info(f"launching on {len(active)} hosts via {args.launcher}: {list(active)}")
+    if args.launcher == "ssh":
+        procs = [subprocess.Popen(cmd) for cmd in runner.get_host_cmds(env)]
+        rcs = [p.wait() for p in procs]
+        return next((rc for rc in rcs if rc), 0)
+    cmd = runner.get_cmd(env, active)
+    logger.info("cmd = " + " ".join(cmd))
+    return subprocess.call(cmd)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main() or 0)

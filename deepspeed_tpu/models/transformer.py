@@ -133,20 +133,21 @@ def _update_cache(cache_kv, new_kv, cache_index):
 def cached_attention(q, k_cache, v_cache, q_pos):
     """Decode attention over the full KV cache with per-sequence validity:
     cache slot j attends iff ``j <= q_pos`` (absolute position), which also
-    masks unwritten slots. q: [B,S,H,D]; caches: [B,M,Hk,D]; q_pos: [B,S]."""
+    masks unwritten slots. q: [B,S,H,D]; caches: [B,M,Hk,D]; q_pos: [B,S].
+    GQA is handled by grouping query heads per kv head — no materialized
+    kv-head replication."""
     b, s, h, d = q.shape
     m, hk = k_cache.shape[1], k_cache.shape[2]
-    if hk != h:
-        rep = h // hk
-        k_cache = jnp.repeat(k_cache, rep, axis=2)
-        v_cache = jnp.repeat(v_cache, rep, axis=2)
+    rep = h // hk
+    qg = q.reshape(b, s, hk, rep, d)
     scale = 1.0 / np.sqrt(d)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache.astype(q.dtype),
+    logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k_cache.astype(q.dtype),
                         preferred_element_type=jnp.float32) * scale
-    mask = jnp.arange(m)[None, None, None, :] <= q_pos[:, None, :, None]
+    mask = jnp.arange(m)[None, None, None, None, :] <= q_pos[:, None, None, :, None]
     logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache.astype(q.dtype))
+    out = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v_cache.astype(q.dtype))
+    return out.reshape(b, s, h, d)
 
 
 class Attention(nn.Module):
@@ -165,6 +166,10 @@ class Attention(nn.Module):
         if cfg.position == "rope":
             cos, sin = rope_table(cfg.max_seq_len, d, cfg.rope_theta)
 
+        o_proj = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                                 use_bias=(cfg.norm == "layernorm"), dtype=cfg.dtype,
+                                 param_dtype=jnp.float32, name="o_proj")
+
         if cache is not None:
             # incremental decoding path (inference v1 engine)
             positions = cache_index[:, None] + jnp.arange(x.shape[1])[None, :]
@@ -173,11 +178,14 @@ class Attention(nn.Module):
                 k = apply_rope(k, cos, sin, positions)
             new_cache = {"k": _update_cache(cache["k"], k, cache_index),
                          "v": _update_cache(cache["v"], v, cache_index)}
-            out = cached_attention(q, new_cache["k"], new_cache["v"], positions)
-            out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
-                                  use_bias=(cfg.norm == "layernorm"), dtype=cfg.dtype,
-                                  param_dtype=jnp.float32, name="o_proj")(out)
-            return out, new_cache
+            if x.shape[1] > 1:
+                # whole-prompt prefill (cache_index==0 in the v1 engine):
+                # attend within the fresh prompt — [S,S] logits, not [S,M]
+                # over the cache's unwritten capacity
+                out = attention_core(q, k, v, causal=True, impl="xla")
+            else:
+                out = cached_attention(q, new_cache["k"], new_cache["v"], positions)
+            return o_proj(out), new_cache
 
         impl = cfg.attn_impl
         if impl == "auto":
@@ -204,9 +212,7 @@ class Attention(nn.Module):
                 k = apply_rope(k, cos, sin)
             out = attention_core(q, k, v, causal=True, impl=impl)
 
-        out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
-                              use_bias=(cfg.norm == "layernorm"), dtype=cfg.dtype,
-                              param_dtype=jnp.float32, name="o_proj")(out)
+        out = o_proj(out)
         if cfg.dropout > 0 and not deterministic:
             out = nn.Dropout(rate=cfg.dropout)(out, deterministic=False)
         return out

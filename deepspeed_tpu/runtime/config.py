@@ -183,7 +183,7 @@ class FlopsProfilerConfig(ConfigModel):
     enabled: bool = False
     profile_step: int = 1
     module_depth: int = -1
-    top_modules: int = 1
+    top_modules: int = 3
     detailed: bool = True
     output_file: Optional[str] = None
 
@@ -390,16 +390,22 @@ class DeepSpeedTPUConfig(ConfigModel):
         # the true dp world size without conflicting with defaults filled here.
         self._user_batch = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
                             self.gradient_accumulation_steps)
-        self._resolve_batch_sizes()
+        self._resolve_batch_sizes(strict=False)
 
-    def _resolve_batch_sizes(self, world_dp_size: int = 1):
-        """Reference ``config.py`` batch-size triangle: tbs = mbs * gas * dp."""
+    def _resolve_batch_sizes(self, world_dp_size: int = 1, strict: bool = True):
+        """Reference ``config.py`` batch-size triangle: tbs = mbs * gas * dp.
+
+        ``strict=False`` (config load time, before the engine knows the real
+        dp size) keeps a fully-specified but dp-inconsistent triangle as-is;
+        ``finalize(world_dp_size)`` re-resolves strictly."""
         raw_tbs, raw_mbs, raw_gas = self._user_batch
         tbs = raw_tbs if isinstance(raw_tbs, int) else None
         mbs = raw_mbs if isinstance(raw_mbs, int) else None
         gas = raw_gas if isinstance(raw_gas, int) else None
         if tbs and mbs and gas:
             if tbs != mbs * gas * world_dp_size:
+                if not strict:
+                    return  # defer to finalize() with the true dp size
                 raise ConfigError(
                     f"train_batch_size({tbs}) != micro_batch({mbs}) * gas({gas}) * dp({world_dp_size})")
         elif tbs and mbs:

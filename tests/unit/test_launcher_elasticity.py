@@ -1,0 +1,242 @@
+"""Launcher + elasticity tests (reference: tests/unit/elasticity/,
+launcher hostfile tests)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.elasticity import (ElasticityConfig, ElasticityConfigError,
+                                      ElasticityIncompatibleWorldSize,
+                                      compute_elastic_config, valid_chip_counts)
+from deepspeed_tpu.launcher import (fetch_hostfile, parse_args,
+                                    parse_inclusion_exclusion)
+from deepspeed_tpu.launcher.multinode_runner import (OpenMPIRunner, PDSHRunner,
+                                                     SlurmRunner, SSHRunner)
+
+
+# ---------------------------------------------------------------------------
+# hostfile parsing
+# ---------------------------------------------------------------------------
+
+
+def test_fetch_hostfile(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text(textwrap.dedent("""\
+        # comment
+        worker-0 slots=4
+        worker-1 slots=4
+
+        worker-2   # trailing comment, default slots
+        """))
+    pool = fetch_hostfile(str(hf))
+    assert pool == {"worker-0": 4, "worker-1": 4, "worker-2": 1}
+
+
+def test_fetch_hostfile_missing(tmp_path):
+    assert fetch_hostfile(str(tmp_path / "nope")) is None
+
+
+def test_fetch_hostfile_duplicate(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("w0 slots=2\nw0 slots=2\n")
+    with pytest.raises(ValueError, match="duplicate"):
+        fetch_hostfile(str(hf))
+
+
+def test_include_exclude_filters():
+    pool = {"w0": 4, "w1": 4, "w2": 4}
+    assert parse_inclusion_exclusion(pool, "w0@w2", "") == {"w0": 4, "w2": 4}
+    assert parse_inclusion_exclusion(pool, "", "w1") == {"w0": 4, "w2": 4}
+    assert parse_inclusion_exclusion(pool, "w1:0,1", "") == {"w1": 2}
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        parse_inclusion_exclusion(pool, "w0", "w1")
+    with pytest.raises(ValueError, match="not in hostfile"):
+        parse_inclusion_exclusion(pool, "w9", "")
+
+
+# ---------------------------------------------------------------------------
+# runner command construction
+# ---------------------------------------------------------------------------
+
+
+def _args(extra=()):
+    return parse_args(["--master_addr", "w0", "--master_port", "9999",
+                       *extra, "train.py", "--foo", "1"])
+
+
+def test_ssh_runner_cmds():
+    r = SSHRunner(_args(), {"w0": 1, "w1": 1})
+    cmds = r.get_host_cmds({})
+    assert len(cmds) == 2
+    assert cmds[0][0] == "ssh" and cmds[0][-2] == "w0"
+    assert "DSTPU_PROCESS_ID=0" in cmds[0][-1]
+    assert "DSTPU_PROCESS_ID=1" in cmds[1][-1]
+    assert "DSTPU_COORDINATOR=w0:9999" in cmds[1][-1]
+    assert "DSTPU_NUM_PROCESSES=2" in cmds[0][-1]
+
+
+def test_pdsh_runner_cmd():
+    r = PDSHRunner(_args(), {"w0": 1, "w1": 1})
+    cmd = r.get_cmd({}, {"w0": 1, "w1": 1})
+    assert cmd[0] == "pdsh" and "w0,w1" in cmd
+    remote = cmd[-1]
+    assert "DSTPU_COORDINATOR=w0:9999" in remote
+    assert "train.py" in remote
+
+
+def test_openmpi_runner_cmd():
+    r = OpenMPIRunner(_args(), {"w0": 1, "w1": 1})
+    cmd = r.get_cmd({}, {"w0": 1, "w1": 1})
+    assert cmd[:3] == ["mpirun", "-n", "2"]
+    assert "DSTPU_COORDINATOR=w0:9999" in " ".join(cmd)
+
+
+def test_slurm_runner_cmd():
+    r = SlurmRunner(_args(), {"w0": 1, "w1": 1})
+    cmd = r.get_cmd({}, {"w0": 1, "w1": 1})
+    assert cmd[0] == "srun"
+    assert any(c.startswith("--export=ALL,") for c in cmd)
+
+
+def test_runner_exports_forwarded():
+    r = SSHRunner(_args(), {"w0": 1})
+    r.add_export("XLA_FLAGS", "--xla_dump_to=/tmp/d")
+    cmds = r.get_host_cmds({})
+    assert "XLA_FLAGS" in cmds[0][-1]
+
+
+# ---------------------------------------------------------------------------
+# single-node end-to-end: dstpu CLI actually runs a script
+# ---------------------------------------------------------------------------
+
+
+def test_launch_local_runs_script(tmp_path):
+    script = tmp_path / "train.py"
+    out = tmp_path / "out.txt"
+    script.write_text(textwrap.dedent(f"""\
+        import os
+        with open({str(out)!r}, 'w') as f:
+            f.write(os.environ.get('DSTPU_PROCESS_ID', 'missing'))
+        """))
+    from deepspeed_tpu.launcher.runner import main
+
+    rc = main(["--hostfile", str(tmp_path / "none"), str(script)])
+    assert rc == 0
+    assert out.read_text() == "0"
+
+
+def test_elastic_supervision_restarts(tmp_path):
+    script = tmp_path / "flaky.py"
+    marker = tmp_path / "marker"
+    # fails on first run, succeeds on second
+    script.write_text(textwrap.dedent(f"""\
+        import os, sys
+        m = {str(marker)!r}
+        if not os.path.exists(m):
+            open(m, 'w').close()
+            sys.exit(3)
+        sys.exit(0)
+        """))
+    from deepspeed_tpu.launcher.launch import _supervise
+
+    rc = _supervise([sys.executable, str(script)], dict(os.environ),
+                    max_restarts=2, min_uptime_s=0.0, backoff_s=0.0)
+    assert rc == 0
+    assert marker.exists()
+
+
+# ---------------------------------------------------------------------------
+# elasticity math
+# ---------------------------------------------------------------------------
+
+
+def test_valid_chip_counts():
+    # batch 12, micro {2,3}: c valid iff 12 % (m*c) == 0 for some m
+    assert valid_chip_counts(12, [2, 3], 1, 8) == [1, 2, 3, 4, 6]
+
+
+def test_compute_elastic_config_schedule_only():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 100,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 16}}
+    final, valid, micro = compute_elastic_config(cfg)
+    assert final <= 100 and micro is None
+    # the chosen batch must be maximally flexible: every power of two to 16 valid
+    for c in (1, 2, 4, 8, 16):
+        assert c in valid
+
+
+def test_compute_elastic_config_with_world_size():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 8}}
+    final, valid, micro = compute_elastic_config(cfg, world_size=4)
+    assert final % (micro * 4) == 0
+    assert micro in (2, 4)
+
+
+def test_compute_elastic_config_incompatible_world():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 8,
+                          "micro_batch_sizes": [8], "min_gpus": 1, "max_gpus": 1}}
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(cfg, world_size=7)
+
+
+def test_elasticity_config_validation():
+    with pytest.raises(ElasticityConfigError):
+        ElasticityConfig.from_dict({"max_train_batch_size": 0})
+    with pytest.raises(ElasticityConfigError):
+        ElasticityConfig.from_dict({"micro_batch_sizes": []})
+    with pytest.raises(ElasticityConfigError):
+        ElasticityConfig.from_dict({"min_gpus": 5, "max_gpus": 2})
+    cfg = ElasticityConfig.from_dict({"min_gpus": 2, "max_gpus": 4})
+    assert (cfg.min_chips, cfg.max_chips) == (2, 4)
+
+
+def test_prefer_larger_batch():
+    kw = dict(enabled=True, max_train_batch_size=16, micro_batch_sizes=[1],
+              min_gpus=1, max_gpus=1)
+    final_large, _, _ = compute_elastic_config({"elasticity": dict(kw)})
+    final_small, _, _ = compute_elastic_config(
+        {"elasticity": dict(kw, prefer_larger_batch=False)})
+    assert final_large == 16 and final_small == 1
+
+
+def test_compute_elastic_config_requires_enabled():
+    with pytest.raises(ElasticityConfigError, match="not enabled"):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+    with pytest.raises(ElasticityConfigError, match="no 'elasticity'"):
+        compute_elastic_config({"train_batch_size": 8})
+
+
+def test_supervise_stops_on_signal(tmp_path, monkeypatch):
+    """A SIGTERM'd worker must not be restarted (reviewed failure mode:
+    elastic jobs were unkillable): once the forwarded-signal flag is set,
+    a non-zero child exit ends supervision instead of relaunching."""
+    import signal as _signal
+
+    from deepspeed_tpu.launcher import launch as launch_mod
+
+    script = tmp_path / "fail.py"
+    script.write_text("import sys; sys.exit(1)\n")
+    launches = []
+
+    def fake_forward(proc, stop_flag=None):
+        launches.append(proc)
+        if stop_flag is not None:  # as if SIGTERM arrived during this child
+            stop_flag.append(_signal.SIGTERM)
+
+    monkeypatch.setattr(launch_mod, "_forward_signals", fake_forward)
+    rc = launch_mod._supervise([sys.executable, str(script)], dict(os.environ),
+                               max_restarts=5, min_uptime_s=0.0, backoff_s=0.0)
+    assert rc == 1
+    assert len(launches) == 1  # no restart after the signal
+
+
+def test_pdsh_ip_hostfile_maps_process_id():
+    r = PDSHRunner(_args(), {"10.0.0.1": 1, "10.0.0.2": 1})
+    cmd = r.get_cmd({}, {"10.0.0.1": 1, "10.0.0.2": 1})
+    remote = cmd[-1]
+    assert "hostname -I" in remote  # IP-based hostfiles resolve via local IPs
+    assert "cannot map" in remote   # and fail loudly instead of defaulting to 0
