@@ -211,7 +211,8 @@ class InferenceEngine:
             cache = jax.lax.with_sharding_constraint(cache, cache_sh)
             # prefill: positions 0..S-1, write offsets 0
             logits, cache = model.apply({"params": params}, tokens,
-                                        cache=cache, cache_index=jnp.zeros((b,), jnp.int32))
+                                        cache=cache, cache_index=jnp.zeros((b,), jnp.int32),
+                                        whole_prefill=True)
             # next-token logits at each row's last real position
             last = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
             rng, r0 = jax.random.split(rng)

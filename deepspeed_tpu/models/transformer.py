@@ -154,7 +154,8 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, *, deterministic=True, cache=None, cache_index=None):
+    def __call__(self, x, *, deterministic=True, cache=None, cache_index=None,
+                 whole_prefill=False):
         cfg = self.cfg
         h, hk, d = cfg.num_heads, cfg.kv_heads, cfg.head_dim
         dense = partial(nn.DenseGeneral, use_bias=(cfg.norm == "layernorm"),
@@ -178,10 +179,12 @@ class Attention(nn.Module):
                 k = apply_rope(k, cos, sin, positions)
             new_cache = {"k": _update_cache(cache["k"], k, cache_index),
                          "v": _update_cache(cache["v"], v, cache_index)}
-            if x.shape[1] > 1:
-                # whole-prompt prefill (cache_index==0 in the v1 engine):
+            if x.shape[1] > 1 and whole_prefill:
+                # whole-prompt prefill (caller asserts cache_index==0):
                 # attend within the fresh prompt — [S,S] logits, not [S,M]
-                # over the cache's unwritten capacity
+                # over the cache's unwritten capacity. Without the static
+                # whole_prefill promise, chunked multi-token calls take the
+                # full-cache path, which is correct for any cache_index.
                 out = attention_core(q, k, v, causal=True, impl="xla")
             else:
                 out = cached_attention(q, new_cache["k"], new_cache["v"], positions)
@@ -244,14 +247,16 @@ class Block(nn.Module):
     layer_idx: int = 0
 
     @nn.compact
-    def __call__(self, x, deterministic=True, cache=None, cache_index=None):
+    def __call__(self, x, deterministic=True, cache=None, cache_index=None,
+                 whole_prefill=False):
         # (x, deterministic) stay positional for nn.remat static_argnums
         cfg = self.cfg
         y = _norm(cfg, "attn_norm")(x)
         attn = Attention(cfg, name="attn")
         if cache is not None:
             attn_out, new_cache = attn(y, deterministic=deterministic,
-                                       cache=cache, cache_index=cache_index)
+                                       cache=cache, cache_index=cache_index,
+                                       whole_prefill=whole_prefill)
         else:
             attn_out, new_cache = attn(y, deterministic=deterministic), None
         x = x + attn_out
@@ -273,7 +278,8 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, *, deterministic=True, cache=None, cache_index=None):
+    def __call__(self, tokens, *, deterministic=True, cache=None, cache_index=None,
+                 whole_prefill=False):
         """Training/eval: ``logits = __call__(tokens)``. Incremental decode
         (inference v1): pass ``cache`` (see ``init_kv_cache``) + per-sequence
         write offsets ``cache_index [B]`` → ``(logits, new_cache)``."""
@@ -301,7 +307,8 @@ class TransformerLM(nn.Module):
             name = f"layer_{i}"
             if cache is not None:
                 x, new_cache[name] = block(cfg, i, name=name)(
-                    x, deterministic, cache=cache[name], cache_index=cache_index)
+                    x, deterministic, cache=cache[name], cache_index=cache_index,
+                    whole_prefill=whole_prefill)
             else:
                 x = block(cfg, i, name=name)(x, deterministic)
         x = _norm(cfg, "final_norm")(x)

@@ -1,0 +1,19 @@
+"""Data pipeline: curriculum learning, difficulty-based sampling, mmap
+datasets, offline difficulty analysis, random-LTD token dropping.
+
+Reference: ``deepspeed/runtime/data_pipeline/`` — ``curriculum_scheduler.py``,
+``data_sampling/{data_sampler,data_analyzer,indexed_dataset}.py``,
+``data_routing/basic_layer.py`` (RandomLTD).
+"""
+
+from .curriculum_scheduler import CurriculumScheduler
+from .data_analyzer import DataAnalyzer
+from .data_sampler import DeepSpeedDataSampler
+from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
+from .random_ltd import RandomLTDScheduler, random_ltd_apply, random_ltd_select
+
+__all__ = [
+    "CurriculumScheduler", "DataAnalyzer", "DeepSpeedDataSampler",
+    "MMapIndexedDataset", "MMapIndexedDatasetBuilder",
+    "RandomLTDScheduler", "random_ltd_apply", "random_ltd_select",
+]

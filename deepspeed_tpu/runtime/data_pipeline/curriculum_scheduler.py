@@ -1,0 +1,109 @@
+"""Curriculum learning scheduler.
+
+Reference ``CurriculumScheduler`` (``runtime/data_pipeline/
+curriculum_scheduler.py:11``): maps the global training step to a
+"difficulty" (canonically the effective sequence length), increasing it over
+training per a configured schedule. The engine/dataloader truncate or filter
+batches to the current difficulty. On TPU each distinct difficulty is a new
+static shape → one XLA recompile; ``fixed_discrete`` and the rounded
+``fixed_linear``/``fixed_root`` schedules keep that set small.
+"""
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+    """Config keys follow the reference vocabulary::
+
+        {"curriculum_type": "seqlen", "min_difficulty": 64,
+         "max_difficulty": 1024, "schedule_type": "fixed_linear",
+         "schedule_config": {"total_curriculum_step": 10000,
+                             "difficulty_step": 8}}
+
+    ``fixed_root`` adds ``root_degree``; ``fixed_discrete`` instead takes
+    ``{"difficulty": [...], "max_step": [...]}``; ``custom`` takes a callable
+    via :meth:`set_custom_get_difficulty`.
+    """
+
+    def __init__(self, config: Dict[str, Any]):
+        self.state: Dict[str, Any] = {}
+        self.curriculum_type = config.get("curriculum_type", "seqlen")
+        self.min_difficulty = int(config.get("min_difficulty", 1))
+        self.max_difficulty = int(config.get("max_difficulty", self.min_difficulty))
+        self.schedule_type = config.get("schedule_type", FIXED_LINEAR)
+        self.schedule = dict(config.get("schedule_config", {}))
+        self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+        self.current_difficulty = self.min_difficulty
+        self.first_step = True
+
+        if self.schedule_type in (FIXED_LINEAR, FIXED_ROOT):
+            if "total_curriculum_step" not in self.schedule:
+                raise ValueError(f"{self.schedule_type} needs schedule_config."
+                                 "total_curriculum_step")
+            self.schedule.setdefault("difficulty_step", 1)
+            if self.schedule_type == FIXED_ROOT:
+                self.schedule.setdefault("root_degree", 2)
+        elif self.schedule_type == FIXED_DISCRETE:
+            diffs = self.schedule.get("difficulty")
+            steps = self.schedule.get("max_step")
+            if not diffs or steps is None or len(steps) != len(diffs) - 1:
+                raise ValueError("fixed_discrete needs schedule_config.difficulty "
+                                 "(N values) and max_step (N-1 boundaries)")
+        elif self.schedule_type != CUSTOM:
+            raise ValueError(f"unknown curriculum schedule_type {self.schedule_type!r}")
+
+    # ------------------------------------------------------------------
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]):
+        self.custom_get_difficulty = fn
+
+    def get_current_difficulty(self) -> int:
+        return self.current_difficulty
+
+    def update_difficulty(self, global_step: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_step)
+        return self.current_difficulty
+
+    # ------------------------------------------------------------------
+    def get_difficulty(self, global_step: int) -> int:
+        if self.schedule_type == FIXED_LINEAR:
+            return self._fixed_linear(global_step)
+        if self.schedule_type == FIXED_ROOT:
+            return self._fixed_root(global_step)
+        if self.schedule_type == FIXED_DISCRETE:
+            return self._fixed_discrete(global_step)
+        if self.custom_get_difficulty is None:
+            raise RuntimeError("custom curriculum schedule needs "
+                               "set_custom_get_difficulty(fn)")
+        return int(self.custom_get_difficulty(global_step))
+
+    def _quantize(self, diff: float) -> int:
+        step = int(self.schedule["difficulty_step"])
+        d = int(diff // step) * step
+        return max(self.min_difficulty, min(self.max_difficulty, d))
+
+    def _fixed_linear(self, global_step: int) -> int:
+        total = self.schedule["total_curriculum_step"]
+        frac = min(1.0, global_step / total)
+        diff = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        return self._quantize(diff)
+
+    def _fixed_root(self, global_step: int) -> int:
+        total = self.schedule["total_curriculum_step"]
+        degree = self.schedule["root_degree"]
+        frac = min(1.0, global_step / total) ** (1.0 / degree)
+        diff = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        return self._quantize(diff)
+
+    def _fixed_discrete(self, global_step: int) -> int:
+        diffs = self.schedule["difficulty"]
+        bounds = self.schedule["max_step"]
+        for d, bound in zip(diffs, bounds):
+            if global_step <= bound:
+                return int(d)
+        return int(diffs[-1])

@@ -1,0 +1,96 @@
+"""Offline difficulty analysis (map-reduce).
+
+Reference ``DataAnalyzer`` (``data_sampling/data_analyzer.py``): a corpus
+pass computing per-sample "difficulty" metrics (seqlen, vocab rarity, ...)
+sharded over workers, then a reduce that merges shards and emits, per metric:
+
+* ``<out>/<metric>_sample_to_metric.npy`` — metric value per sample index
+* ``<out>/<metric>_index_to_sample.npz`` — for each distinct metric value,
+  the sample indices having it (the curriculum buckets the sampler draws from)
+
+Metric fns are numpy-level; the analysis is host-side (no TPU involvement).
+"""
+
+import os
+from typing import Callable, Dict, List, Sequence
+
+import numpy as np
+
+METRIC_SEQLEN = "seqlen"
+
+
+def metric_seqlen(sample) -> int:
+    return int(np.asarray(sample).shape[-1])
+
+
+def metric_vocab_rarity(vocab_freq: np.ndarray) -> Callable:
+    """Lower = more common tokens. Difficulty = -mean log frequency."""
+    logf = np.log(np.maximum(vocab_freq.astype(np.float64), 1.0))
+
+    def fn(sample) -> int:
+        toks = np.asarray(sample).reshape(-1)
+        return int(-logf[toks].mean() * 100)  # scaled to int difficulty
+
+    return fn
+
+
+class DataAnalyzer:
+    def __init__(self, dataset, metric_names: Sequence[str] = (METRIC_SEQLEN,),
+                 metric_fns: Dict[str, Callable] = None, output_dir: str = "./analysis",
+                 num_workers: int = 1, worker_id: int = 0):
+        self.dataset = dataset
+        self.metric_names = list(metric_names)
+        self.metric_fns = dict(metric_fns or {METRIC_SEQLEN: metric_seqlen})
+        for m in self.metric_names:
+            if m not in self.metric_fns:
+                raise ValueError(f"no metric fn for {m!r}")
+        self.output_dir = output_dir
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+
+    # map ---------------------------------------------------------------
+    def _shard_range(self):
+        n = len(self.dataset)
+        per = (n + self.num_workers - 1) // self.num_workers
+        lo = self.worker_id * per
+        return lo, min(n, lo + per)
+
+    def run_map(self):
+        """Compute this worker's shard; writes partial npy files."""
+        os.makedirs(self.output_dir, exist_ok=True)
+        lo, hi = self._shard_range()
+        results = {m: np.empty(hi - lo, np.int64) for m in self.metric_names}
+        for i in range(lo, hi):
+            sample = self.dataset[i]
+            for m in self.metric_names:
+                results[m][i - lo] = self.metric_fns[m](sample)
+        for m, vals in results.items():
+            np.save(self._part_path(m, self.worker_id), vals)
+
+    def _part_path(self, metric: str, worker: int) -> str:
+        return os.path.join(self.output_dir, f"{metric}_part{worker}.npy")
+
+    # reduce ------------------------------------------------------------
+    def run_reduce(self):
+        """Merge worker shards into sample_to_metric + index_to_sample."""
+        for m in self.metric_names:
+            parts = [np.load(self._part_path(m, w)) for w in range(self.num_workers)]
+            sample_to_metric = np.concatenate(parts)
+            np.save(os.path.join(self.output_dir, f"{m}_sample_to_metric.npy"),
+                    sample_to_metric)
+            values = np.unique(sample_to_metric)
+            buckets = {str(v): np.nonzero(sample_to_metric == v)[0] for v in values}
+            np.savez(os.path.join(self.output_dir, f"{m}_index_to_sample.npz"),
+                     **buckets)
+
+    def run(self):
+        """Single-process convenience: map all shards then reduce."""
+        for w in range(self.num_workers):
+            DataAnalyzer(self.dataset, self.metric_names, self.metric_fns,
+                         self.output_dir, self.num_workers, w).run_map()
+        self.run_reduce()
+
+    # load --------------------------------------------------------------
+    @staticmethod
+    def load_sample_to_metric(output_dir: str, metric: str) -> np.ndarray:
+        return np.load(os.path.join(output_dir, f"{metric}_sample_to_metric.npy"))

@@ -1,0 +1,82 @@
+"""Difficulty-ordered curriculum sampling.
+
+Reference ``DeepSpeedDataSampler`` (``data_sampling/data_sampler.py``): at
+each step, draw the global batch from the pool of samples whose analyzed
+difficulty is within the curriculum's current threshold, deterministically
+across hosts (same seed → same indices everywhere; each host then feeds its
+dp shard). Consumed samples recycle when the eligible pool is exhausted.
+"""
+
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+class DeepSpeedDataSampler:
+    def __init__(self, sample_to_metric: np.ndarray, batch_size: int,
+                 curriculum: Optional[CurriculumScheduler] = None,
+                 seed: int = 1234, drop_last: bool = True):
+        self.metric = np.asarray(sample_to_metric)
+        self.order = np.argsort(self.metric, kind="stable")  # easy → hard
+        self.sorted_metric = self.metric[self.order]
+        self.batch_size = batch_size
+        self.curriculum = curriculum
+        self.seed = seed
+        self.drop_last = drop_last
+        self.global_step = 0
+        self._consumed = 0
+        self._perm = None
+        self._perm_size = 0
+        self._perm_step = 0  # step whose seed generated the live permutation
+
+    def __len__(self):
+        return len(self.metric) // self.batch_size
+
+    def _eligible_count(self) -> int:
+        if self.curriculum is None:
+            return len(self.metric)
+        difficulty = self.curriculum.update_difficulty(self.global_step)
+        # all samples with metric <= current difficulty threshold
+        return int(np.searchsorted(self.sorted_metric, difficulty, side="right"))
+
+    def next_batch(self) -> np.ndarray:
+        """Global batch of sample indices for the current step."""
+        n = max(self._eligible_count(), min(self.batch_size, len(self.metric)))
+        if self._perm is None or self._perm_size != n or \
+                self._consumed + self.batch_size > len(self._perm):
+            rng = np.random.default_rng(self.seed + self.global_step)
+            self._perm = rng.permutation(n)
+            self._perm_size = n
+            self._perm_step = self.global_step
+            self._consumed = 0
+        sel = self._perm[self._consumed:self._consumed + self.batch_size]
+        self._consumed += self.batch_size
+        self.global_step += 1
+        return self.order[sel]
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            yield self.next_batch()
+
+    # checkpoint --------------------------------------------------------
+    def state_dict(self):
+        return {"global_step": self.global_step, "consumed": self._consumed,
+                "seed": self.seed, "perm_step": self._perm_step,
+                "perm_size": self._perm_size}
+
+    def load_state_dict(self, sd):
+        """Resume exactly: regenerate the live permutation from the seed of
+        the step that created it, so the post-resume draw sequence matches an
+        uninterrupted run (no replay of consumed samples)."""
+        self.global_step = sd["global_step"]
+        self._consumed = sd["consumed"]
+        self.seed = sd["seed"]
+        self._perm_step = sd.get("perm_step", 0)
+        self._perm_size = sd.get("perm_size", 0)
+        if self._perm_size > 0:
+            rng = np.random.default_rng(self.seed + self._perm_step)
+            self._perm = rng.permutation(self._perm_size)
+        else:
+            self._perm = None

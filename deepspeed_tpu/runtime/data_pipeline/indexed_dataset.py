@@ -1,0 +1,112 @@
+"""Memory-mapped indexed dataset.
+
+Reference: ``data_sampling/indexed_dataset.py`` (Megatron-style ``.bin`` +
+``.idx`` pair). Re-designed minimal format (not a byte-level copy):
+
+``<path>.bin`` — all documents' tokens, flat, one dtype.
+``<path>.idx`` — header ``DSTPUIDX`` + version u32 + dtype code u32 +
+doc count u64, then ``sizes`` (u32[count]) and ``pointers`` (u64[count],
+byte offsets into .bin).
+
+Reads are ``np.memmap`` slices — zero-copy host RAM paging, which feeds
+``jax.device_put`` per batch without materializing the corpus.
+"""
+
+import os
+import struct
+from typing import Sequence
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+           5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16,
+           9: np.uint32}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer: ``add_item(tokens)`` per document, ``finalize()``."""
+
+    def __init__(self, path_prefix: str, dtype=np.int32):
+        self.prefix = path_prefix
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _DTYPE_CODES:
+            raise ValueError(f"unsupported dtype {dtype}")
+        self._data = open(data_file_path(path_prefix), "wb")
+        self._sizes = []
+        self._pointers = []
+        self._offset = 0
+
+    def add_item(self, tokens: Sequence[int]):
+        arr = np.asarray(tokens, dtype=self.dtype)
+        self._data.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+        self._pointers.append(self._offset)
+        self._offset += arr.nbytes
+
+    def merge_file_(self, other_prefix: str):
+        """Append another builder's finalized files (the reduce step of
+        multi-worker dataset building, reference ``merge_file_``)."""
+        other = MMapIndexedDataset(other_prefix)
+        for i in range(len(other)):
+            self.add_item(other[i])
+
+    def finalize(self):
+        self._data.close()
+        with open(index_file_path(self.prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<II", _VERSION, _DTYPE_CODES[self.dtype]))
+            f.write(struct.pack("<Q", len(self._sizes)))
+            f.write(np.asarray(self._sizes, np.uint32).tobytes())
+            f.write(np.asarray(self._pointers, np.uint64).tobytes())
+
+
+class MMapIndexedDataset:
+    """Random-access reader over the ``.bin``/``.idx`` pair."""
+
+    def __init__(self, path_prefix: str):
+        idx_path = index_file_path(path_prefix)
+        with open(idx_path, "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(f"{idx_path}: bad magic {magic!r}")
+            version, dtype_code = struct.unpack("<II", f.read(8))
+            if version != _VERSION:
+                raise ValueError(f"{idx_path}: unsupported version {version}")
+            (count,) = struct.unpack("<Q", f.read(8))
+            header = f.tell()
+        self.dtype = np.dtype(_DTYPES[dtype_code])
+        idx = np.memmap(idx_path, mode="r", offset=header, dtype=np.uint8)
+        self.sizes = idx[:count * 4].view(np.uint32)
+        self.pointers = idx[count * 4:count * 4 + count * 8].view(np.uint64)
+        self._data = np.memmap(data_file_path(path_prefix), mode="r", dtype=np.uint8)
+
+    def __len__(self) -> int:
+        return len(self.sizes)
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        ptr, size = int(self.pointers[i]), int(self.sizes[i])
+        nbytes = size * self.dtype.itemsize
+        return self._data[ptr:ptr + nbytes].view(self.dtype)
+
+    def get(self, i: int, offset: int = 0, length: int = None) -> np.ndarray:
+        doc = self[i]
+        return doc[offset:None if length is None else offset + length]
+
+    @staticmethod
+    def exists(path_prefix: str) -> bool:
+        return (os.path.exists(data_file_path(path_prefix))
+                and os.path.exists(index_file_path(path_prefix)))

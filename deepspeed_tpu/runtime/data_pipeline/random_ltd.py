@@ -1,0 +1,82 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference: ``data_routing/basic_layer.py:14`` (``RandomLayerTokenDrop``) +
+``csrc/random_ltd/*`` (token sort/gather/scatter kernels). Each wrapped
+transformer layer processes only a random subset of tokens; dropped tokens
+bypass the layer unchanged, and the kept-token count ramps up over training.
+
+TPU-native: the kept count is static per schedule stage (one XLA program per
+stage — the scheduler quantizes to keep that set small); select/restore are
+``jnp.take_along_axis`` / scatter, which XLA fuses — no custom kernels needed.
+"""
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def random_ltd_select(x: jax.Array, rng: jax.Array, keep: int
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Pick ``keep`` random token positions per batch row.
+
+    x: [B, S, H] → (selected [B, keep, H], indices [B, keep] sorted ascending
+    so relative order — and thus causal masks/positions — are preserved).
+    """
+    b, s, _ = x.shape
+    scores = jax.random.uniform(rng, (b, s))
+    idx = jnp.argsort(scores, axis=-1)[:, :keep]
+    idx = jnp.sort(idx, axis=-1)
+    sel = jnp.take_along_axis(x, idx[:, :, None], axis=1)
+    return sel, idx
+
+
+def random_ltd_restore(x_full: jax.Array, x_processed: jax.Array,
+                       idx: jax.Array) -> jax.Array:
+    """Scatter processed tokens back into the full sequence; dropped tokens
+    keep their input values (the reference's bypass semantics)."""
+    b = x_full.shape[0]
+    batch_idx = jnp.arange(b)[:, None]
+    return x_full.at[batch_idx, idx].set(x_processed.astype(x_full.dtype))
+
+
+def random_ltd_apply(layer_fn: Callable, x: jax.Array, rng: jax.Array,
+                     keep: int, *args, **kwargs) -> jax.Array:
+    """Run ``layer_fn`` on a random ``keep``-token subset of ``x``."""
+    if keep >= x.shape[1]:
+        return layer_fn(x, *args, **kwargs)
+    sel, idx = random_ltd_select(x, rng, keep)
+    out = layer_fn(sel, *args, **kwargs)
+    return random_ltd_restore(x, out, idx)
+
+
+class RandomLTDScheduler:
+    """Ramp the kept-token count from ``min_value`` to ``max_value`` over
+    ``total_layer_drop_step`` steps in ``step_size`` increments (reference
+    scheduler config vocabulary: ``random_ltd_schedule``)."""
+
+    def __init__(self, config: Dict):
+        r = config.get("random_ltd", config)
+        self.min_value = int(r.get("random_ltd_schedule", r).get("min_value", 128))
+        sched = r.get("random_ltd_schedule", r)
+        self.max_value = int(sched.get("max_value", 2048))
+        cfg = sched.get("schedule_config", sched)
+        self.total_steps = int(cfg.get("total_layer_drop_step", 10000))
+        self.step_size = int(cfg.get("step_size", 16))
+        self.current_value = self.min_value
+
+    def get_value(self, global_step: int) -> int:
+        frac = min(1.0, global_step / max(1, self.total_steps))
+        v = self.min_value + frac * (self.max_value - self.min_value)
+        v = int(v // self.step_size) * self.step_size
+        return max(self.min_value, min(self.max_value, v))
+
+    def update(self, global_step: int) -> int:
+        self.current_value = self.get_value(global_step)
+        return self.current_value
+
+    def state_dict(self):
+        return {"current_value": self.current_value}
+
+    def load_state_dict(self, sd):
+        self.current_value = sd["current_value"]

@@ -120,6 +120,7 @@ class DeepSpeedTPUEngine:
         config.finalize(world_dp_size=self.topo.dp_size)
         self.loss_fn_raw = loss_fn
         self._loss_takes_rng = _accepts_rng(loss_fn)
+        self._loss_takes_ltd = _accepts_kw(loss_fn, "ltd_keep")
         self.gas = config.gradient_accumulation_steps
         self.micro_batch_size = config.train_micro_batch_size_per_gpu
         self.train_batch_size = config.train_batch_size
@@ -173,6 +174,28 @@ class DeepSpeedTPUEngine:
         self.flops_profiler = None
         self._last_batch = None
         self._step_times = []
+
+        # data-efficiency hooks (reference engine.py:354-358, 1887-1890)
+        self.curriculum_scheduler = None
+        self.random_ltd_scheduler = None
+        de = config.data_efficiency
+        if de.enabled:
+            cl = de.data_sampling.get("curriculum_learning", {})
+            if cl.get("enabled"):
+                from .data_pipeline import CurriculumScheduler
+
+                self.curriculum_scheduler = CurriculumScheduler(cl)
+            rl = de.data_routing.get("random_ltd", {})
+            if rl.get("enabled"):
+                from .data_pipeline import RandomLTDScheduler
+
+                self.random_ltd_scheduler = RandomLTDScheduler(de.data_routing)
+                if not self._loss_takes_ltd:
+                    logger.warning(
+                        "random_ltd is enabled but the loss fn does not accept an "
+                        "'ltd_keep' kwarg — token dropping will NOT be applied. "
+                        "Accept ltd_keep (tokens to keep per layer) and wrap layers "
+                        "with data_pipeline.random_ltd_apply.")
         log_dist(f"engine initialized: {self.topo}, zero_stage={zc.stage}, "
                  f"gas={self.gas}, micro_bs={self.micro_batch_size}, "
                  f"dtype={jnp.dtype(self.compute_dtype).name}")
@@ -219,14 +242,17 @@ class DeepSpeedTPUEngine:
         self.grad_spec_tree = self.rules.grad_spec_tree(self.state.params, self.param_specs_base)
 
     # ------------------------------------------------------------------
-    def _loss(self, params, batch, rng):
+    def _loss(self, params, batch, rng, ltd_keep=None):
         p = jax.tree.map(
             lambda x: x.astype(self.compute_dtype)
             if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        kw = {}
+        if ltd_keep is not None and self._loss_takes_ltd:
+            kw["ltd_keep"] = ltd_keep
         if self._loss_takes_rng:
-            out = self.loss_fn_raw(p, batch, rng)
+            out = self.loss_fn_raw(p, batch, rng, **kw)
         else:
-            out = self.loss_fn_raw(p, batch)
+            out = self.loss_fn_raw(p, batch, **kw)
         if isinstance(out, tuple):
             return out[0].astype(jnp.float32), out[1]
         return out.astype(jnp.float32), None
@@ -242,7 +268,7 @@ class DeepSpeedTPUEngine:
             # knob is accepted but has no additional effect.
             log_dist("prescale_gradients is subsumed by SPMD mean-reduction; ignoring")
 
-        def train_step(state: TrainState, batch, rng):
+        def train_step(state: TrainState, batch, rng, *, ltd_keep=None):
             scale = state.loss_scale.scale if fp16 else jnp.asarray(1.0, jnp.float32)
 
             def micro(carry, xs):
@@ -250,7 +276,7 @@ class DeepSpeedTPUEngine:
                 mb, mb_rng = xs
 
                 def scaled_loss(p):
-                    loss, aux = self._loss(p, mb, mb_rng)
+                    loss, aux = self._loss(p, mb, mb_rng, ltd_keep=ltd_keep)
                     return loss * scale, loss
 
                 grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params)
@@ -307,11 +333,19 @@ class DeepSpeedTPUEngine:
             params=self._param_shardings,
             opt_state=self._opt_shardings,
             loss_scale=jax.tree.map(lambda _: NamedSharding(topo.mesh, P()), self.state.loss_scale))
-        self._train_step = jax.jit(
-            train_step,
-            in_shardings=(state_sh, None, None),
-            out_shardings=(state_sh, None),
-            donate_argnums=(0,) if donate_state else ())
+
+        def make_train_step(ltd_keep):
+            # one compiled program per random-LTD stage (the scheduler's
+            # step_size quantization bounds how many exist)
+            return jax.jit(
+                partial(train_step, ltd_keep=ltd_keep),
+                in_shardings=(state_sh, None, None),
+                out_shardings=(state_sh, None),
+                donate_argnums=(0,) if donate_state else ())
+
+        self._make_train_step = make_train_step
+        self._train_steps = {None: make_train_step(None)}
+        self._train_step = self._train_steps[None]
         self._state_shardings = state_sh
         self._rng = jax.random.PRNGKey(config.seed)
 
@@ -328,10 +362,24 @@ class DeepSpeedTPUEngine:
         if batch is None:
             batch = _draw_from_iter(data_iter, self.gas)
         batch = self._shape_batch(batch)
+        if self.curriculum_scheduler is not None:
+            # seqlen curriculum: truncate [gas, micro, seq] leaves to the
+            # current difficulty. Each distinct difficulty is one recompile;
+            # the scheduler's difficulty_step quantization bounds that set.
+            diff = self.curriculum_scheduler.update_difficulty(self.global_steps)
+            if self.curriculum_scheduler.curriculum_type == "seqlen":
+                batch = jax.tree.map(
+                    lambda x: x[:, :, :diff] if x.ndim >= 3 else x, batch)
+        ltd_keep = None
+        if self.random_ltd_scheduler is not None and self._loss_takes_ltd:
+            ltd_keep = self.random_ltd_scheduler.update(self.global_steps)
         self._last_batch = batch  # reference only; sliced lazily by flops_profile
         self._rng, step_rng = jax.random.split(self._rng)
+        step_fn = self._train_steps.get(ltd_keep)
+        if step_fn is None:
+            step_fn = self._train_steps[ltd_keep] = self._make_train_step(ltd_keep)
         t0 = time.perf_counter()
-        self.state, metrics = self._train_step(self.state, batch, step_rng)
+        self.state, metrics = step_fn(self.state, batch, step_rng)
         self.global_steps += 1
         metrics = {k: np.asarray(v) for k, v in metrics.items()}
         if bool(metrics.pop("overflow", False)):
@@ -523,6 +571,15 @@ class DeepSpeedTPUEngine:
 
 
 # ---------------------------------------------------------------------------
+
+
+def _accepts_kw(fn, name: str) -> bool:
+    try:
+        sig = inspect.signature(fn)
+        return name in sig.parameters or any(
+            p.kind == p.VAR_KEYWORD for p in sig.parameters.values())
+    except (TypeError, ValueError):
+        return False
 
 
 def _accepts_rng(fn) -> bool:

