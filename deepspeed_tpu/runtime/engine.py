@@ -17,6 +17,7 @@ API surface preserved from the reference:
 """
 
 import inspect
+import os
 import time
 from functools import partial
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
@@ -204,9 +205,12 @@ class DeepSpeedTPUEngine:
     def _build_state(self, params):
         rules, topo = self.rules, self.topo
         store_dtype = jnp.float32 if self.master_weights else self.compute_dtype
+        # jnp.array (copy=True), NOT asarray: device_put can alias the
+        # caller's buffers, and the donated train step would then delete the
+        # user's own model_parameters arrays out from under them
         params = jax.tree.map(
-            lambda p: jnp.asarray(p, store_dtype) if jnp.issubdtype(
-                jnp.asarray(p).dtype, jnp.floating) else jnp.asarray(p), params)
+            lambda p: jnp.array(p, store_dtype) if jnp.issubdtype(
+                jnp.asarray(p).dtype, jnp.floating) else jnp.array(p), params)
         self.param_spec_tree = rules.param_spec_tree(params, self.param_specs_base)
         param_sh = rules.shardings(self.param_spec_tree)
         params = jax.device_put(params, param_sh)
@@ -558,6 +562,76 @@ class DeepSpeedTPUEngine:
         dt = float(np.mean(recent))
         return {"step_time_s": dt, "samples_per_sec": self.train_batch_size / dt}
 
+    # state offload (reference ``engine.offload_states:3720``) ----------
+    def offload_states(self, include=("optimizer_state",), device: str = "cpu",
+                       nvme_path: Optional[str] = None):
+        """Move engine state off-device between training phases: ``cpu`` =
+        host RAM (numpy), ``nvme`` = SSD via the native aio swap tier
+        (``runtime/zero/swapper.py``). Training is invalid until
+        ``reload_states`` — same contract as the reference."""
+        self._offloaded = getattr(self, "_offloaded", {})
+        for raw_kind in include:
+            kind = self._canonical_kind(raw_kind)
+            if kind in self._offloaded:
+                continue
+            tree, sh = self._state_part(kind)
+            if device == "nvme":
+                sw = self._get_swapper(nvme_path)
+                sw.swap_out(kind, tree)
+                sw.synchronize(kind)
+                placeholder = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+                self._set_state_part(kind, placeholder)
+                self._offloaded[kind] = ("nvme", sh)
+            else:
+                self._set_state_part(kind, _offload_to_host(tree, sh))
+                self._offloaded[kind] = ("cpu", sh)
+
+    def reload_states(self):
+        for kind, (where, sh) in list(getattr(self, "_offloaded", {}).items()):
+            if where == "nvme":
+                tree = self._swapper.swap_in(kind, shardings=sh, delete=True)
+            else:
+                tree, _ = self._state_part(kind)
+                tree = jax.device_put(tree, sh)
+            self._set_state_part(kind, tree)
+            del self._offloaded[kind]
+
+    @staticmethod
+    def _canonical_kind(kind: str) -> str:
+        if kind in ("optimizer_state", "optimizer"):
+            return "optimizer_state"
+        if kind in ("params", "fp32_params", "hp_params"):
+            return "params"
+        raise ValueError(f"unknown offload kind {kind!r} "
+                         "(use 'optimizer_state' or 'params')")
+
+    def _state_part(self, kind: str):
+        if kind == "optimizer_state":
+            return self.state.opt_state, self._opt_shardings
+        return self.state.params, self._param_shardings
+
+    def _set_state_part(self, kind: str, tree):
+        if kind == "optimizer_state":
+            self.state = self.state.replace(opt_state=tree)
+        else:
+            self.state = self.state.replace(params=tree)
+
+    def _get_swapper(self, nvme_path: Optional[str]):
+        if getattr(self, "_swapper", None) is None:
+            from .zero.swapper import AsyncTensorSwapper
+
+            path = nvme_path or self.config.zero_optimization.offload_optimizer.nvme_path
+            if not path:
+                raise ValueError(
+                    "offload to nvme needs a path: pass nvme_path= or set "
+                    "zero_optimization.offload_optimizer.nvme_path in the config")
+            aio = self.config.aio
+            self._swapper = AsyncTensorSwapper(
+                os.path.join(path, "dstpu_swap"),
+                num_threads=aio.thread_count, block_size=aio.block_size)
+        return self._swapper
+
     # checkpointing (delegates to checkpoint subsystem) -----------------
     def save_checkpoint(self, save_dir, tag=None, client_state=None, **kw):
         from ..checkpoint.engine import save_checkpoint as _save
@@ -608,6 +682,19 @@ def _to_host_memory(tree, shardings):
             return jax.device_put(x, host_sh)
         except Exception:
             return x
+
+    return jax.tree.map(move, tree, shardings,
+                        is_leaf=lambda x: isinstance(x, jax.Array))
+
+
+def _offload_to_host(tree, shardings):
+    """offload_states cpu tier: pinned-host placement when the backend has it
+    (fast reload over PCIe/ICI), plain numpy otherwise."""
+    def move(x, sh):
+        try:
+            return jax.device_put(x, sh.with_memory_kind("pinned_host"))
+        except Exception:
+            return jax.device_get(x)
 
     return jax.tree.map(move, tree, shardings,
                         is_leaf=lambda x: isinstance(x, jax.Array))

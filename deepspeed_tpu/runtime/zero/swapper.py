@@ -1,0 +1,146 @@
+"""SSD swap tier for optimizer state / parameters (ZeRO-Infinity analogue).
+
+Reference: ``deepspeed/runtime/swap_tensor/`` (``AsyncPartitionedParameterSwapper``
+``partitioned_param_swapper.py:37``, optimizer swapper) over the csrc AIO
+threadpool. TPU-native shape: pytrees are flattened into one packed file per
+swap key (+ a manifest of offsets/shapes/dtypes); writes/reads stripe across
+the native ``dstpu_aio`` threadpool and can overlap compute — the device
+round-trip is ``jax.device_get``/``device_put`` at the swap boundary, the
+hot loop never sees host IO.
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def _dtype_name(dt) -> str:
+    return str(np.dtype(dt))
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """np.dtype lookup that also resolves ml_dtypes names (bfloat16, fp8s)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _key_str(path) -> str:
+    out = []
+    for e in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(e, attr):
+                out.append(str(getattr(e, attr)))
+                break
+        else:
+            out.append(str(e))
+    return "/".join(out)
+
+
+class AsyncTensorSwapper:
+    """Swap pytrees device↔SSD. ``swap_out`` is async (call ``synchronize``
+    or let ``swap_in`` wait); ``swap_in`` restores the tree with original
+    structure/dtypes and optional shardings."""
+
+    def __init__(self, swap_dir: str, num_threads: int = 8,
+                 block_size: int = 1 << 20, use_o_direct: bool = False):
+        from ...ops.aio import AsyncIOHandle
+
+        self.swap_dir = swap_dir
+        os.makedirs(swap_dir, exist_ok=True)
+        self.handle = AsyncIOHandle(num_threads=num_threads, block_size=block_size,
+                                    use_o_direct=use_o_direct)
+        self._manifests: Dict[str, dict] = {}
+        self._pending: Dict[str, list] = {}
+        self._treedefs: Dict[str, Any] = {}
+        self._keepalive: Dict[str, list] = {}
+
+    def _data_path(self, name: str) -> str:
+        return os.path.join(self.swap_dir, f"{name}.swp")
+
+    def _manifest_path(self, name: str) -> str:
+        return os.path.join(self.swap_dir, f"{name}.manifest.json")
+
+    # ------------------------------------------------------------------
+    def swap_out(self, name: str, tree: Any):
+        """Write a pytree to SSD (async). Leaves are device-fetched first;
+        the arrays stay referenced until ``synchronize``."""
+        if name in self._pending:
+            # never delete the file under in-flight writes of a prior swap_out
+            self.synchronize(name)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        self._treedefs[name] = treedef
+        manifest, reqs, keep = [], [], []
+        offset = 0
+        path = self._data_path(name)
+        if os.path.exists(path):
+            os.remove(path)
+        for kp, leaf in flat:
+            arr = np.ascontiguousarray(jax.device_get(leaf))
+            manifest.append({"key": _key_str(kp), "shape": list(arr.shape),
+                             "dtype": _dtype_name(arr.dtype), "offset": offset,
+                             "nbytes": int(arr.nbytes)})
+            if arr.nbytes:
+                reqs.append(self.handle.async_pwrite(arr, path, offset))
+            keep.append(arr)
+            offset += arr.nbytes
+        self._manifests[name] = {"entries": manifest, "total": offset}
+        with open(self._manifest_path(name), "w") as f:
+            json.dump(self._manifests[name], f)
+        self._pending[name] = reqs
+        self._keepalive[name] = keep
+
+    def synchronize(self, name: Optional[str] = None):
+        names = [name] if name else list(self._pending)
+        for n in names:
+            for rid in self._pending.pop(n, []):
+                self.handle.wait(rid)
+            self._keepalive.pop(n, None)
+
+    # ------------------------------------------------------------------
+    def swap_in(self, name: str, shardings: Any = None, delete: bool = False) -> Any:
+        """Read a swapped tree back; ``shardings`` (optional pytree or single
+        sharding) re-places leaves on device."""
+        self.synchronize(name)
+        man = self._manifests.get(name)
+        treedef = self._treedefs.get(name)
+        if man is None or treedef is None:
+            raise RuntimeError(f"swap_in({name!r}): unknown swap name — "
+                               "swap_out must happen in this process "
+                               f"(known: {self.swapped_names()})")
+        path = self._data_path(name)
+        bufs, reqs = [], []
+        for e in man["entries"]:
+            buf = np.empty(tuple(e["shape"]), dtype=_resolve_dtype(e["dtype"]))
+            if buf.nbytes:
+                reqs.append((self.handle.async_pread(buf, path, e["offset"]), e))
+            bufs.append(buf)
+        for rid, e in reqs:
+            got = self.handle.wait(rid)
+            if got != e["nbytes"]:
+                raise OSError(
+                    f"swap_in({name!r}): short read for {e['key']} — got {got} "
+                    f"of {e['nbytes']} bytes (truncated/corrupt {path})")
+        tree = jax.tree_util.tree_unflatten(treedef, bufs)
+        if shardings is not None:
+            tree = jax.device_put(tree, shardings)
+        if delete:
+            self.release(name)
+        return tree
+
+    def release(self, name: str):
+        self.synchronize(name)
+        for p in (self._data_path(name), self._manifest_path(name)):
+            if os.path.exists(p):
+                os.remove(p)
+        self._manifests.pop(name, None)
+        self._treedefs.pop(name, None)
+
+    def swapped_names(self):
+        return sorted(self._manifests)
