@@ -220,7 +220,7 @@ class AioHandle {
 extern "C" {
 
 void* dstpu_aio_create(int num_threads, int64_t block_size, int use_o_direct) {
-  if (block_size < 4096) block_size = 1 << 20;
+  if (block_size < 4096) block_size = 4096;  // mirrored by the Python handle
   return new AioHandle(num_threads, block_size, use_o_direct != 0);
 }
 

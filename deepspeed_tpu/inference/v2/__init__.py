@@ -1,0 +1,8 @@
+"""Inference v2: continuous ragged batching over a paged KV cache.
+
+Reference: ``deepspeed/inference/v2/`` (FastGen). See ``engine_v2.py``.
+"""
+
+from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+
+__all__ = ["InferenceEngineV2", "RaggedInferenceEngineConfig"]

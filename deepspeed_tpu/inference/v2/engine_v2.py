@@ -1,0 +1,186 @@
+"""Inference engine v2: continuous ragged batching (FastGen analogue).
+
+Reference ``InferenceEngineV2`` (``inference/v2/engine_v2.py:30``):
+``put(uids, tokens)`` admits work, each engine step packs prompt chunks +
+decode tokens into one forward pass (Dynamic SplitFuse token budgeting,
+blogs/deepspeed-fastgen/README.md:94-105), ``query``/``can_schedule`` expose
+scheduling capacity. TPU-native: static-shape packed batches (one XLA program
+for every batch mix), paged KV pools donated through the jitted step, host-side
+scheduler/allocator.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.transformer import TransformerConfig, TransformerLM
+from ...utils.logging import log_dist
+from .model import ragged_forward
+from .ragged.kv_cache import BlockedKVCache
+from .ragged.ragged_manager import DSStateManager
+from .ragged.ragged_wrapper import RaggedBatch, RaggedBatchWrapper
+
+
+@dataclass
+class RaggedInferenceEngineConfig:
+    """Knob vocabulary follows the reference's DSStateManagerConfig /
+    RaggedInferenceEngineConfig."""
+    token_budget: int = 256         # max tokens per engine step (T)
+    max_ragged_sequence_count: int = 16   # sequence slots per step (S)
+    max_chunk_size: int = 128       # SplitFuse prompt chunk cap (Q)
+    num_kv_blocks: int = 512
+    kv_block_size: int = 32
+    max_blocks_per_seq: int = 64
+    dtype: str = "float32"
+    greedy: bool = True
+    temperature: float = 1.0
+    seed: int = 0
+
+
+class InferenceEngineV2:
+    def __init__(self, model: TransformerLM, params,
+                 config: Optional[RaggedInferenceEngineConfig] = None):
+        self.config = config or RaggedInferenceEngineConfig()
+        c = self.config
+        self.cfg: TransformerConfig = model.cfg
+        dtype = jnp.dtype(c.dtype)
+        self.params = jax.tree.map(
+            lambda x: jnp.asarray(x, dtype) if jnp.issubdtype(
+                jnp.asarray(x).dtype, jnp.floating) else jnp.asarray(x), params)
+        self.kv = BlockedKVCache(self.cfg.num_layers, c.num_kv_blocks,
+                                 c.kv_block_size, self.cfg.kv_heads,
+                                 self.cfg.head_dim, dtype=dtype)
+        self.state_manager = DSStateManager(self.kv)
+        self.wrapper = RaggedBatchWrapper(token_budget=c.token_budget,
+                                          max_seqs=c.max_ragged_sequence_count,
+                                          max_chunk=c.max_chunk_size,
+                                          max_blocks_per_seq=c.max_blocks_per_seq)
+        self._rng = np.random.default_rng(c.seed)
+        self.steps = 0
+        log_dist(f"inference v2: budget={c.token_budget} seqs={c.max_ragged_sequence_count} "
+                 f"chunk={c.max_chunk_size} blocks={c.num_kv_blocks}x{c.kv_block_size}")
+
+    # ------------------------------------------------------------------
+    # admission (reference put/query/can_schedule, engine_v2.py:107,158,184)
+    # ------------------------------------------------------------------
+    def put(self, uids: Sequence[int], tokens_list: Sequence[np.ndarray],
+            max_new_tokens: int = 256, eos_token_id: Optional[int] = None):
+        """Admit new sequences (prompts are scheduled incrementally)."""
+        for uid, toks in zip(uids, tokens_list):
+            toks = np.asarray(toks, np.int32).reshape(-1)
+            ok, why = self.can_schedule(len(toks), max_new_tokens)
+            if not ok:
+                raise RuntimeError(f"cannot schedule uid={uid}: {why}")
+            self.state_manager.create(uid, toks, max_new_tokens=max_new_tokens,
+                                      eos_token_id=eos_token_id)
+
+    def can_schedule(self, prompt_len: int, max_new_tokens: int) -> Tuple[bool, str]:
+        blocks_needed = -(-(prompt_len + max_new_tokens) // self.config.kv_block_size)
+        if blocks_needed > self.config.max_blocks_per_seq:
+            return False, (f"sequence needs {blocks_needed} blocks > "
+                           f"max_blocks_per_seq {self.config.max_blocks_per_seq}")
+        if blocks_needed > self.kv.free_blocks:
+            return False, f"KV pool has {self.kv.free_blocks} free blocks, need {blocks_needed}"
+        return True, ""
+
+    def query(self, uid: int):
+        """(done, generated tokens so far) for a tracked uid."""
+        seq = self.state_manager.get(uid)
+        if seq is None:
+            raise KeyError(f"unknown uid {uid}")
+        return seq.done, np.array(seq.generated, np.int32)
+
+    def flush(self, uid: int):
+        """Release a sequence's KV blocks and tracking state."""
+        self.state_manager.release(uid)
+
+    def has_work(self) -> bool:
+        return any((s.in_prefill or (not s.done)) for s in self.state_manager.all())
+
+    # ------------------------------------------------------------------
+    # one engine step: schedule -> pack -> forward -> sample
+    # ------------------------------------------------------------------
+    def schedule(self) -> List:
+        """Dynamic SplitFuse: decode tokens first (latency), then fill the
+        remaining budget with prompt chunks."""
+        c = self.config
+        budget = c.token_budget
+        slots = c.max_ragged_sequence_count
+        scheduled = []
+        decodes = [s for s in self.state_manager.all()
+                   if not s.done and not s.in_prefill and s.generated]
+        prefills = [s for s in self.state_manager.all() if s.in_prefill]
+        for seq in decodes:
+            if budget < 1 or slots < 1:
+                break
+            toks = seq.next_tokens(1)
+            if len(toks):
+                self.kv.reserve(seq, len(toks))
+                scheduled.append((seq, toks))
+                budget -= len(toks)
+                slots -= 1
+        for seq in prefills:
+            if budget < 1 or slots < 1:
+                break
+            n = min(budget, c.max_chunk_size)
+            toks = seq.next_tokens(n)
+            if len(toks):
+                self.kv.reserve(seq, len(toks))
+                scheduled.append((seq, toks))
+                budget -= len(toks)
+                slots -= 1
+        return scheduled
+
+    def step(self) -> Dict[int, int]:
+        """Run one packed forward; returns {uid: sampled token} for sequences
+        that produced a token this step."""
+        scheduled = self.schedule()
+        if not scheduled:
+            return {}
+        batch = self.wrapper.pack(scheduled, self.config.kv_block_size)
+        logits, new_k, new_v = ragged_forward(
+            self.params, self.cfg, self.kv.k, self.kv.v,
+            jnp.asarray(batch.tokens), jnp.asarray(batch.positions),
+            jnp.asarray(batch.gather_idx), jnp.asarray(batch.block_table),
+            jnp.asarray(batch.kv_len), jnp.asarray(batch.logits_idx))
+        self.kv.update(new_k, new_v)
+        logits = np.asarray(logits)
+        out: Dict[int, int] = {}
+        for s, (seq, toks) in enumerate(scheduled):
+            seq.seen_tokens += len(toks)
+        for s in batch.sample_slots:
+            seq, _ = scheduled[s]
+            tok = self._sample(logits[s])
+            seq.generated.append(tok)
+            out[seq.uid] = tok
+            if ((seq.eos_token_id is not None and tok == seq.eos_token_id)
+                    or len(seq.generated) >= seq.max_new_tokens):
+                seq.done = True
+        self.steps += 1
+        return out
+
+    def _sample(self, row: np.ndarray) -> int:
+        if self.config.greedy:
+            return int(row.argmax())
+        z = row / max(self.config.temperature, 1e-6)
+        z = z - z.max()
+        p = np.exp(z) / np.exp(z).sum()
+        return int(self._rng.choice(len(row), p=p))
+
+    # ------------------------------------------------------------------
+    def generate(self, prompts: Sequence[np.ndarray], max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None) -> List[np.ndarray]:
+        """Convenience batch API over the continuous engine."""
+        uids = list(range(len(prompts)))
+        self.put(uids, prompts, max_new_tokens=max_new_tokens,
+                 eos_token_id=eos_token_id)
+        while any(not self.query(u)[0] for u in uids):
+            if not self.step():
+                break
+        outs = [self.query(u)[1] for u in uids]
+        for u in uids:
+            self.flush(u)
+        return outs

@@ -1,0 +1,181 @@
+"""Ragged-batch transformer forward over a paged KV cache.
+
+Reference: the FastGen model implementations + ragged kernels
+(``inference/v2/model_implementations/*``, ``kernels/ragged_ops/*`` —
+blocked_flash, blocked_kv_rotary, logits_gather, atom_builder). TPU-native
+re-design: instead of per-kernel CUDA ops, ONE jitted function processes the
+packed token buffer —
+
+* dense projections run over the flat ``[T]`` token buffer (MXU-friendly:
+  every scheduled token, prompt chunk or decode, shares the same matmuls —
+  this is the Dynamic SplitFuse property);
+* per-sequence grouping is a static-shape gather ``[S, Q]``;
+* KV pages are scattered/gathered with the trash-block convention (pad
+  writes land in block 0, never read);
+* paged attention = grouped-GQA einsum over gathered pages with an
+  absolute-position mask.
+
+Operates directly on ``models.transformer.TransformerLM`` parameter pytrees
+(same checkpoint loads serve v1 and v2 engines).
+"""
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.transformer import TransformerConfig, rope_table
+
+
+def _rms_norm(x, scale, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
+
+
+def _layer_norm(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale + bias
+
+
+def _norm(cfg, p, x):
+    if cfg.norm == "rmsnorm":
+        return _rms_norm(x, p["scale"], cfg.norm_eps)
+    return _layer_norm(x, p["scale"], p["bias"], cfg.norm_eps)
+
+
+def _dense(p, x, group_shape=None):
+    """flax DenseGeneral kernels: [in, ...out]; optional bias."""
+    k = p["kernel"]
+    out = jnp.einsum("ti,i...->t...", x, k.astype(x.dtype))
+    if "bias" in p:
+        out = out + p["bias"].astype(x.dtype)
+    return out
+
+
+def _rope(x, cos, sin, positions):
+    """x: [T, H, D]; positions: [T]."""
+    cos_p = cos[positions][:, None, :]
+    sin_p = sin[positions][:, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * cos_p - x2 * sin_p,
+                            x2 * cos_p + x1 * sin_p], axis=-1).astype(x.dtype)
+
+
+def paged_attention(qg, k_pool, v_pool, block_table, positions_g, q_valid, kv_len):
+    """Grouped paged attention.
+
+    qg: [S, Q, Hq, D] grouped queries; k/v_pool: [N, bs, Hk, D] this layer's
+    pages; block_table: [S, B]; positions_g: [S, Q] absolute positions;
+    q_valid: [S, Q] bool; kv_len: [S]. Returns [S, Q, Hq, D].
+    Slot j of sequence s attends iff j <= position of the query (also masks
+    unwritten/trash slots because kv_len bounds writes).
+    """
+    s, q, hq, d = qg.shape
+    bs = k_pool.shape[1]
+    hk = k_pool.shape[2]
+    rep = hq // hk
+    # gather pages -> [S, B*bs, Hk, D]
+    kg = k_pool[block_table].reshape(s, -1, hk, d)
+    vg = v_pool[block_table].reshape(s, -1, hk, d)
+    m = kg.shape[1]
+    qq = qg.reshape(s, q, hk, rep, d)
+    scale = 1.0 / np.sqrt(d)
+    logits = jnp.einsum("sqhrd,skhd->shrqk", qq, kg.astype(qg.dtype),
+                        preferred_element_type=jnp.float32) * scale
+    slot = jnp.arange(m)[None, None, None, None, :]
+    pos_q = positions_g[:, None, None, :, None]
+    valid = (slot <= pos_q) & q_valid[:, None, None, :, None]
+    valid = valid & (slot < kv_len[:, None, None, None, None])
+    logits = jnp.where(valid, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(qg.dtype)
+    out = jnp.einsum("shrqk,skhd->sqhrd", probs, vg.astype(qg.dtype))
+    return out.reshape(s, q, hq, d)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_k", "kv_v"))
+def ragged_forward(params, cfg: TransformerConfig, kv_k, kv_v, tokens, positions,
+                   gather_idx, block_table, kv_len, logits_idx
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One engine step over a packed ragged batch.
+
+    kv pools: [L, N, bs, Hk, D] (donated — updated in place). Returns
+    (logits [S, V] fp32 at each sequence's logits_idx token, new kv_k, kv_v).
+    """
+    T = tokens.shape[0]
+    S, Q = gather_idx.shape
+    bs = kv_k.shape[2]
+    dtype = cfg.dtype
+
+    x = params["embed"]["embedding"].astype(dtype)[tokens]          # [T, H]
+    if cfg.position == "learned":
+        x = x + params["pos_embed"][positions].astype(dtype)
+    if cfg.position == "rope":
+        cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+
+    q_valid = gather_idx < T                                        # [S, Q]
+    safe_gather = jnp.minimum(gather_idx, T - 1)
+    pos_g = jnp.where(q_valid, positions[safe_gather], 0)           # [S, Q]
+    # scatter targets for new KV: pad/invalid -> trash block 0, slot 0
+    blk_of_pos = jnp.take_along_axis(
+        block_table, (pos_g // bs).astype(jnp.int32), axis=1)       # [S, Q]
+    tgt_block = jnp.where(q_valid, blk_of_pos, 0).reshape(-1)
+    tgt_slot = jnp.where(q_valid, pos_g % bs, 0).reshape(-1)
+
+    h, hk, d = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    for i in range(cfg.num_layers):
+        lp = params[f"layer_{i}"]
+        y = _norm(cfg, lp["attn_norm"], x)
+        ap = lp["attn"]
+        qt = _dense(ap["q_proj"], y)                                # [T, Hq, D]
+        kt = _dense(ap["k_proj"], y)                                # [T, Hk, D]
+        vt = _dense(ap["v_proj"], y)
+        if cfg.position == "rope":
+            qt = _rope(qt, cos, sin, positions)
+            kt = _rope(kt, cos, sin, positions)
+        # group per sequence (extra zero pad row at index T)
+        qg = jnp.concatenate([qt, jnp.zeros_like(qt[:1])])[gather_idx]
+        kg = jnp.concatenate([kt, jnp.zeros_like(kt[:1])])[gather_idx]
+        vg = jnp.concatenate([vt, jnp.zeros_like(vt[:1])])[gather_idx]
+        # write new kv into pages
+        kv_k = kv_k.at[i, tgt_block, tgt_slot].set(
+            kg.reshape(-1, hk, d).astype(kv_k.dtype))
+        kv_v = kv_v.at[i, tgt_block, tgt_slot].set(
+            vg.reshape(-1, hk, d).astype(kv_v.dtype))
+        out = paged_attention(qg, kv_k[i], kv_v[i], block_table, pos_g,
+                              q_valid, kv_len)                      # [S, Q, Hq, D]
+        # ungroup back to the flat token buffer ([T+1] with pad row dropped)
+        flat = jnp.zeros((T + 1, h, d), out.dtype)
+        flat = flat.at[gather_idx.reshape(-1)].set(out.reshape(-1, h, d))
+        attn_tok = flat[:T]
+        attn_out = _dense_multi_in(ap["o_proj"], attn_tok)          # [T, H]
+        x = x + attn_out
+        y = _norm(cfg, lp["mlp_norm"], x)
+        mp = lp["mlp"]
+        if cfg.activation == "swiglu":
+            hid = jax.nn.silu(_dense(mp["gate_proj"], y)) * _dense(mp["up_proj"], y)
+        else:
+            hid = jax.nn.gelu(_dense(mp["up_proj"], y))
+        x = x + _dense(mp["down_proj"], hid)
+
+    x = _norm(cfg, params["final_norm"], x)
+    # logits only at the sample positions (reference logits_gather kernel);
+    # logits_idx == T selects the zero pad row for non-sampling slots
+    h_sel = jnp.concatenate([x, jnp.zeros_like(x[:1])])[logits_idx]  # [S, H]
+    h_sel = h_sel.astype(jnp.float32)
+    if cfg.tie_embeddings:
+        logits = h_sel @ params["embed"]["embedding"].astype(jnp.float32).T
+    else:
+        logits = h_sel @ params["lm_head"]["kernel"].astype(jnp.float32)
+    return logits, kv_k, kv_v
+
+
+def _dense_multi_in(p, x):
+    """o_proj DenseGeneral with axis=(-2,-1): kernel [H, D, hidden]."""
+    out = jnp.einsum("thd,hdo->to", x, p["kernel"].astype(x.dtype))
+    if "bias" in p:
+        out = out + p["bias"].astype(x.dtype)
+    return out

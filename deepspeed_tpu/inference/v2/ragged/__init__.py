@@ -1,0 +1,13 @@
+"""Ragged batching substrate: allocator, descriptors, paged KV, packing.
+
+Reference: ``deepspeed/inference/v2/ragged/``.
+"""
+
+from .blocked_allocator import BlockedAllocator
+from .kv_cache import BlockedKVCache
+from .ragged_manager import DSStateManager
+from .ragged_wrapper import RaggedBatch, RaggedBatchWrapper
+from .sequence_descriptor import DSSequenceDescriptor
+
+__all__ = ["BlockedAllocator", "BlockedKVCache", "DSStateManager",
+           "RaggedBatch", "RaggedBatchWrapper", "DSSequenceDescriptor"]

@@ -1,0 +1,51 @@
+"""Paged (blocked) KV cache on device.
+
+Reference ``BlockedKVCache`` (``inference/v2/ragged/kv_cache.py:40``) backed
+by CUDA block copy kernels. TPU-native: one K and one V pool per model,
+``[L, num_blocks, block_size, Hk, D]``, living on device across engine steps
+(donated through the jitted step so updates are in-place); block reservation
+is host-side via :class:`BlockedAllocator`."""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .blocked_allocator import BlockedAllocator
+
+
+class BlockedKVCache:
+    def __init__(self, num_layers: int, num_blocks: int, block_size: int,
+                 kv_heads: int, head_dim: int, dtype=jnp.bfloat16,
+                 shardings=None):
+        self.num_layers = num_layers
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.allocator = BlockedAllocator(num_blocks)
+        shape = (num_layers, num_blocks, block_size, kv_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        if shardings is not None:
+            self.k = jax.device_put(self.k, shardings)
+            self.v = jax.device_put(self.v, shardings)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.allocator.free_blocks
+
+    def reserve(self, seq, n_new_tokens: int) -> None:
+        """Ensure ``seq`` has blocks for ``n_new_tokens`` more tokens."""
+        need = seq.blocks_needed(n_new_tokens, self.block_size)
+        if need:
+            seq.blocks.extend(self.allocator.allocate(need).tolist())
+
+    def free(self, seq) -> None:
+        if seq.blocks:
+            self.allocator.free(seq.blocks)
+            seq.blocks = []
+
+    def update(self, k, v) -> None:
+        """Install the new pools returned by the jitted step (donation makes
+        this an in-place device update)."""
+        self.k, self.v = k, v

@@ -98,6 +98,7 @@ class AsyncIOHandle:
     def __init__(self, num_threads: int = 8, block_size: int = 1 << 20,
                  use_o_direct: bool = False):
         self._lib = AsyncIOBuilder().load()
+        block_size = max(block_size, 4096)  # native side clamps identically
         self._h = self._lib.dstpu_aio_create(num_threads, block_size,
                                              1 if use_o_direct else 0)
         self.num_threads = num_threads

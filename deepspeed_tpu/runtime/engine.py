@@ -223,7 +223,9 @@ class DeepSpeedTPUEngine:
                               is_leaf=lambda x: isinstance(x, P))
         opt_state = jax.jit(self.tx.init, out_shardings=opt_sh)(params)
         if self._offload_optimizer:
-            opt_state = _to_host_memory(opt_state, opt_sh)
+            # opt_sh updates to pinned-host kinds so every later device_put
+            # (checkpoint load, reload_states) restores host residency
+            opt_state, opt_sh = _to_host_memory(opt_state, opt_sh)
 
         ls = make_loss_scale_state(self.config.fp16.initial_scale_power,
                                    self.config.fp16.loss_scale,
@@ -584,7 +586,8 @@ class DeepSpeedTPUEngine:
                 self._set_state_part(kind, placeholder)
                 self._offloaded[kind] = ("nvme", sh)
             else:
-                self._set_state_part(kind, _offload_to_host(tree, sh))
+                host_tree, _ = _to_host_memory(tree, sh, fallback="numpy")
+                self._set_state_part(kind, host_tree)
                 self._offloaded[kind] = ("cpu", sh)
 
     def reload_states(self):
@@ -618,18 +621,19 @@ class DeepSpeedTPUEngine:
             self.state = self.state.replace(params=tree)
 
     def _get_swapper(self, nvme_path: Optional[str]):
-        if getattr(self, "_swapper", None) is None:
+        path = nvme_path or self.config.zero_optimization.offload_optimizer.nvme_path
+        if not path:
+            raise ValueError(
+                "offload to nvme needs a path: pass nvme_path= or set "
+                "zero_optimization.offload_optimizer.nvme_path in the config")
+        if getattr(self, "_swapper", None) is None or self._swapper_path != path:
             from .zero.swapper import AsyncTensorSwapper
 
-            path = nvme_path or self.config.zero_optimization.offload_optimizer.nvme_path
-            if not path:
-                raise ValueError(
-                    "offload to nvme needs a path: pass nvme_path= or set "
-                    "zero_optimization.offload_optimizer.nvme_path in the config")
             aio = self.config.aio
             self._swapper = AsyncTensorSwapper(
                 os.path.join(path, "dstpu_swap"),
                 num_threads=aio.thread_count, block_size=aio.block_size)
+            self._swapper_path = path
         return self._swapper
 
     # checkpointing (delegates to checkpoint subsystem) -----------------
@@ -672,32 +676,26 @@ def _draw_from_iter(data_iter, gas):
     return jax.tree.map(lambda *xs: jnp.stack(xs), *mbs)
 
 
-def _to_host_memory(tree, shardings):
+def _to_host_memory(tree, shardings, fallback: str = "keep"):
     """Move a pytree to pinned host memory (ZeRO-Offload tier; reference
-    ``offload_optimizer.device=cpu``). Falls back to device placement when the
-    backend has no pinned_host memory space (e.g. CPU tests)."""
-    def move(x, sh):
+    ``offload_optimizer.device=cpu``). Returns ``(tree, shardings)`` with the
+    shardings updated to the actual residency, so later device_puts (e.g.
+    ``reload_states``) restore the same memory kind. When the backend has no
+    pinned_host space: ``fallback='keep'`` leaves the leaf on device,
+    ``'numpy'`` fetches it to host RAM."""
+    flat, treedef = jax.tree.flatten(tree)
+    shs = jax.tree.leaves(shardings)
+    out_leaves, out_shs = [], []
+    for x, sh in zip(flat, shs):
         try:
             host_sh = sh.with_memory_kind("pinned_host")
-            return jax.device_put(x, host_sh)
+            out_leaves.append(jax.device_put(x, host_sh))
+            out_shs.append(host_sh)
         except Exception:
-            return x
-
-    return jax.tree.map(move, tree, shardings,
-                        is_leaf=lambda x: isinstance(x, jax.Array))
-
-
-def _offload_to_host(tree, shardings):
-    """offload_states cpu tier: pinned-host placement when the backend has it
-    (fast reload over PCIe/ICI), plain numpy otherwise."""
-    def move(x, sh):
-        try:
-            return jax.device_put(x, sh.with_memory_kind("pinned_host"))
-        except Exception:
-            return jax.device_get(x)
-
-    return jax.tree.map(move, tree, shardings,
-                        is_leaf=lambda x: isinstance(x, jax.Array))
+            out_leaves.append(x if fallback == "keep" else jax.device_get(x))
+            out_shs.append(sh)
+    return (jax.tree.unflatten(treedef, out_leaves),
+            jax.tree.unflatten(treedef, out_shs))
 
 
 def initialize(args=None,
