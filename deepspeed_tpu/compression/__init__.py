@@ -1,0 +1,26 @@
+"""Compression: QAT quantization, pruning, layer reduction, 1-bit comm.
+
+Reference: ``deepspeed/compression/`` (``compress.py:100`` init_compression,
+``basic_layer.py`` technique layers, ``scheduler.py``) and the 1-bit
+optimizer family (``runtime/fp16/onebit/*``).
+"""
+
+from .basic_layer import (apply_prune, head_prune_mask, magnitude_prune_mask,
+                          quant_act, quantize_weight, row_prune_mask, ste,
+                          symmetric_quantize, topk_prune_mask)
+from .compress import (CompressionContext, TechniquePlan, init_compression,
+                       reduce_layers, redundancy_clean)
+from .onebit import (ErrorFeedbackState, OnebitState, build_onebit_optimizer,
+                     compressed_allreduce, init_error_feedback, onebit_compress,
+                     onebit_train_step_factory)
+from .scheduler import CompressionScheduler
+
+__all__ = [
+    "apply_prune", "head_prune_mask", "magnitude_prune_mask", "quant_act",
+    "quantize_weight", "row_prune_mask", "ste", "symmetric_quantize",
+    "topk_prune_mask", "CompressionContext", "TechniquePlan",
+    "init_compression", "reduce_layers", "redundancy_clean",
+    "ErrorFeedbackState", "OnebitState", "build_onebit_optimizer",
+    "compressed_allreduce", "init_error_feedback", "onebit_compress",
+    "onebit_train_step_factory", "CompressionScheduler",
+]

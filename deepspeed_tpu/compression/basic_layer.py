@@ -1,0 +1,132 @@
+"""Compression primitives: fake quantization, pruning masks, STE.
+
+Reference: ``compression/basic_layer.py`` (``LinearLayer_Compress``,
+``QuantAct``, Embedding compress) — the reference monkey-patches nn.Modules;
+here every technique is a pure function applied to params/activations inside
+the loss (JAX-native), with straight-through-estimator gradients where the
+reference uses autograd tricks.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def ste(x: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Straight-through estimator: forward q, gradient of identity."""
+    return x + jax.lax.stop_gradient(q - x)
+
+
+# ---------------------------------------------------------------------------
+# quantization (QAT)
+# ---------------------------------------------------------------------------
+
+
+def symmetric_quantize(x: jnp.ndarray, bits: int, groups: int = 1) -> jnp.ndarray:
+    """Symmetric uniform fake-quant with per-group scales (reference
+    ``Quantizer``/``SymQuantizer``). Returns dequantized values (QAT)."""
+    levels = 2 ** (bits - 1) - 1
+    orig_shape = x.shape
+    g = x.reshape(groups, -1)
+    scale = jnp.max(jnp.abs(g), axis=1, keepdims=True) / levels
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.round(g / scale).clip(-levels, levels) * scale
+    return q.reshape(orig_shape)
+
+
+def asymmetric_quantize(x: jnp.ndarray, bits: int, groups: int = 1) -> jnp.ndarray:
+    levels = 2 ** bits - 1
+    orig_shape = x.shape
+    g = x.reshape(groups, -1)
+    lo = jnp.min(g, axis=1, keepdims=True)
+    hi = jnp.max(g, axis=1, keepdims=True)
+    scale = (hi - lo) / levels
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = (jnp.round((g - lo) / scale).clip(0, levels)) * scale + lo
+    return q.reshape(orig_shape)
+
+
+def quantize_weight(w: jnp.ndarray, bits: int, groups: int = 1,
+                    symmetric: bool = True, training: bool = True) -> jnp.ndarray:
+    """QAT weight fake-quant: quantized forward, STE backward."""
+    qfn = symmetric_quantize if symmetric else asymmetric_quantize
+    q = qfn(w, bits, groups)
+    return ste(w, q) if training else q
+
+
+def quant_act(x: jnp.ndarray, bits: int = 8, symmetric: bool = False,
+              range_calibration: str = "dynamic",
+              static_range: Optional[Tuple[float, float]] = None) -> jnp.ndarray:
+    """Activation fake-quant (reference ``QuantAct``): dynamic per-tensor
+    range or a provided static range; STE gradients."""
+    if range_calibration == "static" and static_range is not None:
+        lo, hi = static_range
+        levels = 2 ** bits - 1
+        scale = (hi - lo) / levels
+        q = jnp.round((x - lo) / scale).clip(0, levels) * scale + lo
+    else:
+        qfn = symmetric_quantize if symmetric else asymmetric_quantize
+        q = qfn(x, bits, groups=1)
+    return ste(x, q)
+
+
+# ---------------------------------------------------------------------------
+# pruning
+# ---------------------------------------------------------------------------
+
+
+def magnitude_prune_mask(w: jnp.ndarray, ratio: float) -> jnp.ndarray:
+    """Unstructured L1 mask keeping the largest (1-ratio) fraction (reference
+    sparse_pruning_method='l1')."""
+    if ratio <= 0:
+        return jnp.ones_like(w, dtype=bool)
+    k = int(w.size * (1.0 - ratio))
+    if k < 1:
+        return jnp.zeros_like(w, dtype=bool)
+    flat = jnp.abs(w).reshape(-1)
+    thresh = jnp.sort(flat)[-k]
+    return (jnp.abs(w) >= thresh)
+
+
+def topk_prune_mask(w: jnp.ndarray, ratio: float) -> jnp.ndarray:
+    """Per-output-row top-k mask (reference 'topk')."""
+    if ratio <= 0:
+        return jnp.ones_like(w, dtype=bool)
+    mat = w.reshape(w.shape[0], -1) if w.ndim > 1 else w.reshape(1, -1)
+    keep = max(1, int(mat.shape[1] * (1.0 - ratio)))
+    thresh = jnp.sort(jnp.abs(mat), axis=1)[:, -keep][:, None]
+    mask = jnp.abs(mat) >= thresh
+    return mask.reshape(w.shape)
+
+
+def row_prune_mask(w: jnp.ndarray, ratio: float) -> jnp.ndarray:
+    """Structured row pruning: drop whole output rows by L1 norm (reference
+    row_pruning). w: [..., out] conventions vary; row = axis 0."""
+    if ratio <= 0:
+        return jnp.ones_like(w, dtype=bool)
+    norms = jnp.sum(jnp.abs(w.reshape(w.shape[0], -1)), axis=1)
+    keep = max(1, int(w.shape[0] * (1.0 - ratio)))
+    thresh = jnp.sort(norms)[-keep]
+    row_mask = norms >= thresh
+    return jnp.broadcast_to(row_mask.reshape((-1,) + (1,) * (w.ndim - 1)), w.shape)
+
+
+def head_prune_mask(w: jnp.ndarray, num_heads: int, ratio: float) -> jnp.ndarray:
+    """Structured attention-head pruning (reference head_pruning): w is an
+    attention projection [in, heads, dim] or [in, heads*dim]."""
+    if ratio <= 0:
+        return jnp.ones_like(w, dtype=bool)
+    hw = w.reshape(w.shape[0], num_heads, -1)
+    norms = jnp.sum(jnp.abs(hw), axis=(0, 2))
+    keep = max(1, int(num_heads * (1.0 - ratio)))
+    thresh = jnp.sort(norms)[-keep]
+    head_mask = norms >= thresh
+    return jnp.broadcast_to(head_mask[None, :, None], hw.shape).reshape(w.shape)
+
+
+def apply_prune(w: jnp.ndarray, mask: jnp.ndarray, training: bool = True) -> jnp.ndarray:
+    """Masked forward; STE keeps gradients flowing to masked weights during
+    QAT-style training (matching the reference's mask-in-forward)."""
+    pruned = w * mask
+    return ste(w, pruned) if training else pruned

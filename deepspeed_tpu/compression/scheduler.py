@@ -1,0 +1,43 @@
+"""Compression scheduler (reference ``compression/scheduler.py``):
+steps techniques on/off by schedule_offset and ramps quantization bits
+from start_bits to target_bits over quantization_period."""
+
+from typing import Dict, List
+
+from .compress import CompressionContext, TechniquePlan
+
+
+class CompressionScheduler:
+    def __init__(self, ctx: CompressionContext, config: Dict = None):
+        self.ctx = ctx
+        block = (config or {}).get("compression_training", config or {})
+        wq = block.get("weight_quantization", {})
+        self._bit_ramps = {}
+        for gname, gcfg in wq.get("different_groups", {}).items():
+            p = gcfg.get("params", {})
+            period = int(p.get("quantization_period", 0))
+            start, target = int(p.get("start_bits", 8)), int(p.get("target_bits", 8))
+            if period > 0 and start != target:
+                self._bit_ramps[tuple(gcfg.get("modules", ["*"]))] = \
+                    (start, target, period)
+
+    def step(self, global_step: int):
+        """Update plan bits for ramped quantization; called once per train
+        step (reference scheduler hooks into engine.step)."""
+        for plan in self.ctx.plans:
+            if plan.technique != "weight_quantization":
+                continue
+            ramp = self._bit_ramps.get(tuple(plan.modules))
+            if ramp is None:
+                continue
+            start, target, period = ramp
+            # halve bits every `period` steps until target (reference ramp)
+            bits = start
+            steps = global_step
+            while bits > target and steps >= period:
+                bits = max(target, bits // 2)
+                steps -= period
+            plan.bits = bits
+
+    def active_plans(self, global_step: int) -> List[TechniquePlan]:
+        return [p for p in self.ctx.plans if global_step >= p.start_step]

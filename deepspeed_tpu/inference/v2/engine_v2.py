@@ -60,6 +60,7 @@ class InferenceEngineV2:
                                           max_blocks_per_seq=c.max_blocks_per_seq)
         self._rng = np.random.default_rng(c.seed)
         self.steps = 0
+        self.last_num_scheduled = 0
         log_dist(f"inference v2: budget={c.token_budget} seqs={c.max_ragged_sequence_count} "
                  f"chunk={c.max_chunk_size} blocks={c.num_kv_blocks}x{c.kv_block_size}")
 
@@ -77,13 +78,31 @@ class InferenceEngineV2:
             self.state_manager.create(uid, toks, max_new_tokens=max_new_tokens,
                                       eos_token_id=eos_token_id)
 
+    def _outstanding_blocks(self) -> int:
+        """Worst-case blocks already promised to admitted sequences but not
+        yet allocated — admission must not over-commit the pool."""
+        bs = self.config.kv_block_size
+        total = 0
+        for seq in self.state_manager.all():
+            if seq.done:
+                continue
+            worst = -(-(len(seq.prompt_tokens) + seq.max_new_tokens) // bs)
+            total += max(0, worst - len(seq.blocks))
+        return total
+
     def can_schedule(self, prompt_len: int, max_new_tokens: int) -> Tuple[bool, str]:
-        blocks_needed = -(-(prompt_len + max_new_tokens) // self.config.kv_block_size)
+        total_len = prompt_len + max_new_tokens
+        if total_len > self.cfg.max_seq_len:
+            return False, (f"prompt {prompt_len} + max_new {max_new_tokens} exceeds "
+                           f"the model's max_seq_len {self.cfg.max_seq_len}")
+        blocks_needed = -(-total_len // self.config.kv_block_size)
         if blocks_needed > self.config.max_blocks_per_seq:
             return False, (f"sequence needs {blocks_needed} blocks > "
                            f"max_blocks_per_seq {self.config.max_blocks_per_seq}")
-        if blocks_needed > self.kv.free_blocks:
-            return False, f"KV pool has {self.kv.free_blocks} free blocks, need {blocks_needed}"
+        available = self.kv.free_blocks - self._outstanding_blocks()
+        if blocks_needed > available:
+            return False, (f"KV pool has {available} uncommitted free blocks "
+                           f"(of {self.kv.free_blocks} free), need {blocks_needed}")
         return True, ""
 
     def query(self, uid: int):
@@ -136,8 +155,10 @@ class InferenceEngineV2:
 
     def step(self) -> Dict[int, int]:
         """Run one packed forward; returns {uid: sampled token} for sequences
-        that produced a token this step."""
+        that produced a token this step (a step that only advanced prompt
+        chunks returns {} — check ``last_num_scheduled`` for progress)."""
         scheduled = self.schedule()
+        self.last_num_scheduled = len(scheduled)
         if not scheduled:
             return {}
         batch = self.wrapper.pack(scheduled, self.config.kv_block_size)
@@ -178,8 +199,9 @@ class InferenceEngineV2:
         self.put(uids, prompts, max_new_tokens=max_new_tokens,
                  eos_token_id=eos_token_id)
         while any(not self.query(u)[0] for u in uids):
-            if not self.step():
-                break
+            self.step()
+            if self.last_num_scheduled == 0:
+                break  # nothing left to schedule (not merely a chunk-only step)
         outs = [self.query(u)[1] for u in uids]
         for u in uids:
             self.flush(u)

@@ -47,7 +47,7 @@ def _norm(cfg, p, x):
     return _layer_norm(x, p["scale"], p["bias"], cfg.norm_eps)
 
 
-def _dense(p, x, group_shape=None):
+def _dense(p, x):
     """flax DenseGeneral kernels: [in, ...out]; optional bias."""
     k = p["kernel"]
     out = jnp.einsum("ti,i...->t...", x, k.astype(x.dtype))

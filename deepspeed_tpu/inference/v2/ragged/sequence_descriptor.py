@@ -20,7 +20,6 @@ class DSSequenceDescriptor:
     max_new_tokens: int = 256
     eos_token_id: Optional[int] = None
     done: bool = False
-    in_flight: int = 0                         # tokens scheduled this step
 
     @property
     def prompt_remaining(self) -> int:

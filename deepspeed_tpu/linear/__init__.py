@@ -1,0 +1,144 @@
+"""OptimizedLinear: LoRA adapters over (optionally quantized) frozen base
+weights.
+
+Reference: ``deepspeed/linear/`` — ``OptimizedLinear``
+(``optimized_linear.py:18``), ``LoRAOptimizedLinear:76``,
+``QuantizedParameter`` (``quantization.py:18``), ``LoRAConfig``
+(``config.py:11``). TPU-native: the module is a flax layer whose base kernel
+can be stored int8-block-quantized (Pallas quant kernels) and sharded over
+``tp``; LoRA A/B stay fp32-trainable. Freezing the base = zeroing its updates
+in the optimizer (``lora_optimizer``), the JAX analogue of
+requires_grad=False.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ..ops.pallas.quant import dequantize_int8, quantize_int8
+
+__all__ = ["LoRAConfig", "QuantizationConfig", "QuantizedParameter",
+           "OptimizedLinear", "lora_trainable_mask", "lora_optimizer",
+           "fuse_lora"]
+
+
+@dataclass
+class LoRAConfig:
+    """Reference ``LoRAConfig`` (``linear/config.py:11``)."""
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1   # kept for config parity; sharding is a spec
+
+
+@dataclass
+class QuantizationConfig:
+    """Reference ``QuantizationConfig``: int8 block quantization knobs
+    (q_bits kept for vocabulary parity — the Pallas kernel packs int8)."""
+    q_bits: int = 8
+    group_size: int = 512
+
+
+class QuantizedParameter:
+    """Blockwise-int8 stored tensor that dequantizes on use (reference
+    ``QuantizedParameter``, ``linear/quantization.py:18``)."""
+
+    def __init__(self, values: jnp.ndarray, quantization: Optional[QuantizationConfig] = None):
+        self.config = quantization or QuantizationConfig()
+        self.shape = tuple(values.shape)
+        self.dtype = values.dtype
+        self.q, self.scale, self._qshape = quantize_int8(
+            jnp.asarray(values), block=self.config.group_size)
+
+    def dequantized(self, dtype=None) -> jnp.ndarray:
+        return dequantize_int8(self.q, self.scale, self._qshape,
+                               dtype or self.dtype).reshape(self.shape)
+
+    @property
+    def nbytes_quantized(self) -> int:
+        # int8 payload + one authoritative fp32 scale per block (the pallas
+        # wire format lane-replicates scales to [nb, 128] for TPU tiling)
+        return int(self.q.size) + int(self.scale.shape[0]) * 4
+
+
+class OptimizedLinear(nn.Module):
+    """y = x @ W_base + (alpha/r) * x @ A @ B  (+ bias).
+
+    ``quantized_base=True`` fake-stores the base kernel via int8 block quant
+    (QAT-faithful values; bit-packed storage path is ``QuantizedParameter``
+    for inference weights). The base kernel is a regular param — exclude it
+    from training with ``lora_trainable_mask``.
+    """
+    input_dim: int
+    output_dim: int
+    lora: Optional[LoRAConfig] = None
+    quantization: Optional[QuantizationConfig] = None
+    use_bias: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        lora = self.lora or LoRAConfig()
+        base = self.param("base_weight", nn.initializers.lecun_normal(),
+                          (self.input_dim, self.output_dim), jnp.float32)
+        if self.quantization is not None:
+            q, scale, qshape = quantize_int8(base, block=self.quantization.group_size)
+            base = dequantize_int8(q, scale, qshape, jnp.float32).reshape(base.shape)
+        y = x @ base.astype(self.dtype)
+        if lora.lora_r > 0:
+            a = self.param("lora_a", nn.initializers.lecun_normal(),
+                           (self.input_dim, lora.lora_r), jnp.float32)
+            b = self.param("lora_b", nn.initializers.zeros,
+                           (lora.lora_r, self.output_dim), jnp.float32)
+            y = y + (lora.lora_alpha / lora.lora_r) * \
+                ((x @ a.astype(self.dtype)) @ b.astype(self.dtype))
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros,
+                               (self.output_dim,), jnp.float32).astype(self.dtype)
+        return y
+
+
+def lora_trainable_mask(params) -> Any:
+    """True for LoRA/bias params, False for base weights (reference
+    requires_grad flips, ``optimized_linear.py``). Use with
+    :func:`lora_optimizer` — NOT bare ``optax.masked``, which passes raw
+    gradients through for masked-out leaves instead of freezing them."""
+    def mark(path, leaf):
+        keys = [str(getattr(e, "key", getattr(e, "name", e))) for e in path]
+        return not any(k == "base_weight" for k in keys)
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(treedef, [mark(p, l) for p, l in flat])
+
+
+def lora_optimizer(inner, params) -> Any:
+    """Wrap an optax transform so base weights are frozen (zero updates) and
+    only LoRA/bias params train."""
+    import optax
+
+    labels = jax.tree.map(lambda t: "train" if t else "freeze",
+                          lora_trainable_mask(params))
+    return optax.multi_transform({"train": inner, "freeze": optax.set_to_zero()},
+                                 labels)
+
+
+def fuse_lora(params, alpha_over_r: Optional[float] = None) -> Any:
+    """Merge LoRA adapters into base weights (reference HybridEngine
+    ``fuse_lora_weight``): W' = W + (alpha/r) A @ B; adapters zeroed."""
+    def fuse(d):
+        if isinstance(d, dict) and "base_weight" in d and "lora_a" in d:
+            r = d["lora_a"].shape[1]
+            coef = alpha_over_r if alpha_over_r is not None else 16.0 / r
+            out = dict(d)
+            out["base_weight"] = d["base_weight"] + coef * (d["lora_a"] @ d["lora_b"])
+            out["lora_a"] = jnp.zeros_like(d["lora_a"])
+            out["lora_b"] = jnp.zeros_like(d["lora_b"])
+            return out
+        if isinstance(d, dict):
+            return {k: fuse(v) for k, v in d.items()}
+        return d
+
+    return fuse(params)

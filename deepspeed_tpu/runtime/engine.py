@@ -584,16 +584,18 @@ class DeepSpeedTPUEngine:
                 placeholder = jax.tree.map(
                     lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
                 self._set_state_part(kind, placeholder)
-                self._offloaded[kind] = ("nvme", sh)
+                # keep the owning swapper with the entry: its in-memory
+                # manifest is the only way back to this data
+                self._offloaded[kind] = ("nvme", sh, sw)
             else:
                 host_tree, _ = _to_host_memory(tree, sh, fallback="numpy")
                 self._set_state_part(kind, host_tree)
-                self._offloaded[kind] = ("cpu", sh)
+                self._offloaded[kind] = ("cpu", sh, None)
 
     def reload_states(self):
-        for kind, (where, sh) in list(getattr(self, "_offloaded", {}).items()):
+        for kind, (where, sh, sw) in list(getattr(self, "_offloaded", {}).items()):
             if where == "nvme":
-                tree = self._swapper.swap_in(kind, shardings=sh, delete=True)
+                tree = sw.swap_in(kind, shardings=sh, delete=True)
             else:
                 tree, _ = self._state_part(kind)
                 tree = jax.device_put(tree, sh)
@@ -626,15 +628,17 @@ class DeepSpeedTPUEngine:
             raise ValueError(
                 "offload to nvme needs a path: pass nvme_path= or set "
                 "zero_optimization.offload_optimizer.nvme_path in the config")
-        if getattr(self, "_swapper", None) is None or self._swapper_path != path:
+        swappers = getattr(self, "_swappers", None)
+        if swappers is None:
+            swappers = self._swappers = {}
+        if path not in swappers:
             from .zero.swapper import AsyncTensorSwapper
 
             aio = self.config.aio
-            self._swapper = AsyncTensorSwapper(
+            swappers[path] = AsyncTensorSwapper(
                 os.path.join(path, "dstpu_swap"),
                 num_threads=aio.thread_count, block_size=aio.block_size)
-            self._swapper_path = path
-        return self._swapper
+        return swappers[path]
 
     # checkpointing (delegates to checkpoint subsystem) -----------------
     def save_checkpoint(self, save_dir, tag=None, client_state=None, **kw):

@@ -159,9 +159,18 @@ def test_v2_eos_and_capacity():
     v2 = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
         num_kv_blocks=8, kv_block_size=4, max_blocks_per_seq=4, dtype="float32"))
     ok, why = v2.can_schedule(prompt_len=100, max_new_tokens=100)
-    assert not ok and "blocks" in why
+    assert not ok and "max_seq_len" in why
+    ok, why = v2.can_schedule(prompt_len=50, max_new_tokens=50)
+    assert not ok and "blocks" in why  # fits max_seq_len but not the pool
     with pytest.raises(RuntimeError, match="cannot schedule"):
-        v2.put([1], [np.arange(100, dtype=np.int32)], max_new_tokens=100)
+        v2.put([1], [np.arange(50, dtype=np.int32)], max_new_tokens=50)
+    # over-commit guard: admitted seqs may not jointly exceed the pool
+    v2.put([2], [np.array([1, 2], np.int32)], max_new_tokens=10)  # commits 3
+    v2.put([3], [np.array([1, 2], np.int32)], max_new_tokens=10)  # commits 3 more
+    ok, why = v2.can_schedule(prompt_len=2, max_new_tokens=6)     # needs 2, 1 left
+    assert not ok and "uncommitted" in why
+    v2.flush(2)
+    v2.flush(3)  # releasing commitments frees admission capacity
     # max_new_tokens bounds generation (2 + 10 tokens fits 3 of 4 blocks)
     outs = v2.generate([np.array([1, 2], np.int32)], max_new_tokens=10,
                        eos_token_id=None)
@@ -181,3 +190,15 @@ def test_v2_block_reuse_after_flush():
     assert v2.kv.free_blocks < free0
     v2.flush(5)
     assert v2.kv.free_blocks == free0
+
+
+def test_v2_long_prompt_chunked_generate():
+    """A single prompt spanning multiple SplitFuse chunks must generate fully
+    (regression: chunk-only steps return no tokens and used to end generate)."""
+    model, params = _tiny_model()
+    v2 = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        token_budget=4, max_ragged_sequence_count=2, max_chunk_size=4,
+        num_kv_blocks=32, kv_block_size=8, dtype="float32"))
+    prompt = np.arange(1, 15, dtype=np.int32)  # 14 tokens -> 4 chunk steps
+    outs = v2.generate([prompt], max_new_tokens=5)
+    assert outs[0].shape == (5,)

@@ -1,0 +1,95 @@
+"""OptimizedLinear / LoRA / QuantizedParameter tests (reference:
+tests/unit/linear/test_linear.py, test_quant_param.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deepspeed_tpu.linear import (LoRAConfig, OptimizedLinear, QuantizationConfig,
+                                  QuantizedParameter, fuse_lora, lora_optimizer,
+                                  lora_trainable_mask)
+
+
+def test_quantized_parameter_roundtrip():
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(64, 32)).astype(np.float32))
+    qp = QuantizedParameter(w, QuantizationConfig(group_size=64))
+    deq = qp.dequantized()
+    assert deq.shape == w.shape
+    np.testing.assert_allclose(np.asarray(deq), np.asarray(w), atol=0.05)
+    # ~4x smaller than fp32 (int8 + fp32 scales)
+    assert qp.nbytes_quantized < w.size * 4 / 3
+
+
+def test_optimized_linear_forward_and_lora_zero_init():
+    m = OptimizedLinear(input_dim=16, output_dim=8,
+                        lora=LoRAConfig(lora_r=4, lora_alpha=8))
+    x = jnp.ones((2, 16))
+    params = m.init(jax.random.PRNGKey(0), x)["params"]
+    assert params["lora_b"].shape == (4, 8)
+    # lora_b zero-init: output equals base-only at init
+    y = m.apply({"params": params}, x)
+    y_base = x @ params["base_weight"]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_base), rtol=1e-6)
+
+
+def test_quantized_base_close_to_dense():
+    mq = OptimizedLinear(input_dim=32, output_dim=16, quantization=QuantizationConfig(),
+                         lora=LoRAConfig(lora_r=0))
+    md = OptimizedLinear(input_dim=32, output_dim=16, lora=LoRAConfig(lora_r=0))
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 32)).astype(np.float32))
+    params = md.init(jax.random.PRNGKey(0), x)["params"]
+    yd = md.apply({"params": params}, x)
+    yq = mq.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(yq), np.asarray(yd), atol=0.2)
+    assert not np.array_equal(np.asarray(yq), np.asarray(yd))
+
+
+def test_lora_finetune_base_frozen():
+    """Only LoRA params update under the mask; base stays frozen; loss drops."""
+    m = OptimizedLinear(input_dim=8, output_dim=4,
+                        lora=LoRAConfig(lora_r=2, lora_alpha=4))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
+    w_t = rng.normal(size=(8, 4)).astype(np.float32)
+    y = x @ w_t
+    params = m.init(jax.random.PRNGKey(0), x)["params"]
+    base0 = np.asarray(params["base_weight"]).copy()
+
+    mask = lora_trainable_mask(params)
+    assert mask["base_weight"] is False and mask["lora_a"] is True
+    tx = lora_optimizer(optax.adam(5e-2), params)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss(p):
+            return jnp.mean((m.apply({"params": p}, x) - y) ** 2)
+
+        l, g = jax.value_and_grad(loss)(params)
+        updates, opt_state = tx.update(g, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, l
+
+    losses = []
+    for _ in range(60):
+        params, opt_state, l = step(params, opt_state)
+        losses.append(float(l))
+    assert losses[-1] < 0.5 * losses[0]
+    np.testing.assert_array_equal(np.asarray(params["base_weight"]), base0)
+    assert np.abs(np.asarray(params["lora_b"])).sum() > 0
+
+
+def test_fuse_lora_matches_unfused():
+    m = OptimizedLinear(input_dim=8, output_dim=4,
+                        lora=LoRAConfig(lora_r=2, lora_alpha=4))
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(3, 8)).astype(np.float32))
+    params = m.init(jax.random.PRNGKey(1), x)["params"]
+    params = dict(params)
+    params["lora_b"] = jnp.asarray(
+        np.random.default_rng(3).normal(size=(2, 4)).astype(np.float32))
+    y_unfused = m.apply({"params": params}, x)
+    fused = fuse_lora({"lin": params}, alpha_over_r=4 / 2)["lin"]
+    y_fused = m.apply({"params": fused}, x)
+    np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_unfused), rtol=1e-4)
+    assert np.abs(np.asarray(fused["lora_b"])).sum() == 0
