@@ -1,0 +1,11 @@
+"""Autotuning: ZeRO-stage / micro-batch search for peak throughput.
+
+Reference: ``deepspeed/autotuning/`` (``autotuner.py:42``).
+"""
+
+from .autotuner import (Autotuner, Experiment, apply_autotune_env_overrides,
+                        generate_experiments, report_autotune_result,
+                        run_autotuning)
+
+__all__ = ["Autotuner", "Experiment", "apply_autotune_env_overrides",
+           "generate_experiments", "report_autotune_result", "run_autotuning"]

@@ -1,0 +1,215 @@
+"""Autotuner: search ZeRO stage × micro-batch for peak throughput.
+
+Reference ``Autotuner`` (``autotuning/autotuner.py:42``, ``tune:404``):
+profiles model memory, generates ZeRO-stage experiment grids from config
+templates, launches each experiment through the launcher, and selects by
+metric (``run_after_tuning:1103``). TPU-native: the memory model prunes
+stage/micro-batch candidates against per-chip HBM, then experiments run
+either in-process (``Autotuner.tune`` over a loss_fn — each candidate builds
+a fresh engine, JIT included in warmup, throughput measured over steady-state
+steps) or as subprocesses of the user script (``run_autotuning``, the
+``dstpu --autotuning`` path: candidates are injected via
+``DSTPU_AUTOTUNE_CONFIG`` and results read back from
+``DSTPU_AUTOTUNE_RESULT``).
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..runtime.zero.memory_estimators import estimate_zero_model_states_mem_needs
+from ..utils.logging import logger
+
+AUTOTUNE_CONFIG_ENV = "DSTPU_AUTOTUNE_CONFIG"
+AUTOTUNE_RESULT_ENV = "DSTPU_AUTOTUNE_RESULT"
+
+
+@dataclass
+class Experiment:
+    name: str
+    overrides: Dict[str, Any]
+    metric_value: Optional[float] = None
+    error: Optional[str] = None
+
+
+def generate_experiments(base_config: Dict, param_count: int, dp_size: int,
+                         hbm_bytes: Optional[float] = None,
+                         stages=(0, 1, 2, 3),
+                         micro_batches: Optional[List[int]] = None) -> List[Experiment]:
+    """Stage × micro-batch grid, memory-pruned (reference config_templates +
+    ``_generate_experiments``)."""
+    base_mbs = int(base_config.get("train_micro_batch_size_per_gpu", 1) or 1)
+    if micro_batches is None:
+        micro_batches = sorted({max(1, base_mbs // 2), base_mbs, base_mbs * 2,
+                                base_mbs * 4})
+    exps = []
+    for stage in stages:
+        est = estimate_zero_model_states_mem_needs(param_count, stage, dp_size)
+        if hbm_bytes is not None and est["total_bytes"] > hbm_bytes:
+            logger.info(f"autotuner: prune stage {stage} "
+                        f"(model states {est['total_gb']:.2f} GiB > HBM)")
+            continue
+        for mbs in micro_batches:
+            exps.append(Experiment(
+                name=f"z{stage}_mbs{mbs}",
+                overrides={"zero_optimization": {"stage": stage},
+                           "train_micro_batch_size_per_gpu": mbs,
+                           "train_batch_size": None,
+                           "gradient_accumulation_steps":
+                               base_config.get("gradient_accumulation_steps", 1)}))
+    return exps
+
+
+class Autotuner:
+    """In-process tuner over a loss function (unit-testable fast path)."""
+
+    def __init__(self, base_config: Dict, metric: str = "throughput",
+                 warmup_steps: int = 2, measure_steps: int = 3,
+                 hbm_bytes: Optional[float] = None):
+        self.base_config = dict(base_config)
+        self.metric = metric
+        self.warmup_steps = warmup_steps
+        self.measure_steps = measure_steps
+        self.hbm_bytes = hbm_bytes
+        self.results: List[Experiment] = []
+
+    def tune(self, loss_fn: Callable, params: Any, batch_fn: Callable[[int], Any],
+             stages=(0, 1, 2, 3), micro_batches: Optional[List[int]] = None) -> Dict:
+        """``batch_fn(global_batch_size) -> batch``. Returns the best full
+        config (base + winning overrides)."""
+        import jax
+
+        import deepspeed_tpu as ds
+
+        ndev = len(jax.devices())
+        param_count = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params)
+                          if hasattr(l, "shape"))
+        exps = generate_experiments(self.base_config, param_count, ndev,
+                                    self.hbm_bytes, stages, micro_batches)
+        if not exps:
+            raise RuntimeError("autotuner: every candidate was memory-pruned")
+        for exp in exps:
+            cfg = _merge(self.base_config, exp.overrides)
+            try:
+                engine, _, _, _ = ds.initialize(model=loss_fn,
+                                                model_parameters=params, config=cfg)
+                gbs = engine.train_batch_size
+                for _ in range(self.warmup_steps):
+                    engine.train_batch(batch=batch_fn(gbs))
+                t0 = time.perf_counter()
+                for _ in range(self.measure_steps):
+                    engine.train_batch(batch=batch_fn(gbs))
+                dt = (time.perf_counter() - t0) / self.measure_steps
+                exp.metric_value = (gbs / dt if self.metric == "throughput"
+                                    else -dt)
+                logger.info(f"autotuner: {exp.name} -> "
+                            f"{exp.metric_value:.2f} ({self.metric})")
+            except Exception as e:  # OOM / invalid combo: record and continue
+                exp.error = str(e).splitlines()[0][:120]
+                logger.warning(f"autotuner: {exp.name} failed: {exp.error}")
+            self.results.append(exp)
+        best = max((e for e in self.results if e.metric_value is not None),
+                   key=lambda e: e.metric_value, default=None)
+        if best is None:
+            raise RuntimeError("autotuner: all experiments failed")
+        self.best = best
+        return _merge(self.base_config, best.overrides)
+
+    def summary(self) -> str:
+        lines = [f"{'experiment':<16} {self.metric:>14}"]
+        for e in self.results:
+            val = f"{e.metric_value:.2f}" if e.metric_value is not None else \
+                f"FAILED ({e.error})"
+            lines.append(f"{e.name:<16} {val:>14}")
+        return "\n".join(lines)
+
+
+def _merge(base: Dict, overrides: Dict) -> Dict:
+    out = copy.deepcopy(base)
+    for k, v in overrides.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = {**out[k], **v}
+        elif v is None:
+            out.pop(k, None)
+        else:
+            out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# engine-side hooks (consumed by runtime.engine / config)
+# ---------------------------------------------------------------------------
+
+
+def apply_autotune_env_overrides(config: Dict) -> Dict:
+    """Merge DSTPU_AUTOTUNE_CONFIG (json) into a user config dict — the
+    subprocess-experiment injection point."""
+    raw = os.environ.get(AUTOTUNE_CONFIG_ENV)
+    if not raw:
+        return config
+    return _merge(dict(config), json.loads(raw))
+
+
+def report_autotune_result(throughput: float):
+    """Write the experiment metric for the parent tuner."""
+    path = os.environ.get(AUTOTUNE_RESULT_ENV)
+    if path:
+        with open(path, "w") as f:
+            json.dump({"throughput": throughput}, f)
+
+
+# ---------------------------------------------------------------------------
+# launcher entry (`dstpu --autotuning tune user_script.py ...`)
+# ---------------------------------------------------------------------------
+
+
+def run_autotuning(args) -> int:
+    """Run the user script once per candidate config (reference
+    ``launcher/runner.py:498`` autotuning branch). The script must call
+    ``deepspeed_tpu.initialize`` (env overrides apply there) and train past
+    ``autotuning.end_profile_step`` steps so the engine reports throughput."""
+    results_dir = "autotuning_results"
+    os.makedirs(results_dir, exist_ok=True)
+    # grid without model introspection: stages x {1,2,4} micro-batch
+    exps = [Experiment(name=f"z{s}_mbs{m}",
+                       overrides={"zero_optimization": {"stage": s},
+                                  "train_micro_batch_size_per_gpu": m,
+                                  "train_batch_size": None})
+            for s in (0, 1, 2, 3) for m in (1, 2, 4)]
+    best = None
+    for exp in exps:
+        result_file = os.path.join(results_dir, f"{exp.name}.json")
+        if os.path.exists(result_file):  # never attribute stale results
+            os.remove(result_file)
+        env = dict(os.environ)
+        env[AUTOTUNE_CONFIG_ENV] = json.dumps(exp.overrides)
+        env[AUTOTUNE_RESULT_ENV] = result_file
+        cmd = [args.python_exec, "-u", args.user_script] + list(args.user_args)
+        rc = subprocess.call(cmd, env=env)
+        if rc == 0 and os.path.exists(result_file):
+            with open(result_file) as f:
+                exp.metric_value = json.load(f).get("throughput")
+        else:
+            exp.error = f"rc={rc}"
+        logger.info(f"autotuning experiment {exp.name}: "
+                    f"{exp.metric_value or exp.error}")
+        if exp.metric_value is not None and \
+                (best is None or exp.metric_value > best.metric_value):
+            best = exp
+    if best is None:
+        logger.error("autotuning: no experiment succeeded")
+        return 1
+    with open(os.path.join(results_dir, "best_config.json"), "w") as f:
+        json.dump({"name": best.name, "overrides": best.overrides,
+                   "throughput": best.metric_value}, f, indent=2)
+    logger.info(f"autotuning: best = {best.name} "
+                f"({best.metric_value:.2f} samples/s) -> "
+                f"{results_dir}/best_config.json")
+    return 0

@@ -39,6 +39,9 @@ class TechniquePlan:
     bits: int = 8
     groups: int = 1
     symmetric: bool = True
+    start_bits: int = 8
+    target_bits: int = 8
+    quantization_period: int = 0
     # pruning
     ratio: float = 0.0
     method: str = "l1"       # l1 | topk
@@ -110,7 +113,10 @@ def _parse_group(technique: str, gname: str, gcfg: Dict, shared: Dict) -> Techni
     plan = TechniquePlan(technique=technique, modules=list(gcfg.get("modules", ["*"])))
     plan.start_step = int(shared.get("schedule_offset", 0))
     if technique == "weight_quantization":
-        plan.bits = int(p.get("target_bits", p.get("start_bits", 8)))
+        plan.start_bits = int(p.get("start_bits", 8))
+        plan.target_bits = int(p.get("target_bits", plan.start_bits))
+        plan.quantization_period = int(p.get("quantization_period", 0))
+        plan.bits = plan.target_bits if plan.quantization_period == 0 else plan.start_bits
         plan.groups = int(p.get("quantization_groups", 1))
         plan.symmetric = shared.get("quantization_type", "symmetric") == "symmetric"
     else:

@@ -83,7 +83,7 @@ class OnebitState(NamedTuple):
 
 
 def onebit_train_step_factory(loss_fn, tx, mesh, dp_axis: str = "dp",
-                              freeze_step: int = 0):
+                              freeze_step: int = None):
     """Build a jitted 1-bit data-parallel train step.
 
     Unlike the main engine (where XLA inserts exact mean-psums in backward),
@@ -96,6 +96,7 @@ def onebit_train_step_factory(loss_fn, tx, mesh, dp_axis: str = "dp",
     ``freeze_step`` steps (host-side switch → two compiled programs, no dead
     collectives in either).
     """
+    import inspect as _inspect
     from functools import partial
 
     from jax import lax
@@ -105,6 +106,15 @@ def onebit_train_step_factory(loss_fn, tx, mesh, dp_axis: str = "dp",
     except ImportError:  # pragma: no cover
         from jax.experimental.shard_map import shard_map as _sm
     from jax.sharding import PartitionSpec as P
+
+    if freeze_step is None:
+        # honor the marker build_onebit_optimizer attaches (warmup with exact
+        # reduction protects the Adam variance estimate)
+        freeze_step = int(getattr(tx, "freeze_step", 0) or 0)
+    # old shard_map spells the replication-check kwarg check_rep
+    _sm_params = _inspect.signature(_sm).parameters
+    _check_kw = ({"check_vma": False} if "check_vma" in _sm_params
+                 else {"check_rep": False})
 
     ndev = int(np.prod([mesh.shape[a] for a in (dp_axis,)]))
 
@@ -142,7 +152,7 @@ def onebit_train_step_factory(loss_fn, tx, mesh, dp_axis: str = "dp",
             per_shard, mesh=mesh,
             in_specs=(rep, err_spec, P(dp_axis)),
             out_specs=(rep, err_spec, rep),
-            check_vma=False)(state.params, state.error, batch)
+            **_check_kw)(state.params, state.error, batch)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree.map(lambda p, u: p + u.astype(p.dtype),
                                   state.params, updates)

@@ -9,34 +9,22 @@ from .compress import CompressionContext, TechniquePlan
 
 class CompressionScheduler:
     def __init__(self, ctx: CompressionContext, config: Dict = None):
+        # ramp parameters live on each plan (parsed once in _parse_group) —
+        # no re-parse here, so same-module groups cannot alias each other
         self.ctx = ctx
-        block = (config or {}).get("compression_training", config or {})
-        wq = block.get("weight_quantization", {})
-        self._bit_ramps = {}
-        for gname, gcfg in wq.get("different_groups", {}).items():
-            p = gcfg.get("params", {})
-            period = int(p.get("quantization_period", 0))
-            start, target = int(p.get("start_bits", 8)), int(p.get("target_bits", 8))
-            if period > 0 and start != target:
-                self._bit_ramps[tuple(gcfg.get("modules", ["*"]))] = \
-                    (start, target, period)
 
     def step(self, global_step: int):
         """Update plan bits for ramped quantization; called once per train
         step (reference scheduler hooks into engine.step)."""
         for plan in self.ctx.plans:
-            if plan.technique != "weight_quantization":
+            if plan.technique != "weight_quantization" or \
+                    plan.quantization_period <= 0:
                 continue
-            ramp = self._bit_ramps.get(tuple(plan.modules))
-            if ramp is None:
-                continue
-            start, target, period = ramp
-            # halve bits every `period` steps until target (reference ramp)
-            bits = start
-            steps = global_step
-            while bits > target and steps >= period:
-                bits = max(target, bits // 2)
-                steps -= period
+            # halve bits every `quantization_period` steps until target
+            bits, steps = plan.start_bits, global_step
+            while bits > plan.target_bits and steps >= plan.quantization_period:
+                bits = max(plan.target_bits, bits // 2)
+                steps -= plan.quantization_period
             plan.bits = bits
 
     def active_plans(self, global_step: int) -> List[TechniquePlan]:

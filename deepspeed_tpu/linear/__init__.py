@@ -125,13 +125,14 @@ def lora_optimizer(inner, params) -> Any:
                                  labels)
 
 
-def fuse_lora(params, alpha_over_r: Optional[float] = None) -> Any:
+def fuse_lora(params, alpha_over_r: float) -> Any:
     """Merge LoRA adapters into base weights (reference HybridEngine
-    ``fuse_lora_weight``): W' = W + (alpha/r) A @ B; adapters zeroed."""
+    ``fuse_lora_weight``): W' = W + (alpha/r) A @ B; adapters zeroed.
+    ``alpha_over_r`` is the model's ``lora_alpha / lora_r`` — it must be
+    supplied (a guessed default would silently mis-scale the fusion)."""
     def fuse(d):
         if isinstance(d, dict) and "base_weight" in d and "lora_a" in d:
-            r = d["lora_a"].shape[1]
-            coef = alpha_over_r if alpha_over_r is not None else 16.0 / r
+            coef = alpha_over_r
             out = dict(d)
             out["base_weight"] = d["base_weight"] + coef * (d["lora_a"] @ d["lora_b"])
             out["lora_a"] = jnp.zeros_like(d["lora_a"])

@@ -17,6 +17,7 @@ API surface preserved from the reference:
 """
 
 import inspect
+import json
 import os
 import time
 from functools import partial
@@ -394,6 +395,12 @@ class DeepSpeedTPUEngine:
         self._last_metrics = {k: float(v) for k, v in metrics.items()}
         self._step_times.append(time.perf_counter() - t0)
         self._maybe_report()
+        if os.environ.get("DSTPU_AUTOTUNE_RESULT") and \
+                self.global_steps >= self.config.autotuning.end_profile_step:
+            from ..autotuning.autotuner import report_autotune_result
+
+            tp = self.throughput()
+            report_autotune_result(tp.get("samples_per_sec", 0.0))
         return self._last_metrics["loss"]
 
     def eval_batch(self, batch, compute_loss: bool = True):
@@ -726,7 +733,15 @@ def initialize(args=None,
     Returns ``(engine, optimizer_proxy, dataloader, lr_scheduler_proxy)`` to
     match the reference tuple.
     """
-    cfg = load_config(config if config is not None else config_params)
+    raw_cfg = config if config is not None else config_params
+    if os.environ.get("DSTPU_AUTOTUNE_CONFIG") and isinstance(raw_cfg, (dict, str)):
+        from ..autotuning.autotuner import apply_autotune_env_overrides
+
+        if isinstance(raw_cfg, str):  # config file path: load, then overlay
+            with open(raw_cfg) as f:
+                raw_cfg = json.load(f)
+        raw_cfg = apply_autotune_env_overrides(raw_cfg)
+    cfg = load_config(raw_cfg)
     dist.init_distributed()
     if topology is None:
         spec = TopologySpec(pp=cfg.pipeline.stages if cfg.pipeline.stages else 1,

@@ -1,0 +1,125 @@
+"""Hybrid engine: one engine flipping between training and generation (RLHF).
+
+Reference ``DeepSpeedHybridEngine`` (``runtime/hybrid_engine.py:30``):
+``generate:168`` gathers ZeRO-3 params into injected inference kernels,
+``eval:376``/``train:418`` flip modes, LoRA is fused for generation and
+unfused for training. TPU-native: training state (fp32 master, ZeRO
+shardings) and the inference program (compute dtype, TP shardings) are two
+*views* of one parameter pytree — mode flips are a cast + ``device_put``
+resharding collective, not module surgery. The actor's RLHF loop is:
+
+    engine.train_batch(...)        # ZeRO-sharded training step
+    out = engine.generate(prompts) # inference view of the CURRENT weights
+"""
+
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..inference.config import DeepSpeedInferenceConfig
+from ..models.transformer import TransformerLM
+from ..utils.logging import log_dist
+from .engine import DeepSpeedTPUEngine
+
+
+def lm_loss_fn(model: TransformerLM) -> Callable:
+    """Next-token cross-entropy for a ``TransformerLM`` (the default actor
+    loss; RLHF losses wrap/replace this)."""
+    def loss_fn(params, batch, rng=None):
+        tokens = batch["tokens"] if isinstance(batch, dict) else batch
+        logits = model.apply({"params": params}, tokens[:, :-1])
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    return loss_fn
+
+
+class DeepSpeedHybridEngine(DeepSpeedTPUEngine):
+    """Training engine + on-demand generation over the live weights."""
+
+    def __init__(self, model: TransformerLM, params: Any, config,
+                 loss_fn: Optional[Callable] = None,
+                 inference_config: Optional[DeepSpeedInferenceConfig] = None,
+                 lora_config=None, lora_fused_generate: bool = False, **kw):
+        self._model = model
+        self._inference_config = inference_config or DeepSpeedInferenceConfig()
+        self._lora_fused = lora_fused_generate
+        self._lora_config = lora_config
+        if lora_fused_generate and lora_config is None:
+            raise ValueError("lora_fused_generate needs lora_config "
+                             "(its alpha/r scales the fusion)")
+        self._infer = None
+        self._training = True
+        self.generate_time = 0.0
+        self.generate_count = 0
+        from .config import load_config
+
+        super().__init__(loss_fn=loss_fn or lm_loss_fn(model), params=params,
+                         config=load_config(config), **kw)
+
+    # mode flips (reference eval:376 / train:418) -----------------------
+    def train(self, mode: bool = True):
+        self._training = mode
+        return self
+
+    def eval(self):
+        return self.train(False)
+
+    @property
+    def is_training(self) -> bool:
+        return self._training
+
+    # ------------------------------------------------------------------
+    def _inference_engine(self):
+        if self._infer is None:
+            from ..inference.engine import InferenceEngine
+
+            self._infer = InferenceEngine(self._model, self._inference_params(),
+                                          self._inference_config)
+            log_dist("hybrid engine: inference view initialized "
+                     f"(tp={self._infer.topo.tp_size})")
+        return self._infer
+
+    def _inference_params(self):
+        params = self.state.params
+        if self._lora_fused:
+            from ..linear import fuse_lora
+
+            lc = self._lora_config
+            # fuse_lora is pure jnp — stays on device, no host round-trip
+            params = fuse_lora(params, lc.lora_alpha / lc.lora_r)
+        return params
+
+    def _refresh_inference_params(self):
+        """Push the CURRENT training weights into the inference view: cast to
+        the inference dtype and reshard onto the inference topology (a
+        collective, the analogue of the reference's param gather,
+        ``hybrid_engine.py:generate:168``)."""
+        inf = self._inference_engine()
+        params = self._inference_params()
+        dtype = self._inference_config.jnp_dtype
+        cast = jax.tree.map(
+            lambda x: x.astype(dtype) if jnp.issubdtype(
+                jnp.asarray(x).dtype, jnp.floating) else x, params)
+        inf.params = jax.device_put(cast, inf._param_shardings)
+
+    def generate(self, tokens, prompt_lengths=None, max_new_tokens=None, **kw):
+        """Generate with the live weights (reference ``generate:168``)."""
+        t0 = time.perf_counter()
+        self._refresh_inference_params()
+        out = self._inference_engine().generate(
+            tokens, prompt_lengths=prompt_lengths,
+            max_new_tokens=max_new_tokens, **kw)
+        self.generate_time = time.perf_counter() - t0
+        self.generate_count += 1
+        return out
+
+    def forward_logits(self, tokens):
+        """Full-sequence logits under the inference view (reward/critic
+        scoring in RLHF loops)."""
+        self._refresh_inference_params()
+        return self._inference_engine().forward(tokens)

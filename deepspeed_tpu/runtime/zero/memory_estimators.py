@@ -1,0 +1,60 @@
+"""ZeRO memory-need estimators.
+
+Reference API parity: ``estimate_zero2_model_states_mem_needs_all_live``
+(``runtime/zero/stage_1_and_2.py``) and the zero3 variant
+(``stage3.py``) — sizing helpers users call before picking a stage. Model
+state accounting (per chip, bf16 compute + fp32 master + Adam m/v):
+
+* stage 0: 2P (weights) + 4P master + 8P optim + 4P grads
+* stage 1: optimizer+master sharded over dp
+* stage 2: + fp32 grads sharded
+* stage 3: + weights sharded
+"""
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+
+def _param_count(params_or_count) -> int:
+    if isinstance(params_or_count, (int, np.integer)):
+        return int(params_or_count)
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params_or_count)
+               if hasattr(l, "shape"))
+
+
+def estimate_zero_model_states_mem_needs(params_or_count, zero_stage: int,
+                                         dp_size: int,
+                                         compute_bytes: int = 2) -> Dict[str, float]:
+    """Per-chip model-state bytes for a given stage/dp (activations excluded)."""
+    p = _param_count(params_or_count)
+    d = max(1, dp_size)
+    weights = compute_bytes * p
+    master = 4 * p
+    optim = 8 * p   # adam m+v fp32
+    grads = 4 * p
+    if zero_stage >= 1:
+        master, optim = master / d, optim / d
+    if zero_stage >= 2:
+        grads = grads / d
+    if zero_stage >= 3:
+        weights = weights / d
+    total = weights + master + optim + grads
+    return {"params": p, "weights_bytes": weights, "master_bytes": master,
+            "optimizer_bytes": optim, "grad_bytes": grads,
+            "total_bytes": total, "total_gb": total / 1024**3}
+
+
+def estimate_zero2_model_states_mem_needs_all_live(model_params, num_gpus_per_node=1,
+                                                   num_nodes=1):
+    """Reference-named helper (``stage_1_and_2.py``)."""
+    return estimate_zero_model_states_mem_needs(
+        model_params, 2, num_gpus_per_node * num_nodes)
+
+
+def estimate_zero3_model_states_mem_needs_all_live(model_params, num_gpus_per_node=1,
+                                                   num_nodes=1):
+    """Reference-named helper (``stage3.py``)."""
+    return estimate_zero_model_states_mem_needs(
+        model_params, 3, num_gpus_per_node * num_nodes)

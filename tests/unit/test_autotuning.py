@@ -1,0 +1,111 @@
+"""Autotuner + memory estimator tests (reference: tests/unit/autotuning/)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import (Autotuner, apply_autotune_env_overrides,
+                                      generate_experiments)
+from deepspeed_tpu.runtime.zero.memory_estimators import (
+    estimate_zero2_model_states_mem_needs_all_live,
+    estimate_zero3_model_states_mem_needs_all_live,
+    estimate_zero_model_states_mem_needs)
+
+
+def test_memory_estimators_scale_with_stage():
+    p = 1_000_000
+    ests = [estimate_zero_model_states_mem_needs(p, s, dp_size=8)["total_bytes"]
+            for s in (0, 1, 2, 3)]
+    # monotonically decreasing with stage
+    assert ests[0] > ests[1] > ests[2] > ests[3]
+    # stage 0: 2P + 4P + 8P + 4P = 18P
+    assert ests[0] == 18 * p
+    # stage 3 with dp=8: everything sharded -> 18P/8
+    assert abs(ests[3] - 18 * p / 8) < 1e-6
+    # named reference helpers agree
+    z2 = estimate_zero2_model_states_mem_needs_all_live(p, 8, 1)
+    assert z2["total_bytes"] == ests[2]
+    z3 = estimate_zero3_model_states_mem_needs_all_live(p, 4, 2)
+    assert z3["total_bytes"] == ests[3]
+    # param-tree input
+    tree = {"w": jnp.zeros((10, 10)), "b": jnp.zeros((10,))}
+    assert estimate_zero_model_states_mem_needs(tree, 0, 1)["params"] == 110
+
+
+def test_generate_experiments_memory_pruning():
+    base = {"train_micro_batch_size_per_gpu": 2}
+    exps = generate_experiments(base, param_count=1_000_000, dp_size=4,
+                                hbm_bytes=None)
+    names = {e.name for e in exps}
+    assert "z0_mbs2" in names and "z3_mbs8" in names
+    # prune: HBM fits only sharded stages (stage0 needs 18MB, cap at 10MB)
+    exps = generate_experiments(base, param_count=1_000_000, dp_size=4,
+                                hbm_bytes=10 * 1024**2 * 1.0)
+    stages = {e.overrides["zero_optimization"]["stage"] for e in exps}
+    assert 0 not in stages and 3 in stages
+
+
+def test_autotuner_tune_inprocess():
+    rng = np.random.default_rng(0)
+    w_t = rng.normal(size=(8, 4)).astype(np.float32)
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    params = {"w": jnp.zeros((8, 4), jnp.float32)}
+
+    def batch_fn(gbs):
+        x = rng.normal(size=(gbs, 8)).astype(np.float32)
+        return (jnp.asarray(x), jnp.asarray(x @ w_t))
+
+    tuner = Autotuner({"train_micro_batch_size_per_gpu": 1,
+                       "optimizer": {"type": "adam", "params": {"lr": 1e-2}}},
+                      warmup_steps=1, measure_steps=2)
+    best = tuner.tune(loss_fn, params, batch_fn, stages=(0, 1),
+                      micro_batches=[1, 2])
+    assert len(tuner.results) == 4
+    assert all(e.metric_value is not None for e in tuner.results)
+    assert "zero_optimization" in best
+    assert tuner.best.metric_value == max(e.metric_value for e in tuner.results)
+    assert "experiment" in tuner.summary()
+
+
+def test_env_override_merge(monkeypatch):
+    monkeypatch.setenv("DSTPU_AUTOTUNE_CONFIG", json.dumps(
+        {"zero_optimization": {"stage": 3}, "train_micro_batch_size_per_gpu": 4,
+         "train_batch_size": None}))
+    cfg = apply_autotune_env_overrides(
+        {"zero_optimization": {"stage": 1, "mics_shard_size": 2},
+         "train_batch_size": 64, "train_micro_batch_size_per_gpu": 1})
+    assert cfg["zero_optimization"]["stage"] == 3
+    assert cfg["zero_optimization"]["mics_shard_size"] == 2  # deep-merged
+    assert cfg["train_micro_batch_size_per_gpu"] == 4
+    assert "train_batch_size" not in cfg  # None removes the key
+
+
+def test_engine_reports_result(tmp_path, monkeypatch):
+    import deepspeed_tpu as ds
+
+    result = tmp_path / "r.json"
+    monkeypatch.setenv("DSTPU_AUTOTUNE_RESULT", str(result))
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    ndev = len(jax.devices())
+    engine, _, _, _ = ds.initialize(
+        model=loss_fn, model_parameters={"w": jnp.zeros((4, 2), jnp.float32)},
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "sgd", "params": {"lr": 0.1}},
+                "autotuning": {"end_profile_step": 2}})
+    x = jnp.ones((ndev, 4)); y = jnp.ones((ndev, 2))
+    for _ in range(3):
+        engine.train_batch(batch=(x, y))
+    data = json.loads(result.read_text())
+    assert data["throughput"] > 0

@@ -1,0 +1,84 @@
+"""Hybrid engine (RLHF train/generate) tests (reference:
+tests/unit/hybrid_engine/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.models.transformer import TransformerConfig, TransformerLM
+from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine, lm_loss_fn
+
+
+def _setup(zero_stage=2):
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                            num_layers=2, num_heads=4, max_seq_len=64,
+                            dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    ndev = len(jax.devices())
+    ds_cfg = {"train_micro_batch_size_per_gpu": 1, "gradient_accumulation_steps": 1,
+              "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+              "zero_optimization": {"stage": zero_stage}}
+    engine = DeepSpeedHybridEngine(
+        model, params, ds_cfg,
+        inference_config=DeepSpeedInferenceConfig.from_dict(
+            {"dtype": "float32", "max_out_tokens": 64}))
+    return model, engine, ndev
+
+
+def test_train_then_generate_uses_live_weights():
+    model, engine, ndev = _setup()
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 64, size=(ndev, 16)).astype(np.int32)
+    prompts = toks[:2, :6].copy()
+
+    g0 = engine.generate(prompts, max_new_tokens=5)
+    l0 = engine.train_batch(batch=jnp.asarray(toks))
+    for _ in range(5):
+        l1 = engine.train_batch(batch=jnp.asarray(toks))
+    assert l1 < l0  # memorizing the fixed batch
+    g1 = engine.generate(prompts, max_new_tokens=5)
+    assert g1.shape == (2, 5)
+    # training shifted the distribution: generations generally change
+    # (guaranteed check instead: inference view == fresh engine on same params)
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    fresh = InferenceEngine(model, jax.device_get(engine.state.params),
+                            DeepSpeedInferenceConfig.from_dict(
+                                {"dtype": "float32", "max_out_tokens": 64}))
+    g_ref = fresh.generate(prompts, max_new_tokens=5)
+    np.testing.assert_array_equal(g1, g_ref)
+
+
+def test_mode_flips_and_latency_stats():
+    _, engine, ndev = _setup(zero_stage=0)
+    assert engine.is_training
+    engine.eval()
+    assert not engine.is_training
+    engine.train()
+    assert engine.is_training
+    prompts = np.ones((2, 4), np.int32)
+    engine.generate(prompts, max_new_tokens=3)
+    assert engine.generate_count == 1 and engine.generate_time > 0
+
+
+def test_forward_logits_scoring():
+    model, engine, ndev = _setup(zero_stage=1)
+    toks = np.ones((2, 8), np.int32)
+    logits = engine.forward_logits(toks)
+    assert logits.shape == (2, 8, 64)
+    # matches direct model application on the training params
+    direct = model.apply({"params": jax.device_get(engine.state.params)},
+                         jnp.asarray(toks))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(direct),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_lm_loss_decreases_under_engine():
+    model, engine, ndev = _setup(zero_stage=3)
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, 64, size=(ndev * 2, 16)).astype(np.int32)
+    losses = [engine.train_batch(batch=jnp.asarray(toks)) for _ in range(8)]
+    assert losses[-1] < losses[0]
