@@ -96,25 +96,17 @@ def onebit_train_step_factory(loss_fn, tx, mesh, dp_axis: str = "dp",
     ``freeze_step`` steps (host-side switch → two compiled programs, no dead
     collectives in either).
     """
-    import inspect as _inspect
     from functools import partial
 
     from jax import lax
-
-    try:
-        from jax import shard_map as _sm  # jax >= 0.8
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map as _sm
     from jax.sharding import PartitionSpec as P
+
+    from ..utils.shard_map_compat import shard_map_nocheck as _sm
 
     if freeze_step is None:
         # honor the marker build_onebit_optimizer attaches (warmup with exact
         # reduction protects the Adam variance estimate)
         freeze_step = int(getattr(tx, "freeze_step", 0) or 0)
-    # old shard_map spells the replication-check kwarg check_rep
-    _sm_params = _inspect.signature(_sm).parameters
-    _check_kw = ({"check_vma": False} if "check_vma" in _sm_params
-                 else {"check_rep": False})
 
     ndev = int(np.prod([mesh.shape[a] for a in (dp_axis,)]))
 
@@ -149,10 +141,9 @@ def onebit_train_step_factory(loss_fn, tx, mesh, dp_axis: str = "dp",
         rep = P()
         err_spec = P(dp_axis)  # leading axis = one error slice per dp shard
         grads, new_error, loss = _sm(
-            per_shard, mesh=mesh,
+            per_shard, mesh,
             in_specs=(rep, err_spec, P(dp_axis)),
-            out_specs=(rep, err_spec, rep),
-            **_check_kw)(state.params, state.error, batch)
+            out_specs=(rep, err_spec, rep))(state.params, state.error, batch)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree.map(lambda p, u: p + u.astype(p.dtype),
                                   state.params, updates)

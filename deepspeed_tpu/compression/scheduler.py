@@ -2,13 +2,13 @@
 steps techniques on/off by schedule_offset and ramps quantization bits
 from start_bits to target_bits over quantization_period."""
 
-from typing import Dict, List
+from typing import List
 
 from .compress import CompressionContext, TechniquePlan
 
 
 class CompressionScheduler:
-    def __init__(self, ctx: CompressionContext, config: Dict = None):
+    def __init__(self, ctx: CompressionContext):
         # ramp parameters live on each plan (parsed once in _parse_group) —
         # no re-parse here, so same-module groups cannot alias each other
         self.ctx = ctx

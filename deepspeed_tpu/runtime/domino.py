@@ -1,0 +1,40 @@
+"""Domino: tensor-parallel communication/compute overlap.
+
+Reference ``DominoModule``/``DominoTransformerLayer``
+(``runtime/domino/transformer.py:19``): splits each batch into two
+micro-chunks so the TP allreduce of chunk 0's attention overlaps chunk 1's
+attention compute (hand-scheduled async NCCL handles). TPU-native: the same
+dependency-breaking chunk split, but the *overlap itself is XLA's job* —
+with two independent chunk pipelines in one program, XLA's async collective
+scheduler hides each chunk's tp-allreduce behind the other chunk's compute.
+No handles, no streams; the transformation is purely structural.
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def domino_chunked(layer_fn: Callable, x: jnp.ndarray, num_chunks: int = 2
+                   ) -> jnp.ndarray:
+    """Run ``layer_fn`` (a TP-parallel block containing row-parallel
+    allreduces) over ``num_chunks`` batch chunks as independent dataflow
+    branches; XLA interleaves chunk i's collectives with chunk j's compute."""
+    if x.shape[0] % num_chunks:
+        return layer_fn(x)
+    chunks = jnp.split(x, num_chunks, axis=0)
+    return jnp.concatenate([layer_fn(c) for c in chunks], axis=0)
+
+
+class DominoTransformerLayer:
+    """Callable wrapper pairing a transformer block with the chunk split
+    (reference ``DominoTransformerLayer``)."""
+
+    def __init__(self, block_fn: Callable, num_chunks: int = 2):
+        self.block_fn = block_fn
+        self.num_chunks = num_chunks
+
+    def __call__(self, x, *args, **kwargs):
+        return domino_chunked(lambda c: self.block_fn(c, *args, **kwargs),
+                              x, self.num_chunks)

@@ -395,12 +395,17 @@ class DeepSpeedTPUEngine:
         self._last_metrics = {k: float(v) for k, v in metrics.items()}
         self._step_times.append(time.perf_counter() - t0)
         self._maybe_report()
-        if os.environ.get("DSTPU_AUTOTUNE_RESULT") and \
-                self.global_steps >= self.config.autotuning.end_profile_step:
-            from ..autotuning.autotuner import report_autotune_result
+        at = self.config.autotuning
+        if self.global_steps == at.end_profile_step:
+            from ..autotuning.autotuner import AUTOTUNE_RESULT_ENV, report_autotune_result
 
-            tp = self.throughput()
-            report_autotune_result(tp.get("samples_per_sec", 0.0))
+            if os.environ.get(AUTOTUNE_RESULT_ENV):
+                # steady-state only: skip the JIT-compile steps before
+                # start_profile_step so compile time can't invert the ranking
+                start = min(at.start_profile_step, at.end_profile_step - 1)
+                times = self._step_times[max(0, start):]
+                dt = float(np.mean(times)) if times else float("inf")
+                report_autotune_result(self.train_batch_size / dt)
         return self._last_metrics["loss"]
 
     def eval_batch(self, batch, compute_loss: bool = True):
@@ -734,12 +739,16 @@ def initialize(args=None,
     match the reference tuple.
     """
     raw_cfg = config if config is not None else config_params
-    if os.environ.get("DSTPU_AUTOTUNE_CONFIG") and isinstance(raw_cfg, (dict, str)):
+    from ..autotuning.autotuner import AUTOTUNE_CONFIG_ENV
+
+    if os.environ.get(AUTOTUNE_CONFIG_ENV) and raw_cfg is not None:
         from ..autotuning.autotuner import apply_autotune_env_overrides
 
         if isinstance(raw_cfg, str):  # config file path: load, then overlay
             with open(raw_cfg) as f:
                 raw_cfg = json.load(f)
+        elif not isinstance(raw_cfg, dict):  # typed config object
+            raw_cfg = raw_cfg.to_dict()
         raw_cfg = apply_autotune_env_overrides(raw_cfg)
     cfg = load_config(raw_cfg)
     dist.init_distributed()

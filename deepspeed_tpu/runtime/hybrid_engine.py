@@ -98,9 +98,13 @@ class DeepSpeedHybridEngine(DeepSpeedTPUEngine):
         """Push the CURRENT training weights into the inference view: cast to
         the inference dtype and reshard onto the inference topology (a
         collective, the analogue of the reference's param gather,
-        ``hybrid_engine.py:generate:168``)."""
+        ``hybrid_engine.py:generate:168``). Skipped when no train step has
+        happened since the last refresh."""
+        if getattr(self, "_refreshed_at_step", None) == self.global_steps:
+            return
         inf = self._inference_engine()
         params = self._inference_params()
+        self._refreshed_at_step = self.global_steps
         dtype = self._inference_config.jnp_dtype
         cast = jax.tree.map(
             lambda x: x.astype(dtype) if jnp.issubdtype(

@@ -1,0 +1,49 @@
+"""Progressive layer drop (PLD).
+
+Reference ``ProgressiveLayerDrop`` (``runtime/progressive_layer_drop.py:40``;
+engine hook ``engine.py:348``): keep probability theta(t) anneals from 1
+toward ``theta`` with rate ``gamma``; deeper layers drop more (the i/L
+scaling of the PLD paper). ``pld_apply`` wraps a residual layer with the
+stochastic skip; at eval the layer always runs (outputs are scaled during
+training so eval needs no rescale, inverted-dropout style).
+"""
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self, global_step: int) -> float:
+        """theta(t) = (1 - theta_min) * exp(-gamma t) + theta_min."""
+        return (1.0 - self.theta) * math.exp(-self.gamma * global_step) + self.theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = self.get_theta(global_step)
+        return self.current_theta
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.current_theta}
+
+    def keep_prob(self, layer_idx: int, num_layers: int) -> float:
+        """Deeper layers drop more: p_i = 1 - i/L * (1 - theta(t))."""
+        return 1.0 - (layer_idx / max(1, num_layers)) * (1.0 - self.current_theta)
+
+
+def pld_apply(layer_fn: Callable, x: jnp.ndarray, rng, keep_prob: float,
+              deterministic: bool = False) -> jnp.ndarray:
+    """Stochastic residual-layer skip: with prob ``1-keep_prob`` the layer's
+    contribution is dropped; kept contributions are scaled by 1/keep_prob so
+    eval (always-on) needs no rescaling."""
+    residual = layer_fn(x) - x  # layer contribution (layer_fn includes +x)
+    if deterministic or keep_prob >= 1.0:
+        return x + residual
+    keep = jax.random.bernoulli(rng, keep_prob)
+    return x + jnp.where(keep, residual / keep_prob, jnp.zeros_like(residual))

@@ -1,0 +1,64 @@
+"""MoQ — Mixture of Quantization (training-time weight quantization).
+
+Reference ``Quantizer`` (``runtime/quantize.py``) + ``WeightQuantization``
+(``runtime/weight_quantizer.py``): anneal weight precision from
+``start_bits`` to ``target_bits`` every ``quantize_period`` steps, optionally
+modulated per-layer by Hessian eigenvalues (sharp layers quantize later).
+Built on the compression QAT primitives; this class owns the schedule.
+"""
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..compression.basic_layer import quantize_weight
+from ..utils.logging import logger
+
+
+class MoQQuantizer:
+    def __init__(self, q_type: str = "symmetric", start_bits: int = 16,
+                 target_bits: int = 8, quantize_period: int = 100,
+                 quantize_groups: int = 1, eigenvalue_scale: Optional[Dict[str, float]] = None):
+        self.symmetric = q_type == "symmetric"
+        self.start_bits = start_bits
+        self.target_bits = target_bits
+        self.period = quantize_period
+        self.groups = quantize_groups
+        # larger eigenvalue -> longer effective period (quantize later)
+        self.eigenvalue_scale = eigenvalue_scale or {}
+        self.current_bits = start_bits
+
+    def bits_at(self, step: int, key: str = "") -> int:
+        period = self.period
+        scale = self.eigenvalue_scale.get(key)
+        if scale is not None:
+            period = int(period * max(1.0, scale))
+        bits, s = self.start_bits, step
+        while bits > self.target_bits and s >= period:
+            bits = max(self.target_bits, bits // 2)
+            s -= period
+        return bits
+
+    def update(self, step: int) -> int:
+        self.current_bits = self.bits_at(step)
+        return self.current_bits
+
+    def quantize(self, params, step: int, training: bool = True):
+        """Fake-quantize every >=2-D floating leaf at its scheduled bits."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        out = []
+        for kp, leaf in flat:
+            key = "/".join(str(getattr(e, "key", getattr(e, "name", e))) for e in kp)
+            if hasattr(leaf, "ndim") and leaf.ndim >= 2 and \
+                    jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+                bits = self.bits_at(step, key)
+                if bits < 16:
+                    leaf = quantize_weight(leaf, bits, self.groups,
+                                           self.symmetric, training)
+            out.append(leaf)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class WeightQuantization(MoQQuantizer):
+    """Reference-named alias (``runtime/weight_quantizer.py``)."""

@@ -1,0 +1,60 @@
+"""Sparse gradients for embeddings.
+
+Reference ``SparseTensor`` (``runtime/sparse_tensor.py:69``) +
+``engine.sparse_allreduce:2564``: embedding grads shipped as (indices,
+values) pairs so the allreduce moves only touched rows. In JAX embedding
+grads come out dense; the sparse path pays off when few vocabulary rows are
+touched per step — ``from_dense`` extracts the touched rows (static capacity
+``max_rows`` for XLA), ``sparse_all_reduce`` allgathers the compact pairs and
+re-accumulates locally.
+"""
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class SparseTensor(NamedTuple):
+    indices: jnp.ndarray    # [R] row ids (may repeat; -1 = empty slot)
+    values: jnp.ndarray     # [R, D] row values
+    dense_shape: tuple
+
+    @property
+    def sparse_size(self) -> int:
+        return int(self.indices.shape[0]) * int(self.values.shape[-1])
+
+    def to_dense(self) -> jnp.ndarray:
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        safe = jnp.where(self.indices < 0, 0, self.indices)
+        mask = (self.indices >= 0).reshape((-1,) + (1,) * (self.values.ndim - 1))
+        return out.at[safe].add(jnp.where(mask, self.values,
+                                          jnp.zeros_like(self.values)))
+
+
+def from_dense(grad: jnp.ndarray, max_rows: int) -> SparseTensor:
+    """Extract the top-``max_rows`` rows by L1 mass (static shape for XLA).
+    Exact whenever at most ``max_rows`` rows are nonzero — the embedding-grad
+    case this path exists for; beyond capacity the smallest rows are
+    dropped (size the capacity at the per-step token count to avoid that)."""
+    mass = jnp.sum(jnp.abs(grad), axis=tuple(range(1, grad.ndim)))
+    top = jax.lax.top_k(mass, max_rows)
+    idx = jnp.where(top[0] > 0, top[1].astype(jnp.int32), -1)
+    mask = (idx >= 0).reshape((-1,) + (1,) * (grad.ndim - 1))
+    vals = jnp.where(mask, grad[jnp.where(idx < 0, 0, idx)], 0)
+    return SparseTensor(indices=idx, values=vals, dense_shape=tuple(grad.shape))
+
+
+def sparse_all_reduce(st: SparseTensor, axis) -> jnp.ndarray:
+    """Mean-reduce a sparse grad across ``axis`` (inside shard_map/jit):
+    allgather the compact (indices, values), densify once, divide by world —
+    comm volume is R·D per rank instead of V·D (reference
+    ``sparse_allreduce_bucket``)."""
+    world = lax.axis_size(axis)
+    all_idx = lax.all_gather(st.indices, axis)          # [W, R]
+    all_val = lax.all_gather(st.values, axis)           # [W, R, D]
+    merged = SparseTensor(indices=all_idx.reshape(-1),
+                          values=all_val.reshape(-1, st.values.shape[-1]),
+                          dense_shape=st.dense_shape)
+    return merged.to_dense() / world

@@ -1,0 +1,64 @@
+"""TiledLinear: bound activation memory for huge linears.
+
+Reference ``TiledLinear`` (``runtime/zero/tiling.py:32``): splits a linear
+into an in_splits × out_splits grid of sub-linears so no full-size activation
+ever materializes. TPU-native: one weight tensor, the *computation* is tiled
+with ``lax.scan`` over output tiles (+ optional ``jax.checkpoint`` per tile);
+XLA keeps at most one tile's activation live.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def tiled_matmul(x: jnp.ndarray, w: jnp.ndarray, out_splits: int = 1,
+                 in_splits: int = 1, remat: bool = False) -> jnp.ndarray:
+    """y = x @ w computed in tiles. x: [..., K]; w: [K, N].
+
+    ``out_splits`` scans over column tiles of ``w`` (bounds the live output
+    activation); ``in_splits`` accumulates over row tiles (bounds the live
+    input slice in the backward)."""
+    k, n = w.shape
+    if n % out_splits or k % in_splits:
+        raise ValueError(f"w {w.shape} not divisible by splits "
+                         f"({in_splits}, {out_splits})")
+    wt = w.reshape(k, out_splits, n // out_splits).transpose(1, 0, 2)  # [O,K,n']
+
+    def one_tile(w_tile):
+        def inner(acc_x):
+            xs = jnp.split(acc_x, in_splits, axis=-1)
+            ws = jnp.split(w_tile, in_splits, axis=0)
+            out = xs[0] @ ws[0]
+            for xi, wi in zip(xs[1:], ws[1:]):
+                out = out + xi @ wi
+            return out
+
+        fn = jax.checkpoint(inner) if remat else inner
+        return fn(x)
+
+    tiles = jax.lax.map(one_tile, wt)                # [O, ..., n']
+    return jnp.moveaxis(tiles, 0, -2).reshape(x.shape[:-1] + (n,))
+
+
+class TiledLinear(nn.Module):
+    """Reference-shaped module; forward runs :func:`tiled_matmul`."""
+    in_features: int
+    out_features: int
+    in_splits: int = 1
+    out_splits: int = 1
+    use_bias: bool = True
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("kernel", nn.initializers.lecun_normal(),
+                       (self.in_features, self.out_features), jnp.float32)
+        y = tiled_matmul(x, w.astype(x.dtype), self.out_splits, self.in_splits,
+                         self.remat)
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros,
+                               (self.out_features,), jnp.float32).astype(x.dtype)
+        return y

@@ -1,0 +1,147 @@
+"""ZeRO++ — explicit sharded training with quantized collectives.
+
+Reference: ZeRO++ (``zero/config.py`` knobs ``zero_quantized_weights`` qwZ,
+``zero_quantized_gradients`` qgZ, ``zero_hpz_partition_size`` hpZ; kernels
+``csrc/quantization/*``). The declarative engine path (``sharding.py``) lets
+XLA insert *exact* collectives; this module is the explicit counterpart for
+bandwidth-constrained meshes: parameters live as flat fp32 shards, the train
+step gathers them with **int8-quantized allgather** (qwZ), and gradients
+return to shards via **quantized reduce-scatter** (qgZ) — 4x less traffic on
+the gather and the reduction, with error bounded by blockwise scales.
+
+hpZ note: the reference keeps a secondary intra-node fp16 copy so the
+backward gather stays off the inter-node links. Under XLA the analogue is a
+remat policy that saves the gathered weights between fwd and bwd (no second
+gather at all); the hierarchical gather itself is provided for MiCS-style
+meshes (``hierarchical_all_gather``).
+"""
+
+from functools import partial
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...ops.pallas.quant import quantized_all_gather, quantized_reduce_scatter
+from ...utils.shard_map_compat import shard_map_nocheck as _sm
+
+_PAD_QUANTUM = 128  # quantized_reduce_scatter block alignment
+
+
+def hierarchical_all_gather(x, inner_axis: str, outer_axis: str, tiled: bool = True):
+    """MiCS/hpZ-style two-hop gather: inner (ICI-local) first, then outer
+    (reference ``mics_hierarchical_params_gather``, ``mics.py``)."""
+    inner = lax.all_gather(x, inner_axis, tiled=tiled)
+    return lax.all_gather(inner, outer_axis, tiled=tiled)
+
+
+class ZeroPPState(NamedTuple):
+    step: jnp.ndarray
+    shards: Any        # fp32 master shards: each leaf [dp, padded_n/dp]
+    opt_state: Any     # optimizer state over the shards
+
+
+def _shard_leaf(p, dp: int) -> jnp.ndarray:
+    n = int(np.prod(p.shape)) if p.ndim else 1
+    pad = (-n) % (dp * _PAD_QUANTUM)
+    flat = jnp.pad(jnp.ravel(p).astype(jnp.float32), (0, pad))
+    return flat.reshape(dp, -1)
+
+
+def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
+                              dp_axis: str = "dp",
+                              quantized_weights: bool = True,
+                              quantized_gradients: bool = True,
+                              compute_dtype=jnp.float32,
+                              quant_block: int = _PAD_QUANTUM):
+    """Build (init, step) for ZeRO-3 training with ZeRO++ collectives.
+
+    ``init(params) -> ZeroPPState`` (shards placed over ``dp_axis``);
+    ``step(state, batch) -> (state, loss)``. Weight gathers use int8
+    quantization when ``quantized_weights`` (qwZ), gradient reduction uses
+    quantized reduce-scatter when ``quantized_gradients`` (qgZ); exact XLA
+    collectives otherwise.
+    """
+    dp = mesh.shape[dp_axis]
+    state_box = {"shapes": None, "treedef": None}
+
+    def shard_spec_tree(tree):
+        return jax.tree.map(
+            lambda l: P(dp_axis) if getattr(l, "ndim", 0) >= 1 and
+            l.shape[:1] == (dp,) else P(), tree)
+
+    def init(params):
+        flat, treedef = jax.tree.flatten(params)
+        state_box["shapes"] = [tuple(p.shape) for p in flat]
+        state_box["treedef"] = treedef
+        shards = jax.tree.map(lambda p: _shard_leaf(p, dp), params)
+        shards = jax.device_put(
+            shards, jax.tree.map(lambda s: NamedSharding(mesh, P(dp_axis)), shards))
+        opt_state = tx.init(shards)
+        return ZeroPPState(step=jnp.zeros([], jnp.int32), shards=shards,
+                           opt_state=opt_state)
+
+    def _gather(local_1d, shape):
+        """shard [m] -> full param [shape] at compute dtype (qwZ)."""
+        n = int(np.prod(shape)) if shape else 1
+        if quantized_weights:
+            full = quantized_all_gather(local_1d, dp_axis, block=quant_block)
+        else:
+            full = lax.all_gather(local_1d, dp_axis)
+        return full.reshape(-1)[:n].reshape(shape).astype(compute_dtype)
+
+    def _reduce(grad_full, m):
+        """full grad -> this rank's mean shard [m] fp32 (qgZ)."""
+        flat = jnp.ravel(grad_full).astype(jnp.float32)
+        pad = dp * m - flat.shape[0]
+        flat = jnp.pad(flat, (0, pad))
+        if quantized_gradients:
+            return quantized_reduce_scatter(flat, dp_axis, block=quant_block)
+        return lax.psum_scatter(flat, dp_axis, tiled=True) / dp
+
+    def step(state: ZeroPPState, batch):
+        flat_shapes = state_box["shapes"]
+
+        def body(shards, opt_state, mb):
+            local = jax.tree.map(lambda s: s[0], shards)   # [1, m] -> [m]
+            leaves, tdef = jax.tree.flatten(local)
+
+            # gather OUTSIDE autodiff: the gather is data movement, not part
+            # of the loss — differentiating through all_gather would add its
+            # transpose (a second reduce-scatter) on top of the explicit qgZ
+            # reduction below
+            full = [_gather(jax.lax.stop_gradient(l), shp)
+                    for l, shp in zip(leaves, flat_shapes)]
+
+            def forward(full_leaves):
+                return loss_fn(jax.tree.unflatten(tdef, full_leaves), mb)
+
+            loss, grads_full = jax.value_and_grad(forward)(full)
+            grad_shards = [
+                _reduce(g, l.shape[0]) for g, l in zip(grads_full, leaves)]
+            grad_tree = jax.tree.unflatten(tdef, [g[None] for g in grad_shards])
+            updates, new_opt = tx.update(grad_tree, opt_state, shards)
+            new_shards = jax.tree.map(jnp.add, shards, updates)
+            return new_shards, new_opt, lax.pmean(loss, dp_axis)
+
+        sh_spec = shard_spec_tree(state.shards)
+        opt_spec = shard_spec_tree(state.opt_state)
+        new_shards, new_opt, loss = _sm(
+            body, mesh,
+            in_specs=(sh_spec, opt_spec, P(dp_axis)),
+            out_specs=(sh_spec, opt_spec, P()))(
+                state.shards, state.opt_state, batch)
+        return ZeroPPState(step=state.step + 1, shards=new_shards,
+                           opt_state=new_opt), loss
+
+    def gather_params(state: ZeroPPState):
+        """Materialize full fp32 params from shards (checkpoint export)."""
+        flat = jax.tree.leaves(state.shards)
+        full = [jnp.ravel(s)[:int(np.prod(shp) if shp else 1)].reshape(shp)
+                for s, shp in zip(flat, state_box["shapes"])]
+        return jax.tree.unflatten(state_box["treedef"], full)
+
+    return init, jax.jit(step, donate_argnums=(0,)), gather_params

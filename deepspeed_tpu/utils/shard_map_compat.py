@@ -1,0 +1,29 @@
+"""shard_map version shim.
+
+jax >= 0.8 exposes ``jax.shard_map`` with a ``check_vma`` kwarg; older
+releases have ``jax.experimental.shard_map.shard_map`` with ``check_rep``.
+One probe, shared by every explicit-collective module (onebit, zeropp,
+tests) so the version logic cannot drift between copies.
+"""
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_NOCHECK_KW = ({"check_vma": False}
+               if "check_vma" in inspect.signature(_shard_map).parameters
+               else {"check_rep": False})
+
+
+def shard_map_nocheck(fn, mesh, in_specs, out_specs):
+    """shard_map with the replication/vma check disabled (whichever kwarg the
+    installed jax spells it with)."""
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **_NOCHECK_KW)
+
+
+def shard_map(fn, mesh, in_specs, out_specs, **kw):
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

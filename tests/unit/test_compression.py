@@ -134,7 +134,7 @@ def test_scheduler_bit_ramp():
                        "quantization_period": 10},
             "modules": ["attn"]}}}}}
     ctx = C.init_compression(_toy_params(), cfg)
-    sched = C.CompressionScheduler(ctx, cfg)
+    sched = C.CompressionScheduler(ctx)
     sched.step(0)
     assert ctx.plans[0].bits == 16
     sched.step(10)

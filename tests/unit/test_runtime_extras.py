@@ -1,0 +1,185 @@
+"""Tests for TiledLinear, Domino, PLD, eigenvalue, MoQ, sparse grads
+(reference: tests/unit/runtime/{test_pld,...}, ops tiling tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.runtime.domino import DominoTransformerLayer, domino_chunked
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue, hvp
+from deepspeed_tpu.runtime.progressive_layer_drop import (ProgressiveLayerDrop,
+                                                          pld_apply)
+from deepspeed_tpu.runtime.quantize import MoQQuantizer, WeightQuantization
+from deepspeed_tpu.runtime.sparse_tensor import (SparseTensor, from_dense,
+                                                 sparse_all_reduce)
+from deepspeed_tpu.runtime.zero.tiling import TiledLinear, tiled_matmul
+
+
+# ---------------------------------------------------------------------------
+# TiledLinear
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("in_splits,out_splits,remat",
+                         [(1, 4, False), (2, 2, True), (4, 1, False)])
+def test_tiled_matmul_matches_dense(in_splits, out_splits, remat):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(3, 16)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+    y = tiled_matmul(x, w, out_splits, in_splits, remat)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x @ w), rtol=1e-5)
+    # gradients flow through tiles
+    g = jax.grad(lambda w: jnp.sum(tiled_matmul(x, w, out_splits, in_splits,
+                                                remat)))(w)
+    np.testing.assert_allclose(np.asarray(g),
+                               np.asarray(jax.grad(lambda w: jnp.sum(x @ w))(w)),
+                               rtol=1e-5)
+
+
+def test_tiled_linear_module():
+    m = TiledLinear(in_features=8, out_features=12, in_splits=2, out_splits=3)
+    x = jnp.ones((2, 8))
+    params = m.init(jax.random.PRNGKey(0), x)["params"]
+    y = m.apply({"params": params}, x)
+    ref = x @ params["kernel"] + params["bias"]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5)
+    with pytest.raises(ValueError, match="not divisible"):
+        tiled_matmul(x, params["kernel"], out_splits=5)
+
+
+# ---------------------------------------------------------------------------
+# Domino
+# ---------------------------------------------------------------------------
+
+
+def test_domino_chunked_equivalence():
+    w = jnp.asarray(np.random.default_rng(1).normal(size=(8, 8)).astype(np.float32))
+    fn = lambda x: jnp.tanh(x @ w)
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(6, 8)).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(domino_chunked(fn, x, 2)),
+                               np.asarray(fn(x)), rtol=1e-6)
+    # indivisible batch falls back to unchunked
+    x5 = x[:5]
+    np.testing.assert_allclose(np.asarray(domino_chunked(fn, x5, 2)),
+                               np.asarray(fn(x5)), rtol=1e-6)
+    layer = DominoTransformerLayer(lambda x, s: x * s, num_chunks=2)
+    np.testing.assert_allclose(np.asarray(layer(x, 2.0)), np.asarray(x * 2.0))
+
+
+# ---------------------------------------------------------------------------
+# progressive layer drop
+# ---------------------------------------------------------------------------
+
+
+def test_pld_theta_schedule():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta(0) == pytest.approx(1.0)
+    assert pld.get_theta(10**6) == pytest.approx(0.5)
+    mid = pld.get_theta(100)
+    assert 0.5 < mid < 1.0
+    pld.update_state(100)
+    assert pld.get_state()["pld_theta"] == pytest.approx(mid)
+    # deeper layers drop more
+    assert pld.keep_prob(1, 12) > pld.keep_prob(11, 12)
+
+
+def test_pld_apply_semantics():
+    layer = lambda x: x + 1.0  # residual contribution = 1
+    x = jnp.zeros((4, 4))
+    # deterministic: always applied
+    out = pld_apply(layer, x, jax.random.PRNGKey(0), keep_prob=0.3,
+                    deterministic=True)
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+    # stochastic: either skipped (0) or scaled (1/keep_prob)
+    outs = {float(np.asarray(pld_apply(layer, x, jax.random.PRNGKey(s), 0.5))[0, 0])
+            for s in range(20)}
+    assert outs <= {0.0, 2.0} and len(outs) == 2
+
+
+# ---------------------------------------------------------------------------
+# eigenvalue
+# ---------------------------------------------------------------------------
+
+
+def test_eigenvalue_quadratic():
+    """For loss = 0.5 x^T A x the Hessian is A; power iteration finds the
+    dominant eigenvalue."""
+    a = jnp.diag(jnp.asarray([5.0, 2.0, 1.0]))
+
+    def loss(params, batch):
+        x = params["x"]
+        return 0.5 * x @ a @ x
+
+    params = {"x": jnp.asarray([1.0, 1.0, 1.0])}
+    hv = hvp(loss, params, None, {"x": jnp.asarray([1.0, 0.0, 0.0])})
+    np.testing.assert_allclose(np.asarray(hv["x"]), [5.0, 0.0, 0.0], atol=1e-5)
+    eig = Eigenvalue(max_iter=50, tol=1e-4).compute_eigenvalue(loss, params, None)
+    assert eig == pytest.approx(5.0, rel=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# MoQ
+# ---------------------------------------------------------------------------
+
+
+def test_moq_schedule_and_eigen_modulation():
+    q = MoQQuantizer(start_bits=16, target_bits=4, quantize_period=10,
+                     eigenvalue_scale={"sharp": 2.0})
+    assert q.bits_at(0) == 16
+    assert q.bits_at(10) == 8
+    assert q.bits_at(20) == 4
+    assert q.bits_at(1000) == 4
+    # sharp layer quantizes later (doubled period)
+    assert q.bits_at(10, key="sharp") == 16
+    assert q.bits_at(20, key="sharp") == 8
+    assert issubclass(WeightQuantization, MoQQuantizer)
+
+
+def test_moq_quantize_params():
+    q = MoQQuantizer(start_bits=8, target_bits=8, quantize_period=0)
+    params = {"w": jnp.asarray(np.random.default_rng(0)
+                               .normal(size=(8, 8)).astype(np.float32)),
+              "b": jnp.zeros((8,))}
+    out = q.quantize(params, step=100)
+    assert not np.array_equal(np.asarray(out["w"]), np.asarray(params["w"]))
+    np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(params["w"]),
+                               atol=0.05)
+    np.testing.assert_array_equal(np.asarray(out["b"]), 0)  # 1-D untouched
+
+
+# ---------------------------------------------------------------------------
+# sparse gradients
+# ---------------------------------------------------------------------------
+
+
+def test_sparse_tensor_roundtrip():
+    dense = jnp.zeros((10, 4)).at[jnp.asarray([2, 7])].set(
+        jnp.asarray([[1.0, 2, 3, 4], [5, 6, 7, 8]]))
+    st = from_dense(dense, max_rows=3)
+    assert st.sparse_size == 12  # vs dense 40
+    np.testing.assert_allclose(np.asarray(st.to_dense()), np.asarray(dense))
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 devices")
+def test_sparse_all_reduce_matches_dense():
+    ndev = 4
+    mesh = Mesh(np.array(jax.devices()[:ndev]), ("dp",))
+    rng = np.random.default_rng(0)
+    # per-rank embedding grads touching few rows
+    dense = np.zeros((ndev, 16, 4), np.float32)
+    for r in range(ndev):
+        rows = rng.choice(16, size=2, replace=False)
+        dense[r, rows] = rng.normal(size=(2, 4))
+    expected = dense.mean(axis=0)
+
+    def body(g):
+        st = from_dense(g[0], max_rows=4)
+        return sparse_all_reduce(st, "dp")[None]
+
+    from deepspeed_tpu.utils.shard_map_compat import shard_map_nocheck
+
+    out = jax.jit(shard_map_nocheck(body, mesh, in_specs=P("dp"),
+                                    out_specs=P("dp")))(jnp.asarray(dense))
+    np.testing.assert_allclose(np.asarray(out)[0], expected, rtol=1e-5)
