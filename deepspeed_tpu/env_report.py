@@ -35,6 +35,8 @@ def op_compatibility() -> List[Tuple[str, bool, str]]:
           lambda: importlib.import_module("deepspeed_tpu.ops.pallas.quant"))
     probe("optimizers (adam/lamb/lion/adagrad)",
           lambda: importlib.import_module("deepspeed_tpu.ops.optimizers"))
+    probe("fp_quantizer (fp8/fp6/fp12)",
+          lambda: importlib.import_module("deepspeed_tpu.ops.fp_quantizer"))
 
     def _aio():
         from deepspeed_tpu.ops.aio import AsyncIOBuilder

@@ -1,0 +1,117 @@
+"""FP quantization: float8 / arbitrary exponent-mantissa formats (FP6-LLM).
+
+Reference: ``csrc/fp_quantizer/*`` + ``ops/fp_quantizer/`` — "quantize to
+selective bits" for weights/KV (FP6 e3m2, FP8 e4m3/e5m2, FP12). TPU-native:
+fp8 uses the MXU-supported ml_dtypes formats directly (a hardware cast);
+other formats round the fp32 mantissa with bit arithmetic — pure jnp, XLA
+fuses it into the surrounding matmul. Per-block max scaling keeps dynamic
+range (the reference's group-scale layout).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_FP8_FORMATS = {
+    "e4m3": jnp.float8_e4m3fn,
+    "e5m2": jnp.float8_e5m2,
+}
+# max representable magnitude per format
+_FP8_MAX = {"e4m3": 448.0, "e5m2": 57344.0}
+
+
+def _block_view(x: jnp.ndarray, block: int) -> Tuple[jnp.ndarray, int, tuple]:
+    shape = x.shape
+    n = int(np.prod(shape)) if shape else 1
+    nb = -(-n // block)
+    flat = jnp.pad(jnp.ravel(x).astype(jnp.float32), (0, nb * block - n))
+    return flat.reshape(nb, block), n, shape
+
+
+def fp8_quantize(x: jnp.ndarray, fmt: str = "e4m3", block: int = 512
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, tuple]:
+    """Blockwise-scaled cast to fp8. Returns (q [nb, block] fp8,
+    scales [nb, 1] fp32, original shape)."""
+    if fmt not in _FP8_FORMATS:
+        raise ValueError(f"fmt must be one of {sorted(_FP8_FORMATS)}")
+    xb, n, shape = _block_view(x, block)
+    amax = jnp.max(jnp.abs(xb), axis=1, keepdims=True)
+    scale = jnp.where(amax == 0, 1.0, amax / _FP8_MAX[fmt])
+    q = (xb / scale).astype(_FP8_FORMATS[fmt])
+    return q, scale, shape
+
+
+def fp8_dequantize(q: jnp.ndarray, scale: jnp.ndarray, shape,
+                   dtype=jnp.float32) -> jnp.ndarray:
+    n = int(np.prod(shape)) if shape else 1
+    x = q.astype(jnp.float32) * scale
+    return x.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+def quantize_to_fp(x: jnp.ndarray, exp_bits: int, man_bits: int,
+                   block: int = 512) -> jnp.ndarray:
+    """Fake-quantize fp32 to a (1, exp_bits, man_bits) float format with
+    round-to-nearest-even mantissa truncation (the FP6-LLM e3m2 / FP12 path).
+    Values are blockwise pre-scaled into the format's range, so the result is
+    faithful to bit-packed storage + per-block scales."""
+    if exp_bits < 2 or man_bits < 1 or exp_bits + man_bits > 22:
+        raise ValueError(f"unsupported format e{exp_bits}m{man_bits}")
+    xb, n, shape = _block_view(x, block)
+    # scale into range: max magnitude of the format
+    emax = 2 ** (exp_bits - 1)  # unbiased max exponent (with inf-free top)
+    fmax = (2.0 - 2.0 ** (-man_bits)) * (2.0 ** (emax - 1))
+    amax = jnp.max(jnp.abs(xb), axis=1, keepdims=True)
+    scale = jnp.where(amax == 0, 1.0, amax / fmax)
+    scaled = xb / scale
+
+    # round-to-nearest-even mantissa truncation via integer bit ops
+    drop = 23 - man_bits
+    bits = jax.lax.bitcast_convert_type(scaled, jnp.uint32)
+    half = jnp.uint32(1 << (drop - 1))
+    lsb = (bits >> drop) & 1
+    rounded = bits + half - 1 + lsb
+    bits = (rounded >> drop) << drop
+    trunc = jax.lax.bitcast_convert_type(bits, jnp.float32)
+    # clamp exponent range: flush sub-minimal to 0, saturate overflow
+    emin = 2 - emax
+    tiny = 2.0 ** emin
+    trunc = jnp.where(jnp.abs(trunc) < tiny * 0.5, 0.0, trunc)
+    trunc = jnp.clip(trunc, -fmax, fmax)
+    out = trunc * scale
+    return out.reshape(-1)[:n].reshape(shape).astype(x.dtype)
+
+
+def fp6_quantize(x: jnp.ndarray, block: int = 512) -> jnp.ndarray:
+    """FP6 e3m2 fake-quant (FP6-LLM weight format)."""
+    return quantize_to_fp(x, exp_bits=3, man_bits=2, block=block)
+
+
+def fp12_quantize(x: jnp.ndarray, block: int = 512) -> jnp.ndarray:
+    """FP12 e5m6 fake-quant (reference fp_quantizer's 12-bit KV mode)."""
+    return quantize_to_fp(x, exp_bits=5, man_bits=6, block=block)
+
+
+class FPQuantizer:
+    """Reference-shaped class API (``ops/fp_quantizer``): quantize/dequantize
+    pairs keyed by q_bits."""
+
+    def __init__(self, q_bits: int = 8, fmt: str = "e4m3", block: int = 512):
+        self.q_bits = q_bits
+        self.fmt = fmt
+        self.block = block
+
+    def quantize(self, x):
+        if self.q_bits == 8:
+            return fp8_quantize(x, self.fmt, self.block)
+        if self.q_bits == 6:
+            return fp6_quantize(x, self.block), None, x.shape
+        if self.q_bits == 12:
+            return fp12_quantize(x, self.block), None, x.shape
+        raise ValueError(f"unsupported q_bits {self.q_bits} (8, 6, 12)")
+
+    def dequantize(self, q, scale, shape, dtype=jnp.float32):
+        if self.q_bits == 8:
+            return fp8_dequantize(q, scale, shape, dtype)
+        return q.astype(dtype)  # 6/12-bit paths return fake-quant values

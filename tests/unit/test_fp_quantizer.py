@@ -1,0 +1,73 @@
+"""FP quantizer tests (reference: tests/unit/ops/fp_quantizer/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.fp_quantizer import (FPQuantizer, fp6_quantize,
+                                            fp8_dequantize, fp8_quantize,
+                                            fp12_quantize, quantize_to_fp)
+
+
+@pytest.mark.parametrize("fmt,rtol", [("e4m3", 0.07), ("e5m2", 0.15)])
+def test_fp8_roundtrip(fmt, rtol):
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(64, 32)).astype(np.float32))
+    q, scale, shape = fp8_quantize(x, fmt=fmt, block=256)
+    assert q.dtype in (jnp.float8_e4m3fn, jnp.float8_e5m2)
+    back = fp8_dequantize(q, scale, shape)
+    assert back.shape == x.shape
+    err = np.abs(np.asarray(back) - np.asarray(x)).max() / np.abs(np.asarray(x)).max()
+    assert err < rtol
+
+
+def test_fp8_extreme_ranges():
+    # per-block scaling handles magnitudes far outside native fp8 range
+    # (one tiny-valued block, one huge-valued block)
+    x = jnp.asarray([1e-6, 2e-6, -3e-6, 1.5e-6, 4e6, -5e6, 6e6, 4.5e6],
+                    jnp.float32)
+    q, s, shape = fp8_quantize(x, block=4)
+    back = np.asarray(fp8_dequantize(q, s, shape))
+    np.testing.assert_allclose(back[:4], np.asarray(x)[:4], rtol=0.1)
+    np.testing.assert_allclose(back[4:], np.asarray(x)[4:], rtol=0.1)
+
+
+def test_fp6_precision_ordering():
+    """More mantissa bits -> lower error: fp12 < fp8-sim < fp6."""
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(1024,)).astype(np.float32))
+
+    def err(y):
+        return float(np.abs(np.asarray(y) - np.asarray(x)).mean())
+
+    e6 = err(fp6_quantize(x))
+    e8 = err(quantize_to_fp(x, 4, 3))
+    e12 = err(fp12_quantize(x))
+    assert e12 < e8 < e6
+    assert e6 > 0  # actually quantizing
+
+
+def test_quantize_to_fp_levels():
+    # e3m2: few distinct mantissa levels per binade
+    x = jnp.linspace(0.5, 1.0, 100)
+    q = np.unique(np.asarray(quantize_to_fp(x, 3, 2, block=128)))
+    assert len(q) <= 10
+
+
+def test_quantize_validation():
+    with pytest.raises(ValueError):
+        quantize_to_fp(jnp.ones(4), exp_bits=1, man_bits=2)
+    with pytest.raises(ValueError):
+        fp8_quantize(jnp.ones(4), fmt="e9m9")
+
+
+def test_fpquantizer_class():
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(32, 8)).astype(np.float32))
+    for bits in (8, 6, 12):
+        fq = FPQuantizer(q_bits=bits)
+        q, scale, shape = fq.quantize(x)
+        back = fq.dequantize(q, scale, shape)
+        assert back.shape == x.shape
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                                   atol=0.3 * float(jnp.abs(x).max()))
+    with pytest.raises(ValueError):
+        FPQuantizer(q_bits=3).quantize(x)
