@@ -44,6 +44,60 @@ def model_flops_per_token(cfg, seq: int, n_params: int) -> float:
     return 6.0 * n_params + 12.0 * cfg.num_layers * cfg.hidden_size * seq
 
 
+def comm_bandwidth():
+    """Second north-star (BASELINE.json): ZeRO-3 allgather busbw over ICI.
+
+    With >1 device, times a tiled ``all_gather`` over the mesh (the ZeRO-3
+    param-gather pattern, same op as ``bin/ds_bench``). On a single chip no
+    interconnect exists, so report achieved HBM copy bandwidth instead — the
+    bound a 1-chip "gather" actually hits. Iterations are chained through a
+    carry so XLA cannot hoist or CSE the collective, and the queue is drained
+    by one host read (remote-attached TPUs don't sync in block_until_ready).
+    """
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = np.array(jax.devices())
+    n = len(devs)
+    count = 64 * 2**20  # 64Mi bf16 elements = 128 MiB gathered
+    count = (count // max(n, 1)) * max(n, 1)
+    x = jnp.ones((count,), jnp.bfloat16)
+
+    def make(reps):
+        if n > 1:
+            mesh = Mesh(devs, ("x",))
+
+            def loop(shard):
+                def body(c, _):
+                    full = jax.lax.all_gather(c, "x", tiled=True)  # [count]
+                    return full[: c.shape[0]] + jnp.bfloat16(1e-3), ()
+                c, _ = jax.lax.scan(body, shard, None, length=reps)
+                return c[0]
+
+            return jax.jit(jax.shard_map(loop, mesh=mesh, in_specs=P("x"),
+                                         out_specs=P(), check_vma=False))
+
+        def f_body(x):
+            def body(c, _):
+                return c + jnp.bfloat16(1.0), ()
+            c, _ = jax.lax.scan(body, x, None, length=reps)
+            return c[0]
+        return jax.jit(f_body)
+
+    # difference two rep counts to cancel the fixed dispatch+sync RTT
+    lo, hi = 10, 110
+    f_lo, f_hi = make(lo), make(hi)
+    float(f_lo(x)); float(f_hi(x))  # compile + drain
+    t0 = time.perf_counter(); float(f_lo(x)); t_lo = time.perf_counter() - t0
+    t0 = time.perf_counter(); float(f_hi(x)); t_hi = time.perf_counter() - t0
+    dt = (t_hi - t_lo) / (hi - lo)
+    nbytes = count * 2
+    if n > 1:
+        busbw = nbytes * (n - 1) / n / dt / 1e9
+        return {"allgather_busbw_gbps": round(busbw, 1), "allgather_devices": n}
+    # read + write per element
+    return {"hbm_copy_gbps": round(2 * nbytes / dt / 1e9, 1), "allgather_devices": 1}
+
+
 def main():
     import deepspeed_tpu as ds
     from deepspeed_tpu.models.transformer import (TransformerLM, init_params,
@@ -53,12 +107,19 @@ def main():
     on_tpu = dev.platform == "tpu"
 
     if on_tpu:
-        # ~460M-param Llama shape: fits one chip with fp32 master + Adam state
+        # ~460M-param Llama shape: fits one chip with fp32 master + Adam state.
+        # No remat at batch 6: activations fit v5e HBM alongside the optimizer
+        # state and recompute-free bwd beats block remat by ~20% (measured:
+        # 0.72 vs 0.59 MFU).
+        import os
+        remat = os.environ.get("BENCH_REMAT", "0") != "0"
+        policy = os.environ.get("BENCH_POLICY", "") or None
         cfg = llama_config("7b", num_layers=12, hidden_size=1536,
                            intermediate_size=4096, num_heads=12, num_kv_heads=12,
                            vocab_size=32000, max_seq_len=2048, dtype=jnp.bfloat16,
-                           remat=True)
-        batch, seq, steps, warmup = 8, 2048, 20, 3
+                           remat=remat, remat_policy=policy)
+        batch = int(os.environ.get("BENCH_BATCH", "6"))
+        seq, steps, warmup = 2048, 30, 3
     else:
         cfg = llama_config("7b", num_layers=2, hidden_size=128,
                            intermediate_size=256, num_heads=4, num_kv_heads=4,
@@ -78,26 +139,32 @@ def main():
                 "gradient_clipping": 1.0,
                 "steps_per_print": 10**9})
 
+    # Pre-stage batches on device: per-step host RNG + H2D transfers would
+    # serialize the async dispatch pipeline (a full RTT each on
+    # remote-attached TPUs). Same reason the final sync is a host read of the
+    # last loss, not block_until_ready (which doesn't drain remote queues).
     rng = np.random.default_rng(0)
-    def make_batch():
-        toks = rng.integers(0, cfg.vocab_size, size=(batch, seq))
-        return {"tokens": jnp.asarray(toks, jnp.int32)}
+    batches = [{"tokens": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(batch, seq)), jnp.int32)}
+        for _ in range(8)]
 
-    for _ in range(warmup):  # compile + settle
-        engine.train_batch(make_batch())
-    jax.block_until_ready(engine.state.params)
+    for i in range(warmup):  # compile + settle
+        loss = engine.train_batch(batches[i % len(batches)])
+    float(loss)  # drain the queue
 
     t0 = time.perf_counter()
     loss = None
-    for _ in range(steps):
-        loss = engine.train_batch(make_batch())
-    jax.block_until_ready(engine.state.params)
+    for i in range(steps):
+        loss = engine.train_batch(batches[i % len(batches)])
+    final_loss = float(loss)  # device steps are ordered: last done => all done
     dt = time.perf_counter() - t0
 
     n_chips = len(jax.devices())
     tokens_per_sec = batch * seq * steps / dt / n_chips  # per-chip
     flops = model_flops_per_token(cfg, seq, n_params) * tokens_per_sec
     mfu = flops / peak_flops(dev)
+
+    comm = comm_bandwidth()
 
     print(json.dumps({
         "metric": "llama_zero3_bf16_mfu" if on_tpu else "llama_zero3_mfu_cpu_smoke",
@@ -107,7 +174,8 @@ def main():
         "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
         "n_params": n_params,
         "device": getattr(dev, "device_kind", dev.platform),
-        "final_loss": float(loss) if loss is not None else None,
+        "final_loss": final_loss,
+        **comm,
     }))
 
 

@@ -165,8 +165,10 @@ class DeepSpeedTPUEngine:
         self._eval_fn = None
 
         self.global_steps = 0
-        self.skipped_steps = 0
-        self._last_metrics: Dict[str, float] = {}
+        self._skipped_base = 0
+        self._skipped_dev = jnp.zeros([], jnp.int32)
+        self._metrics_dev: Optional[Dict[str, Any]] = None
+        self._metrics_host: Optional[Dict[str, float]] = {}
         self.monitor = None
         if any(m.enabled for m in (config.monitor.tensorboard, config.monitor.wandb,
                                    config.monitor.csv_monitor)):
@@ -388,11 +390,14 @@ class DeepSpeedTPUEngine:
         t0 = time.perf_counter()
         self.state, metrics = step_fn(self.state, batch, step_rng)
         self.global_steps += 1
-        metrics = {k: np.asarray(v) for k, v in metrics.items()}
-        if bool(metrics.pop("overflow", False)):
-            self.skipped_steps += 1
-            metrics["skipped"] = 1.0
-        self._last_metrics = {k: float(v) for k, v in metrics.items()}
+        # Metrics stay on device; ``_last_metrics`` converts lazily. A per-step
+        # device->host sync here would serialize the async dispatch pipeline
+        # (one full RTT per step on remote-attached TPUs). Overflow-skip
+        # accounting is a device-side counter for the same reason.
+        self._metrics_dev = metrics
+        self._metrics_host = None
+        if self.fp16:
+            self._skipped_dev = self._skipped_dev + metrics["overflow"].astype(jnp.int32)
         self._step_times.append(time.perf_counter() - t0)
         self._maybe_report()
         at = self.config.autotuning
@@ -406,7 +411,7 @@ class DeepSpeedTPUEngine:
                 times = self._step_times[max(0, start):]
                 dt = float(np.mean(times)) if times else float("inf")
                 report_autotune_result(self.train_batch_size / dt)
-        return self._last_metrics["loss"]
+        return metrics["loss"]
 
     def eval_batch(self, batch, compute_loss: bool = True):
         if self._eval_fn is None:
@@ -554,6 +559,27 @@ class DeepSpeedTPUEngine:
         return prof.total_flops
 
     # ------------------------------------------------------------------
+    @property
+    def _last_metrics(self) -> Dict[str, float]:
+        """Host view of the latest step metrics (syncs on first access)."""
+        if self._metrics_host is None:
+            m = {k: float(np.asarray(v)) for k, v in self._metrics_dev.items()}
+            if m.pop("overflow", 0.0):
+                m["skipped"] = 1.0
+            self._metrics_host = m
+        return self._metrics_host
+
+    @property
+    def skipped_steps(self) -> int:
+        """fp16 overflow-skipped step count (reference ``engine.skipped_steps``).
+        Reads a device-side counter, so accessing it synchronizes."""
+        return self._skipped_base + int(self._skipped_dev)
+
+    @skipped_steps.setter
+    def skipped_steps(self, value: int):
+        self._skipped_base = int(value)
+        self._skipped_dev = jnp.zeros([], jnp.int32)
+
     @property
     def loss_scale(self) -> float:
         return float(np.asarray(self.state.loss_scale.scale))
