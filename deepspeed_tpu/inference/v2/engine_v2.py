@@ -18,7 +18,7 @@ import numpy as np
 
 from ...models.transformer import TransformerConfig, TransformerLM
 from ...utils.logging import log_dist
-from .model import ragged_forward
+from .model import decode_loop, ragged_step
 from .ragged.kv_cache import BlockedKVCache
 from .ragged.ragged_manager import DSStateManager
 from .ragged.ragged_wrapper import RaggedBatch, RaggedBatchWrapper
@@ -38,6 +38,11 @@ class RaggedInferenceEngineConfig:
     greedy: bool = True
     temperature: float = 1.0
     seed: int = 0
+    # "auto": Pallas paged kernel on TPU, einsum reference path on CPU.
+    attn_backend: str = "auto"    # auto | pallas | einsum
+    # decode iterations fused into one compiled program by decode_batch()
+    # (one host round-trip per chunk instead of per token)
+    decode_chunk: int = 16
 
 
 class InferenceEngineV2:
@@ -58,7 +63,12 @@ class InferenceEngineV2:
                                           max_seqs=c.max_ragged_sequence_count,
                                           max_chunk=c.max_chunk_size,
                                           max_blocks_per_seq=c.max_blocks_per_seq)
-        self._rng = np.random.default_rng(c.seed)
+        self._key = jax.random.PRNGKey(c.seed)
+        if c.attn_backend == "auto":
+            self.attn_impl = ("pallas" if jax.default_backend() == "tpu"
+                              else "einsum")
+        else:
+            self.attn_impl = c.attn_backend
         self.steps = 0
         self.last_num_scheduled = 0
         log_dist(f"inference v2: budget={c.token_budget} seqs={c.max_ragged_sequence_count} "
@@ -162,19 +172,23 @@ class InferenceEngineV2:
         if not scheduled:
             return {}
         batch = self.wrapper.pack(scheduled, self.config.kv_block_size)
-        logits, new_k, new_v = ragged_forward(
+        self._key, step_key = jax.random.split(self._key)
+        sampled, new_k, new_v = ragged_step(
             self.params, self.cfg, self.kv.k, self.kv.v,
             jnp.asarray(batch.tokens), jnp.asarray(batch.positions),
             jnp.asarray(batch.gather_idx), jnp.asarray(batch.block_table),
-            jnp.asarray(batch.kv_len), jnp.asarray(batch.logits_idx))
+            jnp.asarray(batch.kv_len), jnp.asarray(batch.logits_idx),
+            jnp.asarray(batch.start_pos), jnp.asarray(batch.chunk_len),
+            step_key, jnp.float32(self.config.temperature),
+            attn_impl=self.attn_impl, greedy=self.config.greedy)
         self.kv.update(new_k, new_v)
-        logits = np.asarray(logits)
+        sampled = np.asarray(sampled)    # [S] int32 — the only D2H transfer
         out: Dict[int, int] = {}
         for s, (seq, toks) in enumerate(scheduled):
             seq.seen_tokens += len(toks)
         for s in batch.sample_slots:
             seq, _ = scheduled[s]
-            tok = self._sample(logits[s])
+            tok = int(sampled[s])
             seq.generated.append(tok)
             out[seq.uid] = tok
             if ((seq.eos_token_id is not None and tok == seq.eos_token_id)
@@ -183,25 +197,148 @@ class InferenceEngineV2:
         self.steps += 1
         return out
 
-    def _sample(self, row: np.ndarray) -> int:
-        if self.config.greedy:
-            return int(row.argmax())
-        z = row / max(self.config.temperature, 1e-6)
-        z = z - z.max()
-        p = np.exp(z) / np.exp(z).sum()
-        return int(self._rng.choice(len(row), p=p))
+    def decode_batch(self, n_steps: Optional[int] = None) -> Dict[int, List[int]]:
+        """Fused multi-token decode: ``n`` forward+sample iterations for every
+        active sequence in ONE compiled program (``model.decode_loop``).
+
+        Requires all active sequences to be past prefill (use ``step()`` for
+        mixed prefill/decode batches). Returns {uid: accepted tokens}.
+        """
+        c = self.config
+        seqs = [s for s in self.state_manager.all() if not s.done]
+        if not seqs:
+            return {}
+        if any(s.in_prefill or not s.generated for s in seqs):
+            raise RuntimeError("decode_batch requires every active sequence "
+                               "past prefill with a first sampled token")
+        if len(seqs) > c.max_ragged_sequence_count:
+            raise RuntimeError(f"{len(seqs)} active sequences > "
+                               f"max_ragged_sequence_count {c.max_ragged_sequence_count}")
+        n = min(n_steps or c.decode_chunk,
+                min(s.max_new_tokens - len(s.generated) for s in seqs))
+        if n < 1:
+            return {}
+        S, B = c.max_ragged_sequence_count, c.max_blocks_per_seq
+        tokens0 = np.zeros((S,), np.int32)
+        pos0 = np.zeros((S,), np.int32)
+        bt = np.zeros((S, B), np.int32)
+        active = np.zeros((S,), bool)
+        for slot, seq in enumerate(seqs):
+            self.kv.reserve(seq, n)
+            tokens0[slot] = seq.generated[-1]
+            pos0[slot] = seq.seen_tokens
+            bt[slot, :len(seq.blocks)] = seq.blocks
+            active[slot] = True
+        self._key, step_key = jax.random.split(self._key)
+        toks, new_k, new_v = decode_loop(
+            self.params, self.cfg, self.kv.k, self.kv.v,
+            jnp.asarray(tokens0), jnp.asarray(pos0), jnp.asarray(bt),
+            jnp.asarray(active), step_key, jnp.float32(c.temperature),
+            n_steps=n, attn_impl=self.attn_impl, greedy=c.greedy)
+        self.kv.update(new_k, new_v)
+        toks = np.asarray(toks)                     # [S, n]
+        out: Dict[int, List[int]] = {}
+        for slot, seq in enumerate(seqs):
+            accepted: List[int] = []
+            for t in toks[slot, :n]:
+                accepted.append(int(t))
+                if ((seq.eos_token_id is not None and int(t) == seq.eos_token_id)
+                        or len(seq.generated) + len(accepted) >= seq.max_new_tokens):
+                    seq.done = True
+                    break
+            seq.generated.extend(accepted)
+            seq.seen_tokens += n                    # n tokens entered the KV cache
+            out[seq.uid] = accepted
+        self.steps += 1
+        return out
+
+    def decode_stream(self, total_steps: int) -> Dict[int, List[int]]:
+        """Fused decode of ``total_steps`` tokens in ONE dispatch + ONE host
+        sync (``model.decode_loop`` scans the whole run on device). On
+        remote-attached TPUs each dispatch costs a round-trip, so batch
+        generation wants exactly one.
+
+        Generates ``min(total_steps, min remaining)`` tokens, rounded UP to a
+        ``decode_chunk`` multiple when KV capacity allows — ``n_steps`` is a
+        static jit argument, so rounding keeps repeated calls with staggered
+        remaining-counts on ONE compiled program instead of recompiling the
+        whole scanned model per distinct count. Tokens past a sequence's EOS
+        or ``max_new_tokens`` are discarded on host.
+        """
+        c = self.config
+        seqs = [s for s in self.state_manager.all() if not s.done]
+        if not seqs:
+            return {}
+        if any(s.in_prefill or not s.generated for s in seqs):
+            raise RuntimeError("decode_stream requires every active sequence "
+                               "past prefill with a first sampled token")
+        total = min(total_steps,
+                    min(s.max_new_tokens - len(s.generated) for s in seqs))
+        if total < 1:
+            return {}
+        S, B = c.max_ragged_sequence_count, c.max_blocks_per_seq
+        bs = c.kv_block_size
+        # bucket n_steps (see docstring); cap by per-seq block-table capacity
+        # and by the free-block pool, falling back to the exact count
+        bucket = -(-total // c.decode_chunk) * c.decode_chunk
+        cap = min(B * bs - s.seen_tokens for s in seqs)
+        n = min(bucket, cap)
+        need = sum(s.blocks_needed(n, bs) for s in seqs)
+        if need > self.kv.free_blocks:
+            n = total
+        tokens0 = np.zeros((S,), np.int32)
+        pos0 = np.zeros((S,), np.int32)
+        bt = np.zeros((S, B), np.int32)
+        active = np.zeros((S,), bool)
+        for slot, seq in enumerate(seqs):
+            self.kv.reserve(seq, n)
+            tokens0[slot] = seq.generated[-1]
+            pos0[slot] = seq.seen_tokens
+            bt[slot, :len(seq.blocks)] = seq.blocks
+            active[slot] = True
+        self._key, step_key = jax.random.split(self._key)
+        toks, new_k, new_v = decode_loop(
+            self.params, self.cfg, self.kv.k, self.kv.v,
+            jnp.asarray(tokens0), jnp.asarray(pos0), jnp.asarray(bt),
+            jnp.asarray(active), step_key, jnp.float32(c.temperature),
+            n_steps=n, attn_impl=self.attn_impl, greedy=c.greedy)
+        self.kv.update(new_k, new_v)
+        self.steps += 1
+        all_toks = np.asarray(toks)                 # [S, n]
+        out: Dict[int, List[int]] = {}
+        for slot, seq in enumerate(seqs):
+            accepted: List[int] = []
+            for t in all_toks[slot, :n]:
+                accepted.append(int(t))
+                if ((seq.eos_token_id is not None and int(t) == seq.eos_token_id)
+                        or len(seq.generated) + len(accepted) >= seq.max_new_tokens):
+                    seq.done = True
+                    break
+            seq.generated.extend(accepted)
+            seq.seen_tokens += n        # every scanned token entered the KV
+            out[seq.uid] = accepted
+        return out
 
     # ------------------------------------------------------------------
     def generate(self, prompts: Sequence[np.ndarray], max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None) -> List[np.ndarray]:
-        """Convenience batch API over the continuous engine."""
+        """Convenience batch API over the continuous engine: SplitFuse steps
+        through prefill, then fused decode chunks."""
         uids = list(range(len(prompts)))
         self.put(uids, prompts, max_new_tokens=max_new_tokens,
                  eos_token_id=eos_token_id)
-        while any(not self.query(u)[0] for u in uids):
+        while any(s.in_prefill for s in self.state_manager.all() if not s.done):
             self.step()
             if self.last_num_scheduled == 0:
-                break  # nothing left to schedule (not merely a chunk-only step)
+                break
+        while any(not self.query(u)[0] for u in uids):
+            if eos_token_id is None:
+                # no early exit possible: chain all remaining chunks with one
+                # host sync (decode_stream never overshoots in this case)
+                if not self.decode_stream(max_new_tokens):
+                    break
+            elif not self.decode_batch():
+                break
         outs = [self.query(u)[1] for u in uids]
         for u in uids:
             self.flush(u)

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.transformer import TransformerConfig, rope_table
+from ...ops.pallas.paged_attention import paged_attention as paged_attention_pallas
 
 
 def _rms_norm(x, scale, eps):
@@ -56,6 +57,33 @@ def _dense(p, x):
     return out
 
 
+def _qkv(cfg, ap, y, rope_cs, positions):
+    """Shared q/k/v projection + rotary for the packed and decode paths."""
+    qt = _dense(ap["q_proj"], y)                # [T, Hq, D]
+    kt = _dense(ap["k_proj"], y)                # [T, Hk, D]
+    vt = _dense(ap["v_proj"], y)
+    if cfg.position == "rope":
+        cos, sin = rope_cs
+        qt = _rope(qt, cos, sin, positions)
+        kt = _rope(kt, cos, sin, positions)
+    return qt, kt, vt
+
+
+def _mlp(cfg, mp, y):
+    if cfg.activation == "swiglu":
+        hid = jax.nn.silu(_dense(mp["gate_proj"], y)) * _dense(mp["up_proj"], y)
+    else:
+        hid = jax.nn.gelu(_dense(mp["up_proj"], y))
+    return _dense(mp["down_proj"], hid)
+
+
+def _lm_logits(cfg, params, h_sel):
+    h_sel = h_sel.astype(jnp.float32)
+    if cfg.tie_embeddings:
+        return h_sel @ params["embed"]["embedding"].astype(jnp.float32).T
+    return h_sel @ params["lm_head"]["kernel"].astype(jnp.float32)
+
+
 def _rope(x, cos, sin, positions):
     """x: [T, H, D]; positions: [T]."""
     cos_p = cos[positions][:, None, :]
@@ -68,19 +96,19 @@ def _rope(x, cos, sin, positions):
 def paged_attention(qg, k_pool, v_pool, block_table, positions_g, q_valid, kv_len):
     """Grouped paged attention.
 
-    qg: [S, Q, Hq, D] grouped queries; k/v_pool: [N, bs, Hk, D] this layer's
-    pages; block_table: [S, B]; positions_g: [S, Q] absolute positions;
-    q_valid: [S, Q] bool; kv_len: [S]. Returns [S, Q, Hq, D].
+    qg: [S, Q, Hq, D] grouped queries; k/v_pool: [N, Hk, bs, D] this layer's
+    pages (head-major); block_table: [S, B]; positions_g: [S, Q] absolute
+    positions; q_valid: [S, Q] bool; kv_len: [S]. Returns [S, Q, Hq, D].
     Slot j of sequence s attends iff j <= position of the query (also masks
     unwritten/trash slots because kv_len bounds writes).
     """
     s, q, hq, d = qg.shape
-    bs = k_pool.shape[1]
-    hk = k_pool.shape[2]
+    hk = k_pool.shape[1]
+    bs = k_pool.shape[2]
     rep = hq // hk
-    # gather pages -> [S, B*bs, Hk, D]
-    kg = k_pool[block_table].reshape(s, -1, hk, d)
-    vg = v_pool[block_table].reshape(s, -1, hk, d)
+    # gather pages [S, B, Hk, bs, D] -> slot-major [S, B*bs, Hk, D]
+    kg = k_pool[block_table].transpose(0, 1, 3, 2, 4).reshape(s, -1, hk, d)
+    vg = v_pool[block_table].transpose(0, 1, 3, 2, 4).reshape(s, -1, hk, d)
     m = kg.shape[1]
     qq = qg.reshape(s, q, hk, rep, d)
     scale = 1.0 / np.sqrt(d)
@@ -96,18 +124,20 @@ def paged_attention(qg, k_pool, v_pool, block_table, positions_g, q_valid, kv_le
     return out.reshape(s, q, hq, d)
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_k", "kv_v"))
-def ragged_forward(params, cfg: TransformerConfig, kv_k, kv_v, tokens, positions,
-                   gather_idx, block_table, kv_len, logits_idx
-                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+def _ragged_forward_impl(params, cfg: TransformerConfig, kv_k, kv_v, tokens,
+                         positions, gather_idx, block_table, kv_len,
+                         logits_idx, start_pos, chunk_len, attn_impl: str
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One engine step over a packed ragged batch.
 
-    kv pools: [L, N, bs, Hk, D] (donated — updated in place). Returns
+    kv pools: [L, N, Hk, bs, D] (donated — updated in place). Returns
     (logits [S, V] fp32 at each sequence's logits_idx token, new kv_k, kv_v).
+    ``attn_impl``: "einsum" (dense gathered-page reference path) or "pallas"
+    (paged online-softmax kernel, ops/pallas/paged_attention.py).
     """
     T = tokens.shape[0]
     S, Q = gather_idx.shape
-    bs = kv_k.shape[2]
+    bs = kv_k.shape[3]
     dtype = cfg.dtype
 
     x = params["embed"]["embedding"].astype(dtype)[tokens]          # [T, H]
@@ -126,51 +156,86 @@ def ragged_forward(params, cfg: TransformerConfig, kv_k, kv_v, tokens, positions
     tgt_slot = jnp.where(q_valid, pos_g % bs, 0).reshape(-1)
 
     h, hk, d = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    rope_cs = (cos, sin) if cfg.position == "rope" else None
     for i in range(cfg.num_layers):
         lp = params[f"layer_{i}"]
         y = _norm(cfg, lp["attn_norm"], x)
         ap = lp["attn"]
-        qt = _dense(ap["q_proj"], y)                                # [T, Hq, D]
-        kt = _dense(ap["k_proj"], y)                                # [T, Hk, D]
-        vt = _dense(ap["v_proj"], y)
-        if cfg.position == "rope":
-            qt = _rope(qt, cos, sin, positions)
-            kt = _rope(kt, cos, sin, positions)
+        qt, kt, vt = _qkv(cfg, ap, y, rope_cs, positions)
         # group per sequence (extra zero pad row at index T)
         qg = jnp.concatenate([qt, jnp.zeros_like(qt[:1])])[gather_idx]
         kg = jnp.concatenate([kt, jnp.zeros_like(kt[:1])])[gather_idx]
         vg = jnp.concatenate([vt, jnp.zeros_like(vt[:1])])[gather_idx]
-        # write new kv into pages
-        kv_k = kv_k.at[i, tgt_block, tgt_slot].set(
+        # write new kv into pages ([i, block, :, slot] — advanced indices
+        # around the head slice put the token axis first: values [T', Hk, D])
+        kv_k = kv_k.at[i, tgt_block, :, tgt_slot].set(
             kg.reshape(-1, hk, d).astype(kv_k.dtype))
-        kv_v = kv_v.at[i, tgt_block, tgt_slot].set(
+        kv_v = kv_v.at[i, tgt_block, :, tgt_slot].set(
             vg.reshape(-1, hk, d).astype(kv_v.dtype))
-        out = paged_attention(qg, kv_k[i], kv_v[i], block_table, pos_g,
-                              q_valid, kv_len)                      # [S, Q, Hq, D]
+        if attn_impl == "pallas":
+            out = paged_attention_pallas(qg, kv_k[i], kv_v[i], block_table,
+                                         start_pos, chunk_len, kv_len)
+        else:
+            out = paged_attention(qg, kv_k[i], kv_v[i], block_table, pos_g,
+                                  q_valid, kv_len)                  # [S, Q, Hq, D]
         # ungroup back to the flat token buffer ([T+1] with pad row dropped)
         flat = jnp.zeros((T + 1, h, d), out.dtype)
         flat = flat.at[gather_idx.reshape(-1)].set(out.reshape(-1, h, d))
         attn_tok = flat[:T]
         attn_out = _dense_multi_in(ap["o_proj"], attn_tok)          # [T, H]
         x = x + attn_out
-        y = _norm(cfg, lp["mlp_norm"], x)
-        mp = lp["mlp"]
-        if cfg.activation == "swiglu":
-            hid = jax.nn.silu(_dense(mp["gate_proj"], y)) * _dense(mp["up_proj"], y)
-        else:
-            hid = jax.nn.gelu(_dense(mp["up_proj"], y))
-        x = x + _dense(mp["down_proj"], hid)
+        x = x + _mlp(cfg, lp["mlp"], _norm(cfg, lp["mlp_norm"], x))
 
     x = _norm(cfg, params["final_norm"], x)
     # logits only at the sample positions (reference logits_gather kernel);
     # logits_idx == T selects the zero pad row for non-sampling slots
     h_sel = jnp.concatenate([x, jnp.zeros_like(x[:1])])[logits_idx]  # [S, H]
-    h_sel = h_sel.astype(jnp.float32)
-    if cfg.tie_embeddings:
-        logits = h_sel @ params["embed"]["embedding"].astype(jnp.float32).T
-    else:
-        logits = h_sel @ params["lm_head"]["kernel"].astype(jnp.float32)
+    logits = _lm_logits(cfg, params, h_sel)
     return logits, kv_k, kv_v
+
+
+@partial(jax.jit, static_argnames=("cfg", "attn_impl"),
+         donate_argnames=("kv_k", "kv_v"))
+def ragged_forward(params, cfg: TransformerConfig, kv_k, kv_v, tokens,
+                   positions, gather_idx, block_table, kv_len, logits_idx,
+                   start_pos=None, chunk_len=None, attn_impl: str = "einsum"
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Jitted ragged step returning full logits (see _ragged_forward_impl)."""
+    if start_pos is None:
+        if attn_impl == "pallas":
+            raise ValueError("attn_impl='pallas' requires start_pos/chunk_len "
+                             "(the contiguous-chunk invariant); only the "
+                             "einsum path can derive masks from gather_idx")
+        start_pos = kv_len  # unused by the einsum path
+        chunk_len = kv_len
+    return _ragged_forward_impl(params, cfg, kv_k, kv_v, tokens, positions,
+                                gather_idx, block_table, kv_len, logits_idx,
+                                start_pos, chunk_len, attn_impl)
+
+
+@partial(jax.jit, static_argnames=("cfg", "attn_impl", "greedy"),
+         donate_argnames=("kv_k", "kv_v"))
+def ragged_step(params, cfg: TransformerConfig, kv_k, kv_v, tokens, positions,
+                gather_idx, block_table, kv_len, logits_idx, start_pos,
+                chunk_len, key, temperature, attn_impl: str = "einsum",
+                greedy: bool = True
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Jitted ragged step with ON-DEVICE sampling.
+
+    The reference engine gathers logits to host and samples in Python per
+    step (and so does our v1 path); here sampling stays in the compiled
+    program (reference ``logits_gather`` + host sampler collapsed into the
+    step) and only ``[S]`` int32 tokens cross to host.
+    """
+    logits, kv_k, kv_v = _ragged_forward_impl(
+        params, cfg, kv_k, kv_v, tokens, positions, gather_idx, block_table,
+        kv_len, logits_idx, start_pos, chunk_len, attn_impl)
+    if greedy:
+        toks = jnp.argmax(logits, axis=-1)
+    else:
+        toks = jax.random.categorical(
+            key, logits / jnp.maximum(temperature, 1e-6), axis=-1)
+    return toks.astype(jnp.int32), kv_k, kv_v
 
 
 def _dense_multi_in(p, x):
@@ -179,3 +244,76 @@ def _dense_multi_in(p, x):
     if "bias" in p:
         out = out + p["bias"].astype(x.dtype)
     return out
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_steps", "attn_impl", "greedy"),
+         donate_argnames=("kv_k", "kv_v"))
+def decode_loop(params, cfg: TransformerConfig, kv_k, kv_v, tokens0, pos0,
+                block_table, active, key, temperature, n_steps: int = 16,
+                attn_impl: str = "einsum", greedy: bool = True
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """``n_steps`` fused decode iterations in ONE compiled program.
+
+    The reference serving loop (and our ``step()``) round-trips host every
+    token: logits→sample→repack. On a remote-attached TPU that RTT dominates
+    decode latency, so this runs the whole forward→sample→KV-append loop as a
+    ``lax.scan`` on device and ships back only ``[S, n_steps]`` int32.
+
+    tokens0: [S] last sampled token per sequence; pos0: [S] its absolute
+    position (== tokens cached so far); block_table [S, B] must already cover
+    ``pos0 + n_steps`` (reserve before calling); active: [S] bool (inactive
+    slots write to the trash block). Returns (tokens [S, n_steps], kv pools).
+    """
+    S = tokens0.shape[0]
+    bs = kv_k.shape[3]
+    dtype = cfg.dtype
+    if cfg.position == "rope":
+        cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    ones = jnp.ones((S,), jnp.int32)
+
+    def forward_one(kv_k, kv_v, toks, pos):
+        x = params["embed"]["embedding"].astype(dtype)[toks]        # [S, H]
+        if cfg.position == "learned":
+            x = x + params["pos_embed"][pos].astype(dtype)
+        tgt_block = jnp.where(
+            active, jnp.take_along_axis(
+                block_table, (pos // bs).astype(jnp.int32)[:, None],
+                axis=1)[:, 0], 0)
+        tgt_slot = jnp.where(active, pos % bs, 0)
+        kv_len = pos + 1
+        rope_cs = (cos, sin) if cfg.position == "rope" else None
+        for i in range(cfg.num_layers):
+            lp = params[f"layer_{i}"]
+            y = _norm(cfg, lp["attn_norm"], x)
+            ap = lp["attn"]
+            qt, kt, vt = _qkv(cfg, ap, y, rope_cs, pos)             # [S, H*, D]
+            kv_k = kv_k.at[i, tgt_block, :, tgt_slot].set(kt.astype(kv_k.dtype))
+            kv_v = kv_v.at[i, tgt_block, :, tgt_slot].set(vt.astype(kv_v.dtype))
+            qg = qt[:, None]                                        # [S, 1, Hq, D]
+            if attn_impl == "pallas":
+                out = paged_attention_pallas(qg, kv_k[i], kv_v[i], block_table,
+                                             pos, ones, kv_len)
+            else:
+                out = paged_attention(qg, kv_k[i], kv_v[i], block_table,
+                                      pos[:, None], active[:, None], kv_len)
+            x = x + _dense_multi_in(ap["o_proj"], out[:, 0])
+            x = x + _mlp(cfg, lp["mlp"], _norm(cfg, lp["mlp_norm"], x))
+        x = _norm(cfg, params["final_norm"], x)
+        logits = _lm_logits(cfg, params, x)
+        return logits, kv_k, kv_v
+
+    def body(carry, _):
+        kv_k, kv_v, toks, pos, key = carry
+        logits, kv_k, kv_v = forward_one(kv_k, kv_v, toks, pos)
+        if greedy:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(
+                sub, logits / jnp.maximum(temperature, 1e-6),
+                axis=-1).astype(jnp.int32)
+        return (kv_k, kv_v, nxt, pos + 1, key), nxt
+
+    (kv_k, kv_v, *_), toks = jax.lax.scan(
+        body, (kv_k, kv_v, tokens0, pos0, key), None, length=n_steps)
+    return toks.T, kv_k, kv_v                                       # [S, n_steps]

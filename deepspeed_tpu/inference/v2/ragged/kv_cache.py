@@ -2,9 +2,11 @@
 
 Reference ``BlockedKVCache`` (``inference/v2/ragged/kv_cache.py:40``) backed
 by CUDA block copy kernels. TPU-native: one K and one V pool per model,
-``[L, num_blocks, block_size, Hk, D]``, living on device across engine steps
-(donated through the jitted step so updates are in-place); block reservation
-is host-side via :class:`BlockedAllocator`."""
+``[L, num_blocks, Hk, block_size, D]`` (head-major so each head's page is a
+contiguous ``[block_size, D]`` tile — one DMA per page in the Pallas paged
+attention kernel), living on device across engine steps (donated through the
+jitted step so updates are in-place); block reservation is host-side via
+:class:`BlockedAllocator`."""
 
 from typing import Optional, Tuple
 
@@ -23,7 +25,7 @@ class BlockedKVCache:
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.allocator = BlockedAllocator(num_blocks)
-        shape = (num_layers, num_blocks, block_size, kv_heads, head_dim)
+        shape = (num_layers, num_blocks, kv_heads, block_size, head_dim)
         self.k = jnp.zeros(shape, dtype)
         self.v = jnp.zeros(shape, dtype)
         if shardings is not None:

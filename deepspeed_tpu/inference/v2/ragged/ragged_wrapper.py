@@ -23,6 +23,8 @@ class RaggedBatch:
     block_table: np.ndarray   # [S, B] int32; 0 (trash) when unused
     kv_len: np.ndarray        # [S] int32: cached+new tokens after this step
     logits_idx: np.ndarray    # [S] int32 into [0, T]: token to sample from (T = none)
+    start_pos: np.ndarray     # [S] int32: absolute position of chunk token 0
+    chunk_len: np.ndarray     # [S] int32: scheduled tokens this step (0 = pad slot)
     uids: List[int]           # seq slot -> uid (len <= S)
     num_tokens: int
     sample_slots: List[int]   # seq slots that produce a next token this step
@@ -47,6 +49,8 @@ class RaggedBatchWrapper:
         block_table = np.zeros((S, B), np.int32)
         kv_len = np.zeros((S,), np.int32)
         logits_idx = np.full((S,), T, np.int32)
+        start_pos = np.zeros((S,), np.int32)
+        chunk_len = np.zeros((S,), np.int32)
         uids, sample_slots = [], []
         cursor = 0
         for s, (seq, new_toks) in enumerate(scheduled):
@@ -64,6 +68,8 @@ class RaggedBatchWrapper:
             gather_idx[s, :n] = np.arange(cursor, cursor + n)
             block_table[s, :len(seq.blocks)] = seq.blocks
             kv_len[s] = seq.seen_tokens + n
+            start_pos[s] = seq.seen_tokens
+            chunk_len[s] = n
             uids.append(seq.uid)
             # sample only when this chunk finishes the prompt (or is decode)
             if seq.seen_tokens + n >= len(seq.prompt_tokens):
@@ -72,5 +78,6 @@ class RaggedBatchWrapper:
             cursor += n
         return RaggedBatch(tokens=tokens, positions=positions,
                            gather_idx=gather_idx, block_table=block_table,
-                           kv_len=kv_len, logits_idx=logits_idx, uids=uids,
+                           kv_len=kv_len, logits_idx=logits_idx,
+                           start_pos=start_pos, chunk_len=chunk_len, uids=uids,
                            num_tokens=cursor, sample_slots=sample_slots)
