@@ -93,6 +93,19 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     path = os.path.join(os.path.abspath(save_dir), str(tag))
     ck = _get_ckpt_engine(engine)
     ck.save(_state_to_tree(engine), os.path.join(path, "state"))
+    host_adam = getattr(engine, "_host_adam", None)
+    if host_adam is not None and jax.process_index() == 0:
+        # ZeRO-Offload host optimizer state (fp32 master + moments) lives
+        # outside TrainState; store it beside the orbax tree
+        sd = host_adam.state_dict()
+        flat = {"step": np.int64(sd["step"])}
+        for name in ("master", "exp_avg", "exp_avg_sq"):
+            for i, leaf in enumerate(jax.tree.leaves(
+                    sd[name], is_leaf=lambda x: x is None)):
+                if leaf is not None:
+                    flat[f"{name}_{i}"] = leaf
+        os.makedirs(path, exist_ok=True)
+        np.savez(os.path.join(path, "host_optimizer.npz"), **flat)
     meta = {
         "tag": str(tag),
         "global_steps": engine.global_steps,
@@ -173,6 +186,28 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                             hysteresis=tree["loss_scale"]["hysteresis"])
     engine.state = TrainState(step=step, params=tree["params"], opt_state=opt_state,
                               loss_scale=ls)
+
+    host_adam = getattr(engine, "_host_adam", None)
+    if host_adam is not None:
+        host_npz = os.path.join(path, "host_optimizer.npz")
+        if not params_only and os.path.exists(host_npz):
+            data = np.load(host_npz)
+            sd = {"step": int(data["step"])}
+            for name in ("master", "exp_avg", "exp_avg_sq"):
+                ref = getattr(host_adam, name)
+                flat = jax.tree.leaves(ref, is_leaf=lambda x: x is None)
+                restored = [data[f"{name}_{i}"] if f"{name}_{i}" in data else None
+                            for i in range(len(flat))]
+                treedef = jax.tree.structure(ref, is_leaf=lambda x: x is None)
+                sd[name] = jax.tree.unflatten(treedef, restored)
+            host_adam.load_state_dict(sd)
+        else:
+            # no host state in this checkpoint (params-only load, or saved
+            # without offload): re-seed the masters from the loaded params so
+            # the next step doesn't overwrite them with stale init-time ones
+            logger.warning("host optimizer state not restored — re-seeding "
+                           "fp32 masters from the loaded params")
+            host_adam.reseed_masters(jax.device_get(tree["params"]))
 
     meta_path = os.path.join(path, "metadata.json")
     meta = json.load(open(meta_path)) if os.path.exists(meta_path) else {}

@@ -1,0 +1,3 @@
+from .cpu_adam import CPUAdamBuilder, DeepSpeedCPUAdam
+
+__all__ = ["CPUAdamBuilder", "DeepSpeedCPUAdam"]

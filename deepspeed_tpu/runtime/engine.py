@@ -131,6 +131,28 @@ class DeepSpeedTPUEngine:
         self.rules = ZeroShardingRules(zc.stage, self.topo, mics_shard_size=zc.mics_shard_size)
         self.param_specs_base = param_specs
         self._offload_optimizer = zc.offload_optimizer.device in ("cpu", "nvme")
+        # True host-offload (ZeRO-Offload): device=cpu + an adam-family config
+        # optimizer runs the update ON HOST via the native kernel
+        # (csrc/adam/cpu_adam.cpp); optimizer state never exists on device.
+        # A custom optax optimizer or non-adam type falls back to pinned-host
+        # storage with on-device compute (the previous tier).
+        self._host_adam = None
+        self._host_adam_mode = (
+            zc.offload_optimizer.device == "cpu" and optimizer is None
+            and config.optimizer.type.lower().replace("_", "") in
+            ("adam", "adamw", "fusedadam", "cpuadam", "deepspeedcpuadam"))
+        if self._host_adam_mode and config.fp16.enabled:
+            raise ValueError(
+                "fp16 dynamic loss scaling is not supported with "
+                "offload_optimizer.device='cpu' (the host Adam step runs "
+                "outside the scaled program); use bf16 — the TPU default")
+        if self._host_adam_mode and jax.process_count() > 1:
+            # host Adam needs fully-addressable grads; on a multi-process
+            # mesh fall back to the pinned-host storage tier
+            log_dist("offload_optimizer.device=cpu: multi-process mesh — "
+                     "falling back to pinned-host optimizer state with "
+                     "on-device compute")
+            self._host_adam_mode = False
 
         # --- precision ---------------------------------------------------
         self.compute_dtype = config.compute_dtype
@@ -208,27 +230,74 @@ class DeepSpeedTPUEngine:
     def _build_state(self, params):
         rules, topo = self.rules, self.topo
         store_dtype = jnp.float32 if self.master_weights else self.compute_dtype
-        # jnp.array (copy=True), NOT asarray: device_put can alias the
-        # caller's buffers, and the donated train step would then delete the
-        # user's own model_parameters arrays out from under them
-        params = jax.tree.map(
-            lambda p: jnp.array(p, store_dtype) if jnp.issubdtype(
-                jnp.asarray(p).dtype, jnp.floating) else jnp.array(p), params)
-        self.param_spec_tree = rules.param_spec_tree(params, self.param_specs_base)
-        param_sh = rules.shardings(self.param_spec_tree)
-        params = jax.device_put(params, param_sh)
+        if callable(params) and not hasattr(params, "shape"):
+            # zero.Init analogue (reference partition_parameters.py:816):
+            # ``params`` is a zero-arg init closure. jax.eval_shape derives
+            # the tree abstractly (nothing materializes), the ZeRO specs are
+            # computed from the abstract shapes, and jitting the closure with
+            # out_shardings materializes every leaf DIRECTLY into its shard —
+            # no full-size host or device buffer ever exists, so models
+            # larger than host RAM can initialize. Per-shard randomness comes
+            # from partitionable threefry (XLA generates only local shards).
+            init_fn = params
 
-        opt_shapes = jax.eval_shape(self.tx.init, params)
-        # master/optimizer state shards at stage>=1 even when params don't
-        opt_param_specs = rules.opt_spec_tree(params, self.param_specs_base)
-        opt_spec_tree = _struct_congruent_specs(opt_shapes, params, opt_param_specs)
-        opt_sh = jax.tree.map(lambda s: NamedSharding(topo.mesh, s), opt_spec_tree,
-                              is_leaf=lambda x: isinstance(x, P))
-        opt_state = jax.jit(self.tx.init, out_shardings=opt_sh)(params)
-        if self._offload_optimizer:
-            # opt_sh updates to pinned-host kinds so every later device_put
-            # (checkpoint load, reload_states) restores host residency
-            opt_state, opt_sh = _to_host_memory(opt_state, opt_sh)
+            def cast_init():
+                return jax.tree.map(
+                    lambda p: p.astype(store_dtype) if jnp.issubdtype(
+                        p.dtype, jnp.floating) else p, init_fn())
+
+            abstract = jax.eval_shape(cast_init)
+            self.param_spec_tree = rules.param_spec_tree(abstract, self.param_specs_base)
+            param_sh = rules.shardings(self.param_spec_tree)
+            params = jax.jit(cast_init, out_shardings=param_sh)()
+        else:
+            # jnp.array (copy=True), NOT asarray: device_put can alias the
+            # caller's buffers, and the donated train step would then delete
+            # the user's own model_parameters arrays out from under them
+            params = jax.tree.map(
+                lambda p: jnp.array(p, store_dtype) if jnp.issubdtype(
+                    jnp.asarray(p).dtype, jnp.floating) else jnp.array(p), params)
+            self.param_spec_tree = rules.param_spec_tree(params, self.param_specs_base)
+            param_sh = rules.shardings(self.param_spec_tree)
+            params = jax.device_put(params, param_sh)
+
+        if self._host_adam_mode:
+            # ZeRO-Offload: fp32 master + moments live on HOST (native SIMD
+            # Adam, csrc/adam/cpu_adam.cpp); the device keeps only the
+            # compute-dtype working copy. Reference cpu_adam_impl.cpp flow.
+            from ..ops.adam import DeepSpeedCPUAdam
+
+            op = dict(self.config.optimizer.params)
+            self._host_adam = DeepSpeedCPUAdam(
+                jax.device_get(params),
+                lr=op.get("lr", 1e-3), betas=tuple(op.get("betas", (0.9, 0.999))),
+                eps=op.get("eps", 1e-8),
+                weight_decay=op.get("weight_decay", 0.0),
+                adamw_mode=op.get("adam_w_mode", op.get("adamw_mode", True)),
+                bias_correction=op.get("bias_correction", True))
+            if self.compute_dtype != jnp.dtype(jnp.float32):
+                cast_sh = param_sh
+
+                def to_compute(t):
+                    return jax.tree.map(
+                        lambda x: x.astype(self.compute_dtype) if jnp.issubdtype(
+                            x.dtype, jnp.floating) else x, t)
+
+                params = jax.jit(to_compute, out_shardings=cast_sh,
+                                 donate_argnums=(0,))(params)
+            opt_state, opt_sh = (), ()
+        else:
+            opt_shapes = jax.eval_shape(self.tx.init, params)
+            # master/optimizer state shards at stage>=1 even when params don't
+            opt_param_specs = rules.opt_spec_tree(params, self.param_specs_base)
+            opt_spec_tree = _struct_congruent_specs(opt_shapes, params, opt_param_specs)
+            opt_sh = jax.tree.map(lambda s: NamedSharding(topo.mesh, s), opt_spec_tree,
+                                  is_leaf=lambda x: isinstance(x, P))
+            opt_state = jax.jit(self.tx.init, out_shardings=opt_sh)(params)
+            if self._offload_optimizer:
+                # opt_sh updates to pinned-host kinds so every later device_put
+                # (checkpoint load, reload_states) restores host residency
+                opt_state, opt_sh = _to_host_memory(opt_state, opt_sh)
 
         ls = make_loss_scale_state(self.config.fp16.initial_scale_power,
                                    self.config.fp16.loss_scale,
@@ -337,20 +406,62 @@ class DeepSpeedTPUEngine:
             }
             return new_state, metrics
 
+        def grad_step(params, batch, rng, step, *, ltd_keep=None):
+            # ZeRO-Offload device half: grads + metrics only; the optimizer
+            # update happens on host (engine._host_adam). fp16 loss scaling
+            # is rejected at init in this mode (bf16/fp32 only), so the
+            # micro scan needs no scale factor.
+            def micro(carry, xs):
+                acc = carry
+                mb, mb_rng = xs
+                loss, grads = jax.value_and_grad(
+                    lambda p: self._loss(p, mb, mb_rng, ltd_keep=ltd_keep)[0]
+                )(params)
+                grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+                grads = jax.lax.with_sharding_constraint(
+                    grads, rules.shardings(self.grad_spec_tree))
+                acc = jax.tree.map(jnp.add, acc, grads)
+                return acc, loss
+
+            zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            zeros = jax.lax.with_sharding_constraint(zeros, rules.shardings(self.grad_spec_tree))
+            rngs = jax.random.split(rng, gas)
+            acc, losses = lax.scan(micro, zeros, (batch, rngs))
+            grads = jax.tree.map(lambda g: g / gas, acc)
+            grad_norm = global_grad_norm(grads)
+            if clip and clip > 0:
+                coef = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+                grads = jax.tree.map(lambda g: g * coef, grads)
+            metrics = {"loss": jnp.mean(losses), "grad_norm": grad_norm,
+                       "lr": jnp.asarray(self.lr_schedule(step + 1), jnp.float32),
+                       "loss_scale": jnp.asarray(1.0, jnp.float32),
+                       "overflow": ~jnp.isfinite(grad_norm)}
+            return grads, metrics
+
         state_sh = TrainState(
             step=NamedSharding(topo.mesh, P()),
             params=self._param_shardings,
             opt_state=self._opt_shardings,
             loss_scale=jax.tree.map(lambda _: NamedSharding(topo.mesh, P()), self.state.loss_scale))
 
-        def make_train_step(ltd_keep):
-            # one compiled program per random-LTD stage (the scheduler's
-            # step_size quantization bounds how many exist)
-            return jax.jit(
-                partial(train_step, ltd_keep=ltd_keep),
-                in_shardings=(state_sh, None, None),
-                out_shardings=(state_sh, None),
-                donate_argnums=(0,) if donate_state else ())
+        if self._host_adam is not None:
+            grad_sh = jax.tree.map(lambda s: NamedSharding(topo.mesh, s),
+                                   self.grad_spec_tree,
+                                   is_leaf=lambda x: isinstance(x, P))
+
+            def make_train_step(ltd_keep):
+                return jax.jit(partial(grad_step, ltd_keep=ltd_keep),
+                               in_shardings=(self._param_shardings, None, None, None),
+                               out_shardings=(grad_sh, None))
+        else:
+            def make_train_step(ltd_keep):
+                # one compiled program per random-LTD stage (the scheduler's
+                # step_size quantization bounds how many exist)
+                return jax.jit(
+                    partial(train_step, ltd_keep=ltd_keep),
+                    in_shardings=(state_sh, None, None),
+                    out_shardings=(state_sh, None),
+                    donate_argnums=(0,) if donate_state else ())
 
         self._make_train_step = make_train_step
         self._train_steps = {None: make_train_step(None)}
@@ -388,7 +499,10 @@ class DeepSpeedTPUEngine:
         if step_fn is None:
             step_fn = self._train_steps[ltd_keep] = self._make_train_step(ltd_keep)
         t0 = time.perf_counter()
-        self.state, metrics = step_fn(self.state, batch, step_rng)
+        if self._host_adam is not None:
+            metrics = self._host_offload_step(step_fn, batch, step_rng)
+        else:
+            self.state, metrics = step_fn(self.state, batch, step_rng)
         self.global_steps += 1
         # Metrics stay on device; ``_last_metrics`` converts lazily. A per-step
         # device->host sync here would serialize the async dispatch pipeline
@@ -412,6 +526,32 @@ class DeepSpeedTPUEngine:
                 dt = float(np.mean(times)) if times else float("inf")
                 report_autotune_result(self.train_batch_size / dt)
         return metrics["loss"]
+
+    def _host_offload_step(self, step_fn, batch, step_rng):
+        """ZeRO-Offload step: device grads → host SIMD Adam → device params.
+
+        D2H transfers are started async for every leaf so they overlap the
+        per-leaf kernel work; the update itself runs in the native library's
+        thread pool (csrc/adam/cpu_adam.cpp). The fp32 master and moments
+        never exist on device — only compute-dtype params and fp32 grads do.
+        """
+        state = self.state
+        grads, metrics = step_fn(state.params, batch, step_rng, state.step)
+        for leaf in jax.tree.leaves(grads):
+            leaf.copy_to_host_async()
+        grad_norm = float(np.asarray(metrics["grad_norm"]))
+        if not np.isfinite(grad_norm):
+            # skip the update (fp16/bf16 overflow semantics without scaling)
+            self.state = state.replace(step=state.step + 1)
+            return metrics
+        lr_t = float(np.asarray(self.lr_schedule(self.global_steps + 1)))
+        emit_bf16 = jnp.dtype(self.compute_dtype) == jnp.dtype(jnp.bfloat16)
+        new_np = self._host_adam.step(jax.device_get(grads), lr=lr_t,
+                                      emit_bf16=emit_bf16)
+        new_params = jax.device_put(new_np, self._param_shardings)
+        self.state = TrainState(step=state.step + 1, params=new_params,
+                                opt_state=(), loss_scale=state.loss_scale)
+        return metrics
 
     def eval_batch(self, batch, compute_loss: bool = True):
         if self._eval_fn is None:
@@ -470,6 +610,30 @@ class DeepSpeedTPUEngine:
         """Apply the optimizer with accumulated grads (reference ``step:2204``);
         no-op until the accumulation boundary like the reference."""
         if not self.is_gradient_accumulation_boundary():
+            return
+        if self._host_adam is not None:
+            # route the accumulated grads through the host optimizer (the
+            # jitted apply_step below assumes on-device optax state)
+            clip = self.config.gradient_clipping
+            grads = jax.tree.map(lambda g: g / self.gas, self._compat_acc)
+            grad_norm = float(np.asarray(global_grad_norm(grads)))
+            if np.isfinite(grad_norm):
+                if clip and clip > 0:
+                    coef = min(1.0, clip / (grad_norm + 1e-6))
+                    grads = jax.tree.map(lambda g: g * coef, grads)
+                lr_t = float(np.asarray(self.lr_schedule(self.global_steps + 1)))
+                emit_bf16 = jnp.dtype(self.compute_dtype) == jnp.dtype(jnp.bfloat16)
+                new_np = self._host_adam.step(jax.device_get(grads), lr=lr_t,
+                                              emit_bf16=emit_bf16)
+                self.state = TrainState(
+                    step=self.state.step + 1,
+                    params=jax.device_put(new_np, self._param_shardings),
+                    opt_state=(), loss_scale=self.state.loss_scale)
+            else:
+                self.state = self.state.replace(step=self.state.step + 1)
+            self._compat_acc = None
+            self._compat_count = 0
+            self.global_steps += 1
             return
         if self._apply_fn is None:
             config = self.config
@@ -760,7 +924,12 @@ def initialize(args=None,
 
     ``model`` is a pure loss function ``loss = f(params, batch[, rng])`` or a
     flax module whose ``apply`` returns the loss; ``model_parameters`` is the
-    initial parameter pytree (fp32).
+    initial parameter pytree (fp32) — or, for the ``zero.Init`` analogue
+    (shard-at-creation, reference ``partition_parameters.py:816``), a
+    zero-arg closure returning that pytree (e.g.
+    ``lambda: flax_model.init(key, dummy)["params"]``): each leaf then
+    materializes directly into its ZeRO shard and no full-size copy of the
+    model ever exists on host or any single device.
     Returns ``(engine, optimizer_proxy, dataloader, lr_scheduler_proxy)`` to
     match the reference tuple.
     """
