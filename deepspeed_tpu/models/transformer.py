@@ -50,6 +50,8 @@ class TransformerConfig:
     moe_every: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_loss_weight: float = 0.01
+    # dropless grouped-GEMM experts (ragged_dot); best with ep=1
+    moe_dropless: bool = False
     # execution
     dtype: Any = jnp.bfloat16
     remat: bool = False

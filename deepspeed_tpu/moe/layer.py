@@ -18,7 +18,8 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
-from .sharded_moe import compute_capacity, moe_combine, moe_dispatch, topk_gating
+from .sharded_moe import (compute_capacity, dropless_moe, load_balance_aux,
+                          moe_combine, moe_dispatch, topk_gating)
 
 
 def _constrain(x, spec, skip: bool = False):
@@ -54,24 +55,50 @@ class MoEBlock(nn.Module):
         router = nn.Dense(e, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
                           name="router")
         logits = router(x.astype(jnp.float32))
+
+        init = nn.initializers.lecun_normal()
+        swiglu = cfg.activation == "swiglu"
+        # gate projection exists only for gated activations (mirrors MLP)
+        w_gate = (self.param("expert_gate_proj", init, (e, d, f), jnp.float32)
+                  if swiglu else None)
+        w_up = self.param("expert_up_proj", init, (e, d, f), jnp.float32)
+        w_down = self.param("expert_down_proj", init, (e, f, d), jnp.float32)
+        skip = self.is_initializing()
+
+        if getattr(cfg, "moe_dropless", False):
+            # grouped-GEMM dropless path (reference cutlass moe_gemm /
+            # megablocks): no capacity, no zero-padded compute. Token
+            # grouping is a global sort under SPMD, so this path shines for
+            # ep=1 (local groups); with ep>1 prefer the capacity einsums.
+            gates = jax.nn.softmax(logits, axis=-1)
+            aux = load_balance_aux(gates)
+            y = dropless_moe(x, gates, k, w_gate, w_up, w_down,
+                             activation=cfg.activation)
+            y = _constrain(y, P(("dp_outer", "ep"), None, None), skip)
+            return y.astype(x.dtype), aux * cfg.moe_aux_loss_weight
+
         dispatch, combine, aux = topk_gating(logits, k, capacity)
+        # keep the token-major mask sharded like the activations (G over
+        # dp, S over sp): leaving it unconstrained made the partitioner
+        # replicate-and-repartition the dispatch collective-permute
+        # ("involuntary full rematerialization", spmd_partitioner.cc:652)
+        tok_mask_spec = P(("dp_outer", "ep"), "sp", None, None)
+        dispatch = _constrain(dispatch, tok_mask_spec, skip)
+        combine = _constrain(combine, tok_mask_spec, skip)
 
         # expert-major dispatch: [E, G, C, D], experts over the ep axis
-        skip = self.is_initializing()
         expert_in = moe_dispatch(x, dispatch)
         expert_in = _constrain(expert_in, P("ep", ("dp_outer",), None, None), skip)
 
-        init = nn.initializers.lecun_normal()
-        w_gate = self.param("expert_gate_proj", init, (e, d, f), jnp.float32)
-        w_up = self.param("expert_up_proj", init, (e, d, f), jnp.float32)
-        w_down = self.param("expert_down_proj", init, (e, f, d), jnp.float32)
-
-        h = jnp.einsum("egcd,edf->egcf", expert_in, w_gate.astype(x.dtype))
         u = jnp.einsum("egcd,edf->egcf", expert_in, w_up.astype(x.dtype))
-        h = nn.silu(h) * u
+        if swiglu:
+            h = jnp.einsum("egcd,edf->egcf", expert_in, w_gate.astype(x.dtype))
+            h = nn.silu(h) * u
+        else:
+            h = nn.gelu(u)
         out = jnp.einsum("egcf,efd->egcd", h, w_down.astype(x.dtype))
         out = _constrain(out, P("ep", ("dp_outer",), None, None), skip)
 
         y = moe_combine(out, combine)
-        y = _constrain(y, P(("dp_outer", "ep"), None, None), skip)
+        y = _constrain(y, P(("dp_outer", "ep"), "sp", None), skip)
         return y.astype(x.dtype), aux * cfg.moe_aux_loss_weight

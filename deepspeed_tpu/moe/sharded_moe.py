@@ -24,6 +24,16 @@ def compute_capacity(k: int, tokens_per_group: int, num_experts: int,
     return max(cap, min_capacity)
 
 
+def load_balance_aux(gates: jnp.ndarray) -> jnp.ndarray:
+    """GShard load-balance loss from the top-1 assignment (reference
+    ``top1gating:183``): E * mean_e(mean-prob_e * assigned-fraction_e)."""
+    g, s, e = gates.shape
+    top1 = jnp.argmax(gates, axis=-1)
+    me = jnp.mean(gates, axis=1)                            # [G,E] mean prob
+    ce = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=1)
+    return jnp.mean(jnp.sum(me * ce, axis=-1)) * e
+
+
 def topk_gating(logits: jnp.ndarray, k: int, capacity: int,
                 rng: Optional[jax.Array] = None,
                 noisy_gate_policy: Optional[str] = None,
@@ -37,12 +47,7 @@ def topk_gating(logits: jnp.ndarray, k: int, capacity: int,
     if noisy_gate_policy == "RSample" and rng is not None:
         logits = logits + jax.random.normal(rng, logits.shape) / e
     gates = jax.nn.softmax(logits, axis=-1)  # [G,S,E]
-
-    # aux load-balance loss from the top-1 assignment (reference top1gating:183)
-    top1 = jnp.argmax(gates, axis=-1)
-    me = jnp.mean(gates, axis=1)                            # [G,E] mean prob
-    ce = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=1)  # fraction
-    aux_loss = jnp.mean(jnp.sum(me * ce, axis=-1)) * e
+    aux_loss = load_balance_aux(gates)
 
     remaining = gates
     committed = jnp.zeros((g, 1, e), jnp.float32)  # tokens assigned per expert so far
@@ -83,3 +88,48 @@ def moe_dispatch(x: jnp.ndarray, dispatch: jnp.ndarray) -> jnp.ndarray:
 def moe_combine(expert_out: jnp.ndarray, combine: jnp.ndarray) -> jnp.ndarray:
     """expert outputs [E,G,C,D] x combine [G,S,E,C] -> tokens [G,S,D]."""
     return jnp.einsum("egcd,gsec->gsd", expert_out, combine.astype(expert_out.dtype))
+
+
+def dropless_moe(x: jnp.ndarray, gates: jnp.ndarray, k: int,
+                 w_gate: jnp.ndarray, w_up: jnp.ndarray, w_down: jnp.ndarray,
+                 activation: str = "swiglu") -> jnp.ndarray:
+    """Dropless MoE via grouped GEMM (``jax.lax.ragged_dot``).
+
+    TPU-native replacement for the reference CUTLASS grouped ``moe_gemm``
+    (``inference/v2/kernels/cutlass_ops/moe_gemm/``) and the megablocks-style
+    dropless path: every token reaches its top-k experts (no capacity, no
+    zero-padded compute). Tokens are sorted by expert id; ``ragged_dot``
+    multiplies each contiguous group against its expert's weights on the MXU
+    without materializing per-expert padding.
+
+    x: [G, S, D]; gates: [G, S, E] fp32 router probabilities;
+    w_gate/w_up: [E, D, F]; w_down: [E, F, D]. Returns [G, S, D].
+    """
+    g, s, d = x.shape
+    e = gates.shape[-1]
+    n = g * s
+    xf = x.reshape(n, d)
+    gf = gates.reshape(n, e)
+
+    top_w, top_e = jax.lax.top_k(gf, k)                     # [N, k]
+    top_w = top_w / jnp.maximum(jnp.sum(top_w, axis=-1, keepdims=True), 1e-9)
+    eid = top_e.reshape(-1)                                 # [N*k]
+    wts = top_w.reshape(-1)                                 # [N*k]
+    order = jnp.argsort(eid)                                # expert-sorted copies
+    tok_of = order // k                                     # source token per copy
+    xs = xf[tok_of]                                         # [N*k, D]
+    group_sizes = jnp.bincount(eid, length=e).astype(jnp.int32)
+
+    wu = w_up.astype(x.dtype)
+    wd = w_down.astype(x.dtype)
+    if activation == "swiglu":
+        wg = w_gate.astype(x.dtype)
+        h = jax.nn.silu(jax.lax.ragged_dot(xs, wg, group_sizes)) * \
+            jax.lax.ragged_dot(xs, wu, group_sizes)
+    else:  # w_gate is None for ungated activations
+        h = jax.nn.gelu(jax.lax.ragged_dot(xs, wu, group_sizes))
+    out = jax.lax.ragged_dot(h, wd, group_sizes)            # [N*k, D]
+
+    out = out * wts[order][:, None].astype(out.dtype)
+    yf = jnp.zeros((n, d), out.dtype).at[tok_of].add(out)
+    return yf.reshape(g, s, d)

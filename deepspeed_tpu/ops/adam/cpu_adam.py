@@ -13,80 +13,40 @@ copies for the device upload) — the engine wires it into ``train_batch`` when
 """
 
 import ctypes
-import hashlib
 import os
-import subprocess
-import threading
 from typing import Any, Optional, Tuple
 
 import jax
 import numpy as np
 
+from ..op_builder import NativeOpBuilder
+
 _SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "..",
                     "csrc", "adam", "cpu_adam.cpp")
-_LOCK = threading.Lock()
-_LIB = None
 
 
-class CPUAdamBuilder:
+def _is_float(dtype) -> bool:
+    """np.issubdtype misses ml_dtypes (bfloat16 etc.) — jnp's check covers
+    both numpy and extended float types."""
+    return jax.numpy.issubdtype(dtype, jax.numpy.floating)
+
+
+class CPUAdamBuilder(NativeOpBuilder):
     """JIT build + load of the native host-Adam library."""
 
     NAME = "cpu_adam"
+    SRC = _SRC
 
-    def cache_dir(self) -> str:
-        d = os.environ.get("DSTPU_CACHE_DIR",
-                           os.path.join(os.path.expanduser("~"), ".cache",
-                                        "deepspeed_tpu"))
-        os.makedirs(d, exist_ok=True)
-        return d
-
-    def src_path(self) -> str:
-        return os.path.normpath(_SRC)
-
-    def lib_path(self) -> str:
-        with open(self.src_path(), "rb") as f:
-            tag = hashlib.sha256(f.read()).hexdigest()[:16]
-        return os.path.join(self.cache_dir(), f"libdstpu_cpu_adam_{tag}.so")
-
-    def is_compatible(self) -> bool:
-        try:
-            self.load()
-            return True
-        except Exception:
-            return False
-
-    def build(self) -> str:
-        out = self.lib_path()
-        if os.path.exists(out):
-            return out
-        tmp = f"{out}.tmp.{os.getpid()}"  # atomic vs concurrent rank builds
-        cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-               "-pthread", self.src_path(), "-o", tmp]
-        try:
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
-        except subprocess.CalledProcessError:
-            # portable fallback (still auto-vectorized, just not -march tuned)
-            cmd.remove("-march=native")
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
-        os.replace(tmp, out)
-        return out
-
-    def load(self):
-        global _LIB
-        with _LOCK:
-            if _LIB is None:
-                lib = ctypes.CDLL(self.build())
-                lib.dstpu_cpu_adam.restype = None
-                lib.dstpu_cpu_adam.argtypes = [
-                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-                    ctypes.c_void_p, ctypes.c_int64,
-                    ctypes.c_float, ctypes.c_float, ctypes.c_float,
-                    ctypes.c_float, ctypes.c_float,
-                    ctypes.c_int, ctypes.c_int, ctypes.c_int,
-                    ctypes.c_void_p, ctypes.c_int,
-                ]
-                _LIB = lib
-            return _LIB
+    def _bind(self, lib):
+        lib.dstpu_cpu_adam.restype = None
+        lib.dstpu_cpu_adam.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_int,
+        ]
 
 
 class DeepSpeedCPUAdam:
@@ -115,13 +75,12 @@ class DeepSpeedCPUAdam:
         # non-float leaves (e.g. int buffers) pass through untouched
         def to_master(p):
             p = np.asarray(p)
-            if not np.issubdtype(p.dtype, np.floating):
+            if not _is_float(p.dtype):
                 return p
             return np.ascontiguousarray(p.astype(np.float32))
 
         self.master = jax.tree.map(to_master, params)
-        zeros = lambda p: (np.zeros_like(p)
-                           if np.issubdtype(p.dtype, np.floating) else None)
+        zeros = lambda p: np.zeros_like(p) if _is_float(p.dtype) else None
         self.exp_avg = jax.tree.map(zeros, self.master)
         self.exp_avg_sq = jax.tree.map(zeros, self.master)
 
@@ -158,7 +117,11 @@ class DeepSpeedCPUAdam:
             g = np.ascontiguousarray(np.asarray(g, np.float32))
             ob = np.empty(p.shape, np.uint16) if emit_bf16 else None
             self._leaf_step(p, m, v, g, lr_t, ob)
-            outs.append(ob.view(np.dtype(jax.numpy.bfloat16)) if emit_bf16 else p)
+            # COPY the master on the fp32 path: device_put may zero-copy
+            # alias host buffers, and the next step mutates the master in
+            # place — aliasing would let state.params change under JAX
+            outs.append(ob.view(np.dtype(jax.numpy.bfloat16)) if emit_bf16
+                        else p.copy())
         return treedef.unflatten(outs)
 
     # -- checkpoint support --------------------------------------------
@@ -171,8 +134,9 @@ class DeepSpeedCPUAdam:
         # float leaves live as contiguous fp32; non-float pass through with
         # their original dtype preserved
         new = np.asarray(new)
-        if not np.issubdtype(np.asarray(old).dtype, np.floating):
-            return np.ascontiguousarray(new.astype(np.asarray(old).dtype))
+        old_dtype = np.asarray(old).dtype
+        if not _is_float(old_dtype):
+            return np.ascontiguousarray(new.astype(old_dtype))
         return np.ascontiguousarray(new.astype(np.float32))
 
     def load_state_dict(self, sd):

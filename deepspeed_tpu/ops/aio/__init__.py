@@ -9,81 +9,39 @@ wait), operating on numpy buffers.
 """
 
 import ctypes
-import hashlib
 import os
-import subprocess
-import threading
 from typing import Optional
 
 import numpy as np
 
+from ..op_builder import NativeOpBuilder
+
 _SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "..",
                     "csrc", "aio", "aio.cpp")
-_LOCK = threading.Lock()
-_LIB = None
 
 
-class AsyncIOBuilder:
+class AsyncIOBuilder(NativeOpBuilder):
     """JIT build + load of the native aio library."""
 
     NAME = "async_io"
+    SRC = _SRC
+    EXTRA_FLAGS = ()  # io code gains nothing from -march tuning
 
-    def cache_dir(self) -> str:
-        d = os.environ.get("DSTPU_CACHE_DIR",
-                           os.path.join(os.path.expanduser("~"), ".cache",
-                                        "deepspeed_tpu"))
-        os.makedirs(d, exist_ok=True)
-        return d
-
-    def src_path(self) -> str:
-        return os.path.normpath(_SRC)
-
-    def lib_path(self) -> str:
-        with open(self.src_path(), "rb") as f:
-            tag = hashlib.sha256(f.read()).hexdigest()[:16]
-        return os.path.join(self.cache_dir(), f"libdstpu_aio_{tag}.so")
-
-    def is_compatible(self) -> bool:
-        try:
-            self.load()
-            return True
-        except Exception:
-            return False
-
-    def build(self) -> str:
-        out = self.lib_path()
-        if os.path.exists(out):
-            return out
-        # per-pid tmp + atomic rename: concurrent first-use builds from the
-        # launcher's N local ranks must not corrupt each other's output
-        tmp = f"{out}.tmp.{os.getpid()}"
-        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-               self.src_path(), "-o", tmp]
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
-        os.replace(tmp, out)
-        return out
-
-    def load(self):
-        global _LIB
-        with _LOCK:
-            if _LIB is None:
-                lib = ctypes.CDLL(self.build())
-                lib.dstpu_aio_create.restype = ctypes.c_void_p
-                lib.dstpu_aio_create.argtypes = [ctypes.c_int, ctypes.c_int64,
-                                                 ctypes.c_int]
-                lib.dstpu_aio_destroy.argtypes = [ctypes.c_void_p]
-                lib.dstpu_aio_submit.restype = ctypes.c_int64
-                lib.dstpu_aio_submit.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                                 ctypes.c_void_p, ctypes.c_int64,
-                                                 ctypes.c_int64, ctypes.c_int]
-                lib.dstpu_aio_wait.restype = ctypes.c_int64
-                lib.dstpu_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
-                lib.dstpu_aio_wait_all.restype = ctypes.c_int64
-                lib.dstpu_aio_wait_all.argtypes = [ctypes.c_void_p]
-                lib.dstpu_aio_pending.restype = ctypes.c_int
-                lib.dstpu_aio_pending.argtypes = [ctypes.c_void_p]
-                _LIB = lib
-        return _LIB
+    def _bind(self, lib):
+        lib.dstpu_aio_create.restype = ctypes.c_void_p
+        lib.dstpu_aio_create.argtypes = [ctypes.c_int, ctypes.c_int64,
+                                         ctypes.c_int]
+        lib.dstpu_aio_destroy.argtypes = [ctypes.c_void_p]
+        lib.dstpu_aio_submit.restype = ctypes.c_int64
+        lib.dstpu_aio_submit.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_void_p, ctypes.c_int64,
+                                         ctypes.c_int64, ctypes.c_int]
+        lib.dstpu_aio_wait.restype = ctypes.c_int64
+        lib.dstpu_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.dstpu_aio_wait_all.restype = ctypes.c_int64
+        lib.dstpu_aio_wait_all.argtypes = [ctypes.c_void_p]
+        lib.dstpu_aio_pending.restype = ctypes.c_int
+        lib.dstpu_aio_pending.argtypes = [ctypes.c_void_p]
 
 
 class AsyncIOHandle:

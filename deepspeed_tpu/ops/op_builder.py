@@ -1,0 +1,75 @@
+"""Shared JIT-build scaffolding for native (C++) ops.
+
+Reference ``OpBuilder`` (``op_builder/builder.py:514``): compile the shared
+library with the host toolchain on first use, cache by source hash, load via
+ctypes. Subclasses set ``NAME``, ``SRC`` and implement ``_bind(lib)`` to
+declare the C ABI.
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+
+class NativeOpBuilder:
+    NAME: str = ""
+    SRC: str = ""                      # absolute path to the .cpp source
+    EXTRA_FLAGS = ("-march=native",)   # dropped on build failure (portability)
+
+    _lock = threading.Lock()
+    _libs = {}                         # class-level cache keyed by NAME
+
+    def cache_dir(self) -> str:
+        d = os.environ.get("DSTPU_CACHE_DIR",
+                           os.path.join(os.path.expanduser("~"), ".cache",
+                                        "deepspeed_tpu"))
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def src_path(self) -> str:
+        return os.path.normpath(self.SRC)
+
+    def lib_path(self) -> str:
+        with open(self.src_path(), "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        return os.path.join(self.cache_dir(), f"libdstpu_{self.NAME}_{tag}.so")
+
+    def is_compatible(self) -> bool:
+        try:
+            self.load()
+            return True
+        except Exception:
+            return False
+
+    def build(self) -> str:
+        out = self.lib_path()
+        if os.path.exists(out):
+            return out
+        # per-pid tmp + atomic rename: concurrent first-use builds from the
+        # launcher's N local ranks must not corrupt each other's output
+        tmp = f"{out}.tmp.{os.getpid()}"
+        base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread"]
+        try:
+            subprocess.run(base + list(self.EXTRA_FLAGS) +
+                           [self.src_path(), "-o", tmp],
+                           check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError:
+            subprocess.run(base + [self.src_path(), "-o", tmp],
+                           check=True, capture_output=True, text=True)
+        os.replace(tmp, out)
+        return out
+
+    def _bind(self, lib):
+        """Declare restype/argtypes on the loaded CDLL."""
+        raise NotImplementedError
+
+    def load(self):
+        with NativeOpBuilder._lock:
+            lib = NativeOpBuilder._libs.get(self.NAME)
+            if lib is None:
+                lib = ctypes.CDLL(self.build())
+                self._bind(lib)
+                NativeOpBuilder._libs[self.NAME] = lib
+            return lib

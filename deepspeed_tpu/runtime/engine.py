@@ -539,11 +539,24 @@ class DeepSpeedTPUEngine:
         grads, metrics = step_fn(state.params, batch, step_rng, state.step)
         for leaf in jax.tree.leaves(grads):
             leaf.copy_to_host_async()
-        grad_norm = float(np.asarray(metrics["grad_norm"]))
+        self._apply_host_adam(grads, float(np.asarray(metrics["grad_norm"])),
+                              already_clipped=True)
+        return metrics
+
+    def _apply_host_adam(self, grads, grad_norm: float,
+                         already_clipped: bool = False):
+        """Shared host-optimizer apply for train_batch and the compat step():
+        finite check (skip on overflow), clip, lr lookup, native Adam, and
+        the device upload of the new compute-dtype params."""
+        state = self.state
         if not np.isfinite(grad_norm):
-            # skip the update (fp16/bf16 overflow semantics without scaling)
             self.state = state.replace(step=state.step + 1)
-            return metrics
+            return
+        if not already_clipped:
+            clip = self.config.gradient_clipping
+            if clip and clip > 0:
+                coef = min(1.0, clip / (grad_norm + 1e-6))
+                grads = jax.tree.map(lambda g: g * coef, grads)
         lr_t = float(np.asarray(self.lr_schedule(self.global_steps + 1)))
         emit_bf16 = jnp.dtype(self.compute_dtype) == jnp.dtype(jnp.bfloat16)
         new_np = self._host_adam.step(jax.device_get(grads), lr=lr_t,
@@ -551,7 +564,6 @@ class DeepSpeedTPUEngine:
         new_params = jax.device_put(new_np, self._param_shardings)
         self.state = TrainState(step=state.step + 1, params=new_params,
                                 opt_state=(), loss_scale=state.loss_scale)
-        return metrics
 
     def eval_batch(self, batch, compute_loss: bool = True):
         if self._eval_fn is None:
@@ -614,23 +626,8 @@ class DeepSpeedTPUEngine:
         if self._host_adam is not None:
             # route the accumulated grads through the host optimizer (the
             # jitted apply_step below assumes on-device optax state)
-            clip = self.config.gradient_clipping
             grads = jax.tree.map(lambda g: g / self.gas, self._compat_acc)
-            grad_norm = float(np.asarray(global_grad_norm(grads)))
-            if np.isfinite(grad_norm):
-                if clip and clip > 0:
-                    coef = min(1.0, clip / (grad_norm + 1e-6))
-                    grads = jax.tree.map(lambda g: g * coef, grads)
-                lr_t = float(np.asarray(self.lr_schedule(self.global_steps + 1)))
-                emit_bf16 = jnp.dtype(self.compute_dtype) == jnp.dtype(jnp.bfloat16)
-                new_np = self._host_adam.step(jax.device_get(grads), lr=lr_t,
-                                              emit_bf16=emit_bf16)
-                self.state = TrainState(
-                    step=self.state.step + 1,
-                    params=jax.device_put(new_np, self._param_shardings),
-                    opt_state=(), loss_scale=self.state.loss_scale)
-            else:
-                self.state = self.state.replace(step=self.state.step + 1)
+            self._apply_host_adam(grads, float(np.asarray(global_grad_norm(grads))))
             self._compat_acc = None
             self._compat_count = 0
             self.global_steps += 1
