@@ -386,6 +386,62 @@ def make_loss_fn(model: TransformerLM):
     return loss_fn
 
 
+def stack_transformer_params(params, cfg: TransformerConfig):
+    """Re-layout TransformerLM params for the SPMD pipeline: per-layer
+    ``layer_i`` subtrees stack into ``blocks`` ``[L, ...]`` arrays; embedding
+    goes to ``embed``, final norm + lm head to ``head`` (the analogue of
+    handing a layer list to ``PipelineModule``, reference ``module.py:86``).
+
+    Requires homogeneous layers (stacking needs one structure) and untied
+    embeddings (a tied table would appear as two leaves with divergent
+    updates).
+    """
+    if cfg.tie_embeddings:
+        raise ValueError("pipeline bridge needs tie_embeddings=False (a tied "
+                         "table would be two independently-updated leaves)")
+    layers = [params[f"layer_{i}"] for i in range(cfg.num_layers)]
+    structs = {jax.tree.structure(l) for l in layers}
+    if len(structs) > 1:
+        raise ValueError("pipeline stacking needs homogeneous layers (mixed "
+                         "MoE/dense stacks can't share one stage program); "
+                         "set moe_every=1 or num_experts=0")
+    blocks = jax.tree.map(lambda *ls: jnp.stack(ls), *layers)
+    embed = {"embed": params["embed"]}
+    if cfg.position == "learned":
+        embed["pos_embed"] = params["pos_embed"]
+    head = {"final_norm": params["final_norm"], "lm_head": params["lm_head"]}
+    return {"embed": embed, "blocks": blocks, "head": head}
+
+
+def transformer_pipeline_fns(cfg: TransformerConfig):
+    """(embed_fn, block_fn, head_loss_fn) for ``make_pipeline_loss_fn`` over
+    the real TransformerLM block (same math as ``TransformerLM.__call__``,
+    expressed per pipeline stage). MoE aux losses are sown into a collection
+    the pipeline does not thread, so they are excluded here (dense CE only).
+    """
+    block_mod = Block(cfg, layer_idx=0)
+    final_norm_mod = _norm(cfg, "final_norm")  # same module the model uses
+
+    def embed_fn(p, mb):
+        tokens = mb["tokens"] if isinstance(mb, dict) else mb
+        x = p["embed"]["embedding"].astype(cfg.dtype)[tokens]
+        if cfg.position == "learned":
+            x = x + p["pos_embed"][: tokens.shape[1]].astype(cfg.dtype)
+        return x
+
+    def block_fn(lp, x):
+        return block_mod.apply({"params": lp}, x, True)
+
+    def head_loss_fn(p, x, mb):
+        tokens = mb["tokens"] if isinstance(mb, dict) else mb
+        mask = mb.get("loss_mask") if isinstance(mb, dict) else None
+        x = final_norm_mod.apply({"params": p["final_norm"]}, x)
+        logits = x.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
+        return causal_lm_loss(logits, tokens, mask)
+
+    return embed_fn, block_fn, head_loss_fn
+
+
 def init_params(model: TransformerLM, seed: int = 0, batch: int = 2, seq: Optional[int] = None):
     seq = seq or min(model.cfg.max_seq_len, 128)
     tokens = jnp.zeros((batch, seq), jnp.int32)

@@ -33,6 +33,14 @@ def _constrain(x, spec, skip: bool = False):
 
     topo = get_topology()
     if topo.n_devices > 1:
+        # inside shard_map (e.g. the SPMD pipeline body) the mesh axes are
+        # manual: per-shard values carry no global sharding to constrain —
+        # layout is already fixed by the enclosing in_specs
+        manual = getattr(jax.sharding.get_abstract_mesh(), "manual_axes", ())
+        axes_in_spec = {a for entry in spec if entry is not None
+                        for a in (entry if isinstance(entry, tuple) else (entry,))}
+        if axes_in_spec & set(manual):
+            return x
         eff = topo.filter_spec(spec, x.shape)
         return jax.lax.with_sharding_constraint(
             x, jax.sharding.NamedSharding(topo.mesh, eff))

@@ -52,13 +52,19 @@ class NativeOpBuilder:
         tmp = f"{out}.tmp.{os.getpid()}"
         base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread"]
         try:
-            subprocess.run(base + list(self.EXTRA_FLAGS) +
-                           [self.src_path(), "-o", tmp],
-                           check=True, capture_output=True, text=True)
-        except subprocess.CalledProcessError:
-            subprocess.run(base + [self.src_path(), "-o", tmp],
-                           check=True, capture_output=True, text=True)
-        os.replace(tmp, out)
+            try:
+                subprocess.run(base + list(self.EXTRA_FLAGS) +
+                               [self.src_path(), "-o", tmp],
+                               check=True, capture_output=True, text=True)
+            except subprocess.CalledProcessError:
+                if not self.EXTRA_FLAGS:
+                    raise  # nothing to retry without — surface the real error
+                subprocess.run(base + [self.src_path(), "-o", tmp],
+                               check=True, capture_output=True, text=True)
+            os.replace(tmp, out)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
         return out
 
     def _bind(self, lib):

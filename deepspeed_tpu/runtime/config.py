@@ -144,7 +144,10 @@ class PipelineConfig(ConfigModel):
     partition_method: str = "parameters"  # uniform | parameters | type:<regex>
     micro_batches: Optional[int] = None  # default = gradient_accumulation_steps
     activation_checkpoint_interval: int = 0
-    schedule: str = "1f1b"  # 1f1b | gpipe
+    # only 'gpipe': the SPMD circulating pipeline has no instruction list to
+    # reorder — 1F1B-style fwd/bwd interleaving is XLA's scheduling job
+    # (from_pipeline_config rejects anything else)
+    schedule: str = "gpipe"
 
 
 @register_config

@@ -114,22 +114,80 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, microbatches: jnp.ndarr
     return lax.psum(outputs, PP_AXIS)
 
 
+def resolve_partition(num_layers: int, num_stages: int, partition_method: str,
+                      layer_costs=None):
+    """Consume the reference ``partition_method`` knob (``module.py:86``,
+    ``partition_balanced`` ``utils.py:583``) under the SPMD constraint that
+    every stage runs the same program (equal layer counts).
+
+    ``uniform`` splits evenly; ``parameters`` balances ``layer_costs`` (per
+    -layer parameter counts; homogeneous stacked blocks make these equal, so
+    the balanced split IS the uniform one) — if the costs are so skewed that
+    the balanced boundaries are non-uniform, that's unexpressible in the
+    stacked-SPMD layout and we fail loudly rather than silently unbalance.
+    """
+    if num_layers % num_stages:
+        raise ValueError(f"num_layers={num_layers} must divide into {num_stages} stages")
+    per = num_layers // num_stages
+    uniform = list(range(0, num_layers + 1, per))
+    if partition_method in ("uniform", None):
+        return uniform
+    if partition_method == "parameters":
+        costs = layer_costs if layer_costs is not None else [1.0] * num_layers
+        bounds = partition_balanced(costs, num_stages)
+        if bounds != uniform:
+            raise ValueError(
+                f"partition_method='parameters' balanced the layer costs to "
+                f"boundaries {bounds}, but the SPMD pipeline stacks layers "
+                f"[{num_stages}, {per}] and needs a uniform split {uniform}; "
+                "heterogeneous per-stage layer counts are a per-process "
+                "(GPU-style) layout — restructure the costs or use 'uniform'")
+        return bounds
+    raise ValueError(
+        f"partition_method={partition_method!r} is not supported: the SPMD "
+        "pipeline has no module graph to regex over (reference 'type:' "
+        "matching); use 'uniform' or 'parameters'")
+
+
 def make_pipeline_loss_fn(embed_fn: Callable, block_fn: Callable, head_loss_fn: Callable,
-                          *, num_layers: int, num_stages: int, num_microbatches: int):
+                          *, num_layers: int, num_stages: int, num_microbatches: int,
+                          partition_method: str = "uniform",
+                          activation_checkpoint_interval: int = 0,
+                          layer_costs=None):
     """Build an engine-compatible ``loss = f(params, batch)`` running an SPMD
     pipeline (the analogue of wrapping a model in ``PipelineModule``).
 
     params structure: {"embed": ..., "blocks": <stacked [L, ...]>, "head": ...}
     block_fn(block_params, x) -> x applies ONE layer given its [L]-indexed slice.
+    ``activation_checkpoint_interval=k`` rematerializes activations every k
+    layers within a stage (reference ``PipelineModule`` knob, ``module.py:86``).
     """
-    if num_layers % num_stages:
-        raise ValueError(f"num_layers={num_layers} must divide into {num_stages} stages")
+    resolve_partition(num_layers, num_stages, partition_method, layer_costs)
     layers_per_stage = num_layers // num_stages
+    ack = activation_checkpoint_interval
+    if ack and layers_per_stage % ack:
+        raise ValueError(f"activation_checkpoint_interval={ack} must divide "
+                         f"layers_per_stage={layers_per_stage}")
 
     def stage_fn(stage_blocks, x):
         def body(x, layer_params):
             return block_fn(layer_params, x), None
 
+        if ack:
+            # remat groups of `ack` layers: forward stores only group
+            # boundaries, backward recomputes within each group
+            def group(x, group_params):
+                y, _ = lax.scan(body, x, group_params)
+                return y
+
+            def outer(x, group_params):
+                return jax.checkpoint(group)(x, group_params), None
+
+            grouped = jax.tree.map(
+                lambda a: a.reshape((layers_per_stage // ack, ack) + a.shape[1:]),
+                stage_blocks)
+            y, _ = lax.scan(outer, x, grouped)
+            return y
         y, _ = lax.scan(body, x, stage_blocks)
         return y
 
@@ -169,11 +227,14 @@ def make_pipeline_loss_fn(embed_fn: Callable, block_fn: Callable, head_loss_fn: 
         rep = jax.tree.map(lambda _: P(), params["embed"])
         rep_h = jax.tree.map(lambda _: P(), params["head"])
         mb_spec = jax.tree.map(lambda _: P(None, dp), mbs)
+        # ALL mesh axes manual: grad-of-checkpoint inside a partial shard_map
+        # emits residual specs over the auto axes and trips the out_specs
+        # check; unused axes (sp/tp here) just see replicated values
         losses = jax.shard_map(
             pipe_body, mesh=mesh,
             in_specs=(blocks_spec, rep, rep_h, mb_spec),
             out_specs=P(),
-            axis_names={PP_AXIS} | set(dp),
+            axis_names=set(mesh.axis_names),
             check_vma=False)(blocks, params["embed"], params["head"], mbs)
         return jnp.mean(losses)
 
@@ -184,15 +245,27 @@ def make_pipeline_loss_fn(embed_fn: Callable, block_fn: Callable, head_loss_fn: 
     return loss_fn
 
 
-def from_pipeline_config(embed_fn, block_fn, head_loss_fn, *, num_layers: int, config):
+def from_pipeline_config(embed_fn, block_fn, head_loss_fn, *, num_layers: int,
+                         config, layer_costs=None):
     """Build the pipeline loss from a DeepSpeedTPUConfig (wires the reference
     config keys: ``pipeline.stages``, ``pipeline.micro_batches`` with the
-    reference default of ``gradient_accumulation_steps``)."""
-    stages = config.pipeline.stages
-    micro = config.pipeline.micro_batches or config.gradient_accumulation_steps or 1
-    return make_pipeline_loss_fn(embed_fn, block_fn, head_loss_fn,
-                                 num_layers=num_layers, num_stages=stages,
-                                 num_microbatches=micro)
+    reference default of ``gradient_accumulation_steps``,
+    ``partition_method``, ``activation_checkpoint_interval``)."""
+    pc = config.pipeline
+    if pc.schedule != "gpipe":
+        raise ValueError(
+            f"pipeline.schedule={pc.schedule!r}: the SPMD pipeline runs ONE "
+            "circulating program (fill/drain = GPipe bubble) and reverse-mode "
+            "autodiff interleaves fwd/bwd under XLA's scheduler — there is no "
+            "instruction list to reorder, so '1f1b' is not a separate "
+            "schedule here; set schedule='gpipe' (reference schedule.py:189)")
+    micro = pc.micro_batches or config.gradient_accumulation_steps or 1
+    return make_pipeline_loss_fn(
+        embed_fn, block_fn, head_loss_fn, num_layers=num_layers,
+        num_stages=pc.stages, num_microbatches=micro,
+        partition_method=pc.partition_method,
+        activation_checkpoint_interval=pc.activation_checkpoint_interval,
+        layer_costs=layer_costs)
 
 
 def pipeline_param_specs(params, topo=None) -> Any:

