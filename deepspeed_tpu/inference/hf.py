@@ -8,9 +8,13 @@ into the flax params pytree, after which ``InferenceEngine`` shards it over
 the mesh (the TP slicing the reference does tensor-by-tensor is just a
 ``device_put`` with PartitionSpecs here).
 
-Supported families (reference containers ``module_inject/containers/*``):
-llama/llama2/mistral (RoPE+GQA+SwiGLU), gpt2 (learned pos, GELU), and
-mixtral (MoE) — one converter per weight-naming scheme.
+Supported families (reference containers ``module_inject/containers/*`` +
+``inference/v2/model_implementations/*``): llama/llama2/mistral
+(RoPE+GQA+SwiGLU), gpt2 (learned pos, GELU), mixtral (MoE), qwen2 (qkv
+bias), phi3 (fused qkv/gate_up), falcon (parallel residual, GQA/MQA fused
+qkv, optional ALiBi), gpt_neox (parallel residual, partial rotary, fused
+qkv), opt (learned pos offset 2, ReLU) — one converter per weight-naming
+scheme.
 """
 
 from typing import Any, Dict
@@ -33,7 +37,7 @@ def config_from_hf(hf_config) -> TransformerConfig:
     matching in ``replace_policy.py``)."""
     d = hf_config if isinstance(hf_config, dict) else hf_config.to_dict()
     mt = d.get("model_type", "")
-    if mt in ("llama", "mistral", "mixtral"):
+    if mt in ("llama", "mistral", "mixtral", "qwen2", "phi3"):
         cfg = dict(
             vocab_size=d["vocab_size"], hidden_size=d["hidden_size"],
             intermediate_size=d["intermediate_size"],
@@ -47,6 +51,9 @@ def config_from_hf(hf_config) -> TransformerConfig:
         if mt == "mixtral":
             cfg.update(num_experts=d.get("num_local_experts", 8),
                        moe_top_k=d.get("num_experts_per_tok", 2))
+        if mt == "qwen2":
+            # qwen2: rmsnorm model with q/k/v biases (no out/mlp bias)
+            cfg.update(attn_qkv_bias=True)
         return TransformerConfig(**cfg)
     if mt == "gpt2":
         return TransformerConfig(
@@ -56,8 +63,58 @@ def config_from_hf(hf_config) -> TransformerConfig:
             max_seq_len=d["n_positions"], norm="layernorm", activation="gelu",
             position="learned", norm_eps=d.get("layer_norm_epsilon", 1e-5),
             tie_embeddings=True)
-    raise ValueError(f"unsupported HF model_type '{mt}' "
-                     f"(supported: llama, mistral, mixtral, gpt2)")
+    if mt == "falcon":
+        n_head = d["num_attention_heads"]
+        if d.get("multi_query", False) and not d.get("new_decoder_architecture"):
+            n_kv = 1
+        else:
+            n_kv = d.get("num_kv_heads") or n_head
+        return TransformerConfig(
+            vocab_size=d["vocab_size"], hidden_size=d["hidden_size"],
+            intermediate_size=d.get("ffn_hidden_size") or 4 * d["hidden_size"],
+            num_layers=d["num_hidden_layers"], num_heads=n_head,
+            num_kv_heads=n_kv,
+            max_seq_len=d.get("max_position_embeddings", 2048),
+            norm="layernorm", activation="gelu",
+            position="alibi" if d.get("alibi") else "rope",
+            rope_theta=d.get("rope_theta", 10000.0),
+            norm_eps=d.get("layer_norm_epsilon", 1e-5),
+            parallel_residual=d.get("parallel_attn", True),
+            # 7b-style: one input_layernorm feeds attn AND mlp; the
+            # new_decoder_architecture (40b+) has separate ln_attn/ln_mlp
+            parallel_shared_norm=not d.get("new_decoder_architecture", False),
+            attn_qkv_bias=d.get("bias", False), attn_out_bias=d.get("bias", False),
+            mlp_bias=d.get("bias", False), tie_embeddings=True)
+    if mt == "gpt_neox":
+        return TransformerConfig(
+            vocab_size=d["vocab_size"], hidden_size=d["hidden_size"],
+            intermediate_size=d["intermediate_size"],
+            num_layers=d["num_hidden_layers"], num_heads=d["num_attention_heads"],
+            max_seq_len=d.get("max_position_embeddings", 2048),
+            norm="layernorm", activation="gelu", position="rope",
+            rope_theta=d.get("rotary_emb_base", 10000.0),
+            rotary_pct=d.get("rotary_pct", 0.25),
+            norm_eps=d.get("layer_norm_eps", 1e-5),
+            parallel_residual=d.get("use_parallel_residual", True),
+            tie_embeddings=False)
+    if mt == "opt":
+        if d.get("word_embed_proj_dim", d["hidden_size"]) != d["hidden_size"]:
+            raise ValueError("OPT with word_embed_proj_dim != hidden_size "
+                             "(125m-style projection) is not supported")
+        if not d.get("do_layer_norm_before", True):
+            raise ValueError("OPT 350m-style post-layernorm is not supported")
+        return TransformerConfig(
+            vocab_size=d["vocab_size"], hidden_size=d["hidden_size"],
+            intermediate_size=d["ffn_dim"],
+            num_layers=d["num_hidden_layers"], num_heads=d["num_attention_heads"],
+            max_seq_len=d.get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation="relu" if d.get("activation_function", "relu") == "relu"
+            else "gelu",
+            position="learned", pos_offset=2,
+            tie_embeddings=d.get("tie_word_embeddings", True))
+    raise ValueError(f"unsupported HF model_type '{mt}' (supported: llama, "
+                     "mistral, mixtral, qwen2, phi3, gpt2, falcon, gpt_neox, opt)")
 
 
 def _llama_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
@@ -65,17 +122,22 @@ def _llama_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
     p: Dict[str, Any] = {"embed": {"embedding": _t(sd["model.embed_tokens.weight"])}}
     for i in range(cfg.num_layers):
         pre = f"model.layers.{i}."
+        attn = {
+            "q_proj": {"kernel": _t(sd[pre + "self_attn.q_proj.weight"]).T
+                       .reshape(dm, h, dh)},
+            "k_proj": {"kernel": _t(sd[pre + "self_attn.k_proj.weight"]).T
+                       .reshape(dm, hk, dh)},
+            "v_proj": {"kernel": _t(sd[pre + "self_attn.v_proj.weight"]).T
+                       .reshape(dm, hk, dh)},
+            "o_proj": {"kernel": _t(sd[pre + "self_attn.o_proj.weight"]).T
+                       .reshape(h, dh, dm)},
+        }
+        if pre + "self_attn.q_proj.bias" in sd:  # qwen2 qkv bias
+            attn["q_proj"]["bias"] = _t(sd[pre + "self_attn.q_proj.bias"]).reshape(h, dh)
+            attn["k_proj"]["bias"] = _t(sd[pre + "self_attn.k_proj.bias"]).reshape(hk, dh)
+            attn["v_proj"]["bias"] = _t(sd[pre + "self_attn.v_proj.bias"]).reshape(hk, dh)
         layer = {
-            "attn": {
-                "q_proj": {"kernel": _t(sd[pre + "self_attn.q_proj.weight"]).T
-                           .reshape(dm, h, dh)},
-                "k_proj": {"kernel": _t(sd[pre + "self_attn.k_proj.weight"]).T
-                           .reshape(dm, hk, dh)},
-                "v_proj": {"kernel": _t(sd[pre + "self_attn.v_proj.weight"]).T
-                           .reshape(dm, hk, dh)},
-                "o_proj": {"kernel": _t(sd[pre + "self_attn.o_proj.weight"]).T
-                           .reshape(h, dh, dm)},
-            },
+            "attn": attn,
             "attn_norm": {"scale": _t(sd[pre + "input_layernorm.weight"])},
             "mlp_norm": {"scale": _t(sd[pre + "post_attention_layernorm.weight"])},
         }
@@ -144,6 +206,194 @@ def _gpt2_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
     return p
 
 
+def _phi3_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
+    """Phi-3: llama family with FUSED qkv_proj and gate_up_proj weights."""
+    h, hk, dh, dm = cfg.num_heads, cfg.kv_heads, cfg.head_dim, cfg.hidden_size
+    f = cfg.intermediate_size
+    p: Dict[str, Any] = {"embed": {"embedding": _t(sd["model.embed_tokens.weight"])}}
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        qkv = _t(sd[pre + "self_attn.qkv_proj.weight"])      # [(h+2hk)dh, D]
+        qw, kw, vw = np.split(qkv, [h * dh, (h + hk) * dh], axis=0)
+        gu = _t(sd[pre + "mlp.gate_up_proj.weight"])         # [2F, D]
+        gw, uw = np.split(gu, 2, axis=0)
+        p[f"layer_{i}"] = {
+            "attn": {
+                "q_proj": {"kernel": qw.T.reshape(dm, h, dh)},
+                "k_proj": {"kernel": kw.T.reshape(dm, hk, dh)},
+                "v_proj": {"kernel": vw.T.reshape(dm, hk, dh)},
+                "o_proj": {"kernel": _t(sd[pre + "self_attn.o_proj.weight"]).T
+                           .reshape(h, dh, dm)},
+            },
+            "attn_norm": {"scale": _t(sd[pre + "input_layernorm.weight"])},
+            "mlp_norm": {"scale": _t(sd[pre + "post_attention_layernorm.weight"])},
+            "mlp": {"gate_proj": {"kernel": gw.T}, "up_proj": {"kernel": uw.T},
+                    "down_proj": {"kernel": _t(sd[pre + "mlp.down_proj.weight"]).T}},
+        }
+    p["final_norm"] = {"scale": _t(sd["model.norm.weight"])}
+    if not cfg.tie_embeddings:
+        p["lm_head"] = {"kernel": _t(sd["lm_head.weight"]).T}
+    return p
+
+
+def _split_falcon_qkv(w, cfg: TransformerConfig, d: Dict[str, Any],
+                      is_bias: bool = False):
+    """Un-fuse falcon's query_key_value along its three historical layouts.
+    ``is_bias``: the fused bias vector shares the layout minus the input dim."""
+    h, hk, dh = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    dm = () if is_bias else (cfg.hidden_size,)
+    if d.get("new_decoder_architecture", False):
+        # per kv-group: [q * (h/hk), k, v] heads interleaved
+        g = h // hk
+        w = w.reshape(hk, g + 2, dh, *dm)
+        qw = w[:, :g].reshape(h, dh, *dm)
+        kw = w[:, g].reshape(hk, dh, *dm)
+        vw = w[:, g + 1].reshape(hk, dh, *dm)
+    elif d.get("multi_query", False):
+        # [all q heads, one k, one v]
+        qw = w[: h * dh].reshape(h, dh, *dm)
+        kw = w[h * dh: (h + 1) * dh].reshape(1, dh, *dm)
+        vw = w[(h + 1) * dh:].reshape(1, dh, *dm)
+    else:
+        # per head [q, k, v] interleaved (falcon-rw)
+        w = w.reshape(h, 3, dh, *dm)
+        qw, kw, vw = w[:, 0], w[:, 1], w[:, 2]
+    if is_bias:
+        return qw, kw, vw
+    # torch [out, in] slices -> flax [in, heads, dh]
+    to_flax = lambda a: np.transpose(a, (2, 0, 1))
+    return to_flax(qw), to_flax(kw), to_flax(vw)
+
+
+def _falcon_params(sd: Dict[str, Any], cfg: TransformerConfig,
+                   d: Dict[str, Any]) -> Dict[str, Any]:
+    h, dh, dm = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    p: Dict[str, Any] = {
+        "embed": {"embedding": _t(sd["transformer.word_embeddings.weight"])}}
+    new_arch = d.get("new_decoder_architecture", False)
+    for i in range(cfg.num_layers):
+        pre = f"transformer.h.{i}."
+        qw, kw, vw = _split_falcon_qkv(
+            _t(sd[pre + "self_attention.query_key_value.weight"]), cfg, d)
+        layer = {
+            "attn": {
+                "q_proj": {"kernel": qw}, "k_proj": {"kernel": kw},
+                "v_proj": {"kernel": vw},
+                "o_proj": {"kernel": _t(sd[pre + "self_attention.dense.weight"]).T
+                           .reshape(h, dh, dm)},
+            },
+            "mlp": {
+                "up_proj": {"kernel": _t(sd[pre + "mlp.dense_h_to_4h.weight"]).T},
+                "down_proj": {"kernel": _t(sd[pre + "mlp.dense_4h_to_h.weight"]).T},
+            },
+        }
+        if d.get("bias", False):  # falcon-rw style checkpoints carry biases
+            qb, kb, vb = _split_falcon_qkv(
+                _t(sd[pre + "self_attention.query_key_value.bias"]), cfg, d,
+                is_bias=True)
+            layer["attn"]["q_proj"]["bias"] = qb
+            layer["attn"]["k_proj"]["bias"] = kb
+            layer["attn"]["v_proj"]["bias"] = vb
+            layer["attn"]["o_proj"]["bias"] = _t(sd[pre + "self_attention.dense.bias"])
+            layer["mlp"]["up_proj"]["bias"] = _t(sd[pre + "mlp.dense_h_to_4h.bias"])
+            layer["mlp"]["down_proj"]["bias"] = _t(sd[pre + "mlp.dense_4h_to_h.bias"])
+        if new_arch:
+            layer["attn_norm"] = {"scale": _t(sd[pre + "ln_attn.weight"]),
+                                  "bias": _t(sd[pre + "ln_attn.bias"])}
+            layer["mlp_norm"] = {"scale": _t(sd[pre + "ln_mlp.weight"]),
+                                 "bias": _t(sd[pre + "ln_mlp.bias"])}
+        else:
+            layer["attn_norm"] = {"scale": _t(sd[pre + "input_layernorm.weight"]),
+                                  "bias": _t(sd[pre + "input_layernorm.bias"])}
+            if not (cfg.parallel_residual and cfg.parallel_shared_norm):
+                # sequential falcon-rw keeps a post-attention norm
+                layer["mlp_norm"] = {
+                    "scale": _t(sd[pre + "post_attention_layernorm.weight"]),
+                    "bias": _t(sd[pre + "post_attention_layernorm.bias"])}
+        p[f"layer_{i}"] = layer
+    p["final_norm"] = {"scale": _t(sd["transformer.ln_f.weight"]),
+                       "bias": _t(sd["transformer.ln_f.bias"])}
+    return p
+
+
+def _neox_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
+    h, dh, dm = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    p: Dict[str, Any] = {
+        "embed": {"embedding": _t(sd["gpt_neox.embed_in.weight"])}}
+    for i in range(cfg.num_layers):
+        pre = f"gpt_neox.layers.{i}."
+        # fused qkv, per-head [q, k, v] interleaved: [h, 3, dh, D]
+        w = _t(sd[pre + "attention.query_key_value.weight"]).reshape(h, 3, dh, dm)
+        b = _t(sd[pre + "attention.query_key_value.bias"]).reshape(h, 3, dh)
+        to_flax = lambda a: np.transpose(a, (2, 0, 1))
+        p[f"layer_{i}"] = {
+            "attn": {
+                "q_proj": {"kernel": to_flax(w[:, 0]), "bias": b[:, 0]},
+                "k_proj": {"kernel": to_flax(w[:, 1]), "bias": b[:, 1]},
+                "v_proj": {"kernel": to_flax(w[:, 2]), "bias": b[:, 2]},
+                "o_proj": {"kernel": _t(sd[pre + "attention.dense.weight"]).T
+                           .reshape(h, dh, dm),
+                           "bias": _t(sd[pre + "attention.dense.bias"])},
+            },
+            "attn_norm": {"scale": _t(sd[pre + "input_layernorm.weight"]),
+                          "bias": _t(sd[pre + "input_layernorm.bias"])},
+            "mlp_norm": {"scale": _t(sd[pre + "post_attention_layernorm.weight"]),
+                         "bias": _t(sd[pre + "post_attention_layernorm.bias"])},
+            "mlp": {
+                "up_proj": {"kernel": _t(sd[pre + "mlp.dense_h_to_4h.weight"]).T,
+                            "bias": _t(sd[pre + "mlp.dense_h_to_4h.bias"])},
+                "down_proj": {"kernel": _t(sd[pre + "mlp.dense_4h_to_h.weight"]).T,
+                              "bias": _t(sd[pre + "mlp.dense_4h_to_h.bias"])},
+            },
+        }
+    p["final_norm"] = {"scale": _t(sd["gpt_neox.final_layer_norm.weight"]),
+                       "bias": _t(sd["gpt_neox.final_layer_norm.bias"])}
+    p["lm_head"] = {"kernel": _t(sd["embed_out.weight"]).T}
+    return p
+
+
+def _opt_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
+    h, dh, dm = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    p: Dict[str, Any] = {
+        "embed": {"embedding": _t(sd["model.decoder.embed_tokens.weight"])},
+        # OPT's table embeds position+2 — rows align with our pos_offset=2
+        "pos_embed": _t(sd["model.decoder.embed_positions.weight"]),
+    }
+    for i in range(cfg.num_layers):
+        pre = f"model.decoder.layers.{i}."
+        p[f"layer_{i}"] = {
+            "attn": {
+                "q_proj": {"kernel": _t(sd[pre + "self_attn.q_proj.weight"]).T
+                           .reshape(dm, h, dh),
+                           "bias": _t(sd[pre + "self_attn.q_proj.bias"]).reshape(h, dh)},
+                "k_proj": {"kernel": _t(sd[pre + "self_attn.k_proj.weight"]).T
+                           .reshape(dm, h, dh),
+                           "bias": _t(sd[pre + "self_attn.k_proj.bias"]).reshape(h, dh)},
+                "v_proj": {"kernel": _t(sd[pre + "self_attn.v_proj.weight"]).T
+                           .reshape(dm, h, dh),
+                           "bias": _t(sd[pre + "self_attn.v_proj.bias"]).reshape(h, dh)},
+                "o_proj": {"kernel": _t(sd[pre + "self_attn.out_proj.weight"]).T
+                           .reshape(h, dh, dm),
+                           "bias": _t(sd[pre + "self_attn.out_proj.bias"])},
+            },
+            "attn_norm": {"scale": _t(sd[pre + "self_attn_layer_norm.weight"]),
+                          "bias": _t(sd[pre + "self_attn_layer_norm.bias"])},
+            "mlp_norm": {"scale": _t(sd[pre + "final_layer_norm.weight"]),
+                         "bias": _t(sd[pre + "final_layer_norm.bias"])},
+            "mlp": {
+                "up_proj": {"kernel": _t(sd[pre + "fc1.weight"]).T,
+                            "bias": _t(sd[pre + "fc1.bias"])},
+                "down_proj": {"kernel": _t(sd[pre + "fc2.weight"]).T,
+                              "bias": _t(sd[pre + "fc2.bias"])},
+            },
+        }
+    p["final_norm"] = {"scale": _t(sd["model.decoder.final_layer_norm.weight"]),
+                       "bias": _t(sd["model.decoder.final_layer_norm.bias"])}
+    if not cfg.tie_embeddings:
+        p["lm_head"] = {"kernel": _t(sd["lm_head.weight"]).T}
+    return p
+
+
 def params_from_hf(model_or_state_dict, hf_config=None):
     """Convert a HF model (or its state_dict + config) → ``(TransformerConfig,
     params)`` ready for ``InferenceEngine`` / the training engine."""
@@ -154,9 +404,19 @@ def params_from_hf(model_or_state_dict, hf_config=None):
         sd = dict(model_or_state_dict)
         if hf_config is None:
             raise ValueError("pass hf_config when giving a raw state_dict")
+    d = hf_config if isinstance(hf_config, dict) else hf_config.to_dict()
+    mt = d.get("model_type", "")
     cfg = config_from_hf(hf_config)
-    if cfg.position == "rope":
+    if mt in ("llama", "mistral", "mixtral", "qwen2"):
         params = _llama_params(sd, cfg)
+    elif mt == "phi3":
+        params = _phi3_params(sd, cfg)
+    elif mt == "falcon":
+        params = _falcon_params(sd, cfg, d)
+    elif mt == "gpt_neox":
+        params = _neox_params(sd, cfg)
+    elif mt == "opt":
+        params = _opt_params(sd, cfg)
     else:
         params = _gpt2_params(sd, cfg)
     return cfg, _to_jnp(params)

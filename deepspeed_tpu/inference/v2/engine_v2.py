@@ -51,6 +51,13 @@ class InferenceEngineV2:
         self.config = config or RaggedInferenceEngineConfig()
         c = self.config
         self.cfg: TransformerConfig = model.cfg
+        if (self.cfg.parallel_residual or self.cfg.position == "alibi"
+                or self.cfg.pos_offset or self.cfg.activation == "relu"):
+            raise NotImplementedError(
+                "inference v2's ragged forward covers the sequential-residual "
+                "rope/learned (no offset) swiglu/gelu families; use the v1 "
+                "engine for parallel-residual (falcon/neox), ALiBi, or "
+                "OPT-style (pos offset / relu) models")
         dtype = jnp.dtype(c.dtype)
         self.params = jax.tree.map(
             lambda x: jnp.asarray(x, dtype) if jnp.issubdtype(

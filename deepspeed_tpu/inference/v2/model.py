@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...models.transformer import TransformerConfig, rope_table
+from ...models.transformer import TransformerConfig, apply_rope, rope_table
 from ...ops.pallas.paged_attention import paged_attention as paged_attention_pallas
 
 
@@ -85,12 +85,10 @@ def _lm_logits(cfg, params, h_sel):
 
 
 def _rope(x, cos, sin, positions):
-    """x: [T, H, D]; positions: [T]."""
-    cos_p = cos[positions][:, None, :]
-    sin_p = sin[positions][:, None, :]
-    x1, x2 = jnp.split(x, 2, axis=-1)
-    return jnp.concatenate([x1 * cos_p - x2 * sin_p,
-                            x2 * cos_p + x1 * sin_p], axis=-1).astype(x.dtype)
+    """x: [T, H, D]; positions: [T] — the shared rotary
+    (models.transformer.apply_rope, incl. partial rotary) over a flat token
+    buffer, expressed as a batch of one."""
+    return apply_rope(x[None], cos, sin, positions[None])[0]
 
 
 def paged_attention(qg, k_pool, v_pool, block_table, positions_g, q_valid, kv_len):
@@ -144,7 +142,7 @@ def _ragged_forward_impl(params, cfg: TransformerConfig, kv_k, kv_v, tokens,
     if cfg.position == "learned":
         x = x + params["pos_embed"][positions].astype(dtype)
     if cfg.position == "rope":
-        cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+        cos, sin = rope_table(cfg.max_seq_len, cfg.rotary_dim, cfg.rope_theta)
 
     q_valid = gather_idx < T                                        # [S, Q]
     safe_gather = jnp.minimum(gather_idx, T - 1)
@@ -268,7 +266,7 @@ def decode_loop(params, cfg: TransformerConfig, kv_k, kv_v, tokens0, pos0,
     bs = kv_k.shape[3]
     dtype = cfg.dtype
     if cfg.position == "rope":
-        cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+        cos, sin = rope_table(cfg.max_seq_len, cfg.rotary_dim, cfg.rope_theta)
     ones = jnp.ones((S,), jnp.int32)
 
     def forward_one(kv_k, kv_v, toks, pos):

@@ -38,12 +38,21 @@ class TransformerConfig:
     max_seq_len: int = 2048
     # family switches
     norm: str = "rmsnorm"                       # rmsnorm (llama) | layernorm (gpt2)
-    activation: str = "swiglu"                  # swiglu (llama) | gelu (gpt2)
-    position: str = "rope"                      # rope (llama) | learned (gpt2)
+    activation: str = "swiglu"                  # swiglu (llama) | gelu (gpt2) | relu (opt)
+    position: str = "rope"                      # rope (llama) | learned (gpt2) | alibi (falcon-rw)
     tie_embeddings: bool = False
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dropout: float = 0.0
+    # architecture flags for the HF container zoo (reference
+    # module_inject/containers/*): None = follow the norm-type heuristic
+    attn_qkv_bias: Optional[bool] = None        # qwen2: True with rmsnorm
+    attn_out_bias: Optional[bool] = None
+    mlp_bias: Optional[bool] = None
+    parallel_residual: bool = False             # falcon / gpt-neox / gpt-j
+    parallel_shared_norm: bool = False          # falcon-7b: one norm feeds both
+    rotary_pct: float = 1.0                     # gpt-neox partial rotary
+    pos_offset: int = 0                         # OPT: learned pos ids offset 2
     # MoE (mixtral): replace the MLP every `moe_every` layers
     num_experts: int = 0
     moe_top_k: int = 2
@@ -67,6 +76,25 @@ class TransformerConfig:
     def kv_heads(self):
         return self.num_kv_heads or self.num_heads
 
+    @property
+    def rotary_dim(self):
+        d = int(self.head_dim * self.rotary_pct)
+        return d - d % 2  # rope rotates pairs
+
+    @property
+    def qkv_bias(self):
+        return (self.norm == "layernorm" if self.attn_qkv_bias is None
+                else self.attn_qkv_bias)
+
+    @property
+    def out_bias(self):
+        return (self.norm == "layernorm" if self.attn_out_bias is None
+                else self.attn_out_bias)
+
+    @property
+    def ffn_bias(self):
+        return self.norm == "layernorm" if self.mlp_bias is None else self.mlp_bias
+
 
 def _norm(cfg, name):
     if cfg.norm == "rmsnorm":
@@ -82,23 +110,50 @@ def rope_table(seq_len: int, head_dim: int, theta: float):
 
 
 def apply_rope(x, cos, sin, positions=None):
-    """x: [B, S, H, D]; rotate pairs (even, odd) halves interleaved-free."""
+    """x: [B, S, H, D]; rotate pairs (even, odd) halves interleaved-free.
+    Partial rotary (gpt-neox ``rotary_pct``): when the table covers fewer
+    dims than D, only the leading ``2 * cos.shape[-1]`` dims rotate."""
+    rot = 2 * cos.shape[-1]
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
     if positions is None:
         cos_p = cos[None, :x.shape[1], None, :]
         sin_p = sin[None, :x.shape[1], None, :]
     else:
         cos_p = cos[positions][:, :, None, :]
         sin_p = sin[positions][:, :, None, :]
-    x1, x2 = jnp.split(x, 2, axis=-1)
+    x1, x2 = jnp.split(x_rot, 2, axis=-1)
     out = jnp.concatenate([x1 * cos_p - x2 * sin_p, x2 * cos_p + x1 * sin_p], axis=-1)
+    if x_pass.shape[-1]:
+        out = jnp.concatenate([out, x_pass], axis=-1)
     return out.astype(x.dtype)
 
 
+def alibi_slopes(num_heads: int) -> np.ndarray:
+    """ALiBi per-head slopes (Press et al.; matches the HF implementation
+    used by falcon/bloom — geometric in 2^(-8/n), extended for non-pow2)."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(np.log2(n) - 3)))
+        return start * (start ** np.arange(n))
+
+    n2 = 2 ** int(np.floor(np.log2(num_heads)))
+    slopes = pow2_slopes(n2)
+    if n2 != num_heads:
+        extra = pow2_slopes(2 * n2)[0::2][: num_heads - n2]
+        slopes = np.concatenate([slopes, extra])
+    # HF build_alibi_tensor rounds the slopes through bfloat16 — match it so
+    # converted checkpoints reproduce logits bit-closely
+    import ml_dtypes
+
+    return slopes.astype(ml_dtypes.bfloat16).astype(np.float32)
+
+
 def attention_core(q, k, v, *, causal: bool = True, impl: str = "auto",
-                   positions_q=None, positions_kv=None):
+                   positions_q=None, positions_kv=None, alibi=None):
     """[B, S, H, D] attention. ``flash`` uses the Pallas kernel on TPU;
-    ``xla`` is the jnp reference (fused well by XLA on small shapes)."""
-    if impl == "flash":
+    ``xla`` is the jnp reference (fused well by XLA on small shapes).
+    ``alibi``: per-head slopes [H] — adds ``-slope * (pos_q - pos_k)`` to the
+    logits (Press et al.; reference bloom/falcon containers)."""
+    if impl == "flash" and alibi is None:
         from ..ops.pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal)
@@ -113,9 +168,15 @@ def attention_core(q, k, v, *, causal: bool = True, impl: str = "auto",
     # fp32 accumulation off the MXU (free on TPU), so softmax sees full precision
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
+    pq = positions_q if positions_q is not None else jnp.arange(sq)[:, None]
+    pk = positions_kv if positions_kv is not None else jnp.arange(skv)[None, :]
+    if alibi is not None:
+        # falcon/bloom apply the bias BEFORE the 1/sqrt(d) scaling (HF
+        # modeling_falcon.py: (scores + alibi) * inv_norm_factor) — fold the
+        # scale into the slope to match
+        dist = (pq - pk).astype(jnp.float32)                 # [sq, skv]
+        logits = logits - (scale * jnp.asarray(alibi))[None, :, None, None] * dist[None, None]
     if causal:
-        pq = positions_q if positions_q is not None else jnp.arange(sq)[:, None]
-        pk = positions_kv if positions_kv is not None else jnp.arange(skv)[None, :]
         mask = pq >= pk  # [sq, skv]
         logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
@@ -132,7 +193,7 @@ def _update_cache(cache_kv, new_kv, cache_index):
     return jax.vmap(upd)(cache_kv, new_kv, cache_index)
 
 
-def cached_attention(q, k_cache, v_cache, q_pos):
+def cached_attention(q, k_cache, v_cache, q_pos, alibi=None):
     """Decode attention over the full KV cache with per-sequence validity:
     cache slot j attends iff ``j <= q_pos`` (absolute position), which also
     masks unwritten slots. q: [B,S,H,D]; caches: [B,M,Hk,D]; q_pos: [B,S].
@@ -145,7 +206,13 @@ def cached_attention(q, k_cache, v_cache, q_pos):
     scale = 1.0 / np.sqrt(d)
     logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k_cache.astype(q.dtype),
                         preferred_element_type=jnp.float32) * scale
-    mask = jnp.arange(m)[None, None, None, None, :] <= q_pos[:, None, None, :, None]
+    slot = jnp.arange(m)[None, None, None, None, :]
+    if alibi is not None:
+        # pre-scaling bias convention (see attention_core)
+        dist = (q_pos[:, None, None, :, None] - slot).astype(jnp.float32)
+        sl = scale * jnp.asarray(alibi).reshape(hk, rep)
+        logits = logits - sl[None, :, :, None, None] * dist
+    mask = slot <= q_pos[:, None, None, :, None]
     logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v_cache.astype(q.dtype))
@@ -160,17 +227,18 @@ class Attention(nn.Module):
                  whole_prefill=False):
         cfg = self.cfg
         h, hk, d = cfg.num_heads, cfg.kv_heads, cfg.head_dim
-        dense = partial(nn.DenseGeneral, use_bias=(cfg.norm == "layernorm"),
+        dense = partial(nn.DenseGeneral, use_bias=cfg.qkv_bias,
                         dtype=cfg.dtype, param_dtype=jnp.float32)
         q = dense(features=(h, d), name="q_proj")(x)
         k = dense(features=(hk, d), name="k_proj")(x)
         v = dense(features=(hk, d), name="v_proj")(x)
 
         if cfg.position == "rope":
-            cos, sin = rope_table(cfg.max_seq_len, d, cfg.rope_theta)
+            cos, sin = rope_table(cfg.max_seq_len, cfg.rotary_dim, cfg.rope_theta)
+        alibi = alibi_slopes(h) if cfg.position == "alibi" else None
 
         o_proj = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
-                                 use_bias=(cfg.norm == "layernorm"), dtype=cfg.dtype,
+                                 use_bias=cfg.out_bias, dtype=cfg.dtype,
                                  param_dtype=jnp.float32, name="o_proj")
 
         if cache is not None:
@@ -187,21 +255,29 @@ class Attention(nn.Module):
                 # over the cache's unwritten capacity. Without the static
                 # whole_prefill promise, chunked multi-token calls take the
                 # full-cache path, which is correct for any cache_index.
-                out = attention_core(q, k, v, causal=True, impl="xla")
+                out = attention_core(q, k, v, causal=True, impl="xla",
+                                     alibi=alibi)
             else:
-                out = cached_attention(q, new_cache["k"], new_cache["v"], positions)
+                out = cached_attention(q, new_cache["k"], new_cache["v"],
+                                       positions, alibi=alibi)
             return o_proj(out), new_cache
 
         impl = cfg.attn_impl
         if impl == "auto":
             # flash on real accelerators when the seq tiles cleanly; the XLA
-            # reference (O(S^2) logits) on CPU tests and odd shapes
+            # reference (O(S^2) logits) on CPU tests, odd shapes, and alibi
+            # (the flash kernel takes no additive bias)
             seq = x.shape[1]
-            impl = "flash" if (jax.default_backend() != "cpu" and seq % 128 == 0) else "xla"
+            impl = "flash" if (jax.default_backend() != "cpu" and seq % 128 == 0
+                               and alibi is None) else "xla"
 
         # Ulysses only in real execution: flax init traces tiny batches that
         # need not divide the mesh, and attention adds no params anyway.
         if cfg.sequence_parallel and not self.is_initializing():
+            if alibi is not None:
+                raise NotImplementedError(
+                    "ALiBi + Ulysses sequence parallelism is unsupported: the "
+                    "head all-to-all would need per-shard slope slices")
             from ..sequence.layer import ulysses_attention
 
             def local_attn(q_, k_, v_, pos):
@@ -215,7 +291,7 @@ class Attention(nn.Module):
             if cfg.position == "rope":
                 q = apply_rope(q, cos, sin)
                 k = apply_rope(k, cos, sin)
-            out = attention_core(q, k, v, causal=True, impl=impl)
+            out = attention_core(q, k, v, causal=True, impl=impl, alibi=alibi)
 
         out = o_proj(out)
         if cfg.dropout > 0 and not deterministic:
@@ -229,7 +305,7 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        bias = cfg.norm == "layernorm"
+        bias = cfg.ffn_bias
         if cfg.activation == "swiglu":
             gate = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype,
                             param_dtype=jnp.float32, name="gate_proj")(x)
@@ -239,7 +315,7 @@ class MLP(nn.Module):
         else:
             hidden = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype,
                               param_dtype=jnp.float32, name="up_proj")(x)
-            hidden = nn.gelu(hidden)
+            hidden = nn.relu(hidden) if cfg.activation == "relu" else nn.gelu(hidden)
         return nn.Dense(cfg.hidden_size, use_bias=bias, dtype=cfg.dtype,
                         param_dtype=jnp.float32, name="down_proj")(hidden)
 
@@ -261,17 +337,25 @@ class Block(nn.Module):
                                        whole_prefill=whole_prefill)
         else:
             attn_out, new_cache = attn(y, deterministic=deterministic), None
-        x = x + attn_out
-        y = _norm(cfg, "mlp_norm")(x)
-        use_moe = cfg.num_experts > 0 and (self.layer_idx % cfg.moe_every == 0)
-        if use_moe:
-            from ..moe.layer import MoEBlock
 
-            mlp_out, aux = MoEBlock(cfg, name="moe")(y)
-            self.sow("intermediates", "moe_aux_loss", aux)
+        def mlp_of(z):
+            use_moe = cfg.num_experts > 0 and (self.layer_idx % cfg.moe_every == 0)
+            if use_moe:
+                from ..moe.layer import MoEBlock
+
+                out, aux = MoEBlock(cfg, name="moe")(z)
+                self.sow("intermediates", "moe_aux_loss", aux)
+                return out
+            return MLP(cfg, name="mlp")(z)
+
+        if cfg.parallel_residual:
+            # falcon / gpt-neox: attn and mlp both branch off x and sum into
+            # the residual; falcon-7b feeds BOTH from one norm
+            y_mlp = y if cfg.parallel_shared_norm else _norm(cfg, "mlp_norm")(x)
+            out = x + attn_out + mlp_of(y_mlp)
         else:
-            mlp_out = MLP(cfg, name="mlp")(y)
-        out = x + mlp_out
+            x = x + attn_out
+            out = x + mlp_of(_norm(cfg, "mlp_norm")(x))
         return (out, new_cache) if cache is not None else out
 
 
@@ -291,12 +375,14 @@ class TransformerLM(nn.Module):
         x = embed(tokens)
         if cfg.position == "learned":
             pos_emb = self.param("pos_embed", nn.initializers.normal(0.02),
-                                 (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
+                                 (cfg.max_seq_len + cfg.pos_offset,
+                                  cfg.hidden_size), jnp.float32)
+            off = cfg.pos_offset  # OPT embeds positions shifted by 2
             if cache is not None:
                 positions = cache_index[:, None] + jnp.arange(tokens.shape[1])[None, :]
-                x = x + pos_emb[positions].astype(cfg.dtype)
+                x = x + pos_emb[positions + off].astype(cfg.dtype)
             else:
-                x = x + pos_emb[None, :x.shape[1]].astype(cfg.dtype)
+                x = x + pos_emb[None, off:off + x.shape[1]].astype(cfg.dtype)
 
         block = Block
         if cfg.remat and cache is None:
@@ -426,7 +512,8 @@ def transformer_pipeline_fns(cfg: TransformerConfig):
         tokens = mb["tokens"] if isinstance(mb, dict) else mb
         x = p["embed"]["embedding"].astype(cfg.dtype)[tokens]
         if cfg.position == "learned":
-            x = x + p["pos_embed"][: tokens.shape[1]].astype(cfg.dtype)
+            off = cfg.pos_offset
+            x = x + p["pos_embed"][off: off + tokens.shape[1]].astype(cfg.dtype)
         return x
 
     def block_fn(lp, x):

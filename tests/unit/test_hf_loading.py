@@ -82,3 +82,155 @@ def test_hf_weights_into_inference_engine():
 def test_config_from_hf_rejects_unknown():
     with pytest.raises(ValueError, match="unsupported"):
         config_from_hf({"model_type": "resnet"})
+
+
+# ---------------------------------------------------------------------------
+# round-3 breadth: qwen2, phi3, falcon (3 qkv layouts + parallel residual),
+# gpt-neox (partial rotary), opt (pos offset, relu)
+# ---------------------------------------------------------------------------
+
+
+def _golden(hf_model, vocab, seed=0, seq=12, **assert_cfg):
+    cfg, params = params_from_hf(hf_model)
+    for k, v in assert_cfg.items():
+        assert getattr(cfg, k) == v, (k, getattr(cfg, k), v)
+    model = TransformerLM(type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32}))
+    toks = np.random.default_rng(seed).integers(0, vocab, (2, seq))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(toks)).logits
+    ours = model.apply({"params": params}, jnp.asarray(toks, jnp.int32))
+    _logits_close(ours, ref)
+    return cfg
+
+
+def test_qwen2_parity():
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False)
+    torch.manual_seed(3)
+    _golden(transformers.Qwen2ForCausalLM(hf_cfg).eval(), 128, seed=3,
+            attn_qkv_bias=True, norm="rmsnorm")
+
+
+def test_phi3_parity():
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        resid_pdrop=0.0, embd_pdrop=0.0, attention_dropout=0.0,
+        pad_token_id=0)  # phi3 default pad id exceeds the tiny vocab
+    torch.manual_seed(4)
+    _golden(transformers.Phi3ForCausalLM(hf_cfg).eval(), 128, seed=4,
+            norm="rmsnorm", activation="swiglu")
+
+
+def test_falcon7b_style_parity():
+    """multi_query + parallel_attn + shared input_layernorm (falcon-7b)."""
+    hf_cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=True, parallel_attn=True,
+        new_decoder_architecture=False, bias=False, alibi=False,
+        max_position_embeddings=64,
+        hidden_dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(5)
+    _golden(transformers.FalconForCausalLM(hf_cfg).eval(), 128, seed=5,
+            num_kv_heads=1, parallel_residual=True, parallel_shared_norm=True)
+
+
+def test_falcon40b_style_parity():
+    """new_decoder_architecture: GQA fused qkv + separate ln_attn/ln_mlp."""
+    hf_cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_kv_heads=2, multi_query=False,
+        parallel_attn=True, new_decoder_architecture=True, bias=False,
+        alibi=False, max_position_embeddings=64,
+        hidden_dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(6)
+    _golden(transformers.FalconForCausalLM(hf_cfg).eval(), 128, seed=6,
+            num_kv_heads=2, parallel_shared_norm=False)
+
+
+def test_falcon_alibi_parity():
+    """falcon-rw style: ALiBi positions, no parallel attn, per-head qkv."""
+    hf_cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=False, parallel_attn=False,
+        new_decoder_architecture=False, bias=False, alibi=True,
+        max_position_embeddings=64,
+        hidden_dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(7)
+    _golden(transformers.FalconForCausalLM(hf_cfg).eval(), 128, seed=7,
+            position="alibi", parallel_residual=False)
+
+
+def test_gptneox_parity():
+    """parallel residual + partial rotary (rotary_pct=0.25) + fused qkv."""
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, rotary_pct=0.25,
+        max_position_embeddings=64, use_parallel_residual=True,
+        hidden_dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(8)
+    _golden(transformers.GPTNeoXForCausalLM(hf_cfg).eval(), 128, seed=8,
+            rotary_pct=0.25, parallel_residual=True, parallel_shared_norm=False)
+
+
+def test_opt_parity():
+    hf_cfg = transformers.OPTConfig(
+        vocab_size=128, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        activation_function="relu", do_layer_norm_before=True,
+        dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(9)
+    _golden(transformers.OPTForCausalLM(hf_cfg).eval(), 128, seed=9,
+            position="learned", pos_offset=2, activation="relu",
+            tie_embeddings=True)
+
+
+@pytest.mark.parametrize("family", ["falcon7b", "opt", "neox"])
+def test_new_family_generate_matches_hf(family):
+    """v1 engine greedy continuation == HF generate for the new-architecture
+    decode paths (parallel residual / pos offset / partial rotary caches)."""
+    torch.manual_seed(11)
+    if family == "falcon7b":
+        hf = transformers.FalconForCausalLM(transformers.FalconConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, multi_query=True, parallel_attn=True,
+            new_decoder_architecture=False, bias=False, alibi=False,
+            max_position_embeddings=64, hidden_dropout=0.0,
+            attention_dropout=0.0)).eval()
+    elif family == "opt":
+        hf = transformers.OPTForCausalLM(transformers.OPTConfig(
+            vocab_size=128, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64,
+            activation_function="relu", do_layer_norm_before=True,
+            dropout=0.0, attention_dropout=0.0)).eval()
+    else:
+        hf = transformers.GPTNeoXForCausalLM(transformers.GPTNeoXConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, rotary_pct=0.25,
+            max_position_embeddings=64, use_parallel_residual=True,
+            hidden_dropout=0.0, attention_dropout=0.0)).eval()
+    cfg, params = params_from_hf(hf)
+    model = TransformerLM(type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32}))
+    eng = InferenceEngine(model, params,
+                          DeepSpeedInferenceConfig(dtype="float32", max_out_tokens=32))
+    prompts = jnp.asarray(np.random.default_rng(11).integers(0, 128, (2, 6)), jnp.int32)
+    out = eng.generate(prompts, max_new_tokens=4)
+    with torch.no_grad():
+        hf_out = hf.generate(torch.tensor(np.asarray(prompts)), max_new_tokens=4,
+                             do_sample=False, pad_token_id=0)
+    assert np.array_equal(out, hf_out[:, 6:].numpy())
+
+
+def test_falcon_bias_parity():
+    """falcon-rw-1b style: fused qkv WITH biases + alibi + sequential."""
+    hf_cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=False, parallel_attn=False,
+        new_decoder_architecture=False, bias=True, alibi=True,
+        max_position_embeddings=64, hidden_dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(12)
+    _golden(transformers.FalconForCausalLM(hf_cfg).eval(), 128, seed=12,
+            attn_qkv_bias=True, mlp_bias=True)
