@@ -40,7 +40,10 @@ def peak_flops(device) -> float:
 
 
 def model_flops_per_token(cfg, seq: int, n_params: int) -> float:
-    # 6*N for the dense matmuls (fwd+bwd) + attention term 12*L*h*S
+    # 6*N for the dense matmuls (fwd+bwd) + attention term 12*L*h*S.
+    # Pass MATMUL params only: the input-embedding gather is not a matmul
+    # (PaLM-style accounting; r2 ADVICE flagged counting it as ~9.6% MFU
+    # inflation). The untied lm_head IS a matmul and stays counted.
     return 6.0 * n_params + 12.0 * cfg.num_layers * cfg.hidden_size * seq
 
 
@@ -96,6 +99,45 @@ def comm_bandwidth():
         return {"allgather_busbw_gbps": round(busbw, 1), "allgather_devices": n}
     # read + write per element
     return {"hbm_copy_gbps": round(2 * nbytes / dt / 1e9, 1), "allgather_devices": 1}
+
+
+def plan_bench_config(cfg, seq: int):
+    """Order (batch, remat) candidates by expected MFU, filtered by an HBM
+    headroom estimate (r2 used BENCH_REMAT/BENCH_BATCH env vars instead —
+    the probe makes the choice automatic; a compile-time OOM in main() still
+    falls through to the next candidate)."""
+    from deepspeed_tpu.models.transformer import TransformerLM, init_params
+
+    model = TransformerLM(cfg)
+    shapes = jax.eval_shape(lambda: init_params(model, batch=1, seq=seq))
+    n = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
+    state_bytes = 14 * n  # bf16 params + fp32 master + fp32 adam m/v
+
+    h, inter, L, V = (cfg.hidden_size, cfg.intermediate_size, cfg.num_layers,
+                      cfg.vocab_size)
+
+    def act_bytes(batch, remat):
+        # calibrated on v5e: batch 6 no-remat fits beside the state (r2's
+        # measured operating point), batch 8 does not
+        tok = batch * seq
+        logits = tok * V * 4  # fp32 logits (softmax residuals are transient)
+        if remat:
+            return L * tok * h * 2 * 2 + logits
+        per_layer = (8 * h + 2 * inter) * 2  # bf16 residuals/qkv/mlp hidden
+        return L * tok * per_layer + logits
+
+    try:
+        limit = jax.local_devices()[0].memory_stats().get("bytes_limit", 16e9)
+    except Exception:
+        limit = 16e9
+    budget = 0.92 * limit - state_bytes
+    plan = [(b, r) for b, r in ((8, False), (6, False), (4, False),
+                                (8, True), (6, True))
+            if act_bytes(b, r) <= budget]
+    if not plan:
+        plan = [(4, True)]
+    plan.append((4, True))  # last-resort fallback for the OOM retry loop
+    return plan
 
 
 def decode_bench():
@@ -161,51 +203,75 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
 
+    import os
+
     if on_tpu:
-        # ~460M-param Llama shape: fits one chip with fp32 master + Adam state.
-        # No remat at batch 6: activations fit v5e HBM alongside the optimizer
-        # state and recompute-free bwd beats block remat by ~20% (measured:
-        # 0.72 vs 0.59 MFU).
-        import os
-        remat = os.environ.get("BENCH_REMAT", "0") != "0"
-        policy = os.environ.get("BENCH_POLICY", "") or None
-        cfg = llama_config("7b", num_layers=12, hidden_size=1536,
-                           intermediate_size=4096, num_heads=12, num_kv_heads=12,
-                           vocab_size=32000, max_seq_len=2048, dtype=jnp.bfloat16,
-                           remat=remat, remat_policy=policy)
-        batch = int(os.environ.get("BENCH_BATCH", "6"))
+        # ~460M-param Llama shape: fits one chip with fp32 master + Adam
+        # state. The remat/batch choice is PROBED (HBM headroom estimate +
+        # compile-time OOM fallback), not env-fixed — no-remat wins ~20%
+        # when activations fit (measured 0.72 vs 0.59 MFU on v5e).
+        def make_cfg(remat):
+            return llama_config("7b", num_layers=12, hidden_size=1536,
+                                intermediate_size=4096, num_heads=12,
+                                num_kv_heads=12, vocab_size=32000,
+                                max_seq_len=2048, dtype=jnp.bfloat16,
+                                remat=remat,
+                                remat_policy=os.environ.get("BENCH_POLICY") or None)
+
         seq, steps, warmup = 2048, 30, 3
+        manual = {k for k in ("BENCH_BATCH", "BENCH_REMAT", "BENCH_POLICY")
+                  if k in os.environ}
+        if manual:  # any explicit knob pins the configuration (no probe)
+            plan = [(int(os.environ.get("BENCH_BATCH", "6")),
+                     os.environ.get("BENCH_REMAT", "0") != "0")]
+        else:
+            plan = plan_bench_config(make_cfg(False), seq)
     else:
-        cfg = llama_config("7b", num_layers=2, hidden_size=128,
-                           intermediate_size=256, num_heads=4, num_kv_heads=4,
-                           vocab_size=1024, max_seq_len=128, dtype=jnp.float32)
-        batch, seq, steps, warmup = 4, 128, 5, 2
-
-    model = TransformerLM(cfg)
-    params = init_params(model, batch=1, seq=seq)
-    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
-
-    engine, *_ = ds.initialize(
-        model=make_loss_fn(model), model_parameters=params,
-        config={"train_micro_batch_size_per_gpu": batch,
-                "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
-                "zero_optimization": {"stage": 3},
-                "bf16": {"enabled": bool(on_tpu)},
-                "gradient_clipping": 1.0,
-                "steps_per_print": 10**9})
+        cpu_cfg = llama_config("7b", num_layers=2, hidden_size=128,
+                               intermediate_size=256, num_heads=4, num_kv_heads=4,
+                               vocab_size=1024, max_seq_len=128, dtype=jnp.float32)
+        seq, steps, warmup = 128, 5, 2
+        plan = [(4, False)]
+        make_cfg = lambda remat, c=cpu_cfg: c
 
     # Pre-stage batches on device: per-step host RNG + H2D transfers would
     # serialize the async dispatch pipeline (a full RTT each on
     # remote-attached TPUs). Same reason the final sync is a host read of the
     # last loss, not block_until_ready (which doesn't drain remote queues).
-    rng = np.random.default_rng(0)
-    batches = [{"tokens": jnp.asarray(
-        rng.integers(0, cfg.vocab_size, size=(batch, seq)), jnp.int32)}
-        for _ in range(8)]
-
-    for i in range(warmup):  # compile + settle
-        loss = engine.train_batch(batches[i % len(batches)])
-    float(loss)  # drain the queue
+    engine = cfg = loss = None
+    for batch, remat in plan:
+        cfg = make_cfg(remat)
+        model = TransformerLM(cfg)
+        rng = np.random.default_rng(0)
+        batches = [{"tokens": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, size=(batch, seq)), jnp.int32)}
+            for _ in range(8)]
+        try:
+            params = init_params(model, batch=1, seq=seq)
+            engine, *_ = ds.initialize(
+                model=make_loss_fn(model), model_parameters=params,
+                config={"train_micro_batch_size_per_gpu": batch,
+                        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+                        "zero_optimization": {"stage": 3},
+                        "bf16": {"enabled": bool(on_tpu)},
+                        "gradient_clipping": 1.0,
+                        "steps_per_print": 10**9})
+            # the train step compiles LAZILY: the warmup must run inside the
+            # try so an activation-memory OOM falls through to the next plan
+            for i in range(warmup):
+                loss = engine.train_batch(batches[i % len(batches)])
+            float(loss)  # drain the queue
+            break
+        except Exception as e:  # OOM: try the next plan entry
+            engine = None
+            if "RESOURCE_EXHAUSTED" not in str(e) or (batch, remat) == plan[-1]:
+                raise
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(engine.state.params))
+    embed_params = cfg.vocab_size * cfg.hidden_size
+    # input-embedding gather is not a matmul; tied head reuses the table AS
+    # a matmul so it stays counted in that case
+    n_matmul = n_params - (0 if cfg.tie_embeddings else embed_params)
 
     t0 = time.perf_counter()
     loss = None
@@ -216,8 +282,10 @@ def main():
 
     n_chips = len(jax.devices())
     tokens_per_sec = batch * seq * steps / dt / n_chips  # per-chip
-    flops = model_flops_per_token(cfg, seq, n_params) * tokens_per_sec
-    mfu = flops / peak_flops(dev)
+    mfu = model_flops_per_token(cfg, seq, n_matmul) * tokens_per_sec / peak_flops(dev)
+    # r2 continuity metric: same accounting as BENCH_r02 (embedding in 6N)
+    mfu_incl_embed = (model_flops_per_token(cfg, seq, n_params)
+                      * tokens_per_sec / peak_flops(dev))
 
     comm = comm_bandwidth()
     try:
@@ -230,8 +298,11 @@ def main():
         "value": round(mfu, 4),
         "unit": "MFU",
         "vs_baseline": round(mfu / TARGET_MFU, 4),
+        "mfu_incl_embed": round(mfu_incl_embed, 4),
         "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
         "n_params": n_params,
+        "batch": batch,
+        "remat": cfg.remat,
         "device": getattr(dev, "device_kind", dev.platform),
         "final_loss": final_loss,
         **comm,

@@ -103,6 +103,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, acc_sc, m_sc, l_sc, *,
 def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    group = h // k.shape[1]  # GQA: kv heads stay unexpanded, indexed h//group
     block_q, block_k = _fit_blocks(sq, block_q), _fit_blocks(sk, block_k)
     if sq % block_q or sk % block_k:
         raise ValueError(f"seq lengths ({sq},{sk}) must be multiples of the block sizes "
@@ -117,8 +118,10 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, iq, ik: (b_, h_ // group, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, iq, ik: (b_, h_ // group, ik, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
@@ -239,6 +242,8 @@ def _flash_backward(res, g, causal, sm_scale, block_q, block_k, interpret):
     q, k, v, o, L = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    hk = k.shape[1]
+    group = h // hk
     block_q, block_k = _fit_blocks(sq, block_q), _fit_blocks(sk, block_k)
     nq, nk = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
 
@@ -246,15 +251,18 @@ def _flash_backward(res, g, causal, sm_scale, block_q, block_k, interpret):
     delta = jnp.sum(do * o.astype(jnp.float32), axis=-1)  # [B,H,Sq]
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
 
-    # dk/dv: grid (b, h, nk, nq) — q innermost
+    # dk/dv: grid (b, h, nk, nq) — q innermost. Per full head (each query
+    # head contributes its own partial), group-summed to kv heads below.
     dkdv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k),
         grid=(b, h, nk, nq),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),  # q
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),  # k
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),  # v
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, ik, iq: (b_, h_ // group, ik, 0)),  # k
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, ik, iq: (b_, h_ // group, ik, 0)),  # v
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),  # do
             pl.BlockSpec((1, 1, block_q, 128), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),  # L
             pl.BlockSpec((1, 1, block_q, 128), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),  # delta
@@ -274,6 +282,9 @@ def _flash_backward(res, g, causal, sm_scale, block_q, block_k, interpret):
         interpret=interpret,
     )(q, k, v, do.astype(q.dtype), L, delta)
     dk, dv = dkdv
+    if group > 1:  # sum the query-head partials belonging to each kv head
+        dk = dk.reshape(b, hk, group, sk, d).sum(axis=2)
+        dv = dv.reshape(b, hk, group, sk, d).sum(axis=2)
 
     dq, = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
@@ -281,8 +292,10 @@ def _flash_backward(res, g, causal, sm_scale, block_q, block_k, interpret):
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, iq, ik: (b_, h_ // group, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, iq, ik: (b_, h_ // group, ik, 0)),
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
             pl.BlockSpec((1, 1, block_q, 128), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
             pl.BlockSpec((1, 1, block_q, 128), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
@@ -323,20 +336,24 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q, k, v, *, causal: bool = True, sm_scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
                     interpret: Optional[bool] = None):
-    """Flash attention over ``[B, S, H, D]`` tensors (GQA: kv heads repeated).
+    """Flash attention over ``[B, S, H, D]`` tensors.
 
-    ``interpret=None`` auto-selects interpreter mode off-TPU so the same tests
-    run on the CPU mesh (the parity-test pattern of reference
+    GQA: kv heads stay unexpanded ([B, S, Hk, D]) — the BlockSpec index maps
+    route query head h to kv head h // group, so the FORWARD and the dq pass
+    never materialize repeated K/V (the r2 weakness). The dk/dv pass still
+    emits per-query-head partials ([B, H, Sk, D]) that are group-summed
+    outside the kernel — same transient footprint as the old repeat's
+    gradient, confined to backward.
+    ``interpret=None`` auto-selects interpreter mode off-TPU so the same
+    tests run on the CPU mesh (the parity-test pattern of reference
     ``tests/unit/ops``)."""
     if interpret is None:
         interpret = _interpret_default()
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
     h, hk = q.shape[2], k.shape[2]
-    if hk != h:  # GQA
-        rep = h // hk
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    if h % hk:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {hk}")
     # [B,S,H,D] -> [B,H,S,D]
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     o = _flash(qt, kt, vt, causal, float(sm_scale), block_q, block_k, interpret)
