@@ -339,6 +339,9 @@ class DeepSpeedTPUConfig(ConfigModel):
 
     steps_per_print: int = 10
     wall_clock_breakdown: bool = False
+    # reference memory_breakdown / see_memory_usage: log device+host memory
+    # at engine init and the compiled step's XLA accounting at step 1
+    memory_breakdown: bool = False
     dump_state: bool = False
     prescale_gradients: bool = False
     gradient_predivide_factor: float = 1.0
@@ -382,7 +385,6 @@ class DeepSpeedTPUConfig(ConfigModel):
         "train_micro_batch_size_per_device": "train_micro_batch_size_per_gpu",
         "zero_allow_untested_optimizer": None,
         "zero_force_ds_cpu_optimizer": None,
-        "memory_breakdown": None,
         "communication_data_type": None,
         "amp": None,
     }

@@ -1,20 +1,33 @@
 """Offline difficulty analysis (map-reduce).
 
-Reference ``DataAnalyzer`` (``data_sampling/data_analyzer.py``): a corpus
-pass computing per-sample "difficulty" metrics (seqlen, vocab rarity, ...)
-sharded over workers, then a reduce that merges shards and emits, per metric:
+Reference ``DataAnalyzer`` (``data_sampling/data_analyzer.py``, ~2.5k LoC
+distributed map-reduce): a corpus pass computing per-sample "difficulty"
+metrics (seqlen, vocab rarity, ...) sharded over workers, then a reduce that
+merges shards and emits, per metric:
 
 * ``<out>/<metric>_sample_to_metric.npy`` — metric value per sample index
 * ``<out>/<metric>_index_to_sample.npz`` — for each distinct metric value,
   the sample indices having it (the curriculum buckets the sampler draws from)
+* the same two tables in the reference's MMAP INDEXED-DATASET format
+  (``<metric>_sample_to_metric.bin/.idx``, ``<metric>_index_to_sample.bin/
+  .idx`` — item i of the latter holds the sample indices of the i-th
+  distinct metric value, with the values themselves in
+  ``<metric>_metric_values.npy``), so reference-style samplers can mmap the
+  buckets without loading them.
 
-Metric fns are numpy-level; the analysis is host-side (no TPU involvement).
+The map phase runs multi-process (``run(num_procs=N)`` forks workers; the
+reference uses torch.distributed ranks the same way). Metric fns are
+numpy-level; the analysis is host-side (no TPU involvement).
 """
 
+import multiprocessing
 import os
 from typing import Callable, Dict, List, Sequence
 
 import numpy as np
+
+from ...utils.logging import logger
+from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
 
 METRIC_SEQLEN = "seqlen"
 
@@ -72,7 +85,9 @@ class DataAnalyzer:
 
     # reduce ------------------------------------------------------------
     def run_reduce(self):
-        """Merge worker shards into sample_to_metric + index_to_sample."""
+        """Merge worker shards into sample_to_metric + index_to_sample, in
+        both npy/npz (quick local loads) and the reference's mmap
+        indexed-dataset format (sampler-facing)."""
         for m in self.metric_names:
             parts = [np.load(self._part_path(m, w)) for w in range(self.num_workers)]
             sample_to_metric = np.concatenate(parts)
@@ -83,14 +98,74 @@ class DataAnalyzer:
             np.savez(os.path.join(self.output_dir, f"{m}_index_to_sample.npz"),
                      **buckets)
 
-    def run(self):
-        """Single-process convenience: map all shards then reduce."""
-        for w in range(self.num_workers):
-            DataAnalyzer(self.dataset, self.metric_names, self.metric_fns,
-                         self.output_dir, self.num_workers, w).run_map()
+            b = MMapIndexedDatasetBuilder(
+                os.path.join(self.output_dir, f"{m}_sample_to_metric"),
+                dtype=np.int64)
+            b.add_item(sample_to_metric)  # one row, sample-indexed
+            b.finalize()
+            b = MMapIndexedDatasetBuilder(
+                os.path.join(self.output_dir, f"{m}_index_to_sample"),
+                dtype=np.int64)
+            for v in values:  # item i = sample indices of i-th metric value
+                b.add_item(buckets[str(v)])
+            b.finalize()
+            np.save(os.path.join(self.output_dir, f"{m}_metric_values.npy"),
+                    values)
+
+    def run(self, num_procs: int = 1, mp_context: str = "fork"):
+        """Map all shards (forked workers when ``num_procs > 1`` — the
+        reference's rank-parallel map phase) then reduce.
+
+        The default ``fork`` context keeps closure metric fns usable but is
+        only safe BEFORE any accelerator backend initializes in this process
+        (forking a live XLA client can deadlock) — run the analysis as its
+        own offline step, or pass ``mp_context='spawn'`` with picklable
+        metric fns, or ``num_procs=1``.
+        """
+        if num_procs > 1:
+            import jax
+
+            if (mp_context == "fork"
+                    and getattr(jax._src.xla_bridge, "_default_backend", None)
+                    is not None):
+                logger.warning(
+                    "DataAnalyzer.run(num_procs>1): an XLA backend is already "
+                    "initialized — fork is unsafe; falling back to in-process "
+                    "map (pass mp_context='spawn' with picklable metric fns "
+                    "to parallelize)")
+                num_procs = 1
+        if num_procs > 1:
+            ctx = multiprocessing.get_context(mp_context)
+            procs = []
+            for w in range(self.num_workers):
+                a = DataAnalyzer(self.dataset, self.metric_names, self.metric_fns,
+                                 self.output_dir, self.num_workers, w)
+                procs.append(ctx.Process(target=a.run_map))
+            running: List = []
+            for p in procs:
+                p.start()
+                running.append(p)
+                if len(running) >= num_procs:
+                    running.pop(0).join()
+            for p in running:
+                p.join()
+            for p in procs:
+                if p.exitcode:
+                    raise RuntimeError(f"analyzer map worker failed rc={p.exitcode}")
+        else:
+            for w in range(self.num_workers):
+                DataAnalyzer(self.dataset, self.metric_names, self.metric_fns,
+                             self.output_dir, self.num_workers, w).run_map()
         self.run_reduce()
 
     # load --------------------------------------------------------------
     @staticmethod
     def load_sample_to_metric(output_dir: str, metric: str) -> np.ndarray:
         return np.load(os.path.join(output_dir, f"{metric}_sample_to_metric.npy"))
+
+    @staticmethod
+    def load_indexed_buckets(output_dir: str, metric: str):
+        """mmap the index_to_sample buckets (values[i] -> dataset[i])."""
+        values = np.load(os.path.join(output_dir, f"{metric}_metric_values.npy"))
+        ds = MMapIndexedDataset(os.path.join(output_dir, f"{metric}_index_to_sample"))
+        return values, ds

@@ -225,6 +225,9 @@ class DeepSpeedTPUEngine:
         log_dist(f"engine initialized: {self.topo}, zero_stage={zc.stage}, "
                  f"gas={self.gas}, micro_bs={self.micro_batch_size}, "
                  f"dtype={jnp.dtype(self.compute_dtype).name}")
+        from ..utils.memory import see_memory_usage
+
+        see_memory_usage("after engine init", force=config.memory_breakdown)
 
     # ------------------------------------------------------------------
     def _build_state(self, params):
@@ -503,6 +506,8 @@ class DeepSpeedTPUEngine:
             metrics = self._host_offload_step(step_fn, batch, step_rng)
         else:
             self.state, metrics = step_fn(self.state, batch, step_rng)
+        if self.global_steps == 0 and self.config.memory_breakdown:
+            self._log_memory_breakdown(step_fn, batch, step_rng)
         self.global_steps += 1
         # Metrics stay on device; ``_last_metrics`` converts lazily. A per-step
         # device->host sync here would serialize the async dispatch pipeline
@@ -564,6 +569,28 @@ class DeepSpeedTPUEngine:
         new_params = jax.device_put(new_np, self._param_shardings)
         self.state = TrainState(step=state.step + 1, params=new_params,
                                 opt_state=(), loss_scale=state.loss_scale)
+
+    def _log_memory_breakdown(self, step_fn, batch, step_rng):
+        """Step-1 memory report (reference ``see_memory_usage`` at the first
+        step + ``memory_breakdown``): live device/host stats plus the
+        compiled train step's XLA accounting (cache-hit lowering)."""
+        from ..utils.memory import compiled_memory_analysis, see_memory_usage
+
+        see_memory_usage("after first train step", force=True)
+        if self._host_adam is not None:
+            analysis = compiled_memory_analysis(step_fn, self.state.params,
+                                                batch, step_rng, self.state.step)
+        else:
+            analysis = compiled_memory_analysis(step_fn, self.state, batch, step_rng)
+        if analysis:
+            log_dist("compiled train step memory: " +
+                     "  ".join(f"{k}={v:.3f}" for k, v in analysis.items()))
+        self._memory_analysis = analysis
+
+    def memory_breakdown(self):
+        """Programmatic access to the step-1 XLA memory analysis (None until
+        the first step runs with config.memory_breakdown enabled)."""
+        return getattr(self, "_memory_analysis", None)
 
     def eval_batch(self, batch, compute_loss: bool = True):
         if self._eval_fn is None:

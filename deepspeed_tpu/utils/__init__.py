@@ -1,0 +1,6 @@
+from .logging import log_dist, logger
+from .memory import (compiled_memory_analysis, memory_status,
+                     see_memory_usage)
+
+__all__ = ["log_dist", "logger", "see_memory_usage", "memory_status",
+           "compiled_memory_analysis"]

@@ -1,0 +1,85 @@
+"""Memory introspection (reference ``see_memory_usage``,
+``runtime/utils.py:771`` + ``memory_breakdown`` engine knob).
+
+Two views:
+* :func:`see_memory_usage` — live device HBM stats (accelerator
+  ``memory_stats``) + host RSS/available, logged rank-0.
+* :func:`compiled_memory_analysis` — XLA's per-program accounting
+  (argument/output/temp/generated-code bytes) for a jitted function, the
+  TPU-native analogue of torch's allocator breakdown: under XLA the
+  interesting number is what the COMPILED program reserves, not a runtime
+  allocator's high-water mark.
+"""
+
+from typing import Any, Dict, Optional
+
+from .logging import log_dist, logger
+
+
+def _host_memory() -> Dict[str, float]:
+    try:
+        import resource
+
+        rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        out = {"host_max_rss_gb": rss_kb / 1024 / 1024}
+    except Exception:  # pragma: no cover
+        out = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    out["host_available_gb"] = int(line.split()[1]) / 1024 / 1024
+                    break
+    except OSError:  # pragma: no cover
+        pass
+    return out
+
+
+def memory_status() -> Dict[str, float]:
+    """Device + host memory numbers (GB)."""
+    from ..accelerator import get_accelerator
+
+    acc = get_accelerator()
+    stats = acc.memory_stats()
+    gb = 1024 ** 3
+    out = {
+        "device_in_use_gb": stats.get("bytes_in_use", 0) / gb,
+        "device_peak_gb": stats.get("peak_bytes_in_use", 0) / gb,
+        "device_limit_gb": stats.get("bytes_limit", 0) / gb,
+    }
+    out.update(_host_memory())
+    return out
+
+
+def see_memory_usage(message: str, force: bool = False):
+    """Reference ``see_memory_usage(message, force)``: rank-0 log of the
+    current device/host memory picture. ``force=False`` is a no-op (the
+    reference gates on its ``memory_breakdown`` config the same way)."""
+    if not force:
+        return
+    s = memory_status()
+    log_dist(
+        f"{message} | MA {s['device_in_use_gb']:.2f} GB  "
+        f"Max_MA {s['device_peak_gb']:.2f} GB  "
+        f"Limit {s['device_limit_gb']:.2f} GB | "
+        f"host max-RSS {s.get('host_max_rss_gb', 0):.2f} GB  "
+        f"host-avail {s.get('host_available_gb', 0):.2f} GB")
+    return s
+
+
+def compiled_memory_analysis(jitted_fn, *args, **kwargs) -> Optional[Dict[str, Any]]:
+    """XLA memory accounting for ``jitted_fn(*args)``: lowering + compile are
+    cache hits when the function already ran with these shapes."""
+    try:
+        analysis = jitted_fn.lower(*args, **kwargs).compile().memory_analysis()
+    except Exception as e:  # backend without memory analysis
+        logger.debug(f"memory_analysis unavailable: {e}")
+        return None
+    if analysis is None:
+        return None
+    gb = 1024 ** 3
+    fields = ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes", "alias_size_in_bytes",
+              "generated_code_size_in_bytes")
+    return {f.replace("_in_bytes", "_gb"): getattr(analysis, f, 0) / gb
+            for f in fields}

@@ -283,3 +283,55 @@ def test_engine_random_ltd_wiring():
         engine.train_batch(batch=(x, y))
     keeps = {k for k in seen_keeps if k is not None}
     assert 4 in keeps and 8 in keeps  # ramped from min to max
+
+
+def test_analyzer_multiprocess_and_indexed_output(tmp_path):
+    """Forked map workers + the reference indexed-dataset output format."""
+    ds = _toy_dataset()
+    an = DataAnalyzer(ds, output_dir=str(tmp_path), num_workers=4)
+    # spawn: the default fork context correctly refuses to run once the
+    # test harness's XLA backend is live
+    an.run(num_procs=2, mp_context="spawn")
+    s2m = DataAnalyzer.load_sample_to_metric(str(tmp_path), "seqlen")
+    assert len(s2m) == len(ds)
+    # mmap sample_to_metric row equals the npy table
+    from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import MMapIndexedDataset
+    mm = MMapIndexedDataset(str(tmp_path / "seqlen_sample_to_metric"))
+    np.testing.assert_array_equal(np.asarray(mm[0]), s2m)
+    # buckets: every sample index appears exactly once, under its own value
+    values, buckets = DataAnalyzer.load_indexed_buckets(str(tmp_path), "seqlen")
+    assert len(values) == len(buckets)
+    seen = []
+    for i, v in enumerate(values):
+        idxs = np.asarray(buckets[i])
+        assert all(s2m[j] == v for j in idxs)
+        seen.extend(idxs.tolist())
+    assert sorted(seen) == list(range(len(ds)))
+
+
+def test_engine_memory_breakdown():
+    """memory_breakdown config: see_memory_usage at init + XLA program
+    accounting at step 1 (reference runtime/utils.py:771)."""
+    import deepspeed_tpu as ds2
+    from deepspeed_tpu.parallel import Topology, TopologySpec, set_topology
+    from deepspeed_tpu.utils.memory import memory_status
+
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from simple_model import make_simple_params, random_batches, simple_loss
+
+    set_topology(Topology(TopologySpec()))
+    engine, *_ = ds2.initialize(
+        model=simple_loss, model_parameters=make_simple_params(hidden=32),
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1},
+                "memory_breakdown": True, "steps_per_print": 10**9})
+    assert engine.memory_breakdown() is None  # nothing until step 1
+    batch = random_batches(1, 8, hidden=32)[0]
+    engine.train_batch(batch)
+    analysis = engine.memory_breakdown()
+    assert analysis is not None and analysis["temp_size_gb"] >= 0
+    assert "argument_size_gb" in analysis
+    stat = memory_status()
+    assert "device_in_use_gb" in stat and "host_max_rss_gb" in stat
