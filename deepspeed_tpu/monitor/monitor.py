@@ -115,6 +115,35 @@ class csvMonitor(Monitor):
                 f.write(f"{int(step)},{value}\n")
 
 
+class CometMonitor(Monitor):
+    """Reference ``monitor/comet.py:23``; import-gated like WandbMonitor."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.experiment = None
+        if not (self.enabled and _is_rank_0()):
+            self.enabled = False
+            return
+        try:
+            import comet_ml
+
+            kwargs = {k: getattr(config, k) for k in
+                      ("api_key", "project", "workspace", "experiment_key",
+                       "mode", "online") if getattr(config, k, None) is not None}
+            self.experiment = comet_ml.start(**kwargs)
+            if getattr(config, "experiment_name", None):
+                self.experiment.set_name(config.experiment_name)
+        except Exception:
+            self.enabled = False
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        if not self.enabled or self.experiment is None:
+            return
+        for name, value, step in event_list:
+            if value is not None:
+                self.experiment.log_metric(name, value, step=int(step))
+
+
 class MonitorMaster(Monitor):
     """Fan-out to every enabled writer (reference ``monitor/monitor.py:30``)."""
 
@@ -123,6 +152,7 @@ class MonitorMaster(Monitor):
             TensorBoardMonitor(monitor_config.tensorboard),
             WandbMonitor(monitor_config.wandb),
             csvMonitor(monitor_config.csv_monitor),
+            CometMonitor(monitor_config.comet),
         ]
         self.monitors = [m for m in self.monitors if m.enabled]
         self.enabled = bool(self.monitors)

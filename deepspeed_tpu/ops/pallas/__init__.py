@@ -1,0 +1,10 @@
+from .flash_attention import flash_attention
+from .fused_adam import adam_update
+from .paged_attention import paged_attention
+from .quant import dequantize_int8, quantize_int8
+from .sparse_attention import (bigbird_layout, bslongformer_layout,
+                               causal_layout, fixed_layout, sparse_attention)
+
+__all__ = ["flash_attention", "paged_attention", "sparse_attention",
+           "fixed_layout", "bigbird_layout", "bslongformer_layout",
+           "causal_layout", "adam_update", "quantize_int8", "dequantize_int8"]

@@ -228,10 +228,26 @@ class CSVConfig(ConfigModel):
 
 @register_config
 @dataclass
+class CometConfig(ConfigModel):
+    """Reference ``monitor/config.py`` CometConfig (monitor/comet.py:23)."""
+    enabled: bool = False
+    samples_log_interval: int = 100
+    project: Optional[str] = None
+    workspace: Optional[str] = None
+    api_key: Optional[str] = None
+    experiment_name: Optional[str] = None
+    experiment_key: Optional[str] = None
+    online: Optional[bool] = None
+    mode: Optional[str] = None
+
+
+@register_config
+@dataclass
 class MonitorConfig(ConfigModel):
     tensorboard: TensorBoardConfig = field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = field(default_factory=WandbConfig)
     csv_monitor: CSVConfig = field(default_factory=CSVConfig)
+    comet: CometConfig = field(default_factory=CometConfig)
 
 
 @register_config
@@ -371,6 +387,7 @@ class DeepSpeedTPUConfig(ConfigModel):
     tensorboard: TensorBoardConfig = field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = field(default_factory=WandbConfig)
     csv_monitor: CSVConfig = field(default_factory=CSVConfig)
+    comet: CometConfig = field(default_factory=CometConfig)
     elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
     compression_training: CompressionConfig = field(default_factory=CompressionConfig)
     data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
@@ -464,7 +481,7 @@ def _fold_monitor_keys(cfg: DeepSpeedTPUConfig) -> DeepSpeedTPUConfig:
     # and the MonitorConfig grouping; fold top-level into cfg.monitor (idempotent).
     import copy
 
-    for key in ("tensorboard", "wandb", "csv_monitor"):
+    for key in ("tensorboard", "wandb", "csv_monitor", "comet"):
         top = getattr(cfg, key)
         if top.enabled and not getattr(cfg.monitor, key).enabled:
             setattr(cfg.monitor, key, copy.deepcopy(top))

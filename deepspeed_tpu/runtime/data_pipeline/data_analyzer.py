@@ -122,19 +122,26 @@ class DataAnalyzer:
         own offline step, or pass ``mp_context='spawn'`` with picklable
         metric fns, or ``num_procs=1``.
         """
-        if num_procs > 1:
-            import jax
+        if num_procs > 1 and mp_context == "fork":
+            # fail CLOSED: forking a process with a live XLA client can
+            # deadlock, and if the probe itself breaks (private attr moved in
+            # a jax upgrade) we must assume the backend is live
+            try:
+                import jax
 
-            if (mp_context == "fork"
-                    and getattr(jax._src.xla_bridge, "_default_backend", None)
-                    is not None):
+                backend_live = jax._src.xla_bridge._default_backend is not None
+            except Exception:
+                backend_live = True
+            if backend_live:
                 logger.warning(
-                    "DataAnalyzer.run(num_procs>1): an XLA backend is already "
+                    "DataAnalyzer.run(num_procs>1): an XLA backend may be "
                     "initialized — fork is unsafe; falling back to in-process "
                     "map (pass mp_context='spawn' with picklable metric fns "
                     "to parallelize)")
                 num_procs = 1
         if num_procs > 1:
+            from multiprocessing.connection import wait as mp_wait
+
             ctx = multiprocessing.get_context(mp_context)
             procs = []
             for w in range(self.num_workers):
@@ -145,8 +152,11 @@ class DataAnalyzer:
             for p in procs:
                 p.start()
                 running.append(p)
-                if len(running) >= num_procs:
-                    running.pop(0).join()
+                if len(running) >= num_procs:  # reap whichever exits FIRST
+                    done = mp_wait([r.sentinel for r in running])
+                    for r in [r for r in running if r.sentinel in done]:
+                        r.join()
+                        running.remove(r)
             for p in running:
                 p.join()
             for p in procs:

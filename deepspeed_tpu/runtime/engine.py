@@ -181,6 +181,7 @@ class DeepSpeedTPUEngine:
         # compat-path buffers (forward/backward/step API)
         self._compat_acc = None
         self._compat_batch = None
+        self._compat_pending = None
         self._compat_count = 0
         self._micro_step_fn = None
         self._apply_fn = None
@@ -193,7 +194,7 @@ class DeepSpeedTPUEngine:
         self._metrics_host: Optional[Dict[str, float]] = {}
         self.monitor = None
         if any(m.enabled for m in (config.monitor.tensorboard, config.monitor.wandb,
-                                   config.monitor.csv_monitor)):
+                                   config.monitor.csv_monitor, config.monitor.comet)):
             from ..monitor import MonitorMaster
 
             self.monitor = MonitorMaster(config.monitor)
@@ -606,20 +607,9 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------
     # reference-compat imperative API: forward -> backward (xGAS) -> step
     # ------------------------------------------------------------------
-    def forward(self, batch):
-        """Compute loss for one microbatch (reference ``engine.forward:1848``)."""
-        self._compat_batch = batch
-        return self.eval_batch(batch)
-
-    def backward(self, loss=None, batch=None):
-        """Accumulate grads for one microbatch (reference ``backward:2007``).
-        ``loss`` is accepted for API compatibility; grads are recomputed
-        functionally from the stored microbatch."""
-        batch = batch if batch is not None else self._compat_batch
-        if batch is None:
-            raise ValueError("backward() needs a microbatch: call forward(batch) first or "
-                             "pass backward(batch=...) — grads are recomputed functionally, "
-                             "a bare loss tensor is not enough on TPU")
+    def _run_micro_step(self, batch):
+        """One fused value-and-grad microbatch pass, returning the would-be
+        new accumulator + the unscaled loss."""
         if self._micro_step_fn is None:
             def micro_step(state, acc, mb, rng):
                 scale = state.loss_scale.scale if self.fp16 else jnp.asarray(1.0, jnp.float32)
@@ -638,9 +628,41 @@ class DeepSpeedTPUEngine:
             self._compat_acc = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
                                             self.state.params)
         self._rng, r = jax.random.split(self._rng)
-        self._compat_acc, loss = self._micro_step_fn(self.state, self._compat_acc, batch, r)
-        self._compat_count += 1
+        return self._micro_step_fn(self.state, self._compat_acc, batch, r)
+
+    def forward(self, batch):
+        """Compute the loss for one microbatch (reference ``engine.forward:1848``).
+
+        Fused with the gradient pass: functional autodiff would otherwise
+        recompute this forward inside ``backward()``, silently doubling a
+        ported reference loop's compute. The grads are cached and committed
+        by ``backward()``; a forward that is never followed by backward pays
+        for them — use ``eval_batch`` for inference-only evaluation.
+        """
+        self._compat_batch = batch
+        acc, loss = self._run_micro_step(batch)
+        self._compat_pending = (acc, loss)
         return float(np.asarray(loss))
+
+    def backward(self, loss=None, batch=None):
+        """Accumulate grads for one microbatch (reference ``backward:2007``).
+        ``loss`` is accepted for API compatibility; the grads cached by the
+        fused ``forward`` are committed (or recomputed for an explicitly
+        different ``batch``)."""
+        if batch is not None and batch is not self._compat_batch:
+            self._compat_pending = None  # different data: recompute
+            self._compat_batch = batch
+        if self._compat_batch is None:
+            raise ValueError("backward() needs a microbatch: call forward(batch) first or "
+                             "pass backward(batch=...) — grads are recomputed functionally, "
+                             "a bare loss tensor is not enough on TPU")
+        if self._compat_pending is None:
+            self._compat_pending = self._run_micro_step(self._compat_batch)
+        acc, loss_dev = self._compat_pending
+        self._compat_acc = acc
+        self._compat_pending = None
+        self._compat_count += 1
+        return float(np.asarray(loss_dev))
 
     def is_gradient_accumulation_boundary(self) -> bool:
         return self._compat_count >= self.gas
