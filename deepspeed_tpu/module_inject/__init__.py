@@ -1,0 +1,13 @@
+"""Automatic tensor-parallel policy inference (reference ``deepspeed/module_inject/``).
+
+The reference package rewrites ``nn.Module`` trees in place (kernel injection,
+``AutoTP`` Linear replacement). On TPU nothing is rewritten: models are pure
+functions of a param pytree, so "injection" reduces to *choosing
+PartitionSpecs* — this package infers them automatically for arbitrary models
+(reference ``module_inject/auto_tp.py:189`` ``AutoTP.tp_parser``).
+"""
+
+from .auto_tp import (AutoTPResult, infer_tp_roles, shard_checkpoint_leaf,
+                      tp_parser)
+
+__all__ = ["tp_parser", "infer_tp_roles", "shard_checkpoint_leaf", "AutoTPResult"]

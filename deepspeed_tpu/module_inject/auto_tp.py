@@ -1,0 +1,455 @@
+"""AutoTP: infer tensor-parallel PartitionSpecs for arbitrary param trees.
+
+Reference: ``deepspeed/module_inject/auto_tp.py:189`` (``AutoTP``) walks the
+``nn.Module`` graph, collects every ``nn.Linear``, and classifies each as
+*column-parallel* (shard the output features) or *row-parallel* (shard the
+input features + allreduce the output) from layer-name heuristics
+(``tp_parser``), then rewrites modules via ``ReplaceWithTensorSlicing``.
+
+TPU-native redesign — two analyses, no module rewriting:
+
+1. **Jaxpr dataflow** (:func:`infer_tp_roles`): trace the model's apply
+   function once abstractly and walk the jaxpr. A weight is *column-parallel*
+   when its matmul output dims flow onward; it is *row-parallel* when its
+   contracting dim consumes a dim **produced by an earlier column-parallel
+   weight** — exactly the Megatron pairing (col → elementwise → row → psum),
+   discovered from the program itself instead of layer names. This handles
+   models whose param names carry no signal (reference AutoTP falls over
+   there and demands a manual policy; see ``auto_tp.py:223`` ``supported``).
+2. **Name heuristics** (:func:`_spec_by_name`): the reference's name
+   vocabulary (``o_proj``/``down_proj``/``dense_4h_to_h``/… → row; other
+   matmul weights → column; embeddings → vocab-sharded), used for leaves the
+   dataflow pass could not classify (e.g. params only used inside
+   ``lax.scan`` bodies) and for biases.
+
+The result is a ``PartitionSpec`` pytree consumable by ``pjit`` /
+``jax.device_put``; sharding a checkpoint shard-by-shard at load time uses
+:func:`shard_checkpoint_leaf` (plays reference
+``module_inject/replace_module.py`` ``ReplaceWithTensorSlicing``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+# Reference name vocabulary (``auto_tp.py:303-351`` tp_parser): layers whose
+# *output* is summed into the residual stream → row-parallel. Everything else
+# that is a matmul weight defaults to column-parallel, as the reference's
+# ``_replace`` does for non-allreduce linears.
+_ROW_PATTERNS = (
+    "o_proj", "out_proj", "down_proj", "dense_4h_to_h", "attention/dense",
+    "attn/dense", "self_attention/dense", "fc2", "c_proj", "wo",
+    "proj_out", "dense_out",
+)
+_COL_PATTERNS = (
+    "q_proj", "k_proj", "v_proj", "query", "key", "value", "qkv",
+    "gate_proj", "up_proj", "dense_h_to_4h", "fc1", "c_fc", "c_attn",
+    "wi", "w1", "w3", "query_key_value",
+)
+_EMBED_PATTERNS = ("embed", "embedding", "embeddings", "wte",
+                   "word_embeddings", "lm_head", "embed_out")
+_NORM_PATTERNS = ("norm", "ln", "layernorm", "ln_f", "ln_1", "ln_2")
+
+
+@dataclasses.dataclass
+class AutoTPResult:
+    """Per-leaf outcome of the analysis.
+
+    role: 'col' | 'row' | 'embed' | 'replicated'
+    shard_dim: which dim of the leaf to shard (None for replicated)
+    source: 'jaxpr' | 'name' — which analysis decided it
+    """
+    role: str
+    shard_dim: Optional[int]
+    source: str
+
+    def spec(self, ndim: int, axis: str = "tp") -> P:
+        if self.shard_dim is None:
+            return P(*([None] * ndim))
+        dims: List[Optional[str]] = [None] * ndim
+        dims[self.shard_dim] = axis
+        return P(*dims)
+
+
+# ---------------------------------------------------------------------------
+# Jaxpr dataflow analysis
+# ---------------------------------------------------------------------------
+
+_ELEMENTWISE = {
+    "add", "sub", "mul", "div", "max", "min", "pow", "and", "or", "xor",
+    "neg", "exp", "log", "tanh", "logistic", "sqrt", "rsqrt", "abs", "sign",
+    "erf", "sin", "cos", "floor", "ceil", "round", "integer_pow", "cbrt",
+    "clamp", "select_n", "stop_gradient", "convert_element_type",
+    "reduce_precision", "custom_jvp_call", "nextafter", "rem", "atan2",
+    "square",
+}
+
+_ALIAS_UNARY = {"convert_element_type", "stop_gradient", "reduce_precision",
+                "copy"}
+
+
+def _reshape_dim_map(old_shape: Sequence[int], new_shape: Sequence[int]
+                     ) -> Dict[int, int]:
+    """Map old dim index → new dim index across a reshape.
+
+    Greedy left-to-right factor matching. A merged old dim maps to the new
+    dim containing it only when it is the *leading* factor of that group
+    (its shard stays contiguous); a split old dim maps to the leading new
+    dim of its group. Anything ambiguous is dropped (no mapping) — dropping
+    a tag is always safe (leaf degrades to the name heuristic / replicated).
+    """
+    mapping: Dict[int, int] = {}
+    i = j = 0
+    old = list(old_shape)
+    new = list(new_shape)
+    while i < len(old) and j < len(new):
+        if old[i] == new[j]:
+            mapping[i] = j
+            i += 1
+            j += 1
+            continue
+        # accumulate a group on the smaller side
+        oi, oj = i, j
+        po, pn = old[i], new[j]
+        while po != pn:
+            if po < pn:
+                i += 1
+                if i >= len(old):
+                    return mapping
+                po *= old[i]
+            else:
+                j += 1
+                if j >= len(new):
+                    return mapping
+                pn *= new[j]
+        # old[oi..i] merged/split against new[oj..j]: leading dims correspond
+        mapping[oi] = oj
+        i += 1
+        j += 1
+    return mapping
+
+
+class _JaxprWalk:
+    """Forward walk propagating 'this dim was produced by param X' tags."""
+
+    def __init__(self):
+        # var -> {dim_index: (param_path, param_out_dim)}
+        self.tags: Dict[Any, Dict[int, Tuple[str, int]]] = {}
+        # var -> (param_path, {var_dim: param_dim}) for (aliases of) weights
+        self.alias: Dict[Any, Tuple[str, Dict[int, int]]] = {}
+        # param_path -> AutoTPResult-ish decisions
+        self.roles: Dict[str, Tuple[str, int]] = {}
+        self.conflicts: set = set()
+
+    def _set_role(self, path: str, role: str, dim: int) -> None:
+        prev = self.roles.get(path)
+        if prev is not None and prev != (role, dim):
+            # a weight classified both ways (reused in different positions):
+            # force replication, like reference AutoTP bailing to no-TP.
+            self.conflicts.add(path)
+        self.roles[path] = (role, dim)
+
+    @staticmethod
+    def _is_var(v) -> bool:
+        # jaxpr Literals (inline constants) are unhashable and carry no tags
+        return not hasattr(v, "val")
+
+    def _get_tags(self, v) -> Dict[int, Tuple[str, int]]:
+        if not self._is_var(v):
+            return {}
+        return self.tags.get(v, {})
+
+    def run(self, jaxpr) -> None:
+        for eqn in jaxpr.eqns:
+            self.eqn(eqn)
+
+    # -- recursion into sub-jaxprs (pjit, custom_vjp, remat, ...) ----------
+    def _sub(self, sub_jaxpr, invars, outvars) -> None:
+        inner = sub_jaxpr.jaxpr if hasattr(sub_jaxpr, "jaxpr") else sub_jaxpr
+        for outer, inner_v in zip(invars, inner.invars):
+            if not self._is_var(outer):
+                continue
+            if outer in self.tags:
+                self.tags[inner_v] = dict(self.tags[outer])
+            if outer in self.alias:
+                self.alias[inner_v] = self.alias[outer]
+        self.run(inner)
+        for outer, inner_v in zip(outvars, inner.outvars):
+            if not self._is_var(inner_v):
+                continue
+            if inner_v in self.tags:
+                self.tags[outer] = dict(self.tags[inner_v])
+            if inner_v in self.alias:
+                self.alias[outer] = self.alias[inner_v]
+
+    def eqn(self, eqn) -> None:
+        prim = eqn.primitive.name
+        params = eqn.params
+
+        sub = params.get("jaxpr") or params.get("call_jaxpr")
+        if sub is not None and prim not in ("scan", "while", "cond"):
+            self._sub(sub, eqn.invars, eqn.outvars)
+            return
+
+        if prim == "dot_general":
+            self._dot_general(eqn)
+            return
+
+        if prim == "transpose":
+            (src,) = eqn.invars
+            perm = params["permutation"]
+            if not self._is_var(src):
+                return
+            if src in self.tags:
+                self.tags[eqn.outvars[0]] = {
+                    perm.index(d): t for d, t in self.tags[src].items()
+                    if d in perm}
+            if src in self.alias:
+                path, dmap = self.alias[src]
+                self.alias[eqn.outvars[0]] = (
+                    path, {perm.index(d): p for d, p in dmap.items()})
+            return
+
+        if prim == "reshape":
+            (src,) = eqn.invars
+            if not self._is_var(src) or (src not in self.tags
+                                         and src not in self.alias):
+                return
+            old = getattr(src.aval, "shape", ())
+            new = eqn.outvars[0].aval.shape
+            dim_map = _reshape_dim_map(old, new)
+            if src in self.tags:
+                self.tags[eqn.outvars[0]] = {
+                    dim_map[d]: t for d, t in self.tags[src].items()
+                    if d in dim_map}
+            if src in self.alias:
+                path, dmap = self.alias[src]
+                self.alias[eqn.outvars[0]] = (
+                    path, {dim_map[d]: p for d, p in dmap.items()
+                           if d in dim_map})
+            return
+
+        if prim == "broadcast_in_dim":
+            (src,) = eqn.invars
+            bdims = params["broadcast_dimensions"]
+            if self._is_var(src) and src in self.tags:
+                self.tags[eqn.outvars[0]] = {
+                    bdims[d]: t for d, t in self.tags[src].items()}
+            return
+
+        if prim in _ELEMENTWISE or prim in ("reduce_max", "reduce_sum",
+                                            "squeeze", "expand_dims"):
+            out = eqn.outvars[0]
+            out_shape = getattr(out.aval, "shape", ())
+            merged: Dict[int, Tuple[str, int]] = {}
+            for v in eqn.invars:
+                v_shape = getattr(getattr(v, "aval", None), "shape", ())
+                if v_shape == out_shape:
+                    merged.update(self._get_tags(v))
+            if merged:
+                self.tags[out] = merged
+            if (prim in _ALIAS_UNARY and self._is_var(eqn.invars[0])
+                    and eqn.invars[0] in self.alias):
+                self.alias[out] = self.alias[eqn.invars[0]]
+            return
+        # unknown primitive: tags do not flow through (safe default).
+
+    def _dot_general(self, eqn) -> None:
+        lhs, rhs = eqn.invars
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        out = eqn.outvars[0]
+
+        for operand, contract, batch, other, other_contract in (
+                (rhs, rc, rb, lhs, lc), (lhs, lc, lb, rhs, rc)):
+            if not self._is_var(operand) or operand not in self.alias:
+                continue
+            path, dmap = self.alias[operand]
+            op_ndim = len(operand.aval.shape)
+            free = [d for d in range(op_ndim)
+                    if d not in contract and d not in batch]
+
+            # Row detection: the *other* operand's contracted dims carry a
+            # tag from an earlier weight's output → Megatron col/row pair.
+            paired = False
+            other_tags = self._get_tags(other)
+            for od in other_contract:
+                if od in other_tags:
+                    src_path, src_dim = other_tags[od]
+                    if src_path != path:
+                        self._set_role(src_path, "col", src_dim)
+                        paired = True
+            if paired and contract:
+                pdim = dmap.get(contract[0])
+                if pdim is not None:
+                    self._set_role(path, "row", pdim)
+                # row output is psum'd; its dims carry no shard tag.
+                return
+
+            # Col candidate: tag the out var's dims fed by this weight's
+            # free dims. dot_general output layout: batch, lhs-free, rhs-free.
+            lhs_free = [d for d in range(len(lhs.aval.shape))
+                        if d not in lc and d not in lb]
+            rhs_free = [d for d in range(len(rhs.aval.shape))
+                        if d not in rc and d not in rb]
+            out_tags = dict(self._get_tags(out))
+            if operand is rhs:
+                base = len(lb) + len(lhs_free)
+                free_list = rhs_free
+            else:
+                base = len(lb)
+                free_list = lhs_free
+            for i, d in enumerate(free_list):
+                pdim = dmap.get(d)
+                if pdim is not None:
+                    out_tags[base + i] = (path, pdim)
+            if out_tags:
+                self.tags[out] = out_tags
+            return
+
+        # Neither operand is a weight alias: propagate activation tags on
+        # batch + free dims (e.g. the head dim rides through attention).
+        lhs_free = [d for d in range(len(lhs.aval.shape))
+                    if d not in lc and d not in lb]
+        lhs_tags = self._get_tags(lhs)
+        out_tags = {}
+        for i, d in enumerate(lb):
+            if d in lhs_tags:
+                out_tags[i] = lhs_tags[d]
+        for i, d in enumerate(lhs_free):
+            if d in lhs_tags:
+                out_tags[len(lb) + i] = lhs_tags[d]
+        if out_tags:
+            self.tags[out] = out_tags
+
+
+def _flatten_paths(tree) -> Tuple[List[str], List[Any], Any]:
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    paths, leaves = [], []
+    for kp, leaf in flat:
+        keys = [str(getattr(e, "key", getattr(e, "name", e))) for e in kp]
+        paths.append("/".join(keys))
+        leaves.append(leaf)
+    return paths, leaves, treedef
+
+
+def infer_tp_roles(apply_fn, params, *example_inputs) -> Dict[str, Tuple[str, int]]:
+    """Classify weights as ('col'|'row', shard_dim) from the traced jaxpr.
+
+    ``apply_fn(params, *example_inputs)`` is traced abstractly (nothing
+    materializes). Returns only the leaves the dataflow pass could decide;
+    callers fall back to name heuristics for the rest.
+    """
+    paths, leaves, _ = _flatten_paths(params)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), params)
+    closed = jax.make_jaxpr(apply_fn)(abstract, *example_inputs)
+    walk = _JaxprWalk()
+    n = len(paths)
+    for var, path, leaf in zip(closed.jaxpr.invars[:n], paths, leaves):
+        ndim = len(getattr(var.aval, "shape", ()))
+        if ndim >= 2:
+            walk.alias[var] = (path, {d: d for d in range(ndim)})
+    walk.run(closed.jaxpr)
+    return {p: rd for p, rd in walk.roles.items() if p not in walk.conflicts}
+
+
+# ---------------------------------------------------------------------------
+# Name heuristics (the reference tp_parser vocabulary)
+# ---------------------------------------------------------------------------
+
+
+def _matches(patterns: Sequence[str], text: str) -> bool:
+    """Pattern hit only at name-component boundaries ([/_.-] or ends), so
+    e.g. 'wo' does not fire inside 'word_embeddings'."""
+    return any(re.search(rf"(^|[/_.\-]){re.escape(p)}([/_.\-]|$)", text)
+               for p in patterns)
+
+
+def _spec_by_name(path: str, ndim: int) -> AutoTPResult:
+    low = path.lower()
+    leaf_name = low.rsplit("/", 1)[-1]
+    is_bias = leaf_name in ("bias", "b")
+    if _matches(_NORM_PATTERNS, low) and ndim <= 1:
+        return AutoTPResult("replicated", None, "name")
+    if ndim >= 2:
+        if _matches(_ROW_PATTERNS, low):
+            return AutoTPResult("row", 0, "name")
+        if _matches(_COL_PATTERNS, low):
+            return AutoTPResult("col", ndim - 1, "name")
+        if _matches(_EMBED_PATTERNS, low):
+            return AutoTPResult("embed", ndim - 1, "name")
+        return AutoTPResult("replicated", None, "name")
+    if is_bias or ndim == 1:
+        # bias shards with a column-parallel owner, replicates with row.
+        parent = low.rsplit("/", 1)[0] if "/" in low else low
+        if _matches(_ROW_PATTERNS, parent):
+            return AutoTPResult("replicated", None, "name")
+        if _matches(_COL_PATTERNS + _EMBED_PATTERNS, parent):
+            return AutoTPResult("col", 0, "name")
+    return AutoTPResult("replicated", None, "name")
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def tp_parser(params, apply_fn=None, example_inputs: Sequence[Any] = (),
+              axis: str = "tp", tp_size: Optional[int] = None):
+    """Infer a PartitionSpec pytree for ``params``.
+
+    When ``apply_fn`` is given, the jaxpr dataflow analysis runs first and
+    name heuristics only fill the gaps; otherwise names decide everything
+    (the reference behaviour). ``tp_size`` (if given) drops shardings whose
+    dim is not divisible — reference ``tp_shard.py`` pads instead; on TPU an
+    indivisible dim would force XLA padding everywhere, so replication is
+    the better default.
+    """
+    roles: Dict[str, Tuple[str, int]] = {}
+    if apply_fn is not None:
+        roles = infer_tp_roles(apply_fn, params, *example_inputs)
+    paths, leaves, treedef = _flatten_paths(params)
+    specs = []
+    for path, leaf in zip(paths, leaves):
+        ndim = len(jnp.shape(leaf))
+        if path in roles:
+            role, dim = roles[path]
+            res = AutoTPResult(role, dim, "jaxpr")
+        else:
+            res = _spec_by_name(path, ndim)
+        if (tp_size and res.shard_dim is not None
+                and jnp.shape(leaf)[res.shard_dim] % tp_size != 0):
+            res = AutoTPResult("replicated", None, res.source)
+        specs.append(res.spec(ndim, axis))
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def shard_checkpoint_leaf(value: np.ndarray, spec: P, axis: str,
+                          axis_index: int, axis_size: int) -> np.ndarray:
+    """Slice one checkpoint leaf to this TP rank's shard.
+
+    Plays reference ``ReplaceWithTensorSlicing.copy``
+    (``module_inject/replace_module.py``): numpy slicing on host, so a full
+    model checkpoint never needs to fit on device.
+    """
+    if axis_size == 1:
+        return value
+    for dim, name in enumerate(spec):
+        names = (name,) if isinstance(name, str) else (name or ())
+        if axis in names:
+            if value.shape[dim] % axis_size:
+                raise ValueError(
+                    f"dim {dim} of shape {value.shape} not divisible by "
+                    f"tp={axis_size}")
+            step = value.shape[dim] // axis_size
+            idx = [slice(None)] * value.ndim
+            idx[dim] = slice(axis_index * step, (axis_index + 1) * step)
+            return np.ascontiguousarray(value[tuple(idx)])
+    return value

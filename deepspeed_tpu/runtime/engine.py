@@ -115,7 +115,8 @@ class DeepSpeedTPUEngine:
                  batch_spec: Any = None,
                  optimizer: Optional[optax.GradientTransformation] = None,
                  lr_scheduler: Optional[Callable] = None,
-                 donate_state: bool = True):
+                 donate_state: bool = True,
+                 autotp_example_batch: Any = None):
         self.config = config
         self.topo = topology or get_topology()
         set_topology(self.topo)
@@ -129,6 +130,25 @@ class DeepSpeedTPUEngine:
 
         zc = config.zero_optimization
         self.rules = ZeroShardingRules(zc.stage, self.topo, mics_shard_size=zc.mics_shard_size)
+        if isinstance(param_specs, str) and param_specs == "auto":
+            # AutoTP (reference module_inject/auto_tp.py:189): infer TP
+            # PartitionSpecs from the param tree. With an example batch the
+            # jaxpr dataflow analysis classifies col/row from the program;
+            # otherwise the reference's name vocabulary decides.
+            from ..module_inject import tp_parser
+            abstract = (jax.eval_shape(params) if callable(params)
+                        and not hasattr(params, "shape") else params)
+            if autotp_example_batch is not None:
+                if self._loss_takes_rng:
+                    trace_fn = lambda p, b: loss_fn(p, b, jax.random.PRNGKey(0))  # noqa: E731
+                else:
+                    trace_fn = loss_fn
+                param_specs = tp_parser(
+                    abstract, apply_fn=trace_fn,
+                    example_inputs=(autotp_example_batch,),
+                    tp_size=self.topo.tp_size)
+            else:
+                param_specs = tp_parser(abstract, tp_size=self.topo.tp_size)
         self.param_specs_base = param_specs
         self._offload_optimizer = zc.offload_optimizer.device in ("cpu", "nvme")
         # True host-offload (ZeRO-Offload): device=cpu + an adam-family config
@@ -679,6 +699,10 @@ class DeepSpeedTPUEngine:
             self._apply_host_adam(grads, float(np.asarray(global_grad_norm(grads))))
             self._compat_acc = None
             self._compat_count = 0
+            # a forward() cached before this step holds grads computed
+            # against the pre-step params/accumulator — drop it so a later
+            # backward() cannot commit already-applied gradients
+            self._compat_pending = None
             self.global_steps += 1
             return
         if self._apply_fn is None:
@@ -712,6 +736,7 @@ class DeepSpeedTPUEngine:
         self.state = self._apply_fn(self.state, self._compat_acc)
         self._compat_acc = None
         self._compat_count = 0
+        self._compat_pending = None  # see host-adam branch above
         self.global_steps += 1
 
     # ------------------------------------------------------------------
@@ -1012,7 +1037,9 @@ def initialize(args=None,
     engine = DeepSpeedTPUEngine(loss_fn=loss_fn, params=model_parameters, config=cfg,
                                 topology=topology, param_specs=param_specs,
                                 batch_spec=batch_spec, optimizer=optimizer,
-                                lr_scheduler=lr_scheduler)
+                                lr_scheduler=lr_scheduler,
+                                autotp_example_batch=kwargs.get(
+                                    "autotp_example_batch"))
     dist.configure(comms_logger=cfg.comms_logger)
 
     dataloader = None

@@ -112,6 +112,29 @@ def test_compat_forward_backward_step():
     assert compat.global_steps == 6
 
 
+def test_compat_forward_cached_across_step_not_double_applied():
+    """forward(b2) cached before step() must not commit pre-step grads:
+    sequence fwd(b1), bwd, fwd(b2), step, bwd, step must equal the canonical
+    per-batch fwd/bwd/step ordering."""
+    canonical = _make_engine(zero_stage=0)
+    reordered = _make_engine(zero_stage=0)
+    b1, b2 = random_batches(2, 8, HIDDEN, seed=7)
+    for b in (b1, b2):
+        canonical.forward(b)
+        canonical.backward()
+        canonical.step()
+    reordered.forward(b1)
+    reordered.backward()
+    reordered.forward(b2)   # cached against pre-step accumulator
+    reordered.step()        # applies b1; must invalidate the b2 cache
+    reordered.backward()    # recomputes b2 grads against fresh accumulator
+    reordered.step()
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-5, atol=1e-6),
+        canonical.state.params, reordered.state.params)
+
+
 def test_fp16_dynamic_loss_scale_skips():
     engine = _make_engine(zero_stage=0, extra_cfg={
         "fp16": {"enabled": True, "initial_scale_power": 32}})  # absurd scale -> overflow
