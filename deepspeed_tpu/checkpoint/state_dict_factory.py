@@ -1,0 +1,257 @@
+"""TP-aware merge/split of checkpoint state dicts.
+
+Reference: ``deepspeed/runtime/state_dict_factory.py`` — ``SDLoaderFactory`` /
+``MegatronSDLoader`` re-partition Megatron-style checkpoint shards when the
+serving TP degree differs from the saved one (``merge_state_dict:301``,
+``split_state_dict:350``), with special handling for fused query-key-value
+weights whose head layout differs by checkpoint version
+(``merge_query_key_value:220``, ``split_query_key_value:258``).
+
+TPU-native redesign: a state dict here is a flat/nested pytree of numpy
+arrays, and the TP layout is *described by PartitionSpecs* (from an explicit
+tree or AutoTP's ``tp_parser``) instead of being hard-coded per layer class.
+Merging N shards = concatenating each leaf along its sharded dim; splitting =
+host-side slicing (never materializing on device), so a 70B checkpoint
+re-partitions with O(one leaf) peak memory above the shard files.
+
+Fused-QKV layouts (the reference's version switch) are expressed as an
+explicit ``qkv_layout`` per leaf: ``"concat"`` ([q;k;v] blocks — Megatron
+ckpt_ver>=2 / llama-style) or ``"interleaved"`` (per-head [q,k,v] interleave —
+bloom/older Megatron), each sliced head-group-contiguously so every TP rank
+gets whole heads.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..module_inject.auto_tp import (flatten_with_paths,
+                                     shard_checkpoint_leaf, sharded_dim,
+                                     tp_parser)
+from ..utils.logging import log_dist
+
+__all__ = ["SDLoaderFactory", "merge_state_dicts", "split_state_dict",
+           "merge_qkv", "split_qkv"]
+
+
+# ---------------------------------------------------------------------------
+# Fused-QKV layout math (reference merge/split_query_key_value)
+# ---------------------------------------------------------------------------
+
+
+def split_qkv(value: np.ndarray, rank: int, size: int, *, num_heads: int,
+              layout: str = "concat", dim: int = -1) -> np.ndarray:
+    """Slice one fused-QKV weight so each rank gets whole heads of q, k, v.
+
+    ``concat``: the fused dim is [q_heads | k_heads | v_heads] — each third
+    is sliced independently and re-concatenated (reference ckpt_ver>=2 path,
+    ``split_query_key_value:283``).
+    ``interleaved``: the fused dim is [h0:(q,k,v), h1:(q,k,v), ...] — a plain
+    contiguous slice keeps whole (q,k,v) head groups together (reference
+    ckpt_ver<2 path).
+    """
+    dim = dim % value.ndim
+    n = value.shape[dim]
+    if n % (3 * num_heads):
+        raise ValueError(f"fused qkv dim {n} not divisible by 3*{num_heads}")
+    if num_heads % size:
+        raise ValueError(f"num_heads={num_heads} not divisible by tp={size}")
+    if layout == "interleaved":
+        step = n // size
+        idx = [slice(None)] * value.ndim
+        idx[dim] = slice(rank * step, (rank + 1) * step)
+        return np.ascontiguousarray(value[tuple(idx)])
+    if layout != "concat":
+        raise ValueError(f"unknown qkv layout {layout!r}")
+    third = n // 3
+    step = third // size
+    parts = []
+    for t in range(3):
+        idx = [slice(None)] * value.ndim
+        idx[dim] = slice(t * third + rank * step, t * third + (rank + 1) * step)
+        parts.append(value[tuple(idx)])
+    return np.ascontiguousarray(np.concatenate(parts, axis=dim))
+
+
+def merge_qkv(values: Sequence[np.ndarray], *, layout: str = "concat",
+              dim: int = -1) -> np.ndarray:
+    """Inverse of :func:`split_qkv` (reference ``merge_query_key_value:220``)."""
+    dim = dim % values[0].ndim
+    if layout == "interleaved":
+        return np.concatenate(values, axis=dim)
+    if layout != "concat":
+        raise ValueError(f"unknown qkv layout {layout!r}")
+    thirds: List[List[np.ndarray]] = [[], [], []]
+    for v in values:
+        n = v.shape[dim]
+        if n % 3:
+            raise ValueError(f"fused qkv shard dim {n} not divisible by 3")
+        step = n // 3
+        for t in range(3):
+            idx = [slice(None)] * v.ndim
+            idx[dim] = slice(t * step, (t + 1) * step)
+            thirds[t].append(v[tuple(idx)])
+    return np.ascontiguousarray(np.concatenate(
+        [np.concatenate(t, axis=dim) for t in thirds], axis=dim))
+
+
+# ---------------------------------------------------------------------------
+# Whole-tree merge / split
+# ---------------------------------------------------------------------------
+
+
+def merge_state_dicts(shards: Sequence[Any], specs: Any = None, *,
+                      axis: str = "tp",
+                      qkv_leaves: Optional[Dict[str, str]] = None) -> Any:
+    """Merge TP shard pytrees into one full pytree.
+
+    ``specs``: PartitionSpec tree (default: AutoTP name inference on the
+    first shard — sharded dims are found by *comparing shapes is not
+    possible* for already-sliced shards, so the spec tree is authoritative).
+    ``qkv_leaves``: path → layout for fused-QKV leaves needing the
+    version-aware merge.
+    """
+    if not shards:
+        raise ValueError("no shards to merge")
+    if specs is None:
+        specs = tp_parser(shards[0], axis=axis)
+    qkv_leaves = qkv_leaves or {}
+
+    paths, leaves0, treedef = flatten_with_paths(shards[0])
+    rest = [flatten_with_paths(s)[1] for s in shards[1:]]
+    spec_leaves = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    out = []
+    for i, (path, leaf0, spec) in enumerate(zip(paths, leaves0, spec_leaves)):
+        vals = [np.asarray(leaf0)] + [np.asarray(r[i]) for r in rest]
+        dim = sharded_dim(spec, axis)
+        # A leaf the split pass replicated (e.g. an indivisible dim) arrives
+        # identical in every shard even though the spec names it sharded —
+        # concatenating copies would corrupt it. Identical shards = one copy.
+        if dim is not None and all(
+                v.shape == vals[0].shape and np.array_equal(v, vals[0])
+                for v in vals[1:]):
+            dim = None
+        if path in qkv_leaves and dim is not None:
+            out.append(merge_qkv(vals, layout=qkv_leaves[path], dim=dim))
+            continue
+        if dim is None:
+            out.append(vals[0])
+        else:
+            out.append(np.ascontiguousarray(np.concatenate(vals, axis=dim)))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def split_state_dict(sd: Any, rank: int, size: int, specs: Any = None, *,
+                     axis: str = "tp",
+                     qkv_leaves: Optional[Dict[str, str]] = None,
+                     num_heads: Optional[int] = None) -> Any:
+    """Slice a full pytree to one TP rank's shard (host-side numpy)."""
+    if specs is None:
+        specs = tp_parser(sd, axis=axis, tp_size=size)
+    qkv_leaves = qkv_leaves or {}
+
+    paths, leaves, treedef = flatten_with_paths(sd)
+    spec_leaves = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    out = []
+    for path, leaf, spec in zip(paths, leaves, spec_leaves):
+        val = np.asarray(leaf)
+        if path in qkv_leaves:
+            if num_heads is None:
+                raise ValueError("qkv_leaves given but num_heads is None")
+            dim = sharded_dim(spec, axis)
+            out.append(split_qkv(val, rank, size, num_heads=num_heads,
+                                 layout=qkv_leaves[path],
+                                 dim=dim if dim is not None else -1))
+        else:
+            out.append(shard_checkpoint_leaf(val, spec, axis, rank, size))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class SDLoaderFactory:
+    """Reference ``SDLoaderFactory`` vocabulary: pick a loader and produce the
+    state dict for (mp_world_size, mp_rank) from a list of saved shards.
+
+    ``ckpt_list`` entries are either in-memory pytrees or paths to ``.npz``
+    files (flat key → array, '/'-joined paths) — the TPU-native serialized
+    shard format (orbax handles the full logical-global checkpoints;
+    this factory serves the reference's raw-shard re-partition flow).
+    """
+
+    @staticmethod
+    def get_sd_loader(ckpt_list: Sequence[Any], sd_type: str = "Megatron",
+                      version: Optional[int] = None, **kwargs) -> "SDLoader":
+        """``kwargs`` pass through to :class:`SDLoader` (``specs``,
+        ``qkv_leaves``, ``num_heads`` — the split path *requires* num_heads
+        when the checkpoint has fused-QKV leaves)."""
+        if sd_type.lower() not in ("megatron", "auto"):
+            raise ValueError(f"unsupported sd_type {sd_type!r}")
+        return SDLoader(list(ckpt_list), version=version, **kwargs)
+
+
+class SDLoader:
+    def __init__(self, ckpt_list: Sequence[Any], version: Optional[int] = None,
+                 specs: Any = None, qkv_leaves: Optional[Dict[str, str]] = None,
+                 num_heads: Optional[int] = None):
+        self.ckpt_list = list(ckpt_list)
+        self.version = version
+        self.specs = specs
+        # reference get_checkpoint_version: ckpt_ver>=2 => block-concat qkv
+        default_layout = "interleaved" if (version or 2) < 2 else "concat"
+        self.qkv_layout = default_layout
+        self.qkv_leaves = qkv_leaves
+        self.num_heads = num_heads
+
+    @staticmethod
+    def _load_one(entry) -> Any:
+        if isinstance(entry, str):
+            with np.load(entry) as z:
+                flat = {k: z[k] for k in z.files}
+            tree: Dict[str, Any] = {}
+            for k, v in flat.items():
+                node = tree
+                parts = k.split("/")
+                for p in parts[:-1]:
+                    node = node.setdefault(p, {})
+                node[parts[-1]] = v
+            return tree
+        return entry
+
+    def _auto_qkv(self, tree) -> Dict[str, str]:
+        if self.qkv_leaves is not None:
+            return self.qkv_leaves
+        found = {}
+        for path in flatten_with_paths(tree)[0]:
+            low = path.lower()
+            if any(t in low for t in ("query_key_value", "qkv", "c_attn")):
+                found[path] = self.qkv_layout
+        return found
+
+    def load(self, mp_world_size: int, mp_rank: int) -> Any:
+        """Reference ``SDLoaderBase.load:57``: produce this rank's state dict,
+        merging or splitting as the saved/serving TP degrees require."""
+        n = len(self.ckpt_list)
+        if mp_world_size == n:
+            return self._load_one(self.ckpt_list[mp_rank])
+        if mp_world_size < n:  # merge: this rank owns n//mp ckpt shards
+            if n % mp_world_size:
+                raise ValueError(f"cannot merge {n} shards to tp={mp_world_size}")
+            per = n // mp_world_size
+            shards = [self._load_one(c)
+                      for c in self.ckpt_list[mp_rank * per:(mp_rank + 1) * per]]
+            log_dist(f"sd_factory: merging {per} shards for mp_rank {mp_rank}")
+            return merge_state_dicts(shards, self.specs,
+                                     qkv_leaves=self._auto_qkv(shards[0]))
+        # split: this rank slices one saved shard
+        if mp_world_size % n:
+            raise ValueError(f"cannot split {n} shards to tp={mp_world_size}")
+        per = mp_world_size // n
+        src = self._load_one(self.ckpt_list[mp_rank // per])
+        log_dist(f"sd_factory: splitting shard {mp_rank // per} "
+                 f"{per}-way for mp_rank {mp_rank}")
+        return split_state_dict(src, mp_rank % per, per, self.specs,
+                                qkv_leaves=self._auto_qkv(src),
+                                num_heads=self.num_heads)

@@ -129,8 +129,12 @@ def _reshape_dim_map(old_shape: Sequence[int], new_shape: Sequence[int]
                 if j >= len(new):
                     return mapping
                 pn *= new[j]
-        # old[oi..i] merged/split against new[oj..j]: leading dims correspond
-        mapping[oi] = oj
+        # old[oi..i] vs new[oj..j]: a pure split (one old dim -> several
+        # new) or pure merge (several old -> one new) keeps the leading dims
+        # aligned; a many-to-many regrouping (e.g. (2,6)->(3,4)) has no
+        # contiguous correspondence — drop it (tags degrade safely).
+        if oi == i or oj == j:
+            mapping[oi] = oj
         i += 1
         j += 1
     return mapping
@@ -329,7 +333,8 @@ class _JaxprWalk:
             self.tags[out] = out_tags
 
 
-def _flatten_paths(tree) -> Tuple[List[str], List[Any], Any]:
+def flatten_with_paths(tree) -> Tuple[List[str], List[Any], Any]:
+    """Flatten a pytree to ('/'-joined path, leaf) with its treedef."""
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
     paths, leaves = [], []
     for kp, leaf in flat:
@@ -346,7 +351,7 @@ def infer_tp_roles(apply_fn, params, *example_inputs) -> Dict[str, Tuple[str, in
     materializes). Returns only the leaves the dataflow pass could decide;
     callers fall back to name heuristics for the rest.
     """
-    paths, leaves, _ = _flatten_paths(params)
+    paths, leaves, _ = flatten_with_paths(params)
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), params)
     closed = jax.make_jaxpr(apply_fn)(abstract, *example_inputs)
@@ -386,7 +391,7 @@ def _spec_by_name(path: str, ndim: int) -> AutoTPResult:
         if _matches(_EMBED_PATTERNS, low):
             return AutoTPResult("embed", ndim - 1, "name")
         return AutoTPResult("replicated", None, "name")
-    if is_bias or ndim == 1:
+    if ndim == 1:
         # bias shards with a column-parallel owner, replicates with row.
         parent = low.rsplit("/", 1)[0] if "/" in low else low
         if _matches(_ROW_PATTERNS, parent):
@@ -415,7 +420,7 @@ def tp_parser(params, apply_fn=None, example_inputs: Sequence[Any] = (),
     roles: Dict[str, Tuple[str, int]] = {}
     if apply_fn is not None:
         roles = infer_tp_roles(apply_fn, params, *example_inputs)
-    paths, leaves, treedef = _flatten_paths(params)
+    paths, leaves, treedef = flatten_with_paths(params)
     specs = []
     for path, leaf in zip(paths, leaves):
         ndim = len(jnp.shape(leaf))
@@ -431,6 +436,16 @@ def tp_parser(params, apply_fn=None, example_inputs: Sequence[Any] = (),
     return jax.tree_util.tree_unflatten(treedef, specs)
 
 
+def sharded_dim(spec: P, axis: str):
+    """First dim of ``spec`` sharded over ``axis`` (handles tuple axis
+    entries), or None."""
+    for dim, name in enumerate(spec):
+        names = (name,) if isinstance(name, str) else (name or ())
+        if axis in names:
+            return dim
+    return None
+
+
 def shard_checkpoint_leaf(value: np.ndarray, spec: P, axis: str,
                           axis_index: int, axis_size: int) -> np.ndarray:
     """Slice one checkpoint leaf to this TP rank's shard.
@@ -441,15 +456,14 @@ def shard_checkpoint_leaf(value: np.ndarray, spec: P, axis: str,
     """
     if axis_size == 1:
         return value
-    for dim, name in enumerate(spec):
-        names = (name,) if isinstance(name, str) else (name or ())
-        if axis in names:
-            if value.shape[dim] % axis_size:
-                raise ValueError(
-                    f"dim {dim} of shape {value.shape} not divisible by "
-                    f"tp={axis_size}")
-            step = value.shape[dim] // axis_size
-            idx = [slice(None)] * value.ndim
-            idx[dim] = slice(axis_index * step, (axis_index + 1) * step)
-            return np.ascontiguousarray(value[tuple(idx)])
-    return value
+    dim = sharded_dim(spec, axis)
+    if dim is None:
+        return value
+    if value.shape[dim] % axis_size:
+        raise ValueError(
+            f"dim {dim} of shape {value.shape} not divisible by "
+            f"tp={axis_size}")
+    step = value.shape[dim] // axis_size
+    idx = [slice(None)] * value.ndim
+    idx[dim] = slice(axis_index * step, (axis_index + 1) * step)
+    return np.ascontiguousarray(value[tuple(idx)])
