@@ -106,14 +106,17 @@ def merge_qkv(values: Sequence[np.ndarray], *, layout: str = "concat",
 
 def merge_state_dicts(shards: Sequence[Any], specs: Any = None, *,
                       axis: str = "tp",
-                      qkv_leaves: Optional[Dict[str, str]] = None) -> Any:
+                      qkv_leaves: Optional[Dict[str, str]] = None,
+                      split_size: Optional[int] = None) -> Any:
     """Merge TP shard pytrees into one full pytree.
 
     ``specs``: PartitionSpec tree (default: AutoTP name inference on the
     first shard — sharded dims are found by *comparing shapes is not
     possible* for already-sliced shards, so the spec tree is authoritative).
     ``qkv_leaves``: path → layout for fused-QKV leaves needing the
-    version-aware merge.
+    version-aware merge. ``split_size``: the TP degree the shards were
+    *written* at (defaults to ``len(shards)``) — used to recognize leaves
+    the split pass replicated.
     """
     if not shards:
         raise ValueError("no shards to merge")
@@ -128,12 +131,17 @@ def merge_state_dicts(shards: Sequence[Any], specs: Any = None, *,
     for i, (path, leaf0, spec) in enumerate(zip(paths, leaves0, spec_leaves)):
         vals = [np.asarray(leaf0)] + [np.asarray(r[i]) for r in rest]
         dim = sharded_dim(spec, axis)
-        # A leaf the split pass replicated (e.g. an indivisible dim) arrives
-        # identical in every shard even though the spec names it sharded —
-        # concatenating copies would corrupt it. Identical shards = one copy.
-        if dim is not None and all(
-                v.shape == vals[0].shape and np.array_equal(v, vals[0])
-                for v in vals[1:]):
+        # A leaf the split pass replicated (its dim was indivisible by the
+        # split degree) arrives identical in every shard even though the spec
+        # names it sharded — concatenating copies would corrupt it. The split
+        # pass only replicates when dim % split_size != 0, so a cleanly
+        # divisible dim is always a real shard (content equality there — e.g.
+        # zero-initialized biases — must NOT suppress the concat); an
+        # indivisible dim with identical content is a replica.
+        n_split = split_size or len(vals)
+        if (dim is not None and vals[0].shape[dim] % n_split != 0
+                and all(v.shape == vals[0].shape and np.array_equal(v, vals[0])
+                        for v in vals[1:])):
             dim = None
         if path in qkv_leaves and dim is not None:
             out.append(merge_qkv(vals, layout=qkv_leaves[path], dim=dim))
@@ -200,7 +208,10 @@ class SDLoader:
         self.version = version
         self.specs = specs
         # reference get_checkpoint_version: ckpt_ver>=2 => block-concat qkv
-        default_layout = "interleaved" if (version or 2) < 2 else "concat"
+        # (version 0 is a real value — old Megatron — and must stay < 2)
+        default_layout = ("interleaved"
+                          if (2 if version is None else version) < 2
+                          else "concat")
         self.qkv_layout = default_layout
         self.qkv_leaves = qkv_leaves
         self.num_heads = num_heads
@@ -244,7 +255,8 @@ class SDLoader:
                       for c in self.ckpt_list[mp_rank * per:(mp_rank + 1) * per]]
             log_dist(f"sd_factory: merging {per} shards for mp_rank {mp_rank}")
             return merge_state_dicts(shards, self.specs,
-                                     qkv_leaves=self._auto_qkv(shards[0]))
+                                     qkv_leaves=self._auto_qkv(shards[0]),
+                                     split_size=n)
         # split: this rank slices one saved shard
         if mp_world_size % n:
             raise ValueError(f"cannot split {n} shards to tp={mp_world_size}")

@@ -13,8 +13,11 @@ Supported families (reference containers ``module_inject/containers/*`` +
 (RoPE+GQA+SwiGLU), gpt2 (learned pos, GELU), mixtral (MoE), qwen2 (qkv
 bias), phi3 (fused qkv/gate_up), falcon (parallel residual, GQA/MQA fused
 qkv, optional ALiBi), gpt_neox (parallel residual, partial rotary, fused
-qkv), opt (learned pos offset 2, ReLU) — one converter per weight-naming
-scheme.
+qkv), opt (learned pos offset 2, ReLU), bloom (ALiBi, embedding layernorm,
+interleaved fused qkv), gptj (rotate-every-two partial rotary, shared-norm
+parallel residual, biased lm_head), gpt_neo (unscaled attention,
+alternating local windows), phi (partial rotary, parallel shared-norm,
+fully biased) — one converter per weight-naming scheme.
 """
 
 from typing import Any, Dict
@@ -113,8 +116,67 @@ def config_from_hf(hf_config) -> TransformerConfig:
             else "gelu",
             position="learned", pos_offset=2,
             tie_embeddings=d.get("tie_word_embeddings", True))
+    if mt == "bloom":
+        return TransformerConfig(
+            vocab_size=d["vocab_size"], hidden_size=d["hidden_size"],
+            intermediate_size=4 * d["hidden_size"],
+            num_layers=d["n_layer"], num_heads=d["n_head"],
+            max_seq_len=d.get("max_position_embeddings") or 2048,
+            norm="layernorm", activation="gelu", position="alibi",
+            norm_eps=d.get("layer_norm_epsilon", 1e-5),
+            embed_norm=True,  # word_embeddings_layernorm
+            attn_qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+            tie_embeddings=True)
+    if mt == "gptj":
+        dh = d["n_embd"] // d["n_head"]
+        return TransformerConfig(
+            vocab_size=d["vocab_size"], hidden_size=d["n_embd"],
+            intermediate_size=d.get("n_inner") or 4 * d["n_embd"],
+            num_layers=d["n_layer"], num_heads=d["n_head"],
+            max_seq_len=d["n_positions"], norm="layernorm", activation="gelu",
+            position="rope", rotary_pct=(d.get("rotary_dim") or dh) / dh,
+            rotary_interleaved=True,  # rotate-every-two pairing
+            norm_eps=d.get("layer_norm_epsilon", 1e-5),
+            parallel_residual=True, parallel_shared_norm=True,  # single ln_1
+            attn_qkv_bias=False, attn_out_bias=False, mlp_bias=True,
+            lm_head_bias=True, tie_embeddings=False)
+    if mt == "gpt_neo":
+        # expand attention_types [[["global","local"], N/2]] to per-layer
+        kinds = []
+        for group, repeat in d["attention_types"]:
+            kinds.extend(list(group) * repeat)
+        windows = tuple(d.get("window_size", 256) if k == "local" else None
+                        for k in kinds)
+        return TransformerConfig(
+            vocab_size=d["vocab_size"], hidden_size=d["hidden_size"],
+            intermediate_size=d.get("intermediate_size") or 4 * d["hidden_size"],
+            num_layers=d["num_layers"], num_heads=d["num_heads"],
+            max_seq_len=d.get("max_position_embeddings", 2048),
+            norm="layernorm", activation="gelu", position="learned",
+            norm_eps=d.get("layer_norm_epsilon", 1e-5),
+            attn_scale=1.0,  # gpt-neo attention is famously unscaled
+            layer_windows=windows if any(w for w in windows) else None,
+            attn_qkv_bias=False, attn_out_bias=True, mlp_bias=True,
+            tie_embeddings=True)
+    if mt == "phi":
+        if d.get("qk_layernorm"):
+            raise ValueError("phi qk_layernorm checkpoints are not supported")
+        return TransformerConfig(
+            vocab_size=d["vocab_size"], hidden_size=d["hidden_size"],
+            intermediate_size=d["intermediate_size"],
+            num_layers=d["num_hidden_layers"], num_heads=d["num_attention_heads"],
+            num_kv_heads=d.get("num_key_value_heads") or d["num_attention_heads"],
+            max_seq_len=d.get("max_position_embeddings", 2048),
+            norm="layernorm", activation="gelu", position="rope",
+            rope_theta=d.get("rope_theta", 10000.0),
+            rotary_pct=d.get("partial_rotary_factor", 0.5),
+            norm_eps=d.get("layer_norm_eps", 1e-5),
+            parallel_residual=True, parallel_shared_norm=True,
+            attn_qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+            lm_head_bias=True, tie_embeddings=False)
     raise ValueError(f"unsupported HF model_type '{mt}' (supported: llama, "
-                     "mistral, mixtral, qwen2, phi3, gpt2, falcon, gpt_neox, opt)")
+                     "mistral, mixtral, qwen2, phi3, gpt2, falcon, gpt_neox, "
+                     "opt, bloom, gptj, gpt_neo, phi)")
 
 
 def _llama_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
@@ -394,6 +456,153 @@ def _opt_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
     return p
 
 
+def _bloom_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
+    h, dh, dm = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    p: Dict[str, Any] = {
+        "embed": {"embedding": _t(sd["transformer.word_embeddings.weight"])},
+        "embed_norm": {
+            "scale": _t(sd["transformer.word_embeddings_layernorm.weight"]),
+            "bias": _t(sd["transformer.word_embeddings_layernorm.bias"])},
+    }
+    to_flax = lambda a: np.transpose(a, (2, 0, 1))  # [h,dh,D] -> [D,h,dh]
+    for i in range(cfg.num_layers):
+        pre = f"transformer.h.{i}."
+        # fused qkv, per-head [q, k, v] interleaved (bloom layout)
+        w = _t(sd[pre + "self_attention.query_key_value.weight"]).reshape(
+            h, 3, dh, dm)
+        b = _t(sd[pre + "self_attention.query_key_value.bias"]).reshape(h, 3, dh)
+        p[f"layer_{i}"] = {
+            "attn": {
+                "q_proj": {"kernel": to_flax(w[:, 0]), "bias": b[:, 0]},
+                "k_proj": {"kernel": to_flax(w[:, 1]), "bias": b[:, 1]},
+                "v_proj": {"kernel": to_flax(w[:, 2]), "bias": b[:, 2]},
+                "o_proj": {"kernel": _t(sd[pre + "self_attention.dense.weight"])
+                           .T.reshape(h, dh, dm),
+                           "bias": _t(sd[pre + "self_attention.dense.bias"])},
+            },
+            "attn_norm": {"scale": _t(sd[pre + "input_layernorm.weight"]),
+                          "bias": _t(sd[pre + "input_layernorm.bias"])},
+            "mlp_norm": {"scale": _t(sd[pre + "post_attention_layernorm.weight"]),
+                         "bias": _t(sd[pre + "post_attention_layernorm.bias"])},
+            "mlp": {
+                "up_proj": {"kernel": _t(sd[pre + "mlp.dense_h_to_4h.weight"]).T,
+                            "bias": _t(sd[pre + "mlp.dense_h_to_4h.bias"])},
+                "down_proj": {"kernel": _t(sd[pre + "mlp.dense_4h_to_h.weight"]).T,
+                              "bias": _t(sd[pre + "mlp.dense_4h_to_h.bias"])},
+            },
+        }
+    p["final_norm"] = {"scale": _t(sd["transformer.ln_f.weight"]),
+                       "bias": _t(sd["transformer.ln_f.bias"])}
+    return p
+
+
+def _gptj_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
+    h, dh, dm = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    p: Dict[str, Any] = {"embed": {"embedding": _t(sd["transformer.wte.weight"])}}
+    for i in range(cfg.num_layers):
+        pre = f"transformer.h.{i}."
+        p[f"layer_{i}"] = {
+            "attn": {
+                "q_proj": {"kernel": _t(sd[pre + "attn.q_proj.weight"]).T
+                           .reshape(dm, h, dh)},
+                "k_proj": {"kernel": _t(sd[pre + "attn.k_proj.weight"]).T
+                           .reshape(dm, h, dh)},
+                "v_proj": {"kernel": _t(sd[pre + "attn.v_proj.weight"]).T
+                           .reshape(dm, h, dh)},
+                "o_proj": {"kernel": _t(sd[pre + "attn.out_proj.weight"]).T
+                           .reshape(h, dh, dm)},
+            },
+            # single ln_1 feeds both branches (parallel_shared_norm)
+            "attn_norm": {"scale": _t(sd[pre + "ln_1.weight"]),
+                          "bias": _t(sd[pre + "ln_1.bias"])},
+            "mlp": {
+                "up_proj": {"kernel": _t(sd[pre + "mlp.fc_in.weight"]).T,
+                            "bias": _t(sd[pre + "mlp.fc_in.bias"])},
+                "down_proj": {"kernel": _t(sd[pre + "mlp.fc_out.weight"]).T,
+                              "bias": _t(sd[pre + "mlp.fc_out.bias"])},
+            },
+        }
+    p["final_norm"] = {"scale": _t(sd["transformer.ln_f.weight"]),
+                       "bias": _t(sd["transformer.ln_f.bias"])}
+    p["lm_head"] = {"kernel": _t(sd["lm_head.weight"]).T,
+                    "bias": _t(sd["lm_head.bias"])}
+    return p
+
+
+def _gpt_neo_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
+    h, dh, dm = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    p: Dict[str, Any] = {
+        "embed": {"embedding": _t(sd["transformer.wte.weight"])},
+        "pos_embed": _t(sd["transformer.wpe.weight"]),
+    }
+    for i in range(cfg.num_layers):
+        pre = f"transformer.h.{i}."
+        # gpt-neo uses nn.Linear ([out, in] — transpose), unlike gpt2 Conv1D
+        p[f"layer_{i}"] = {
+            "attn": {
+                "q_proj": {"kernel": _t(sd[pre + "attn.attention.q_proj.weight"])
+                           .T.reshape(dm, h, dh)},
+                "k_proj": {"kernel": _t(sd[pre + "attn.attention.k_proj.weight"])
+                           .T.reshape(dm, h, dh)},
+                "v_proj": {"kernel": _t(sd[pre + "attn.attention.v_proj.weight"])
+                           .T.reshape(dm, h, dh)},
+                "o_proj": {"kernel": _t(sd[pre + "attn.attention.out_proj.weight"])
+                           .T.reshape(h, dh, dm),
+                           "bias": _t(sd[pre + "attn.attention.out_proj.bias"])},
+            },
+            "attn_norm": {"scale": _t(sd[pre + "ln_1.weight"]),
+                          "bias": _t(sd[pre + "ln_1.bias"])},
+            "mlp_norm": {"scale": _t(sd[pre + "ln_2.weight"]),
+                         "bias": _t(sd[pre + "ln_2.bias"])},
+            "mlp": {
+                "up_proj": {"kernel": _t(sd[pre + "mlp.c_fc.weight"]).T,
+                            "bias": _t(sd[pre + "mlp.c_fc.bias"])},
+                "down_proj": {"kernel": _t(sd[pre + "mlp.c_proj.weight"]).T,
+                              "bias": _t(sd[pre + "mlp.c_proj.bias"])},
+            },
+        }
+    p["final_norm"] = {"scale": _t(sd["transformer.ln_f.weight"]),
+                       "bias": _t(sd["transformer.ln_f.bias"])}
+    return p
+
+
+def _phi_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
+    h, hk, dh, dm = cfg.num_heads, cfg.kv_heads, cfg.head_dim, cfg.hidden_size
+    p: Dict[str, Any] = {"embed": {"embedding": _t(sd["model.embed_tokens.weight"])}}
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        p[f"layer_{i}"] = {
+            "attn": {
+                "q_proj": {"kernel": _t(sd[pre + "self_attn.q_proj.weight"]).T
+                           .reshape(dm, h, dh),
+                           "bias": _t(sd[pre + "self_attn.q_proj.bias"]).reshape(h, dh)},
+                "k_proj": {"kernel": _t(sd[pre + "self_attn.k_proj.weight"]).T
+                           .reshape(dm, hk, dh),
+                           "bias": _t(sd[pre + "self_attn.k_proj.bias"]).reshape(hk, dh)},
+                "v_proj": {"kernel": _t(sd[pre + "self_attn.v_proj.weight"]).T
+                           .reshape(dm, hk, dh),
+                           "bias": _t(sd[pre + "self_attn.v_proj.bias"]).reshape(hk, dh)},
+                "o_proj": {"kernel": _t(sd[pre + "self_attn.dense.weight"]).T
+                           .reshape(h, dh, dm),
+                           "bias": _t(sd[pre + "self_attn.dense.bias"])},
+            },
+            # phi: one input_layernorm feeds attn AND mlp (parallel residual)
+            "attn_norm": {"scale": _t(sd[pre + "input_layernorm.weight"]),
+                          "bias": _t(sd[pre + "input_layernorm.bias"])},
+            "mlp": {
+                "up_proj": {"kernel": _t(sd[pre + "mlp.fc1.weight"]).T,
+                            "bias": _t(sd[pre + "mlp.fc1.bias"])},
+                "down_proj": {"kernel": _t(sd[pre + "mlp.fc2.weight"]).T,
+                              "bias": _t(sd[pre + "mlp.fc2.bias"])},
+            },
+        }
+    p["final_norm"] = {"scale": _t(sd["model.final_layernorm.weight"]),
+                       "bias": _t(sd["model.final_layernorm.bias"])}
+    p["lm_head"] = {"kernel": _t(sd["lm_head.weight"]).T,
+                    "bias": _t(sd["lm_head.bias"])}
+    return p
+
+
 def params_from_hf(model_or_state_dict, hf_config=None):
     """Convert a HF model (or its state_dict + config) → ``(TransformerConfig,
     params)`` ready for ``InferenceEngine`` / the training engine."""
@@ -417,6 +626,14 @@ def params_from_hf(model_or_state_dict, hf_config=None):
         params = _neox_params(sd, cfg)
     elif mt == "opt":
         params = _opt_params(sd, cfg)
+    elif mt == "bloom":
+        params = _bloom_params(sd, cfg)
+    elif mt == "gptj":
+        params = _gptj_params(sd, cfg)
+    elif mt == "gpt_neo":
+        params = _gpt_neo_params(sd, cfg)
+    elif mt == "phi":
+        params = _phi_params(sd, cfg)
     else:
         params = _gpt2_params(sd, cfg)
     return cfg, _to_jnp(params)

@@ -52,7 +52,14 @@ class TransformerConfig:
     parallel_residual: bool = False             # falcon / gpt-neox / gpt-j
     parallel_shared_norm: bool = False          # falcon-7b: one norm feeds both
     rotary_pct: float = 1.0                     # gpt-neox partial rotary
+    rotary_interleaved: bool = False            # gpt-j rotate-every-two pairs
     pos_offset: int = 0                         # OPT: learned pos ids offset 2
+    embed_norm: bool = False                    # bloom word_embeddings_layernorm
+    lm_head_bias: bool = False                  # gpt-j / phi biased lm_head
+    attn_scale: Optional[float] = None          # gpt-neo trains UNSCALED (1.0)
+    # per-layer attention windows (gpt-neo local attention): tuple with one
+    # entry per layer, None = global; e.g. (None, 256, None, 256, ...)
+    layer_windows: Optional[Any] = None
     # MoE (mixtral): replace the MLP every `moe_every` layers
     num_experts: int = 0
     moe_top_k: int = 2
@@ -109,10 +116,13 @@ def rope_table(seq_len: int, head_dim: int, theta: float):
     return jnp.asarray(np.cos(angles)), jnp.asarray(np.sin(angles))
 
 
-def apply_rope(x, cos, sin, positions=None):
-    """x: [B, S, H, D]; rotate pairs (even, odd) halves interleaved-free.
-    Partial rotary (gpt-neox ``rotary_pct``): when the table covers fewer
-    dims than D, only the leading ``2 * cos.shape[-1]`` dims rotate."""
+def apply_rope(x, cos, sin, positions=None, interleaved: bool = False):
+    """x: [B, S, H, D]. Two pairing conventions (HF container zoo):
+    half-split "rotate_half" (llama/neox — pairs are (i, i+rot/2)) and
+    ``interleaved`` "rotate_every_two" (gpt-j — pairs are (2i, 2i+1)).
+    Partial rotary (gpt-neox ``rotary_pct`` / gpt-j ``rotary_dim``): when the
+    table covers fewer dims than D, only the leading ``2 * cos.shape[-1]``
+    dims rotate."""
     rot = 2 * cos.shape[-1]
     x_rot, x_pass = x[..., :rot], x[..., rot:]
     if positions is None:
@@ -121,8 +131,15 @@ def apply_rope(x, cos, sin, positions=None):
     else:
         cos_p = cos[positions][:, :, None, :]
         sin_p = sin[positions][:, :, None, :]
-    x1, x2 = jnp.split(x_rot, 2, axis=-1)
-    out = jnp.concatenate([x1 * cos_p - x2 * sin_p, x2 * cos_p + x1 * sin_p], axis=-1)
+    if interleaved:
+        x1, x2 = x_rot[..., 0::2], x_rot[..., 1::2]
+        r1 = x1 * cos_p - x2 * sin_p
+        r2 = x2 * cos_p + x1 * sin_p
+        out = jnp.stack([r1, r2], axis=-1).reshape(x_rot.shape)
+    else:
+        x1, x2 = jnp.split(x_rot, 2, axis=-1)
+        out = jnp.concatenate([x1 * cos_p - x2 * sin_p,
+                               x2 * cos_p + x1 * sin_p], axis=-1)
     if x_pass.shape[-1]:
         out = jnp.concatenate([out, x_pass], axis=-1)
     return out.astype(x.dtype)
@@ -148,12 +165,15 @@ def alibi_slopes(num_heads: int) -> np.ndarray:
 
 
 def attention_core(q, k, v, *, causal: bool = True, impl: str = "auto",
-                   positions_q=None, positions_kv=None, alibi=None):
+                   positions_q=None, positions_kv=None, alibi=None,
+                   scale=None, window=None):
     """[B, S, H, D] attention. ``flash`` uses the Pallas kernel on TPU;
     ``xla`` is the jnp reference (fused well by XLA on small shapes).
     ``alibi``: per-head slopes [H] — adds ``-slope * (pos_q - pos_k)`` to the
-    logits (Press et al.; reference bloom/falcon containers)."""
-    if impl == "flash" and alibi is None:
+    logits (Press et al.; reference bloom/falcon containers).
+    ``scale``: logits multiplier (default 1/sqrt(d); gpt-neo uses 1.0).
+    ``window``: local attention — key j visible iff q_pos - j < window."""
+    if impl == "flash" and alibi is None and scale is None and window is None:
         from ..ops.pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal)
@@ -164,7 +184,7 @@ def attention_core(q, k, v, *, causal: bool = True, impl: str = "auto",
         rep = h // k.shape[2]
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    scale = 1.0 / np.sqrt(d)
+    scale = (1.0 / np.sqrt(d)) if scale is None else float(scale)
     # fp32 accumulation off the MXU (free on TPU), so softmax sees full precision
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
@@ -178,6 +198,8 @@ def attention_core(q, k, v, *, causal: bool = True, impl: str = "auto",
         logits = logits - (scale * jnp.asarray(alibi))[None, :, None, None] * dist[None, None]
     if causal:
         mask = pq >= pk  # [sq, skv]
+        if window is not None:
+            mask = mask & (pq - pk < window)
         logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
@@ -193,7 +215,8 @@ def _update_cache(cache_kv, new_kv, cache_index):
     return jax.vmap(upd)(cache_kv, new_kv, cache_index)
 
 
-def cached_attention(q, k_cache, v_cache, q_pos, alibi=None):
+def cached_attention(q, k_cache, v_cache, q_pos, alibi=None, scale=None,
+                     window=None):
     """Decode attention over the full KV cache with per-sequence validity:
     cache slot j attends iff ``j <= q_pos`` (absolute position), which also
     masks unwritten slots. q: [B,S,H,D]; caches: [B,M,Hk,D]; q_pos: [B,S].
@@ -203,7 +226,7 @@ def cached_attention(q, k_cache, v_cache, q_pos, alibi=None):
     m, hk = k_cache.shape[1], k_cache.shape[2]
     rep = h // hk
     qg = q.reshape(b, s, hk, rep, d)
-    scale = 1.0 / np.sqrt(d)
+    scale = (1.0 / np.sqrt(d)) if scale is None else float(scale)
     logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k_cache.astype(q.dtype),
                         preferred_element_type=jnp.float32) * scale
     slot = jnp.arange(m)[None, None, None, None, :]
@@ -213,6 +236,8 @@ def cached_attention(q, k_cache, v_cache, q_pos, alibi=None):
         sl = scale * jnp.asarray(alibi).reshape(hk, rep)
         logits = logits - sl[None, :, :, None, None] * dist
     mask = slot <= q_pos[:, None, None, :, None]
+    if window is not None:
+        mask = mask & (q_pos[:, None, None, :, None] - slot < window)
     logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v_cache.astype(q.dtype))
@@ -221,12 +246,15 @@ def cached_attention(q, k_cache, v_cache, q_pos, alibi=None):
 
 class Attention(nn.Module):
     cfg: TransformerConfig
+    window: Optional[int] = None   # gpt-neo per-layer local attention
 
     @nn.compact
     def __call__(self, x, *, deterministic=True, cache=None, cache_index=None,
                  whole_prefill=False):
         cfg = self.cfg
         h, hk, d = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        rope = partial(apply_rope, interleaved=cfg.rotary_interleaved)
+        scale, window = cfg.attn_scale, self.window
         dense = partial(nn.DenseGeneral, use_bias=cfg.qkv_bias,
                         dtype=cfg.dtype, param_dtype=jnp.float32)
         q = dense(features=(h, d), name="q_proj")(x)
@@ -245,8 +273,8 @@ class Attention(nn.Module):
             # incremental decoding path (inference v1 engine)
             positions = cache_index[:, None] + jnp.arange(x.shape[1])[None, :]
             if cfg.position == "rope":
-                q = apply_rope(q, cos, sin, positions)
-                k = apply_rope(k, cos, sin, positions)
+                q = rope(q, cos, sin, positions)
+                k = rope(k, cos, sin, positions)
             new_cache = {"k": _update_cache(cache["k"], k, cache_index),
                          "v": _update_cache(cache["v"], v, cache_index)}
             if x.shape[1] > 1 and whole_prefill:
@@ -256,10 +284,11 @@ class Attention(nn.Module):
                 # whole_prefill promise, chunked multi-token calls take the
                 # full-cache path, which is correct for any cache_index.
                 out = attention_core(q, k, v, causal=True, impl="xla",
-                                     alibi=alibi)
+                                     alibi=alibi, scale=scale, window=window)
             else:
                 out = cached_attention(q, new_cache["k"], new_cache["v"],
-                                       positions, alibi=alibi)
+                                       positions, alibi=alibi, scale=scale,
+                                       window=window)
             return o_proj(out), new_cache
 
         impl = cfg.attn_impl
@@ -269,7 +298,8 @@ class Attention(nn.Module):
             # (the flash kernel takes no additive bias)
             seq = x.shape[1]
             impl = "flash" if (jax.default_backend() != "cpu" and seq % 128 == 0
-                               and alibi is None) else "xla"
+                               and alibi is None and scale is None
+                               and window is None) else "xla"
 
         # Ulysses only in real execution: flax init traces tiny batches that
         # need not divide the mesh, and attention adds no params anyway.
@@ -282,16 +312,18 @@ class Attention(nn.Module):
 
             def local_attn(q_, k_, v_, pos):
                 if cfg.position == "rope":
-                    q_ = apply_rope(q_, cos, sin, pos)
-                    k_ = apply_rope(k_, cos, sin, pos)
-                return attention_core(q_, k_, v_, causal=True, impl=impl)
+                    q_ = rope(q_, cos, sin, pos)
+                    k_ = rope(k_, cos, sin, pos)
+                return attention_core(q_, k_, v_, causal=True, impl=impl,
+                                      scale=scale, window=window)
 
             out = ulysses_attention(local_attn, q, k, v)
         else:
             if cfg.position == "rope":
-                q = apply_rope(q, cos, sin)
-                k = apply_rope(k, cos, sin)
-            out = attention_core(q, k, v, causal=True, impl=impl, alibi=alibi)
+                q = rope(q, cos, sin)
+                k = rope(k, cos, sin)
+            out = attention_core(q, k, v, causal=True, impl=impl, alibi=alibi,
+                                 scale=scale, window=window)
 
         out = o_proj(out)
         if cfg.dropout > 0 and not deterministic:
@@ -330,7 +362,10 @@ class Block(nn.Module):
         # (x, deterministic) stay positional for nn.remat static_argnums
         cfg = self.cfg
         y = _norm(cfg, "attn_norm")(x)
-        attn = Attention(cfg, name="attn")
+        window = None
+        if cfg.layer_windows is not None:
+            window = cfg.layer_windows[self.layer_idx]
+        attn = Attention(cfg, window=window, name="attn")
         if cache is not None:
             attn_out, new_cache = attn(y, deterministic=deterministic,
                                        cache=cache, cache_index=cache_index,
@@ -373,6 +408,8 @@ class TransformerLM(nn.Module):
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                          param_dtype=jnp.float32, name="embed")
         x = embed(tokens)
+        if cfg.embed_norm:  # bloom word_embeddings_layernorm
+            x = _norm(cfg, "embed_norm")(x)
         if cfg.position == "learned":
             pos_emb = self.param("pos_embed", nn.initializers.normal(0.02),
                                  (cfg.max_seq_len + cfg.pos_offset,
@@ -403,7 +440,8 @@ class TransformerLM(nn.Module):
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+            logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias,
+                              dtype=jnp.float32,
                               param_dtype=jnp.float32, name="lm_head")(x.astype(jnp.float32))
         return (logits, new_cache) if cache is not None else logits
 
@@ -505,6 +543,13 @@ def transformer_pipeline_fns(cfg: TransformerConfig):
     expressed per pipeline stage). MoE aux losses are sown into a collection
     the pipeline does not thread, so they are excluded here (dense CE only).
     """
+    if cfg.layer_windows is not None and len(set(cfg.layer_windows)) > 1:
+        raise ValueError(
+            "pipeline bridge runs ONE stacked block program for all layers; "
+            "per-layer attention windows (layer_windows with mixed values, "
+            "gpt-neo style) cannot vary across a scanned stack — use the "
+            "non-pipeline model or a uniform window")
+    # a uniform window flows through Block(layer_idx=0) reading layer_windows[0]
     block_mod = Block(cfg, layer_idx=0)
     final_norm_mod = _norm(cfg, "final_norm")  # same module the model uses
 
@@ -524,6 +569,8 @@ def transformer_pipeline_fns(cfg: TransformerConfig):
         mask = mb.get("loss_mask") if isinstance(mb, dict) else None
         x = final_norm_mod.apply({"params": p["final_norm"]}, x)
         logits = x.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
+        if "bias" in p["lm_head"]:  # gptj/phi biased lm_head
+            logits = logits + p["lm_head"]["bias"].astype(jnp.float32)
         return causal_lm_loss(logits, tokens, mask)
 
     return embed_fn, block_fn, head_loss_fn
@@ -574,6 +621,8 @@ def param_specs(params, tp_axis: str = "tp") -> Any:
             return P(None, tp_axis)
         if not is_bias and "lm_head" in path and nd == 2:
             return P(None, tp_axis)
+        if is_bias and "lm_head" in path:
+            return P(tp_axis)  # shards with the vocab-sharded kernel output
         return P(*([None] * nd))
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)

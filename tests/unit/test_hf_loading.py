@@ -224,6 +224,88 @@ def test_new_family_generate_matches_hf(family):
     assert np.array_equal(out, hf_out[:, 6:].numpy())
 
 
+def test_bloom_parity():
+    """ALiBi + embedding layernorm + per-head interleaved fused qkv."""
+    hf_cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
+        hidden_dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(13)
+    _golden(transformers.BloomForCausalLM(hf_cfg).eval(), 128, seed=13,
+            position="alibi", embed_norm=True, tie_embeddings=True,
+            attn_qkv_bias=True)
+
+
+def test_gptj_parity():
+    """Interleaved (rotate-every-two) partial rotary + shared-norm parallel
+    residual + biased lm_head."""
+    hf_cfg = transformers.GPTJConfig(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        rotary_dim=8, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(14)
+    _golden(transformers.GPTJForCausalLM(hf_cfg).eval(), 128, seed=14,
+            rotary_interleaved=True, rotary_pct=0.5, parallel_residual=True,
+            parallel_shared_norm=True, lm_head_bias=True)
+
+
+def test_gpt_neo_parity():
+    """Unscaled attention + alternating global/local (windowed) layers."""
+    hf_cfg = transformers.GPTNeoConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        attention_types=[[["global", "local"], 1]], window_size=4,
+        max_position_embeddings=64, resid_dropout=0.0, embed_dropout=0.0,
+        attention_dropout=0.0)
+    torch.manual_seed(15)
+    cfg = _golden(transformers.GPTNeoForCausalLM(hf_cfg).eval(), 128, seed=15,
+                  attn_scale=1.0, position="learned", tie_embeddings=True)
+    assert cfg.layer_windows == (None, 4)
+
+
+def test_phi_parity():
+    """phi-1/2: layernorm + partial rotary + parallel shared-norm residual +
+    fully-biased projections incl. lm_head."""
+    hf_cfg = transformers.PhiConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        partial_rotary_factor=0.5, max_position_embeddings=64,
+        resid_pdrop=0.0, embd_pdrop=0.0, attention_dropout=0.0)
+    torch.manual_seed(16)
+    _golden(transformers.PhiForCausalLM(hf_cfg).eval(), 128, seed=16,
+            rotary_pct=0.5, parallel_residual=True, parallel_shared_norm=True,
+            attn_qkv_bias=True, lm_head_bias=True)
+
+
+@pytest.mark.parametrize("family", ["bloom", "gptj", "gpt_neo"])
+def test_round3_family_generate_matches_hf(family):
+    """Greedy decode parity for the new cache paths (alibi cache, interleaved
+    rotary cache, windowed cached attention)."""
+    torch.manual_seed(17)
+    if family == "bloom":
+        hf = transformers.BloomForCausalLM(transformers.BloomConfig(
+            vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
+            hidden_dropout=0.0, attention_dropout=0.0)).eval()
+    elif family == "gptj":
+        hf = transformers.GPTJForCausalLM(transformers.GPTJConfig(
+            vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+            rotary_dim=8, resid_pdrop=0.0, embd_pdrop=0.0,
+            attn_pdrop=0.0)).eval()
+    else:
+        hf = transformers.GPTNeoForCausalLM(transformers.GPTNeoConfig(
+            vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+            attention_types=[[["global", "local"], 1]], window_size=4,
+            max_position_embeddings=64, resid_dropout=0.0, embed_dropout=0.0,
+            attention_dropout=0.0)).eval()
+    cfg, params = params_from_hf(hf)
+    model = TransformerLM(type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32}))
+    eng = InferenceEngine(model, params,
+                          DeepSpeedInferenceConfig(dtype="float32", max_out_tokens=32))
+    prompts = jnp.asarray(np.random.default_rng(17).integers(0, 128, (2, 6)), jnp.int32)
+    out = eng.generate(prompts, max_new_tokens=4)
+    with torch.no_grad():
+        hf_out = hf.generate(torch.tensor(np.asarray(prompts)), max_new_tokens=4,
+                             do_sample=False, pad_token_id=0)
+    assert np.array_equal(out, hf_out[:, 6:].numpy())
+
+
 def test_falcon_bias_parity():
     """falcon-rw-1b style: fused qkv WITH biases + alibi + sequential."""
     hf_cfg = transformers.FalconConfig(

@@ -147,3 +147,21 @@ class TestSDLoader:
         loader = SDLoaderFactory.get_sd_loader([sd], version=2)
         with pytest.raises(ValueError):
             loader.load(2, 0)
+
+    def test_merge_constant_sharded_leaf_still_concatenates(self):
+        """Zero-initialized (identical-content) shards of a divisible dim are
+        REAL shards and must concatenate back to full size."""
+        sd = {"up_proj": {"kernel": np.ones((4, 16), np.float32),
+                          "bias": np.zeros((16,), np.float32)}}
+        shards = [split_state_dict(sd, r, 2) for r in range(2)]
+        assert shards[0]["up_proj"]["bias"].shape == (8,)
+        merged = merge_state_dicts(shards, split_size=2)
+        assert merged["up_proj"]["bias"].shape == (16,)
+        assert merged["up_proj"]["kernel"].shape == (4, 16)
+
+    def test_version_zero_is_interleaved(self):
+        from deepspeed_tpu.checkpoint.state_dict_factory import SDLoader
+        assert SDLoader([{}], version=0).qkv_layout == "interleaved"
+        assert SDLoader([{}], version=1).qkv_layout == "interleaved"
+        assert SDLoader([{}], version=2).qkv_layout == "concat"
+        assert SDLoader([{}], version=None).qkv_layout == "concat"
