@@ -23,7 +23,7 @@ gets whole heads.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -107,7 +107,8 @@ def merge_qkv(values: Sequence[np.ndarray], *, layout: str = "concat",
 def merge_state_dicts(shards: Sequence[Any], specs: Any = None, *,
                       axis: str = "tp",
                       qkv_leaves: Optional[Dict[str, str]] = None,
-                      split_size: Optional[int] = None) -> Any:
+                      split_size: Optional[int] = None,
+                      replicated_paths: Optional[Iterable[str]] = None) -> Any:
     """Merge TP shard pytrees into one full pytree.
 
     ``specs``: PartitionSpec tree (default: AutoTP name inference on the
@@ -115,14 +116,24 @@ def merge_state_dicts(shards: Sequence[Any], specs: Any = None, *,
     possible* for already-sliced shards, so the spec tree is authoritative).
     ``qkv_leaves``: path → layout for fused-QKV leaves needing the
     version-aware merge. ``split_size``: the TP degree the shards were
-    *written* at (defaults to ``len(shards)``) — used to recognize leaves
-    the split pass replicated.
+    *written* at (defaults to ``len(shards)``).
+
+    ``replicated_paths`` (authoritative when given; get it from
+    ``split_state_dict(..., return_replicated=True)``): which leaves the
+    split pass replicated. Without it a heuristic applies — identical shards
+    whose dim is indivisible by ``split_size`` are treated as replicas. The
+    heuristic is provably ambiguous in one corner: a *constant-content*
+    sharded leaf whose shard dim is itself indivisible by the degree (e.g. a
+    zero GQA bias [2, dh] split 2-ways to [1, dh]) is indistinguishable from
+    a replica by content alone, and merges to the shard shape. Thread
+    ``replicated_paths`` when exact round-trips of constant leaves matter.
     """
     if not shards:
         raise ValueError("no shards to merge")
     if specs is None:
         specs = tp_parser(shards[0], axis=axis)
     qkv_leaves = qkv_leaves or {}
+    repl = None if replicated_paths is None else frozenset(replicated_paths)
 
     paths, leaves0, treedef = flatten_with_paths(shards[0])
     rest = [flatten_with_paths(s)[1] for s in shards[1:]]
@@ -131,18 +142,20 @@ def merge_state_dicts(shards: Sequence[Any], specs: Any = None, *,
     for i, (path, leaf0, spec) in enumerate(zip(paths, leaves0, spec_leaves)):
         vals = [np.asarray(leaf0)] + [np.asarray(r[i]) for r in rest]
         dim = sharded_dim(spec, axis)
-        # A leaf the split pass replicated (its dim was indivisible by the
-        # split degree) arrives identical in every shard even though the spec
-        # names it sharded — concatenating copies would corrupt it. The split
-        # pass only replicates when dim % split_size != 0, so a cleanly
-        # divisible dim is always a real shard (content equality there — e.g.
-        # zero-initialized biases — must NOT suppress the concat); an
-        # indivisible dim with identical content is a replica.
-        n_split = split_size or len(vals)
-        if (dim is not None and vals[0].shape[dim] % n_split != 0
-                and all(v.shape == vals[0].shape and np.array_equal(v, vals[0])
-                        for v in vals[1:])):
-            dim = None
+        if repl is not None:
+            if path in repl:
+                dim = None
+        elif dim is not None:
+            # Heuristic replica detection (see docstring for the ambiguous
+            # corner): identical shards + indivisible dim => replica. A
+            # cleanly divisible dim is always treated as a real shard, so
+            # equal content there (zero-init biases) still concatenates.
+            n_split = split_size or len(vals)
+            if (vals[0].shape[dim] % n_split != 0
+                    and all(v.shape == vals[0].shape
+                            and np.array_equal(v, vals[0])
+                            for v in vals[1:])):
+                dim = None
         if path in qkv_leaves and dim is not None:
             out.append(merge_qkv(vals, layout=qkv_leaves[path], dim=dim))
             continue
@@ -156,8 +169,15 @@ def merge_state_dicts(shards: Sequence[Any], specs: Any = None, *,
 def split_state_dict(sd: Any, rank: int, size: int, specs: Any = None, *,
                      axis: str = "tp",
                      qkv_leaves: Optional[Dict[str, str]] = None,
-                     num_heads: Optional[int] = None) -> Any:
-    """Slice a full pytree to one TP rank's shard (host-side numpy)."""
+                     num_heads: Optional[int] = None,
+                     return_replicated: bool = False) -> Any:
+    """Slice a full pytree to one TP rank's shard (host-side numpy).
+
+    ``return_replicated=True`` additionally returns the frozenset of leaf
+    paths that stayed replicated (spec said replicate, or an indivisible
+    dim) — feed it to ``merge_state_dicts(replicated_paths=...)`` for exact
+    round-trips.
+    """
     if specs is None:
         specs = tp_parser(sd, axis=axis, tp_size=size)
     qkv_leaves = qkv_leaves or {}
@@ -165,6 +185,7 @@ def split_state_dict(sd: Any, rank: int, size: int, specs: Any = None, *,
     paths, leaves, treedef = flatten_with_paths(sd)
     spec_leaves = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
     out = []
+    replicated = set()
     for path, leaf, spec in zip(paths, leaves, spec_leaves):
         val = np.asarray(leaf)
         if path in qkv_leaves:
@@ -175,8 +196,14 @@ def split_state_dict(sd: Any, rank: int, size: int, specs: Any = None, *,
                                  layout=qkv_leaves[path],
                                  dim=dim if dim is not None else -1))
         else:
-            out.append(shard_checkpoint_leaf(val, spec, axis, rank, size))
-    return jax.tree_util.tree_unflatten(treedef, out)
+            shard = shard_checkpoint_leaf(val, spec, axis, rank, size)
+            if shard.shape == val.shape and size > 1:
+                replicated.add(path)
+            out.append(shard)
+    tree = jax.tree_util.tree_unflatten(treedef, out)
+    if return_replicated:
+        return tree, frozenset(replicated)
+    return tree
 
 
 class SDLoaderFactory:

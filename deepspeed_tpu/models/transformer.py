@@ -72,7 +72,8 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = False
     remat_policy: Optional[str] = None
-    sequence_parallel: bool = False             # Ulysses over the 'sp' axis
+    sequence_parallel: bool = False             # SP over the 'sp' axis
+    sp_impl: str = "ulysses"                    # ulysses (all-to-all) | ring
     attn_impl: str = "auto"                     # auto | xla | flash (pallas)
 
     @property
@@ -306,18 +307,33 @@ class Attention(nn.Module):
         if cfg.sequence_parallel and not self.is_initializing():
             if alibi is not None:
                 raise NotImplementedError(
-                    "ALiBi + Ulysses sequence parallelism is unsupported: the "
-                    "head all-to-all would need per-shard slope slices")
-            from ..sequence.layer import ulysses_attention
+                    "ALiBi + sequence parallelism is unsupported: the "
+                    "exchange would need per-shard slope slices")
+            if cfg.sp_impl == "ring":
+                if window is not None:
+                    raise NotImplementedError(
+                        "local attention windows + ring SP not supported")
+                from ..sequence.ring import ring_attention
 
-            def local_attn(q_, k_, v_, pos):
-                if cfg.position == "rope":
-                    q_ = rope(q_, cos, sin, pos)
-                    k_ = rope(k_, cos, sin, pos)
-                return attention_core(q_, k_, v_, causal=True, impl=impl,
-                                      scale=scale, window=window)
+                def apply_pos(q_, k_, pos):
+                    if cfg.position == "rope":
+                        q_ = rope(q_, cos, sin, pos)
+                        k_ = rope(k_, cos, sin, pos)
+                    return q_, k_
 
-            out = ulysses_attention(local_attn, q, k, v)
+                out = ring_attention(q, k, v, apply_pos=apply_pos,
+                                     causal=True, scale=scale)
+            else:
+                from ..sequence.layer import ulysses_attention
+
+                def local_attn(q_, k_, v_, pos):
+                    if cfg.position == "rope":
+                        q_ = rope(q_, cos, sin, pos)
+                        k_ = rope(k_, cos, sin, pos)
+                    return attention_core(q_, k_, v_, causal=True, impl=impl,
+                                          scale=scale, window=window)
+
+                out = ulysses_attention(local_attn, q, k, v)
         else:
             if cfg.position == "rope":
                 q = rope(q, cos, sin)
@@ -531,6 +547,8 @@ def stack_transformer_params(params, cfg: TransformerConfig):
                          "set moe_every=1 or num_experts=0")
     blocks = jax.tree.map(lambda *ls: jnp.stack(ls), *layers)
     embed = {"embed": params["embed"]}
+    if cfg.embed_norm:
+        embed["embed_norm"] = params["embed_norm"]
     if cfg.position == "learned":
         embed["pos_embed"] = params["pos_embed"]
     head = {"final_norm": params["final_norm"], "lm_head": params["lm_head"]}
@@ -552,10 +570,13 @@ def transformer_pipeline_fns(cfg: TransformerConfig):
     # a uniform window flows through Block(layer_idx=0) reading layer_windows[0]
     block_mod = Block(cfg, layer_idx=0)
     final_norm_mod = _norm(cfg, "final_norm")  # same module the model uses
+    embed_norm_mod = _norm(cfg, "embed_norm") if cfg.embed_norm else None
 
     def embed_fn(p, mb):
         tokens = mb["tokens"] if isinstance(mb, dict) else mb
         x = p["embed"]["embedding"].astype(cfg.dtype)[tokens]
+        if embed_norm_mod is not None:  # bloom word_embeddings_layernorm
+            x = embed_norm_mod.apply({"params": p["embed_norm"]}, x)
         if cfg.position == "learned":
             off = cfg.pos_offset
             x = x + p["pos_embed"][off: off + tokens.shape[1]].astype(cfg.dtype)

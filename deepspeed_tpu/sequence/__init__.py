@@ -1,0 +1,4 @@
+from .layer import ulysses_attention
+from .ring import ring_attention, ring_attention_local
+
+__all__ = ["ulysses_attention", "ring_attention", "ring_attention_local"]

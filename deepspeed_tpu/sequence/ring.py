@@ -1,0 +1,130 @@
+"""Ring attention: context parallelism over the ``sp`` mesh axis.
+
+The reference has no ring attention — its long-context scaling is all-to-all
+based (Ulysses, ``deepspeed/sequence/layer.py:271``; SURVEY.md §2.3 marks
+CP/ring as the TPU build's optional extra). Ulysses' hard limit is
+``num_heads % sp == 0``: the exchange re-shards heads, so sp cannot exceed
+(or fail to divide) the head count — exactly the regime (few-head GQA models,
+very long sequences, large meshes) where context parallelism matters most.
+
+Ring attention (blockwise attention over a ring of devices; Liu et al. 2023,
+"Ring Attention with Blockwise Transformers") removes that limit: every rank
+keeps ALL heads for its sequence block, KV blocks rotate around the ring via
+``ppermute`` (one ICI hop per step — the natural TPU torus pattern), and a
+flash-style online softmax accumulates exact attention. Comm volume is
+O(S·Hk·D) per rank — independent of the ring size — and the next block's
+ppermute is issued before the current block's compute so XLA overlaps
+transfer with the matmuls.
+
+Causal masking uses global positions, so a fully-skippable block (all keys
+in the future) contributes exp(-inf)=0 work-free; GQA rotates the *unrepeated*
+KV blocks (grouped-query einsum locally) so MQA models move 1/H of the bytes.
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.topology import SP_AXIS, get_topology
+
+NEG_INF = -1e30
+
+
+def ring_attention_local(q, k, v, *, axis_size: int, axis_name: str = SP_AXIS,
+                         causal: bool = True, scale: Optional[float] = None):
+    """Blockwise ring attention for use INSIDE ``shard_map``.
+
+    q: ``[B, L, H, D]`` (this rank's sequence block, all heads);
+    k/v: ``[B, L, Hk, D]``. Returns ``[B, L, H, D]``. Exact (online-softmax)
+    attention over the global sequence of ``axis_size * L`` tokens.
+    """
+    b, l, h, d = q.shape
+    hk = k.shape[2]
+    rep = h // hk
+    sc = (1.0 / np.sqrt(d)) if scale is None else float(scale)
+    r = lax.axis_index(axis_name)
+    pos_q = r * l + jnp.arange(l)                                # global q pos
+
+    qg = q.reshape(b, l, hk, rep, d)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(t, carry):
+        kb, vb, m, s_sum, acc = carry
+        # issue the rotation FIRST so XLA overlaps the ppermute with compute;
+        # the last step needs no rotation (its result would be discarded, but
+        # a collective inside the loop body is not DCE-able — skip it)
+        kb_next, vb_next = lax.cond(
+            t < axis_size - 1,
+            lambda ops: (lax.ppermute(ops[0], axis_name, perm),
+                         lax.ppermute(ops[1], axis_name, perm)),
+            lambda ops: ops, (kb, vb))
+        src = (r - t) % axis_size                                # block owner
+        pos_k = src * l + jnp.arange(l)
+        logits = jnp.einsum("blhrd,bmhd->bhrlm", qg, kb.astype(q.dtype),
+                            preferred_element_type=jnp.float32) * sc
+        if causal:
+            mask = pos_q[:, None] >= pos_k[None, :]              # [l, l]
+            logits = jnp.where(mask[None, None, None], logits, NEG_INF)
+        m_blk = jnp.max(logits, axis=-1, keepdims=True)          # [b,hk,rep,l,1]
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(logits - m_new)
+        if causal:  # exp(NEG_INF - NEG_INF) = 1 on fully-masked rows: zero it
+            p = jnp.where(logits > NEG_INF / 2, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        s_new = alpha * s_sum + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bhrlm,bmhd->bhrld", p.astype(v.dtype),
+                        vb.astype(q.dtype), preferred_element_type=jnp.float32)
+        acc_new = alpha * acc + pv
+        return kb_next, vb_next, m_new, s_new, acc_new
+
+    m0 = jnp.full((b, hk, rep, l, 1), NEG_INF, jnp.float32)
+    s0 = jnp.zeros((b, hk, rep, l, 1), jnp.float32)
+    a0 = jnp.zeros((b, hk, rep, l, d), jnp.float32)
+    _, _, m, s_sum, acc = lax.fori_loop(0, axis_size, step, (k, v, m0, s0, a0))
+    safe = jnp.where(s_sum == 0.0, 1.0, s_sum)
+    out = (acc / safe).astype(q.dtype)                           # [b,hk,rep,l,d]
+    return jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, l, h, d)
+
+
+def ring_attention(q, k, v, *, apply_pos: Optional[Callable] = None,
+                   causal: bool = True, scale: Optional[float] = None):
+    """Ring attention over the topology's ``sp`` axis (Ulysses' sibling).
+
+    Inputs are global ``[B, S, H, D]`` arrays whose S dim the engine's batch
+    spec shards over ``sp``. ``apply_pos(q, k, positions) -> (q, k)`` applies
+    position encoding (RoPE) with GLOBAL positions inside the shard — the
+    rank's block offset is not visible outside the shard_map.
+
+    Unlike :func:`~deepspeed_tpu.sequence.layer.ulysses_attention` this places
+    no constraint on head counts (works at sp > num_heads) and its per-step
+    transfer is one neighbor hop riding the ICI torus.
+    """
+    topo = get_topology()
+    sp = topo.sp_size
+    if sp == 1:
+        if apply_pos is not None:
+            q, k = apply_pos(q, k, None)
+        from ..models.transformer import attention_core
+
+        return attention_core(q, k, v, causal=causal, impl="xla", scale=scale)
+
+    h, hk = q.shape[2], k.shape[2]
+    tp = topo.tp_size
+    heads_axis = "tp" if (tp > 1 and h % tp == 0 and hk % tp == 0) else None
+    io_spec = P(topo.dp_axes, SP_AXIS, heads_axis, None)
+
+    def body(q_, k_, v_):
+        if apply_pos is not None:
+            r = lax.axis_index(SP_AXIS)
+            pos = (r * q_.shape[1] + jnp.arange(q_.shape[1]))[None, :]
+            q_, k_ = apply_pos(q_, k_, pos)
+        return ring_attention_local(q_, k_, v_, axis_size=sp, causal=causal,
+                                    scale=scale)
+
+    return jax.shard_map(body, mesh=topo.mesh,
+                         in_specs=(io_spec, io_spec, io_spec),
+                         out_specs=io_spec, check_vma=False)(q, k, v)

@@ -159,6 +159,24 @@ class TestSDLoader:
         assert merged["up_proj"]["bias"].shape == (16,)
         assert merged["up_proj"]["kernel"].shape == (4, 16)
 
+    def test_replicated_paths_resolves_constant_shard_ambiguity(self):
+        """A zero GQA bias [2, dh] split 2-ways gives identical [1, dh]
+        shards — content-indistinguishable from a replica; the explicit
+        replicated_paths channel restores the exact round-trip."""
+        sd = {"k_proj": {"kernel": np.random.RandomState(0)
+                         .randn(8, 2, 4).astype(np.float32),
+                         "bias": np.zeros((2, 4), np.float32)}}
+        specs = {"k_proj": {"kernel": P(None, "tp", None), "bias": P("tp", None)}}
+        out = [split_state_dict(sd, r, 2, specs=specs, return_replicated=True)
+               for r in range(2)]
+        shards, repl = [o[0] for o in out], out[0][1]
+        assert repl == frozenset()  # everything genuinely sharded
+        assert shards[0]["k_proj"]["bias"].shape == (1, 4)
+        merged = merge_state_dicts(shards, specs=specs, replicated_paths=repl)
+        assert merged["k_proj"]["bias"].shape == (2, 4)
+        np.testing.assert_array_equal(merged["k_proj"]["kernel"],
+                                      sd["k_proj"]["kernel"])
+
     def test_version_zero_is_interleaved(self):
         from deepspeed_tpu.checkpoint.state_dict_factory import SDLoader
         assert SDLoader([{}], version=0).qkv_layout == "interleaved"
