@@ -603,6 +603,123 @@ def _phi_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
     return p
 
 
+def _bert_config(d: Dict[str, Any]):
+    from ..models.bert import BertConfig
+
+    if d.get("model_type") == "distilbert":
+        if d.get("activation", "gelu") != "gelu":
+            raise ValueError(f"distilbert activation {d.get('activation')!r} "
+                             "unsupported (exact gelu only)")
+        if d.get("sinusoidal_pos_embds"):
+            raise ValueError("distilbert sinusoidal positions unsupported")
+        return BertConfig(
+            vocab_size=d["vocab_size"], hidden_size=d["dim"],
+            intermediate_size=d["hidden_dim"], num_layers=d["n_layers"],
+            num_heads=d["n_heads"],
+            max_seq_len=d.get("max_position_embeddings", 512),
+            norm_eps=1e-12, use_token_type=False)
+    if d.get("hidden_act", "gelu") != "gelu":
+        raise ValueError(f"bert hidden_act {d.get('hidden_act')!r} "
+                         "unsupported (exact gelu only)")
+    if d.get("position_embedding_type", "absolute") != "absolute":
+        raise ValueError("bert relative position embeddings unsupported")
+    return BertConfig(
+        vocab_size=d["vocab_size"], hidden_size=d["hidden_size"],
+        intermediate_size=d["intermediate_size"],
+        num_layers=d["num_hidden_layers"], num_heads=d["num_attention_heads"],
+        max_seq_len=d.get("max_position_embeddings", 512),
+        type_vocab_size=d.get("type_vocab_size", 2),
+        norm_eps=d.get("layer_norm_eps", 1e-12))
+
+
+# per-architecture HF key tables for the shared encoder converter: the layer
+# prefix is formatted with the layer index; (q, k, v, out, attn_ln, up, down,
+# mlp_ln) name the per-layer modules, head names the MLM triple
+_BERT_KEYS = dict(
+    embed="bert.embeddings.word_embeddings.weight",
+    pos="bert.embeddings.position_embeddings.weight",
+    type_embed="bert.embeddings.token_type_embeddings.weight",
+    embed_ln="bert.embeddings.LayerNorm",
+    layer="bert.encoder.layer.{i}.",
+    q="attention.self.query", k="attention.self.key",
+    v="attention.self.value", out="attention.output.dense",
+    attn_ln="attention.output.LayerNorm",
+    up="intermediate.dense", down="output.dense", mlp_ln="output.LayerNorm",
+    mlm_transform="cls.predictions.transform.dense",
+    mlm_ln="cls.predictions.transform.LayerNorm",
+    mlm_bias="cls.predictions.bias",
+    mlm_decoder="cls.predictions.decoder.weight",
+)
+_DISTILBERT_KEYS = dict(
+    embed="distilbert.embeddings.word_embeddings.weight",
+    pos="distilbert.embeddings.position_embeddings.weight",
+    type_embed=None,
+    embed_ln="distilbert.embeddings.LayerNorm",
+    layer="distilbert.transformer.layer.{i}.",
+    q="attention.q_lin", k="attention.k_lin", v="attention.v_lin",
+    out="attention.out_lin", attn_ln="sa_layer_norm",
+    up="ffn.lin1", down="ffn.lin2", mlp_ln="output_layer_norm",
+    mlm_transform="vocab_transform", mlm_ln="vocab_layer_norm",
+    mlm_bias="vocab_projector.bias", mlm_decoder="vocab_projector.weight",
+)
+
+
+def _encoder_params(sd: Dict[str, Any], cfg, keys: Dict[str, Any]
+                    ) -> Dict[str, Any]:
+    """Shared BERT-family converter driven by a per-architecture key table."""
+    h, dh, dm = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+
+    def ln(name):
+        return {"scale": _t(sd[name + ".weight"]), "bias": _t(sd[name + ".bias"])}
+
+    def lin(name):
+        return {"kernel": _t(sd[name + ".weight"]).T,
+                "bias": _t(sd[name + ".bias"])}
+
+    def heads(name):  # torch [h*dh, D] -> flax DenseGeneral [D, h, dh]
+        return {"kernel": _t(sd[name + ".weight"]).T.reshape(dm, h, dh),
+                "bias": _t(sd[name + ".bias"]).reshape(h, dh)}
+
+    enc: Dict[str, Any] = {
+        "embed": {"embedding": _t(sd[keys["embed"]])},
+        "pos_embed": _t(sd[keys["pos"]]),
+        "embed_norm": ln(keys["embed_ln"]),
+    }
+    if keys["type_embed"]:
+        enc["type_embed"] = {"embedding": _t(sd[keys["type_embed"]])}
+    for i in range(cfg.num_layers):
+        pre = keys["layer"].format(i=i)
+        enc[f"layer_{i}"] = {
+            "attn": {
+                "query": heads(pre + keys["q"]),
+                "key": heads(pre + keys["k"]),
+                "value": heads(pre + keys["v"]),
+                "out_proj": {"kernel": _t(sd[pre + keys["out"] + ".weight"]).T
+                             .reshape(h, dh, dm),
+                             "bias": _t(sd[pre + keys["out"] + ".bias"])},
+            },
+            "attn_norm": ln(pre + keys["attn_ln"]),
+            "up_proj": lin(pre + keys["up"]),
+            "down_proj": lin(pre + keys["down"]),
+            "mlp_norm": ln(pre + keys["mlp_ln"]),
+        }
+    p: Dict[str, Any] = {"encoder": enc}
+    if keys["mlm_transform"] + ".weight" in sd:  # MLM head present
+        dec = keys["mlm_decoder"]
+        if dec in sd and not np.array_equal(_t(sd[dec]), _t(sd[keys["embed"]])):
+            raise ValueError(
+                "MLM decoder weight is not tied to the embedding table "
+                "(tie_word_embeddings=False); the encoder MLM head only "
+                "supports the tied layout")
+        p["mlm_transform"] = lin(keys["mlm_transform"])
+        p["mlm_norm"] = ln(keys["mlm_ln"])
+        p["mlm_bias"] = _t(sd[keys["mlm_bias"]])
+    if "qa_outputs.weight" in sd:  # SQuAD head (BingBertSquad)
+        p["qa_outputs"] = {"kernel": _t(sd["qa_outputs.weight"]).T,
+                           "bias": _t(sd["qa_outputs.bias"])}
+    return p
+
+
 def params_from_hf(model_or_state_dict, hf_config=None):
     """Convert a HF model (or its state_dict + config) → ``(TransformerConfig,
     params)`` ready for ``InferenceEngine`` / the training engine."""
@@ -615,6 +732,10 @@ def params_from_hf(model_or_state_dict, hf_config=None):
             raise ValueError("pass hf_config when giving a raw state_dict")
     d = hf_config if isinstance(hf_config, dict) else hf_config.to_dict()
     mt = d.get("model_type", "")
+    if mt in ("bert", "distilbert"):  # encoder family (models/bert.py)
+        cfg = _bert_config(d)
+        keys = _BERT_KEYS if mt == "bert" else _DISTILBERT_KEYS
+        return cfg, _to_jnp(_encoder_params(sd, cfg, keys))
     cfg = config_from_hf(hf_config)
     if mt in ("llama", "mistral", "mixtral", "qwen2"):
         params = _llama_params(sd, cfg)

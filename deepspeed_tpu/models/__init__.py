@@ -1,0 +1,8 @@
+from .bert import (BertConfig, BertEncoder, BertForMaskedLM,
+                   BertForQuestionAnswering, mlm_loss_fn, qa_loss_fn)
+from .transformer import (TransformerConfig, TransformerLM, init_params,
+                          make_loss_fn, param_specs)
+
+__all__ = ["TransformerConfig", "TransformerLM", "init_params", "make_loss_fn",
+           "param_specs", "BertConfig", "BertEncoder", "BertForMaskedLM",
+           "BertForQuestionAnswering", "mlm_loss_fn", "qa_loss_fn"]

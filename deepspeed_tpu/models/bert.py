@@ -1,0 +1,187 @@
+"""BERT-family bidirectional encoders.
+
+Reference coverage: ``module_inject/containers/bert.py`` and
+``distil_bert.py`` (kernel-injection policies for HF BERT/DistilBERT), and
+the model-level ``tests/model/BingBertSquad`` convergence suite — the
+reference's encoder story. The decoder zoo lives in ``transformer.py``;
+encoders differ enough to warrant their own module:
+
+* **post-layernorm** blocks (norm AFTER the residual add — BERT's original
+  layout; the decoder zoo is pre-LN),
+* bidirectional attention with a **padding mask** instead of a causal mask,
+* segment (token-type) embeddings + embedding layernorm,
+* task heads: MLM (transform + tied decoder + bias) and extractive QA
+  (start/end span logits — the BingBertSquad head).
+
+TPU notes: same MXU-friendly shapes as the decoder (DenseGeneral heads,
+bf16 matmuls, fp32 logits); parameter names reuse the AutoTP vocabulary
+(``query``/``key``/``value`` column-parallel, ``out_proj``/``down_proj``
+row-parallel) so ``module_inject.tp_parser`` shards it with no policy.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    dropout: float = 0.0
+    # distilbert: no token-type embeddings, no pooler
+    use_token_type: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+def _ln(cfg, name):
+    return nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name=name)
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        cfg = self.cfg
+        h, d = cfg.num_heads, cfg.head_dim
+        dense = lambda name: nn.DenseGeneral(features=(h, d), use_bias=True,
+                                             dtype=cfg.dtype,
+                                             param_dtype=jnp.float32, name=name)
+        q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) / np.sqrt(d)
+        if mask is not None:  # [B, S] 1=token, 0=pad
+            logits = jnp.where(mask[:, None, None, :].astype(bool), logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                               use_bias=True, dtype=cfg.dtype,
+                               param_dtype=jnp.float32, name="out_proj")(out)
+
+
+class BertBlock(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        cfg = self.cfg
+        attn = BertSelfAttention(cfg, name="attn")(x, mask)
+        if cfg.dropout and not deterministic:
+            attn = nn.Dropout(cfg.dropout)(attn, deterministic=False)
+        x = _ln(cfg, "attn_norm")(x + attn)           # post-LN
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="up_proj")(x)
+        h = nn.gelu(h, approximate=False)             # BERT uses exact gelu
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="down_proj")(h)
+        if cfg.dropout and not deterministic:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=False)
+        return _ln(cfg, "mlp_norm")(x + h)
+
+
+class BertEncoder(nn.Module):
+    """Embeddings + N post-LN blocks -> hidden states ``[B, S, H]``."""
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, token_type_ids=None, attention_mask=None,
+                 deterministic=True):
+        cfg = self.cfg
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="embed")
+        x = embed(tokens)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
+        x = x + pos[None, :tokens.shape[1]].astype(cfg.dtype)
+        if cfg.use_token_type:
+            tt = (jnp.zeros_like(tokens) if token_type_ids is None
+                  else token_type_ids)
+            x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                             dtype=cfg.dtype, param_dtype=jnp.float32,
+                             name="type_embed")(tt)
+        x = _ln(cfg, "embed_norm")(x)
+        if cfg.dropout and not deterministic:
+            x = nn.Dropout(cfg.dropout)(x, deterministic=False)
+        for i in range(cfg.num_layers):
+            x = BertBlock(cfg, name=f"layer_{i}")(x, attention_mask,
+                                                  deterministic)
+        return x
+
+
+class BertForMaskedLM(nn.Module):
+    """Encoder + MLM head (transform dense+gelu+LN, tied decoder + bias)."""
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, token_type_ids=None, attention_mask=None,
+                 deterministic=True):
+        cfg = self.cfg
+        enc = BertEncoder(cfg, name="encoder")
+        x = enc(tokens, token_type_ids, attention_mask, deterministic)
+        x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="mlm_transform")(x)
+        x = nn.gelu(x, approximate=False)
+        x = _ln(cfg, "mlm_norm")(x)
+        table = self.get_variable("params", "encoder")["embed"]["embedding"]
+        logits = x.astype(jnp.float32) @ table.astype(jnp.float32).T
+        bias = self.param("mlm_bias", nn.initializers.zeros,
+                          (cfg.vocab_size,), jnp.float32)
+        return logits + bias
+
+
+class BertForQuestionAnswering(nn.Module):
+    """Encoder + SQuAD span head (reference tests/model/BingBertSquad)."""
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, token_type_ids=None, attention_mask=None,
+                 deterministic=True):
+        x = BertEncoder(self.cfg, name="encoder")(
+            tokens, token_type_ids, attention_mask, deterministic)
+        logits = nn.Dense(2, dtype=jnp.float32, param_dtype=jnp.float32,
+                          name="qa_outputs")(x.astype(jnp.float32))
+        return logits[..., 0], logits[..., 1]       # start, end [B, S]
+
+
+def mlm_loss_fn(model: BertForMaskedLM):
+    """Masked-LM loss: batch = {tokens, labels (-100 = unmasked), ...}."""
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["tokens"],
+                             batch.get("token_type_ids"),
+                             batch.get("attention_mask"))
+        labels = batch["labels"]
+        mask = (labels != -100).astype(jnp.float32)
+        safe = jnp.maximum(labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return loss_fn
+
+
+def qa_loss_fn(model: BertForQuestionAnswering):
+    """SQuAD span CE: batch = {tokens, start_positions, end_positions, ...}."""
+    def loss_fn(params, batch):
+        start, end = model.apply({"params": params}, batch["tokens"],
+                                 batch.get("token_type_ids"),
+                                 batch.get("attention_mask"))
+        def ce(logits, pos):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.mean(jnp.take_along_axis(logp, pos[:, None], 1))
+        return 0.5 * (ce(start, batch["start_positions"])
+                      + ce(end, batch["end_positions"]))
+    return loss_fn
