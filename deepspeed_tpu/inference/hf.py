@@ -17,7 +17,9 @@ qkv), opt (learned pos offset 2, ReLU), bloom (ALiBi, embedding layernorm,
 interleaved fused qkv), gptj (rotate-every-two partial rotary, shared-norm
 parallel residual, biased lm_head), gpt_neo (unscaled attention,
 alternating local windows), phi (partial rotary, parallel shared-norm,
-fully biased) — one converter per weight-naming scheme.
+fully biased), qwen2_moe (shared expert + un-normalized top-k routing),
+clip_text_model (quick_gelu, no LM head), bert/distilbert (encoders,
+``models/bert.py``) — one converter per weight-naming scheme.
 """
 
 from typing import Any, Dict
@@ -40,7 +42,7 @@ def config_from_hf(hf_config) -> TransformerConfig:
     matching in ``replace_policy.py``)."""
     d = hf_config if isinstance(hf_config, dict) else hf_config.to_dict()
     mt = d.get("model_type", "")
-    if mt in ("llama", "mistral", "mixtral", "qwen2", "phi3"):
+    if mt in ("llama", "mistral", "mixtral", "qwen2", "qwen2_moe", "phi3"):
         cfg = dict(
             vocab_size=d["vocab_size"], hidden_size=d["hidden_size"],
             intermediate_size=d["intermediate_size"],
@@ -54,9 +56,22 @@ def config_from_hf(hf_config) -> TransformerConfig:
         if mt == "mixtral":
             cfg.update(num_experts=d.get("num_local_experts", 8),
                        moe_top_k=d.get("num_experts_per_tok", 2))
-        if mt == "qwen2":
+        if mt in ("qwen2", "qwen2_moe"):
             # qwen2: rmsnorm model with q/k/v biases (no out/mlp bias)
             cfg.update(attn_qkv_bias=True)
+        if mt == "qwen2_moe":
+            if d.get("mlp_only_layers"):
+                raise ValueError("qwen2_moe mlp_only_layers is not supported "
+                                 "(mixed dense/MoE stacks)")
+            cfg.update(num_experts=d.get("num_experts", 60),
+                       moe_top_k=d.get("num_experts_per_tok", 4),
+                       moe_every=d.get("decoder_sparse_step", 1),
+                       # HF rule: layer i is MoE iff (i+1) % step == 0
+                       moe_offset=(d.get("decoder_sparse_step", 1) - 1),
+                       moe_intermediate_size=d.get("moe_intermediate_size"),
+                       moe_shared_expert_size=d.get(
+                           "shared_expert_intermediate_size", 0),
+                       moe_norm_topk=d.get("norm_topk_prob", False))
         return TransformerConfig(**cfg)
     if mt == "gpt2":
         return TransformerConfig(
@@ -158,6 +173,20 @@ def config_from_hf(hf_config) -> TransformerConfig:
             layer_windows=windows if any(w for w in windows) else None,
             attn_qkv_bias=False, attn_out_bias=True, mlp_bias=True,
             tie_embeddings=True)
+    if mt == "clip_text_model":
+        if d.get("hidden_act", "quick_gelu") not in ("quick_gelu", "gelu"):
+            raise ValueError(f"clip hidden_act {d.get('hidden_act')!r} unsupported")
+        return TransformerConfig(
+            vocab_size=d["vocab_size"], hidden_size=d["hidden_size"],
+            intermediate_size=d["intermediate_size"],
+            num_layers=d["num_hidden_layers"], num_heads=d["num_attention_heads"],
+            max_seq_len=d.get("max_position_embeddings", 77),
+            norm="layernorm",
+            activation="quick_gelu" if d.get("hidden_act", "quick_gelu")
+            == "quick_gelu" else "gelu",
+            position="learned", norm_eps=d.get("layer_norm_eps", 1e-5),
+            attn_qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+            no_lm_head=True, tie_embeddings=False)
     if mt == "phi":
         if d.get("qk_layernorm"):
             raise ValueError("phi qk_layernorm checkpoints are not supported")
@@ -175,8 +204,9 @@ def config_from_hf(hf_config) -> TransformerConfig:
             attn_qkv_bias=True, attn_out_bias=True, mlp_bias=True,
             lm_head_bias=True, tie_embeddings=False)
     raise ValueError(f"unsupported HF model_type '{mt}' (supported: llama, "
-                     "mistral, mixtral, qwen2, phi3, gpt2, falcon, gpt_neox, "
-                     "opt, bloom, gptj, gpt_neo, phi)")
+                     "mistral, mixtral, qwen2, qwen2_moe, phi3, gpt2, falcon, "
+                     "gpt_neox, opt, bloom, gptj, gpt_neo, phi, "
+                     "clip_text_model, bert, distilbert)")
 
 
 def _llama_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
@@ -203,20 +233,41 @@ def _llama_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
             "attn_norm": {"scale": _t(sd[pre + "input_layernorm.weight"])},
             "mlp_norm": {"scale": _t(sd[pre + "post_attention_layernorm.weight"])},
         }
-        if cfg.num_experts > 0 and (i % cfg.moe_every == 0):
-            gate = _t(sd[pre + "block_sparse_moe.gate.weight"]).T
-            ws, vs, w2s = [], [], []
-            for e in range(cfg.num_experts):
-                ep = pre + f"block_sparse_moe.experts.{e}."
-                ws.append(_t(sd[ep + "w1.weight"]).T)   # gate_proj [D,F]
-                vs.append(_t(sd[ep + "w3.weight"]).T)   # up_proj
-                w2s.append(_t(sd[ep + "w2.weight"]).T)  # down_proj [F,D]
-            layer["moe"] = {
-                "router": {"kernel": gate},
-                "expert_gate_proj": np.stack(ws),
-                "expert_up_proj": np.stack(vs),
-                "expert_down_proj": np.stack(w2s),
-            }
+        if cfg.num_experts > 0 and (
+                i % cfg.moe_every == cfg.moe_offset % cfg.moe_every):
+            if pre + "block_sparse_moe.gate.weight" in sd:  # mixtral naming
+                gate = _t(sd[pre + "block_sparse_moe.gate.weight"]).T
+                ws, vs, w2s = [], [], []
+                for e in range(cfg.num_experts):
+                    ep = pre + f"block_sparse_moe.experts.{e}."
+                    ws.append(_t(sd[ep + "w1.weight"]).T)   # gate_proj [D,F]
+                    vs.append(_t(sd[ep + "w3.weight"]).T)   # up_proj
+                    w2s.append(_t(sd[ep + "w2.weight"]).T)  # down_proj [F,D]
+                layer["moe"] = {
+                    "router": {"kernel": gate},
+                    "expert_gate_proj": np.stack(ws),
+                    "expert_up_proj": np.stack(vs),
+                    "expert_down_proj": np.stack(w2s),
+                }
+            else:  # qwen2_moe naming (+ always-on shared expert)
+                gate = _t(sd[pre + "mlp.gate.weight"]).T
+                ws, vs, w2s = [], [], []
+                for e in range(cfg.num_experts):
+                    ep = pre + f"mlp.experts.{e}."
+                    ws.append(_t(sd[ep + "gate_proj.weight"]).T)
+                    vs.append(_t(sd[ep + "up_proj.weight"]).T)
+                    w2s.append(_t(sd[ep + "down_proj.weight"]).T)
+                sh = pre + "mlp.shared_expert."
+                layer["moe"] = {
+                    "router": {"kernel": gate},
+                    "expert_gate_proj": np.stack(ws),
+                    "expert_up_proj": np.stack(vs),
+                    "expert_down_proj": np.stack(w2s),
+                    "shared_gate_proj": _t(sd[sh + "gate_proj.weight"]).T,
+                    "shared_up_proj": _t(sd[sh + "up_proj.weight"]).T,
+                    "shared_down_proj": _t(sd[sh + "down_proj.weight"]).T,
+                    "shared_router": _t(sd[pre + "mlp.shared_expert_gate.weight"]).T,
+                }
         else:
             layer["mlp"] = {
                 "gate_proj": {"kernel": _t(sd[pre + "mlp.gate_proj.weight"]).T},
@@ -720,6 +771,48 @@ def _encoder_params(sd: Dict[str, Any], cfg, keys: Dict[str, Any]
     return p
 
 
+def _clip_text_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
+    """CLIPTextModel (reference ``module_inject/containers/clip.py``): pre-LN
+    causal text encoder; our Block IS its layer layout (ln1→attn→add,
+    ln2→mlp→add), so the map is mechanical."""
+    h, dh, dm = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    p: Dict[str, Any] = {
+        "embed": {"embedding": _t(sd["text_model.embeddings.token_embedding.weight"])},
+        "pos_embed": _t(sd["text_model.embeddings.position_embedding.weight"]),
+    }
+    for i in range(cfg.num_layers):
+        pre = f"text_model.encoder.layers.{i}."
+        p[f"layer_{i}"] = {
+            "attn": {
+                "q_proj": {"kernel": _t(sd[pre + "self_attn.q_proj.weight"]).T
+                           .reshape(dm, h, dh),
+                           "bias": _t(sd[pre + "self_attn.q_proj.bias"]).reshape(h, dh)},
+                "k_proj": {"kernel": _t(sd[pre + "self_attn.k_proj.weight"]).T
+                           .reshape(dm, h, dh),
+                           "bias": _t(sd[pre + "self_attn.k_proj.bias"]).reshape(h, dh)},
+                "v_proj": {"kernel": _t(sd[pre + "self_attn.v_proj.weight"]).T
+                           .reshape(dm, h, dh),
+                           "bias": _t(sd[pre + "self_attn.v_proj.bias"]).reshape(h, dh)},
+                "o_proj": {"kernel": _t(sd[pre + "self_attn.out_proj.weight"]).T
+                           .reshape(h, dh, dm),
+                           "bias": _t(sd[pre + "self_attn.out_proj.bias"])},
+            },
+            "attn_norm": {"scale": _t(sd[pre + "layer_norm1.weight"]),
+                          "bias": _t(sd[pre + "layer_norm1.bias"])},
+            "mlp_norm": {"scale": _t(sd[pre + "layer_norm2.weight"]),
+                         "bias": _t(sd[pre + "layer_norm2.bias"])},
+            "mlp": {
+                "up_proj": {"kernel": _t(sd[pre + "mlp.fc1.weight"]).T,
+                            "bias": _t(sd[pre + "mlp.fc1.bias"])},
+                "down_proj": {"kernel": _t(sd[pre + "mlp.fc2.weight"]).T,
+                              "bias": _t(sd[pre + "mlp.fc2.bias"])},
+            },
+        }
+    p["final_norm"] = {"scale": _t(sd["text_model.final_layer_norm.weight"]),
+                       "bias": _t(sd["text_model.final_layer_norm.bias"])}
+    return p
+
+
 def params_from_hf(model_or_state_dict, hf_config=None):
     """Convert a HF model (or its state_dict + config) → ``(TransformerConfig,
     params)`` ready for ``InferenceEngine`` / the training engine."""
@@ -737,7 +830,7 @@ def params_from_hf(model_or_state_dict, hf_config=None):
         keys = _BERT_KEYS if mt == "bert" else _DISTILBERT_KEYS
         return cfg, _to_jnp(_encoder_params(sd, cfg, keys))
     cfg = config_from_hf(hf_config)
-    if mt in ("llama", "mistral", "mixtral", "qwen2"):
+    if mt in ("llama", "mistral", "mixtral", "qwen2", "qwen2_moe"):
         params = _llama_params(sd, cfg)
     elif mt == "phi3":
         params = _phi3_params(sd, cfg)
@@ -755,6 +848,8 @@ def params_from_hf(model_or_state_dict, hf_config=None):
         params = _gpt_neo_params(sd, cfg)
     elif mt == "phi":
         params = _phi_params(sd, cfg)
+    elif mt == "clip_text_model":
+        params = _clip_text_params(sd, cfg)
     else:
         params = _gpt2_params(sd, cfg)
     return cfg, _to_jnp(params)

@@ -158,12 +158,21 @@ class BertForQuestionAnswering(nn.Module):
         return logits[..., 0], logits[..., 1]       # start, end [B, S]
 
 
+def _apply_kwargs(cfg, rng):
+    if rng is not None and cfg.dropout > 0:
+        return {"deterministic": False, "rngs": {"dropout": rng}}
+    return {}
+
+
 def mlm_loss_fn(model: BertForMaskedLM):
-    """Masked-LM loss: batch = {tokens, labels (-100 = unmasked), ...}."""
-    def loss_fn(params, batch):
+    """Masked-LM loss: batch = {tokens, labels (-100 = unmasked), ...}.
+    Engine-compatible ``f(params, batch, rng)`` — the rng activates dropout
+    when ``cfg.dropout > 0`` (mirrors ``transformer.make_loss_fn``)."""
+    def loss_fn(params, batch, rng=None):
         logits = model.apply({"params": params}, batch["tokens"],
                              batch.get("token_type_ids"),
-                             batch.get("attention_mask"))
+                             batch.get("attention_mask"),
+                             **_apply_kwargs(model.cfg, rng))
         labels = batch["labels"]
         mask = (labels != -100).astype(jnp.float32)
         safe = jnp.maximum(labels, 0)
@@ -174,11 +183,13 @@ def mlm_loss_fn(model: BertForMaskedLM):
 
 
 def qa_loss_fn(model: BertForQuestionAnswering):
-    """SQuAD span CE: batch = {tokens, start_positions, end_positions, ...}."""
-    def loss_fn(params, batch):
+    """SQuAD span CE: batch = {tokens, start_positions, end_positions, ...}.
+    Engine-compatible ``f(params, batch, rng)`` like :func:`mlm_loss_fn`."""
+    def loss_fn(params, batch, rng=None):
         start, end = model.apply({"params": params}, batch["tokens"],
                                  batch.get("token_type_ids"),
-                                 batch.get("attention_mask"))
+                                 batch.get("attention_mask"),
+                                 **_apply_kwargs(model.cfg, rng))
         def ce(logits, pos):
             logp = jax.nn.log_softmax(logits, axis=-1)
             return -jnp.mean(jnp.take_along_axis(logp, pos[:, None], 1))

@@ -38,7 +38,7 @@ class TransformerConfig:
     max_seq_len: int = 2048
     # family switches
     norm: str = "rmsnorm"                       # rmsnorm (llama) | layernorm (gpt2)
-    activation: str = "swiglu"                  # swiglu (llama) | gelu (gpt2) | relu (opt)
+    activation: str = "swiglu"                  # swiglu | gelu | relu | quick_gelu (clip)
     position: str = "rope"                      # rope (llama) | learned (gpt2) | alibi (falcon-rw)
     tie_embeddings: bool = False
     rope_theta: float = 10000.0
@@ -56,14 +56,22 @@ class TransformerConfig:
     pos_offset: int = 0                         # OPT: learned pos ids offset 2
     embed_norm: bool = False                    # bloom word_embeddings_layernorm
     lm_head_bias: bool = False                  # gpt-j / phi biased lm_head
+    no_lm_head: bool = False                    # clip text encoder: return hidden states
     attn_scale: Optional[float] = None          # gpt-neo trains UNSCALED (1.0)
     # per-layer attention windows (gpt-neo local attention): tuple with one
     # entry per layer, None = global; e.g. (None, 256, None, 256, ...)
     layer_windows: Optional[Any] = None
-    # MoE (mixtral): replace the MLP every `moe_every` layers
+    # MoE (mixtral / qwen2_moe): replace the MLP every `moe_every` layers
     num_experts: int = 0
     moe_top_k: int = 2
     moe_every: int = 1
+    # which layers are MoE: layer_idx % moe_every == moe_offset. HF
+    # qwen2_moe's decoder_sparse_step rule is (i+1) % step == 0, i.e.
+    # offset = step - 1; mixtral is every layer (1, 0)
+    moe_offset: int = 0
+    moe_intermediate_size: Optional[int] = None  # qwen2_moe: expert ffn != dense ffn
+    moe_shared_expert_size: int = 0             # qwen2_moe always-on shared expert
+    moe_norm_topk: bool = True                  # mixtral renormalizes top-k; qwen2_moe doesn't
     moe_capacity_factor: float = 1.25
     moe_aux_loss_weight: float = 0.01
     # dropless grouped-GEMM experts (ragged_dot); best with ep=1
@@ -363,7 +371,12 @@ class MLP(nn.Module):
         else:
             hidden = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype,
                               param_dtype=jnp.float32, name="up_proj")(x)
-            hidden = nn.relu(hidden) if cfg.activation == "relu" else nn.gelu(hidden)
+            if cfg.activation == "relu":
+                hidden = nn.relu(hidden)
+            elif cfg.activation == "quick_gelu":  # clip: x * sigmoid(1.702 x)
+                hidden = hidden * nn.sigmoid(1.702 * hidden)
+            else:
+                hidden = nn.gelu(hidden)
         return nn.Dense(cfg.hidden_size, use_bias=bias, dtype=cfg.dtype,
                         param_dtype=jnp.float32, name="down_proj")(hidden)
 
@@ -390,7 +403,8 @@ class Block(nn.Module):
             attn_out, new_cache = attn(y, deterministic=deterministic), None
 
         def mlp_of(z):
-            use_moe = cfg.num_experts > 0 and (self.layer_idx % cfg.moe_every == 0)
+            use_moe = cfg.num_experts > 0 and (
+                self.layer_idx % cfg.moe_every == cfg.moe_offset % cfg.moe_every)
             if use_moe:
                 from ..moe.layer import MoEBlock
 
@@ -453,6 +467,8 @@ class TransformerLM(nn.Module):
             else:
                 x = block(cfg, i, name=name)(x, deterministic)
         x = _norm(cfg, "final_norm")(x)
+        if cfg.no_lm_head:  # clip text encoder: normalized hidden states
+            return (x, new_cache) if cache is not None else x
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
         else:

@@ -56,7 +56,7 @@ class MoEBlock(nn.Module):
         cfg = self.cfg
         g, s, d = x.shape
         e, k = cfg.num_experts, cfg.moe_top_k
-        f = cfg.intermediate_size
+        f = getattr(cfg, "moe_intermediate_size", None) or cfg.intermediate_size
         capacity = compute_capacity(k, s, e, cfg.moe_capacity_factor)
 
         # router in fp32 (reference TopKGate keeps the gate fp32)
@@ -73,6 +73,24 @@ class MoEBlock(nn.Module):
         w_down = self.param("expert_down_proj", init, (e, f, d), jnp.float32)
         skip = self.is_initializing()
 
+        norm_topk = getattr(cfg, "moe_norm_topk", True)
+
+        # qwen2_moe always-on shared expert, modulated by a sigmoid gate
+        fs = getattr(cfg, "moe_shared_expert_size", 0)
+        if fs:
+            sg = self.param("shared_gate_proj", init, (d, fs), jnp.float32)
+            su = self.param("shared_up_proj", init, (d, fs), jnp.float32)
+            sdn = self.param("shared_down_proj", init, (fs, d), jnp.float32)
+            srt = self.param("shared_router", init, (d, 1), jnp.float32)
+
+        def add_shared(y):
+            if not fs:
+                return y
+            h_s = nn.silu(x @ sg.astype(x.dtype)) * (x @ su.astype(x.dtype))
+            out_s = h_s @ sdn.astype(x.dtype)
+            mod = nn.sigmoid((x.astype(jnp.float32) @ srt)).astype(x.dtype)
+            return y + out_s * mod
+
         if getattr(cfg, "moe_dropless", False):
             # grouped-GEMM dropless path (reference cutlass moe_gemm /
             # megablocks): no capacity, no zero-padded compute. Token
@@ -81,11 +99,13 @@ class MoEBlock(nn.Module):
             gates = jax.nn.softmax(logits, axis=-1)
             aux = load_balance_aux(gates)
             y = dropless_moe(x, gates, k, w_gate, w_up, w_down,
-                             activation=cfg.activation)
+                             activation=cfg.activation, norm_topk=norm_topk)
+            y = add_shared(y.astype(x.dtype))
             y = _constrain(y, P(("dp_outer", "ep"), None, None), skip)
             return y.astype(x.dtype), aux * cfg.moe_aux_loss_weight
 
-        dispatch, combine, aux = topk_gating(logits, k, capacity)
+        dispatch, combine, aux = topk_gating(logits, k, capacity,
+                                             norm_topk=norm_topk)
         # keep the token-major mask sharded like the activations (G over
         # dp, S over sp): leaving it unconstrained made the partitioner
         # replicate-and-repartition the dispatch collective-permute
@@ -108,5 +128,6 @@ class MoEBlock(nn.Module):
         out = _constrain(out, P("ep", ("dp_outer",), None, None), skip)
 
         y = moe_combine(out, combine)
+        y = add_shared(y.astype(x.dtype))
         y = _constrain(y, P(("dp_outer", "ep"), "sp", None), skip)
         return y.astype(x.dtype), aux * cfg.moe_aux_loss_weight

@@ -37,7 +37,8 @@ def load_balance_aux(gates: jnp.ndarray) -> jnp.ndarray:
 def topk_gating(logits: jnp.ndarray, k: int, capacity: int,
                 rng: Optional[jax.Array] = None,
                 noisy_gate_policy: Optional[str] = None,
-                drop_tokens: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                drop_tokens: bool = True,
+                norm_topk: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Generic top-k gating with capacity (covers reference top1/top2/topk).
 
     Returns (dispatch [G,S,E,C] bool, combine [G,S,E,C] f32, aux_loss scalar).
@@ -73,9 +74,10 @@ def topk_gating(logits: jnp.ndarray, k: int, capacity: int,
         committed = committed + jnp.sum(mask, axis=1, keepdims=True)
         remaining = remaining * (1.0 - mask)
 
-    # renormalize combine weights over the k selected experts (reference
-    # top2gating denominator)
-    combine = combine / jnp.maximum(denom, 1e-9)[:, :, None, None]
+    if norm_topk:
+        # renormalize combine weights over the k selected experts (reference
+        # top2gating denominator; qwen2_moe norm_topk_prob=False skips this)
+        combine = combine / jnp.maximum(denom, 1e-9)[:, :, None, None]
     return dispatch, combine, aux_loss
 
 
@@ -92,7 +94,8 @@ def moe_combine(expert_out: jnp.ndarray, combine: jnp.ndarray) -> jnp.ndarray:
 
 def dropless_moe(x: jnp.ndarray, gates: jnp.ndarray, k: int,
                  w_gate: jnp.ndarray, w_up: jnp.ndarray, w_down: jnp.ndarray,
-                 activation: str = "swiglu") -> jnp.ndarray:
+                 activation: str = "swiglu",
+                 norm_topk: bool = True) -> jnp.ndarray:
     """Dropless MoE via grouped GEMM (``jax.lax.ragged_dot``).
 
     TPU-native replacement for the reference CUTLASS grouped ``moe_gemm``
@@ -112,7 +115,8 @@ def dropless_moe(x: jnp.ndarray, gates: jnp.ndarray, k: int,
     gf = gates.reshape(n, e)
 
     top_w, top_e = jax.lax.top_k(gf, k)                     # [N, k]
-    top_w = top_w / jnp.maximum(jnp.sum(top_w, axis=-1, keepdims=True), 1e-9)
+    if norm_topk:
+        top_w = top_w / jnp.maximum(jnp.sum(top_w, axis=-1, keepdims=True), 1e-9)
     eid = top_e.reshape(-1)                                 # [N*k]
     wts = top_w.reshape(-1)                                 # [N*k]
     order = jnp.argsort(eid)                                # expert-sorted copies

@@ -306,6 +306,99 @@ def test_round3_family_generate_matches_hf(family):
     assert np.array_equal(out, hf_out[:, 6:].numpy())
 
 
+def test_qwen2_moe_parity():
+    """qwen2_moe: MoE experts with their own ffn width + an always-on
+    sigmoid-gated shared expert + UN-normalized top-k routing. The dropless
+    grouped-GEMM path routes exactly like HF's dense implementation, so
+    logits parity is exact."""
+    hf_cfg = transformers.Qwen2MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, num_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=48, shared_expert_intermediate_size=96,
+        decoder_sparse_step=1, norm_topk_prob=False, mlp_only_layers=[],
+        tie_word_embeddings=False, output_router_logits=False)
+    torch.manual_seed(19)
+    hf = transformers.Qwen2MoeForCausalLM(hf_cfg).eval()
+    cfg, params = params_from_hf(hf)
+    assert cfg.moe_shared_expert_size == 96 and not cfg.moe_norm_topk
+    assert cfg.moe_intermediate_size == 48 and cfg.attn_qkv_bias
+    model = TransformerLM(type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32,
+                                       "moe_dropless": True}))
+    toks = np.random.default_rng(19).integers(0, 128, (2, 10))
+    with torch.no_grad():
+        ref = hf(torch.tensor(toks)).logits
+    ours = model.apply({"params": params}, jnp.asarray(toks, jnp.int32))
+    _logits_close(ours, ref)
+
+
+def test_qwen2_moe_capacity_path_parity():
+    """The default capacity-einsum MoE path (what training uses) with ample
+    capacity must also match HF exactly — covers shared-expert add and the
+    norm_topk=False branch of topk_gating."""
+    hf_cfg = transformers.Qwen2MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, num_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=48, shared_expert_intermediate_size=96,
+        decoder_sparse_step=1, norm_topk_prob=False, mlp_only_layers=[],
+        tie_word_embeddings=False, output_router_logits=False)
+    torch.manual_seed(20)
+    hf = transformers.Qwen2MoeForCausalLM(hf_cfg).eval()
+    cfg, params = params_from_hf(hf)
+    # capacity = k*s*cf/e >= s tokens per expert => nothing ever drops
+    model = TransformerLM(type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32,
+                                       "moe_capacity_factor": 4.0}))
+    toks = np.random.default_rng(20).integers(0, 128, (2, 10))
+    with torch.no_grad():
+        ref = hf(torch.tensor(toks)).logits
+    ours = model.apply({"params": params}, jnp.asarray(toks, jnp.int32))
+    _logits_close(ours, ref)
+
+
+def test_qwen2_moe_sparse_step_phase():
+    """decoder_sparse_step=2: HF puts MoE on layers 1, 3, ... ((i+1) % step
+    == 0) — conversion must land experts on the same layers."""
+    hf_cfg = transformers.Qwen2MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, num_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=48, shared_expert_intermediate_size=96,
+        decoder_sparse_step=2, norm_topk_prob=False, mlp_only_layers=[],
+        tie_word_embeddings=False, output_router_logits=False)
+    torch.manual_seed(23)
+    hf = transformers.Qwen2MoeForCausalLM(hf_cfg).eval()
+    cfg, params = params_from_hf(hf)
+    assert cfg.moe_every == 2 and cfg.moe_offset == 1
+    assert "mlp" in params["layer_0"] and "moe" in params["layer_1"]
+    model = TransformerLM(type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32,
+                                       "moe_dropless": True}))
+    toks = np.random.default_rng(23).integers(0, 128, (2, 10))
+    with torch.no_grad():
+        ref = hf(torch.tensor(toks)).logits
+    ours = model.apply({"params": params}, jnp.asarray(toks, jnp.int32))
+    _logits_close(ours, ref)
+
+
+def test_clip_text_parity():
+    """CLIP text encoder: quick_gelu pre-LN causal encoder, hidden states
+    (no LM head) — reference module_inject/containers/clip.py."""
+    hf_cfg = transformers.CLIPTextConfig(
+        vocab_size=99, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=32)
+    torch.manual_seed(18)
+    hf = transformers.CLIPTextModel(hf_cfg).eval()
+    cfg, params = params_from_hf(hf)
+    assert cfg.activation == "quick_gelu" and cfg.no_lm_head
+    model = TransformerLM(type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32}))
+    toks = np.random.default_rng(18).integers(0, 99, (2, 10))
+    with torch.no_grad():
+        ref = hf(torch.tensor(toks)).last_hidden_state
+    ours = model.apply({"params": params}, jnp.asarray(toks, jnp.int32))
+    _logits_close(ours, ref)
+
+
 def test_falcon_bias_parity():
     """falcon-rw-1b style: fused qkv WITH biases + alibi + sequential."""
     hf_cfg = transformers.FalconConfig(
