@@ -174,16 +174,18 @@ def config_from_hf(hf_config) -> TransformerConfig:
             attn_qkv_bias=False, attn_out_bias=True, mlp_bias=True,
             tie_embeddings=True)
     if mt == "clip_text_model":
-        if d.get("hidden_act", "quick_gelu") not in ("quick_gelu", "gelu"):
-            raise ValueError(f"clip hidden_act {d.get('hidden_act')!r} unsupported")
+        # HF ACT2FN['gelu'] is EXACT erf gelu; our 'gelu' activation is the
+        # tanh approximation (what the gpt2 families need) — reject rather
+        # than silently diverge per layer
+        if d.get("hidden_act", "quick_gelu") != "quick_gelu":
+            raise ValueError(f"clip hidden_act {d.get('hidden_act')!r} "
+                             "unsupported (quick_gelu only)")
         return TransformerConfig(
             vocab_size=d["vocab_size"], hidden_size=d["hidden_size"],
             intermediate_size=d["intermediate_size"],
             num_layers=d["num_hidden_layers"], num_heads=d["num_attention_heads"],
             max_seq_len=d.get("max_position_embeddings", 77),
-            norm="layernorm",
-            activation="quick_gelu" if d.get("hidden_act", "quick_gelu")
-            == "quick_gelu" else "gelu",
+            norm="layernorm", activation="quick_gelu",
             position="learned", norm_eps=d.get("layer_norm_eps", 1e-5),
             attn_qkv_bias=True, attn_out_bias=True, mlp_bias=True,
             no_lm_head=True, tie_embeddings=False)

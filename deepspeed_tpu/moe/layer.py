@@ -56,7 +56,7 @@ class MoEBlock(nn.Module):
         cfg = self.cfg
         g, s, d = x.shape
         e, k = cfg.num_experts, cfg.moe_top_k
-        f = getattr(cfg, "moe_intermediate_size", None) or cfg.intermediate_size
+        f = cfg.moe_intermediate_size or cfg.intermediate_size
         capacity = compute_capacity(k, s, e, cfg.moe_capacity_factor)
 
         # router in fp32 (reference TopKGate keeps the gate fp32)
@@ -73,10 +73,10 @@ class MoEBlock(nn.Module):
         w_down = self.param("expert_down_proj", init, (e, f, d), jnp.float32)
         skip = self.is_initializing()
 
-        norm_topk = getattr(cfg, "moe_norm_topk", True)
+        norm_topk = cfg.moe_norm_topk
 
         # qwen2_moe always-on shared expert, modulated by a sigmoid gate
-        fs = getattr(cfg, "moe_shared_expert_size", 0)
+        fs = cfg.moe_shared_expert_size
         if fs:
             sg = self.param("shared_gate_proj", init, (d, fs), jnp.float32)
             su = self.param("shared_up_proj", init, (d, fs), jnp.float32)

@@ -240,3 +240,56 @@ def test_pdsh_ip_hostfile_maps_process_id():
     remote = cmd[-1]
     assert "hostname -I" in remote  # IP-based hostfiles resolve via local IPs
     assert "cannot map" in remote   # and fail loudly instead of defaulting to 0
+
+
+# ---------------------------------------------------------------------------
+# bin/ CLIs (reference bin/ds_elastic, bin/ds_ssh, bin/ds_nvme_tune)
+# ---------------------------------------------------------------------------
+
+_BIN = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "bin")
+_ENV = {**os.environ, "PYTHONPATH": os.path.dirname(_BIN)}
+
+
+def test_ds_elastic_cli(tmp_path):
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text('{"train_batch_size": 64, "elasticity": {"enabled": true, '
+                   '"max_train_batch_size": 512, "micro_batch_sizes": [2, 4, 8], '
+                   '"min_gpus": 1, "max_gpus": 64, "min_time": 20, '
+                   '"version": 0.2}}')
+    out = subprocess.run(
+        [sys.executable, os.path.join(_BIN, "ds_elastic"), "-c", str(cfg),
+         "-w", "8"], capture_output=True, text=True, env=_ENV)
+    assert out.returncode == 0, out.stderr
+    assert "final_batch_size .... 480" in out.stdout
+    assert "micro_batch_size .... 4" in out.stdout
+
+
+def test_ds_elastic_cli_requires_section(tmp_path):
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text('{"train_batch_size": 64}')
+    out = subprocess.run(
+        [sys.executable, os.path.join(_BIN, "ds_elastic"), "-c", str(cfg)],
+        capture_output=True, text=True, env=_ENV)
+    assert out.returncode != 0 and "elasticity" in out.stderr
+
+
+def test_ds_ssh_cli_bad_hostfile():
+    out = subprocess.run(
+        [sys.executable, os.path.join(_BIN, "ds_ssh"), "-f", "/nonexistent",
+         "echo", "hi"], capture_output=True, text=True, env=_ENV)
+    assert out.returncode != 0 and "hostfile" in out.stderr
+
+
+def test_ds_nvme_tune_cli(tmp_path):
+    out_json = tmp_path / "aio.json"
+    out = subprocess.run(
+        [sys.executable, os.path.join(_BIN, "ds_nvme_tune"),
+         "--nvme-dir", str(tmp_path), "--size-mb", "8", "--threads", "2",
+         "--block-kb", "512", "--trials", "1", "--out", str(out_json)],
+        capture_output=True, text=True, env=_ENV)
+    assert out.returncode == 0, out.stderr
+    import json as _json
+
+    aio = _json.loads(out_json.read_text())["aio"]
+    assert aio["thread_count"] == 2 and aio["block_size"] == 512 << 10
