@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.transformer import TransformerConfig, apply_rope, rope_table
+from ...ops.pallas.paged_attention import NEG_INF
 from ...ops.pallas.paged_attention import paged_attention as paged_attention_pallas
 
 
@@ -91,14 +92,17 @@ def _rope(x, cos, sin, positions):
     return apply_rope(x[None], cos, sin, positions[None])[0]
 
 
-def paged_attention(qg, k_pool, v_pool, block_table, positions_g, q_valid, kv_len):
+def paged_attention(qg, k_pool, v_pool, block_table, positions_g, q_valid,
+                    kv_len, return_stats: bool = False):
     """Grouped paged attention.
 
     qg: [S, Q, Hq, D] grouped queries; k/v_pool: [N, Hk, bs, D] this layer's
     pages (head-major); block_table: [S, B]; positions_g: [S, Q] absolute
     positions; q_valid: [S, Q] bool; kv_len: [S]. Returns [S, Q, Hq, D].
     Slot j of sequence s attends iff j <= position of the query (also masks
-    unwritten/trash slots because kv_len bounds writes).
+    unwritten/trash slots because kv_len bounds writes). With
+    ``return_stats`` also returns the softmax ``(m, l)`` per row
+    ([S, Q, Hq] fp32) for two-source merges.
     """
     s, q, hq, d = qg.shape
     hk = k_pool.shape[1]
@@ -116,10 +120,32 @@ def paged_attention(qg, k_pool, v_pool, block_table, positions_g, q_valid, kv_le
     pos_q = positions_g[:, None, None, :, None]
     valid = (slot <= pos_q) & q_valid[:, None, None, :, None]
     valid = valid & (slot < kv_len[:, None, None, None, None])
-    logits = jnp.where(valid, logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(qg.dtype)
-    out = jnp.einsum("shrqk,skhd->sqhrd", probs, vg.astype(qg.dtype))
-    return out.reshape(s, q, hq, d)
+    logits = jnp.where(valid, logits, NEG_INF)
+    m_row = jnp.max(logits, axis=-1)                       # [s,hk,rep,q]
+    p = jnp.where(valid, jnp.exp(logits - m_row[..., None]), 0.0)
+    l_row = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("shrqk,skhd->sqhrd", p.astype(qg.dtype),
+                     vg.astype(qg.dtype), preferred_element_type=jnp.float32)
+    safe_l = jnp.where(l_row == 0.0, 1.0, l_row)
+    out = (acc / jnp.transpose(safe_l, (0, 3, 1, 2))[..., None]).astype(qg.dtype)
+    out = out.reshape(s, q, hq, d)
+    if return_stats:
+        stats = lambda a: jnp.transpose(a, (0, 3, 1, 2)).reshape(s, q, hq)
+        return out, stats(m_row), stats(l_row)
+    return out
+
+
+def merge_attention(out1, m1, l1, out2, m2, l2):
+    """Merge two normalized partial-attention results over disjoint KV sets
+    (flash-attention combine algebra). out_i: [..., D]; m_i/l_i: [...] with
+    ``m = NEG_INF, l = 0`` for an empty set."""
+    m = jnp.maximum(m1, m2)
+    e1 = l1 * jnp.exp(m1 - m)
+    e2 = l2 * jnp.exp(m2 - m)
+    den = jnp.maximum(e1 + e2, 1e-30)
+    num = (out1.astype(jnp.float32) * e1[..., None]
+           + out2.astype(jnp.float32) * e2[..., None])
+    return num / den[..., None]
 
 
 def _ragged_forward_impl(params, cfg: TransformerConfig, kv_k, kv_v, tokens,
@@ -257,6 +283,16 @@ def decode_loop(params, cfg: TransformerConfig, kv_k, kv_v, tokens0, pos0,
     decode latency, so this runs the whole forward→sample→KV-append loop as a
     ``lax.scan`` on device and ships back only ``[S, n_steps]`` int32.
 
+    The KV pool is FROZEN during the scan. XLA (at least on this backend)
+    copies a scanned carry on every iteration when it is updated by
+    scatter/DUS, so carrying the multi-GB pool made step time proportional
+    to POOL size (measured: ~1.1 ms/step per 0.9 GB — dominating decode).
+    Instead the scan carries only a small in-window KV buffer
+    ``[L, n_steps, S, Hk, D]``; each step attends to the frozen pool (paged
+    kernel, ``return_stats``) and to the window (dense, masked), merging the
+    two with the flash combine algebra; the window is scattered into the
+    pool ONCE after the scan.
+
     tokens0: [S] last sampled token per sequence; pos0: [S] its absolute
     position (== tokens cached so far); block_table [S, B] must already cover
     ``pos0 + n_steps`` (reserve before calling); active: [S] bool (inactive
@@ -264,45 +300,72 @@ def decode_loop(params, cfg: TransformerConfig, kv_k, kv_v, tokens0, pos0,
     """
     S = tokens0.shape[0]
     bs = kv_k.shape[3]
+    L, Hq, Hk, D = cfg.num_layers, cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    G = Hq // Hk
+    W = n_steps
     dtype = cfg.dtype
+    sm = 1.0 / np.sqrt(D)
     if cfg.position == "rope":
         cos, sin = rope_table(cfg.max_seq_len, cfg.rotary_dim, cfg.rope_theta)
     ones = jnp.ones((S,), jnp.int32)
+    pool_len = pos0  # tokens cached before this call — static for the scan
+    rope_cs = (cos, sin) if cfg.position == "rope" else None
 
-    def forward_one(kv_k, kv_v, toks, pos):
+    def forward_one(wk, wv, toks, pos, t):
         x = params["embed"]["embedding"].astype(dtype)[toks]        # [S, H]
         if cfg.position == "learned":
             x = x + params["pos_embed"][pos].astype(dtype)
-        tgt_block = jnp.where(
-            active, jnp.take_along_axis(
-                block_table, (pos // bs).astype(jnp.int32)[:, None],
-                axis=1)[:, 0], 0)
-        tgt_slot = jnp.where(active, pos % bs, 0)
-        kv_len = pos + 1
-        rope_cs = (cos, sin) if cfg.position == "rope" else None
+        widx = jnp.arange(W)
+        wmask = widx <= t                                           # [W]
         for i in range(cfg.num_layers):
             lp = params[f"layer_{i}"]
             y = _norm(cfg, lp["attn_norm"], x)
             ap = lp["attn"]
             qt, kt, vt = _qkv(cfg, ap, y, rope_cs, pos)             # [S, H*, D]
-            kv_k = kv_k.at[i, tgt_block, :, tgt_slot].set(kt.astype(kv_k.dtype))
-            kv_v = kv_v.at[i, tgt_block, :, tgt_slot].set(vt.astype(kv_v.dtype))
+            wk = jax.lax.dynamic_update_slice(
+                wk, kt.astype(wk.dtype)[None, None], (i, t, 0, 0, 0))
+            wv = jax.lax.dynamic_update_slice(
+                wv, vt.astype(wv.dtype)[None, None], (i, t, 0, 0, 0))
             qg = qt[:, None]                                        # [S, 1, Hq, D]
             if attn_impl == "pallas":
-                out = paged_attention_pallas(qg, kv_k[i], kv_v[i], block_table,
-                                             pos, ones, kv_len)
+                o1, m1, l1 = paged_attention_pallas(
+                    qg, kv_k[i], kv_v[i], block_table, pos, ones, pool_len,
+                    return_stats=True)
             else:
-                out = paged_attention(qg, kv_k[i], kv_v[i], block_table,
-                                      pos[:, None], active[:, None], kv_len)
-            x = x + _dense_multi_in(ap["o_proj"], out[:, 0])
+                o1, m1, l1 = paged_attention(
+                    qg, kv_k[i], kv_v[i], block_table, pos[:, None],
+                    active[:, None], pool_len, return_stats=True)
+            o1, m1, l1 = o1[:, 0], m1[:, 0], l1[:, 0]               # [S,Hq,*]
+
+            # dense attention over the in-window tokens (incl. this one)
+            wki = jax.lax.dynamic_index_in_dim(wk, i, 0, keepdims=False)
+            wvi = jax.lax.dynamic_index_in_dim(wv, i, 0, keepdims=False)
+            qr = qt.reshape(S, Hk, G, D)
+            lg2 = jnp.einsum("shgd,wshd->shgw", qr, wki.astype(qt.dtype),
+                             preferred_element_type=jnp.float32) * sm
+            lg2 = jnp.where(wmask[None, None, None], lg2, NEG_INF)
+            m2 = jnp.max(lg2, axis=-1)                              # [S,Hk,G]
+            p2 = jnp.where(wmask[None, None, None],
+                           jnp.exp(lg2 - m2[..., None]), 0.0)
+            l2 = jnp.sum(p2, axis=-1)
+            acc2 = jnp.einsum("shgw,wshd->shgd", p2.astype(qt.dtype),
+                              wvi.astype(qt.dtype),
+                              preferred_element_type=jnp.float32)
+            o2 = acc2 / jnp.where(l2 == 0.0, 1.0, l2)[..., None]
+
+            merged = merge_attention(o1.reshape(S, Hk, G, D),
+                                     m1.reshape(S, Hk, G), l1.reshape(S, Hk, G),
+                                     o2, m2, l2)
+            attn_tok = merged.reshape(S, Hq, D).astype(dtype)
+            x = x + _dense_multi_in(ap["o_proj"], attn_tok)
             x = x + _mlp(cfg, lp["mlp"], _norm(cfg, lp["mlp_norm"], x))
         x = _norm(cfg, params["final_norm"], x)
         logits = _lm_logits(cfg, params, x)
-        return logits, kv_k, kv_v
+        return logits, wk, wv
 
-    def body(carry, _):
-        kv_k, kv_v, toks, pos, key = carry
-        logits, kv_k, kv_v = forward_one(kv_k, kv_v, toks, pos)
+    def body(carry, t):
+        wk, wv, toks, pos, key = carry
+        logits, wk, wv = forward_one(wk, wv, toks, pos, t)
         if greedy:
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:
@@ -310,8 +373,22 @@ def decode_loop(params, cfg: TransformerConfig, kv_k, kv_v, tokens0, pos0,
             nxt = jax.random.categorical(
                 sub, logits / jnp.maximum(temperature, 1e-6),
                 axis=-1).astype(jnp.int32)
-        return (kv_k, kv_v, nxt, pos + 1, key), nxt
+        return (wk, wv, nxt, pos + 1, key), nxt
 
-    (kv_k, kv_v, *_), toks = jax.lax.scan(
-        body, (kv_k, kv_v, tokens0, pos0, key), None, length=n_steps)
+    wk0 = jnp.zeros((L, W, S, Hk, D), dtype)
+    wv0 = jnp.zeros((L, W, S, Hk, D), dtype)
+    (wk, wv, *_), toks = jax.lax.scan(
+        body, (wk0, wv0, tokens0, pos0, key), jnp.arange(n_steps))
+
+    # one batched scatter of the whole window into the pool
+    tpos = pos0[:, None] + jnp.arange(W)[None]                      # [S, W]
+    blk = jnp.take_along_axis(block_table, (tpos // bs).astype(jnp.int32),
+                              axis=1)
+    blk = jnp.where(active[:, None], blk, 0).reshape(-1)
+    slot = jnp.where(active[:, None], tpos % bs, 0).reshape(-1)
+    wkt = wk.transpose(0, 2, 1, 3, 4).reshape(L, S * W, Hk, D)      # [L,S*W,..]
+    wvt = wv.transpose(0, 2, 1, 3, 4).reshape(L, S * W, Hk, D)
+    for i in range(L):
+        kv_k = kv_k.at[i, blk, :, slot].set(wkt[i].astype(kv_k.dtype))
+        kv_v = kv_v.at[i, blk, :, slot].set(wvt[i].astype(kv_v.dtype))
     return toks.T, kv_k, kv_v                                       # [S, n_steps]

@@ -41,9 +41,13 @@ def _interpret_default() -> bool:
 
 
 def _kernel(bt_ref, kvl_ref, start_ref, chunk_ref,   # scalar prefetch
-            q_ref, k_ref, v_ref, o_ref,
-            acc_sc, m_sc, l_sc, *,
-            block_size: int, group: int, kv_heads: int, sm_scale: float):
+            q_ref, k_ref, v_ref, o_ref, *rest,
+            block_size: int, group: int, kv_heads: int, sm_scale: float,
+            with_stats: bool = False):
+    if with_stats:
+        m_ref, l_ref, acc_sc, m_sc, l_sc = rest
+    else:
+        acc_sc, m_sc, l_sc = rest
     s_idx = pl.program_id(0)
     b = pl.program_id(1)
     nb = pl.num_programs(1)
@@ -102,11 +106,15 @@ def _kernel(bt_ref, kvl_ref, start_ref, chunk_ref,   # scalar prefetch
         l = l_sc[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_sc[:] / safe_l).astype(o_ref.dtype)
+        if with_stats:  # raw online-softmax stats for two-way merges
+            m_ref[0] = m_sc[:]
+            l_ref[0] = l_sc[:]
 
 
 def paged_attention(q, k_pool, v_pool, block_table, start_pos, chunk_len,
                     kv_len, *, sm_scale: Optional[float] = None,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    return_stats: bool = False):
     """Paged attention over one layer's KV pool.
 
     Args:
@@ -117,7 +125,11 @@ def paged_attention(q, k_pool, v_pool, block_table, start_pos, chunk_len,
         one head's page is a contiguous ``[bs, D]`` tile — a single DMA).
       block_table: ``[S, B]`` int32 logical→physical page map.
       start_pos / chunk_len / kv_len: ``[S]`` int32.
-    Returns ``[S, Q, Hq, D]``; rows of invalid queries are zero.
+    Returns ``[S, Q, Hq, D]``; rows of invalid queries are zero. With
+    ``return_stats`` also returns the raw online-softmax ``(m, l)`` per row
+    (``[S, Q, Hq]`` fp32) so a caller can merge this result with attention
+    over another KV source (the frozen-pool decode loop does this with its
+    in-window buffer).
     """
     S, Q, Hq, D = q.shape
     N, Hk, bs, _ = k_pool.shape
@@ -148,29 +160,47 @@ def paged_attention(q, k_pool, v_pool, block_table, start_pos, chunk_len,
     def _q_map(s, b, *_):
         return (s, 0, 0)
 
+    rows = Hk * Q * group
+    out_shapes = jax.ShapeDtypeStruct((S, rows, D), q.dtype)
+    out_specs = pl.BlockSpec((1, rows, D), _q_map)
+    if return_stats:
+        out_shapes = (out_shapes,
+                      jax.ShapeDtypeStruct((S, rows, 128), jnp.float32),
+                      jax.ShapeDtypeStruct((S, rows, 128), jnp.float32))
+        out_specs = (out_specs,
+                     pl.BlockSpec((1, rows, 128), _q_map),
+                     pl.BlockSpec((1, rows, 128), _q_map))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(S, B),
         in_specs=[
-            pl.BlockSpec((1, Hk * Q * group, D), _q_map),
+            pl.BlockSpec((1, rows, D), _q_map),
             pl.BlockSpec((1, Hk, bs, D), _kv_map),
             pl.BlockSpec((1, Hk, bs, D), _kv_map),
         ],
-        out_specs=pl.BlockSpec((1, Hk * Q * group, D), _q_map),
+        out_specs=out_specs,
         scratch_shapes=[
-            pltpu.VMEM((Hk * Q * group, D), jnp.float32),
-            pltpu.VMEM((Hk * Q * group, 128), jnp.float32),
-            pltpu.VMEM((Hk * Q * group, 128), jnp.float32),
+            pltpu.VMEM((rows, D), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
         ],
     )
-    out = pl.pallas_call(
+    res = pl.pallas_call(
         functools.partial(_kernel, block_size=bs, group=group, kv_heads=Hk,
-                          sm_scale=float(sm_scale)),
+                          sm_scale=float(sm_scale), with_stats=return_stats),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, Hk * Q * group, D), q.dtype),
+        out_shape=out_shapes,
         interpret=interpret,
     )(bt, kvl, start_pos.astype(jnp.int32), chunk_len.astype(jnp.int32),
       qt, k_pool, v_pool)
 
-    out = out.reshape(S, Hk, Q, group, D).transpose(0, 2, 1, 3, 4)
-    return out.reshape(S, Q, Hq, D)
+    def unrows(a):  # [S, Hk*Q*G, ...] -> [S, Q, Hq, ...]
+        tail = a.shape[2:]
+        a = a.reshape(S, Hk, Q, group, *tail).transpose(0, 2, 1, 3,
+                                                        *range(4, 4 + len(tail)))
+        return a.reshape(S, Q, Hq, *tail)
+
+    if return_stats:
+        out, m, l = res
+        return unrows(out), unrows(m)[..., 0], unrows(l)[..., 0]
+    return unrows(res)

@@ -106,3 +106,95 @@ def test_v2_engine_pallas_backend_matches_einsum():
         outs[backend] = eng.generate(prompts, max_new_tokens=6)
     for a, b in zip(outs["einsum"], outs["pallas"]):
         np.testing.assert_array_equal(a, b)
+
+
+def test_stats_parity_and_merge(rng):
+    """return_stats parity (einsum vs pallas) + merge_attention golden test:
+    attention over a split KV (pool half via stats + dense half) must equal
+    attention over the whole KV — the frozen-pool decode invariant."""
+    from deepspeed_tpu.inference.v2.model import merge_attention
+
+    S, Q, Hq, Hk, D, bs = 3, 1, 4, 2, 16, 8
+    kv_lens = [13, 5, 0]  # incl. an EMPTY pool row
+    case = _make_case(rng, S, Q, Hq, Hk, D, N=8, bs=bs, B=4,
+                      kv_lens=kv_lens, chunk_lens=[1, 1, 1])
+    q, k_pool, v_pool, bt, start, chunk, kvl = case
+    pos = jnp.asarray([20, 9, 0], jnp.int32)  # query positions past the pool
+
+    o_e, m_e, l_e = einsum_paged(q, k_pool, v_pool, bt, pos[:, None],
+                                 jnp.ones((S, 1), bool), kvl,
+                                 return_stats=True)
+    o_p, m_p, l_p = pallas_paged(q, k_pool, v_pool, bt, pos,
+                                 jnp.ones((S,), jnp.int32), kvl,
+                                 return_stats=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(o_p), np.asarray(o_e), rtol=2e-5,
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(m_p), np.asarray(m_e), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(l_p), np.asarray(l_e), rtol=2e-5)
+
+    # golden merge: pool (stats) + a 4-token dense window == full attention
+    W = 4
+    wk = rng.standard_normal((W, S, Hk, D)).astype(np.float32)
+    wv = rng.standard_normal((W, S, Hk, D)).astype(np.float32)
+    G = Hq // Hk
+    qr = jnp.asarray(q)[:, 0].reshape(S, Hk, G, D)
+    lg2 = jnp.einsum("shgd,wshd->shgw", qr, jnp.asarray(wk)) / np.sqrt(D)
+    m2 = jnp.max(lg2, axis=-1)
+    p2 = jnp.exp(lg2 - m2[..., None])
+    l2 = jnp.sum(p2, axis=-1)
+    o2 = jnp.einsum("shgw,wshd->shgd", p2, jnp.asarray(wv)) / l2[..., None]
+    merged = merge_attention(
+        o_e[:, 0].reshape(S, Hk, G, D), m_e[:, 0].reshape(S, Hk, G),
+        l_e[:, 0].reshape(S, Hk, G), o2, m2, l2).reshape(S, Hq, D)
+
+    # reference: whole attention over pool tokens + window tokens
+    for s in range(S):
+        n_pool = int(kv_lens[s])
+        kg = np.asarray(k_pool)[np.asarray(bt)[s]].transpose(0, 2, 1, 3)
+        kg = kg.reshape(-1, Hk, D)[:n_pool]
+        vg = np.asarray(v_pool)[np.asarray(bt)[s]].transpose(0, 2, 1, 3)
+        vg = vg.reshape(-1, Hk, D)[:n_pool]
+        k_all = np.concatenate([kg, np.asarray(wk)[:, s]], 0)   # [n+W, Hk, D]
+        v_all = np.concatenate([vg, np.asarray(wv)[:, s]], 0)
+        qs = np.asarray(q)[s, 0].reshape(Hk, G, D)
+        lg = np.einsum("hgd,khd->hgk", qs, k_all) / np.sqrt(D)
+        p = np.exp(lg - lg.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("hgk,khd->hgd", p, v_all).reshape(Hq, D)
+        np.testing.assert_allclose(np.asarray(merged)[s], want, rtol=2e-5,
+                                   atol=2e-5)
+
+
+def test_decode_loop_pallas_matches_einsum():
+    """The fused decode loop must produce identical tokens and pools on both
+    attention backends (interpret-mode pallas on CPU)."""
+    from deepspeed_tpu.inference.v2.model import decode_loop
+    from deepspeed_tpu.models.transformer import (TransformerLM, init_params,
+                                                  llama_config)
+
+    cfg = llama_config("tiny", num_layers=2, hidden_size=32,
+                       intermediate_size=64, num_heads=4, num_kv_heads=2,
+                       vocab_size=64, max_seq_len=128, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    params = init_params(model, batch=1, seq=16)
+    S, bs, N, B = 2, 8, 10, 8
+    L, Hk, D = cfg.num_layers, cfg.kv_heads, cfg.head_dim
+    rng = np.random.default_rng(5)
+    kv_k = jnp.asarray(rng.standard_normal((L, N, Hk, bs, D)), jnp.float32)
+    kv_v = jnp.asarray(rng.standard_normal((L, N, Hk, bs, D)), jnp.float32)
+    bt = jnp.asarray([[1, 2, 3, 0, 0, 0, 0, 0], [4, 5, 6, 0, 0, 0, 0, 0]],
+                     jnp.int32)
+    tokens0 = jnp.asarray([3, 7], jnp.int32)
+    pos0 = jnp.asarray([10, 17], jnp.int32)
+    active = jnp.ones((S,), bool)
+    key = jax.random.PRNGKey(0)
+    def args():  # the pools are donated — fresh copies per call
+        return (params, cfg, jnp.array(kv_k), jnp.array(kv_v), tokens0, pos0,
+                bt, active, key, jnp.float32(1.0))
+    te, ke, ve = decode_loop(*args(), n_steps=6, attn_impl="einsum")
+    tp, kp, vp = decode_loop(*args(), n_steps=6, attn_impl="pallas")
+    np.testing.assert_array_equal(np.asarray(te), np.asarray(tp))
+    np.testing.assert_allclose(np.asarray(ke), np.asarray(kp), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ve), np.asarray(vp), rtol=1e-5,
+                               atol=1e-5)
