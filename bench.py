@@ -158,8 +158,9 @@ def decode_bench():
                            vocab_size=32000, max_seq_len=4096,
                            dtype=jnp.bfloat16)
         # 512-token pages + 32 sequences, frozen-pool fused decode with the
-        # gather path (measured v5e: 9.2k tok/s vs 4.4k for the r3-early
-        # pool-carrying loop; page 1024 exceeds scoped VMEM)
+        # gather path: 7.8k tok/s recorded for THIS config (ctx grows to
+        # ~1.5k over the 1024 warmup+timed steps) vs 4.4k for the r3-early
+        # pool-carrying loop; page 1024 exceeds scoped VMEM
         n_seqs, prompt_len, kv_blocks, bs = 32, 512, 200, 512
         steps, warmup = 512, 512  # warmup compiles the same n_steps program
         dtype = "bfloat16"

@@ -1,0 +1,163 @@
+"""Megatron-LM GPT checkpoint ingestion.
+
+Reference: ``module_inject/containers/megatron_gpt.py`` (+
+``megatron_gpt_moe.py``) inject fused kernels into Megatron-LM GPT models,
+and ``runtime/state_dict_factory.py`` MegatronSDLoader re-partitions their
+TP shards — including the checkpoint-version switch for the fused
+query-key-value head layout (``split_query_key_value:258``: ckpt_ver < 2
+stores per-head ``[q, k, v]`` interleaved, >= 2 stores ``[q | k | v]``
+blocks).
+
+TPU-native flow: merge raw TP shards with
+``checkpoint.state_dict_factory.SDLoader`` (which already speaks both QKV
+layouts), then map the merged dict to our ``TransformerLM`` params here.
+``params_to_megatron`` is the exact inverse — used for export and for
+round-trip validation without a torch Megatron install.
+"""
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..models.transformer import TransformerConfig
+
+_PRE = "model.language_model."
+
+
+def megatron_config(args: Dict[str, Any]) -> TransformerConfig:
+    """Map Megatron-LM ``args`` (as stored in its checkpoints) to our config.
+    Classic GPT: learned positions, LayerNorm, (tanh) GELU, tied embeddings.
+    """
+    d = dict(args)
+    return TransformerConfig(
+        vocab_size=d["padded_vocab_size"] if "padded_vocab_size" in d
+        else d["vocab_size"],
+        hidden_size=d["hidden_size"],
+        intermediate_size=d.get("ffn_hidden_size") or 4 * d["hidden_size"],
+        num_layers=d["num_layers"], num_heads=d["num_attention_heads"],
+        max_seq_len=d.get("max_position_embeddings", 1024),
+        norm="layernorm", activation="gelu", position="learned",
+        norm_eps=d.get("layernorm_epsilon", 1e-5),
+        attn_qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+        tie_embeddings=True)
+
+
+def _split_qkv(w, b, cfg: TransformerConfig, version: int):
+    """Un-fuse query_key_value per the checkpoint version (reference
+    ``split_query_key_value``). w: [3*H*Dh, D]; b: [3*H*Dh] or None."""
+    h, dh, dm = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    if version < 2:  # per-head [q, k, v] interleaved
+        w = w.reshape(h, 3, dh, dm)
+        qw, kw, vw = w[:, 0], w[:, 1], w[:, 2]            # [h, dh, D]
+        if b is not None:
+            b = b.reshape(h, 3, dh)
+            qb, kb, vb = b[:, 0], b[:, 1], b[:, 2]
+    else:            # [q | k | v] blocks
+        qw, kw, vw = (a.reshape(h, dh, dm) for a in np.split(w, 3, axis=0))
+        if b is not None:
+            qb, kb, vb = (a.reshape(h, dh) for a in np.split(b, 3))
+    to_flax = lambda a: np.ascontiguousarray(np.transpose(a, (2, 0, 1)))
+    out = {
+        "q_proj": {"kernel": to_flax(qw)},
+        "k_proj": {"kernel": to_flax(kw)},
+        "v_proj": {"kernel": to_flax(vw)},
+    }
+    if b is not None:
+        out["q_proj"]["bias"] = np.ascontiguousarray(qb)
+        out["k_proj"]["bias"] = np.ascontiguousarray(kb)
+        out["v_proj"]["bias"] = np.ascontiguousarray(vb)
+    return out
+
+
+def megatron_params(sd: Dict[str, Any], cfg: TransformerConfig,
+                    version: int = 2) -> Dict[str, Any]:
+    """Merged (single-TP) Megatron-GPT state dict → TransformerLM params."""
+    def t(key):
+        x = sd[key]
+        if hasattr(x, "detach"):
+            x = x.detach().cpu().float().numpy()
+        return np.asarray(x, np.float32)
+
+    h, dh, dm = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    p: Dict[str, Any] = {
+        "embed": {"embedding": t(_PRE + "embedding.word_embeddings.weight")},
+        "pos_embed": t(_PRE + "embedding.position_embeddings.weight"),
+    }
+    for i in range(cfg.num_layers):
+        pre = _PRE + f"transformer.layers.{i}."
+        attn = _split_qkv(
+            t(pre + "attention.query_key_value.weight"),
+            t(pre + "attention.query_key_value.bias")
+            if pre + "attention.query_key_value.bias" in sd else None,
+            cfg, version)
+        attn["o_proj"] = {
+            "kernel": np.ascontiguousarray(
+                t(pre + "attention.dense.weight").T.reshape(h, dh, dm)),
+            "bias": t(pre + "attention.dense.bias")}
+        p[f"layer_{i}"] = {
+            "attn": attn,
+            "attn_norm": {"scale": t(pre + "input_layernorm.weight"),
+                          "bias": t(pre + "input_layernorm.bias")},
+            "mlp_norm": {"scale": t(pre + "post_attention_layernorm.weight"),
+                         "bias": t(pre + "post_attention_layernorm.bias")},
+            "mlp": {
+                "up_proj": {"kernel": t(pre + "mlp.dense_h_to_4h.weight").T,
+                            "bias": t(pre + "mlp.dense_h_to_4h.bias")},
+                "down_proj": {"kernel": t(pre + "mlp.dense_4h_to_h.weight").T,
+                              "bias": t(pre + "mlp.dense_4h_to_h.bias")},
+            },
+        }
+    p["final_norm"] = {
+        "scale": t(_PRE + "transformer.final_layernorm.weight"),
+        "bias": t(_PRE + "transformer.final_layernorm.bias")}
+    return p
+
+
+def params_to_megatron(params: Dict[str, Any], cfg: TransformerConfig,
+                       version: int = 2) -> Dict[str, np.ndarray]:
+    """TransformerLM params → Megatron-GPT state dict (export / round-trip).
+    Inverse of :func:`megatron_params` for the same checkpoint version."""
+    h, dh, dm = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    a = lambda x: np.asarray(x, np.float32)
+    sd: Dict[str, np.ndarray] = {
+        _PRE + "embedding.word_embeddings.weight": a(params["embed"]["embedding"]),
+        _PRE + "embedding.position_embeddings.weight": a(params["pos_embed"]),
+    }
+    for i in range(cfg.num_layers):
+        lp = params[f"layer_{i}"]
+        pre = _PRE + f"transformer.layers.{i}."
+        # flax [D, h, dh] -> megatron rows [h, dh, D]
+        rows = lambda n: np.transpose(a(lp["attn"][n]["kernel"]), (1, 2, 0))
+        qw, kw, vw = rows("q_proj"), rows("k_proj"), rows("v_proj")
+        has_b = "bias" in lp["attn"]["q_proj"]
+        if version < 2:
+            w = np.stack([qw, kw, vw], axis=1).reshape(3 * h * dh, dm)
+            if has_b:
+                b = np.stack([a(lp["attn"]["q_proj"]["bias"]),
+                              a(lp["attn"]["k_proj"]["bias"]),
+                              a(lp["attn"]["v_proj"]["bias"])],
+                             axis=1).reshape(3 * h * dh)
+        else:
+            w = np.concatenate([x.reshape(h * dh, dm) for x in (qw, kw, vw)])
+            if has_b:
+                b = np.concatenate([a(lp["attn"][n]["bias"]).reshape(h * dh)
+                                    for n in ("q_proj", "k_proj", "v_proj")])
+        sd[pre + "attention.query_key_value.weight"] = np.ascontiguousarray(w)
+        if has_b:
+            sd[pre + "attention.query_key_value.bias"] = np.ascontiguousarray(b)
+        sd[pre + "attention.dense.weight"] = np.ascontiguousarray(
+            a(lp["attn"]["o_proj"]["kernel"]).reshape(h * dh, dm).T)
+        sd[pre + "attention.dense.bias"] = a(lp["attn"]["o_proj"]["bias"])
+        sd[pre + "input_layernorm.weight"] = a(lp["attn_norm"]["scale"])
+        sd[pre + "input_layernorm.bias"] = a(lp["attn_norm"]["bias"])
+        sd[pre + "post_attention_layernorm.weight"] = a(lp["mlp_norm"]["scale"])
+        sd[pre + "post_attention_layernorm.bias"] = a(lp["mlp_norm"]["bias"])
+        sd[pre + "mlp.dense_h_to_4h.weight"] = np.ascontiguousarray(
+            a(lp["mlp"]["up_proj"]["kernel"]).T)
+        sd[pre + "mlp.dense_h_to_4h.bias"] = a(lp["mlp"]["up_proj"]["bias"])
+        sd[pre + "mlp.dense_4h_to_h.weight"] = np.ascontiguousarray(
+            a(lp["mlp"]["down_proj"]["kernel"]).T)
+        sd[pre + "mlp.dense_4h_to_h.bias"] = a(lp["mlp"]["down_proj"]["bias"])
+    sd[_PRE + "transformer.final_layernorm.weight"] = a(params["final_norm"]["scale"])
+    sd[_PRE + "transformer.final_layernorm.bias"] = a(params["final_norm"]["bias"])
+    return sd

@@ -43,6 +43,11 @@ class RaggedInferenceEngineConfig:
     # decode iterations fused into one compiled program by decode_batch()
     # (one host round-trip per chunk instead of per token)
     decode_chunk: int = 16
+    # cap on the per-dispatch fused window: the frozen-pool decode carries an
+    # in-window KV buffer [L, n, S, Hk, D] and runs an n-wide dense window
+    # attention each step, so an unbounded n would grow HBM and O(n^2) work;
+    # longer runs are chunked into windows of this size
+    max_fused_window: int = 512
 
 
 class InferenceEngineV2:
@@ -286,6 +291,19 @@ class InferenceEngineV2:
         or ``max_new_tokens`` are discarded on host.
         """
         c = self.config
+        if total_steps > c.max_fused_window:
+            # bound the fused window (see max_fused_window); chunked calls
+            # reuse one compiled program per distinct window size
+            out: Dict[int, List[int]] = {}
+            remaining = total_steps
+            while remaining > 0:
+                got = self.decode_stream(min(remaining, c.max_fused_window))
+                if not got:
+                    break
+                for uid, toks in got.items():
+                    out.setdefault(uid, []).extend(toks)
+                remaining -= c.max_fused_window
+            return out
         seqs = [s for s in self.state_manager.all() if not s.done]
         if not seqs:
             return {}

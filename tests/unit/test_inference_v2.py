@@ -202,3 +202,39 @@ def test_v2_long_prompt_chunked_generate():
     prompt = np.arange(1, 15, dtype=np.int32)  # 14 tokens -> 4 chunk steps
     outs = v2.generate([prompt], max_new_tokens=5)
     assert outs[0].shape == (5,)
+
+
+def test_decode_stream_windowed_matches_single_fused():
+    """decode_stream with a small max_fused_window (multiple fused dispatches,
+    each over a fresh frozen pool) must produce the same greedy tokens as one
+    big window and as the per-step step() loop."""
+    model, params = _tiny_model("rope")
+    prompts = [np.array([5, 6, 7, 8, 9], np.int32),
+               np.array([40, 41, 42], np.int32)]
+
+    def run(window):
+        eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            token_budget=16, max_ragged_sequence_count=2, max_chunk_size=8,
+            num_kv_blocks=32, kv_block_size=8, max_blocks_per_seq=8,
+            dtype="float32", max_fused_window=window))
+        eng.put([0, 1], prompts, max_new_tokens=13)
+        while any(s.in_prefill for s in eng.state_manager.all()):
+            eng.step()
+        eng.decode_stream(12)  # 1 token came from prefill
+        return [eng.query(uid)[1] for uid in (0, 1)]
+
+    big = run(512)     # one fused dispatch
+    small = run(4)     # 3 chunked dispatches of <= 4
+    for a, b in zip(big, small):
+        np.testing.assert_array_equal(a, b)
+
+    # reference: per-token step() loop
+    eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        token_budget=16, max_ragged_sequence_count=2, max_chunk_size=8,
+        num_kv_blocks=32, kv_block_size=8, max_blocks_per_seq=8,
+        dtype="float32"))
+    eng.put([0, 1], prompts, max_new_tokens=13)
+    while eng.has_work():
+        eng.step()
+    for uid, want in zip((0, 1), big):
+        np.testing.assert_array_equal(eng.query(uid)[1], want)

@@ -1,0 +1,89 @@
+"""Megatron-GPT checkpoint ingestion (reference
+``module_inject/containers/megatron_gpt.py`` + MegatronSDLoader QKV
+version handling)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.checkpoint.state_dict_factory import SDLoader
+from deepspeed_tpu.inference.megatron import (megatron_config, megatron_params,
+                                              params_to_megatron)
+from deepspeed_tpu.models.transformer import TransformerLM, init_params
+
+ARGS = {"vocab_size": 96, "hidden_size": 48, "ffn_hidden_size": 96,
+        "num_layers": 2, "num_attention_heads": 4,
+        "max_position_embeddings": 32}
+
+
+def make_model():
+    cfg = dataclasses.replace(megatron_config(ARGS), dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    params = init_params(model, seed=3, seq=16)
+    return cfg, model, params
+
+
+def test_config_mapping():
+    cfg = megatron_config(ARGS)
+    assert (cfg.norm, cfg.activation, cfg.position) == ("layernorm", "gelu",
+                                                        "learned")
+    assert cfg.tie_embeddings and cfg.qkv_bias and cfg.out_bias
+
+
+@pytest.mark.parametrize("version", [0, 2])
+def test_roundtrip_preserves_logits(version):
+    """params -> megatron sd (per checkpoint version) -> params must be an
+    exact logits round-trip; v0 (interleaved) and v2 (block) layouts must
+    both decode to the same model."""
+    cfg, model, params = make_model()
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 96, (2, 10)),
+                       jnp.int32)
+    want = model.apply({"params": params}, toks)
+
+    sd = params_to_megatron(params, cfg, version=version)
+    back = jax.tree.map(jnp.asarray, megatron_params(sd, cfg, version=version))
+    got = model.apply({"params": back}, toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_versions_describe_same_weights():
+    """The SAME model exported at v0 and v2 stores different fused layouts."""
+    cfg, _, params = make_model()
+    sd0 = params_to_megatron(params, cfg, version=0)
+    sd2 = params_to_megatron(params, cfg, version=2)
+    k = "model.language_model.transformer.layers.0.attention.query_key_value.weight"
+    assert sd0[k].shape == sd2[k].shape
+    assert not np.array_equal(sd0[k], sd2[k])  # layouts differ...
+    p0 = megatron_params(sd0, cfg, version=0)
+    p2 = megatron_params(sd2, cfg, version=2)
+    np.testing.assert_array_equal(p0["layer_0"]["attn"]["q_proj"]["kernel"],
+                                  p2["layer_0"]["attn"]["q_proj"]["kernel"])
+
+
+def test_tp_sharded_megatron_checkpoint_via_sd_loader():
+    """Reference flow: raw TP=2 Megatron shards -> SDLoader merge (concat
+    qkv layout) -> converter -> logits equal the unsharded model."""
+    cfg, model, params = make_model()
+    toks = jnp.asarray(np.random.default_rng(1).integers(0, 96, (2, 8)),
+                       jnp.int32)
+    want = model.apply({"params": params}, toks)
+
+    full_sd = params_to_megatron(params, cfg, version=2)
+    from deepspeed_tpu.checkpoint.state_dict_factory import split_state_dict
+
+    # fused-qkv handling covers weights AND biases (their [3*H*Dh] dim has
+    # the same per-third layout) — same set _auto_qkv detects
+    shards = [split_state_dict(full_sd, r, 2, num_heads=cfg.num_heads,
+                               qkv_leaves={k: "concat" for k in full_sd
+                                           if "query_key_value" in k})
+              for r in range(2)]
+    loader = SDLoader(shards, version=2, num_heads=cfg.num_heads)
+    merged = loader.load(1, 0)
+    back = jax.tree.map(jnp.asarray, megatron_params(merged, cfg, version=2))
+    got = model.apply({"params": back}, toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5,
+                               atol=1e-5)
