@@ -234,11 +234,13 @@ class SDLoader:
         self.ckpt_list = list(ckpt_list)
         self.version = version
         self.specs = specs
-        # reference get_checkpoint_version: ckpt_ver>=2 => block-concat qkv
-        # (version 0 is a real value — old Megatron — and must stay < 2)
-        default_layout = ("interleaved"
-                          if (2 if version is None else version) < 2
-                          else "concat")
+        # reference merge/split_query_key_value (state_dict_factory.py:220):
+        # version 0 stores [q | k | v] BLOCKS (split per third across TP);
+        # versions 1.0/2.0 store whole-head-contiguous layouts that TP-split
+        # as a plain slice (our "interleaved" handling). Unknown version
+        # defaults to the modern plain-slice layout.
+        default_layout = ("concat" if (version is not None and version == 0)
+                          else "interleaved")
         self.qkv_layout = default_layout
         self.qkv_leaves = qkv_leaves
         self.num_heads = num_heads

@@ -18,8 +18,11 @@ interleaved fused qkv), gptj (rotate-every-two partial rotary, shared-norm
 parallel residual, biased lm_head), gpt_neo (unscaled attention,
 alternating local windows), phi (partial rotary, parallel shared-norm,
 fully biased), qwen2_moe (shared expert + un-normalized top-k routing),
-clip_text_model (quick_gelu, no LM head), bert/distilbert (encoders,
-``models/bert.py``) — one converter per weight-naming scheme.
+starcoder2 (biased layernorm blocks, non-gated mlp), stablelm (layernorm +
+gated silu + partial rotary), mpt (post-scale ALiBi, fused Wqkv, bias-free
+norms, exact gelu), clip_text_model (quick_gelu, no LM head),
+bert/distilbert (encoders, ``models/bert.py``) — one converter per
+weight-naming scheme.
 """
 
 from typing import Any, Dict
@@ -35,6 +38,14 @@ def _t(x) -> np.ndarray:
     if hasattr(x, "detach"):
         x = x.detach().cpu().float().numpy()
     return np.asarray(x)
+
+
+def _norm_p(sd: Dict[str, Any], key: str) -> Dict[str, Any]:
+    """Norm params with the bias picked up when the checkpoint has one."""
+    d = {"scale": _t(sd[key + ".weight"])}
+    if key + ".bias" in sd:
+        d["bias"] = _t(sd[key + ".bias"])
+    return d
 
 
 def config_from_hf(hf_config) -> TransformerConfig:
@@ -173,6 +184,68 @@ def config_from_hf(hf_config) -> TransformerConfig:
             layer_windows=windows if any(w for w in windows) else None,
             attn_qkv_bias=False, attn_out_bias=True, mlp_bias=True,
             tie_embeddings=True)
+    if mt == "starcoder2":
+        if d.get("sliding_window") not in (None, 0):
+            raise ValueError("starcoder2 sliding_window is not supported")
+        return TransformerConfig(
+            vocab_size=d["vocab_size"], hidden_size=d["hidden_size"],
+            intermediate_size=d["intermediate_size"],
+            num_layers=d["num_hidden_layers"], num_heads=d["num_attention_heads"],
+            num_kv_heads=d.get("num_key_value_heads") or d["num_attention_heads"],
+            max_seq_len=d.get("max_position_embeddings", 4096),
+            norm="layernorm", activation="gelu", position="rope",
+            rope_theta=d.get("rope_theta", 10000.0),
+            norm_eps=d.get("norm_epsilon", 1e-5),
+            attn_qkv_bias=d.get("use_bias", True),
+            attn_out_bias=d.get("use_bias", True),
+            mlp_bias=d.get("use_bias", True),
+            tie_embeddings=d.get("tie_word_embeddings", True))
+    if mt == "stablelm":
+        if d.get("use_parallel_residual"):
+            raise ValueError("stablelm use_parallel_residual=True unsupported "
+                             "with its per-branch norms")
+        if d.get("qk_layernorm"):
+            raise ValueError("stablelm qk_layernorm is not supported")
+        return TransformerConfig(
+            vocab_size=d["vocab_size"], hidden_size=d["hidden_size"],
+            intermediate_size=d["intermediate_size"],
+            num_layers=d["num_hidden_layers"], num_heads=d["num_attention_heads"],
+            num_kv_heads=d.get("num_key_value_heads") or d["num_attention_heads"],
+            max_seq_len=d.get("max_position_embeddings", 4096),
+            norm="layernorm", activation="swiglu", position="rope",
+            rope_theta=d.get("rope_theta", 10000.0),
+            rotary_pct=d.get("partial_rotary_factor", 0.25),
+            norm_eps=d.get("layer_norm_eps", 1e-5),
+            attn_qkv_bias=d.get("use_qkv_bias", False), attn_out_bias=False,
+            mlp_bias=False,
+            tie_embeddings=d.get("tie_word_embeddings", False))
+    if mt == "mpt":
+        ac = d.get("attn_config") or {}
+        if not isinstance(ac, dict):
+            ac = ac.to_dict() if hasattr(ac, "to_dict") else vars(ac)
+        if not ac.get("alibi", True):
+            raise ValueError("mpt without alibi (learned-pos variants) "
+                             "is not supported")
+        if ac.get("softmax_scale") is not None:
+            raise ValueError("mpt attn_config.softmax_scale is not supported "
+                             "(custom attention scaling)")
+        if ac.get("clip_qkv") is not None:
+            raise ValueError("mpt attn_config.clip_qkv is not supported")
+        return TransformerConfig(
+            vocab_size=d["vocab_size"], hidden_size=d["d_model"],
+            # HF MptMLP hardcodes 4*d_model and bias-free projections
+            # (modeling_mpt.MptMLP), independent of expansion_ratio/no_bias
+            intermediate_size=4 * d["d_model"],
+            num_layers=d["n_layers"], num_heads=d["n_heads"],
+            max_seq_len=d.get("max_seq_len", 2048),
+            norm="layernorm", activation="gelu_exact", position="alibi",
+            alibi_post_scale=True,  # mpt: qk * softmax_scale + raw alibi
+            norm_eps=d.get("layer_norm_epsilon", 1e-5),
+            # HF modeling_mpt hardcodes bias=False on Wqkv/out_proj/MLP and
+            # norm bias None regardless of no_bias — so do we
+            norm_bias=False, attn_qkv_bias=False, attn_out_bias=False,
+            mlp_bias=False,
+            tie_embeddings=True)
     if mt == "clip_text_model":
         # HF ACT2FN['gelu'] is EXACT erf gelu; our 'gelu' activation is the
         # tanh approximation (what the gpt2 families need) — reject rather
@@ -207,12 +280,16 @@ def config_from_hf(hf_config) -> TransformerConfig:
             lm_head_bias=True, tie_embeddings=False)
     raise ValueError(f"unsupported HF model_type '{mt}' (supported: llama, "
                      "mistral, mixtral, qwen2, qwen2_moe, phi3, gpt2, falcon, "
-                     "gpt_neox, opt, bloom, gptj, gpt_neo, phi, "
-                     "clip_text_model, bert, distilbert)")
+                     "gpt_neox, opt, bloom, gptj, gpt_neo, phi, starcoder2, "
+                     "stablelm, mpt, clip_text_model, bert, distilbert)")
 
 
 def _llama_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
+    """llama-naming converter; also serves layernorm-family members of the
+    same naming scheme (starcoder2, stablelm): norm biases, o_proj bias, and
+    a non-gated c_fc/c_proj MLP are picked up when present."""
     h, hk, dh, dm = cfg.num_heads, cfg.kv_heads, cfg.head_dim, cfg.hidden_size
+    norm_p = lambda key: _norm_p(sd, key)
     p: Dict[str, Any] = {"embed": {"embedding": _t(sd["model.embed_tokens.weight"])}}
     for i in range(cfg.num_layers):
         pre = f"model.layers.{i}."
@@ -226,14 +303,16 @@ def _llama_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
             "o_proj": {"kernel": _t(sd[pre + "self_attn.o_proj.weight"]).T
                        .reshape(h, dh, dm)},
         }
-        if pre + "self_attn.q_proj.bias" in sd:  # qwen2 qkv bias
+        if pre + "self_attn.q_proj.bias" in sd:  # qwen2/starcoder2 qkv bias
             attn["q_proj"]["bias"] = _t(sd[pre + "self_attn.q_proj.bias"]).reshape(h, dh)
             attn["k_proj"]["bias"] = _t(sd[pre + "self_attn.k_proj.bias"]).reshape(hk, dh)
             attn["v_proj"]["bias"] = _t(sd[pre + "self_attn.v_proj.bias"]).reshape(hk, dh)
+        if pre + "self_attn.o_proj.bias" in sd:  # starcoder2
+            attn["o_proj"]["bias"] = _t(sd[pre + "self_attn.o_proj.bias"])
         layer = {
             "attn": attn,
-            "attn_norm": {"scale": _t(sd[pre + "input_layernorm.weight"])},
-            "mlp_norm": {"scale": _t(sd[pre + "post_attention_layernorm.weight"])},
+            "attn_norm": norm_p(pre + "input_layernorm"),
+            "mlp_norm": norm_p(pre + "post_attention_layernorm"),
         }
         if cfg.num_experts > 0 and (
                 i % cfg.moe_every == cfg.moe_offset % cfg.moe_every):
@@ -270,6 +349,13 @@ def _llama_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
                     "shared_down_proj": _t(sd[sh + "down_proj.weight"]).T,
                     "shared_router": _t(sd[pre + "mlp.shared_expert_gate.weight"]).T,
                 }
+        elif pre + "mlp.c_fc.weight" in sd:  # starcoder2 non-gated mlp
+            mlp = {"up_proj": {"kernel": _t(sd[pre + "mlp.c_fc.weight"]).T},
+                   "down_proj": {"kernel": _t(sd[pre + "mlp.c_proj.weight"]).T}}
+            if pre + "mlp.c_fc.bias" in sd:  # use_bias=False has none
+                mlp["up_proj"]["bias"] = _t(sd[pre + "mlp.c_fc.bias"])
+                mlp["down_proj"]["bias"] = _t(sd[pre + "mlp.c_proj.bias"])
+            layer["mlp"] = mlp
         else:
             layer["mlp"] = {
                 "gate_proj": {"kernel": _t(sd[pre + "mlp.gate_proj.weight"]).T},
@@ -277,7 +363,7 @@ def _llama_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
                 "down_proj": {"kernel": _t(sd[pre + "mlp.down_proj.weight"]).T},
             }
         p[f"layer_{i}"] = layer
-    p["final_norm"] = {"scale": _t(sd["model.norm.weight"])}
+    p["final_norm"] = norm_p("model.norm")
     if not cfg.tie_embeddings:
         p["lm_head"] = {"kernel": _t(sd["lm_head.weight"]).T}
     return p
@@ -773,6 +859,43 @@ def _encoder_params(sd: Dict[str, Any], cfg, keys: Dict[str, Any]
     return p
 
 
+def _mpt_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
+    """MPT: ALiBi, fused Wqkv in [q | k | v] blocks, bias-free everywhere
+    (no_bias=True), exact-erf GELU (reference mpt-class containers)."""
+    h, dh, dm = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    has_bias = cfg.qkv_bias
+    norm_p = lambda key: _norm_p(sd, key)
+    p: Dict[str, Any] = {"embed": {"embedding": _t(sd["transformer.wte.weight"])}}
+    for i in range(cfg.num_layers):
+        pre = f"transformer.blocks.{i}."
+        w = _t(sd[pre + "attn.Wqkv.weight"])                 # [3D, D]
+        qw, kw, vw = (a.T.reshape(dm, h, dh) for a in np.split(w, 3, axis=0))
+        attn = {"q_proj": {"kernel": qw}, "k_proj": {"kernel": kw},
+                "v_proj": {"kernel": vw},
+                "o_proj": {"kernel": _t(sd[pre + "attn.out_proj.weight"]).T
+                           .reshape(h, dh, dm)}}
+        if has_bias:
+            qb, kb, vb = (a.reshape(h, dh) for a in
+                          np.split(_t(sd[pre + "attn.Wqkv.bias"]), 3))
+            attn["q_proj"]["bias"] = qb
+            attn["k_proj"]["bias"] = kb
+            attn["v_proj"]["bias"] = vb
+            attn["o_proj"]["bias"] = _t(sd[pre + "attn.out_proj.bias"])
+        mlp = {"up_proj": {"kernel": _t(sd[pre + "ffn.up_proj.weight"]).T},
+               "down_proj": {"kernel": _t(sd[pre + "ffn.down_proj.weight"]).T}}
+        if has_bias:
+            mlp["up_proj"]["bias"] = _t(sd[pre + "ffn.up_proj.bias"])
+            mlp["down_proj"]["bias"] = _t(sd[pre + "ffn.down_proj.bias"])
+        p[f"layer_{i}"] = {
+            "attn": attn,
+            "attn_norm": norm_p(pre + "norm_1"),
+            "mlp_norm": norm_p(pre + "norm_2"),
+            "mlp": mlp,
+        }
+    p["final_norm"] = norm_p("transformer.norm_f")
+    return p
+
+
 def _clip_text_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
     """CLIPTextModel (reference ``module_inject/containers/clip.py``): pre-LN
     causal text encoder; our Block IS its layer layout (ln1→attn→add,
@@ -832,7 +955,8 @@ def params_from_hf(model_or_state_dict, hf_config=None):
         keys = _BERT_KEYS if mt == "bert" else _DISTILBERT_KEYS
         return cfg, _to_jnp(_encoder_params(sd, cfg, keys))
     cfg = config_from_hf(hf_config)
-    if mt in ("llama", "mistral", "mixtral", "qwen2", "qwen2_moe"):
+    if mt in ("llama", "mistral", "mixtral", "qwen2", "qwen2_moe",
+              "starcoder2", "stablelm"):
         params = _llama_params(sd, cfg)
     elif mt == "phi3":
         params = _phi3_params(sd, cfg)
@@ -850,6 +974,8 @@ def params_from_hf(model_or_state_dict, hf_config=None):
         params = _gpt_neo_params(sd, cfg)
     elif mt == "phi":
         params = _phi_params(sd, cfg)
+    elif mt == "mpt":
+        params = _mpt_params(sd, cfg)
     elif mt == "clip_text_model":
         params = _clip_text_params(sd, cfg)
     else:

@@ -4,9 +4,9 @@ Reference: ``module_inject/containers/megatron_gpt.py`` (+
 ``megatron_gpt_moe.py``) inject fused kernels into Megatron-LM GPT models,
 and ``runtime/state_dict_factory.py`` MegatronSDLoader re-partitions their
 TP shards — including the checkpoint-version switch for the fused
-query-key-value head layout (``split_query_key_value:258``: ckpt_ver < 2
-stores per-head ``[q, k, v]`` interleaved, >= 2 stores ``[q | k | v]``
-blocks).
+query-key-value head layout (``split_query_key_value:277``: ckpt_ver 0
+stores ``[q | k | v]`` blocks, 1.0 per-(head, row) triples, 2.0 per-head
+``[q, k, v]`` — 1.0/2.0 TP-split as a plain slice).
 
 TPU-native flow: merge raw TP shards with
 ``checkpoint.state_dict_factory.SDLoader`` (which already speaks both QKV
@@ -44,18 +44,27 @@ def megatron_config(args: Dict[str, Any]) -> TransformerConfig:
 
 def _split_qkv(w, b, cfg: TransformerConfig, version: int):
     """Un-fuse query_key_value per the checkpoint version (reference
-    ``split_query_key_value``). w: [3*H*Dh, D]; b: [3*H*Dh] or None."""
+    ``split_query_key_value``, ``state_dict_factory.py:277``):
+    v0 = ``[(3*H*Dh), D]`` blocks [q | k | v]; v1.0 = ``[(H*Dh*3), D]``
+    per-(head, row) triple; v2.0 = ``[(H*3*Dh), D]`` per-head [q, k, v].
+    w: [3*H*Dh, D]; b: [3*H*Dh] or None."""
     h, dh, dm = cfg.num_heads, cfg.head_dim, cfg.hidden_size
-    if version < 2:  # per-head [q, k, v] interleaved
+    if version == 0:  # [q | k | v] blocks
+        qw, kw, vw = (a.reshape(h, dh, dm) for a in np.split(w, 3, axis=0))
+        if b is not None:
+            qb, kb, vb = (a.reshape(h, dh) for a in np.split(b, 3))
+    elif version == 1:  # [h, dh, 3]
+        w = w.reshape(h, dh, 3, dm)
+        qw, kw, vw = w[:, :, 0], w[:, :, 1], w[:, :, 2]   # [h, dh, D]
+        if b is not None:
+            b = b.reshape(h, dh, 3)
+            qb, kb, vb = b[:, :, 0], b[:, :, 1], b[:, :, 2]
+    else:             # v2.0: per-head [q, k, v] blocks of dh
         w = w.reshape(h, 3, dh, dm)
         qw, kw, vw = w[:, 0], w[:, 1], w[:, 2]            # [h, dh, D]
         if b is not None:
             b = b.reshape(h, 3, dh)
             qb, kb, vb = b[:, 0], b[:, 1], b[:, 2]
-    else:            # [q | k | v] blocks
-        qw, kw, vw = (a.reshape(h, dh, dm) for a in np.split(w, 3, axis=0))
-        if b is not None:
-            qb, kb, vb = (a.reshape(h, dh) for a in np.split(b, 3))
     to_flax = lambda a: np.ascontiguousarray(np.transpose(a, (2, 0, 1)))
     out = {
         "q_proj": {"kernel": to_flax(qw)},
@@ -129,19 +138,23 @@ def params_to_megatron(params: Dict[str, Any], cfg: TransformerConfig,
         # flax [D, h, dh] -> megatron rows [h, dh, D]
         rows = lambda n: np.transpose(a(lp["attn"][n]["kernel"]), (1, 2, 0))
         qw, kw, vw = rows("q_proj"), rows("k_proj"), rows("v_proj")
+        bias_of = lambda n: a(lp["attn"][n]["bias"])
         has_b = "bias" in lp["attn"]["q_proj"]
-        if version < 2:
-            w = np.stack([qw, kw, vw], axis=1).reshape(3 * h * dh, dm)
-            if has_b:
-                b = np.stack([a(lp["attn"]["q_proj"]["bias"]),
-                              a(lp["attn"]["k_proj"]["bias"]),
-                              a(lp["attn"]["v_proj"]["bias"])],
-                             axis=1).reshape(3 * h * dh)
-        else:
+        if version == 0:   # [q | k | v] blocks
             w = np.concatenate([x.reshape(h * dh, dm) for x in (qw, kw, vw)])
             if has_b:
-                b = np.concatenate([a(lp["attn"][n]["bias"]).reshape(h * dh)
+                b = np.concatenate([bias_of(n).reshape(h * dh)
                                     for n in ("q_proj", "k_proj", "v_proj")])
+        elif version == 1:  # [h, dh, 3]
+            w = np.stack([qw, kw, vw], axis=2).reshape(3 * h * dh, dm)
+            if has_b:
+                b = np.stack([bias_of("q_proj"), bias_of("k_proj"),
+                              bias_of("v_proj")], axis=2).reshape(3 * h * dh)
+        else:               # v2.0: per-head [q, k, v]
+            w = np.stack([qw, kw, vw], axis=1).reshape(3 * h * dh, dm)
+            if has_b:
+                b = np.stack([bias_of("q_proj"), bias_of("k_proj"),
+                              bias_of("v_proj")], axis=1).reshape(3 * h * dh)
         sd[pre + "attention.query_key_value.weight"] = np.ascontiguousarray(w)
         if has_b:
             sd[pre + "attention.query_key_value.bias"] = np.ascontiguousarray(b)

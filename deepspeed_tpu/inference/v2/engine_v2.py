@@ -292,17 +292,27 @@ class InferenceEngineV2:
         """
         c = self.config
         if total_steps > c.max_fused_window:
-            # bound the fused window (see max_fused_window); chunked calls
-            # reuse one compiled program per distinct window size
+            # Bound the fused window (see max_fused_window). The whole run's
+            # step count is capped ONCE by the min remaining budget across
+            # the sequences active NOW, so chunking is observationally
+            # identical to a single dispatch (a per-chunk re-min would keep
+            # generating for budget-rich sequences after a budget-poor one
+            # finished, which one big dispatch never does).
+            live = [s for s in self.state_manager.all() if not s.done]
+            if not live:
+                return {}
+            total = min(total_steps,
+                        min(s.max_new_tokens - len(s.generated) for s in live))
             out: Dict[int, List[int]] = {}
-            remaining = total_steps
-            while remaining > 0:
-                got = self.decode_stream(min(remaining, c.max_fused_window))
+            produced = 0
+            while produced < total:
+                n = min(c.max_fused_window, total - produced)
+                got = self.decode_stream(n)
                 if not got:
                     break
                 for uid, toks in got.items():
                     out.setdefault(uid, []).extend(toks)
-                remaining -= c.max_fused_window
+                produced += n
             return out
         seqs = [s for s in self.state_manager.all() if not s.done]
         if not seqs:

@@ -38,6 +38,7 @@ class TransformerConfig:
     max_seq_len: int = 2048
     # family switches
     norm: str = "rmsnorm"                       # rmsnorm (llama) | layernorm (gpt2)
+    norm_bias: bool = True                      # mpt: LayerNorm without bias
     activation: str = "swiglu"                  # swiglu | gelu | relu | quick_gelu (clip)
     position: str = "rope"                      # rope (llama) | learned (gpt2) | alibi (falcon-rw)
     tie_embeddings: bool = False
@@ -55,6 +56,9 @@ class TransformerConfig:
     rotary_interleaved: bool = False            # gpt-j rotate-every-two pairs
     pos_offset: int = 0                         # OPT: learned pos ids offset 2
     embed_norm: bool = False                    # bloom word_embeddings_layernorm
+    # falcon/bloom add the ALiBi bias BEFORE the 1/sqrt(d) scaling (the
+    # slope is effectively scaled); MPT adds it AFTER (raw slope)
+    alibi_post_scale: bool = False
     lm_head_bias: bool = False                  # gpt-j / phi biased lm_head
     no_lm_head: bool = False                    # clip text encoder: return hidden states
     attn_scale: Optional[float] = None          # gpt-neo trains UNSCALED (1.0)
@@ -115,7 +119,8 @@ class TransformerConfig:
 def _norm(cfg, name):
     if cfg.norm == "rmsnorm":
         return nn.RMSNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name=name)
-    return nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name=name)
+    return nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype,
+                        use_bias=cfg.norm_bias, name=name)
 
 
 def rope_table(seq_len: int, head_dim: int, theta: float):
@@ -154,9 +159,11 @@ def apply_rope(x, cos, sin, positions=None, interleaved: bool = False):
     return out.astype(x.dtype)
 
 
-def alibi_slopes(num_heads: int) -> np.ndarray:
+def alibi_slopes(num_heads: int, bf16_round: bool = True) -> np.ndarray:
     """ALiBi per-head slopes (Press et al.; matches the HF implementation
-    used by falcon/bloom — geometric in 2^(-8/n), extended for non-pow2)."""
+    used by falcon/bloom — geometric in 2^(-8/n), extended for non-pow2).
+    ``bf16_round``: HF falcon/bloom round the slopes through bfloat16; MPT
+    computes them in fp32 (matters only for non-power-of-2 head counts)."""
     def pow2_slopes(n):
         start = 2.0 ** (-(2.0 ** -(np.log2(n) - 3)))
         return start * (start ** np.arange(n))
@@ -166,6 +173,8 @@ def alibi_slopes(num_heads: int) -> np.ndarray:
     if n2 != num_heads:
         extra = pow2_slopes(2 * n2)[0::2][: num_heads - n2]
         slopes = np.concatenate([slopes, extra])
+    if not bf16_round:
+        return slopes.astype(np.float32)
     # HF build_alibi_tensor rounds the slopes through bfloat16 — match it so
     # converted checkpoints reproduce logits bit-closely
     import ml_dtypes
@@ -175,7 +184,7 @@ def alibi_slopes(num_heads: int) -> np.ndarray:
 
 def attention_core(q, k, v, *, causal: bool = True, impl: str = "auto",
                    positions_q=None, positions_kv=None, alibi=None,
-                   scale=None, window=None):
+                   scale=None, window=None, alibi_post_scale=False):
     """[B, S, H, D] attention. ``flash`` uses the Pallas kernel on TPU;
     ``xla`` is the jnp reference (fused well by XLA on small shapes).
     ``alibi``: per-head slopes [H] — adds ``-slope * (pos_q - pos_k)`` to the
@@ -202,9 +211,11 @@ def attention_core(q, k, v, *, causal: bool = True, impl: str = "auto",
     if alibi is not None:
         # falcon/bloom apply the bias BEFORE the 1/sqrt(d) scaling (HF
         # modeling_falcon.py: (scores + alibi) * inv_norm_factor) — fold the
-        # scale into the slope to match
+        # scale into the slope to match; MPT adds the raw slope AFTER
+        # scaling (modeling_mpt: qk * softmax_scale + alibi)
+        sl_factor = 1.0 if alibi_post_scale else scale
         dist = (pq - pk).astype(jnp.float32)                 # [sq, skv]
-        logits = logits - (scale * jnp.asarray(alibi))[None, :, None, None] * dist[None, None]
+        logits = logits - (sl_factor * jnp.asarray(alibi))[None, :, None, None] * dist[None, None]
     if causal:
         mask = pq >= pk  # [sq, skv]
         if window is not None:
@@ -225,7 +236,7 @@ def _update_cache(cache_kv, new_kv, cache_index):
 
 
 def cached_attention(q, k_cache, v_cache, q_pos, alibi=None, scale=None,
-                     window=None):
+                     window=None, alibi_post_scale=False):
     """Decode attention over the full KV cache with per-sequence validity:
     cache slot j attends iff ``j <= q_pos`` (absolute position), which also
     masks unwritten slots. q: [B,S,H,D]; caches: [B,M,Hk,D]; q_pos: [B,S].
@@ -240,9 +251,10 @@ def cached_attention(q, k_cache, v_cache, q_pos, alibi=None, scale=None,
                         preferred_element_type=jnp.float32) * scale
     slot = jnp.arange(m)[None, None, None, None, :]
     if alibi is not None:
-        # pre-scaling bias convention (see attention_core)
+        # pre- vs post-scaling bias convention (see attention_core)
+        sl_factor = 1.0 if alibi_post_scale else scale
         dist = (q_pos[:, None, None, :, None] - slot).astype(jnp.float32)
-        sl = scale * jnp.asarray(alibi).reshape(hk, rep)
+        sl = sl_factor * jnp.asarray(alibi).reshape(hk, rep)
         logits = logits - sl[None, :, :, None, None] * dist
     mask = slot <= q_pos[:, None, None, :, None]
     if window is not None:
@@ -272,7 +284,10 @@ class Attention(nn.Module):
 
         if cfg.position == "rope":
             cos, sin = rope_table(cfg.max_seq_len, cfg.rotary_dim, cfg.rope_theta)
-        alibi = alibi_slopes(h) if cfg.position == "alibi" else None
+        # mpt (alibi_post_scale) computes slopes in fp32; falcon/bloom round
+        # them through bf16 — follow each family's convention
+        alibi = (alibi_slopes(h, bf16_round=not cfg.alibi_post_scale)
+                 if cfg.position == "alibi" else None)
 
         o_proj = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
                                  use_bias=cfg.out_bias, dtype=cfg.dtype,
@@ -293,11 +308,13 @@ class Attention(nn.Module):
                 # whole_prefill promise, chunked multi-token calls take the
                 # full-cache path, which is correct for any cache_index.
                 out = attention_core(q, k, v, causal=True, impl="xla",
-                                     alibi=alibi, scale=scale, window=window)
+                                     alibi=alibi, scale=scale, window=window,
+                                     alibi_post_scale=cfg.alibi_post_scale)
             else:
                 out = cached_attention(q, new_cache["k"], new_cache["v"],
                                        positions, alibi=alibi, scale=scale,
-                                       window=window)
+                                       window=window,
+                                       alibi_post_scale=cfg.alibi_post_scale)
             return o_proj(out), new_cache
 
         impl = cfg.attn_impl
@@ -347,7 +364,8 @@ class Attention(nn.Module):
                 q = rope(q, cos, sin)
                 k = rope(k, cos, sin)
             out = attention_core(q, k, v, causal=True, impl=impl, alibi=alibi,
-                                 scale=scale, window=window)
+                                 scale=scale, window=window,
+                                 alibi_post_scale=cfg.alibi_post_scale)
 
         out = o_proj(out)
         if cfg.dropout > 0 and not deterministic:
@@ -375,6 +393,8 @@ class MLP(nn.Module):
                 hidden = nn.relu(hidden)
             elif cfg.activation == "quick_gelu":  # clip: x * sigmoid(1.702 x)
                 hidden = hidden * nn.sigmoid(1.702 * hidden)
+            elif cfg.activation == "gelu_exact":  # mpt: erf gelu, not tanh
+                hidden = nn.gelu(hidden, approximate=False)
             else:
                 hidden = nn.gelu(hidden)
         return nn.Dense(cfg.hidden_size, use_bias=bias, dtype=cfg.dtype,

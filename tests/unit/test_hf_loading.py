@@ -274,7 +274,7 @@ def test_phi_parity():
             attn_qkv_bias=True, lm_head_bias=True)
 
 
-@pytest.mark.parametrize("family", ["bloom", "gptj", "gpt_neo"])
+@pytest.mark.parametrize("family", ["bloom", "gptj", "gpt_neo", "mpt"])
 def test_round3_family_generate_matches_hf(family):
     """Greedy decode parity for the new cache paths (alibi cache, interleaved
     rotary cache, windowed cached attention)."""
@@ -288,6 +288,12 @@ def test_round3_family_generate_matches_hf(family):
             vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
             rotary_dim=8, resid_pdrop=0.0, embd_pdrop=0.0,
             attn_pdrop=0.0)).eval()
+    elif family == "mpt":
+        hf = transformers.MptForCausalLM(transformers.MptConfig(
+            vocab_size=128, d_model=64, n_layers=2, n_heads=4, max_seq_len=64,
+            no_bias=True,
+            attn_config=transformers.models.mpt.configuration_mpt
+            .MptAttentionConfig(alibi=True, attn_pdrop=0.0))).eval()
     else:
         hf = transformers.GPTNeoForCausalLM(transformers.GPTNeoConfig(
             vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
@@ -380,6 +386,19 @@ def test_qwen2_moe_sparse_step_phase():
     _logits_close(ours, ref)
 
 
+def test_mpt_nonpow2_heads_parity():
+    """Non-power-of-2 heads: MPT computes ALiBi slopes in fp32 (falcon/bloom
+    round through bf16) — parity pins the per-family precision convention."""
+    hf_cfg = transformers.MptConfig(
+        vocab_size=128, d_model=96, n_layers=2, n_heads=6, max_seq_len=64,
+        no_bias=True,
+        attn_config=transformers.models.mpt.configuration_mpt.MptAttentionConfig(
+            alibi=True, attn_pdrop=0.0))
+    torch.manual_seed(27)
+    _golden(transformers.MptForCausalLM(hf_cfg).eval(), 128, seed=27,
+            position="alibi", alibi_post_scale=True)
+
+
 def test_clip_text_parity():
     """CLIP text encoder: quick_gelu pre-LN causal encoder, hidden states
     (no LM head) — reference module_inject/containers/clip.py."""
@@ -409,3 +428,46 @@ def test_falcon_bias_parity():
     torch.manual_seed(12)
     _golden(transformers.FalconForCausalLM(hf_cfg).eval(), 128, seed=12,
             attn_qkv_bias=True, mlp_bias=True)
+
+
+def test_starcoder2_parity():
+    """llama naming + biased LayerNorm blocks + non-gated c_fc/c_proj MLP
+    (tanh gelu) + GQA + tied embeddings."""
+    hf_cfg = transformers.Starcoder2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, use_bias=True, sliding_window=None,
+        tie_word_embeddings=True, residual_dropout=0.0, embedding_dropout=0.0,
+        attention_dropout=0.0)
+    torch.manual_seed(24)
+    _golden(transformers.Starcoder2ForCausalLM(hf_cfg).eval(), 128, seed=24,
+            norm="layernorm", activation="gelu", attn_qkv_bias=True,
+            tie_embeddings=True)
+
+
+def test_stablelm_parity():
+    """LayerNorm + silu-gated MLP + partial rotary (0.25)."""
+    hf_cfg = transformers.StableLmConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, partial_rotary_factor=0.5,
+        use_qkv_bias=False, use_parallel_residual=False, qk_layernorm=False,
+        tie_word_embeddings=False, attention_dropout=0.0, hidden_dropout=0.0)
+    torch.manual_seed(25)
+    _golden(transformers.StableLmForCausalLM(hf_cfg).eval(), 128, seed=25,
+            norm="layernorm", activation="swiglu", rotary_pct=0.5,
+            attn_qkv_bias=False)
+
+
+def test_mpt_parity():
+    """ALiBi + fused block Wqkv + bias-free Linears AND LayerNorms + exact
+    erf gelu."""
+    hf_cfg = transformers.MptConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, max_seq_len=64,
+        no_bias=True,
+        attn_config=transformers.models.mpt.configuration_mpt.MptAttentionConfig(
+            alibi=True, attn_pdrop=0.0))
+    torch.manual_seed(26)
+    _golden(transformers.MptForCausalLM(hf_cfg).eval(), 128, seed=26,
+            norm="layernorm", activation="gelu_exact", position="alibi",
+            norm_bias=False, tie_embeddings=True)

@@ -33,11 +33,11 @@ def test_config_mapping():
     assert cfg.tie_embeddings and cfg.qkv_bias and cfg.out_bias
 
 
-@pytest.mark.parametrize("version", [0, 2])
+@pytest.mark.parametrize("version", [0, 1, 2])
 def test_roundtrip_preserves_logits(version):
     """params -> megatron sd (per checkpoint version) -> params must be an
-    exact logits round-trip; v0 (interleaved) and v2 (block) layouts must
-    both decode to the same model."""
+    exact logits round-trip across all three reference layouts (v0 blocks,
+    v1 per-row triples, v2 per-head groups)."""
     cfg, model, params = make_model()
     toks = jnp.asarray(np.random.default_rng(0).integers(0, 96, (2, 10)),
                        jnp.int32)
@@ -75,10 +75,10 @@ def test_tp_sharded_megatron_checkpoint_via_sd_loader():
     full_sd = params_to_megatron(params, cfg, version=2)
     from deepspeed_tpu.checkpoint.state_dict_factory import split_state_dict
 
-    # fused-qkv handling covers weights AND biases (their [3*H*Dh] dim has
-    # the same per-third layout) — same set _auto_qkv detects
+    # v2.0 layout is whole-head contiguous: TP split is a plain slice
+    # ("interleaved" handling); fused-qkv covers weights AND biases
     shards = [split_state_dict(full_sd, r, 2, num_heads=cfg.num_heads,
-                               qkv_leaves={k: "concat" for k in full_sd
+                               qkv_leaves={k: "interleaved" for k in full_sd
                                            if "query_key_value" in k})
               for r in range(2)]
     loader = SDLoader(shards, version=2, num_heads=cfg.num_heads)

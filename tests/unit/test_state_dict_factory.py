@@ -177,9 +177,11 @@ class TestSDLoader:
         np.testing.assert_array_equal(merged["k_proj"]["kernel"],
                                       sd["k_proj"]["kernel"])
 
-    def test_version_zero_is_interleaved(self):
+    def test_qkv_layout_by_checkpoint_version(self):
+        """Reference state_dict_factory.py:220: v0 = [q|k|v] blocks (concat
+        split), v1.0/v2.0 = whole-head-contiguous (plain slice)."""
         from deepspeed_tpu.checkpoint.state_dict_factory import SDLoader
-        assert SDLoader([{}], version=0).qkv_layout == "interleaved"
+        assert SDLoader([{}], version=0).qkv_layout == "concat"
         assert SDLoader([{}], version=1).qkv_layout == "interleaved"
-        assert SDLoader([{}], version=2).qkv_layout == "concat"
-        assert SDLoader([{}], version=None).qkv_layout == "concat"
+        assert SDLoader([{}], version=2).qkv_layout == "interleaved"
+        assert SDLoader([{}], version=None).qkv_layout == "interleaved"
