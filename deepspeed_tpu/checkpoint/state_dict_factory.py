@@ -14,11 +14,12 @@ Merging N shards = concatenating each leaf along its sharded dim; splitting =
 host-side slicing (never materializing on device), so a 70B checkpoint
 re-partitions with O(one leaf) peak memory above the shard files.
 
-Fused-QKV layouts (the reference's version switch) are expressed as an
-explicit ``qkv_layout`` per leaf: ``"concat"`` ([q;k;v] blocks — Megatron
-ckpt_ver>=2 / llama-style) or ``"interleaved"`` (per-head [q,k,v] interleave —
-bloom/older Megatron), each sliced head-group-contiguously so every TP rank
-gets whole heads.
+Fused-QKV layouts (the reference's version switch,
+``split_query_key_value:277``) are expressed as an explicit ``qkv_layout``
+per leaf: ``"concat"`` ([q | k | v] blocks — Megatron ckpt_ver 0, each third
+sliced separately) or ``"interleaved"`` (whole-head-contiguous groups —
+Megatron ckpt_ver 1.0/2.0, bloom/neox; a plain contiguous slice keeps whole
+heads).
 """
 
 from __future__ import annotations
@@ -48,11 +49,11 @@ def split_qkv(value: np.ndarray, rank: int, size: int, *, num_heads: int,
     """Slice one fused-QKV weight so each rank gets whole heads of q, k, v.
 
     ``concat``: the fused dim is [q_heads | k_heads | v_heads] — each third
-    is sliced independently and re-concatenated (reference ckpt_ver>=2 path,
-    ``split_query_key_value:283``).
+    is sliced independently and re-concatenated (reference ckpt_ver==0 path,
+    ``split_query_key_value:279``).
     ``interleaved``: the fused dim is [h0:(q,k,v), h1:(q,k,v), ...] — a plain
     contiguous slice keeps whole (q,k,v) head groups together (reference
-    ckpt_ver<2 path).
+    ckpt_ver 1.0/2.0 path, ``:292``).
     """
     dim = dim % value.ndim
     n = value.shape[dim]

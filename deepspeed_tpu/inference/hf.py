@@ -185,9 +185,11 @@ def config_from_hf(hf_config) -> TransformerConfig:
             attn_qkv_bias=False, attn_out_bias=True, mlp_bias=True,
             tie_embeddings=True)
     if mt == "starcoder2":
-        if d.get("sliding_window") not in (None, 0):
-            raise ValueError("starcoder2 sliding_window is not supported")
+        sw = d.get("sliding_window")
         return TransformerConfig(
+            # sliding window (all released checkpoints: 4096) = a uniform
+            # local-attention window on every layer
+            layer_windows=((sw,) * d["num_hidden_layers"]) if sw else None,
             vocab_size=d["vocab_size"], hidden_size=d["hidden_size"],
             intermediate_size=d["intermediate_size"],
             num_layers=d["num_hidden_layers"], num_heads=d["num_attention_heads"],
@@ -861,9 +863,9 @@ def _encoder_params(sd: Dict[str, Any], cfg, keys: Dict[str, Any]
 
 def _mpt_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
     """MPT: ALiBi, fused Wqkv in [q | k | v] blocks, bias-free everywhere
-    (no_bias=True), exact-erf GELU (reference mpt-class containers)."""
+    (HF modeling_mpt hardcodes bias-free Linears and biasless norms),
+    exact-erf GELU (reference mpt-class containers)."""
     h, dh, dm = cfg.num_heads, cfg.head_dim, cfg.hidden_size
-    has_bias = cfg.qkv_bias
     norm_p = lambda key: _norm_p(sd, key)
     p: Dict[str, Any] = {"embed": {"embedding": _t(sd["transformer.wte.weight"])}}
     for i in range(cfg.num_layers):
@@ -874,18 +876,8 @@ def _mpt_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
                 "v_proj": {"kernel": vw},
                 "o_proj": {"kernel": _t(sd[pre + "attn.out_proj.weight"]).T
                            .reshape(h, dh, dm)}}
-        if has_bias:
-            qb, kb, vb = (a.reshape(h, dh) for a in
-                          np.split(_t(sd[pre + "attn.Wqkv.bias"]), 3))
-            attn["q_proj"]["bias"] = qb
-            attn["k_proj"]["bias"] = kb
-            attn["v_proj"]["bias"] = vb
-            attn["o_proj"]["bias"] = _t(sd[pre + "attn.out_proj.bias"])
         mlp = {"up_proj": {"kernel": _t(sd[pre + "ffn.up_proj.weight"]).T},
                "down_proj": {"kernel": _t(sd[pre + "ffn.down_proj.weight"]).T}}
-        if has_bias:
-            mlp["up_proj"]["bias"] = _t(sd[pre + "ffn.up_proj.bias"])
-            mlp["down_proj"]["bias"] = _t(sd[pre + "ffn.down_proj.bias"])
         p[f"layer_{i}"] = {
             "attn": attn,
             "attn_norm": norm_p(pre + "norm_1"),

@@ -70,6 +70,36 @@ def _qkv(cfg, ap, y, rope_cs, positions):
     return qt, kt, vt
 
 
+def _moe_mlp(cfg, lp, y):
+    """MoE block over a flat token buffer [T, D] (reference FastGen MoE
+    models: mixtral / qwen2_moe via ``moe_scatter``/``moe_gather`` +
+    cutlass ``moe_gemm``). Serving uses the dropless grouped-GEMM path —
+    exact dense routing, no capacity drops."""
+    from ...moe.sharded_moe import dropless_moe
+
+    logits = y.astype(jnp.float32) @ lp["router"]["kernel"].astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)                     # [T, E]
+    out = dropless_moe(y[None], gates[None], cfg.moe_top_k,
+                       lp.get("expert_gate_proj"), lp["expert_up_proj"],
+                       lp["expert_down_proj"], activation=cfg.activation,
+                       norm_topk=cfg.moe_norm_topk)[0]
+    out = out.astype(y.dtype)
+    if "shared_gate_proj" in lp:  # qwen2_moe always-on shared expert
+        h = (jax.nn.silu(y @ lp["shared_gate_proj"].astype(y.dtype))
+             * (y @ lp["shared_up_proj"].astype(y.dtype)))
+        mod = jax.nn.sigmoid(
+            y.astype(jnp.float32) @ lp["shared_router"].astype(jnp.float32))
+        out = out + (h @ lp["shared_down_proj"].astype(y.dtype)) * mod.astype(y.dtype)
+    return out
+
+
+def _ffn(cfg, lp, y):
+    """Dense MLP or MoE, by layer params."""
+    if "moe" in lp:
+        return _moe_mlp(cfg, lp["moe"], y)
+    return _mlp(cfg, lp["mlp"], y)
+
+
 def _mlp(cfg, mp, y):
     if cfg.activation == "swiglu":
         hid = jax.nn.silu(_dense(mp["gate_proj"], y)) * _dense(mp["up_proj"], y)
@@ -208,7 +238,7 @@ def _ragged_forward_impl(params, cfg: TransformerConfig, kv_k, kv_v, tokens,
         attn_tok = flat[:T]
         attn_out = _dense_multi_in(ap["o_proj"], attn_tok)          # [T, H]
         x = x + attn_out
-        x = x + _mlp(cfg, lp["mlp"], _norm(cfg, lp["mlp_norm"], x))
+        x = x + _ffn(cfg, lp, _norm(cfg, lp["mlp_norm"], x))
 
     x = _norm(cfg, params["final_norm"], x)
     # logits only at the sample positions (reference logits_gather kernel);
@@ -358,7 +388,7 @@ def decode_loop(params, cfg: TransformerConfig, kv_k, kv_v, tokens0, pos0,
                                      o2, m2, l2)
             attn_tok = merged.reshape(S, Hq, D).astype(dtype)
             x = x + _dense_multi_in(ap["o_proj"], attn_tok)
-            x = x + _mlp(cfg, lp["mlp"], _norm(cfg, lp["mlp_norm"], x))
+            x = x + _ffn(cfg, lp, _norm(cfg, lp["mlp_norm"], x))
         x = _norm(cfg, params["final_norm"], x)
         logits = _lm_logits(cfg, params, x)
         return logits, wk, wv

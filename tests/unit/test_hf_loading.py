@@ -445,6 +445,21 @@ def test_starcoder2_parity():
             tie_embeddings=True)
 
 
+def test_starcoder2_sliding_window_parity():
+    """sliding_window maps to a uniform per-layer local-attention window —
+    checked with a window SMALLER than the sequence so masking bites."""
+    hf_cfg = transformers.Starcoder2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, use_bias=True, sliding_window=4,
+        tie_word_embeddings=True, residual_dropout=0.0, embedding_dropout=0.0,
+        attention_dropout=0.0, attn_implementation="eager")
+    torch.manual_seed(28)
+    cfg = _golden(transformers.Starcoder2ForCausalLM(hf_cfg).eval(), 128,
+                  seed=28, seq=12)
+    assert cfg.layer_windows == (4, 4)
+
+
 def test_stablelm_parity():
     """LayerNorm + silu-gated MLP + partial rotary (0.25)."""
     hf_cfg = transformers.StableLmConfig(
