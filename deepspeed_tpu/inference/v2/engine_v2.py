@@ -154,6 +154,21 @@ class InferenceEngineV2:
     def has_work(self) -> bool:
         return any((s.in_prefill or (not s.done)) for s in self.state_manager.all())
 
+    def _slice_block_table(self, bt: np.ndarray, pos0: np.ndarray,
+                           n: int) -> np.ndarray:
+        """Slice the table to the pages this decode window can touch.
+
+        The gather attention reads EVERY table column, so a short context in
+        a long table (max_blocks_per_seq sized for max_seq_len) would read
+        mostly trash pages. The page count is static per dispatch; rounding
+        it up to a power of two caps the distinct compiled programs at
+        log2(max_blocks_per_seq) as generation grows across windows.
+        """
+        bs = self.config.kv_block_size
+        b_need = max(1, -(-(int(pos0.max()) + n) // bs))
+        b_need = 1 << (b_need - 1).bit_length()
+        return bt[:, :min(bt.shape[1], b_need)]
+
     # ------------------------------------------------------------------
     # one engine step: schedule -> pack -> forward -> sample
     # ------------------------------------------------------------------
@@ -244,6 +259,7 @@ class InferenceEngineV2:
         if n < 1:
             return {}
         S, B = c.max_ragged_sequence_count, c.max_blocks_per_seq
+        bs = c.kv_block_size
         tokens0 = np.zeros((S,), np.int32)
         pos0 = np.zeros((S,), np.int32)
         bt = np.zeros((S, B), np.int32)
@@ -254,6 +270,7 @@ class InferenceEngineV2:
             pos0[slot] = seq.seen_tokens
             bt[slot, :len(seq.blocks)] = seq.blocks
             active[slot] = True
+        bt = self._slice_block_table(bt, pos0, n)
         self._key, step_key = jax.random.split(self._key)
         toks, new_k, new_v = decode_loop(
             self.params, self.cfg, self.kv.k, self.kv.v,
@@ -344,6 +361,7 @@ class InferenceEngineV2:
             pos0[slot] = seq.seen_tokens
             bt[slot, :len(seq.blocks)] = seq.blocks
             active[slot] = True
+        bt = self._slice_block_table(bt, pos0, n)
         self._key, step_key = jax.random.split(self._key)
         toks, new_k, new_v = decode_loop(
             self.params, self.cfg, self.kv.k, self.kv.v,
