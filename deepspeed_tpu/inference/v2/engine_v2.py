@@ -259,7 +259,6 @@ class InferenceEngineV2:
         if n < 1:
             return {}
         S, B = c.max_ragged_sequence_count, c.max_blocks_per_seq
-        bs = c.kv_block_size
         tokens0 = np.zeros((S,), np.int32)
         pos0 = np.zeros((S,), np.int32)
         bt = np.zeros((S, B), np.int32)
