@@ -27,9 +27,19 @@ _PRE = "model.language_model."
 def megatron_config(args: Dict[str, Any]) -> TransformerConfig:
     """Map Megatron-LM ``args`` (as stored in its checkpoints) to our config.
     Classic GPT: learned positions, LayerNorm, (tanh) GELU, tied embeddings.
+    DeepSpeed-MoE training (reference ``megatron_gpt_moe`` container): pass
+    ``num_experts``/``top_k`` for checkpoints whose MLPs are ``MoE`` layers.
     """
     d = dict(args)
+    ne = d.get("num_experts", 0) or 0
+    if isinstance(ne, (list, tuple)):  # Megatron-DeepSpeed --num-experts nargs='+'
+        if len(set(ne)) > 1:
+            raise ValueError(f"per-layer expert counts {ne} are not supported")
+        ne = ne[0] if ne else 0
     return TransformerConfig(
+        num_experts=int(ne),
+        # DeepSpeed-MoE --topk defaults to 1 (reference arguments)
+        moe_top_k=int(d.get("top_k", d.get("topk", 1))),
         vocab_size=d["padded_vocab_size"] if "padded_vocab_size" in d
         else d["vocab_size"],
         hidden_size=d["hidden_size"],
@@ -103,19 +113,46 @@ def megatron_params(sd: Dict[str, Any], cfg: TransformerConfig,
             "kernel": np.ascontiguousarray(
                 t(pre + "attention.dense.weight").T.reshape(h, dh, dm)),
             "bias": t(pre + "attention.dense.bias")}
-        p[f"layer_{i}"] = {
+        layer = {
             "attn": attn,
             "attn_norm": {"scale": t(pre + "input_layernorm.weight"),
                           "bias": t(pre + "input_layernorm.bias")},
             "mlp_norm": {"scale": t(pre + "post_attention_layernorm.weight"),
                          "bias": t(pre + "post_attention_layernorm.bias")},
-            "mlp": {
+        }
+        moe_pre = pre + "mlp.deepspeed_moe."
+        if moe_pre + "gate.wg.weight" in sd:
+            # DeepSpeed-MoE layer (reference moe/layer.py:73: MOELayer with
+            # TopKGate.wg + Experts.deepspeed_experts ParallelMLP copies).
+            # The expert count comes from the CHECKPOINT (router rows), not
+            # the possibly-absent args entry.
+            n_exp = t(moe_pre + "gate.wg.weight").shape[0]
+            if cfg.num_experts and cfg.num_experts != n_exp:
+                raise ValueError(
+                    f"layer {i}: checkpoint has {n_exp} experts but the "
+                    f"config says {cfg.num_experts}")
+            ups, dns, upb, dnb = [], [], [], []
+            for e_i in range(n_exp):
+                ep = moe_pre + f"experts.deepspeed_experts.{e_i}."
+                ups.append(t(ep + "dense_h_to_4h.weight").T)
+                dns.append(t(ep + "dense_4h_to_h.weight").T)
+                upb.append(t(ep + "dense_h_to_4h.bias"))
+                dnb.append(t(ep + "dense_4h_to_h.bias"))
+            layer["moe"] = {
+                "router": {"kernel": t(moe_pre + "gate.wg.weight").T},
+                "expert_up_proj": np.stack(ups),
+                "expert_down_proj": np.stack(dns),
+                "expert_up_bias": np.stack(upb),
+                "expert_down_bias": np.stack(dnb),
+            }
+        else:
+            layer["mlp"] = {
                 "up_proj": {"kernel": t(pre + "mlp.dense_h_to_4h.weight").T,
                             "bias": t(pre + "mlp.dense_h_to_4h.bias")},
                 "down_proj": {"kernel": t(pre + "mlp.dense_4h_to_h.weight").T,
                               "bias": t(pre + "mlp.dense_4h_to_h.bias")},
-            },
-        }
+            }
+        p[f"layer_{i}"] = layer
     p["final_norm"] = {
         "scale": t(_PRE + "transformer.final_layernorm.weight"),
         "bias": t(_PRE + "transformer.final_layernorm.bias")}
@@ -165,12 +202,35 @@ def params_to_megatron(params: Dict[str, Any], cfg: TransformerConfig,
         sd[pre + "input_layernorm.bias"] = a(lp["attn_norm"]["bias"])
         sd[pre + "post_attention_layernorm.weight"] = a(lp["mlp_norm"]["scale"])
         sd[pre + "post_attention_layernorm.bias"] = a(lp["mlp_norm"]["bias"])
-        sd[pre + "mlp.dense_h_to_4h.weight"] = np.ascontiguousarray(
-            a(lp["mlp"]["up_proj"]["kernel"]).T)
-        sd[pre + "mlp.dense_h_to_4h.bias"] = a(lp["mlp"]["up_proj"]["bias"])
-        sd[pre + "mlp.dense_4h_to_h.weight"] = np.ascontiguousarray(
-            a(lp["mlp"]["down_proj"]["kernel"]).T)
-        sd[pre + "mlp.dense_4h_to_h.bias"] = a(lp["mlp"]["down_proj"]["bias"])
+        if "moe" in lp:
+            mp = lp["moe"]
+            if "expert_gate_proj" in mp or "shared_up_proj" in mp:
+                raise ValueError(
+                    "megatron export supports only ParallelMLP-style experts "
+                    "(up/down + biases); gated (swiglu) or shared-expert MoE "
+                    "trees have no Megatron-DeepSpeed representation")
+            if "expert_up_bias" not in mp:
+                raise ValueError(
+                    "megatron ParallelMLP experts carry biases; this MoE "
+                    "tree has none (ffn_bias=False config)")
+            moe_pre = pre + "mlp.deepspeed_moe."
+            sd[moe_pre + "gate.wg.weight"] = np.ascontiguousarray(
+                a(mp["router"]["kernel"]).T)
+            up, down = a(mp["expert_up_proj"]), a(mp["expert_down_proj"])
+            upb, dnb = a(mp["expert_up_bias"]), a(mp["expert_down_bias"])
+            for e_i in range(up.shape[0]):
+                ep = moe_pre + f"experts.deepspeed_experts.{e_i}."
+                sd[ep + "dense_h_to_4h.weight"] = np.ascontiguousarray(up[e_i].T)
+                sd[ep + "dense_h_to_4h.bias"] = upb[e_i]
+                sd[ep + "dense_4h_to_h.weight"] = np.ascontiguousarray(down[e_i].T)
+                sd[ep + "dense_4h_to_h.bias"] = dnb[e_i]
+        else:
+            sd[pre + "mlp.dense_h_to_4h.weight"] = np.ascontiguousarray(
+                a(lp["mlp"]["up_proj"]["kernel"]).T)
+            sd[pre + "mlp.dense_h_to_4h.bias"] = a(lp["mlp"]["up_proj"]["bias"])
+            sd[pre + "mlp.dense_4h_to_h.weight"] = np.ascontiguousarray(
+                a(lp["mlp"]["down_proj"]["kernel"]).T)
+            sd[pre + "mlp.dense_4h_to_h.bias"] = a(lp["mlp"]["down_proj"]["bias"])
     sd[_PRE + "transformer.final_layernorm.weight"] = a(params["final_norm"]["scale"])
     sd[_PRE + "transformer.final_layernorm.bias"] = a(params["final_norm"]["bias"])
     return sd

@@ -82,7 +82,10 @@ def _moe_mlp(cfg, lp, y):
     out = dropless_moe(y[None], gates[None], cfg.moe_top_k,
                        lp.get("expert_gate_proj"), lp["expert_up_proj"],
                        lp["expert_down_proj"], activation=cfg.activation,
-                       norm_topk=cfg.moe_norm_topk)[0]
+                       norm_topk=cfg.moe_norm_topk,
+                       b_up=lp.get("expert_up_bias"),
+                       b_down=lp.get("expert_down_bias"),
+                       b_gate=lp.get("expert_gate_bias"))[0]
     out = out.astype(y.dtype)
     if "shared_gate_proj" in lp:  # qwen2_moe always-on shared expert
         h = (jax.nn.silu(y @ lp["shared_gate_proj"].astype(y.dtype))

@@ -71,6 +71,15 @@ class MoEBlock(nn.Module):
                   if swiglu else None)
         w_up = self.param("expert_up_proj", init, (e, d, f), jnp.float32)
         w_down = self.param("expert_down_proj", init, (e, f, d), jnp.float32)
+        # expert biases (megatron-MoE ParallelMLP experts carry them; the
+        # llama-family MoEs do not) follow the dense-MLP bias heuristic
+        zeros = nn.initializers.zeros
+        b_up = (self.param("expert_up_bias", zeros, (e, f), jnp.float32)
+                if cfg.ffn_bias else None)
+        b_down = (self.param("expert_down_bias", zeros, (e, d), jnp.float32)
+                  if cfg.ffn_bias else None)
+        b_gate = (self.param("expert_gate_bias", zeros, (e, f), jnp.float32)
+                  if cfg.ffn_bias and swiglu else None)
         skip = self.is_initializing()
 
         norm_topk = cfg.moe_norm_topk
@@ -99,7 +108,8 @@ class MoEBlock(nn.Module):
             gates = jax.nn.softmax(logits, axis=-1)
             aux = load_balance_aux(gates)
             y = dropless_moe(x, gates, k, w_gate, w_up, w_down,
-                             activation=cfg.activation, norm_topk=norm_topk)
+                             activation=cfg.activation, norm_topk=norm_topk,
+                             b_up=b_up, b_down=b_down, b_gate=b_gate)
             y = add_shared(y.astype(x.dtype))
             y = _constrain(y, P(("dp_outer", "ep"), None, None), skip)
             return y.astype(x.dtype), aux * cfg.moe_aux_loss_weight
@@ -119,12 +129,18 @@ class MoEBlock(nn.Module):
         expert_in = _constrain(expert_in, P("ep", ("dp_outer",), None, None), skip)
 
         u = jnp.einsum("egcd,edf->egcf", expert_in, w_up.astype(x.dtype))
+        if b_up is not None:
+            u = u + b_up.astype(x.dtype)[:, None, None, :]
         if swiglu:
             h = jnp.einsum("egcd,edf->egcf", expert_in, w_gate.astype(x.dtype))
+            if b_gate is not None:
+                h = h + b_gate.astype(x.dtype)[:, None, None, :]
             h = nn.silu(h) * u
         else:
             h = nn.gelu(u)
         out = jnp.einsum("egcf,efd->egcd", h, w_down.astype(x.dtype))
+        if b_down is not None:
+            out = out + b_down.astype(x.dtype)[:, None, None, :]
         out = _constrain(out, P("ep", ("dp_outer",), None, None), skip)
 
         y = moe_combine(out, combine)

@@ -95,7 +95,9 @@ def moe_combine(expert_out: jnp.ndarray, combine: jnp.ndarray) -> jnp.ndarray:
 def dropless_moe(x: jnp.ndarray, gates: jnp.ndarray, k: int,
                  w_gate: jnp.ndarray, w_up: jnp.ndarray, w_down: jnp.ndarray,
                  activation: str = "swiglu",
-                 norm_topk: bool = True) -> jnp.ndarray:
+                 norm_topk: bool = True,
+                 b_up: jnp.ndarray = None, b_down: jnp.ndarray = None,
+                 b_gate: jnp.ndarray = None) -> jnp.ndarray:
     """Dropless MoE via grouped GEMM (``jax.lax.ragged_dot``).
 
     TPU-native replacement for the reference CUTLASS grouped ``moe_gemm``
@@ -126,13 +128,21 @@ def dropless_moe(x: jnp.ndarray, gates: jnp.ndarray, k: int,
 
     wu = w_up.astype(x.dtype)
     wd = w_down.astype(x.dtype)
+    eid_sorted = eid[order]                                 # expert per row
+    up = jax.lax.ragged_dot(xs, wu, group_sizes)
+    if b_up is not None:  # megatron-MoE experts carry biases
+        up = up + b_up.astype(x.dtype)[eid_sorted]
     if activation == "swiglu":
         wg = w_gate.astype(x.dtype)
-        h = jax.nn.silu(jax.lax.ragged_dot(xs, wg, group_sizes)) * \
-            jax.lax.ragged_dot(xs, wu, group_sizes)
+        gt = jax.lax.ragged_dot(xs, wg, group_sizes)
+        if b_gate is not None:
+            gt = gt + b_gate.astype(x.dtype)[eid_sorted]
+        h = jax.nn.silu(gt) * up
     else:  # w_gate is None for ungated activations
-        h = jax.nn.gelu(jax.lax.ragged_dot(xs, wu, group_sizes))
+        h = jax.nn.gelu(up)
     out = jax.lax.ragged_dot(h, wd, group_sizes)            # [N*k, D]
+    if b_down is not None:
+        out = out + b_down.astype(x.dtype)[eid_sorted]
 
     out = out * wts[order][:, None].astype(out.dtype)
     yf = jnp.zeros((n, d), out.dtype).at[tok_of].add(out)

@@ -137,3 +137,42 @@ def test_ds_to_universal_cli(tmp_path):
     got = model.apply({"params": jax.tree.map(jnp.asarray, back)}, toks)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5,
                                atol=1e-5)
+
+
+def test_moe_roundtrip_preserves_logits():
+    """DeepSpeed-MoE Megatron checkpoints (reference megatron_gpt_moe
+    container: MOELayer gate.wg + Experts.deepspeed_experts ParallelMLPs
+    WITH biases) round-trip to exact logits."""
+    args = {**ARGS, "num_experts": 4, "top_k": 2}
+    cfg = dataclasses.replace(megatron_config(args), dtype=jnp.float32,
+                              moe_dropless=True)
+    assert cfg.num_experts == 4 and cfg.ffn_bias  # layernorm => biased experts
+    model = TransformerLM(cfg)
+    params = init_params(model, seed=5, seq=16)
+    assert "expert_up_bias" in params["layer_0"]["moe"]
+    toks = jnp.asarray(np.random.default_rng(3).integers(0, 96, (2, 10)),
+                       jnp.int32)
+    want = model.apply({"params": params}, toks)
+
+    sd = params_to_megatron(params, cfg, version=2)
+    assert any("deepspeed_moe.gate.wg.weight" in k for k in sd)
+    back = jax.tree.map(jnp.asarray, megatron_params(sd, cfg, version=2))
+    got = model.apply({"params": back}, toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_moe_bias_paths_agree():
+    """Capacity-einsum and dropless expert paths must agree WITH biases
+    (ample capacity => no drops => identical routing)."""
+    args = {**ARGS, "num_experts": 4, "top_k": 2}
+    base = dataclasses.replace(megatron_config(args), dtype=jnp.float32)
+    m_drop = TransformerLM(dataclasses.replace(base, moe_dropless=True))
+    m_cap = TransformerLM(dataclasses.replace(base, moe_capacity_factor=4.0))
+    params = init_params(m_drop, seed=6, seq=16)
+    toks = jnp.asarray(np.random.default_rng(4).integers(0, 96, (2, 10)),
+                       jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(m_drop.apply({"params": params}, toks)),
+        np.asarray(m_cap.apply({"params": params}, toks)),
+        rtol=2e-5, atol=2e-5)
