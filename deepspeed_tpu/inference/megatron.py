@@ -24,11 +24,14 @@ from ..models.transformer import TransformerConfig
 _PRE = "model.language_model."
 
 
-def megatron_config(args: Dict[str, Any]) -> TransformerConfig:
+def megatron_config(args: Dict[str, Any],
+                    sd: Optional[Dict[str, Any]] = None) -> TransformerConfig:
     """Map Megatron-LM ``args`` (as stored in its checkpoints) to our config.
     Classic GPT: learned positions, LayerNorm, (tanh) GELU, tied embeddings.
     DeepSpeed-MoE training (reference ``megatron_gpt_moe`` container): pass
-    ``num_experts``/``top_k`` for checkpoints whose MLPs are ``MoE`` layers.
+    ``num_experts``/``top_k``; pass the merged state dict ``sd`` as well so
+    the MoE layer placement (``--expert-interval`` spacing) is derived from
+    where the checkpoint actually has gate weights.
     """
     d = dict(args)
     ne = d.get("num_experts", 0) or 0
@@ -36,10 +39,33 @@ def megatron_config(args: Dict[str, Any]) -> TransformerConfig:
         if len(set(ne)) > 1:
             raise ValueError(f"per-layer expert counts {ne} are not supported")
         ne = ne[0] if ne else 0
+    ne = int(ne)
+    if ne <= 1:  # Megatron-DeepSpeed's dense default is num_experts=[1]
+        ne = 0
+    k = int(d.get("top_k", d.get("topk", 1)))
+    every, offset = 1, 0
+    if ne and sd is not None:
+        moe_layers = sorted(
+            i for i in range(d["num_layers"])
+            if f"{_PRE}transformer.layers.{i}.mlp.deepspeed_moe.gate.wg.weight"
+            in sd)
+        if not moe_layers:
+            ne = 0
+        else:
+            every = (moe_layers[1] - moe_layers[0]
+                     if len(moe_layers) > 1 else d["num_layers"])
+            offset = moe_layers[0]
+            if moe_layers != list(range(offset, d["num_layers"], every)):
+                raise ValueError(
+                    f"irregular MoE layer placement {moe_layers} cannot be "
+                    "expressed as (moe_every, moe_offset)")
     return TransformerConfig(
-        num_experts=int(ne),
-        # DeepSpeed-MoE --topk defaults to 1 (reference arguments)
-        moe_top_k=int(d.get("top_k", d.get("topk", 1))),
+        num_experts=ne,
+        moe_every=every, moe_offset=offset,
+        # DeepSpeed-MoE --topk defaults to 1; top1gating combines with the
+        # RAW softmax probability (no top-k renormalization), top2+ with the
+        # normalized weights (reference sharded_moe.py top1/top2gating)
+        moe_top_k=k, moe_norm_topk=(k >= 2),
         vocab_size=d["padded_vocab_size"] if "padded_vocab_size" in d
         else d["vocab_size"],
         hidden_size=d["hidden_size"],
@@ -126,11 +152,13 @@ def megatron_params(sd: Dict[str, Any], cfg: TransformerConfig,
             # TopKGate.wg + Experts.deepspeed_experts ParallelMLP copies).
             # The expert count comes from the CHECKPOINT (router rows), not
             # the possibly-absent args entry.
-            n_exp = t(moe_pre + "gate.wg.weight").shape[0]
-            if cfg.num_experts and cfg.num_experts != n_exp:
+            wg = t(moe_pre + "gate.wg.weight")
+            n_exp = wg.shape[0]
+            if cfg.num_experts != n_exp:
                 raise ValueError(
                     f"layer {i}: checkpoint has {n_exp} experts but the "
-                    f"config says {cfg.num_experts}")
+                    f"config says {cfg.num_experts} — build the config with "
+                    "megatron_config(args, sd=merged_state_dict)")
             ups, dns, upb, dnb = [], [], [], []
             for e_i in range(n_exp):
                 ep = moe_pre + f"experts.deepspeed_experts.{e_i}."
@@ -139,7 +167,7 @@ def megatron_params(sd: Dict[str, Any], cfg: TransformerConfig,
                 upb.append(t(ep + "dense_h_to_4h.bias"))
                 dnb.append(t(ep + "dense_4h_to_h.bias"))
             layer["moe"] = {
-                "router": {"kernel": t(moe_pre + "gate.wg.weight").T},
+                "router": {"kernel": wg.T},
                 "expert_up_proj": np.stack(ups),
                 "expert_down_proj": np.stack(dns),
                 "expert_up_bias": np.stack(upb),

@@ -56,18 +56,16 @@ class InferenceEngineV2:
         self.config = config or RaggedInferenceEngineConfig()
         c = self.config
         self.cfg: TransformerConfig = model.cfg
-        if (self.cfg.parallel_residual or self.cfg.position == "alibi"
-                or self.cfg.pos_offset or self.cfg.activation == "relu"
-                or self.cfg.rotary_interleaved or self.cfg.embed_norm
-                or self.cfg.lm_head_bias or self.cfg.attn_scale is not None
+        if (self.cfg.position == "alibi" or self.cfg.pos_offset
+                or self.cfg.activation == "relu" or self.cfg.embed_norm
+                or self.cfg.attn_scale is not None
                 or self.cfg.layer_windows is not None):
             raise NotImplementedError(
-                "inference v2's ragged forward covers the sequential-residual "
-                "rope/learned (no offset) swiglu/gelu families; use the v1 "
-                "engine for parallel-residual (falcon/neox/gptj/phi), ALiBi/"
-                "embed-norm (bloom), OPT-style (pos offset / relu), "
-                "interleaved-rotary, biased-lm_head, unscaled-attention or "
-                "windowed (gpt_neo) models")
+                "inference v2's ragged forward covers the rope/learned (no "
+                "offset) families incl. parallel residual (falcon/gptj/phi/"
+                "neox) and MoE; use the v1 engine for ALiBi/embed-norm "
+                "(bloom/mpt), OPT-style (pos offset / relu), "
+                "unscaled-attention or windowed (gpt_neo) models")
         dtype = jnp.dtype(c.dtype)
         self.params = jax.tree.map(
             lambda x: jnp.asarray(x, dtype) if jnp.issubdtype(

@@ -65,8 +65,9 @@ def _qkv(cfg, ap, y, rope_cs, positions):
     vt = _dense(ap["v_proj"], y)
     if cfg.position == "rope":
         cos, sin = rope_cs
-        qt = _rope(qt, cos, sin, positions)
-        kt = _rope(kt, cos, sin, positions)
+        il = cfg.rotary_interleaved
+        qt = _rope(qt, cos, sin, positions, il)
+        kt = _rope(kt, cos, sin, positions, il)
     return qt, kt, vt
 
 
@@ -115,14 +116,18 @@ def _lm_logits(cfg, params, h_sel):
     h_sel = h_sel.astype(jnp.float32)
     if cfg.tie_embeddings:
         return h_sel @ params["embed"]["embedding"].astype(jnp.float32).T
-    return h_sel @ params["lm_head"]["kernel"].astype(jnp.float32)
+    logits = h_sel @ params["lm_head"]["kernel"].astype(jnp.float32)
+    if cfg.lm_head_bias:  # gpt-j / phi
+        logits = logits + params["lm_head"]["bias"].astype(jnp.float32)
+    return logits
 
 
-def _rope(x, cos, sin, positions):
+def _rope(x, cos, sin, positions, interleaved=False):
     """x: [T, H, D]; positions: [T] — the shared rotary
-    (models.transformer.apply_rope, incl. partial rotary) over a flat token
-    buffer, expressed as a batch of one."""
-    return apply_rope(x[None], cos, sin, positions[None])[0]
+    (models.transformer.apply_rope, incl. partial rotary and the gpt-j
+    rotate-every-two pairing) over a flat token buffer, batch of one."""
+    return apply_rope(x[None], cos, sin, positions[None],
+                      interleaved=interleaved)[0]
 
 
 def paged_attention(qg, k_pool, v_pool, block_table, positions_g, q_valid,
@@ -240,8 +245,14 @@ def _ragged_forward_impl(params, cfg: TransformerConfig, kv_k, kv_v, tokens,
         flat = flat.at[gather_idx.reshape(-1)].set(out.reshape(-1, h, d))
         attn_tok = flat[:T]
         attn_out = _dense_multi_in(ap["o_proj"], attn_tok)          # [T, H]
-        x = x + attn_out
-        x = x + _ffn(cfg, lp, _norm(cfg, lp["mlp_norm"], x))
+        if cfg.parallel_residual:
+            # falcon / gpt-j / phi: attn and mlp both branch off x
+            y_mlp = (y if cfg.parallel_shared_norm
+                     else _norm(cfg, lp["mlp_norm"], x))
+            x = x + attn_out + _ffn(cfg, lp, y_mlp)
+        else:
+            x = x + attn_out
+            x = x + _ffn(cfg, lp, _norm(cfg, lp["mlp_norm"], x))
 
     x = _norm(cfg, params["final_norm"], x)
     # logits only at the sample positions (reference logits_gather kernel);
@@ -390,8 +401,14 @@ def decode_loop(params, cfg: TransformerConfig, kv_k, kv_v, tokens0, pos0,
                                      m1.reshape(S, Hk, G), l1.reshape(S, Hk, G),
                                      o2, m2, l2)
             attn_tok = merged.reshape(S, Hq, D).astype(dtype)
-            x = x + _dense_multi_in(ap["o_proj"], attn_tok)
-            x = x + _ffn(cfg, lp, _norm(cfg, lp["mlp_norm"], x))
+            attn_out = _dense_multi_in(ap["o_proj"], attn_tok)
+            if cfg.parallel_residual:
+                y_mlp = (y if cfg.parallel_shared_norm
+                         else _norm(cfg, lp["mlp_norm"], x))
+                x = x + attn_out + _ffn(cfg, lp, y_mlp)
+            else:
+                x = x + attn_out
+                x = x + _ffn(cfg, lp, _norm(cfg, lp["mlp_norm"], x))
         x = _norm(cfg, params["final_norm"], x)
         logits = _lm_logits(cfg, params, x)
         return logits, wk, wv

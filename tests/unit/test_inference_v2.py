@@ -316,3 +316,59 @@ def test_decode_with_oversized_block_table():
             break
     for uid, want in zip((0, 1), small):
         np.testing.assert_array_equal(eng.query(uid)[1], want)
+
+
+@pytest.mark.parametrize("family", ["falcon7b", "gptj", "phi"])
+def test_v2_parallel_residual_families_match_v1(family):
+    """v2 ragged serving of parallel-residual families (reference FastGen
+    falcon/phi implementations; gptj adds interleaved rotary + biased
+    lm_head) must match the v1 dense path exactly."""
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.hf import params_from_hf
+
+    torch.manual_seed(31)
+    if family == "falcon7b":
+        hf = transformers.FalconForCausalLM(transformers.FalconConfig(
+            vocab_size=96, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, multi_query=True, parallel_attn=True,
+            new_decoder_architecture=False, bias=False, alibi=False,
+            max_position_embeddings=64, hidden_dropout=0.0,
+            attention_dropout=0.0)).eval()
+    elif family == "gptj":
+        hf = transformers.GPTJForCausalLM(transformers.GPTJConfig(
+            vocab_size=96, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+            rotary_dim=8, resid_pdrop=0.0, embd_pdrop=0.0,
+            attn_pdrop=0.0)).eval()
+    else:
+        hf = transformers.PhiForCausalLM(transformers.PhiConfig(
+            vocab_size=96, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            partial_rotary_factor=0.5, max_position_embeddings=64,
+            resid_pdrop=0.0, embd_pdrop=0.0, attention_dropout=0.0)).eval()
+    cfg, params = params_from_hf(hf)
+    model = TransformerLM(type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32}))
+
+    prompts = [np.array([5, 6, 7, 8, 9], np.int32),
+               np.array([40, 41, 42], np.int32)]
+    v1 = InferenceEngine(model, params,
+                         DeepSpeedInferenceConfig.from_dict(
+                             {"dtype": "float32", "max_out_tokens": 64}))
+    toks = np.zeros((2, 5), np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    lens = np.array([5, 3], np.int32)
+    ref = v1.generate(jnp.asarray(toks), prompt_lengths=jnp.asarray(lens),
+                      max_new_tokens=8)
+
+    v2 = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        token_budget=8, max_ragged_sequence_count=4, max_chunk_size=4,
+        num_kv_blocks=32, kv_block_size=8, max_blocks_per_seq=8,
+        dtype="float32"))
+    outs = v2.generate(prompts, max_new_tokens=8)
+    for i, o in enumerate(outs):
+        np.testing.assert_array_equal(o, np.asarray(ref)[i],
+                                      err_msg=f"{family} seq {i}")

@@ -176,3 +176,30 @@ def test_moe_bias_paths_agree():
         np.asarray(m_drop.apply({"params": params}, toks)),
         np.asarray(m_cap.apply({"params": params}, toks)),
         rtol=2e-5, atol=2e-5)
+
+
+def test_moe_config_conventions():
+    """Megatron-DeepSpeed arg conventions: num_experts=[1] is DENSE, topk
+    defaults to 1 with RAW-probability combine, and the MoE layer placement
+    (--expert-interval spacing) is derived from the checkpoint."""
+    # dense default stored as a list
+    cfg = megatron_config({**ARGS, "num_experts": [1]})
+    assert cfg.num_experts == 0
+    # top_k=1 -> no top-k renormalization (reference top1gating)
+    cfg = megatron_config({**ARGS, "num_experts": [4]})
+    assert cfg.num_experts == 4 and cfg.moe_top_k == 1 and not cfg.moe_norm_topk
+    cfg = megatron_config({**ARGS, "num_experts": [4], "topk": 2})
+    assert cfg.moe_norm_topk
+    # placement derived from gate keys: MoE on layers 1, 3 of 4
+    sd = {f"model.language_model.transformer.layers.{i}"
+          ".mlp.deepspeed_moe.gate.wg.weight": np.zeros((4, 8))
+          for i in (1, 3)}
+    cfg = megatron_config({**ARGS, "num_layers": 4, "num_experts": [4]}, sd=sd)
+    assert (cfg.moe_every, cfg.moe_offset) == (2, 1)
+    # irregular placement is rejected
+    sd_bad = {f"model.language_model.transformer.layers.{i}"
+              ".mlp.deepspeed_moe.gate.wg.weight": np.zeros((4, 8))
+              for i in (0, 1, 3)}
+    with pytest.raises(ValueError, match="irregular"):
+        megatron_config({**ARGS, "num_layers": 4, "num_experts": [4]},
+                        sd=sd_bad)
