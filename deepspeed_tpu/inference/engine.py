@@ -206,8 +206,11 @@ class InferenceEngine:
         cache_sh = self._cache_shardings(batch)
 
         def run(params, tokens, lengths, rng):
-            b = tokens.shape[0]
-            cache = init_kv_cache(cfg, b, self.max_tokens, self.config.jnp_dtype)
+            b, s_prompt = tokens.shape
+            # prefill cache capacity = the prompt width only: it becomes the
+            # READ-ONLY "frozen" side of the decode scan, so it never needs
+            # room for generated tokens (those live in the scanned window)
+            cache = init_kv_cache(cfg, b, s_prompt, self.config.jnp_dtype)
             cache = jax.lax.with_sharding_constraint(cache, cache_sh)
             # prefill: positions 0..S-1, write offsets 0
             logits, cache = model.apply({"params": params}, tokens,
@@ -219,19 +222,38 @@ class InferenceEngine:
             tok = sample(last, r0)
             done = jnp.zeros((b,), bool) if eos is None else (tok == eos)
 
-            def step(carry, r):
-                cache, tok, cur, done = carry
-                lg, cache = model.apply({"params": params}, tok[:, None],
-                                        cache=cache, cache_index=cur)
+            # frozen-cache decode: the scan carries only the small per-layer
+            # window buffers [B, W, Hk, D]; the prefill cache is a read-only
+            # closure operand (a scanned carry updated by DUS is copied IN
+            # FULL every iteration on this backend — see decode_loop in
+            # inference/v2/model.py for the measurement)
+            W = max_new - 1
+            hk, dh = cfg.kv_heads, cfg.head_dim
+            win = {f"layer_{i}": {
+                "k": jnp.zeros((b, W, hk, dh), self.config.jnp_dtype),
+                "v": jnp.zeros((b, W, hk, dh), self.config.jnp_dtype)}
+                for i in range(cfg.num_layers)} if W > 0 else None
+
+            def step(carry, xs):
+                win, tok, cur, done = carry
+                r, t = xs
+                lg, win = model.apply({"params": params}, tok[:, None],
+                                      cache_index=cur, frozen_cache=cache,
+                                      window=win, window_t=t,
+                                      frozen_len=lengths)
                 nxt = sample(lg[:, 0], r)
                 if eos is not None:
                     nxt = jnp.where(done, gen.pad_token_id, nxt)
                     done = done | (nxt == eos)
-                return (cache, nxt, cur + 1, done), nxt
+                return (win, nxt, cur + 1, done), nxt
 
-            rngs = jax.random.split(rng, max_new - 1) if max_new > 1 else jnp.zeros((0, 2), jnp.uint32)
-            (_, _, _, _), rest = jax.lax.scan(step, (cache, tok, lengths, done), rngs)
-            out = jnp.concatenate([tok[:, None], rest.T], axis=1)
+            if max_new > 1:
+                rngs = jax.random.split(rng, W)
+                (_, _, _, _), rest = jax.lax.scan(
+                    step, (win, tok, lengths, done), (rngs, jnp.arange(W)))
+                out = jnp.concatenate([tok[:, None], rest.T], axis=1)
+            else:
+                out = tok[:, None]
             return out
 
         bs = self._batch_sharding(batch)

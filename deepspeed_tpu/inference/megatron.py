@@ -55,7 +55,12 @@ def megatron_config(args: Dict[str, Any],
             every = (moe_layers[1] - moe_layers[0]
                      if len(moe_layers) > 1 else d["num_layers"])
             offset = moe_layers[0]
-            if moe_layers != list(range(offset, d["num_layers"], every)):
+            # Block gates on layer_idx % every == offset % every, so the
+            # pattern must start at offset % every (a dense PREFIX before
+            # the first MoE layer is not expressible)
+            if (offset >= every
+                    or moe_layers != list(range(offset, d["num_layers"],
+                                                every))):
                 raise ValueError(
                     f"irregular MoE layer placement {moe_layers} cannot be "
                     "expressed as (moe_every, moe_offset)")

@@ -26,7 +26,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...models.transformer import TransformerConfig, apply_rope, rope_table
+from ...models.transformer import (TransformerConfig, apply_rope,
+                                   merge_partial_attention as merge_attention,
+                                   rope_table)
 from ...ops.pallas.paged_attention import NEG_INF
 from ...ops.pallas.paged_attention import paged_attention as paged_attention_pallas
 
@@ -171,19 +173,6 @@ def paged_attention(qg, k_pool, v_pool, block_table, positions_g, q_valid,
         stats = lambda a: jnp.transpose(a, (0, 3, 1, 2)).reshape(s, q, hq)
         return out, stats(m_row), stats(l_row)
     return out
-
-
-def merge_attention(out1, m1, l1, out2, m2, l2):
-    """Merge two normalized partial-attention results over disjoint KV sets
-    (flash-attention combine algebra). out_i: [..., D]; m_i/l_i: [...] with
-    ``m = NEG_INF, l = 0`` for an empty set."""
-    m = jnp.maximum(m1, m2)
-    e1 = l1 * jnp.exp(m1 - m)
-    e2 = l2 * jnp.exp(m2 - m)
-    den = jnp.maximum(e1 + e2, 1e-30)
-    num = (out1.astype(jnp.float32) * e1[..., None]
-           + out2.astype(jnp.float32) * e2[..., None])
-    return num / den[..., None]
 
 
 def _ragged_forward_impl(params, cfg: TransformerConfig, kv_k, kv_v, tokens,

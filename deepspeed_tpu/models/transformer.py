@@ -236,12 +236,18 @@ def _update_cache(cache_kv, new_kv, cache_index):
 
 
 def cached_attention(q, k_cache, v_cache, q_pos, alibi=None, scale=None,
-                     window=None, alibi_post_scale=False):
-    """Decode attention over the full KV cache with per-sequence validity:
-    cache slot j attends iff ``j <= q_pos`` (absolute position), which also
-    masks unwritten slots. q: [B,S,H,D]; caches: [B,M,Hk,D]; q_pos: [B,S].
-    GQA is handled by grouping query heads per kv head — no materialized
-    kv-head replication."""
+                     window=None, alibi_post_scale=False, kv_pos=None,
+                     kv_valid=None, return_stats=False):
+    """Decode attention over a KV buffer with per-sequence validity.
+
+    q: [B,S,H,D]; caches: [B,M,Hk,D]; q_pos: [B,S] absolute positions.
+    ``kv_pos`` [B, M] gives each slot's absolute position (default: the slot
+    index — the dense cache layout); ``kv_valid`` [B, M] restricts readable
+    slots (default: all). Slot j attends iff valid, ``pos_j <= q_pos`` and
+    within the local ``window``. GQA is handled by grouping query heads per
+    kv head — no materialized kv-head replication. ``return_stats`` adds the
+    online-softmax (m, l) per row ([B,S,H] fp32) for partial-attention
+    merges (the frozen-cache decode path)."""
     b, s, h, d = q.shape
     m, hk = k_cache.shape[1], k_cache.shape[2]
     rep = h // hk
@@ -249,7 +255,10 @@ def cached_attention(q, k_cache, v_cache, q_pos, alibi=None, scale=None,
     scale = (1.0 / np.sqrt(d)) if scale is None else float(scale)
     logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k_cache.astype(q.dtype),
                         preferred_element_type=jnp.float32) * scale
-    slot = jnp.arange(m)[None, None, None, None, :]
+    if kv_pos is None:
+        slot = jnp.arange(m)[None, None, None, None, :]
+    else:
+        slot = kv_pos[:, None, None, None, :]
     if alibi is not None:
         # pre- vs post-scaling bias convention (see attention_core)
         sl_factor = 1.0 if alibi_post_scale else scale
@@ -259,10 +268,36 @@ def cached_attention(q, k_cache, v_cache, q_pos, alibi=None, scale=None,
     mask = slot <= q_pos[:, None, None, :, None]
     if window is not None:
         mask = mask & (q_pos[:, None, None, :, None] - slot < window)
+    if kv_valid is not None:
+        mask = mask & kv_valid[:, None, None, None, :]
     logits = jnp.where(mask, logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v_cache.astype(q.dtype))
-    return out.reshape(b, s, h, d)
+    if not return_stats:
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v_cache.astype(q.dtype))
+        return out.reshape(b, s, h, d)
+    m_row = jnp.max(logits, axis=-1)                          # [b,hk,rep,s]
+    p = jnp.where(mask, jnp.exp(logits - m_row[..., None]), 0.0)
+    l_row = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhrqk,bkhd->bqhrd", p.astype(q.dtype),
+                     v_cache.astype(q.dtype),
+                     preferred_element_type=jnp.float32)
+    safe = jnp.where(l_row == 0.0, 1.0, l_row)
+    out = (acc / jnp.transpose(safe, (0, 3, 1, 2))[..., None]).astype(q.dtype)
+    stats = lambda a: jnp.transpose(a, (0, 3, 1, 2)).reshape(b, s, h)
+    return out.reshape(b, s, h, d), stats(m_row), stats(l_row)
+
+
+def merge_partial_attention(out1, m1, l1, out2, m2, l2):
+    """Merge two normalized partial-attention results over disjoint KV sets
+    (flash combine algebra). out_i: [..., D]; m_i/l_i: [...]; an empty set
+    contributes ``m = -inf, l = 0``."""
+    mx = jnp.maximum(m1, m2)
+    e1 = l1 * jnp.exp(m1 - mx)
+    e2 = l2 * jnp.exp(m2 - mx)
+    den = jnp.maximum(e1 + e2, 1e-30)
+    num = (out1.astype(jnp.float32) * e1[..., None]
+           + out2.astype(jnp.float32) * e2[..., None])
+    return num / den[..., None]
 
 
 class Attention(nn.Module):
@@ -271,7 +306,8 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, deterministic=True, cache=None, cache_index=None,
-                 whole_prefill=False):
+                 whole_prefill=False, frozen_cache=None, window_kv=None,
+                 window_t=None, frozen_len=None):
         cfg = self.cfg
         h, hk, d = cfg.num_heads, cfg.kv_heads, cfg.head_dim
         rope = partial(apply_rope, interleaved=cfg.rotary_interleaved)
@@ -292,6 +328,42 @@ class Attention(nn.Module):
         o_proj = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
                                  use_bias=cfg.out_bias, dtype=cfg.dtype,
                                  param_dtype=jnp.float32, name="o_proj")
+
+        if window_kv is not None:
+            # frozen-cache decode (inference v1 generate scan): the prefill
+            # cache is READ-ONLY — XLA copies a scanned carry in full on
+            # every iteration when scatter/DUS-updated, so only the small
+            # in-window buffer rides the scan; attention over the two
+            # disjoint KV sets merges with the flash combine algebra.
+            positions = cache_index[:, None]                     # [B, 1]
+            if cfg.position == "rope":
+                q = rope(q, cos, sin, positions)
+                k = rope(k, cos, sin, positions)
+            wk, wv = window_kv["k"], window_kv["v"]              # [B, W, Hk, D]
+            W = wk.shape[1]
+            wk = jax.lax.dynamic_update_slice(
+                wk, k.astype(wk.dtype), (0, window_t, 0, 0))
+            wv = jax.lax.dynamic_update_slice(
+                wv, v.astype(wv.dtype), (0, window_t, 0, 0))
+            b = x.shape[0]
+            mf = frozen_cache["k"].shape[1]
+            frozen_valid = (jnp.arange(mf)[None, :]
+                            < frozen_len[:, None])               # [B, Mf]
+            o1, m1, l1 = cached_attention(
+                q, frozen_cache["k"], frozen_cache["v"], positions,
+                alibi=alibi, scale=scale, window=window,
+                alibi_post_scale=cfg.alibi_post_scale,
+                kv_valid=frozen_valid, return_stats=True)
+            w_pos = frozen_len[:, None] + jnp.arange(W)[None, :]  # [B, W]
+            w_valid = jnp.broadcast_to(
+                (jnp.arange(W) <= window_t)[None, :], (b, W))
+            o2, m2, l2 = cached_attention(
+                q, wk, wv, positions, alibi=alibi, scale=scale, window=window,
+                alibi_post_scale=cfg.alibi_post_scale,
+                kv_pos=w_pos, kv_valid=w_valid, return_stats=True)
+            merged = merge_partial_attention(o1, m1, l1, o2, m2, l2)
+            out = o_proj(merged.astype(x.dtype))
+            return out, {"k": wk, "v": wv}
 
         if cache is not None:
             # incremental decoding path (inference v1 engine)
@@ -407,7 +479,8 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic=True, cache=None, cache_index=None,
-                 whole_prefill=False):
+                 whole_prefill=False, frozen_cache=None, window_kv=None,
+                 window_t=None, frozen_len=None):
         # (x, deterministic) stay positional for nn.remat static_argnums
         cfg = self.cfg
         y = _norm(cfg, "attn_norm")(x)
@@ -415,7 +488,13 @@ class Block(nn.Module):
         if cfg.layer_windows is not None:
             window = cfg.layer_windows[self.layer_idx]
         attn = Attention(cfg, window=window, name="attn")
-        if cache is not None:
+        if window_kv is not None:
+            attn_out, new_cache = attn(y, deterministic=deterministic,
+                                       cache_index=cache_index,
+                                       frozen_cache=frozen_cache,
+                                       window_kv=window_kv, window_t=window_t,
+                                       frozen_len=frozen_len)
+        elif cache is not None:
             attn_out, new_cache = attn(y, deterministic=deterministic,
                                        cache=cache, cache_index=cache_index,
                                        whole_prefill=whole_prefill)
@@ -441,7 +520,9 @@ class Block(nn.Module):
         else:
             x = x + attn_out
             out = x + mlp_of(_norm(cfg, "mlp_norm")(x))
-        return (out, new_cache) if cache is not None else out
+        if cache is not None or window_kv is not None:
+            return out, new_cache
+        return out
 
 
 class TransformerLM(nn.Module):
@@ -450,10 +531,15 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, deterministic=True, cache=None, cache_index=None,
-                 whole_prefill=False):
+                 whole_prefill=False, frozen_cache=None, window=None,
+                 window_t=None, frozen_len=None):
         """Training/eval: ``logits = __call__(tokens)``. Incremental decode
         (inference v1): pass ``cache`` (see ``init_kv_cache``) + per-sequence
-        write offsets ``cache_index [B]`` → ``(logits, new_cache)``."""
+        write offsets ``cache_index [B]`` → ``(logits, new_cache)``.
+        Frozen-cache decode (the generate scan): pass the read-only prefill
+        ``frozen_cache``, the per-layer in-``window`` KV pytree, the step
+        index ``window_t`` and per-sequence prompt lengths ``frozen_len`` →
+        ``(logits, new_window)``."""
         cfg = self.cfg
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                          param_dtype=jnp.float32, name="embed")
@@ -465,7 +551,7 @@ class TransformerLM(nn.Module):
                                  (cfg.max_seq_len + cfg.pos_offset,
                                   cfg.hidden_size), jnp.float32)
             off = cfg.pos_offset  # OPT embeds positions shifted by 2
-            if cache is not None:
+            if cache is not None or window is not None:
                 positions = cache_index[:, None] + jnp.arange(tokens.shape[1])[None, :]
                 x = x + pos_emb[positions + off].astype(cfg.dtype)
             else:
@@ -480,7 +566,12 @@ class TransformerLM(nn.Module):
         new_cache = {}
         for i in range(cfg.num_layers):
             name = f"layer_{i}"
-            if cache is not None:
+            if window is not None:
+                x, new_cache[name] = block(cfg, i, name=name)(
+                    x, deterministic, cache_index=cache_index,
+                    frozen_cache=frozen_cache[name], window_kv=window[name],
+                    window_t=window_t, frozen_len=frozen_len)
+            elif cache is not None:
                 x, new_cache[name] = block(cfg, i, name=name)(
                     x, deterministic, cache=cache[name], cache_index=cache_index,
                     whole_prefill=whole_prefill)
@@ -495,7 +586,9 @@ class TransformerLM(nn.Module):
             logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias,
                               dtype=jnp.float32,
                               param_dtype=jnp.float32, name="lm_head")(x.astype(jnp.float32))
-        return (logits, new_cache) if cache is not None else logits
+        if cache is not None or window is not None:
+            return logits, new_cache
+        return logits
 
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: Optional[int] = None,

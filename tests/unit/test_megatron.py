@@ -196,6 +196,13 @@ def test_moe_config_conventions():
           for i in (1, 3)}
     cfg = megatron_config({**ARGS, "num_layers": 4, "num_experts": [4]}, sd=sd)
     assert (cfg.moe_every, cfg.moe_offset) == (2, 1)
+    # dense PREFIX before the first MoE layer is not expressible either
+    sd_prefix = {f"model.language_model.transformer.layers.{i}"
+                 ".mlp.deepspeed_moe.gate.wg.weight": np.zeros((4, 8))
+                 for i in (2, 4)}
+    with pytest.raises(ValueError, match="irregular"):
+        megatron_config({**ARGS, "num_layers": 6, "num_experts": [4]},
+                        sd=sd_prefix)
     # irregular placement is rejected
     sd_bad = {f"model.language_model.transformer.layers.{i}"
               ".mlp.deepspeed_moe.gate.wg.weight": np.zeros((4, 8))
