@@ -233,6 +233,11 @@ class InferenceEngine:
                 "k": jnp.zeros((b, W, hk, dh), self.config.jnp_dtype),
                 "v": jnp.zeros((b, W, hk, dh), self.config.jnp_dtype)}
                 for i in range(cfg.num_layers)} if W > 0 else None
+            if win is not None:
+                # same layout as the frozen cache (kv heads over tp): an
+                # unconstrained carry could resolve replicated and re-gather
+                # the tp-sharded k/v every step
+                win = jax.lax.with_sharding_constraint(win, cache_sh)
 
             def step(carry, xs):
                 win, tok, cur, done = carry

@@ -85,6 +85,51 @@ def bslongformer_layout(num_heads: int, num_blocks: int, *,
     return np.repeat(lo[None], num_heads, axis=0)
 
 
+def variable_layout(num_heads: int, num_blocks: int, *,
+                    num_random_blocks: int = 0,
+                    local_window_blocks=(4,),
+                    global_block_indices=(0,),
+                    seed: int = 0) -> np.ndarray:
+    """Reference ``VariableSparsityConfig``: consecutive local windows of
+    VARYING widths (the last width repeats), symmetric global blocks, and
+    optional per-head random blocks."""
+    rng = np.random.default_rng(seed)
+    out = np.zeros((num_heads, num_blocks, num_blocks), bool)
+    # partition rows into windows of the given widths, last width repeating
+    starts, widths, i = [], [], 0
+    k = 0
+    while i < num_blocks:
+        w = local_window_blocks[min(k, len(local_window_blocks) - 1)]
+        starts.append(i)
+        widths.append(w)
+        i += w
+        k += 1
+    base = np.zeros((num_blocks, num_blocks), bool)
+    for s, w in zip(starts, widths):
+        base[s:s + w, s:s + w] = True
+    for g in global_block_indices:
+        base[:, g] = True
+        base[g, :] = True
+    out[:] = base[None]
+    if num_random_blocks and num_blocks > num_random_blocks:
+        for h in range(num_heads):  # randoms are the only per-head part
+            for i in range(num_blocks):
+                out[h, i, rng.choice(num_blocks, num_random_blocks,
+                                     replace=False)] = True
+    return out
+
+
+def local_sliding_window_layout(num_heads: int, num_blocks: int, *,
+                                num_sliding_window_blocks: int = 3
+                                ) -> np.ndarray:
+    """Reference ``LocalSlidingWindowSparsityConfig``: pure sliding window."""
+    lo = np.zeros((num_blocks, num_blocks), bool)
+    half = num_sliding_window_blocks // 2
+    for i in range(num_blocks):
+        lo[i, max(0, i - half): i + half + 1] = True
+    return np.repeat(lo[None], num_heads, axis=0)
+
+
 def causal_layout(layout: np.ndarray) -> np.ndarray:
     """Intersect a layout with the block lower-triangle (blocks fully above
     the diagonal can never contribute under causal masking)."""
