@@ -53,7 +53,8 @@ def config_from_hf(hf_config) -> TransformerConfig:
     matching in ``replace_policy.py``)."""
     d = hf_config if isinstance(hf_config, dict) else hf_config.to_dict()
     mt = d.get("model_type", "")
-    if mt in ("llama", "mistral", "mixtral", "qwen2", "qwen2_moe", "phi3"):
+    if mt in ("llama", "mistral", "mixtral", "qwen2", "qwen2_moe", "phi3",
+              "internlm"):
         cfg = dict(
             vocab_size=d["vocab_size"], hidden_size=d["hidden_size"],
             intermediate_size=d["intermediate_size"],
@@ -64,6 +65,14 @@ def config_from_hf(hf_config) -> TransformerConfig:
             rope_theta=d.get("rope_theta", 10000.0),
             norm_eps=d.get("rms_norm_eps", 1e-6),
             tie_embeddings=d.get("tie_word_embeddings", False))
+        if mt == "llama" and d.get("attention_bias"):
+            # llama with attention_bias=True (e.g. internlm exports)
+            cfg.update(attn_qkv_bias=True, attn_out_bias=True)
+        if mt == "internlm":
+            # reference module_inject/containers/internlm.py: llama layout
+            # with optional q/k/v/o biases ("bias": true configs)
+            cfg.update(attn_qkv_bias=d.get("bias", True),
+                       attn_out_bias=d.get("bias", True))
         if mt == "mixtral":
             cfg.update(num_experts=d.get("num_local_experts", 8),
                        moe_top_k=d.get("num_experts_per_tok", 2))
@@ -283,7 +292,8 @@ def config_from_hf(hf_config) -> TransformerConfig:
     raise ValueError(f"unsupported HF model_type '{mt}' (supported: llama, "
                      "mistral, mixtral, qwen2, qwen2_moe, phi3, gpt2, falcon, "
                      "gpt_neox, opt, bloom, gptj, gpt_neo, phi, starcoder2, "
-                     "stablelm, mpt, clip_text_model, bert, distilbert)")
+                     "stablelm, mpt, internlm, clip_text_model, bert, "
+                     "distilbert)")
 
 
 def _llama_params(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
@@ -948,7 +958,7 @@ def params_from_hf(model_or_state_dict, hf_config=None):
         return cfg, _to_jnp(_encoder_params(sd, cfg, keys))
     cfg = config_from_hf(hf_config)
     if mt in ("llama", "mistral", "mixtral", "qwen2", "qwen2_moe",
-              "starcoder2", "stablelm"):
+              "starcoder2", "stablelm", "internlm"):
         params = _llama_params(sd, cfg)
     elif mt == "phi3":
         params = _phi3_params(sd, cfg)

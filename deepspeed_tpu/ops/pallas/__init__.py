@@ -3,8 +3,11 @@ from .fused_adam import adam_update
 from .paged_attention import paged_attention
 from .quant import dequantize_int8, quantize_int8
 from .sparse_attention import (bigbird_layout, bslongformer_layout,
-                               causal_layout, fixed_layout, sparse_attention)
+                               causal_layout, fixed_layout,
+                               local_sliding_window_layout, sparse_attention,
+                               variable_layout)
 
 __all__ = ["flash_attention", "paged_attention", "sparse_attention",
            "fixed_layout", "bigbird_layout", "bslongformer_layout",
+           "variable_layout", "local_sliding_window_layout",
            "causal_layout", "adam_update", "quantize_int8", "dequantize_int8"]

@@ -15,7 +15,9 @@ families (Fixed, BigBird, BSLongformer). TPU-native re-design:
   BERT-era and SURVEY marks this row lowest-priority; forward-heavy serving
   is what the kernel accelerates).
 
-Layout builders mirror the reference ``SparsityConfig`` classes.
+Layout builders mirror the reference ``SparsityConfig`` classes (Fixed,
+BigBird, BSLongformer, Variable, LocalSlidingWindow; Dense = an all-ones
+layout).
 """
 
 import functools
@@ -89,10 +91,12 @@ def variable_layout(num_heads: int, num_blocks: int, *,
                     num_random_blocks: int = 0,
                     local_window_blocks=(4,),
                     global_block_indices=(0,),
+                    horizontal_global_attention: bool = False,
                     seed: int = 0) -> np.ndarray:
     """Reference ``VariableSparsityConfig``: consecutive local windows of
-    VARYING widths (the last width repeats), symmetric global blocks, and
-    optional per-head random blocks."""
+    VARYING widths (the last width repeats), global COLUMNS (rows too only
+    with ``horizontal_global_attention``, matching the reference default),
+    and optional per-head random blocks."""
     rng = np.random.default_rng(seed)
     out = np.zeros((num_heads, num_blocks, num_blocks), bool)
     # partition rows into windows of the given widths, last width repeating
@@ -109,7 +113,8 @@ def variable_layout(num_heads: int, num_blocks: int, *,
         base[s:s + w, s:s + w] = True
     for g in global_block_indices:
         base[:, g] = True
-        base[g, :] = True
+        if horizontal_global_attention:
+            base[g, :] = True
     out[:] = base[None]
     if num_random_blocks and num_blocks > num_random_blocks:
         for h in range(num_heads):  # randoms are the only per-head part
@@ -122,12 +127,12 @@ def variable_layout(num_heads: int, num_blocks: int, *,
 def local_sliding_window_layout(num_heads: int, num_blocks: int, *,
                                 num_sliding_window_blocks: int = 3
                                 ) -> np.ndarray:
-    """Reference ``LocalSlidingWindowSparsityConfig``: pure sliding window."""
-    lo = np.zeros((num_blocks, num_blocks), bool)
-    half = num_sliding_window_blocks // 2
-    for i in range(num_blocks):
-        lo[i, max(0, i - half): i + half + 1] = True
-    return np.repeat(lo[None], num_heads, axis=0)
+    """Reference ``LocalSlidingWindowSparsityConfig``: pure sliding window
+    (= BSLongformer with no global blocks)."""
+    return bslongformer_layout(
+        num_heads, num_blocks,
+        num_sliding_window_blocks=num_sliding_window_blocks,
+        global_block_indices=())
 
 
 def causal_layout(layout: np.ndarray) -> np.ndarray:

@@ -486,3 +486,23 @@ def test_mpt_parity():
     _golden(transformers.MptForCausalLM(hf_cfg).eval(), 128, seed=26,
             norm="layernorm", activation="gelu_exact", position="alibi",
             norm_bias=False, tie_embeddings=True)
+
+
+def test_llama_attention_bias_and_internlm_parity():
+    """llama with attention_bias=True (the internlm weight scheme — reference
+    module_inject/containers/internlm.py): q/k/v/o biases in the llama
+    layout."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, attention_bias=True,
+        tie_word_embeddings=False)
+    torch.manual_seed(29)
+    _golden(transformers.LlamaForCausalLM(hf_cfg).eval(), 128, seed=29,
+            attn_qkv_bias=True, attn_out_bias=True)
+    # the internlm model_type maps to the same family
+    cfg = config_from_hf({"model_type": "internlm", "vocab_size": 128,
+                          "hidden_size": 64, "intermediate_size": 128,
+                          "num_hidden_layers": 2, "num_attention_heads": 4,
+                          "bias": True})
+    assert cfg.attn_qkv_bias and cfg.attn_out_bias and cfg.norm == "rmsnorm"

@@ -91,9 +91,14 @@ def test_variable_and_local_window_layouts():
         local_sliding_window_layout, sparse_attention, variable_layout)
 
     lo = variable_layout(2, 8, local_window_blocks=(2, 3),
-                         global_block_indices=(0,))
+                         global_block_indices=(0,),
+                         horizontal_global_attention=True)
     assert lo.shape == (2, 8, 8)
     assert lo[0, 1, 0] and lo[0, 0, 7]          # symmetric global block 0
+    # reference default: global COLUMNS only (no horizontal rows)
+    lo_cols = variable_layout(2, 8, local_window_blocks=(2, 3),
+                              global_block_indices=(0,))
+    assert lo_cols[0, 7, 0] and not lo_cols[0, 0, 7]
     assert lo[0, 2, 3] and lo[0, 2, 4]          # second window width 3
     assert not lo[0, 2, 5]                       # outside its window
     # windows after the listed ones repeat the LAST width (3): rows 5..7
