@@ -16,6 +16,7 @@ API surface preserved from the reference:
   ``save_checkpoint``/``load_checkpoint`` (``engine.py:3140,2794``).
 """
 
+import contextlib
 import inspect
 import json
 import os
@@ -203,6 +204,7 @@ class DeepSpeedTPUEngine:
         self._compat_batch = None
         self._compat_pending = None
         self._compat_count = 0
+        self._no_sync_depth = 0
         self._micro_step_fn = None
         self._apply_fn = None
         self._eval_fn = None
@@ -503,6 +505,11 @@ class DeepSpeedTPUEngine:
         ``batch`` leaves are either ``[gas, micro_global, ...]`` or
         ``[gas * micro_global, ...]`` (reshaped automatically).
         """
+        if self._no_sync_depth > 0:
+            raise RuntimeError(
+                "train_batch() applies the optimizer unconditionally and is "
+                "incompatible with an open no_sync() context; use the "
+                "imperative backward()/step() path inside no_sync()")
         if batch is None:
             batch = _draw_from_iter(data_iter, self.gas)
         batch = self._shape_batch(batch)
@@ -684,7 +691,33 @@ class DeepSpeedTPUEngine:
         self._compat_count += 1
         return float(np.asarray(loss_dev))
 
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Context manager suppressing the optimizer boundary while inside
+        (reference ``engine.no_sync:1987``: skip gradient allreduce during
+        accumulation micro-steps).
+
+        On TPU the reduction itself is XLA's to schedule: the compiled
+        ``train_batch`` GAS scan already accumulates before reducing, and the
+        imperative ``backward()`` path's per-microbatch psum is inserted by
+        SPMD where the grads are consumed. What the reference contract
+        guarantees — and what this enforces — is that no optimizer step can
+        fire on the imperative path while the context is open:
+        ``is_gradient_accumulation_boundary`` reports False inside, so
+        micro-steps keep accumulating regardless of
+        ``gradient_accumulation_steps``. ``train_batch`` (a fused
+        microbatch-scan + apply) is incompatible with an open context and
+        raises.
+        """
+        self._no_sync_depth += 1
+        try:
+            yield
+        finally:
+            self._no_sync_depth -= 1
+
     def is_gradient_accumulation_boundary(self) -> bool:
+        if self._no_sync_depth > 0:
+            return False
         return self._compat_count >= self.gas
 
     def step(self):

@@ -172,3 +172,34 @@ def test_topology_tp_axis_free():
     engine = _make_engine(zero_stage=3, topology=topo)
     losses = _train(engine, steps=8)
     assert losses[-1] < losses[0] * 0.6
+
+
+def test_no_sync_defers_the_step():
+    """Reference no_sync contract: no optimizer step can fire inside the
+    context even past the configured accumulation boundary; the deferred
+    micro-grads still apply identically afterwards."""
+    base = _make_engine(zero_stage=0)
+    deferred = _make_engine(zero_stage=0)
+    b1, b2, b3 = random_batches(3, 8, HIDDEN, seed=9)
+    # reference ordering: all three microbatches in one accumulation window
+    for b in (b1, b2, b3):
+        base.backward(batch=b)
+    assert base.is_gradient_accumulation_boundary()  # gas=1 exceeded
+    base.step()
+
+    with deferred.no_sync():
+        with pytest.raises(RuntimeError, match="no_sync"):
+            deferred.train_batch(b1)   # fused step is incompatible
+        deferred.backward(batch=b1)
+        deferred.backward(batch=b2)
+        assert not deferred.is_gradient_accumulation_boundary()
+        deferred.step()                    # must be a no-op inside no_sync
+        assert deferred.global_steps == 0
+    deferred.backward(batch=b3)
+    assert deferred.is_gradient_accumulation_boundary()
+    deferred.step()
+    assert deferred.global_steps == 1
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-5, atol=1e-6),
+        base.state.params, deferred.state.params)
