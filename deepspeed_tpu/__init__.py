@@ -9,6 +9,7 @@ profiler / monitor toolchain.
 """
 
 from . import comm
+from .runtime import activation_checkpointing as checkpointing
 from .parallel.topology import Topology, TopologySpec, get_topology, set_topology
 from .runtime.config import DeepSpeedTPUConfig, load_config
 from .runtime.engine import DeepSpeedTPUEngine, TrainState, initialize

@@ -262,4 +262,58 @@ def build_optimizer(name: str, params: Optional[dict] = None) -> optax.GradientT
         from ..compression.onebit import build_onebit_optimizer
 
         return build_onebit_optimizer(name_l, lr=lr, weight_decay=wd, **params)
+    if name_l in ("muadam", "muadamw", "musgd"):
+        base = "sgd" if name_l == "musgd" else \
+            ("adamw" if name_l == "muadamw" else "adam")
+        return mu_optimizer(base, lr=lr, weight_decay=wd, **params)
     raise ValueError(f"Unknown optimizer type: {name}")
+
+
+def mu_optimizer(base: str, lr: float = 1e-3, weight_decay: float = 0.0,
+                 base_width: int = 1, **params) -> optax.GradientTransformation:
+    """μP (Maximal Update Parametrization) optimizer wrappers (reference
+    ``tests/unit/runtime/test_mup_optimizers.py``: ``MuAdam``/``MuSGD`` from
+    the ``mup`` package applied through ``deepspeed.initialize``).
+
+    The μP learning-rate rule, expressed per leaf from its shape — no
+    ``set_base_shapes`` module surgery (there is no module to patch):
+
+    * matrix-like params (ndim >= 2): Adam-family lr scales by
+      ``base_width / fan_in`` (the infinite-width transfer rule); SGD keeps
+      lr (its μP scaling folds into the init/width ratio).
+    * vector/scalar params (biases, norms): Adam keeps lr, SGD scales by
+      ``fan_out / base_width``.
+
+    ``base_width`` is the tuned proxy model's width (``mup`` stores the same
+    ratio in ``infshape``); width ratios of 1 reduce to the base optimizer.
+    """
+    adam_family = base in ("adam", "adamw")
+
+    def scale_for(path_ignored, leaf):
+        if leaf.ndim >= 2:  # matrix-like: fan_in is the leading (input) dim
+            return base_width / leaf.shape[0] if adam_family else 1.0
+        if leaf.ndim == 1 and not adam_family:
+            return leaf.shape[0] / base_width
+        return 1.0
+
+    def per_leaf_scale():
+        def init_fn(params_tree):
+            return optax.EmptyState()
+
+        def update_fn(updates, state, params_tree=None):
+            scaled = jax.tree_util.tree_map_with_path(
+                lambda kp, u: u * scale_for(kp, u), updates)
+            return scaled, state
+
+        return optax.GradientTransformation(init_fn, update_fn)
+
+    if adam_family:
+        inner = fused_adam(lr=lr, weight_decay=weight_decay,
+                           adam_w_mode=(base == "adamw"),
+                           **{k: v for k, v in params.items()
+                              if k in ("betas", "eps", "bias_correction")})
+    else:
+        inner = sgd(lr=lr, weight_decay=weight_decay,
+                    **{k: v for k, v in params.items()
+                       if k in ("momentum", "nesterov")})
+    return optax.chain(inner, per_leaf_scale())

@@ -1,0 +1,113 @@
+"""Activation checkpointing API (reference ``deepspeed.checkpointing``).
+
+Reference: ``deepspeed/runtime/activation_checkpointing/checkpointing.py`` —
+``configure()`` + ``checkpoint()`` wrap Megatron-style activation
+checkpointing (CPU checkpointing, partitioned activations across MP ranks,
+contiguous buffers, RNG state tracking).
+
+TPU-native mapping: rematerialization IS ``jax.checkpoint`` — XLA re-runs the
+wrapped computation in the backward pass; there is no autograd tape, no RNG
+state to save/restore (threefry keys are pure inputs), and "partitioned
+activations" falls out of the mesh sharding of whatever the wrapped function
+produces. ``configure()`` therefore only selects a rematerialization POLICY
+(which intermediates may be kept) and records the knob vocabulary for
+``ds_report``-style introspection; the storage-tier knobs the reference uses
+to shuffle activations to CPU are handled by the engine's offload states API
+instead.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+
+_config = {
+    "partition_activations": False,
+    "contiguous_memory_optimization": False,
+    "cpu_checkpointing": False,
+    "number_checkpoints": None,
+    "synchronize_checkpoint_boundary": False,
+    "profile": False,
+    "policy": None,
+}
+_configured = False
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None,
+              policy: Optional[str] = None):
+    """Record the reference knob vocabulary and pick a remat policy.
+
+    ``policy`` names a ``jax.checkpoint_policies`` entry (e.g.
+    ``"dots_saveable"``, ``"nothing_saveable"``,
+    ``"save_anything_except_these_names"`` callers should pass a policy
+    object instead). The storage knobs are accepted for config compatibility;
+    on TPU their work is done by XLA (rematerialization) and the engine
+    offload tiers, so they do not change the compiled program here.
+    """
+    if deepspeed_config is not None:
+        act = getattr(deepspeed_config, "activation_checkpointing", None)
+        if isinstance(deepspeed_config, dict):
+            act = deepspeed_config.get("activation_checkpointing")
+        if act is not None and not isinstance(act, dict):
+            act = {f: getattr(act, f) for f in
+                   ("partition_activations", "cpu_checkpointing",
+                    "contiguous_memory_optimization", "number_checkpoints",
+                    "synchronize_checkpoint_boundary", "profile", "policy")
+                   if hasattr(act, f)}
+        if act:
+            for key in ("partition_activations", "cpu_checkpointing",
+                        "contiguous_memory_optimization", "number_checkpoints",
+                        "synchronize_checkpoint_boundary", "profile",
+                        "policy"):
+                if key in act and act[key] is not None:
+                    _config[key] = act[key]
+    for key, val in (("partition_activations", partition_activations),
+                     ("contiguous_memory_optimization", contiguous_checkpointing),
+                     ("number_checkpoints", num_checkpoints),
+                     ("cpu_checkpointing", checkpoint_in_cpu),
+                     ("synchronize_checkpoint_boundary", synchronize),
+                     ("profile", profile), ("policy", policy)):
+        if val is not None:
+            _config[key] = val
+    global _configured
+    _configured = True
+
+
+def is_configured() -> bool:
+    """Whether :func:`configure` has run (reference lazy-config idiom:
+    ``if not is_configured(): configure(...)``)."""
+    return _configured
+
+
+def get_config() -> dict:
+    return dict(_config)
+
+
+def checkpoint(function: Callable, *args) -> Any:
+    """Reference ``checkpointing.checkpoint(fn, *args)``: run ``fn`` now,
+    rematerialize its intermediates in the backward pass."""
+    return checkpoint_wrapper(function, _config.get("policy"))(*args)
+
+
+def model_parallel_reconfigure_tp_seed(seed):
+    """Reference ``model_parallel_reconfigure_tp_seed`` reseeds a hidden
+    per-TP-rank RNG stream so dropout differs across ranks. JAX RNG is
+    functional — there is NO global stream this function could mutate, so the
+    caller MUST thread the returned key (the reference's call-for-side-effect
+    idiom cannot work here and would silently de-correlate nothing). Inside
+    ``shard_map`` over a 'tp' axis the key is folded with the rank's axis
+    index; outside, the base key is returned."""
+    key = jax.random.PRNGKey(seed)
+    try:
+        return jax.random.fold_in(key, jax.lax.axis_index("tp"))
+    except NameError:  # not inside a mapped 'tp' axis
+        return key
+
+
+def checkpoint_wrapper(function: Callable, policy: Optional[Any] = None):
+    """Return a remat-wrapped callable (decorator form)."""
+    if isinstance(policy, str):
+        policy = getattr(jax.checkpoint_policies, policy)
+    return jax.checkpoint(function, policy=policy) if policy is not None \
+        else jax.checkpoint(function)
