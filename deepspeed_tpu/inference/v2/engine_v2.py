@@ -35,6 +35,11 @@ class RaggedInferenceEngineConfig:
     kv_block_size: int = 32
     max_blocks_per_seq: int = 64
     dtype: str = "float32"
+    # KV pool storage dtype (reference FP-quantizer KV use case): e.g.
+    # "float8_e4m3fn" halves KV memory vs bf16; None = the compute dtype.
+    # Writers/readers already cast through the pool dtype, so this is purely
+    # a storage-precision knob; the gather path dequantizes on read.
+    kv_cache_dtype: Optional[str] = None
     greedy: bool = True
     temperature: float = 1.0
     seed: int = 0
@@ -70,9 +75,15 @@ class InferenceEngineV2:
         self.params = jax.tree.map(
             lambda x: jnp.asarray(x, dtype) if jnp.issubdtype(
                 jnp.asarray(x).dtype, jnp.floating) else jnp.asarray(x), params)
+        kv_dtype = jnp.dtype(c.kv_cache_dtype) if c.kv_cache_dtype else dtype
+        if c.attn_backend == "pallas" and kv_dtype != dtype:
+            raise ValueError(
+                "attn_backend='pallas' needs the KV pool in the compute "
+                "dtype; kv_cache_dtype storage quantization runs on the "
+                "gather (einsum) path — use attn_backend='auto' or 'einsum'")
         self.kv = BlockedKVCache(self.cfg.num_layers, c.num_kv_blocks,
                                  c.kv_block_size, self.cfg.kv_heads,
-                                 self.cfg.head_dim, dtype=dtype)
+                                 self.cfg.head_dim, dtype=kv_dtype)
         self.state_manager = DSStateManager(self.kv)
         self.wrapper = RaggedBatchWrapper(token_budget=c.token_budget,
                                           max_seqs=c.max_ragged_sequence_count,
@@ -81,7 +92,7 @@ class InferenceEngineV2:
         self._key = jax.random.PRNGKey(c.seed)
         if c.attn_backend == "auto":
             self.attn_impl = ("pallas" if jax.default_backend() == "tpu"
-                              else "einsum")
+                              and kv_dtype == dtype else "einsum")
             # fused decode: the paged kernel's pool operand gets re-laid-out
             # (copied) on every pallas_call inside the scan, so step time
             # grows with POOL size; the gather-einsum path reads only the

@@ -17,6 +17,7 @@ from typing import Any, Callable, NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 ScalarOrSchedule = Union[float, Callable[[jnp.ndarray], jnp.ndarray]]
@@ -275,23 +276,42 @@ def mu_optimizer(base: str, lr: float = 1e-3, weight_decay: float = 0.0,
     ``tests/unit/runtime/test_mup_optimizers.py``: ``MuAdam``/``MuSGD`` from
     the ``mup`` package applied through ``deepspeed.initialize``).
 
-    The μP learning-rate rule, expressed per leaf from its shape — no
-    ``set_base_shapes`` module surgery (there is no module to patch):
+    The μP learning-rate rule, expressed per leaf from its shape and name —
+    no ``set_base_shapes`` module surgery (there is no module to patch):
 
     * matrix-like params (ndim >= 2): Adam-family lr scales by
-      ``base_width / fan_in`` (the infinite-width transfer rule); SGD keeps
-      lr (its μP scaling folds into the init/width ratio).
+      ``base_width / fan_in``. The fan_in is the CONTRACTED extent, which a
+      shape alone cannot tell for DenseGeneral kernels — the AutoTP name
+      vocabulary decides: row-parallel names (o_proj/down_proj/...) contract
+      everything but the last dim; the default (col-parallel layout,
+      ``[fan_in, ...out]``) contracts the leading dim. SGD keeps lr (its μP
+      scaling folds into the init/width ratio).
+    * INPUT embedding tables (embedding/wte/word_embeddings names) are
+      vector-like in μP (vocab is a finite dim, not a width): unscaled.
+      ``lm_head`` IS width-contracted and scales normally.
     * vector/scalar params (biases, norms): Adam keeps lr, SGD scales by
       ``fan_out / base_width``.
 
     ``base_width`` is the tuned proxy model's width (``mup`` stores the same
     ratio in ``infshape``); width ratios of 1 reduce to the base optimizer.
     """
-    adam_family = base in ("adam", "adamw")
+    from ..module_inject.auto_tp import _ROW_PATTERNS, _matches
 
-    def scale_for(path_ignored, leaf):
-        if leaf.ndim >= 2:  # matrix-like: fan_in is the leading (input) dim
-            return base_width / leaf.shape[0] if adam_family else 1.0
+    adam_family = base in ("adam", "adamw")
+    _INPUT_EMBED = ("embedding", "embed_tokens", "wte", "word_embeddings",
+                    "type_embed", "pos_embed")
+
+    def scale_for(path, leaf):
+        name = "/".join(str(getattr(e, "key", getattr(e, "name", e)))
+                        for e in path).lower()
+        if leaf.ndim >= 2:
+            if _matches(_INPUT_EMBED, name):
+                return 1.0  # input tables: vocab is finite, not a width
+            if _matches(_ROW_PATTERNS, name):
+                fan_in = int(np.prod(leaf.shape[:-1]))
+            else:  # col layout [fan_in, ...out]
+                fan_in = leaf.shape[0]
+            return base_width / fan_in if adam_family else 1.0
         if leaf.ndim == 1 and not adam_family:
             return leaf.shape[0] / base_width
         return 1.0

@@ -30,6 +30,9 @@ _config = {
     "policy": None,
 }
 _configured = False
+_KEYS = ("partition_activations", "cpu_checkpointing",
+         "contiguous_memory_optimization", "number_checkpoints",
+         "synchronize_checkpoint_boundary", "profile", "policy")
 
 
 def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
@@ -50,16 +53,9 @@ def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
         if isinstance(deepspeed_config, dict):
             act = deepspeed_config.get("activation_checkpointing")
         if act is not None and not isinstance(act, dict):
-            act = {f: getattr(act, f) for f in
-                   ("partition_activations", "cpu_checkpointing",
-                    "contiguous_memory_optimization", "number_checkpoints",
-                    "synchronize_checkpoint_boundary", "profile", "policy")
-                   if hasattr(act, f)}
+            act = {f: getattr(act, f) for f in _KEYS if hasattr(act, f)}
         if act:
-            for key in ("partition_activations", "cpu_checkpointing",
-                        "contiguous_memory_optimization", "number_checkpoints",
-                        "synchronize_checkpoint_boundary", "profile",
-                        "policy"):
+            for key in _KEYS:
                 if key in act and act[key] is not None:
                     _config[key] = act[key]
     for key, val in (("partition_activations", partition_activations),

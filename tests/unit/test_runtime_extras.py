@@ -219,13 +219,16 @@ def test_activation_checkpointing_api():
 def test_mu_optimizers():
     """muAdam scales matrix-param lr by base_width/fan_in; muSGD scales
     vector params by fan_out/base_width (reference test_mup_optimizers)."""
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
     from deepspeed_tpu.ops.optimizers import build_optimizer
 
-    params = {"w": jnp.zeros((64, 4)), "b": jnp.zeros((4,))}
-    grads = {"w": jnp.ones((64, 4)), "b": jnp.ones((4,))}
+    params = {"w": jnp.zeros((64, 4)), "b": jnp.zeros((4,)),
+              "o_proj": {"kernel": jnp.zeros((8, 8, 4))},   # row: fan_in 64
+              "embed_tokens": {"embedding": jnp.zeros((1000, 4))}}
+    grads = jax.tree.map(jnp.ones_like, params)
 
     tx = build_optimizer("MuAdam", {"lr": 1e-2, "base_width": 16})
     state = tx.init(params)
@@ -233,6 +236,14 @@ def test_mu_optimizers():
     # adam step magnitude is ~lr per element; matrix gets * 16/64 = 0.25
     ratio = float(jnp.abs(upd["w"]).mean() / jnp.abs(upd["b"]).mean())
     np.testing.assert_allclose(ratio, 0.25, rtol=1e-3)
+    # 3-D row-parallel kernel contracts all but the last dim: 16/(8*8)
+    r3 = float(jnp.abs(upd["o_proj"]["kernel"]).mean()
+               / jnp.abs(upd["b"]).mean())
+    np.testing.assert_allclose(r3, 0.25, rtol=1e-3)
+    # input embedding tables are NOT width-scaled (vocab is finite)
+    re_ = float(jnp.abs(upd["embed_tokens"]["embedding"]).mean()
+                / jnp.abs(upd["b"]).mean())
+    np.testing.assert_allclose(re_, 1.0, rtol=1e-3)
 
     tx = build_optimizer("MuSGD", {"lr": 1e-2, "base_width": 2})
     state = tx.init(params)
