@@ -10,6 +10,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
+import os
 import time
 
 import jax
@@ -198,15 +199,42 @@ def decode_bench():
             "decode_attn": eng.decode_attn_impl}
 
 
+def _probe_accelerator(timeout_s: int = 180) -> bool:
+    """Whether the attached accelerator actually works.
+
+    A remote-attached TPU whose tunnel is wedged HANGS on first use rather
+    than failing, which would hang the whole bench; probe it in a subprocess
+    with a hard timeout so the bench always prints its one JSON line (on the
+    CPU fallback if need be)."""
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "y = jax.jit(lambda a: a @ a)(jnp.ones((256, 256), jnp.bfloat16));"
+             "jax.block_until_ready(y);"
+             "print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+        return r.returncode == 0 and r.stdout.strip() == "tpu"
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    if not _probe_accelerator():
+        # wedged or absent accelerator: pin THIS process to CPU before any
+        # backend initialization so the smoke path below still completes
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+
     import deepspeed_tpu as ds
     from deepspeed_tpu.models.transformer import (TransformerLM, init_params,
                                                   llama_config, make_loss_fn)
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
-
-    import os
 
     if on_tpu:
         # ~460M-param Llama shape: fits one chip with fp32 master + Adam

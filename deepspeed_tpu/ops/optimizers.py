@@ -307,10 +307,18 @@ def mu_optimizer(base: str, lr: float = 1e-3, weight_decay: float = 0.0,
         if leaf.ndim >= 2:
             if _matches(_INPUT_EMBED, name):
                 return 1.0  # input tables: vocab is finite, not a width
+            # stacked expert kernels [E, ...]: the leading expert dim is a
+            # batch dim, not a width — strip it before the fan_in rule
+            shape = leaf.shape
+            if _matches(("expert_gate_proj", "expert_up_proj",
+                         "expert_down_proj", "experts"), name):
+                shape = shape[1:]
+            if len(shape) < 2:
+                return 1.0
             if _matches(_ROW_PATTERNS, name):
-                fan_in = int(np.prod(leaf.shape[:-1]))
+                fan_in = int(np.prod(shape[:-1]))
             else:  # col layout [fan_in, ...out]
-                fan_in = leaf.shape[0]
+                fan_in = shape[0]
             return base_width / fan_in if adam_family else 1.0
         if leaf.ndim == 1 and not adam_family:
             return leaf.shape[0] / base_width

@@ -227,7 +227,8 @@ def test_mu_optimizers():
 
     params = {"w": jnp.zeros((64, 4)), "b": jnp.zeros((4,)),
               "o_proj": {"kernel": jnp.zeros((8, 8, 4))},   # row: fan_in 64
-              "embed_tokens": {"embedding": jnp.zeros((1000, 4))}}
+              "embed_tokens": {"embedding": jnp.zeros((1000, 4))},
+              "moe": {"expert_up_proj": jnp.zeros((2, 64, 8))}}  # E batch dim
     grads = jax.tree.map(jnp.ones_like, params)
 
     tx = build_optimizer("MuAdam", {"lr": 1e-2, "base_width": 16})
@@ -244,6 +245,11 @@ def test_mu_optimizers():
     re_ = float(jnp.abs(upd["embed_tokens"]["embedding"]).mean()
                 / jnp.abs(upd["b"]).mean())
     np.testing.assert_allclose(re_, 1.0, rtol=1e-3)
+    # stacked expert kernels [E, d, f]: the expert dim is NOT a width;
+    # fan_in = d -> 16/64
+    rex = float(jnp.abs(upd["moe"]["expert_up_proj"]).mean()
+                / jnp.abs(upd["b"]).mean())
+    np.testing.assert_allclose(rex, 0.25, rtol=1e-3)
 
     tx = build_optimizer("MuSGD", {"lr": 1e-2, "base_width": 2})
     state = tx.init(params)
