@@ -199,33 +199,13 @@ def decode_bench():
             "decode_attn": eng.decode_attn_impl}
 
 
-def _probe_accelerator(timeout_s: int = 180) -> bool:
-    """Whether the attached accelerator actually works.
-
-    A remote-attached TPU whose tunnel is wedged HANGS on first use rather
-    than failing, which would hang the whole bench; probe it in a subprocess
-    with a hard timeout so the bench always prints its one JSON line (on the
-    CPU fallback if need be)."""
-    import subprocess
-    import sys
-
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp;"
-             "y = jax.jit(lambda a: a @ a)(jnp.ones((256, 256), jnp.bfloat16));"
-             "jax.block_until_ready(y);"
-             "print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=timeout_s)
-        return r.returncode == 0 and r.stdout.strip() == "tpu"
-    except subprocess.TimeoutExpired:
-        return False
-
-
 def main():
-    if not _probe_accelerator():
-        # wedged or absent accelerator: pin THIS process to CPU before any
-        # backend initialization so the smoke path below still completes
+    from deepspeed_tpu.utils.health import accelerator_healthy
+
+    if not accelerator_healthy():
+        # wedged accelerator: pin THIS process to CPU before any backend
+        # initialization so the smoke path below still completes (a healthy
+        # non-TPU backend passes the probe and keeps its platform)
         os.environ["JAX_PLATFORMS"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
 

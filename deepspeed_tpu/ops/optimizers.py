@@ -307,11 +307,15 @@ def mu_optimizer(base: str, lr: float = 1e-3, weight_decay: float = 0.0,
         if leaf.ndim >= 2:
             if _matches(_INPUT_EMBED, name):
                 return 1.0  # input tables: vocab is finite, not a width
-            # stacked expert kernels [E, ...]: the leading expert dim is a
-            # batch dim, not a width — strip it before the fan_in rule
+            # STACKED expert leaves [E, ...]: the leading expert dim is a
+            # batch dim, not a width — strip it before the fan_in rule.
+            # Stacked biases [E, f] then fall to vector-like (scale 1.0);
+            # unstacked per-expert kernels (e.g. 'experts/0/up_proj') keep
+            # their normal 2-D treatment.
             shape = leaf.shape
             if _matches(("expert_gate_proj", "expert_up_proj",
-                         "expert_down_proj", "experts"), name):
+                         "expert_down_proj", "expert_gate_bias",
+                         "expert_up_bias", "expert_down_bias"), name):
                 shape = shape[1:]
             if len(shape) < 2:
                 return 1.0

@@ -1,0 +1,24 @@
+"""Accelerator health probe.
+
+A remote-attached TPU whose tunnel is wedged HANGS on first use rather than
+failing; probing in a subprocess with a hard timeout lets callers (bench.py,
+__graft_entry__.py) fall back to CPU instead of hanging forever.
+"""
+
+import subprocess
+import sys
+
+_PROBE = ("import jax, jax.numpy as jnp;"
+          "y = jax.jit(lambda a: a @ a)(jnp.ones((256, 256), jnp.bfloat16));"
+          "jax.block_until_ready(y); print('ok')")
+
+
+def accelerator_healthy(timeout_s: int = 180) -> bool:
+    """Whether the default jax backend completes a tiny jitted matmul within
+    ``timeout_s`` (any platform counts as healthy; only a hang/crash fails)."""
+    try:
+        r = subprocess.run([sys.executable, "-c", _PROBE],
+                           capture_output=True, text=True, timeout=timeout_s)
+        return r.returncode == 0 and r.stdout.strip().endswith("ok")
+    except subprocess.TimeoutExpired:
+        return False
