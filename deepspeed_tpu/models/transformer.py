@@ -61,6 +61,7 @@ class TransformerConfig:
     alibi_post_scale: bool = False
     lm_head_bias: bool = False                  # gpt-j / phi biased lm_head
     no_lm_head: bool = False                    # clip text encoder: return hidden states
+    vocab_parallel_loss: bool = False           # tp-sharded CE (sequence/cross_entropy.py)
     attn_scale: Optional[float] = None          # gpt-neo trains UNSCALED (1.0)
     # per-layer attention windows (gpt-neo local attention): tuple with one
     # entry per layer, None = global; e.g. (None, 256, None, 256, ...)
@@ -532,7 +533,7 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, deterministic=True, cache=None, cache_index=None,
                  whole_prefill=False, frozen_cache=None, window=None,
-                 window_t=None, frozen_len=None):
+                 window_t=None, frozen_len=None, return_hidden=False):
         """Training/eval: ``logits = __call__(tokens)``. Incremental decode
         (inference v1): pass ``cache`` (see ``init_kv_cache``) + per-sequence
         write offsets ``cache_index [B]`` → ``(logits, new_cache)``.
@@ -578,7 +579,7 @@ class TransformerLM(nn.Module):
             else:
                 x = block(cfg, i, name=name)(x, deterministic)
         x = _norm(cfg, "final_norm")(x)
-        if cfg.no_lm_head:  # clip text encoder: normalized hidden states
+        if cfg.no_lm_head or return_hidden:  # clip text / vocab-parallel loss
             return (x, new_cache) if cache is not None else x
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
@@ -629,28 +630,47 @@ def causal_lm_loss(logits, tokens, loss_mask=None, z_loss: float = 0.0):
 
 
 def make_loss_fn(model: TransformerLM):
-    """Engine-compatible ``loss = f(params, batch, rng)``; adds MoE aux loss."""
+    """Engine-compatible ``loss = f(params, batch, rng)``; adds MoE aux loss.
+
+    With ``cfg.vocab_parallel_loss`` the lm-head matmul + CE run vocab-sharded
+    over tp via ``sequence.sharded_lm_loss`` — full-vocab logits are never
+    materialised (reference ``sequence/cross_entropy.py`` capability).
+    """
     cfg = model.cfg
+
+    def _head_kernel_bias(params):
+        if cfg.tie_embeddings:
+            return params["embed"]["embedding"].T, None
+        head = params["lm_head"]
+        return head["kernel"], head.get("bias")
+
+    def _ce(out, params, tokens, mask):
+        if cfg.vocab_parallel_loss:
+            from ..sequence.cross_entropy import sharded_lm_loss
+            kernel, bias = _head_kernel_bias(params)
+            return sharded_lm_loss(out, kernel, tokens, loss_mask=mask,
+                                   head_bias=bias)
+        return causal_lm_loss(out, tokens, mask)
 
     def loss_fn(params, batch, rng=None):
         tokens = batch["tokens"] if isinstance(batch, dict) else batch
         mask = batch.get("loss_mask") if isinstance(batch, dict) else None
-        kwargs = {}
+        kwargs = {"return_hidden": True} if cfg.vocab_parallel_loss else {}
         deterministic = True
         if rng is not None and cfg.dropout > 0:
             kwargs["rngs"] = {"dropout": rng}
             deterministic = False
         if cfg.num_experts > 0:
-            logits, mod_vars = model.apply({"params": params}, tokens,
-                                           deterministic=deterministic,
-                                           mutable=["intermediates"], **kwargs)
+            out, mod_vars = model.apply({"params": params}, tokens,
+                                        deterministic=deterministic,
+                                        mutable=["intermediates"], **kwargs)
             flat = jax.tree_util.tree_flatten_with_path(mod_vars.get("intermediates", {}))[0]
             aux_losses = [leaf for path, leaf in flat
                           if any("moe_aux_loss" in str(getattr(e, "key", e)) for e in path)]
             aux = sum(aux_losses) / max(len(aux_losses), 1) if aux_losses else 0.0
-            return causal_lm_loss(logits, tokens, mask) + aux
-        logits = model.apply({"params": params}, tokens, deterministic=deterministic, **kwargs)
-        return causal_lm_loss(logits, tokens, mask)
+            return _ce(out, params, tokens, mask) + aux
+        out = model.apply({"params": params}, tokens, deterministic=deterministic, **kwargs)
+        return _ce(out, params, tokens, mask)
 
     return loss_fn
 

@@ -1,4 +1,8 @@
+from .cross_entropy import (sharded_lm_loss, vocab_parallel_cross_entropy,
+                            vocab_sequence_parallel_cross_entropy)
 from .layer import ulysses_attention
 from .ring import ring_attention, ring_attention_local
 
-__all__ = ["ulysses_attention", "ring_attention", "ring_attention_local"]
+__all__ = ["ulysses_attention", "ring_attention", "ring_attention_local",
+           "vocab_parallel_cross_entropy", "vocab_sequence_parallel_cross_entropy",
+           "sharded_lm_loss"]

@@ -6,9 +6,23 @@ before any local attention, reverse after. On TPU the all-to-all is a native
 ICI collective (``lax.all_to_all`` over the ``sp`` mesh axis inside
 ``shard_map``); comm volume stays O(N/P) per the Ulysses design.
 
-GQA/uneven heads (reference ``uneven_heads_all2all:43``): when kv heads don't
-divide the sp degree they are replicated up to the q-head count before the
-exchange.
+GQA/uneven heads (reference ``uneven_heads_all2all:43``): the reference moves
+kv tensors with an uneven-split ``all_to_all_single`` so each rank ends with
+its own (possibly 0-or-1 extra) kv heads. Uneven per-rank shapes are hostile
+to XLA's static SPMD model, so the TPU design is different but moves the same
+bytes: when ``hk < sp`` (the GQA regime Ulysses targets) the kv exchange is a
+two-phase subgroup collective —
+
+  1. ``all_to_all`` within the ``hk`` rank-subgroups that share a residue
+     ``r % (sp/hk)``: splits the kv-head axis (one head per subgroup member),
+     concatenates partial sequence.  Bytes/rank: ``S*hk*D/sp``.
+  2. ``all_gather`` within the ``sp/hk`` rank-subgroups that share a kv head:
+     assembles the full sequence for that head.  Bytes/rank: ``~S*D``.
+
+Total ``~S*D(1 + hk/sp)`` per rank vs ``S*h*D/sp`` for replicate-then-a2a — a
+``~h/hk`` reduction, matching the reference's uneven-head saving. Head counts
+not divisible by sp are padded up to alignment (TPU-idiomatic: pad, don't go
+ragged) and sliced back after the reverse exchange.
 """
 
 from typing import Callable
@@ -30,6 +44,41 @@ def _all_to_all_seq_to_heads(x, sp: int):
     return jax.lax.all_to_all(x, SP_AXIS, split_axis=1, concat_axis=2, tiled=True)
 
 
+def _uneven_kv_exchange(x, sp: int, hk: int):
+    """GQA kv exchange for ``hk < sp``: [B, S/sp, hk, D] -> [B, S, 1, D].
+
+    Rank ``r`` (over the sp axis) ends holding kv head ``r // (sp/hk)`` over
+    the *full* sequence — exactly the head its post-exchange q block attends
+    to. Two subgroup collectives (see module docstring); both ride ICI.
+    Requires ``sp % hk == 0`` (callers pad hk up to a divisor of sp first).
+    """
+    rep = sp // hk
+    b, s_loc, _, d = x.shape
+    # Phase 1: a2a among ranks {kvg*rep + j : kvg} for each residue j — one kv
+    # head per member, partial sequence (hk chunks of the global S/sp grid).
+    g1 = [[kvg * rep + j for kvg in range(hk)] for j in range(rep)]
+    x = jax.lax.all_to_all(x, SP_AXIS, split_axis=2, concat_axis=1, tiled=True,
+                           axis_index_groups=g1)  # [B, S_loc*hk, 1, D]
+    # Phase 2: gather the remaining sequence chunks from the ranks that share
+    # this kv head (residues j = 0..rep-1).
+    g2 = [[kvg * rep + j for j in range(rep)] for kvg in range(hk)]
+    x = jax.lax.all_gather(x, SP_AXIS, axis=1, tiled=True,
+                           axis_index_groups=g2)  # [B, S_loc*hk*rep, 1, D]
+    # Gathered chunk order is (j, kvg)-major; global chunk c = kvg*rep + j is
+    # kvg-major — a static transpose restores sequence order.
+    x = x.reshape(b, rep, hk, s_loc, 1, d)
+    x = jnp.transpose(x, (0, 2, 1, 3, 4, 5))
+    return x.reshape(b, rep * hk * s_loc, 1, d)
+
+
+def _kv_head_map(h_padded: int, hk: int, group: int):
+    """Static q-head -> kv-head index map. ``group`` is the TRUE GQA ratio
+    (unpadded h // hk) — padded q heads clamp to the last kv head (their
+    output is sliced away)."""
+    return jnp.asarray([min(j // group, hk - 1) for j in range(h_padded)],
+                       dtype=jnp.int32)
+
+
 def ulysses_attention(local_attn: Callable, q, k, v):
     """Run ``local_attn(q, k, v, positions)`` under Ulysses SP.
 
@@ -38,6 +87,16 @@ def ulysses_attention(local_attn: Callable, q, k, v):
     holds ``S/sp`` of the sequence with all heads; after the exchange it holds
     the full sequence with ``H/sp`` heads — any local attention (including the
     Pallas flash kernel) then works unchanged, with global positions.
+
+    KV routing per (local) head counts, chosen inside the body where shapes
+    are per-shard (so TP composition sees tp-local head counts):
+      * ``hk % sp == 0``  — even all-to-all, the reference's fast path.
+      * ``sp % hk == 0``  — uneven-head subgroup exchange (module docstring):
+        each rank receives exactly the one kv head its q block attends to,
+        cutting kv bytes ~``h/hk``× vs replication.
+      * otherwise        — explicit-index replication fallback (correct for
+        any h/hk, costs the replicated bytes; also used when ``h % sp != 0``
+        forces q-head padding, which breaks group alignment).
     """
     topo = get_topology()
     sp = topo.sp_size
@@ -45,27 +104,58 @@ def ulysses_attention(local_attn: Callable, q, k, v):
         return local_attn(q, k, v, None)
 
     h, hk = q.shape[2], k.shape[2]
-    if hk % sp != 0:  # GQA uneven heads: replicate kv up to q heads
-        rep = h // hk
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    if h % sp != 0:
-        raise ValueError(f"num_heads={h} must be divisible by sp={sp}")
-
     mesh = topo.mesh
     dp = topo.dp_axes
     # Compose with TP: heads arrive column-parallel over 'tp'; keep them
     # sharded through the exchange so no tp all-gather is forced.
     tp = topo.tp_size
-    heads_axis = "tp" if (tp > 1 and h % (sp * tp) == 0 and k.shape[2] % (sp * tp) == 0) else None
-    io_spec = P(dp, SP_AXIS, heads_axis, None)
+    heads_axis = "tp" if (tp > 1 and h % (sp * tp) == 0 and hk % tp == 0) else None
+    q_spec = P(dp, SP_AXIS, heads_axis, None)
+    kv_spec = P(dp, SP_AXIS, heads_axis, None)
+    h_pad = -(-h // (sp * (tp if heads_axis else 1))) * sp * (tp if heads_axis else 1)
+    if h_pad != h:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, h_pad - h), (0, 0)))
 
     def body(q_, k_, v_):
+        hl, hkl = q_.shape[2], k_.shape[2]  # tp-local head counts
         qg = _all_to_all_heads_to_seq(q_, sp)
-        kg = _all_to_all_heads_to_seq(k_, sp)
-        vg = _all_to_all_heads_to_seq(v_, sp)
+        if hkl % sp == 0:
+            kg = _all_to_all_heads_to_seq(k_, sp)
+            vg = _all_to_all_heads_to_seq(v_, sp)
+        elif sp % hkl == 0 and h_pad == h and hl % sp == 0:
+            _ledger_note("ulysses_kv_uneven", k_, sp, hkl)
+            kg = _uneven_kv_exchange(k_, sp, hkl)
+            vg = _uneven_kv_exchange(v_, sp, hkl)
+        else:
+            # Replication fallback: gather each q head's kv explicitly so any
+            # h/hk ratio (incl. padded q heads) stays correct, then even a2a.
+            # Group ratio comes from TRUE head counts (padding would skew it).
+            idx = _kv_head_map(hl, hkl, max(1, (h // (1 if heads_axis is None else tp)) // hkl))
+            _ledger_note("ulysses_kv_replicated", k_, sp, hkl, rep=hl)
+            kg = _all_to_all_heads_to_seq(jnp.take(k_, idx, axis=2), sp)
+            vg = _all_to_all_heads_to_seq(jnp.take(v_, idx, axis=2), sp)
         out = local_attn(qg, kg, vg, None)  # full seq -> global positions
         return _all_to_all_seq_to_heads(out, sp)
 
-    return jax.shard_map(body, mesh=mesh, in_specs=(io_spec, io_spec, io_spec),
-                         out_specs=io_spec, check_vma=False)(q, k, v)
+    out = jax.shard_map(body, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec),
+                        out_specs=q_spec, check_vma=False)(q, k, v)
+    return out[:, :, :h, :] if h_pad != h else out
+
+
+def _ledger_note(op: str, k_local, sp: int, hk_local: int, rep: int = 1):
+    """Record kv-exchange bytes in the comms ledger at trace time, so the
+    uneven-head saving is observable (uneven path: ~S*D*(1+hk/sp)/rank vs
+    replicated: S*rep*D/sp with rep up to h)."""
+    try:
+        from ..comm.comm import get_comms_logger
+    except Exception:  # pragma: no cover
+        return
+    b, s_loc, _, d = k_local.shape
+    itemsize = jnp.dtype(k_local.dtype).itemsize
+    if op == "ulysses_kv_uneven":
+        nbytes = b * s_loc * hk_local * d * itemsize  # phase 1 send
+        nbytes += b * s_loc * hk_local * d * itemsize * max(0, sp // hk_local - 1)  # phase 2
+    else:
+        nbytes = b * s_loc * rep * d * itemsize  # replicated heads through the a2a
+    get_comms_logger().append(op, 2 * nbytes, traced=True)  # k and v
+

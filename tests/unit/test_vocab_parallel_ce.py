@@ -1,0 +1,218 @@
+"""Vocab-parallel cross entropy + uneven-head Ulysses exchange.
+
+Parity targets: reference ``deepspeed/sequence/cross_entropy.py`` (loss against
+vocab-sharded logits, no full gather) and ``sequence/layer.py:43``
+``uneven_heads_all2all`` (GQA kv moved without replicating up to q heads).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.transformer import (TransformerConfig, TransformerLM,
+                                              attention_core, causal_lm_loss,
+                                              init_params, make_loss_fn)
+from deepspeed_tpu.parallel import Topology, TopologySpec, set_topology
+from deepspeed_tpu.sequence import (sharded_lm_loss, ulysses_attention,
+                                    vocab_parallel_cross_entropy,
+                                    vocab_sequence_parallel_cross_entropy)
+
+
+def _dense_ce(logits, targets):
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return logz - tgt
+
+
+def teardown_function(_):
+    set_topology(Topology(TopologySpec()))
+
+
+def test_vocab_parallel_ce_matches_dense():
+    topo = Topology(TopologySpec(tp=4))
+    set_topology(topo)
+    b, s, v = 2, 8, 64
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(b, s, v)), jnp.float32)
+    targets = jnp.asarray(rng.integers(0, v, (b, s)), jnp.int32)
+
+    got = jax.jit(lambda lg, tg: vocab_sequence_parallel_cross_entropy(lg, tg))(
+        logits, targets)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(_dense_ce(logits, targets)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_vocab_parallel_ce_gradient_matches_dense():
+    """grad must be the Megatron softmax-minus-onehot, still vocab-sharded."""
+    topo = Topology(TopologySpec(tp=4))
+    set_topology(topo)
+    b, s, v = 2, 8, 32
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(b, s, v)), jnp.float32)
+    targets = jnp.asarray(rng.integers(0, v, (b, s)), jnp.int32)
+
+    g_ref = jax.grad(lambda lg: jnp.mean(_dense_ce(lg, targets)))(logits)
+    g_got = jax.jit(jax.grad(
+        lambda lg: jnp.mean(vocab_sequence_parallel_cross_entropy(lg, targets))))(logits)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_lm_loss_matches_dense_incl_grads():
+    topo = Topology(TopologySpec(tp=2, sp=2))
+    set_topology(topo)
+    b, s, e, v = 2, 8, 16, 64
+    rng = np.random.default_rng(2)
+    hidden = jnp.asarray(rng.normal(size=(b, s, e)), jnp.float32)
+    kernel = jnp.asarray(rng.normal(size=(e, v)) * 0.1, jnp.float32)
+    bias = jnp.asarray(rng.normal(size=(v,)) * 0.1, jnp.float32)
+    tokens = jnp.asarray(rng.integers(0, v, (b, s)), jnp.int32)
+    mask = jnp.asarray(rng.integers(0, 2, (b, s)), jnp.int32)
+
+    def dense(h, k, bs):
+        logits = h @ k + bs
+        return causal_lm_loss(logits, tokens, loss_mask=mask)
+
+    def sharded(h, k, bs):
+        return sharded_lm_loss(h, k, tokens, loss_mask=mask, head_bias=bs)
+
+    ref, g_ref = jax.value_and_grad(dense, argnums=(0, 1, 2))(hidden, kernel, bias)
+    got, g_got = jax.jit(jax.value_and_grad(sharded, argnums=(0, 1, 2)))(
+        hidden, kernel, bias)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+    for a, b_ in zip(g_got, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-5)
+
+
+def test_model_vocab_parallel_loss_matches_dense():
+    """TransformerLM(vocab_parallel_loss=True) at tp=2 == dense loss, and the
+    engine trains with it (the full ZeRO-3 x tp composition)."""
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                            num_layers=2, num_heads=4, max_seq_len=16,
+                            dtype=jnp.float32)
+    set_topology(Topology(TopologySpec()))
+    params = init_params(TransformerLM(cfg), seq=16)
+    toks = jnp.asarray(np.random.default_rng(3).integers(0, 64, (4, 16)), jnp.int32)
+    dense_loss = make_loss_fn(TransformerLM(cfg))(params, toks)
+
+    topo = Topology(TopologySpec(tp=2))
+    set_topology(topo)
+    vp_cfg = dataclasses.replace(cfg, vocab_parallel_loss=True)
+    vp_loss = jax.jit(make_loss_fn(TransformerLM(vp_cfg)))(params, toks)
+    np.testing.assert_allclose(float(vp_loss), float(dense_loss), rtol=1e-5)
+
+    engine, *_ = ds.initialize(
+        model=make_loss_fn(TransformerLM(vp_cfg)), model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+                "tensor_parallel": {"tp_size": 2},
+                "zero_optimization": {"stage": 3}, "steps_per_print": 1000},
+        topology=topo)
+    losses = [float(engine.train_batch(toks)) for _ in range(5)]
+    np.testing.assert_allclose(losses[0], float(dense_loss), rtol=1e-4)
+    assert losses[-1] < losses[0], losses
+
+
+def test_model_vocab_parallel_tied_embeddings():
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                            num_layers=1, num_heads=4, max_seq_len=16,
+                            tie_embeddings=True, dtype=jnp.float32)
+    set_topology(Topology(TopologySpec()))
+    params = init_params(TransformerLM(cfg), seq=16)
+    toks = jnp.asarray(np.random.default_rng(5).integers(0, 64, (4, 16)), jnp.int32)
+    dense_loss = make_loss_fn(TransformerLM(cfg))(params, toks)
+    set_topology(Topology(TopologySpec(tp=4)))
+    vp_cfg = dataclasses.replace(cfg, vocab_parallel_loss=True)
+    vp_loss = jax.jit(make_loss_fn(TransformerLM(vp_cfg)))(params, toks)
+    np.testing.assert_allclose(float(vp_loss), float(dense_loss), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Uneven-head Ulysses kv exchange
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("heads,kv_heads", [
+    (8, 2),   # sp % hk == 0: subgroup exchange path
+    (8, 1),   # MQA: degenerates to a kv all_gather
+    (8, 4),   # hk == sp: even a2a path
+    (12, 3),  # h not multiple of (sp*..)? 12 % 4 == 0; hk=3: fallback (3∤4, 4%3≠0)
+    (6, 3),   # h % sp != 0: q-head padding + fallback
+    (6, 2),   # h % sp != 0 with sp % hk == 0: padding forces fallback
+])
+def test_ulysses_gqa_paths_match_dense(heads, kv_heads):
+    topo = Topology(TopologySpec(sp=4))
+    set_topology(topo)
+    b, s, d = 2, 32, 16
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(b, s, heads, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, kv_heads, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, kv_heads, d)), jnp.float32)
+
+    def local_attn(q_, k_, v_, pos):
+        return attention_core(q_, k_, v_, causal=True, impl="xla")
+
+    ref = attention_core(q, k, v, causal=True, impl="xla")
+    out = jax.jit(lambda a, b_, c: ulysses_attention(local_attn, a, b_, c))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_gqa_gradients_flow():
+    """The subgroup-collective path must be differentiable (training uses it)."""
+    topo = Topology(TopologySpec(sp=4))
+    set_topology(topo)
+    b, s, h, hk, d = 2, 16, 8, 2, 8
+    rng = np.random.default_rng(8)
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, hk, d)), jnp.float32)
+
+    def local_attn(q_, k_, v_, pos):
+        return attention_core(q_, k_, v_, causal=True, impl="xla")
+
+    def f(q_, k_, v_):
+        return jnp.sum(ulysses_attention(local_attn, q_, k_, v_) ** 2)
+
+    g_got = jax.jit(jax.grad(f, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(lambda a, b_, c: jnp.sum(
+        attention_core(a, b_, c, causal=True, impl="xla") ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_got, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_uneven_kv_ledger_bytes_drop():
+    """Comms ledger records the uneven exchange moving ~h/hk fewer kv bytes
+    than the replication fallback would (VERDICT r3 'done' criterion)."""
+    from deepspeed_tpu.comm.comm import get_comms_logger
+
+    topo = Topology(TopologySpec(sp=4))
+    set_topology(topo)
+    logger = get_comms_logger()
+    logger.configure(enabled=True)
+    logger.comms_dict.clear()
+    b, s, h, hk, d = 2, 32, 8, 2, 16
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, hk, d)), jnp.float32)
+
+    def local_attn(q_, k_, v_, pos):
+        return attention_core(q_, k_, v_, causal=True, impl="xla")
+
+    jax.jit(lambda a, b_, c: ulysses_attention(local_attn, a, b_, c))(q, k, v)
+    rec = logger.comms_dict
+    logger.configure(enabled=False)
+    assert "ulysses_kv_uneven" in rec and "ulysses_kv_replicated" not in rec
+    uneven_bytes = sum(rec["ulysses_kv_uneven"].keys())
+    itemsize = 4
+    # replication would push h (=8) heads per rank through the a2a
+    replicated_bytes = 2 * b * (s // 4) * h * d * itemsize
+    assert uneven_bytes < replicated_bytes / 2, (uneven_bytes, replicated_bytes)
