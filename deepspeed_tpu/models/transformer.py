@@ -580,7 +580,7 @@ class TransformerLM(nn.Module):
                 x = block(cfg, i, name=name)(x, deterministic)
         x = _norm(cfg, "final_norm")(x)
         if cfg.no_lm_head or return_hidden:  # clip text / vocab-parallel loss
-            return (x, new_cache) if cache is not None else x
+            return (x, new_cache) if (cache is not None or window is not None) else x
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
         else:
@@ -637,6 +637,9 @@ def make_loss_fn(model: TransformerLM):
     materialised (reference ``sequence/cross_entropy.py`` capability).
     """
     cfg = model.cfg
+    if cfg.vocab_parallel_loss and cfg.no_lm_head:
+        raise ValueError("vocab_parallel_loss needs an lm head; "
+                         "no_lm_head=True models have no vocab projection")
 
     def _head_kernel_bias(params):
         if cfg.tie_embeddings:

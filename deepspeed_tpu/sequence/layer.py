@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.topology import SP_AXIS, get_topology
+from ..parallel.topology import SP_AXIS, TP_AXIS, get_topology
 
 
 def _all_to_all_heads_to_seq(x, sp: int):
@@ -107,19 +107,32 @@ def ulysses_attention(local_attn: Callable, q, k, v):
     mesh = topo.mesh
     dp = topo.dp_axes
     # Compose with TP: heads arrive column-parallel over 'tp'; keep them
-    # sharded through the exchange so no tp all-gather is forced.
+    # sharded through the exchange so no tp all-gather is forced. q and kv
+    # shard independently — MQA/low-kv GQA keeps q over tp even when the kv
+    # head count can't split (kv then routes via the tp-offset-aware map).
     tp = topo.tp_size
-    heads_axis = "tp" if (tp > 1 and h % (sp * tp) == 0 and hk % tp == 0) else None
-    q_spec = P(dp, SP_AXIS, heads_axis, None)
-    kv_spec = P(dp, SP_AXIS, heads_axis, None)
-    h_pad = -(-h // (sp * (tp if heads_axis else 1))) * sp * (tp if heads_axis else 1)
+    q_axis = "tp" if (tp > 1 and h % (sp * tp) == 0) else None
+    kv_axis = "tp" if (q_axis is not None and hk % tp == 0) else None
+    q_spec = P(dp, SP_AXIS, q_axis, None)
+    kv_spec = P(dp, SP_AXIS, kv_axis, None)
+    h_pad = h if q_axis else -(-h // sp) * sp
     if h_pad != h:
         q = jnp.pad(q, ((0, 0), (0, 0), (0, h_pad - h), (0, 0)))
+    g_true = max(1, h // hk)  # TRUE GQA ratio (padding would skew it)
 
     def body(q_, k_, v_):
         hl, hkl = q_.shape[2], k_.shape[2]  # tp-local head counts
         qg = _all_to_all_heads_to_seq(q_, sp)
-        if hkl % sp == 0:
+        if q_axis is not None and kv_axis is None and tp > 1:
+            # q is tp-sharded, kv is not: this shard's q block starts at a
+            # tp-dependent global head offset, so the kv head each local q
+            # head needs is a traced index — gather it, then even a2a.
+            tp_off = jax.lax.axis_index(TP_AXIS) * hl
+            idx = jnp.minimum((tp_off + jnp.arange(hl)) // g_true, hkl - 1)
+            _ledger_note("ulysses_kv_replicated", k_, sp, hkl, rep=hl)
+            kg = _all_to_all_heads_to_seq(jnp.take(k_, idx, axis=2), sp)
+            vg = _all_to_all_heads_to_seq(jnp.take(v_, idx, axis=2), sp)
+        elif hkl % sp == 0:
             kg = _all_to_all_heads_to_seq(k_, sp)
             vg = _all_to_all_heads_to_seq(v_, sp)
         elif sp % hkl == 0 and h_pad == h and hl % sp == 0:
@@ -129,8 +142,7 @@ def ulysses_attention(local_attn: Callable, q, k, v):
         else:
             # Replication fallback: gather each q head's kv explicitly so any
             # h/hk ratio (incl. padded q heads) stays correct, then even a2a.
-            # Group ratio comes from TRUE head counts (padding would skew it).
-            idx = _kv_head_map(hl, hkl, max(1, (h // (1 if heads_axis is None else tp)) // hkl))
+            idx = _kv_head_map(hl, hkl, g_true)  # local ratio == global ratio
             _ledger_note("ulysses_kv_replicated", k_, sp, hkl, rep=hl)
             kg = _all_to_all_heads_to_seq(jnp.take(k_, idx, axis=2), sp)
             vg = _all_to_all_heads_to_seq(jnp.take(v_, idx, axis=2), sp)

@@ -1,0 +1,32 @@
+"""Entry point for one spawned distributed-test process.
+
+``python -m tests.unit.multiprocess._worker pkg.module:function``
+
+Rendezvous goes through the PRODUCTION path — ``deepspeed_tpu.
+init_distributed()`` reading DSTPU_COORDINATOR / DSTPU_NUM_PROCESSES /
+DSTPU_PROCESS_ID from the environment (the same contract ``launcher/launch.py``
+sets for real multi-host runs) — so the bootstrap code itself is under test,
+not just the function that follows it.
+"""
+
+import importlib
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    target = sys.argv[1]
+    import deepspeed_tpu as ds
+
+    ds.init_distributed()  # env rendezvous: the code under test
+    mod_name, fn_name = target.split(":")
+    fn = getattr(importlib.import_module(mod_name), fn_name)
+    fn()
+    print(f"WORKER_OK rank={jax.process_index()}/{jax.process_count()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -163,6 +163,27 @@ def test_ulysses_gqa_paths_match_dense(heads, kv_heads):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("heads,kv_heads", [(8, 1), (8, 2), (8, 4)])
+def test_ulysses_mqa_composes_with_tp(heads, kv_heads):
+    """q stays tp-sharded through the exchange even when kv heads cannot
+    split over tp (MQA/low-kv GQA) — the tp-offset-aware kv map routes each
+    tp shard's q block to its true kv head."""
+    topo = Topology(TopologySpec(sp=2, tp=2))
+    set_topology(topo)
+    b, s, d = 2, 16, 8
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.normal(size=(b, s, heads, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, kv_heads, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, kv_heads, d)), jnp.float32)
+
+    def local_attn(q_, k_, v_, pos):
+        return attention_core(q_, k_, v_, causal=True, impl="xla")
+
+    ref = attention_core(q, k, v, causal=True, impl="xla")
+    out = jax.jit(lambda a, b_, c: ulysses_attention(local_attn, a, b_, c))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
 def test_ulysses_gqa_gradients_flow():
     """The subgroup-collective path must be differentiable (training uses it)."""
     topo = Topology(TopologySpec(sp=4))
