@@ -81,12 +81,20 @@ class Autotuner:
         self.results: List[Experiment] = []
 
     def tune(self, loss_fn: Callable, params: Any, batch_fn: Callable[[int], Any],
-             stages=(0, 1, 2, 3), micro_batches: Optional[List[int]] = None) -> Dict:
+             stages=(0, 1, 2, 3), micro_batches: Optional[List[int]] = None,
+             tuner_type: str = "gridsearch") -> Dict:
         """``batch_fn(global_batch_size) -> batch``. Returns the best full
-        config (base + winning overrides)."""
+        config (base + winning overrides).
+
+        ``tuner_type``: ``gridsearch`` (exhaustive), ``random``, or ``model``
+        — the cost-model-guided search (reference ``model_based_tuner.py``)
+        that reaches the best config in fewer trials; see
+        ``autotuning/tuner.py``."""
         import jax
 
         import deepspeed_tpu as ds
+
+        from .tuner import TUNERS
 
         ndev = len(jax.devices())
         param_count = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params)
@@ -95,7 +103,8 @@ class Autotuner:
                                     self.hbm_bytes, stages, micro_batches)
         if not exps:
             raise RuntimeError("autotuner: every candidate was memory-pruned")
-        for exp in exps:
+
+        def evaluate(exp) -> Optional[float]:
             cfg = _merge(self.base_config, exp.overrides)
             try:
                 engine, _, _, _ = ds.initialize(model=loss_fn,
@@ -115,11 +124,14 @@ class Autotuner:
                 exp.error = str(e).splitlines()[0][:120]
                 logger.warning(f"autotuner: {exp.name} failed: {exp.error}")
             self.results.append(exp)
-        best = max((e for e in self.results if e.metric_value is not None),
-                   key=lambda e: e.metric_value, default=None)
+            return exp.metric_value
+
+        tuner = TUNERS[tuner_type](exps, metric=self.metric)
+        best = tuner.tune(evaluate)
         if best is None:
             raise RuntimeError("autotuner: all experiments failed")
         self.best = best
+        self.trials_run = tuner.trials_run
         return _merge(self.base_config, best.overrides)
 
     def summary(self) -> str:

@@ -36,7 +36,83 @@ from ..module_inject.auto_tp import (flatten_with_paths,
 from ..utils.logging import log_dist
 
 __all__ = ["SDLoaderFactory", "merge_state_dicts", "split_state_dict",
-           "merge_qkv", "split_qkv"]
+           "merge_qkv", "split_qkv", "megatron_specs", "save_shard_npz"]
+
+# reserved npz key: sidecar list of leaf paths a split pass replicated
+# (merge reads it back so constant-content shards round-trip exactly)
+_REPLICATED_KEY = "__replicated_paths__"
+
+
+# ---------------------------------------------------------------------------
+# Megatron torch-layout spec table (ADVICE r3: auto_tp name heuristics assume
+# the flax [in, out] kernel layout; Megatron torch weights are [out, in], so
+# col-parallel shards dim 0 and row-parallel shards dim 1 — inferring them
+# with tp_parser silently merges along the wrong axis)
+# ---------------------------------------------------------------------------
+
+_MEG_COL = ("query_key_value", "dense_h_to_4h", "query", "key_value", "qkv")
+_MEG_ROW = ("attention/dense", "self_attention/dense", "dense_4h_to_h")
+_MEG_VOCAB = ("word_embeddings", "lm_head", "embed_out", "final_linear")
+_MEG_REPLICATED = ("position_embeddings", "layernorm", "norm", "bias_gelu")
+
+
+def _meg_match(name: str, pats) -> bool:
+    # boundary-aware matching ('/' in a pattern hits '.' too) — shared with
+    # AutoTP so the two name vocabularies can't drift
+    from ..module_inject.auto_tp import _matches
+
+    return _matches(pats, name.lower())
+
+
+def megatron_specs(tree: Any, axis: str = "tp", *, strict: bool = True) -> Any:
+    """Explicit PartitionSpec tree for Megatron-GPT-style checkpoints in the
+    torch ``[out, in]`` layout (reference ``MegatronSDLoader`` hard-codes the
+    same per-layer knowledge, ``state_dict_factory.py:380``).
+
+    col-parallel -> dim 0, row-parallel -> dim 1, word embeddings -> dim 0,
+    norms/position embeddings/1-D row biases -> replicated. ``strict=True``
+    raises on an unmatched 2-D leaf instead of silently replicating (the
+    silent path is how a multi-shard merge corrupts weights)."""
+    paths, leaves, treedef = flatten_with_paths(tree)
+    specs = []
+    for path, leaf in zip(paths, leaves):
+        nd = getattr(leaf, "ndim", np.asarray(leaf).ndim)
+        low = path.lower()
+        if _meg_match(low, _MEG_REPLICATED):
+            specs.append(P())
+        elif _meg_match(low, _MEG_ROW):
+            # row-parallel: weight shards the input dim (1 in [out, in]);
+            # its bias is a full output vector -> replicated
+            specs.append(P(None, axis) if nd == 2 else P())
+        elif _meg_match(low, _MEG_COL):
+            # col-parallel: weight shards the output dim (0); bias too
+            specs.append(P(axis) if nd >= 1 else P())
+        elif _meg_match(low, _MEG_VOCAB):
+            specs.append(P(axis) if nd == 2 else P())
+        elif nd >= 2:
+            if strict:
+                raise ValueError(
+                    f"megatron_specs: unmatched 2-D leaf {path!r} — add it to "
+                    "the layout table or pass strict=False (replicates it)")
+            specs.append(P())
+        else:
+            specs.append(P())
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def save_shard_npz(path: str, tree: Any,
+                   replicated_paths: Optional[Iterable[str]] = None) -> None:
+    """Write one TP shard as a flat ``.npz`` ('/'-joined keys), persisting
+    the replicated-leaf sidecar so a later merge doesn't need the content
+    heuristic (ADVICE r3: the factory merge path couldn't see
+    ``replicated_paths``)."""
+    paths, leaves, _ = flatten_with_paths(tree)
+    flat = {p: np.asarray(l) for p, l in zip(paths, leaves)}
+    if replicated_paths is not None:
+        # always write the key (an EMPTY set is authoritative too: it tells
+        # the merge that every identical-content leaf is a true shard)
+        flat[_REPLICATED_KEY] = np.asarray(sorted(replicated_paths), dtype="U256")
+    np.savez(path, **flat)
 
 
 # ---------------------------------------------------------------------------
@@ -231,10 +307,23 @@ class SDLoaderFactory:
 class SDLoader:
     def __init__(self, ckpt_list: Sequence[Any], version: Optional[int] = None,
                  specs: Any = None, qkv_leaves: Optional[Dict[str, str]] = None,
-                 num_heads: Optional[int] = None):
+                 num_heads: Optional[int] = None, layout: str = "flax",
+                 replicated_paths: Optional[Iterable[str]] = None):
+        """``layout='megatron'``: build specs with the explicit torch
+        ``[out, in]`` table (:func:`megatron_specs`) instead of AutoTP's flax
+        name heuristics — required for real Megatron shards (ADVICE r3: the
+        flax assumption merged QKV along the wrong axis and replicated
+        row-parallel dense weights). ``replicated_paths`` (or an in-file
+        sidecar written by :func:`save_shard_npz`) makes merges exact for
+        constant-content leaves."""
         self.ckpt_list = list(ckpt_list)
         self.version = version
+        self.layout = layout
         self.specs = specs
+        self._explicit_replicated = (None if replicated_paths is None
+                                     else frozenset(replicated_paths))
+        self._sidecar_replicated: set = set()
+        self._sidecar_seen = False
         # reference merge/split_query_key_value (state_dict_factory.py:220):
         # version 0 stores [q | k | v] BLOCKS (split per third across TP);
         # versions 1.0/2.0 store whole-head-contiguous layouts that TP-split
@@ -246,11 +335,14 @@ class SDLoader:
         self.qkv_leaves = qkv_leaves
         self.num_heads = num_heads
 
-    @staticmethod
-    def _load_one(entry) -> Any:
+    def _load_one(self, entry) -> Any:
         if isinstance(entry, str):
             with np.load(entry) as z:
                 flat = {k: z[k] for k in z.files}
+            sidecar = flat.pop(_REPLICATED_KEY, None)
+            if sidecar is not None:
+                self._sidecar_seen = True
+                self._sidecar_replicated.update(str(p) for p in sidecar)
             tree: Dict[str, Any] = {}
             for k, v in flat.items():
                 node = tree
@@ -260,6 +352,18 @@ class SDLoader:
                 node[parts[-1]] = v
             return tree
         return entry
+
+    def _specs_for(self, tree) -> Any:
+        if self.specs is not None:
+            return self.specs
+        if self.layout == "megatron":
+            return megatron_specs(tree)
+        return None  # merge/split fall back to tp_parser (flax layout)
+
+    def _replicated(self) -> Optional[frozenset]:
+        if self._explicit_replicated is not None:
+            return self._explicit_replicated
+        return frozenset(self._sidecar_replicated) if self._sidecar_seen else None
 
     def _auto_qkv(self, tree) -> Dict[str, str]:
         if self.qkv_leaves is not None:
@@ -284,9 +388,10 @@ class SDLoader:
             shards = [self._load_one(c)
                       for c in self.ckpt_list[mp_rank * per:(mp_rank + 1) * per]]
             log_dist(f"sd_factory: merging {per} shards for mp_rank {mp_rank}")
-            return merge_state_dicts(shards, self.specs,
+            return merge_state_dicts(shards, self._specs_for(shards[0]),
                                      qkv_leaves=self._auto_qkv(shards[0]),
-                                     split_size=n)
+                                     split_size=n,
+                                     replicated_paths=self._replicated())
         # split: this rank slices one saved shard
         if mp_world_size % n:
             raise ValueError(f"cannot split {n} shards to tp={mp_world_size}")
@@ -294,6 +399,6 @@ class SDLoader:
         src = self._load_one(self.ckpt_list[mp_rank // per])
         log_dist(f"sd_factory: splitting shard {mp_rank // per} "
                  f"{per}-way for mp_rank {mp_rank}")
-        return split_state_dict(src, mp_rank % per, per, self.specs,
+        return split_state_dict(src, mp_rank % per, per, self._specs_for(src),
                                 qkv_leaves=self._auto_qkv(src),
                                 num_heads=self.num_heads)

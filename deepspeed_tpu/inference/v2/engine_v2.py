@@ -337,7 +337,11 @@ class InferenceEngineV2:
                     break
                 for uid, toks in got.items():
                     out.setdefault(uid, []).extend(toks)
-                produced += n
+                # the inner call may clamp below the requested n (block-table
+                # capacity / free-block fallback): advance by what actually
+                # ran, not what was asked (ADVICE r3 — overcounting returned
+                # fewer than min(total_steps, budget) without surfacing it)
+                produced += max(len(toks) for toks in got.values())
             return out
         seqs = [s for s in self.state_manager.all() if not s.done]
         if not seqs:

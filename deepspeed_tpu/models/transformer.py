@@ -79,6 +79,9 @@ class TransformerConfig:
     moe_norm_topk: bool = True                  # mixtral renormalizes top-k; qwen2_moe doesn't
     moe_capacity_factor: float = 1.25
     moe_aux_loss_weight: float = 0.01
+    moe_noisy_gate_policy: Optional[str] = None  # None | 'Jitter' | 'RSample'
+    moe_drop_tokens: bool = True                 # False -> static no-drop capacity k*S
+    moe_use_rts: bool = True                     # random token selection on overflow
     # dropless grouped-GEMM experts (ragged_dot); best with ep=1
     moe_dropless: bool = False
     # execution

@@ -372,8 +372,12 @@ def infer_tp_roles(apply_fn, params, *example_inputs) -> Dict[str, Tuple[str, in
 
 def _matches(patterns: Sequence[str], text: str) -> bool:
     """Pattern hit only at name-component boundaries ([/_.-] or ends), so
-    e.g. 'wo' does not fire inside 'word_embeddings'."""
-    return any(re.search(rf"(^|[/_.\-]){re.escape(p)}([/_.\-]|$)", text)
+    e.g. 'wo' does not fire inside 'word_embeddings'. A '/' inside a pattern
+    matches either path separator ('attention/dense' hits the dotted
+    megatron-style 'h.0.attention.dense' too — ADVICE r3: the literal '/'
+    made those patterns dead for dotted key schemes)."""
+    return any(re.search(rf"(^|[/_.\-]){re.escape(p).replace('/', '[/.]')}([/_.\-]|$)",
+                         text)
                for p in patterns)
 
 

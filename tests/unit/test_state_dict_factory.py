@@ -1,6 +1,7 @@
 """State-dict factory: TP-aware merge/split (reference
 ``runtime/state_dict_factory.py`` MegatronSDLoader paths)."""
 
+import jax
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
@@ -185,3 +186,109 @@ class TestSDLoader:
         assert SDLoader([{}], version=1).qkv_layout == "interleaved"
         assert SDLoader([{}], version=2).qkv_layout == "interleaved"
         assert SDLoader([{}], version=None).qkv_layout == "interleaved"
+
+
+# ---------------------------------------------------------------------------
+# Megatron torch-layout merge (ADVICE r3 medium: flax-layout inference
+# silently corrupted real Megatron shards) + replicated-path sidecar
+# ---------------------------------------------------------------------------
+
+
+def test_megatron_layout_merge_roundtrip(tmp_path):
+    from deepspeed_tpu.checkpoint.state_dict_factory import (SDLoaderFactory,
+                                                             megatron_specs,
+                                                             split_state_dict)
+
+    rng = np.random.default_rng(0)
+    h, heads = 8, 2
+    full = {"transformer": {"layers": {"0": {
+        "attention": {
+            "query_key_value": {"weight": rng.normal(size=(3 * h, h)).astype(np.float32),
+                                "bias": rng.normal(size=(3 * h,)).astype(np.float32)},
+            "dense": {"weight": rng.normal(size=(h, h)).astype(np.float32),
+                      "bias": rng.normal(size=(h,)).astype(np.float32)},
+        },
+        "mlp": {
+            "dense_h_to_4h": {"weight": rng.normal(size=(4 * h, h)).astype(np.float32)},
+            "dense_4h_to_h": {"weight": rng.normal(size=(h, 4 * h)).astype(np.float32)},
+        },
+        "input_layernorm": {"weight": np.ones(h, np.float32)},
+    }}}, "word_embeddings": {"weight": rng.normal(size=(32, h)).astype(np.float32)}}
+
+    specs = megatron_specs(full)
+    # torch [out, in]: col-parallel shards dim 0, row-parallel dim 1
+    s0 = specs["transformer"]["layers"]["0"]
+    assert s0["attention"]["query_key_value"]["weight"] == P("tp")
+    assert s0["attention"]["dense"]["weight"] == P(None, "tp")
+    assert s0["mlp"]["dense_4h_to_h"]["weight"] == P(None, "tp")
+    assert s0["attention"]["dense"]["bias"] == P()  # row bias replicated
+
+    shards = [split_state_dict(full, r, 2, specs,
+                               qkv_leaves={"transformer/layers/0/attention/query_key_value/weight": "interleaved",
+                                           "transformer/layers/0/attention/query_key_value/bias": "interleaved"},
+                               num_heads=heads) for r in range(2)]
+    # row-parallel dense sharded along dim 1 (would be lost as 'replicated'
+    # under the old flax-layout inference)
+    assert shards[0]["transformer"]["layers"]["0"]["attention"]["dense"]["weight"].shape == (h, h // 2)
+
+    loader = SDLoaderFactory.get_sd_loader(shards, version=2, num_heads=heads,
+                                           layout="megatron")
+    merged = loader.load(1, 0)
+    for (p1, l1), (p2, l2) in zip(
+            jax.tree_util.tree_flatten_with_path(full)[0],
+            jax.tree_util.tree_flatten_with_path(merged)[0]):
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2),
+                                      err_msg=str(p1))
+
+
+def test_megatron_specs_strict_rejects_unknown():
+    from deepspeed_tpu.checkpoint.state_dict_factory import megatron_specs
+
+    tree = {"mystery_weight": np.zeros((4, 4), np.float32)}
+    with pytest.raises(ValueError, match="unmatched 2-D leaf"):
+        megatron_specs(tree)
+    specs = megatron_specs(tree, strict=False)
+    assert specs["mystery_weight"] == P()
+
+
+def test_replicated_sidecar_roundtrip(tmp_path):
+    """The docstring's ambiguous corner: a constant-content SHARDED leaf
+    whose shard shape has an indivisible dim (zero GQA bias [2, dh] split
+    2-ways -> identical [1, dh] shards). The content heuristic alone calls it
+    a replica and merges to the shard shape; the sidecar written by
+    save_shard_npz is authoritative (even when EMPTY) and fixes it."""
+    from deepspeed_tpu.checkpoint.state_dict_factory import (SDLoader,
+                                                             save_shard_npz,
+                                                             split_state_dict)
+
+    full = {"w": np.arange(16, dtype=np.float32).reshape(4, 4),
+            "kv_bias": np.zeros((2, 4), np.float32)}  # constant, truly sharded
+    specs = {"w": P("tp"), "kv_bias": P("tp")}
+    paths = []
+    for r in range(2):
+        shard, repl = split_state_dict(full, r, 2, specs, return_replicated=True)
+        p = str(tmp_path / f"shard{r}.npz")
+        save_shard_npz(p, shard, replicated_paths=repl)
+        paths.append(p)
+
+    # without the sidecar the heuristic collapses kv_bias to the shard shape
+    bare = [str(tmp_path / f"bare{r}.npz") for r in range(2)]
+    for r, p in enumerate(bare):
+        save_shard_npz(p, split_state_dict(full, r, 2, specs))
+    wrong = SDLoader(bare, specs=specs).load(1, 0)
+    assert wrong["kv_bias"].shape == (1, 4)
+
+    merged = SDLoader(paths, specs=specs).load(1, 0)
+    np.testing.assert_array_equal(merged["w"], full["w"])
+    np.testing.assert_array_equal(merged["kv_bias"], full["kv_bias"])
+
+
+def test_dotted_megatron_row_patterns_match():
+    """ADVICE r3 low: 'attention/dense' patterns were dead for dotted keys."""
+    from deepspeed_tpu.module_inject.auto_tp import _spec_by_name
+
+    r = _spec_by_name("h.0.self_attention.dense.weight".replace(".", "/"), 2)
+    assert r.role == "row"
+    # dotted text form as seen by direct name matching
+    from deepspeed_tpu.module_inject.auto_tp import _ROW_PATTERNS, _matches
+    assert _matches(_ROW_PATTERNS, "transformer.h.0.attention.dense.weight")
