@@ -1,0 +1,6 @@
+from .engine import DiffusionEngine, ddim_schedule
+from .unet import UNet2DCondition, UNetConfig
+from .vae import VAEConfig, VAEDecoder, VAEEncoder
+
+__all__ = ["DiffusionEngine", "ddim_schedule", "UNet2DCondition", "UNetConfig",
+           "VAEConfig", "VAEDecoder", "VAEEncoder"]

@@ -1,0 +1,142 @@
+"""Conditional UNet for latent diffusion serving.
+
+Reference: ``deepspeed/model_implementations/diffusers/unet.py:1-81``
+(``DSUNet``) wraps an HF-diffusers UNet in fp16 + CUDA-graph capture, and
+``csrc/spatial/csrc/opt_bias_add.cu`` fuses the bias-adds. The TPU analogue
+needs no wrapper tricks: the whole UNet is one ``jit`` program (jit IS the
+graph capture — one compiled executable replayed per denoise step) and XLA
+fuses bias-adds/groupnorms into the convs.
+
+The diffusers *library* is not in this image, so the model itself is
+implemented here: a UNet2DConditionModel-shaped network (conv_in, timestep
+sinusoidal embedding + MLP, down blocks of [resnet, cross-attn], a mid block,
+up blocks with skip concatenation, groupnorm-silu-conv out) in flax, NHWC
+layout (TPU conv layout; torch checkpoints transpose in on load).
+"""
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    block_channels: Sequence[int] = (32, 64)     # per resolution level
+    layers_per_block: int = 1
+    attn_levels: Sequence[int] = (1,)            # levels with cross-attention
+    context_dim: int = 32                        # text-encoder hidden size
+    num_heads: int = 4
+    time_embed_dim: int = 128
+    groups: int = 8
+    dtype: jnp.dtype = jnp.float32
+
+
+def timestep_embedding(t, dim: int, max_period: float = 10000.0):
+    """Sinusoidal timestep embedding (diffusers ``get_timestep_embedding``)."""
+    half = dim // 2
+    freqs = jnp.exp(-jnp.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+class ResnetBlock(nn.Module):
+    cfg: UNetConfig
+    out_ch: int
+
+    @nn.compact
+    def __call__(self, x, temb):
+        cfg = self.cfg
+        h = nn.GroupNorm(num_groups=min(cfg.groups, x.shape[-1]))(x)
+        h = nn.silu(h)
+        h = nn.Conv(self.out_ch, (3, 3), padding=1, dtype=cfg.dtype)(h)
+        # timestep conditioning: added per-channel after the first conv
+        t = nn.Dense(self.out_ch, dtype=cfg.dtype)(nn.silu(temb))
+        h = h + t[:, None, None, :]
+        h = nn.GroupNorm(num_groups=min(cfg.groups, self.out_ch))(h)
+        h = nn.silu(h)
+        h = nn.Conv(self.out_ch, (3, 3), padding=1, dtype=cfg.dtype)(h)
+        if x.shape[-1] != self.out_ch:
+            x = nn.Conv(self.out_ch, (1, 1), dtype=cfg.dtype, name="shortcut")(x)
+        return x + h
+
+
+class CrossAttnBlock(nn.Module):
+    """Self-attn + cross-attn + geglu MLP over flattened spatial tokens
+    (diffusers ``BasicTransformerBlock``)."""
+    cfg: UNetConfig
+
+    @nn.compact
+    def __call__(self, x, context):
+        cfg = self.cfg
+        b, hh, ww, c = x.shape
+        tokens = x.reshape(b, hh * ww, c)
+        t = nn.LayerNorm()(tokens)
+        tokens = tokens + nn.MultiHeadDotProductAttention(
+            num_heads=cfg.num_heads, dtype=cfg.dtype, name="self_attn")(t, t)
+        t = nn.LayerNorm()(tokens)
+        ctx = context.astype(cfg.dtype)
+        tokens = tokens + nn.MultiHeadDotProductAttention(
+            num_heads=cfg.num_heads, dtype=cfg.dtype, name="cross_attn")(t, ctx)
+        t = nn.LayerNorm()(tokens)
+        g = nn.Dense(4 * c, dtype=cfg.dtype, name="geglu_gate")(t)
+        u = nn.Dense(4 * c, dtype=cfg.dtype, name="geglu_up")(t)
+        tokens = tokens + nn.Dense(c, dtype=cfg.dtype, name="mlp_out")(
+            nn.gelu(g) * u)
+        return tokens.reshape(b, hh, ww, c)
+
+
+class UNet2DCondition(nn.Module):
+    """``(latents [B,H,W,Cin], t [B], context [B,L,D]) -> eps [B,H,W,Cout]``."""
+    cfg: UNetConfig
+
+    @nn.compact
+    def __call__(self, sample, timesteps, encoder_hidden_states):
+        cfg = self.cfg
+        temb = timestep_embedding(timesteps, cfg.time_embed_dim)
+        temb = nn.Dense(cfg.time_embed_dim, dtype=cfg.dtype)(temb)
+        temb = nn.Dense(cfg.time_embed_dim, dtype=cfg.dtype)(nn.silu(temb))
+
+        h = nn.Conv(cfg.block_channels[0], (3, 3), padding=1,
+                    dtype=cfg.dtype, name="conv_in")(sample.astype(cfg.dtype))
+        skips = [h]
+        for lvl, ch in enumerate(cfg.block_channels):          # down path
+            for i in range(cfg.layers_per_block):
+                h = ResnetBlock(cfg, ch, name=f"down_{lvl}_res_{i}")(h, temb)
+                if lvl in cfg.attn_levels:
+                    h = CrossAttnBlock(cfg, name=f"down_{lvl}_attn_{i}")(
+                        h, encoder_hidden_states)
+                skips.append(h)
+            if lvl != len(cfg.block_channels) - 1:
+                h = nn.Conv(ch, (3, 3), strides=2, padding=1,
+                            dtype=cfg.dtype, name=f"down_{lvl}_ds")(h)
+                skips.append(h)
+
+        mid_ch = cfg.block_channels[-1]
+        h = ResnetBlock(cfg, mid_ch, name="mid_res_0")(h, temb)
+        h = CrossAttnBlock(cfg, name="mid_attn")(h, encoder_hidden_states)
+        h = ResnetBlock(cfg, mid_ch, name="mid_res_1")(h, temb)
+
+        for lvl in reversed(range(len(cfg.block_channels))):   # up path
+            ch = cfg.block_channels[lvl]
+            for i in range(cfg.layers_per_block + 1):
+                skip = skips.pop()
+                h = jnp.concatenate([h, skip], axis=-1)
+                h = ResnetBlock(cfg, ch, name=f"up_{lvl}_res_{i}")(h, temb)
+                if lvl in cfg.attn_levels:
+                    h = CrossAttnBlock(cfg, name=f"up_{lvl}_attn_{i}")(
+                        h, encoder_hidden_states)
+            if lvl != 0:
+                b, hh, ww, c = h.shape
+                h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
+                h = nn.Conv(c, (3, 3), padding=1, dtype=cfg.dtype,
+                            name=f"up_{lvl}_us")(h)
+
+        h = nn.GroupNorm(num_groups=min(cfg.groups, h.shape[-1]))(h)
+        h = nn.silu(h)
+        return nn.Conv(cfg.out_channels, (3, 3), padding=1,
+                       dtype=jnp.float32, name="conv_out")(h)

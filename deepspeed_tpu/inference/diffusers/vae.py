@@ -1,0 +1,90 @@
+"""VAE for latent diffusion (decoder-first; encoder for img2img).
+
+Reference: the diffusers pipeline's ``AutoencoderKL`` that DeepSpeed's
+stable-diffusion injection leaves on the fp16 path
+(``model_implementations/diffusers/vae.py`` wraps it with CUDA graphs the
+same way as the UNet). NHWC flax implementation; ``scaling_factor`` follows
+the SD convention (latents = encode(x) * sf, decode(latents / sf))."""
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    latent_channels: int = 4
+    image_channels: int = 3
+    block_channels: Sequence[int] = (32, 64)   # low->high resolution
+    groups: int = 8
+    scaling_factor: float = 0.18215
+    dtype: jnp.dtype = jnp.float32
+
+
+class _Res(nn.Module):
+    cfg: VAEConfig
+    out_ch: int
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.GroupNorm(num_groups=min(cfg.groups, x.shape[-1]))(x)
+        h = nn.silu(h)
+        h = nn.Conv(self.out_ch, (3, 3), padding=1, dtype=cfg.dtype)(h)
+        h = nn.GroupNorm(num_groups=min(cfg.groups, self.out_ch))(h)
+        h = nn.silu(h)
+        h = nn.Conv(self.out_ch, (3, 3), padding=1, dtype=cfg.dtype)(h)
+        if x.shape[-1] != self.out_ch:
+            x = nn.Conv(self.out_ch, (1, 1), dtype=cfg.dtype, name="shortcut")(x)
+        return x + h
+
+
+class VAEDecoder(nn.Module):
+    """``latents [B,h,w,Cl] -> images [B, h*2^L, w*2^L, 3]`` in [-1, 1]."""
+    cfg: VAEConfig
+
+    @nn.compact
+    def __call__(self, z):
+        cfg = self.cfg
+        z = z.astype(cfg.dtype) / cfg.scaling_factor
+        h = nn.Conv(cfg.block_channels[-1], (1, 1), dtype=cfg.dtype,
+                    name="post_quant_conv")(z)
+        h = nn.Conv(cfg.block_channels[-1], (3, 3), padding=1,
+                    dtype=cfg.dtype, name="conv_in")(h)
+        h = _Res(cfg, cfg.block_channels[-1], name="mid_res")(h)
+        for lvl in reversed(range(len(cfg.block_channels))):
+            ch = cfg.block_channels[lvl]
+            h = _Res(cfg, ch, name=f"up_{lvl}_res")(h)
+            b, hh, ww, c = h.shape
+            h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
+            h = nn.Conv(c, (3, 3), padding=1, dtype=cfg.dtype,
+                        name=f"up_{lvl}_us")(h)
+        h = nn.GroupNorm(num_groups=min(cfg.groups, h.shape[-1]))(h)
+        h = nn.silu(h)
+        return nn.tanh(nn.Conv(cfg.image_channels, (3, 3), padding=1,
+                               dtype=jnp.float32, name="conv_out")(h))
+
+
+class VAEEncoder(nn.Module):
+    """``images [B,H,W,3] -> latent mean [B,H/2^L,W/2^L,Cl]`` (deterministic
+    posterior mean x scaling_factor — serving ignores the logvar sample)."""
+    cfg: VAEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.Conv(cfg.block_channels[0], (3, 3), padding=1,
+                    dtype=cfg.dtype, name="conv_in")(x.astype(cfg.dtype))
+        for lvl, ch in enumerate(cfg.block_channels):
+            h = _Res(cfg, ch, name=f"down_{lvl}_res")(h)
+            h = nn.Conv(ch, (3, 3), strides=2, padding=1, dtype=cfg.dtype,
+                        name=f"down_{lvl}_ds")(h)
+        h = _Res(cfg, cfg.block_channels[-1], name="mid_res")(h)
+        h = nn.GroupNorm(num_groups=min(cfg.groups, h.shape[-1]))(h)
+        h = nn.silu(h)
+        mean = nn.Conv(cfg.latent_channels, (3, 3), padding=1,
+                       dtype=jnp.float32, name="conv_mean")(h)
+        return mean * cfg.scaling_factor

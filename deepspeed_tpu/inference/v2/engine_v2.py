@@ -341,7 +341,11 @@ class InferenceEngineV2:
                 # capacity / free-block fallback): advance by what actually
                 # ran, not what was asked (ADVICE r3 — overcounting returned
                 # fewer than min(total_steps, budget) without surfacing it)
-                produced += max(len(toks) for toks in got.values())
+                step_n = max(len(toks) for toks in got.values())
+                if step_n == 0:
+                    break  # capacity exhausted (e.g. full block tables):
+                           # no progress is possible, don't spin
+                produced += step_n
             return out
         seqs = [s for s in self.state_manager.all() if not s.done]
         if not seqs:

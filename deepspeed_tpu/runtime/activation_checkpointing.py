@@ -82,8 +82,19 @@ def get_config() -> dict:
 
 def checkpoint(function: Callable, *args) -> Any:
     """Reference ``checkpointing.checkpoint(fn, *args)``: run ``fn`` now,
-    rematerialize its intermediates in the backward pass."""
-    return checkpoint_wrapper(function, _config.get("policy"))(*args)
+    rematerialize its intermediates in the backward pass.
+
+    With ``cpu_checkpointing`` (reference ``checkpoint_in_cpu``) and no
+    explicit policy, saved dot-product activations are OFFLOADED to pinned
+    host memory instead of kept in HBM
+    (``jax.checkpoint_policies.offload_dot_with_no_batch_dims``) — the true
+    analogue of the reference's CPU-checkpointing storage tier, not just a
+    recorded knob."""
+    policy = _config.get("policy")
+    if policy is None and _config.get("cpu_checkpointing"):
+        policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
+    return checkpoint_wrapper(function, policy)(*args)
 
 
 def model_parallel_reconfigure_tp_seed(seed):

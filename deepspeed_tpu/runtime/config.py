@@ -144,10 +144,13 @@ class PipelineConfig(ConfigModel):
     partition_method: str = "parameters"  # uniform | parameters | type:<regex>
     micro_batches: Optional[int] = None  # default = gradient_accumulation_steps
     activation_checkpoint_interval: int = 0
-    # only 'gpipe': the SPMD circulating pipeline has no instruction list to
-    # reorder — 1F1B-style fwd/bwd interleaving is XLA's scheduling job
-    # (from_pipeline_config rejects anything else)
+    # 'gpipe' or 'interleaved': the SPMD circulating pipeline has no
+    # instruction list to reorder — 1F1B-style fwd/bwd interleaving is XLA's
+    # scheduling job. 'interleaved' (+ virtual_stages) is the Megatron
+    # virtual-pipeline bubble reduction: v layer chunks per stage, bubble
+    # (p-1)/(v*m) instead of (p-1)/m.
     schedule: str = "gpipe"
+    virtual_stages: int = 1
 
 
 @register_config

@@ -57,7 +57,7 @@ def partition_balanced(weights, num_parts: int):
 def spmd_pipeline(stage_fn: Callable, stage_params: Any, microbatches: jnp.ndarray,
                   *, last_stage_fn: Optional[Callable] = None,
                   first_stage_fn: Optional[Callable] = None,
-                  extra_params: Any = None):
+                  extra_params: Any = None, virtual_stages: int = 1):
     """Run the circulating-microbatch pipeline. Call INSIDE shard_map over pp.
 
     stage_fn(stage_params, x) -> x            applied at every stage
@@ -65,19 +65,40 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, microbatches: jnp.ndarr
     last_stage_fn(extra, x, mb) -> per-mb output (e.g. loss scalar)
     microbatches: [M, ...] (replicated across pp)
 
+    ``virtual_stages=v > 1`` is the interleaved schedule (Megatron's
+    virtual-pipeline / the reference's ``1f1b`` bubble-reduction goal,
+    ``schedule.py:189``): every rank holds ``v`` NON-adjacent layer chunks
+    (``stage_params`` leaves lead with ``[v]``; chunk ``c`` of stage ``s``
+    covers global layers ``c*p .. c*p + 1`` blocks) and each activation laps
+    the ring ``v`` times. Bubble shrinks from ``(p-1)/m`` to ``(p-1)/(v*m)``
+    at the cost of ``v``x ppermute latency — on ICI the permutes are
+    near-free, so deeper models win. Requires ``m % p == 0`` (microbatches
+    run in waves of ``p``).
+
     Returns [M, ...] of last-stage outputs (psum'd over pp so every rank holds
     them).
     """
     stage = lax.axis_index(PP_AXIS)
     n_stages = lax.axis_size(PP_AXIS)
+    v = int(virtual_stages)
     m = jax.tree.leaves(microbatches)[0].shape[0]
-    total = m + n_stages - 1
+    if v > 1 and m % n_stages:
+        raise ValueError(f"interleaved schedule needs microbatches ({m}) "
+                         f"divisible by stages ({n_stages})")
+    total = m * v + n_stages - 1
+
+    def chunk_params(c):
+        if v == 1:
+            return stage_params
+        return jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+            stage_params)
 
     def embed(mb):
         return first_stage_fn(extra_params, mb) if first_stage_fn else mb
 
     x0 = embed(jax.tree.map(lambda a: a[0], microbatches))
-    buf_shape = jax.eval_shape(lambda p, x: stage_fn(p, x), stage_params, x0)
+    buf_shape = jax.eval_shape(lambda p, x: stage_fn(p, x), chunk_params(0), x0)
     recv = jnp.zeros(buf_shape.shape, buf_shape.dtype)
 
     def head(x, mb):
@@ -88,23 +109,29 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, microbatches: jnp.ndarr
 
     def step(t, carry):
         recv, outputs = carry
-        mb_in_idx = jnp.clip(t, 0, m - 1)
-        mb = jax.tree.map(lambda a: a[mb_in_idx], microbatches)
-        x_in = jnp.where(stage == 0,
+        # schedule position: rank `stage` at time t works on lap (chunk) c of
+        # microbatch i — waves of p microbatches, v laps per wave
+        u = t - stage
+        valid = (u >= 0) & (u < m * v)
+        uc = jnp.clip(u, 0, m * v - 1)
+        wave = uc // (n_stages * v)
+        r = uc % (n_stages * v)
+        c = r // n_stages
+        i = jnp.clip(r % n_stages + wave * n_stages, 0, m - 1)
+        mb = jax.tree.map(lambda a: a[i], microbatches)
+        x_in = jnp.where((stage == 0) & (c == 0),
                          embed(mb).astype(recv.dtype),
                          recv)
-        y = stage_fn(stage_params, x_in)
-        # last stage emits microbatch t - (P-1)
-        out_idx = t - (n_stages - 1)
-        is_emitting = (stage == n_stages - 1) & (out_idx >= 0)
-        o = head(y, jax.tree.map(lambda a: a[jnp.clip(out_idx, 0, m - 1)], microbatches))
+        y = stage_fn(chunk_params(c), x_in)
+        # last stage emits microbatch i after its final lap
+        is_emitting = (stage == n_stages - 1) & (c == v - 1) & valid
+        o = head(y, mb)
         outputs = lax.dynamic_update_index_in_dim(
-            outputs,
-            jnp.where(is_emitting, o, outputs[jnp.clip(out_idx, 0, m - 1)]),
-            jnp.clip(out_idx, 0, m - 1), 0)
-        # circulate: stage s -> s+1 (last stage's send is discarded at stage 0)
+            outputs, jnp.where(is_emitting, o, outputs[i]), i, 0)
+        # circulate: stage s -> s+1 (stage p-1's send starts the next lap at
+        # stage 0; after the final lap it is discarded there)
         recv = lax.ppermute(y, PP_AXIS,
-                            [(i, (i + 1) % n_stages) for i in range(n_stages)])
+                            [(j, (j + 1) % n_stages) for j in range(n_stages)])
         return recv, outputs
 
     recv, outputs = lax.fori_loop(0, total, step, (recv, outputs))
@@ -149,11 +176,34 @@ def resolve_partition(num_layers: int, num_stages: int, partition_method: str,
         "matching); use 'uniform' or 'parameters'")
 
 
+def interleave_pipeline_params(params: Any, num_stages: int,
+                               virtual_stages: int) -> Any:
+    """Re-layout stacked blocks ``[L, ...]`` for the interleaved schedule:
+    ``[p, v, L/(p*v), ...]`` where chunk ``c`` of stage ``s`` holds global
+    layers ``(c*p + s) * Lg ..`` (Megatron virtual-pipeline placement). Run
+    ONCE at setup — storing the permuted layout is what keeps the per-step
+    program free of weight resharding."""
+    p, v = num_stages, virtual_stages
+    L = jax.tree.leaves(params["blocks"])[0].shape[0]
+    if L % (p * v):
+        raise ValueError(f"{L} layers not divisible by stages*virtual={p * v}")
+    lg = L // (p * v)
+
+    def relayout(a):
+        # [L] -> [v, p, lg] (chunk-major) -> [p, v, lg]
+        a = a.reshape((v, p, lg) + a.shape[1:])
+        return jnp.swapaxes(a, 0, 1)
+
+    out = dict(params)
+    out["blocks"] = jax.tree.map(relayout, params["blocks"])
+    return out
+
+
 def make_pipeline_loss_fn(embed_fn: Callable, block_fn: Callable, head_loss_fn: Callable,
                           *, num_layers: int, num_stages: int, num_microbatches: int,
                           partition_method: str = "uniform",
                           activation_checkpoint_interval: int = 0,
-                          layer_costs=None):
+                          layer_costs=None, virtual_stages: int = 1):
     """Build an engine-compatible ``loss = f(params, batch)`` running an SPMD
     pipeline (the analogue of wrapping a model in ``PipelineModule``).
 
@@ -161,9 +211,14 @@ def make_pipeline_loss_fn(embed_fn: Callable, block_fn: Callable, head_loss_fn: 
     block_fn(block_params, x) -> x applies ONE layer given its [L]-indexed slice.
     ``activation_checkpoint_interval=k`` rematerializes activations every k
     layers within a stage (reference ``PipelineModule`` knob, ``module.py:86``).
+
+    ``virtual_stages > 1`` selects the interleaved schedule; ``params`` must
+    then hold blocks in the ``interleave_pipeline_params`` layout
+    ``[p, v, L/(p*v), ...]``.
     """
-    resolve_partition(num_layers, num_stages, partition_method, layer_costs)
-    layers_per_stage = num_layers // num_stages
+    v = int(virtual_stages)
+    resolve_partition(num_layers, num_stages * v, partition_method, layer_costs)
+    layers_per_stage = num_layers // (num_stages * v)
     ack = activation_checkpoint_interval
     if ack and layers_per_stage % ack:
         raise ValueError(f"activation_checkpoint_interval={ack} must divide "
@@ -208,17 +263,27 @@ def make_pipeline_loss_fn(embed_fn: Callable, block_fn: Callable, head_loss_fn: 
 
         mbs = jax.tree.map(split_mb, batch)
 
-        def reshape_blocks(leaf):
-            return leaf.reshape((num_stages, layers_per_stage) + leaf.shape[1:])
+        if v == 1:
+            def reshape_blocks(leaf):
+                return leaf.reshape((num_stages, layers_per_stage) + leaf.shape[1:])
 
-        blocks = jax.tree.map(reshape_blocks, params["blocks"])
+            blocks = jax.tree.map(reshape_blocks, params["blocks"])
+        else:
+            blocks = params["blocks"]  # pre-permuted [p, v, lg, ...]
+            lead = jax.tree.leaves(blocks)[0].shape[:3]
+            if lead != (num_stages, v, layers_per_stage):
+                raise ValueError(
+                    f"interleaved pipeline expects blocks laid out "
+                    f"[{num_stages}, {v}, {layers_per_stage}, ...] (see "
+                    f"interleave_pipeline_params); got leading dims {lead}")
 
         def pipe_body(blocks_, embed_, head_, mbs_):
             losses = spmd_pipeline(
                 stage_fn, jax.tree.map(lambda a: a[0], blocks_), mbs_,
                 first_stage_fn=lambda extra, mb: embed_fn(extra["embed"], mb),
                 last_stage_fn=lambda extra, x, mb: head_loss_fn(extra["head"], x, mb),
-                extra_params={"embed": embed_, "head": head_})
+                extra_params={"embed": embed_, "head": head_},
+                virtual_stages=v)
             # per-mb losses are local-batch-shard means; average over dp here
             # (the grads' dp reduction follows from reverse-mode of this pmean)
             return lax.pmean(losses, dp)
@@ -241,7 +306,8 @@ def make_pipeline_loss_fn(embed_fn: Callable, block_fn: Callable, head_loss_fn: 
     # metadata for initialize() to cross-check against PipelineConfig
     loss_fn._pipeline_meta = {"num_stages": num_stages,
                               "num_microbatches": num_microbatches,
-                              "num_layers": num_layers}
+                              "num_layers": num_layers,
+                              "virtual_stages": v}
     return loss_fn
 
 
@@ -252,20 +318,30 @@ def from_pipeline_config(embed_fn, block_fn, head_loss_fn, *, num_layers: int,
     reference default of ``gradient_accumulation_steps``,
     ``partition_method``, ``activation_checkpoint_interval``)."""
     pc = config.pipeline
-    if pc.schedule != "gpipe":
+    if pc.schedule not in ("gpipe", "interleaved"):
         raise ValueError(
             f"pipeline.schedule={pc.schedule!r}: the SPMD pipeline runs ONE "
-            "circulating program (fill/drain = GPipe bubble) and reverse-mode "
-            "autodiff interleaves fwd/bwd under XLA's scheduler — there is no "
-            "instruction list to reorder, so '1f1b' is not a separate "
-            "schedule here; set schedule='gpipe' (reference schedule.py:189)")
+            "circulating program and reverse-mode autodiff interleaves "
+            "fwd/bwd under XLA's scheduler — there is no instruction list to "
+            "reorder, so '1f1b' is not a separate schedule here. Use "
+            "'gpipe', or 'interleaved' (+ pipeline.virtual_stages >= 2) for "
+            "the Megatron virtual-stage bubble reduction")
+    v = getattr(pc, "virtual_stages", 1) or 1
+    if pc.schedule == "interleaved" and v < 2:
+        raise ValueError("schedule='interleaved' needs pipeline.virtual_stages >= 2")
+    if pc.schedule == "gpipe" and v > 1:
+        raise ValueError(
+            f"pipeline.virtual_stages={v} has no effect under schedule="
+            "'gpipe' — set schedule='interleaved' to enable the virtual-"
+            "stage bubble reduction (silently ignoring the knob would run "
+            "the full (p-1)/m bubble the user tried to shrink)")
     micro = pc.micro_batches or config.gradient_accumulation_steps or 1
     return make_pipeline_loss_fn(
         embed_fn, block_fn, head_loss_fn, num_layers=num_layers,
         num_stages=pc.stages, num_microbatches=micro,
         partition_method=pc.partition_method,
         activation_checkpoint_interval=pc.activation_checkpoint_interval,
-        layer_costs=layer_costs)
+        layer_costs=layer_costs, virtual_stages=v)
 
 
 def pipeline_param_specs(params, topo=None) -> Any:

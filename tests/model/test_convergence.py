@@ -128,6 +128,11 @@ def test_variants_agree_with_each_other():
 
 
 if __name__ == "__main__":
+    # standalone regeneration: pin the CPU mesh the way conftest does (the
+    # env var alone is too late — the axon sitecustomize registers its PJRT
+    # plugin at interpreter start and first backend use would hang on a
+    # wedged tunnel)
+    jax.config.update("jax_platforms", "cpu")
     losses = _train({"zero_optimization": {"stage": 0}})
     with open(GOLDEN_PATH, "w") as f:
         json.dump({"losses": losses, "steps": STEPS,

@@ -1,0 +1,179 @@
+"""Domino chunked TP overlap (reference ``runtime/domino/transformer.py:19``).
+
+The reference proves overlap by construction (hand-scheduled async NCCL
+handles). On TPU the overlap is XLA's latency-hiding scheduler's job, so what
+the framework must guarantee — and what these tests pin down — is the
+*enabling structure*: the chunked program contains one TP collective per
+chunk, and no chunk's collective transitively depends on another's, so the
+scheduler is free to hide chunk i's all-reduce behind chunk j's compute. A
+wall-clock A/B on the CPU mesh is recorded too (sanity: chunking must not
+regress); the real-hardware overlap measurement belongs to the ``-m tpu``
+lane (multi-chip, not available on a 1-chip bench host).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel import Topology, TopologySpec, set_topology
+from deepspeed_tpu.runtime.domino import DominoTransformerLayer, domino_chunked
+
+
+def _tp_block_fn(topo):
+    """Col-parallel then row-parallel matmul with the row allreduce explicit
+    (the pattern Domino's chunking targets)."""
+    mesh = topo.mesh
+
+    def block(x, w1, w2):
+        def body(x_, w1_, w2_):
+            h = jnp.tanh(x_ @ w1_)           # col-parallel: [B, F/tp]
+            y = h @ w2_                      # row-parallel partial: [B, D]
+            return jax.lax.psum(y, "tp")     # the TP allreduce
+        return jax.shard_map(body, mesh=mesh,
+                             in_specs=(P(), P(None, "tp"), P("tp", None)),
+                             out_specs=P(), check_vma=False)(x, w1, w2)
+    return block
+
+
+def teardown_function(_):
+    set_topology(Topology(TopologySpec()))
+
+
+def test_domino_matches_unchunked():
+    topo = Topology(TopologySpec(tp=8))
+    set_topology(topo)
+    block = _tp_block_fn(topo)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+    w1 = jnp.asarray(rng.normal(size=(16, 32)), jnp.float32)
+    w2 = jnp.asarray(rng.normal(size=(32, 16)), jnp.float32)
+    ref = block(x, w1, w2)
+    layer = DominoTransformerLayer(lambda c, a, b: block(c, a, b), num_chunks=2)
+    out = jax.jit(lambda x_, a, b: layer(x_, a, b))(x, w1, w2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def _collect_eqns(jaxpr, out):
+    """Flatten all eqns incl. nested (pjit/shard_map call) jaxprs."""
+    for eqn in jaxpr.eqns:
+        out.append(eqn)
+        for v in eqn.params.values():
+            if hasattr(v, "eqns"):            # raw Jaxpr (shard_map)
+                _collect_eqns(v, out)
+            elif hasattr(v, "jaxpr"):         # ClosedJaxpr (pjit, scan)
+                _collect_eqns(v.jaxpr, out)
+    return out
+
+
+def test_domino_chunk_collectives_are_independent():
+    """The load-bearing property: chunk 0's psum output is NOT an input
+    (transitively) of chunk 1's psum — the two collectives sit on independent
+    dataflow branches, which is exactly what lets the XLA scheduler overlap
+    one chunk's all-reduce with the other chunk's matmuls."""
+    topo = Topology(TopologySpec(tp=8))
+    set_topology(topo)
+    block = _tp_block_fn(topo)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+    w1 = jnp.asarray(rng.normal(size=(16, 32)), jnp.float32)
+    w2 = jnp.asarray(rng.normal(size=(32, 16)), jnp.float32)
+
+    jaxpr = jax.make_jaxpr(
+        lambda x_, a, b: domino_chunked(lambda c: block(c, a, b), x_, 2))(x, w1, w2)
+    eqns = _collect_eqns(jaxpr.jaxpr, [])
+    psums = [e for e in eqns if e.primitive.name == "psum"]
+    assert len(psums) == 2, [e.primitive.name for e in eqns]
+
+    # transitive producers of each psum's inputs
+    producers = {}
+    for e in eqns:
+        for ov in e.outvars:
+            producers[str(ov)] = e
+
+    def upstream(eqn, seen):
+        for iv in eqn.invars:
+            key = str(iv)
+            if key in seen or key not in producers:
+                continue
+            seen.add(key)
+            upstream(producers[key], seen)
+        return seen
+
+    ups1 = upstream(psums[1], set())
+    outs0 = {str(ov) for ov in psums[0].outvars}
+    assert not (ups1 & outs0), "chunk 1's psum depends on chunk 0's psum"
+    ups0 = upstream(psums[0], set())
+    outs1 = {str(ov) for ov in psums[1].outvars}
+    assert not (ups0 & outs1)
+
+
+def test_domino_cpu_mesh_timing_no_regression():
+    """A/B wall clock on the virtual mesh: chunking must not slow the block
+    down materially (the CPU backend schedules collectives synchronously, so
+    no speedup is expected here — the speedup claim is gated on the tpu
+    lane; this guards the structural transform's overhead)."""
+    import time
+
+    topo = Topology(TopologySpec(tp=8))
+    set_topology(topo)
+    block = _tp_block_fn(topo)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(64, 256)), jnp.float32)
+    w1 = jnp.asarray(rng.normal(size=(256, 512)), jnp.float32)
+    w2 = jnp.asarray(rng.normal(size=(512, 256)), jnp.float32)
+
+    def many(f):
+        def g(x_, a, b):
+            y = x_
+            for _ in range(8):
+                y = f(y, a, b)
+            return y
+        return jax.jit(g)
+
+    plain = many(block)
+    chunked = many(lambda c, a, b: domino_chunked(lambda t: block(t, a, b), c, 2))
+
+    def t(f):
+        f(x, w1, w2).block_until_ready()  # compile
+        t0 = time.perf_counter()
+        for _ in range(5):
+            r = f(x, w1, w2)
+        r.block_until_ready()
+        return time.perf_counter() - t0
+
+    t_plain, t_chunk = t(plain), t(chunked)
+    assert t_chunk < 3.0 * t_plain, (t_chunk, t_plain)
+
+
+@pytest.mark.tpu
+def test_domino_overlap_tpu_timing():
+    """Real-hardware A/B (multi-chip only): chunked TP block should be at
+    least as fast as unchunked at matmul-heavy shapes, the overlap showing
+    up as hidden all-reduce latency. Runs under ``pytest -m tpu`` on a
+    multi-chip host."""
+    if jax.devices()[0].platform != "tpu" or len(jax.devices()) < 2:
+        pytest.skip("needs >=2 TPU chips")
+    import time
+
+    topo = Topology(TopologySpec(tp=len(jax.devices())))
+    set_topology(topo)
+    block = _tp_block_fn(topo)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(512, 4096)), jnp.bfloat16)
+    w1 = jnp.asarray(rng.normal(size=(4096, 16384)), jnp.bfloat16)
+    w2 = jnp.asarray(rng.normal(size=(16384, 4096)), jnp.bfloat16)
+    plain = jax.jit(block)
+    chunked = jax.jit(lambda c, a, b: domino_chunked(lambda t: block(t, a, b), c, 2))
+
+    def t(f):
+        f(x, w1, w2).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(20):
+            r = f(x, w1, w2)
+        r.block_until_ready()
+        return time.perf_counter() - t0
+
+    t_plain, t_chunk = t(plain), t(chunked)
+    assert t_chunk <= 1.05 * t_plain, (t_chunk, t_plain)

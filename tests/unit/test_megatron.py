@@ -77,12 +77,20 @@ def test_tp_sharded_megatron_checkpoint_via_sd_loader():
     from deepspeed_tpu.checkpoint.state_dict_factory import split_state_dict
 
     # v2.0 layout is whole-head contiguous: TP split is a plain slice
-    # ("interleaved" handling); fused-qkv covers weights AND biases
-    shards = [split_state_dict(full_sd, r, 2, num_heads=cfg.num_heads,
+    # ("interleaved" handling); fused-qkv covers weights AND biases.
+    # REAL Megatron shards are split in the torch [out, in] layout
+    # (col-parallel = dim 0) — megatron_specs models that; merging them with
+    # flax-layout name inference was the r3-ADVICE corruption bug.
+    from deepspeed_tpu.checkpoint.state_dict_factory import megatron_specs
+
+    meg_specs = megatron_specs(full_sd)
+    shards = [split_state_dict(full_sd, r, 2, meg_specs,
+                               num_heads=cfg.num_heads,
                                qkv_leaves={k: "interleaved" for k in full_sd
                                            if "query_key_value" in k})
               for r in range(2)]
-    loader = SDLoader(shards, version=2, num_heads=cfg.num_heads)
+    loader = SDLoader(shards, version=2, num_heads=cfg.num_heads,
+                      layout="megatron")
     merged = loader.load(1, 0)
     back = jax.tree.map(jnp.asarray, megatron_params(merged, cfg, version=2))
     got = model.apply({"params": back}, toks)
@@ -106,11 +114,14 @@ def test_ds_to_universal_cli(tmp_path):
     want = model.apply({"params": params}, toks)
 
     full_sd = params_to_megatron(params, cfg, version=2)
+    from deepspeed_tpu.checkpoint.state_dict_factory import megatron_specs
+
     qkv = {k: "interleaved" for k in full_sd if "query_key_value" in k}
+    meg_specs = megatron_specs(full_sd)
     paths = []
     for r in range(2):
-        shard = split_state_dict(full_sd, r, 2, num_heads=cfg.num_heads,
-                                 qkv_leaves=qkv)
+        shard = split_state_dict(full_sd, r, 2, meg_specs,
+                                 num_heads=cfg.num_heads, qkv_leaves=qkv)
         path = str(tmp_path / f"mp_rank_{r:02d}.npz")
         np.savez(path, **shard)
         paths.append(path)

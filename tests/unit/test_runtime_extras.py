@@ -257,3 +257,31 @@ def test_mu_optimizers():
     # sgd: matrix unscaled, vector scaled by 4/2 = 2
     ratio = float(jnp.abs(upd["b"]).mean() / jnp.abs(upd["w"]).mean())
     np.testing.assert_allclose(ratio, 2.0, rtol=1e-6)
+
+
+def test_cpu_checkpointing_offloads_and_matches():
+    """checkpoint_in_cpu=True engages the pinned-host offload remat policy
+    (reference checkpointing.py CPU-checkpointing tier) without changing
+    values or gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime import activation_checkpointing as ckpt
+
+    prev = ckpt.get_config()
+    try:
+        ckpt.configure(checkpoint_in_cpu=True)
+        assert ckpt.get_config()["cpu_checkpointing"] is True
+        w = jnp.asarray(np.random.default_rng(0).normal(size=(32, 32)), jnp.float32)
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 32)), jnp.float32)
+
+        def f(w_, x_):
+            return jnp.sum(ckpt.checkpoint(lambda a: jnp.tanh(a @ w_) @ w_, x_) ** 2)
+
+        g_off = jax.jit(jax.grad(f))(w, x)
+        ckpt.configure(checkpoint_in_cpu=False)
+        g_plain = jax.jit(jax.grad(f))(w, x)
+        np.testing.assert_allclose(np.asarray(g_off), np.asarray(g_plain),
+                                   rtol=1e-5)
+    finally:
+        ckpt._config.update(prev)

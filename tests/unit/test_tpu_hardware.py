@@ -1,0 +1,148 @@
+"""Real-TPU kernel spot-checks (VERDICT r3 item 10): run the Pallas kernels
+COMPILED (not interpret-mode) on the actual chip at odd shapes — tile-fallback
+boundaries (`_fit_blocks`), GQA 12/4, window edges — where bf16 MXU
+accumulation and tiling bugs hide from CPU interpret mode.
+
+Run: ``DSTPU_TPU_TESTS=1 JAX_PLATFORMS=axon python -m pytest tests/ -m tpu -q``
+(skipped by default: ``pytest.ini`` addopts deselects the marker, and every
+test here also skips when no TPU is attached).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+
+def _need_tpu():
+    if jax.devices()[0].platform != "tpu":
+        pytest.skip("no TPU attached")
+
+
+def _dense_ref(q, k, v, causal=True, window=None):
+    h, hk = q.shape[2], k.shape[2]
+    if hk != h:
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / np.sqrt(q.shape[-1])
+    sq, sk = q.shape[1], k.shape[1]
+    pq = jnp.arange(sq)[:, None]
+    pk = jnp.arange(sk)[None, :]
+    mask = jnp.ones((sq, sk), bool)
+    if causal:
+        mask &= pq >= pk
+    if window is not None:
+        mask &= (pq - pk) < window
+    logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v.astype(jnp.float32))
+
+
+@pytest.mark.parametrize("seq,heads,kv_heads", [
+    (640, 8, 8),    # odd seq: not a multiple of the 512 tile
+    (640, 12, 4),   # GQA 12/4 at an odd seq
+    (1024, 12, 4),  # GQA 12/4 aligned
+    (384, 16, 1),   # MQA below one tile
+])
+def test_flash_compiled_parity_odd_shapes(seq, heads, kv_heads):
+    _need_tpu()
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.default_rng(0)
+    b, d = 2, 64
+    q = jnp.asarray(rng.normal(size=(b, seq, heads, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(b, seq, kv_heads, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(b, seq, kv_heads, d)), jnp.bfloat16)
+    out = jax.jit(lambda a, b_, c: flash_attention(a, b_, c, causal=True,
+                                                   interpret=False))(q, k, v)
+    ref = _dense_ref(q, k, v)
+    # bf16 inputs, fp32 online softmax: tolerance covers MXU accumulation
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=0.05, atol=0.05)
+
+
+def test_flash_backward_compiled_odd_seq():
+    _need_tpu()
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.default_rng(1)
+    b, seq, h, hk, d = 1, 640, 12, 4, 64
+    q = jnp.asarray(rng.normal(size=(b, seq, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, seq, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, seq, hk, d)), jnp.float32)
+
+    def f(fn):
+        return jax.jit(jax.grad(lambda a, b_, c: jnp.sum(
+            fn(a, b_, c) ** 2), argnums=(0, 1, 2)))
+
+    g_k = f(lambda a, b_, c: flash_attention(a, b_, c, interpret=False))(q, k, v)
+    g_r = f(lambda a, b_, c: _dense_ref(a, b_, c))(q, k, v)
+    for a, b_ in zip(g_k, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-2, atol=2e-2)
+
+
+def test_paged_attention_compiled_window_edges():
+    """Page-boundary cases: kv_len exactly at a page edge, one past it, and
+    a chunk straddling pages."""
+    _need_tpu()
+    from deepspeed_tpu.ops.pallas.paged_attention import paged_attention
+
+    rng = np.random.default_rng(2)
+    S, Q, Hq, Hk, D, bs, N, B = 3, 8, 8, 4, 64, 128, 16, 8
+    q = jnp.asarray(rng.normal(size=(S, Q, Hq, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.normal(size=(N, Hk, bs, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.normal(size=(N, Hk, bs, D)), jnp.bfloat16)
+    bt = jnp.asarray(rng.permutation(N)[:S * B].reshape(S, B), jnp.int32)
+    # kv_len: page-edge, page-edge+1, mid-page; chunk fills the rest
+    kv_len = jnp.asarray([128, 129, 200], jnp.int32)
+    start = kv_len - Q
+    chunk = jnp.full((S,), Q, jnp.int32)
+    out = jax.jit(lambda *a: paged_attention(*a, interpret=False))(
+        q, kp, vp, bt, start, chunk, kv_len)
+    assert out.shape == (S, Q, Hq, D)
+    assert bool(jnp.all(jnp.isfinite(out.astype(jnp.float32))))
+
+    # parity vs dense gather for sequence 0
+    def gather(pool, s):
+        pages = pool[bt[s]]                      # [B, Hk, bs, D]
+        return jnp.swapaxes(pages, 1, 2).reshape(-1, Hk, D)[: int(kv_len[s])]
+
+    s = 0
+    ks, vs = gather(kp, s), gather(vp, s)
+    ref = _dense_ref(q[s][None].astype(jnp.float32),
+                     ks[None].astype(jnp.float32),
+                     vs[None].astype(jnp.float32), causal=False)
+    # causal-by-position: query i attends to <= start+i+1 keys
+    refs = []
+    for i in range(Q):
+        n = int(start[s]) + i + 1
+        r = _dense_ref(q[s, i][None, None].astype(jnp.float32),
+                       ks[None, :n].astype(jnp.float32),
+                       vs[None, :n].astype(jnp.float32), causal=False)
+        refs.append(r[0, 0])
+    ref = jnp.stack(refs)
+    np.testing.assert_allclose(np.asarray(out[s], np.float32),
+                               np.asarray(ref), rtol=0.05, atol=0.05)
+
+
+def test_sparse_attention_compiled_layouts():
+    _need_tpu()
+    from deepspeed_tpu.ops.pallas.sparse_attention import (bigbird_layout,
+                                                           sparse_attention)
+
+    rng = np.random.default_rng(3)
+    b, seq, h, d, block = 1, 512, 4, 64, 64
+    q = jnp.asarray(rng.normal(size=(b, seq, h, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(b, seq, h, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(b, seq, h, d)), jnp.bfloat16)
+    layout = np.ones((h, seq // block, seq // block), bool)  # dense layout
+    del bigbird_layout  # imported to assert the builder vocabulary exists
+    out = jax.jit(lambda a, b_, c: sparse_attention(
+        a, b_, c, layout, causal=True, block=block, interpret=False))(q, k, v)
+    ref = _dense_ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=0.05, atol=0.05)
