@@ -194,9 +194,39 @@ def decode_bench():
     t0 = time.perf_counter()
     eng.decode_stream(steps)             # ONE dispatch, ONE host sync
     dt = time.perf_counter() - t0
-    return {"decode_tokens_per_sec": round(n_seqs * steps / dt, 1),
-            "decode_seqs": n_seqs, "decode_ctx": prompt_len,
-            "decode_attn": eng.decode_attn_impl}
+    out = {"decode_tokens_per_sec": round(n_seqs * steps / dt, 1),
+           "decode_seqs": n_seqs, "decode_ctx": prompt_len,
+           "decode_attn": eng.decode_attn_impl}
+    try:
+        out.update(v1_generate_bench(cfg, model, params, on_tpu))
+    except Exception as e:  # v1 number must not kill the v2 one
+        out["v1_generate_error"] = str(e)[:200]
+    return out
+
+
+def v1_generate_bench(cfg, model, params, on_tpu):
+    """v1 engine `generate` throughput — re-measured post frozen-cache
+    rewrite (VERDICT r3: 5424 tok/s recorded BEFORE the rewrite, never
+    after; this closes that gap whenever bench runs on a healthy chip)."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    if on_tpu:
+        b, prompt, new = 16, 256, 256
+    else:
+        b, prompt, new = 2, 16, 16
+    eng = InferenceEngine(model, params, DeepSpeedInferenceConfig(
+        dtype="bfloat16" if on_tpu else "float32",
+        max_out_tokens=prompt + new + 8))
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (b, prompt)), jnp.int32)
+    eng.generate(toks, max_new_tokens=new)  # compile
+    t0 = time.perf_counter()
+    got = eng.generate(toks, max_new_tokens=new)
+    np.asarray(got)
+    dt = time.perf_counter() - t0
+    return {"v1_generate_tokens_per_sec": round(b * new / dt, 1),
+            "v1_generate_batch": b, "v1_generate_new": new}
 
 
 def main():
@@ -454,22 +484,27 @@ def rung4_pipeline_bubble():
         rng.integers(0, V, (B, S)), jnp.int32)} for _ in range(4)]
     steps, warmup = 12, 3
 
-    def bench_pp(m_):
+    def bench_pp(m_, v_=1):
+        from deepspeed_tpu.runtime.pipe.pipeline import interleave_pipeline_params
+
         topo = Topology(TopologySpec(pp=p))
         set_topology(topo)
+        pp_params = (interleave_pipeline_params(params, p, v_) if v_ > 1
+                     else params)
         loss_fn = make_pipeline_loss_fn(embed_fn, block_fn, head_loss_fn,
                                         num_layers=L, num_stages=p,
-                                        num_microbatches=m_)
+                                        num_microbatches=m_, virtual_stages=v_)
         engine, *_ = ds.initialize(
-            model=loss_fn, model_parameters=params,
+            model=loss_fn, model_parameters=pp_params,
             config={"train_micro_batch_size_per_gpu": B,
                     "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
                     "pipeline": {"stages": p}, "steps_per_print": 10**9},
-            topology=topo, param_specs=pipeline_param_specs(params))
+            topology=topo, param_specs=pipeline_param_specs(pp_params))
         return _time_steps(engine, batches, steps, warmup)
 
     t_m2, _ = bench_pp(2)
     t_m8, _ = bench_pp(m)
+    t_int, _ = bench_pp(m, v_=2)  # interleaved: bubble (p-1)/(v*m)
     set_topology(Topology(TopologySpec()))
     ideal_ratio = (1 + (p - 1) / 2) / (1 + (p - 1) / m)
     measured = t_m2 / t_m8
@@ -478,6 +513,8 @@ def rung4_pipeline_bubble():
             "vs_baseline": round(measured / ideal_ratio, 4),
             "ideal_ratio": round(ideal_ratio, 4),
             "t_m2_s": round(t_m2, 3), "t_m8_s": round(t_m8, 3),
+            "t_interleaved_v2_s": round(t_int, 3),
+            "interleaved_speedup_vs_gpipe": round(t_m8 / t_int, 4),
             "microbatches": m, "stages": p, "device": "cpu-mesh-8"}
 
 

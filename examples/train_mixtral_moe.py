@@ -1,0 +1,61 @@
+"""Mixtral-style MoE: expert parallelism + Ulysses sequence parallelism.
+
+Maps BASELINE rung 5: top-2 gating with capacity (or dropless grouped-GEMM —
+flip ``moe_dropless=True``), experts sharded over the ``ep`` mesh axis,
+sequence sharded over ``sp``, composed with ZeRO-2.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# honor JAX_PLATFORMS even when a site hook pre-registered another backend
+# (the env-var route alone is too late once jax is imported at startup)
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.transformer import (TransformerLM, init_params,
+                                              make_loss_fn, mixtral_config)
+from deepspeed_tpu.parallel import Topology, TopologySpec, set_topology
+
+DS_CONFIG = {
+    "train_micro_batch_size_per_gpu": 8,
+    "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
+    "zero_optimization": {"stage": 2},
+    "sequence_parallel_size": 2,
+    "moe": {"enabled": True, "ep_size": 4, "num_experts": 4},
+    "steps_per_print": 10,
+}
+
+
+def main():
+    topo = Topology(TopologySpec(sp=2, ep=4))  # 8 devices: dp=4 (ep splits it)
+    set_topology(topo)
+    cfg = mixtral_config("tiny", num_layers=2, hidden_size=64,
+                         intermediate_size=128, num_heads=8, num_kv_heads=2,
+                         vocab_size=512, max_seq_len=64, num_experts=4,
+                         sequence_parallel=True, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    params = init_params(model, seq=64)
+    engine, *_ = ds.initialize(model=make_loss_fn(model),
+                               model_parameters=params, config=DS_CONFIG,
+                               topology=topo)
+    rng = np.random.default_rng(0)
+    for step in range(20):
+        start = rng.integers(0, cfg.vocab_size, size=(engine.train_batch_size, 1))
+        toks = (start + np.arange(64)) % cfg.vocab_size
+        loss = engine.train_batch({"tokens": jnp.asarray(toks, jnp.int32)})
+        if step % 10 == 0:
+            print(f"step {step}: loss {float(loss):.4f}")
+    print("final loss:", float(loss))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""Pipeline parallelism: gpipe and interleaved virtual-stage schedules.
+
+Maps BASELINE rung 4. Uses the real transformer block through the pipeline
+bridge (``transformer_pipeline_fns``) — the analogue of handing a layer list
+to ``PipelineModule``.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# honor JAX_PLATFORMS even when a site hook pre-registered another backend
+# (the env-var route alone is too late once jax is imported at startup)
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.transformer import (TransformerConfig, TransformerLM,
+                                              init_params,
+                                              stack_transformer_params,
+                                              transformer_pipeline_fns)
+from deepspeed_tpu.parallel import Topology, TopologySpec, set_topology
+from deepspeed_tpu.runtime.pipe.pipeline import (interleave_pipeline_params,
+                                                 make_pipeline_loss_fn,
+                                                 pipeline_param_specs)
+
+PP, V, MICRO = 4, 2, 8  # interleaved: bubble (PP-1)/(V*MICRO) ~ 4.5%
+
+
+def main():
+    topo = Topology(TopologySpec(pp=PP))
+    set_topology(topo)
+    cfg = TransformerConfig(vocab_size=256, hidden_size=64,
+                            intermediate_size=128, num_layers=PP * V,
+                            num_heads=4, num_kv_heads=2, max_seq_len=32,
+                            tie_embeddings=False, dtype=jnp.float32)
+    params = stack_transformer_params(init_params(TransformerLM(cfg), seq=32), cfg)
+    params = interleave_pipeline_params(params, PP, V)
+    e_fn, b_fn, h_fn = transformer_pipeline_fns(cfg)
+    loss_fn = make_pipeline_loss_fn(e_fn, b_fn, h_fn, num_layers=cfg.num_layers,
+                                    num_stages=PP, num_microbatches=MICRO,
+                                    virtual_stages=V,
+                                    activation_checkpoint_interval=1)
+    engine, *_ = ds.initialize(
+        model=loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 16,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "pipeline": {"stages": PP, "schedule": "interleaved",
+                             "virtual_stages": V},
+                "steps_per_print": 10},
+        topology=topo, param_specs=pipeline_param_specs(params))
+    rng = np.random.default_rng(0)
+    for step in range(20):
+        start = rng.integers(0, cfg.vocab_size, size=(16, 1))
+        toks = (start + np.arange(32)) % cfg.vocab_size
+        loss = engine.train_batch({"tokens": jnp.asarray(toks, jnp.int32)})
+        if step % 10 == 0:
+            print(f"step {step}: loss {float(loss):.4f}")
+    print("final loss:", float(loss))
+
+
+if __name__ == "__main__":
+    main()
