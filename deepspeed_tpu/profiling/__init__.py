@@ -1,5 +1,6 @@
 from .flops_profiler import (FlopsProfiler, count_flops, get_model_profile,
                              params_count, xla_cost_analysis)
+from . import trace
 from .timer import SynchronizedWallClockTimer, ThroughputTimer
 
 __all__ = [

@@ -1,0 +1,13 @@
+"""Shared example bootstrap (imported for its side effect).
+
+Honors JAX_PLATFORMS even when a site hook pre-registered another backend —
+the env-var route alone is too late once jax is imported at interpreter
+startup, so re-apply it through jax.config before any device use.
+"""
+
+import os
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

@@ -9,13 +9,7 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-# honor JAX_PLATFORMS even when a site hook pre-registered another backend
-# (the env-var route alone is too late once jax is imported at startup)
-if os.environ.get("JAX_PLATFORMS"):
-    import jax
-
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+from examples import _bootstrap  # noqa: E402,F401  (JAX platform handling)
 
 import jax.numpy as jnp
 import numpy as np

@@ -9,13 +9,7 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-# honor JAX_PLATFORMS even when a site hook pre-registered another backend
-# (the env-var route alone is too late once jax is imported at startup)
-if os.environ.get("JAX_PLATFORMS"):
-    import jax
-
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+from examples import _bootstrap  # noqa: E402,F401  (JAX platform handling)
 
 import jax.numpy as jnp
 import numpy as np
@@ -56,8 +50,9 @@ def main():
                 "steps_per_print": 10},
         topology=topo, param_specs=pipeline_param_specs(params))
     rng = np.random.default_rng(0)
+    gbs = engine.train_batch_size  # micro_bs x dp — feed the GLOBAL batch
     for step in range(20):
-        start = rng.integers(0, cfg.vocab_size, size=(16, 1))
+        start = rng.integers(0, cfg.vocab_size, size=(gbs, 1))
         toks = (start + np.arange(32)) % cfg.vocab_size
         loss = engine.train_batch({"tokens": jnp.asarray(toks, jnp.int32)})
         if step % 10 == 0:
