@@ -82,6 +82,7 @@ class TransformerConfig:
     moe_noisy_gate_policy: Optional[str] = None  # None | 'Jitter' | 'RSample'
     moe_drop_tokens: bool = True                 # False -> static no-drop capacity k*S
     moe_use_rts: bool = True                     # random token selection on overflow
+    moe_use_residual: bool = False               # PR-MoE: dense MLP + learned 2-way coef
     # dropless grouped-GEMM experts (ragged_dot); best with ep=1
     moe_dropless: bool = False
     # execution

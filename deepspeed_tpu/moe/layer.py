@@ -116,7 +116,32 @@ class MoEBlock(nn.Module):
             sdn = self.param("shared_down_proj", init, (fs, d), jnp.float32)
             srt = self.param("shared_router", init, (d, 1), jnp.float32)
 
+        # PR-MoE residual (reference MoE.forward, moe/layer.py:124): a dense
+        # MLP runs beside the experts; a learned per-token 2-way softmax
+        # coefficient blends them. Distinct from qwen2's shared expert
+        # (sigmoid-modulated ADDITION) below.
+        use_residual = getattr(cfg, "moe_use_residual", False)
+        if use_residual:
+            r_up = self.param("residual_up_proj", init, (d, f), jnp.float32)
+            r_down = self.param("residual_down_proj", init, (f, d), jnp.float32)
+            r_gate = (self.param("residual_gate_proj", init, (d, f), jnp.float32)
+                      if swiglu else None)
+            r_coef = self.param("residual_coefficient", init, (d, 2), jnp.float32)
+
+        def add_residual(y):
+            if not use_residual:
+                return y
+            if swiglu:
+                h_r = nn.silu(x @ r_gate.astype(x.dtype)) * (x @ r_up.astype(x.dtype))
+            else:
+                h_r = nn.gelu(x @ r_up.astype(x.dtype))
+            out_r = h_r @ r_down.astype(x.dtype)
+            coef = nn.softmax((x.astype(jnp.float32) @ r_coef), axis=-1)
+            coef = coef.astype(y.dtype)
+            return y * coef[..., 0:1] + out_r * coef[..., 1:2]
+
         def add_shared(y):
+            y = add_residual(y)
             if not fs:
                 return y
             h_s = nn.silu(x @ sg.astype(x.dtype)) * (x @ su.astype(x.dtype))
@@ -131,6 +156,12 @@ class MoEBlock(nn.Module):
             # ep=1 (local groups); with ep>1 prefer the capacity einsums.
             gates = jax.nn.softmax(logits, axis=-1)
             aux = load_balance_aux(gates, used_token)
+            # exp_counts diagnostic (reference MoE.forward third return):
+            # dropless = every top-k assignment lands, so counts come from
+            # the router directly
+            _, top_e = jax.lax.top_k(gates.reshape(-1, e), k)
+            self.sow("intermediates", "moe_exp_counts",
+                     jnp.bincount(top_e.reshape(-1), length=e).astype(jnp.int32))
             y = dropless_moe(x, gates, k, w_gate, w_up, w_down,
                              activation=cfg.activation, norm_topk=norm_topk,
                              b_up=b_up, b_down=b_down, b_gate=b_gate)
@@ -172,6 +203,12 @@ class MoEBlock(nn.Module):
         if b_down is not None:
             out = out + b_down.astype(x.dtype)[:, None, None, :]
         out = _constrain(out, P("ep", ("dp_outer",), None, None), skip)
+
+        # per-expert token counts (reference MoE.forward's third return,
+        # exp_counts) — sown as a diagnostic intermediate the caller can
+        # collect with mutable=["intermediates"]
+        self.sow("intermediates", "moe_exp_counts",
+                 jnp.sum(dispatch.astype(jnp.int32), axis=(0, 1, 3)))
 
         y = moe_combine(out, combine)
         y = add_shared(y.astype(x.dtype))

@@ -28,16 +28,13 @@ def capture(log_dir: str, *, host_tracer_level: int = 2,
     """Context manager around any block of dispatches. The trace lands in
     ``<log_dir>/plugins/profile/<run>/`` (TensorBoard layout)."""
     os.makedirs(log_dir, exist_ok=True)
-    options = jax.profiler.ProfileOptions()
     try:
+        options = jax.profiler.ProfileOptions()
         options.host_tracer_level = host_tracer_level
         options.python_tracer_level = python_tracer_level
-    except Exception:  # older jax: options object without these fields
-        options = None
-    if options is not None:
         jax.profiler.start_trace(log_dir, profiler_options=options)
-    else:  # pragma: no cover
-        jax.profiler.start_trace(log_dir)
+    except (AttributeError, TypeError):  # older jax: no ProfileOptions /
+        jax.profiler.start_trace(log_dir)  # no profiler_options kwarg
     try:
         yield log_dir
     finally:
@@ -49,6 +46,10 @@ def profile_steps(engine: Any, batches: Iterable, *, log_dir: str,
     """Run ``warmup`` uncaptured steps (compile outside the trace), then
     capture ``steps`` steps. Returns the log dir."""
     batches = list(batches)
+    if not batches:
+        raise ValueError("profile_steps needs at least one batch")
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
     loss = None
     for i in range(warmup):
         loss = engine.train_batch(batches[i % len(batches)])
