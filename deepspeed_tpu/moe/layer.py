@@ -50,6 +50,13 @@ def _constrain(x, spec, skip: bool = False):
 class MoEBlock(nn.Module):
     """Drop-in MLP replacement returning ``(out, aux_loss)``.
 
+    The per-expert token counts diagnostic (reference ``MoE.forward``'s third
+    return, ``exp_counts``) is sown as the ``moe_exp_counts`` intermediate:
+    PRE-capacity router assignments with padding tokens excluded — matching
+    the reference (``top1gating`` computes exp_counts from ``mask1`` before
+    the capacity truncation) and identical semantics on both the capacity
+    and dropless paths.
+
     ``used_token [G,S]`` (reference ``MoE.forward(hidden, used_token)``,
     ``moe/layer.py:115``) excludes padding tokens from dispatch + aux loss.
     Gating stochasticity (RSample / Jitter noise, Random Token Selection)
@@ -58,6 +65,15 @@ class MoEBlock(nn.Module):
     deterministic — eval and tracing stay reproducible.
     """
     cfg: object  # TransformerConfig
+
+    def _sow_exp_counts(self, gates, k, e, used_token):
+        """Pre-drop per-expert assignment counts (see class docstring)."""
+        _, top_e = jax.lax.top_k(gates, k)                   # [G, S, k]
+        hot = jax.nn.one_hot(top_e, e, dtype=jnp.int32)      # [G, S, k, E]
+        if used_token is not None:
+            hot = hot * used_token.astype(jnp.int32)[..., None, None]
+        self.sow("intermediates", "moe_exp_counts",
+                 jnp.sum(hot, axis=(0, 1, 2)))
 
     @nn.compact
     def __call__(self, x, used_token=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -156,12 +172,7 @@ class MoEBlock(nn.Module):
             # ep=1 (local groups); with ep>1 prefer the capacity einsums.
             gates = jax.nn.softmax(logits, axis=-1)
             aux = load_balance_aux(gates, used_token)
-            # exp_counts diagnostic (reference MoE.forward third return):
-            # dropless = every top-k assignment lands, so counts come from
-            # the router directly
-            _, top_e = jax.lax.top_k(gates.reshape(-1, e), k)
-            self.sow("intermediates", "moe_exp_counts",
-                     jnp.bincount(top_e.reshape(-1), length=e).astype(jnp.int32))
+            self._sow_exp_counts(gates, k, e, used_token)
             y = dropless_moe(x, gates, k, w_gate, w_up, w_down,
                              activation=cfg.activation, norm_topk=norm_topk,
                              b_up=b_up, b_down=b_down, b_gate=b_gate)
@@ -204,11 +215,7 @@ class MoEBlock(nn.Module):
             out = out + b_down.astype(x.dtype)[:, None, None, :]
         out = _constrain(out, P("ep", ("dp_outer",), None, None), skip)
 
-        # per-expert token counts (reference MoE.forward's third return,
-        # exp_counts) — sown as a diagnostic intermediate the caller can
-        # collect with mutable=["intermediates"]
-        self.sow("intermediates", "moe_exp_counts",
-                 jnp.sum(dispatch.astype(jnp.int32), axis=(0, 1, 3)))
+        self._sow_exp_counts(jax.nn.softmax(logits, axis=-1), k, e, used_token)
 
         y = moe_combine(out, combine)
         y = add_shared(y.astype(x.dtype))

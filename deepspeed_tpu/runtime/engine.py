@@ -22,7 +22,7 @@ import json
 import os
 import time
 from functools import partial
-from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -106,6 +106,37 @@ def _struct_congruent_specs(state_shapes, params, param_spec_tree):
     return jax.tree_util.tree_unflatten(treedef, [spec_for(p, l) for p, l in flat])
 
 
+def _abstract_params(params):
+    """Shape tree for possibly-lazy params (the zero.Init closure form)."""
+    return (jax.eval_shape(params)
+            if callable(params) and not hasattr(params, "shape") else params)
+
+
+def _frozen_label_tree(params, patterns: Sequence[str]):
+    """'freeze'/'train' label per leaf: a leaf freezes when any pattern hits
+    its '/'-joined path at a name-component boundary (same matching contract
+    as AutoTP's name vocabulary). A pattern matching NOTHING is an error —
+    a typo'd pattern silently training everything (and materializing full
+    Adam state) is exactly what the user asked to avoid."""
+    import re
+
+    def hit(pattern: str, path: str) -> bool:
+        return re.search(rf"(^|[/_.\-]){re.escape(pattern)}([/_.\-]|$)",
+                         path) is not None
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    paths = ["/".join(str(getattr(e, "key", getattr(e, "name", e))) for e in kp)
+             for kp, _ in flat]
+    unmatched = [p for p in patterns if not any(hit(p, path) for path in paths)]
+    if unmatched:
+        raise ValueError(f"frozen_params patterns {unmatched} match no "
+                         f"parameter path; available paths include "
+                         f"{paths[:8]}...")
+    labels = ["freeze" if any(hit(p, path) for p in patterns) else "train"
+              for path in paths]
+    return jax.tree_util.tree_unflatten(treedef, labels)
+
+
 class DeepSpeedTPUEngine:
     def __init__(self,
                  loss_fn: Callable,
@@ -117,7 +148,8 @@ class DeepSpeedTPUEngine:
                  optimizer: Optional[optax.GradientTransformation] = None,
                  lr_scheduler: Optional[Callable] = None,
                  donate_state: bool = True,
-                 autotp_example_batch: Any = None):
+                 autotp_example_batch: Any = None,
+                 frozen_params: Optional[Sequence[str]] = None):
         self.config = config
         self.topo = topology or get_topology()
         set_topology(self.topo)
@@ -137,8 +169,7 @@ class DeepSpeedTPUEngine:
             # jaxpr dataflow analysis classifies col/row from the program;
             # otherwise the reference's name vocabulary decides.
             from ..module_inject import tp_parser
-            abstract = (jax.eval_shape(params) if callable(params)
-                        and not hasattr(params, "shape") else params)
+            abstract = _abstract_params(params)
             if autotp_example_batch is not None:
                 if self._loss_takes_rng:
                     trace_fn = lambda p, b: loss_fn(p, b, jax.random.PRNGKey(0))  # noqa: E731
@@ -193,6 +224,24 @@ class DeepSpeedTPUEngine:
         else:
             opt_params["lr"] = self.lr_schedule if config.scheduler.type else base_lr
             self.tx = build_optimizer(config.optimizer.type, opt_params)
+
+        # --- frozen parameters (reference requires_grad=False / the
+        # SimpleFrozenModel tier): path patterns select leaves that get NO
+        # update and NO optimizer state (multi_transform routes them to
+        # set_to_zero, so Adam moments for frozen leaves never exist —
+        # the memory-relevant half of freezing under ZeRO) -----------------
+        self.frozen_patterns = tuple(frozen_params or ())
+        if self.frozen_patterns:
+            if self._host_adam_mode:
+                log_dist("frozen_params: host-Adam offload tier does not "
+                         "mask updates — using pinned-host state with "
+                         "on-device compute instead")
+                self._host_adam_mode = False
+            self._frozen_labels = _frozen_label_tree(_abstract_params(params),
+                                                     self.frozen_patterns)
+            self.tx = optax.multi_transform(
+                {"train": self.tx, "freeze": optax.set_to_zero()},
+                self._frozen_labels)
 
         # --- place state on the mesh ------------------------------------
         self._build_state(params)
@@ -400,6 +449,14 @@ class DeepSpeedTPUEngine:
             # scaling, engine.py:2023)
             denom = scale * gas
             grads = jax.tree.map(lambda g: g / denom, acc)
+            if self.frozen_patterns:
+                # requires_grad=False semantics: frozen grads are zeroed
+                # BEFORE the norm so clipping of trained params matches an
+                # unfrozen-free run exactly (the optimizer masking alone
+                # would leave them inflating grad_norm)
+                grads = jax.tree.map(
+                    lambda g, lbl: jnp.zeros_like(g) if lbl == "freeze" else g,
+                    grads, self._frozen_labels)
 
             grad_norm = global_grad_norm(grads)
             overflow = ~jnp.isfinite(grad_norm) if fp16 else jnp.zeros([], jnp.bool_)
@@ -454,6 +511,10 @@ class DeepSpeedTPUEngine:
             rngs = jax.random.split(rng, gas)
             acc, losses = lax.scan(micro, zeros, (batch, rngs))
             grads = jax.tree.map(lambda g: g / gas, acc)
+            if self.frozen_patterns:  # same masking as the fused step
+                grads = jax.tree.map(
+                    lambda g, lbl: jnp.zeros_like(g) if lbl == "freeze" else g,
+                    grads, self._frozen_labels)
             grad_norm = global_grad_norm(grads)
             if clip and clip > 0:
                 coef = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
@@ -1072,7 +1133,8 @@ def initialize(args=None,
                                 batch_spec=batch_spec, optimizer=optimizer,
                                 lr_scheduler=lr_scheduler,
                                 autotp_example_batch=kwargs.get(
-                                    "autotp_example_batch"))
+                                    "autotp_example_batch"),
+                                frozen_params=kwargs.get("frozen_params"))
     dist.configure(comms_logger=cfg.comms_logger)
 
     dataloader = None

@@ -127,6 +127,54 @@ def test_variants_agree_with_each_other():
     np.testing.assert_allclose(z0, z3, rtol=1e-3)
 
 
+def test_pipeline_agrees_with_dense():
+    """The pipeline split is a layout, not a math change: the same untied
+    GPT-2-tiny trained pp=4 (gpipe) for 60 steps must track
+    the dense run step-for-step (reference run_func_test pipeline configs)."""
+    import dataclasses
+
+    from deepspeed_tpu.models.transformer import (stack_transformer_params,
+                                                  transformer_pipeline_fns)
+    from deepspeed_tpu.runtime.pipe.pipeline import (make_pipeline_loss_fn,
+                                                     pipeline_param_specs)
+
+    short = 60
+    cfg = dataclasses.replace(_gpt2_tiny(jnp.float32), tie_embeddings=False,
+                              num_layers=4)
+    model = TransformerLM(cfg)
+    base = init_params(model, seq=SEQ, seed=7)
+
+    # dense run
+    set_topology(Topology(TopologySpec()))
+    engine_d, *_ = ds.initialize(
+        model=make_loss_fn(model), model_parameters=base,
+        config={"train_micro_batch_size_per_gpu": BATCH,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "gradient_clipping": 1.0, "steps_per_print": 10**9})
+    dense = [float(engine_d.train_batch(_batch(s))) for s in range(short)]
+
+    # pipeline run: same weights, pp=4, microbatches = 4
+    try:
+        topo = Topology(TopologySpec(pp=4))
+        set_topology(topo)
+        pparams = stack_transformer_params(base, cfg)
+        e_fn, b_fn, h_fn = transformer_pipeline_fns(cfg)
+        loss_fn = make_pipeline_loss_fn(e_fn, b_fn, h_fn, num_layers=4,
+                                        num_stages=4, num_microbatches=4)
+        engine_p, *_ = ds.initialize(
+            model=loss_fn, model_parameters=pparams,
+            config={"train_micro_batch_size_per_gpu": BATCH,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "pipeline": {"stages": 4}, "gradient_clipping": 1.0,
+                    "steps_per_print": 10**9},
+            topology=topo, param_specs=pipeline_param_specs(pparams))
+        piped = [float(engine_p.train_batch(_batch(s))) for s in range(short)]
+    finally:
+        set_topology(Topology(TopologySpec()))
+    np.testing.assert_allclose(piped, dense, rtol=2e-3,
+                               err_msg="pipeline curve diverged from dense")
+
+
 if __name__ == "__main__":
     # standalone regeneration: pin the CPU mesh the way conftest does (the
     # env var alone is too late — the axon sitecustomize registers its PJRT

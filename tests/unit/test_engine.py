@@ -203,3 +203,55 @@ def test_no_sync_defers_the_step():
         lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                                 rtol=1e-5, atol=1e-6),
         base.state.params, deferred.state.params)
+
+
+def test_frozen_params_not_updated(tmp_path):
+    """frozen_params (reference requires_grad=False / SimpleFrozenModel):
+    matching leaves get no update and no optimizer state; checkpoints
+    round-trip the frozen structure."""
+    import deepspeed_tpu as ds
+    from .simple_model import make_simple_params, random_batches, simple_loss
+
+    def make():
+        engine, *_ = ds.initialize(
+            model=simple_loss, model_parameters=make_simple_params(32),
+            config={"train_micro_batch_size_per_gpu": 8,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                    "zero_optimization": {"stage": 3}, "steps_per_print": 1000},
+            frozen_params=["layer_0"])
+        return engine
+
+    engine = make()
+    before = jax.tree.map(np.asarray, engine.state.params)
+    batches = random_batches(6, 8, 32, seed=21)
+    for b in batches[:3]:
+        engine.train_batch(b)
+    after = jax.tree.map(np.asarray, engine.state.params)
+    frozen_leaves = trained_leaves = 0
+    for (kp, a), (_, b_) in zip(
+            jax.tree_util.tree_flatten_with_path(before)[0],
+            jax.tree_util.tree_flatten_with_path(after)[0]):
+        path = "/".join(str(getattr(e, "key", e)) for e in kp)
+        if "layer_0" in path:
+            np.testing.assert_array_equal(a, b_, err_msg=path)
+            frozen_leaves += 1
+        else:
+            assert not np.array_equal(a, b_), path
+            trained_leaves += 1
+    assert frozen_leaves and trained_leaves
+
+    # no optimizer state exists for frozen leaves (the memory half)
+    import optax
+    masked = [l for l in jax.tree.leaves(
+        engine.state.opt_state,
+        is_leaf=lambda x: isinstance(x, optax.MaskedNode))
+        if isinstance(l, optax.MaskedNode)]
+    assert masked, "expected MaskedNode placeholders for frozen leaves"
+
+    # checkpoint continuation with the frozen structure
+    engine.save_checkpoint(str(tmp_path / "f"), tag="t")
+    cont1 = [float(engine.train_batch(b)) for b in batches[3:]]
+    e2 = make()
+    e2.load_checkpoint(str(tmp_path / "f"), tag="t")
+    cont2 = [float(e2.train_batch(b)) for b in batches[3:]]
+    np.testing.assert_allclose(cont1, cont2, rtol=1e-5, atol=1e-6)
