@@ -688,13 +688,14 @@ def stack_transformer_params(params, cfg: TransformerConfig):
     goes to ``embed``, final norm + lm head to ``head`` (the analogue of
     handing a layer list to ``PipelineModule``, reference ``module.py:86``).
 
-    Requires homogeneous layers (stacking needs one structure) and untied
-    embeddings (a tied table would appear as two leaves with divergent
-    updates).
+    Requires homogeneous layers (stacking needs one structure). Tied
+    embeddings are supported (reference ``TiedLayerSpec``): the table lives
+    ONLY under ``embed`` and the head re-reads it (``head_loss_fn`` receives
+    the full extra tree when ``tied_head=True``); both stages' gradient
+    contributions psum over pp via shard_map's replicated-input transpose —
+    exactly the reference's tied-weight allreduce
+    (``_exec_reduce_tied_grads``, pipe/engine.py:275).
     """
-    if cfg.tie_embeddings:
-        raise ValueError("pipeline bridge needs tie_embeddings=False (a tied "
-                         "table would be two independently-updated leaves)")
     layers = [params[f"layer_{i}"] for i in range(cfg.num_layers)]
     structs = {jax.tree.structure(l) for l in layers}
     if len(structs) > 1:
@@ -707,7 +708,9 @@ def stack_transformer_params(params, cfg: TransformerConfig):
         embed["embed_norm"] = params["embed_norm"]
     if cfg.position == "learned":
         embed["pos_embed"] = params["pos_embed"]
-    head = {"final_norm": params["final_norm"], "lm_head": params["lm_head"]}
+    head = {"final_norm": params["final_norm"]}
+    if not cfg.tie_embeddings:
+        head["lm_head"] = params["lm_head"]
     return {"embed": embed, "blocks": blocks, "head": head}
 
 
@@ -744,12 +747,25 @@ def transformer_pipeline_fns(cfg: TransformerConfig):
     def head_loss_fn(p, x, mb):
         tokens = mb["tokens"] if isinstance(mb, dict) else mb
         mask = mb.get("loss_mask") if isinstance(mb, dict) else None
-        x = final_norm_mod.apply({"params": p["final_norm"]}, x)
-        logits = x.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
-        if "bias" in p["lm_head"]:  # gptj/phi biased lm_head
-            logits = logits + p["lm_head"]["bias"].astype(jnp.float32)
+        if cfg.tie_embeddings:
+            # tied head (make_pipeline_loss_fn auto-detects via the
+            # _tied_head attribute below, so p is the FULL extra tree):
+            # logits reuse the stage-0 embedding table; its two gradient
+            # contributions psum over pp automatically. Matmul in cfg.dtype
+            # to match the dense path's nn.Embed.attend promotion.
+            x = final_norm_mod.apply({"params": p["head"]["final_norm"]}, x)
+            table = p["embed"]["embed"]["embedding"].astype(cfg.dtype)
+            logits = (x.astype(cfg.dtype) @ table.T).astype(jnp.float32)
+        else:
+            x = final_norm_mod.apply({"params": p["final_norm"]}, x)
+            logits = x.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
+            if "bias" in p["lm_head"]:  # gptj/phi biased lm_head
+                logits = logits + p["lm_head"]["bias"].astype(jnp.float32)
         return causal_lm_loss(logits, tokens, mask)
 
+    # make_pipeline_loss_fn reads this to pick the head calling convention —
+    # deriving it here removes the two-flags-must-agree failure mode
+    head_loss_fn._tied_head = cfg.tie_embeddings
     return embed_fn, block_fn, head_loss_fn
 
 

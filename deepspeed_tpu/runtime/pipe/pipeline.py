@@ -203,7 +203,8 @@ def make_pipeline_loss_fn(embed_fn: Callable, block_fn: Callable, head_loss_fn: 
                           *, num_layers: int, num_stages: int, num_microbatches: int,
                           partition_method: str = "uniform",
                           activation_checkpoint_interval: int = 0,
-                          layer_costs=None, virtual_stages: int = 1):
+                          layer_costs=None, virtual_stages: int = 1,
+                          tied_head: Optional[bool] = None):
     """Build an engine-compatible ``loss = f(params, batch)`` running an SPMD
     pipeline (the analogue of wrapping a model in ``PipelineModule``).
 
@@ -215,7 +216,25 @@ def make_pipeline_loss_fn(embed_fn: Callable, block_fn: Callable, head_loss_fn: 
     ``virtual_stages > 1`` selects the interleaved schedule; ``params`` must
     then hold blocks in the ``interleave_pipeline_params`` layout
     ``[p, v, L/(p*v), ...]``.
+
+    ``tied_head=True`` (reference ``TiedLayerSpec``): ``head_loss_fn``
+    receives the FULL extra tree ``{"embed": ..., "head": ...}`` instead of
+    just the head params, so a tied lm head can re-read the embedding table;
+    both stages' gradient contributions psum over pp via the replicated-input
+    transpose (the reference's tied-weight allreduce). Default ``None``
+    derives it from ``head_loss_fn._tied_head`` when the head declares one
+    (the transformer bridge does), so the model flag and the calling
+    convention cannot disagree; an explicit value that contradicts the
+    declaration raises.
     """
+    declared = getattr(head_loss_fn, "_tied_head", None)
+    if tied_head is None:
+        tied_head = bool(declared)
+    elif declared is not None and bool(tied_head) != bool(declared):
+        raise ValueError(
+            f"tied_head={tied_head} contradicts head_loss_fn's declared "
+            f"_tied_head={declared} (set by the transformer bridge from "
+            "cfg.tie_embeddings) — drop the explicit argument")
     v = int(virtual_stages)
     resolve_partition(num_layers, num_stages * v, partition_method, layer_costs)
     layers_per_stage = num_layers // (num_stages * v)
@@ -278,10 +297,12 @@ def make_pipeline_loss_fn(embed_fn: Callable, block_fn: Callable, head_loss_fn: 
                     f"interleave_pipeline_params); got leading dims {lead}")
 
         def pipe_body(blocks_, embed_, head_, mbs_):
+            last = head_loss_fn if tied_head \
+                else (lambda extra, x, mb: head_loss_fn(extra["head"], x, mb))
             losses = spmd_pipeline(
                 stage_fn, jax.tree.map(lambda a: a[0], blocks_), mbs_,
                 first_stage_fn=lambda extra, mb: embed_fn(extra["embed"], mb),
-                last_stage_fn=lambda extra, x, mb: head_loss_fn(extra["head"], x, mb),
+                last_stage_fn=last,
                 extra_params={"embed": embed_, "head": head_},
                 virtual_stages=v)
             # per-mb losses are local-batch-shard means; average over dp here
@@ -307,12 +328,13 @@ def make_pipeline_loss_fn(embed_fn: Callable, block_fn: Callable, head_loss_fn: 
     loss_fn._pipeline_meta = {"num_stages": num_stages,
                               "num_microbatches": num_microbatches,
                               "num_layers": num_layers,
-                              "virtual_stages": v}
+                              "virtual_stages": v,
+                              "tied_head": tied_head}
     return loss_fn
 
 
 def from_pipeline_config(embed_fn, block_fn, head_loss_fn, *, num_layers: int,
-                         config, layer_costs=None):
+                         config, layer_costs=None, tied_head: Optional[bool] = None):
     """Build the pipeline loss from a DeepSpeedTPUConfig (wires the reference
     config keys: ``pipeline.stages``, ``pipeline.micro_batches`` with the
     reference default of ``gradient_accumulation_steps``,
@@ -341,7 +363,7 @@ def from_pipeline_config(embed_fn, block_fn, head_loss_fn, *, num_layers: int,
         num_stages=pc.stages, num_microbatches=micro,
         partition_method=pc.partition_method,
         activation_checkpoint_interval=pc.activation_checkpoint_interval,
-        layer_costs=layer_costs, virtual_stages=v)
+        layer_costs=layer_costs, virtual_stages=v, tied_head=tied_head)
 
 
 def pipeline_param_specs(params, topo=None) -> Any:

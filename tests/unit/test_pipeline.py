@@ -133,7 +133,8 @@ def test_from_pipeline_config():
                        "train_micro_batch_size_per_gpu": 4})
     f = from_pipeline_config(embed_fn, block_fn, head_loss_fn, num_layers=L, config=cfg)
     assert f._pipeline_meta == {"num_stages": 2, "num_microbatches": 4,
-                                "num_layers": L, "virtual_stages": 1}
+                                "num_layers": L, "virtual_stages": 1,
+                                "tied_head": False}
 
 
 def test_partition_balanced_too_many_parts():
@@ -376,3 +377,63 @@ def test_from_pipeline_config_interleaved_knobs():
     with pytest.raises(ValueError, match="virtual_stages"):
         from_pipeline_config(embed_fn, block_fn, head_loss_fn,
                              num_layers=L, config=cfg_bad)
+
+
+def test_tied_embeddings_pipeline_matches_dense():
+    """TiedLayerSpec analogue: a tie_embeddings transformer runs the SPMD
+    pipeline with the table stored once (under embed) and re-read by the
+    head; loss AND the tied table's gradient (stage-0 + head contributions
+    psum'd over pp) match the dense model."""
+    import dataclasses
+
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM, init_params,
+                                                  make_loss_fn,
+                                                  stack_transformer_params,
+                                                  transformer_pipeline_fns)
+
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                            num_layers=4, num_heads=4, max_seq_len=16,
+                            tie_embeddings=True, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    params = init_params(model, seq=16)
+    toks = {"tokens": jnp.asarray(
+        np.random.default_rng(9).integers(0, 64, (8, 16)), jnp.int32)}
+    dense_loss_fn = make_loss_fn(model)
+    dense_loss = float(dense_loss_fn(params, toks))
+    g_dense = jax.grad(lambda p: dense_loss_fn(p, toks))(params)
+
+    topo = Topology(TopologySpec(pp=4))
+    set_topology(topo)
+    try:
+        pparams = stack_transformer_params(params, cfg)
+        assert "lm_head" not in pparams["head"]  # table stored ONCE
+        e_fn, b_fn, h_fn = transformer_pipeline_fns(cfg)
+        loss_fn = make_pipeline_loss_fn(e_fn, b_fn, h_fn, num_layers=4,
+                                        num_stages=4, num_microbatches=4,
+                                        tied_head=True)
+        l_pipe = float(jax.jit(loss_fn)(pparams, toks))
+        np.testing.assert_allclose(l_pipe, dense_loss, rtol=1e-5)
+
+        g_pipe = jax.jit(jax.grad(loss_fn))(pparams, toks)
+        np.testing.assert_allclose(
+            np.asarray(g_pipe["embed"]["embed"]["embedding"]),
+            np.asarray(g_dense["embed"]["embedding"]), rtol=2e-4, atol=1e-6)
+
+        # trains through the engine
+        engine, *_ = ds.initialize(
+            model=loss_fn, model_parameters=pparams,
+            config={"train_micro_batch_size_per_gpu": 8,
+                    "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+                    "pipeline": {"stages": 4}, "steps_per_print": 1000},
+            topology=topo, param_specs=pipeline_param_specs(pparams))
+        rng = np.random.default_rng(10)
+        losses = []
+        for _ in range(15):
+            start = rng.integers(0, 64, size=(8, 1))
+            t = (start + np.arange(16)) % 64
+            losses.append(float(engine.train_batch(
+                {"tokens": jnp.asarray(t, jnp.int32)})))
+        assert losses[-1] < losses[0] * 0.8, losses
+    finally:
+        set_topology(Topology(TopologySpec()))
