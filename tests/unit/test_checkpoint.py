@@ -156,3 +156,48 @@ def test_cross_stage_elastic_load(save_stage, load_stage, tmp_path):
     e2.load_checkpoint(str(tmp_path / "x"), tag="t")
     cont2 = [float(e2.train_batch(b)) for b in batches[3:]]
     np.testing.assert_allclose(cont1, cont2, rtol=1e-5, atol=1e-6)
+
+
+def test_moe_checkpoint_under_ep_mesh(tmp_path):
+    """Reference tests/unit/checkpoint/test_moe_checkpoint.py: an MoE model
+    with experts sharded over ep round-trips (params + expert optimizer
+    state), including load under a DIFFERENT ep degree."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.transformer import (TransformerConfig, TransformerLM,
+                                                  init_params, make_loss_fn,
+                                                  param_specs)
+
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                            num_layers=2, num_heads=4, max_seq_len=16,
+                            num_experts=4, moe_top_k=2, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+
+    def make(ep):
+        topo = Topology(TopologySpec(ep=ep))
+        params = init_params(model, seq=16)
+        engine, *_ = ds.initialize(
+            model=make_loss_fn(model), model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 8,
+                    "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+                    "moe": {"enabled": True, "ep_size": ep, "num_experts": 4},
+                    "zero_optimization": {"stage": 2}, "steps_per_print": 1000},
+            topology=topo, param_specs=param_specs(params))
+        return engine
+
+    def batch(s):
+        r = np.random.default_rng(400 + s)
+        start = r.integers(0, 64, size=(8, 1))
+        return {"tokens": jnp.asarray((start + np.arange(16)) % 64, jnp.int32)}
+
+    e1 = make(ep=4)
+    for s in range(3):
+        e1.train_batch(batch(s))
+    e1.save_checkpoint(str(tmp_path / "moe"), tag="t")
+    cont1 = [float(e1.train_batch(batch(s))) for s in range(3, 6)]
+
+    # reload under ep=2: logical-global arrays reshard onto the new mesh
+    e2 = make(ep=2)
+    e2.load_checkpoint(str(tmp_path / "moe"), tag="t")
+    cont2 = [float(e2.train_batch(batch(s))) for s in range(3, 6)]
+    np.testing.assert_allclose(cont1, cont2, rtol=1e-4, atol=1e-6)
