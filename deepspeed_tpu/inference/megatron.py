@@ -24,6 +24,47 @@ from ..models.transformer import TransformerConfig
 _PRE = "model.language_model."
 
 
+def load_megatron_checkpoint(path: str):
+    """Load a real Megatron-LM ``model_optim_rng.pt`` (torch pickle) to
+    ``(args_dict, flat_numpy_state_dict)`` ready for :func:`megatron_config`
+    + :func:`megatron_params`. torch (cpu) deserializes; everything leaves
+    as numpy so no torch state lingers.
+
+    Reference flow: ``ds_to_universal``/``MegatronSDLoader`` read the same
+    files (``state_dict_factory.py`` ``SDLoaderBase.load``)."""
+    import torch
+
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    args = ckpt.get("args")
+    if args is not None and not isinstance(args, dict):
+        def scalarish(v):
+            return (isinstance(v, (int, float, bool, str, type(None)))
+                    or (isinstance(v, (list, tuple))
+                        and all(isinstance(e, (int, float, bool, str)) for e in v)))
+        # lists survive: Megatron-DeepSpeed stores num_experts as nargs='+'
+        args = {k: v for k, v in vars(args).items() if scalarish(v)}
+
+    flat: Dict[str, Any] = {}
+
+    def walk(node, prefix=""):
+        if hasattr(node, "detach"):
+            t = node.detach().cpu()
+            if t.is_floating_point():
+                t = t.float()
+            flat[prefix.rstrip(".")] = t.numpy()
+        elif isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{prefix}{k}.")
+
+    if "model" in ckpt:
+        # real layout is ckpt["model"]["language_model"]... — re-add the
+        # "model." prefix the _PRE-keyed converters expect
+        walk(ckpt["model"], "model.")
+    else:
+        walk(ckpt)
+    return args or {}, flat
+
+
 def megatron_config(args: Dict[str, Any],
                     sd: Optional[Dict[str, Any]] = None) -> TransformerConfig:
     """Map Megatron-LM ``args`` (as stored in its checkpoints) to our config.
