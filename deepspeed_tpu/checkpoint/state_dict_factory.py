@@ -88,7 +88,10 @@ def megatron_specs(tree: Any, axis: str = "tp", *, strict: bool = True) -> Any:
             # col-parallel: weight shards the output dim (0); bias too
             specs.append(P(axis) if nd >= 1 else P())
         elif _meg_match(low, _MEG_VOCAB):
-            specs.append(P(axis) if nd == 2 else P())
+            # vocab-parallel shards dim 0 for the embedding matrix AND for a
+            # 1-D output-layer bias (Megatron shards lm_head.bias along vocab
+            # too — replicating it here would merge it by the wrong rule)
+            specs.append(P(axis) if nd >= 1 else P())
         elif nd >= 2:
             if strict:
                 raise ValueError(
@@ -111,7 +114,9 @@ def save_shard_npz(path: str, tree: Any,
     if replicated_paths is not None:
         # always write the key (an EMPTY set is authoritative too: it tells
         # the merge that every identical-content leaf is a true shard)
-        flat[_REPLICATED_KEY] = np.asarray(sorted(replicated_paths), dtype="U256")
+        # let numpy size the string dtype — a fixed width would silently
+        # truncate long leaf paths and break their recognition on merge
+        flat[_REPLICATED_KEY] = np.asarray(sorted(replicated_paths))
     np.savez(path, **flat)
 
 
@@ -199,10 +204,11 @@ def merge_state_dicts(shards: Sequence[Any], specs: Any = None, *,
     ``split_state_dict(..., return_replicated=True)``): which leaves the
     split pass replicated. Without it a heuristic applies — identical shards
     whose dim is indivisible by ``split_size`` are treated as replicas. The
-    heuristic is provably ambiguous in one corner: a *constant-content*
-    sharded leaf whose shard dim is itself indivisible by the degree (e.g. a
-    zero GQA bias [2, dh] split 2-ways to [1, dh]) is indistinguishable from
-    a replica by content alone, and merges to the shard shape. Thread
+    heuristic is provably ambiguous for *constant-content* leaves: (a) a
+    sharded leaf whose shard dim is indivisible by the degree (e.g. a zero
+    GQA bias [2, dh] split 2-ways to [1, dh]), and (b) a zero-init 1-D
+    vocab-parallel bias (identical V/n shards look like an old-format
+    replicated full bias, and merge to the shard shape). Thread
     ``replicated_paths`` when exact round-trips of constant leaves matter.
     """
     if not shards:
@@ -224,15 +230,27 @@ def merge_state_dicts(shards: Sequence[Any], specs: Any = None, *,
                 dim = None
         elif dim is not None:
             # Heuristic replica detection (see docstring for the ambiguous
-            # corner): identical shards + indivisible dim => replica. A
-            # cleanly divisible dim is always treated as a real shard, so
-            # equal content there (zero-init biases) still concatenates.
+            # corners): identical shards + indivisible dim => replica. A
+            # cleanly divisible dim is treated as a real shard — EXCEPT 1-D
+            # vocab leaves, where identical content means an old-format
+            # shard set that replicated the full bias (files written before
+            # 1-D vocab leaves were sharded carry no sidecar). Trained vocab
+            # biases are never bit-identical across true shards; a
+            # zero-init sharded vocab bias is the documented ambiguity —
+            # thread ``replicated_paths`` for exactness. Content comparison
+            # is evaluated lazily so divisible 2-D weights keep the cheap
+            # modulo-only path (O(one-leaf) merge traffic).
             n_split = split_size or len(vals)
-            if (vals[0].shape[dim] % n_split != 0
-                    and all(v.shape == vals[0].shape
-                            and np.array_equal(v, vals[0])
-                            for v in vals[1:])):
-                dim = None
+            def _identical():
+                return all(v.shape == vals[0].shape
+                           and np.array_equal(v, vals[0])
+                           for v in vals[1:])
+            if vals[0].shape[dim] % n_split != 0:
+                if _identical():
+                    dim = None
+            elif vals[0].ndim == 1 and _meg_match(path.lower(), _MEG_VOCAB):
+                if _identical():
+                    dim = None
         if path in qkv_leaves and dim is not None:
             out.append(merge_qkv(vals, layout=qkv_leaves[path], dim=dim))
             continue

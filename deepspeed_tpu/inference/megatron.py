@@ -24,7 +24,7 @@ from ..models.transformer import TransformerConfig
 _PRE = "model.language_model."
 
 
-def load_megatron_checkpoint(path: str):
+def load_megatron_checkpoint(path: str, trust_pickle: bool = False):
     """Load a real Megatron-LM ``model_optim_rng.pt`` (torch pickle) to
     ``(args_dict, flat_numpy_state_dict)`` ready for :func:`megatron_config`
     + :func:`megatron_params`. torch (cpu) deserializes; everything leaves
@@ -34,7 +34,50 @@ def load_megatron_checkpoint(path: str):
     files (``state_dict_factory.py`` ``SDLoaderBase.load``)."""
     import torch
 
-    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    # Megatron checkpoints pickle an argparse.Namespace for ``args``; allow
+    # just that type under the safe (weights_only) loader so untrusted
+    # checkpoints cannot execute arbitrary pickled code. ``trust_pickle=True``
+    # is the explicit opt-in for checkpoints carrying exotic objects.
+    import argparse
+    import contextlib
+    import pickle
+    import warnings
+    # Real Megatron checkpoints pickle argparse.Namespace (``args``) and the
+    # numpy RNG state tuple (``rng_state[*]['np_rng_state']``); allowlist
+    # exactly those, scoped to this one load (torch >= 2.5 context manager)
+    # so the process-global weights_only allowlist is not widened for
+    # unrelated torch.load callers.
+    _ma = getattr(np, "_core", getattr(np, "core", None)).multiarray
+    allow = [argparse.Namespace, np.ndarray, np.dtype,
+             np.dtypes.Float64DType, np.dtypes.UInt32DType,
+             _ma._reconstruct]
+    can_allowlist = hasattr(torch.serialization, "add_safe_globals")  # >= 2.4
+    if hasattr(torch.serialization, "safe_globals"):  # >= 2.5, scoped
+        scope = torch.serialization.safe_globals(allow)
+    else:
+        scope = contextlib.nullcontext()
+        if can_allowlist:
+            torch.serialization.add_safe_globals(allow)
+    try:
+        with scope:
+            ckpt = torch.load(path, map_location="cpu", weights_only=True)
+    except pickle.UnpicklingError:
+        # path typos / bad zips propagate as-is above; only the safe
+        # loader's pickle rejection routes here. On torch < 2.4 the
+        # ``args`` Namespace cannot be allowlisted, so an ordinary Megatron
+        # checkpoint lands here too — warn and load rather than break every
+        # default call on old torch.
+        if not trust_pickle and can_allowlist:
+            raise ValueError(
+                f"safe load of {path} failed (exotic pickled objects, or a "
+                "corrupt file — trust_pickle will not fix corruption); pass "
+                "trust_pickle=True only for files you trust")
+        if not trust_pickle:
+            warnings.warn(
+                f"torch {torch.__version__} cannot allowlist argparse."
+                f"Namespace; falling back to full unpickling of {path} — "
+                "upgrade to torch >= 2.4 for the safe loader")
+        ckpt = torch.load(path, map_location="cpu", weights_only=False)
     args = ckpt.get("args")
     if args is not None and not isinstance(args, dict):
         def scalarish(v):

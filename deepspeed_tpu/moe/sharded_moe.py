@@ -25,13 +25,17 @@ def compute_capacity(k: int, tokens_per_group: int, num_experts: int,
 
 
 def load_balance_aux(gates: jnp.ndarray,
-                     used_token: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                     used_token: Optional[jnp.ndarray] = None,
+                     sel_gates: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """GShard load-balance loss from the top-1 assignment (reference
     ``top1gating:183``): E * mean_e(mean-prob_e * assigned-fraction_e).
     ``used_token [G,S]`` excludes padding tokens from the assigned-fraction
-    term (reference ``sharded_moe.py:207`` masks ``mask1`` before ``ce``)."""
+    term (reference ``sharded_moe.py:207`` masks ``mask1`` before ``ce``).
+    ``sel_gates`` supplies the (possibly noised) scores that drove expert
+    selection — the assigned-fraction mask follows the actual assignment
+    while ``me`` stays on clean probabilities (reference RSample path)."""
     g, s, e = gates.shape
-    top1 = jnp.argmax(gates, axis=-1)
+    top1 = jnp.argmax(gates if sel_gates is None else sel_gates, axis=-1)
     me = jnp.mean(gates, axis=1)                            # [G,E] mean prob
     hot = jax.nn.one_hot(top1, e, dtype=jnp.float32)
     if used_token is not None:
@@ -80,13 +84,20 @@ def topk_gating(logits: jnp.ndarray, k: int, capacity: int,
     rng_noise = rng_rts = None
     if rng is not None:
         rng_noise, rng_rts = jax.random.split(rng)
+    # RSample perturbs expert SELECTION only (reference top1gating:156 uses
+    # logits_w_noise = logits + gumbel for the argmax while gates/aux stay on
+    # the clean softmax) — combine weights and the load-balance loss must not
+    # see the noise or training dynamics drift.
+    sel_logits = logits
     if noisy_gate_policy == "RSample" and rng_noise is not None:
-        logits = logits + jax.random.normal(rng_noise, logits.shape) / e
-    gates = jax.nn.softmax(logits, axis=-1)  # [G,S,E]
-    aux_loss = load_balance_aux(gates, used_token)
+        sel_logits = logits + jax.random.gumbel(rng_noise, logits.shape)
+    gates = jax.nn.softmax(logits, axis=-1)  # [G,S,E] clean
+    sel = gates if sel_logits is logits else jax.nn.softmax(sel_logits, axis=-1)
+    aux_loss = load_balance_aux(gates, used_token,
+                                sel_gates=None if sel_logits is logits else sel)
     ut = None if used_token is None else used_token.astype(jnp.float32)
 
-    remaining = gates
+    remaining = sel
     committed = jnp.zeros((g, 1, e), jnp.float32)  # tokens assigned per expert so far
     dispatch = jnp.zeros((g, s, e, capacity), jnp.bool_)
     combine = jnp.zeros((g, s, e, capacity), jnp.float32)
