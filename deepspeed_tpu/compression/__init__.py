@@ -12,7 +12,8 @@ from .compress import (CompressionContext, TechniquePlan, init_compression,
                        reduce_layers, redundancy_clean)
 from .onebit import (ErrorFeedbackState, OnebitState, build_onebit_optimizer,
                      compressed_allreduce, init_error_feedback, onebit_compress,
-                     onebit_train_step_factory)
+                     onebit_train_step_factory, packed_allreduce,
+                     server_error_shape)
 from .scheduler import CompressionScheduler
 
 __all__ = [
@@ -22,5 +23,6 @@ __all__ = [
     "init_compression", "reduce_layers", "redundancy_clean",
     "ErrorFeedbackState", "OnebitState", "build_onebit_optimizer",
     "compressed_allreduce", "init_error_feedback", "onebit_compress",
-    "onebit_train_step_factory", "CompressionScheduler",
+    "onebit_train_step_factory", "packed_allreduce", "server_error_shape",
+    "CompressionScheduler",
 ]

@@ -41,7 +41,6 @@ def load_megatron_checkpoint(path: str, trust_pickle: bool = False):
     import argparse
     import contextlib
     import pickle
-    import warnings
     # Real Megatron checkpoints pickle argparse.Namespace (``args``) and the
     # numpy RNG state tuple (``rng_state[*]['np_rng_state']``); allowlist
     # exactly those, scoped to this one load (torch >= 2.5 context manager)
@@ -54,29 +53,39 @@ def load_megatron_checkpoint(path: str, trust_pickle: bool = False):
     can_allowlist = hasattr(torch.serialization, "add_safe_globals")  # >= 2.4
     if hasattr(torch.serialization, "safe_globals"):  # >= 2.5, scoped
         scope = torch.serialization.safe_globals(allow)
+    elif can_allowlist:
+        # torch 2.4.x: no context manager — snapshot and restore so the
+        # process-global allowlist is not widened for unrelated torch.load
+        # callers after this function returns
+        @contextlib.contextmanager
+        def _scoped():
+            before = list(torch.serialization.get_safe_globals())
+            torch.serialization.add_safe_globals(allow)
+            try:
+                yield
+            finally:
+                torch.serialization.clear_safe_globals()
+                torch.serialization.add_safe_globals(before)
+        scope = _scoped()
     else:
         scope = contextlib.nullcontext()
-        if can_allowlist:
-            torch.serialization.add_safe_globals(allow)
     try:
         with scope:
             ckpt = torch.load(path, map_location="cpu", weights_only=True)
     except pickle.UnpicklingError:
         # path typos / bad zips propagate as-is above; only the safe
-        # loader's pickle rejection routes here. On torch < 2.4 the
-        # ``args`` Namespace cannot be allowlisted, so an ordinary Megatron
-        # checkpoint lands here too — warn and load rather than break every
-        # default call on old torch.
-        if not trust_pickle and can_allowlist:
-            raise ValueError(
-                f"safe load of {path} failed (exotic pickled objects, or a "
-                "corrupt file — trust_pickle will not fix corruption); pass "
-                "trust_pickle=True only for files you trust")
+        # loader's pickle rejection routes here. Full unpickling executes
+        # arbitrary pickled code, so it ALWAYS requires the explicit opt-in
+        # — including on torch < 2.4, where the missing allowlist means even
+        # ordinary checkpoints need it (upgrade torch for the safe loader).
         if not trust_pickle:
-            warnings.warn(
-                f"torch {torch.__version__} cannot allowlist argparse."
-                f"Namespace; falling back to full unpickling of {path} — "
-                "upgrade to torch >= 2.4 for the safe loader")
+            hint = ("exotic pickled objects, or a corrupt file — "
+                    "trust_pickle will not fix corruption" if can_allowlist
+                    else f"torch {torch.__version__} cannot allowlist "
+                    "argparse.Namespace; upgrade to torch >= 2.4")
+            raise ValueError(
+                f"safe load of {path} failed ({hint}); pass "
+                "trust_pickle=True only for files you trust")
         ckpt = torch.load(path, map_location="cpu", weights_only=False)
     args = ckpt.get("args")
     if args is not None and not isinstance(args, dict):

@@ -149,3 +149,30 @@ def quantized_reduce_scatter(x, axis, block: int = BLOCK):
                           (world * nb_per * block,))
     deq = deq.reshape(world, nb_per * block)[:, :shard]
     return jnp.mean(deq, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# 1-bit sign packing (the transport for compression.onebit)
+# ---------------------------------------------------------------------------
+
+
+def pack_signs(x: jnp.ndarray) -> jnp.ndarray:
+    """Pack the sign bits of a flat fp tensor into uint8, 8 values/byte
+    (reference packs with cupy ``packbits`` in
+    ``runtime/comm/nccl.py:16`` ``compressed_allreduce``). Bit k of byte i
+    is ``x[8*i + k] > 0``; zeros encode as negative (receivers decode bit 0
+    as ``-scale``, and the 1-bit error feedback compensates).
+
+    ``x.size`` must be a multiple of 8.
+    """
+    bits = (x.reshape(-1, 8) > 0).astype(jnp.uint8)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    return jnp.sum(bits << shifts, axis=-1, dtype=jnp.uint8)
+
+
+def unpack_signs(q: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`pack_signs`: uint8 ``[m]`` -> ``{-1,+1}`` fp32
+    ``[8*m]`` (cupy ``unpackbits`` analogue)."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (q[:, None] >> shifts) & jnp.uint8(1)
+    return (bits.astype(jnp.float32) * 2.0 - 1.0).reshape(-1)
