@@ -84,8 +84,8 @@ def packed_allreduce(x: jnp.ndarray, worker_error: jnp.ndarray,
     world = jax.lax.axis_size(axis)
     shape = x.shape
     n = int(np.prod(shape))
-    pad = -n % (8 * world)
-    chunk = (n + pad) // world
+    chunk = server_error_shape(shape, world)[0]  # single source of layout math
+    pad = chunk * world - n
 
     # worker compression (error feedback vs what receivers will DECODE:
     # zeros transmit as -scale, so compensate against the decoded value)

@@ -1,17 +1,21 @@
-"""Elasticity: elastic batch-size math + restart supervision.
+"""Elasticity: elastic batch-size math + membership-change rescale agent.
 
 Reference: ``deepspeed/elasticity/`` — config (``config.py``), batch/chip
 compatibility solver (``elasticity.py:233``), torchelastic agent
-(``elastic_agent.py:32``; here, launcher-level supervision in
-``launcher/launch.py:_supervise``).
+(``elastic_agent.py:32``). The rescale loop (detect membership change →
+retopologize via ``compute_elastic_config`` → resume from the reshardable
+checkpoint) is :class:`ElasticAgent`; crash-only restart supervision also
+lives in ``launcher/launch.py:_supervise``.
 """
 
+from .elastic_agent import ElasticAgent, RescaleDecision, decide_world
 from .elasticity import (ElasticityConfig, ElasticityConfigError, ElasticityError,
                          ElasticityIncompatibleWorldSize, compute_elastic_config,
                          get_compatible_chips, valid_chip_counts)
 
 __all__ = [
-    "ElasticityConfig", "ElasticityConfigError", "ElasticityError",
-    "ElasticityIncompatibleWorldSize", "compute_elastic_config",
-    "get_compatible_chips", "valid_chip_counts",
+    "ElasticAgent", "ElasticityConfig", "ElasticityConfigError",
+    "ElasticityError", "ElasticityIncompatibleWorldSize", "RescaleDecision",
+    "compute_elastic_config", "decide_world", "get_compatible_chips",
+    "valid_chip_counts",
 ]

@@ -1,0 +1,127 @@
+"""Membership-change elastic agent: detect → retopologize → resume.
+
+Analogue of the reference ``DSElasticAgent._invoke_run``
+(``elasticity/elastic_agent.py:127``), which monitors the worker group and,
+on a failure or membership change, restarts it against the rendezvous's
+CURRENT world. The TPU-native decomposition:
+
+- **detect**: the agent supervises the worker group; a non-zero exit or a
+  membership probe reporting fewer/more healthy hosts triggers a rescale
+  round (the reference gets this from the torch-elastic rendezvous; here the
+  probe is pluggable — hostfile reachability, k8s endpoints, a scheduler
+  API).
+- **retopologize**: ``compute_elastic_config`` re-derives the one batch
+  schedule that stays valid across chip counts, and the agent clamps the
+  new world to the schedule's valid set (largest valid <= available), so
+  the relaunched job needs no hyperparameter retuning.
+- **resume**: checkpoints are reshardable by construction (orbax logical
+  global arrays — ``checkpoint/engine.py``), so the relaunched workers
+  ``load_checkpoint`` under the new topology and the loss curve continues.
+  This replaces the reference's 3D-reshape machinery as the recovery path.
+
+The worker side needs no agent-specific code beyond resuming from the last
+checkpoint at startup; world size and the rescaled batch arrive through the
+ordinary ``DSTPU_*`` bootstrap env plus ``elasticity.enabled`` config (see
+``runtime/config.py`` ``finalize``).
+"""
+
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from ..utils.logging import logger
+from .elasticity import (ElasticityIncompatibleWorldSize, compute_elastic_config)
+
+
+@dataclass
+class RescaleDecision:
+    """One relaunch round: the world to run at and its batch schedule."""
+    world_size: int
+    final_batch: int
+    micro_batch: int
+
+    @property
+    def gradient_accumulation(self) -> int:
+        return self.final_batch // (self.micro_batch * self.world_size)
+
+
+def decide_world(ds_config, available: int) -> RescaleDecision:
+    """Clamp ``available`` ranks to the elastic schedule's valid set:
+    the largest valid world <= available (the reference declines invalid
+    worlds with ``ElasticityIncompatibleWorldSize``; an agent must instead
+    pick a world it CAN run so the job survives the membership change)."""
+    final_batch, valid, _ = compute_elastic_config(ds_config, world_size=0)
+    fits = [w for w in valid if w <= available]
+    if not fits:
+        raise ElasticityIncompatibleWorldSize(
+            f"no valid elastic world <= {available} (valid set "
+            f"{valid[:16]}{'...' if len(valid) > 16 else ''})")
+    world = max(fits)
+    _, _, micro = compute_elastic_config(ds_config, world_size=world)
+    return RescaleDecision(world_size=world, final_batch=final_batch,
+                           micro_batch=micro)
+
+
+class ElasticAgent:
+    """Supervision loop composing detect → retopologize → resume.
+
+    ``membership_fn() -> int``: currently-available rank count.
+    ``spawn_fn(decision, restart) -> int``: launch the worker group at
+    ``decision.world_size`` (blocking) and return its exit code; workers are
+    expected to resume from the latest checkpoint themselves.
+
+    Mirrors ``DSElasticAgent._invoke_run``: run the group; exit 0 ends the
+    job; a failure re-probes membership, re-decides the world, and relaunches
+    with backoff until ``max_restarts`` consecutive quick failures.
+    """
+
+    def __init__(self, ds_config, membership_fn: Callable[[], int],
+                 spawn_fn: Callable[[RescaleDecision, int], int],
+                 max_restarts: int = 100, backoff_s: float = 3.0,
+                 min_uptime_s: float = 10.0):
+        self.ds_config = ds_config
+        self.membership_fn = membership_fn
+        self.spawn_fn = spawn_fn
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.min_uptime_s = min_uptime_s
+        self.history: List[RescaleDecision] = []  # one entry per launch round
+
+    def run(self) -> int:
+        restarts = 0
+        while True:
+            available = int(self.membership_fn())
+            try:
+                decision = decide_world(self.ds_config, available)
+            except ElasticityIncompatibleWorldSize as e:
+                # transient capacity dip (node rebooting, probe glitch) must
+                # consume the restart budget and re-probe, not kill the agent
+                restarts += 1
+                if restarts > self.max_restarts:
+                    logger.error(f"elastic agent: {e}; restart budget exhausted")
+                    raise
+                logger.warning(f"elastic agent: {e}; re-probing membership "
+                               f"({restarts}/{self.max_restarts}) "
+                               f"in {self.backoff_s}s")
+                time.sleep(self.backoff_s)
+                continue
+            if self.history and decision != self.history[-1]:
+                logger.warning(
+                    f"elastic rescale: world {self.history[-1].world_size} -> "
+                    f"{decision.world_size} (batch {decision.final_batch}, "
+                    f"micro {decision.micro_batch})")
+            self.history.append(decision)
+            start = time.time()
+            rc = int(self.spawn_fn(decision, len(self.history) - 1))
+            if rc == 0:
+                return 0
+            if time.time() - start > self.min_uptime_s:
+                restarts = 0  # healthy uptime resets the budget
+            restarts += 1
+            if restarts > self.max_restarts:
+                logger.error(f"elastic agent: rc={rc}, restart budget exhausted")
+                return rc
+            logger.warning(f"elastic agent: worker group rc={rc}; "
+                           f"restart {restarts}/{self.max_restarts} "
+                           f"in {self.backoff_s}s")
+            time.sleep(self.backoff_s)

@@ -112,6 +112,10 @@ def compute_elastic_config(ds_config: Dict, world_size: int = 0
     """
     if isinstance(ds_config, ElasticityConfig):
         cfg = ds_config
+    elif hasattr(ds_config, "to_dict"):
+        # bridge foreign config models (runtime.config.ElasticityConfig keeps
+        # the reference's GPU-flavored key names; from_dict renames them)
+        cfg = ElasticityConfig.from_dict(ds_config.to_dict())
     else:
         block = ds_config.get("elasticity")
         if block is None:

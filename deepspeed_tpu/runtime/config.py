@@ -457,7 +457,33 @@ class DeepSpeedTPUConfig(ConfigModel):
         self.gradient_accumulation_steps = gas
 
     def finalize(self, world_dp_size: int) -> "DeepSpeedTPUConfig":
-        """Re-resolve batch sizes once the dp world size is known."""
+        """Re-resolve batch sizes once the dp world size is known.
+
+        With ``elasticity.enabled`` the elastic schedule OWNS the batch
+        triangle (reference ``config.py`` elasticity integration over
+        ``elasticity/elasticity.py:233``): the final batch and micro-batch
+        come from ``compute_elastic_config`` at the CURRENT world size, so a
+        rescaled relaunch picks consistent sizes with no retuning. User
+        batch keys then conflict unless ``ignore_non_elastic_batch_info``
+        says to drop them (reference ``elasticity/constants.py``)."""
+        if self.elasticity.enabled:
+            from ..elasticity import compute_elastic_config
+
+            # conflict-check against the ORIGINAL user keys, not a previous
+            # finalize's elastic resolution — finalize must stay idempotent
+            # and re-resolvable at a NEW world size (the rescale flow)
+            if not hasattr(self, "_pre_elastic_batch"):
+                self._pre_elastic_batch = self._user_batch
+            user_keys = [v for v in self._pre_elastic_batch
+                         if isinstance(v, int)]
+            if user_keys and not self.elasticity.ignore_non_elastic_batch_info:
+                raise ConfigError(
+                    "elasticity is enabled but the config also pins "
+                    "train_batch_size / micro_batch / gradient_accumulation; "
+                    "remove them or set elasticity.ignore_non_elastic_batch_info")
+            final_batch, _, micro = compute_elastic_config(
+                self.elasticity, world_size=world_dp_size)
+            self._user_batch = (final_batch, micro, None)
         self._resolve_batch_sizes(world_dp_size)
         if self.fp16.enabled and self.bf16.enabled:
             raise ConfigError("fp16 and bf16 cannot both be enabled")

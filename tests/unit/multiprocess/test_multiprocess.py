@@ -33,3 +33,40 @@ def test_checkpoint_save2_load1(tmp_path):
 
 def test_host_adam_multiprocess_fallback():
     run_distributed(f"{W}:host_adam_fallback", world_size=2)
+
+
+def test_elastic_rescale_end_to_end(tmp_path):
+    """detect -> retopologize -> resume (reference DSElasticAgent._invoke_run,
+    elasticity/elastic_agent.py:127): the agent launches at the largest valid
+    world for 4 available chips, one rank dies mid-job, the re-probe reports
+    2 chips, and the relaunched group resumes from the reshardable checkpoint
+    with the loss curve continuing — all with REAL processes."""
+    from deepspeed_tpu.elasticity import ElasticAgent
+
+    env = {"DSTPU_TEST_DIR": str(tmp_path)}
+    ds_config = {"elasticity": {"enabled": True, "max_train_batch_size": 48,
+                                "micro_batch_sizes": [1, 2],
+                                "min_gpus": 1, "max_gpus": 16}}
+    membership = [4, 2]  # chips available per probe: node lost after round 0
+
+    def membership_fn():
+        return membership.pop(0)
+
+    def spawn_fn(decision, restart):
+        target = "elastic_round0" if restart == 0 else "elastic_round1"
+        # 2 virtual chips per process: world_size chips = world_size/2 procs
+        try:
+            run_distributed(f"{W}:{target}", world_size=decision.world_size // 2,
+                            env_extra=env)
+            return 0 if restart > 0 else 1  # round 0 "fails" (rank death)
+        except AssertionError:
+            return 1
+
+    agent = ElasticAgent(ds_config, membership_fn, spawn_fn,
+                         max_restarts=3, backoff_s=0.1)
+    rc = agent.run()
+    assert rc == 0
+    worlds = [d.world_size for d in agent.history]
+    assert worlds == [4, 2], worlds
+    assert [d.micro_batch for d in agent.history] == [2, 2]
+    assert agent.history[0].final_batch == agent.history[1].final_batch == 48

@@ -293,3 +293,94 @@ def test_ds_nvme_tune_cli(tmp_path):
 
     aio = _json.loads(out_json.read_text())["aio"]
     assert aio["thread_count"] == 2 and aio["block_size"] == 512 << 10
+
+
+# ---------------------------------------------------------------------------
+# rescale agent (reference elasticity/elastic_agent.py:127)
+# ---------------------------------------------------------------------------
+
+_AGENT_CFG = {"elasticity": {"enabled": True, "max_train_batch_size": 48,
+                             "micro_batch_sizes": [1, 2],
+                             "min_gpus": 1, "max_gpus": 16}}
+
+
+def test_decide_world_clamps_to_valid_set():
+    from deepspeed_tpu.elasticity import decide_world
+
+    d = decide_world(_AGENT_CFG, available=4)
+    assert (d.world_size, d.final_batch, d.micro_batch) == (4, 48, 2)
+    assert d.gradient_accumulation == 6
+    # 5 chips is not in 48's valid set -> clamp down to 4, not error
+    d5 = decide_world(_AGENT_CFG, available=5)
+    assert d5.world_size == 4
+    d2 = decide_world(_AGENT_CFG, available=2)
+    assert (d2.world_size, d2.gradient_accumulation) == (2, 12)
+
+
+def test_decide_world_no_fit_raises():
+    from deepspeed_tpu.elasticity import (ElasticityIncompatibleWorldSize,
+                                          decide_world)
+
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 48,
+                          "micro_batch_sizes": [1, 2],
+                          "min_gpus": 4, "max_gpus": 16}}
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        decide_world(cfg, available=2)  # below min_chips
+
+
+def test_elastic_agent_rescale_loop():
+    """detect -> retopologize: a failure triggers a membership re-probe and a
+    relaunch at the new largest-valid world; success ends the loop."""
+    from deepspeed_tpu.elasticity import ElasticAgent
+
+    membership = iter([8, 6, 2])
+    calls = []
+
+    def spawn(decision, restart):
+        calls.append((restart, decision.world_size, decision.micro_batch))
+        return 1 if restart < 2 else 0  # two failures, then healthy
+
+    agent = ElasticAgent(_AGENT_CFG, lambda: next(membership), spawn,
+                         max_restarts=5, backoff_s=0.0)
+    assert agent.run() == 0
+    # 8 valid as-is; 6 valid as-is; 2 valid -> three rounds, rescaling down
+    assert calls == [(0, 8, 2), (1, 6, 2), (2, 2, 2)], calls
+
+
+def test_elastic_agent_budget_exhausted():
+    from deepspeed_tpu.elasticity import ElasticAgent
+
+    agent = ElasticAgent(_AGENT_CFG, lambda: 4, lambda d, r: 7,
+                         max_restarts=2, backoff_s=0.0)
+    assert agent.run() == 7
+    assert len(agent.history) == 3  # initial + 2 restarts
+
+
+def test_config_finalize_elastic_owns_batch():
+    """elasticity.enabled resolves the batch triangle from the schedule at
+    the live world size; pinned user batch keys conflict."""
+    from deepspeed_tpu.runtime.config import load_config
+    from deepspeed_tpu.runtime.config_utils import ConfigError
+
+    def mk():
+        return load_config({**_AGENT_CFG,
+                            "optimizer": {"type": "adam", "params": {"lr": 1e-3}}})
+
+    c4 = mk()
+    c4.finalize(4)
+    assert (c4.train_batch_size, c4.train_micro_batch_size_per_gpu,
+            c4.gradient_accumulation_steps) == (48, 2, 6)
+    c2 = mk()
+    c2.finalize(2)
+    assert (c2.train_batch_size, c2.train_micro_batch_size_per_gpu,
+            c2.gradient_accumulation_steps) == (48, 2, 12)
+    with pytest.raises(ConfigError):
+        bad = load_config({**_AGENT_CFG, "train_batch_size": 8})
+        bad.finalize(2)
+    # ignore_non_elastic_batch_info drops the pinned keys instead
+    cfg = {"elasticity": dict(_AGENT_CFG["elasticity"],
+                              ignore_non_elastic_batch_info=True),
+           "train_batch_size": 8}
+    ok = load_config(cfg)
+    ok.finalize(2)
+    assert ok.train_batch_size == 48
