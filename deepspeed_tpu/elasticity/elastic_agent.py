@@ -57,7 +57,13 @@ def decide_world(ds_config, available: int) -> RescaleDecision:
             f"no valid elastic world <= {available} (valid set "
             f"{valid[:16]}{'...' if len(valid) > 16 else ''})")
     world = max(fits)
-    _, _, micro = compute_elastic_config(ds_config, world_size=world)
+    # micro = largest configured micro-batch dividing the per-chip batch
+    # (compute_elastic_config's rule; world is in `valid` so one exists —
+    # deriving it here avoids re-solving the whole schedule)
+    per_chip = final_batch // world
+    micros = (ds_config.micro_batch_sizes if hasattr(ds_config, "micro_batch_sizes")
+              else ds_config["elasticity"]["micro_batch_sizes"])
+    micro = max(m for m in micros if per_chip % m == 0)
     return RescaleDecision(world_size=world, final_batch=final_batch,
                            micro_batch=micro)
 

@@ -61,16 +61,13 @@ class InferenceEngineV2:
         self.config = config or RaggedInferenceEngineConfig()
         c = self.config
         self.cfg: TransformerConfig = model.cfg
-        if (self.cfg.position == "alibi" or self.cfg.pos_offset
-                or self.cfg.activation == "relu" or self.cfg.embed_norm
-                or self.cfg.attn_scale is not None
-                or self.cfg.layer_windows is not None):
-            raise NotImplementedError(
-                "inference v2's ragged forward covers the rope/learned (no "
-                "offset) families incl. parallel residual (falcon/gptj/phi/"
-                "neox) and MoE; use the v1 engine for ALiBi/embed-norm "
-                "(bloom/mpt), OPT-style (pos offset / relu), "
-                "unscaled-attention or windowed (gpt_neo) models")
+        # families whose attention needs logit bias/masking beyond plain
+        # causal (ALiBi bloom/mpt, unscaled gpt-neo, windowed gpt-neo local
+        # layers): served on the gathered-page einsum path — the Pallas paged
+        # kernel computes plain scaled causal attention only
+        self._special_attn = (self.cfg.position == "alibi"
+                              or self.cfg.attn_scale is not None
+                              or self.cfg.layer_windows is not None)
         dtype = jnp.dtype(c.dtype)
         self.params = jax.tree.map(
             lambda x: jnp.asarray(x, dtype) if jnp.issubdtype(
@@ -90,9 +87,15 @@ class InferenceEngineV2:
                                           max_chunk=c.max_chunk_size,
                                           max_blocks_per_seq=c.max_blocks_per_seq)
         self._key = jax.random.PRNGKey(c.seed)
+        if c.attn_backend == "pallas" and self._special_attn:
+            raise ValueError(
+                "attn_backend='pallas' computes plain scaled causal "
+                "attention; ALiBi / attn_scale / layer_windows families "
+                "run on the einsum path — use attn_backend='auto'")
         if c.attn_backend == "auto":
             self.attn_impl = ("pallas" if jax.default_backend() == "tpu"
-                              and kv_dtype == dtype else "einsum")
+                              and kv_dtype == dtype
+                              and not self._special_attn else "einsum")
             # fused decode: the paged kernel's pool operand gets re-laid-out
             # (copied) on every pallas_call inside the scan, so step time
             # grows with POOL size; the gather-einsum path reads only the

@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...models.transformer import (TransformerConfig, apply_rope,
+from ...models.transformer import (TransformerConfig, alibi_slopes,
+                                   apply_activation, apply_rope,
                                    merge_partial_attention as merge_attention,
                                    rope_table)
 from ...ops.pallas.paged_attention import NEG_INF
@@ -42,13 +43,14 @@ def _layer_norm(x, scale, bias, eps):
     xf = x.astype(jnp.float32)
     mu = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
-    return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale + bias
+    out = ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
+    return out if bias is None else out + bias
 
 
 def _norm(cfg, p, x):
     if cfg.norm == "rmsnorm":
         return _rms_norm(x, p["scale"], cfg.norm_eps)
-    return _layer_norm(x, p["scale"], p["bias"], cfg.norm_eps)
+    return _layer_norm(x, p["scale"], p.get("bias"), cfg.norm_eps)  # mpt: no bias
 
 
 def _dense(p, x):
@@ -110,7 +112,7 @@ def _mlp(cfg, mp, y):
     if cfg.activation == "swiglu":
         hid = jax.nn.silu(_dense(mp["gate_proj"], y)) * _dense(mp["up_proj"], y)
     else:
-        hid = jax.nn.gelu(_dense(mp["up_proj"], y))
+        hid = apply_activation(cfg.activation, _dense(mp["up_proj"], y))
     return _dense(mp["down_proj"], hid)
 
 
@@ -133,7 +135,8 @@ def _rope(x, cos, sin, positions, interleaved=False):
 
 
 def paged_attention(qg, k_pool, v_pool, block_table, positions_g, q_valid,
-                    kv_len, return_stats: bool = False):
+                    kv_len, return_stats: bool = False, alibi=None,
+                    alibi_post_scale: bool = False, scale=None, window=None):
     """Grouped paged attention.
 
     qg: [S, Q, Hq, D] grouped queries; k/v_pool: [N, Hk, bs, D] this layer's
@@ -143,6 +146,14 @@ def paged_attention(qg, k_pool, v_pool, block_table, positions_g, q_valid,
     unwritten/trash slots because kv_len bounds writes). With
     ``return_stats`` also returns the softmax ``(m, l)`` per row
     ([S, Q, Hq] fp32) for two-source merges.
+
+    Family knobs (mirroring ``models.transformer.attention_core``): ``alibi``
+    per-head slopes [Hq] subtract ``slope * (q_pos - k_pos)`` from the
+    logits — the gathered slot index IS the key's absolute position, so the
+    distance is exact under paging; ``alibi_post_scale`` adds the raw slope
+    after scaling (mpt) instead of folding the 1/sqrt(d) in (falcon/bloom);
+    ``scale`` overrides 1/sqrt(d) (gpt-neo trains unscaled); ``window``
+    masks keys at distance >= window (gpt-neo local layers).
     """
     s, q, hq, d = qg.shape
     hk = k_pool.shape[1]
@@ -153,13 +164,20 @@ def paged_attention(qg, k_pool, v_pool, block_table, positions_g, q_valid,
     vg = v_pool[block_table].transpose(0, 1, 3, 2, 4).reshape(s, -1, hk, d)
     m = kg.shape[1]
     qq = qg.reshape(s, q, hk, rep, d)
-    scale = 1.0 / np.sqrt(d)
+    scale = (1.0 / np.sqrt(d)) if scale is None else float(scale)
     logits = jnp.einsum("sqhrd,skhd->shrqk", qq, kg.astype(qg.dtype),
                         preferred_element_type=jnp.float32) * scale
     slot = jnp.arange(m)[None, None, None, None, :]
     pos_q = positions_g[:, None, None, :, None]
+    if alibi is not None:
+        sl_factor = 1.0 if alibi_post_scale else scale
+        sl = (sl_factor * jnp.asarray(alibi, jnp.float32)).reshape(hk, rep)
+        dist = (pos_q - slot).astype(jnp.float32)          # [s,1,1,q,m]
+        logits = logits - sl[None, :, :, None, None] * dist
     valid = (slot <= pos_q) & q_valid[:, None, None, :, None]
     valid = valid & (slot < kv_len[:, None, None, None, None])
+    if window is not None:
+        valid = valid & (pos_q - slot < window)
     logits = jnp.where(valid, logits, NEG_INF)
     m_row = jnp.max(logits, axis=-1)                       # [s,hk,rep,q]
     p = jnp.where(valid, jnp.exp(logits - m_row[..., None]), 0.0)
@@ -192,10 +210,16 @@ def _ragged_forward_impl(params, cfg: TransformerConfig, kv_k, kv_v, tokens,
     dtype = cfg.dtype
 
     x = params["embed"]["embedding"].astype(dtype)[tokens]          # [T, H]
+    if cfg.embed_norm:  # bloom word_embeddings_layernorm
+        x = _norm(cfg, params["embed_norm"], x)
     if cfg.position == "learned":
-        x = x + params["pos_embed"][positions].astype(dtype)
+        # OPT embeds positions shifted by pos_offset (2)
+        x = x + params["pos_embed"][positions + cfg.pos_offset].astype(dtype)
     if cfg.position == "rope":
         cos, sin = rope_table(cfg.max_seq_len, cfg.rotary_dim, cfg.rope_theta)
+    alibi = (jnp.asarray(alibi_slopes(cfg.num_heads,
+                                      bf16_round=not cfg.alibi_post_scale))
+             if cfg.position == "alibi" else None)
 
     q_valid = gather_idx < T                                        # [S, Q]
     safe_gather = jnp.minimum(gather_idx, T - 1)
@@ -227,8 +251,12 @@ def _ragged_forward_impl(params, cfg: TransformerConfig, kv_k, kv_v, tokens,
             out = paged_attention_pallas(qg, kv_k[i], kv_v[i], block_table,
                                          start_pos, chunk_len, kv_len)
         else:
+            win = cfg.layer_windows[i] if cfg.layer_windows else None
             out = paged_attention(qg, kv_k[i], kv_v[i], block_table, pos_g,
-                                  q_valid, kv_len)                  # [S, Q, Hq, D]
+                                  q_valid, kv_len, alibi=alibi,
+                                  alibi_post_scale=cfg.alibi_post_scale,
+                                  scale=cfg.attn_scale,
+                                  window=win)                       # [S, Q, Hq, D]
         # ungroup back to the flat token buffer ([T+1] with pad row dropped)
         flat = jnp.zeros((T + 1, h, d), out.dtype)
         flat = flat.at[gather_idx.reshape(-1)].set(out.reshape(-1, h, d))
@@ -337,17 +365,24 @@ def decode_loop(params, cfg: TransformerConfig, kv_k, kv_v, tokens0, pos0,
     G = Hq // Hk
     W = n_steps
     dtype = cfg.dtype
-    sm = 1.0 / np.sqrt(D)
+    sm = (1.0 / np.sqrt(D)) if cfg.attn_scale is None else float(cfg.attn_scale)
     if cfg.position == "rope":
         cos, sin = rope_table(cfg.max_seq_len, cfg.rotary_dim, cfg.rope_theta)
+    alibi = (jnp.asarray(alibi_slopes(Hq, bf16_round=not cfg.alibi_post_scale))
+             if cfg.position == "alibi" else None)
+    alibi_sl = (None if alibi is None else
+                ((1.0 if cfg.alibi_post_scale else sm)
+                 * alibi.astype(jnp.float32)).reshape(Hk, G))
     ones = jnp.ones((S,), jnp.int32)
     pool_len = pos0  # tokens cached before this call — static for the scan
     rope_cs = (cos, sin) if cfg.position == "rope" else None
 
     def forward_one(wk, wv, toks, pos, t):
         x = params["embed"]["embedding"].astype(dtype)[toks]        # [S, H]
+        if cfg.embed_norm:  # bloom word_embeddings_layernorm
+            x = _norm(cfg, params["embed_norm"], x)
         if cfg.position == "learned":
-            x = x + params["pos_embed"][pos].astype(dtype)
+            x = x + params["pos_embed"][pos + cfg.pos_offset].astype(dtype)
         widx = jnp.arange(W)
         wmask = widx <= t                                           # [W]
         for i in range(cfg.num_layers):
@@ -360,6 +395,7 @@ def decode_loop(params, cfg: TransformerConfig, kv_k, kv_v, tokens0, pos0,
             wv = jax.lax.dynamic_update_slice(
                 wv, vt.astype(wv.dtype)[None, None], (i, t, 0, 0, 0))
             qg = qt[:, None]                                        # [S, 1, Hq, D]
+            win = cfg.layer_windows[i] if cfg.layer_windows else None
             if attn_impl == "pallas":
                 o1, m1, l1 = paged_attention_pallas(
                     qg, kv_k[i], kv_v[i], block_table, pos, ones, pool_len,
@@ -367,18 +403,27 @@ def decode_loop(params, cfg: TransformerConfig, kv_k, kv_v, tokens0, pos0,
             else:
                 o1, m1, l1 = paged_attention(
                     qg, kv_k[i], kv_v[i], block_table, pos[:, None],
-                    active[:, None], pool_len, return_stats=True)
+                    active[:, None], pool_len, return_stats=True,
+                    alibi=alibi, alibi_post_scale=cfg.alibi_post_scale,
+                    scale=cfg.attn_scale, window=win)
             o1, m1, l1 = o1[:, 0], m1[:, 0], l1[:, 0]               # [S,Hq,*]
 
-            # dense attention over the in-window tokens (incl. this one)
+            # dense attention over the in-window tokens (incl. this one);
+            # in-window token w sits at absolute position pos0 + w, so the
+            # query (at pos0 + t) is at distance t - w from it for every
+            # sequence — family bias/masking reuses that shared distance
             wki = jax.lax.dynamic_index_in_dim(wk, i, 0, keepdims=False)
             wvi = jax.lax.dynamic_index_in_dim(wv, i, 0, keepdims=False)
             qr = qt.reshape(S, Hk, G, D)
             lg2 = jnp.einsum("shgd,wshd->shgw", qr, wki.astype(qt.dtype),
                              preferred_element_type=jnp.float32) * sm
-            lg2 = jnp.where(wmask[None, None, None], lg2, NEG_INF)
+            wdist = (t - widx).astype(jnp.float32)                  # [W]
+            if alibi_sl is not None:
+                lg2 = lg2 - alibi_sl[None, :, :, None] * wdist[None, None, None]
+            wmask_l = wmask if win is None else (wmask & (t - widx < win))
+            lg2 = jnp.where(wmask_l[None, None, None], lg2, NEG_INF)
             m2 = jnp.max(lg2, axis=-1)                              # [S,Hk,G]
-            p2 = jnp.where(wmask[None, None, None],
+            p2 = jnp.where(wmask_l[None, None, None],
                            jnp.exp(lg2 - m2[..., None]), 0.0)
             l2 = jnp.sum(p2, axis=-1)
             acc2 = jnp.einsum("shgw,wshd->shgd", p2.astype(qt.dtype),

@@ -164,6 +164,19 @@ def apply_rope(x, cos, sin, positions=None, interleaved: bool = False):
     return out.astype(x.dtype)
 
 
+def apply_activation(name: str, x):
+    """Non-gated MLP activation by config name — the ONE dispatch shared by
+    the flax MLP and the inference-v2 functional forward, so the two stay in
+    lockstep per HF family (swiglu is gated and handled by the callers)."""
+    if name == "relu":                # opt
+        return jax.nn.relu(x)
+    if name == "quick_gelu":          # clip: x * sigmoid(1.702 x)
+        return x * jax.nn.sigmoid(1.702 * x)
+    if name == "gelu_exact":          # mpt: erf gelu, not tanh
+        return jax.nn.gelu(x, approximate=False)
+    return jax.nn.gelu(x)
+
+
 def alibi_slopes(num_heads: int, bf16_round: bool = True) -> np.ndarray:
     """ALiBi per-head slopes (Press et al.; matches the HF implementation
     used by falcon/bloom — geometric in 2^(-8/n), extended for non-pow2).
@@ -466,14 +479,7 @@ class MLP(nn.Module):
         else:
             hidden = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype,
                               param_dtype=jnp.float32, name="up_proj")(x)
-            if cfg.activation == "relu":
-                hidden = nn.relu(hidden)
-            elif cfg.activation == "quick_gelu":  # clip: x * sigmoid(1.702 x)
-                hidden = hidden * nn.sigmoid(1.702 * hidden)
-            elif cfg.activation == "gelu_exact":  # mpt: erf gelu, not tanh
-                hidden = nn.gelu(hidden, approximate=False)
-            else:
-                hidden = nn.gelu(hidden)
+            hidden = apply_activation(cfg.activation, hidden)
         return nn.Dense(cfg.hidden_size, use_bias=bias, dtype=cfg.dtype,
                         param_dtype=jnp.float32, name="down_proj")(hidden)
 

@@ -409,3 +409,131 @@ def test_fp8_kv_cache():
     agree = sum(int(np.array_equal(full.query(u)[1][:2], fp8.query(u)[1][:2]))
                 for u in (0, 1))
     assert agree >= 1, "fp8 KV diverged from full precision immediately"
+
+
+# ---------------------------------------------------------------------------
+# family breadth: ALiBi / OPT / windowed / embed-norm under ragged serving
+# (VERDICT r4 item 5; reference serves these under FastGen — e.g.
+#  inference/v2/model_implementations/opt/model.py)
+# ---------------------------------------------------------------------------
+
+
+def _family_cfg(family):
+    base = dict(vocab_size=97, hidden_size=48, intermediate_size=96,
+                num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128,
+                dtype=jnp.float32, norm="layernorm")
+    if family == "bloom":      # ALiBi + word_embeddings_layernorm
+        return TransformerConfig(**base, position="alibi", embed_norm=True,
+                                 activation="gelu")
+    if family == "mpt":        # post-scale ALiBi, bias-free LayerNorm
+        return TransformerConfig(**base, position="alibi",
+                                 alibi_post_scale=True, norm_bias=False,
+                                 activation="gelu_exact")
+    if family == "opt":        # learned positions offset 2, ReLU MLP
+        return TransformerConfig(**base, position="learned", pos_offset=2,
+                                 activation="relu")
+    if family == "gpt_neo":    # unscaled attention + alternating local window
+        return TransformerConfig(**base, position="learned", attn_scale=1.0,
+                                 layer_windows=(None, 4), activation="gelu")
+    raise ValueError(family)
+
+
+@pytest.mark.parametrize("family", ["bloom", "mpt", "opt", "gpt_neo"])
+def test_v2_family_breadth_matches_v1(family):
+    """Exact greedy parity v2 (ragged paged, chunked prefill + fused decode)
+    vs v1 (dense) for the families previously rejected by engine_v2."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    cfg = _family_cfg(family)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    prompts = [np.array([5, 6, 7, 8, 9], np.int32),
+               np.array([40, 41, 42], np.int32),
+               np.array([3, 1, 4, 1, 5, 9, 2, 6], np.int32)]
+    max_new = 8
+
+    v1 = InferenceEngine(model, params,
+                         DeepSpeedInferenceConfig.from_dict(
+                             {"dtype": "float32", "max_out_tokens": 64}))
+    smax = max(len(p) for p in prompts)
+    toks = np.zeros((len(prompts), smax), np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    lens = np.array([len(p) for p in prompts], np.int32)
+    ref = v1.generate(toks, prompt_lengths=lens, max_new_tokens=max_new)
+
+    v2 = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        token_budget=8, max_ragged_sequence_count=4, max_chunk_size=4,
+        num_kv_blocks=32, kv_block_size=8, max_blocks_per_seq=8,
+        dtype="float32"))
+    assert v2.attn_impl == "einsum"
+    outs = v2.generate(prompts, max_new_tokens=max_new)
+    for i, o in enumerate(outs):
+        np.testing.assert_array_equal(o, np.asarray(ref)[i],
+                                      err_msg=f"{family} seq {i}")
+
+
+def test_v2_pallas_backend_rejects_special_attention():
+    cfg = _family_cfg("bloom")
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    with pytest.raises(ValueError, match="einsum path"):
+        InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            token_budget=8, num_kv_blocks=16, kv_block_size=8,
+            attn_backend="pallas", dtype="float32"))
+
+
+@pytest.mark.parametrize("family", ["bloom", "opt", "gpt_neo"])
+def test_v2_hf_family_breadth_matches_v1(family):
+    """Same parity but with REAL transformers checkpoints ingested via
+    params_from_hf — pins the HF layout conventions (fused bloom qkv,
+    OPT offset-2 positions, gpt_neo local attention) through the ragged
+    engine, not just our own synthetic configs."""
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.hf import params_from_hf
+
+    torch.manual_seed(17)
+    if family == "bloom":
+        hf = transformers.BloomForCausalLM(transformers.BloomConfig(
+            vocab_size=96, hidden_size=64, n_layer=2, n_head=4,
+            hidden_dropout=0.0, attention_dropout=0.0)).eval()
+    elif family == "opt":
+        hf = transformers.OPTForCausalLM(transformers.OPTConfig(
+            vocab_size=96, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64,
+            do_layer_norm_before=True, dropout=0.0)).eval()
+    else:
+        hf = transformers.GPTNeoForCausalLM(transformers.GPTNeoConfig(
+            vocab_size=96, hidden_size=64, num_layers=2, num_heads=4,
+            attention_types=[[["global", "local"], 1]], window_size=4,
+            max_position_embeddings=64, resid_dropout=0.0,
+            embed_dropout=0.0, attention_dropout=0.0)).eval()
+    cfg, params = params_from_hf(hf)
+    model = TransformerLM(type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32}))
+
+    prompts = [np.array([5, 6, 7, 8, 9], np.int32),
+               np.array([40, 41, 42], np.int32)]
+    v1 = InferenceEngine(model, params,
+                         DeepSpeedInferenceConfig.from_dict(
+                             {"dtype": "float32", "max_out_tokens": 64}))
+    toks = np.zeros((2, 5), np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    lens = np.array([5, 3], np.int32)
+    ref = v1.generate(jnp.asarray(toks), prompt_lengths=jnp.asarray(lens),
+                      max_new_tokens=8)
+
+    v2 = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        token_budget=8, max_ragged_sequence_count=4, max_chunk_size=4,
+        num_kv_blocks=32, kv_block_size=8, max_blocks_per_seq=8,
+        dtype="float32"))
+    outs = v2.generate(prompts, max_new_tokens=8)
+    for i, o in enumerate(outs):
+        np.testing.assert_array_equal(o, np.asarray(ref)[i],
+                                      err_msg=f"{family} seq {i}")
