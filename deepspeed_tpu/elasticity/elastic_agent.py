@@ -30,7 +30,9 @@ from dataclasses import dataclass
 from typing import Callable, List, Optional
 
 from ..utils.logging import logger
-from .elasticity import (ElasticityIncompatibleWorldSize, compute_elastic_config)
+from .elasticity import (ElasticityIncompatibleWorldSize,
+                         compute_elastic_config, micro_for_world,
+                         resolve_elasticity_config)
 
 
 @dataclass
@@ -57,13 +59,10 @@ def decide_world(ds_config, available: int) -> RescaleDecision:
             f"no valid elastic world <= {available} (valid set "
             f"{valid[:16]}{'...' if len(valid) > 16 else ''})")
     world = max(fits)
-    # micro = largest configured micro-batch dividing the per-chip batch
-    # (compute_elastic_config's rule; world is in `valid` so one exists —
-    # deriving it here avoids re-solving the whole schedule)
-    per_chip = final_batch // world
-    micros = (ds_config.micro_batch_sizes if hasattr(ds_config, "micro_batch_sizes")
-              else ds_config["elasticity"]["micro_batch_sizes"])
-    micro = max(m for m in micros if per_chip % m == 0)
+    # world is in `valid`, so a dividing micro-batch exists — deriving it
+    # from the already-solved schedule avoids re-solving it
+    micro = micro_for_world(resolve_elasticity_config(ds_config),
+                            final_batch, world)
     return RescaleDecision(world_size=world, final_batch=final_batch,
                            micro_batch=micro)
 

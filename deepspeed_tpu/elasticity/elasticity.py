@@ -102,6 +102,35 @@ def get_compatible_chips(max_batch: int, micro_batches: List[int], min_chips: in
     return best
 
 
+def resolve_elasticity_config(ds_config) -> ElasticityConfig:
+    """Normalize every accepted config shape to an :class:`ElasticityConfig`:
+    an instance, a foreign config model with ``to_dict`` (the runtime
+    config's section keeps the reference's GPU-flavored key names; from_dict
+    renames them), or a ds_config dict with an ``elasticity`` block."""
+    if isinstance(ds_config, ElasticityConfig):
+        return ds_config
+    if hasattr(ds_config, "to_dict"):
+        return ElasticityConfig.from_dict(ds_config.to_dict())
+    block = ds_config.get("elasticity")
+    if block is None:
+        raise ElasticityConfigError("config has no 'elasticity' section")
+    return (block if isinstance(block, ElasticityConfig)
+            else ElasticityConfig.from_dict(block))
+
+
+def micro_for_world(cfg: ElasticityConfig, final_batch: int,
+                    world_size: int) -> int:
+    """Largest configured micro-batch dividing the per-chip batch — the rule
+    ``compute_elastic_config`` applies for a concrete world size."""
+    per_chip = final_batch // world_size
+    fits = [m for m in cfg.micro_batch_sizes if per_chip % m == 0]
+    if not fits:
+        raise ElasticityIncompatibleWorldSize(
+            f"no micro-batch in {cfg.micro_batch_sizes} divides "
+            f"per-chip batch {per_chip}")
+    return max(fits)
+
+
 def compute_elastic_config(ds_config: Dict, world_size: int = 0
                            ) -> Tuple[int, List[int], Optional[int]]:
     """Resolve (final_batch_size, valid_chip_counts, micro_batch_for_world).
@@ -110,17 +139,7 @@ def compute_elastic_config(ds_config: Dict, world_size: int = 0
     ``world_size=0`` resolves only the schedule; a concrete world size also
     picks the largest micro-batch that divides ``final_batch / world``.
     """
-    if isinstance(ds_config, ElasticityConfig):
-        cfg = ds_config
-    elif hasattr(ds_config, "to_dict"):
-        # bridge foreign config models (runtime.config.ElasticityConfig keeps
-        # the reference's GPU-flavored key names; from_dict renames them)
-        cfg = ElasticityConfig.from_dict(ds_config.to_dict())
-    else:
-        block = ds_config.get("elasticity")
-        if block is None:
-            raise ElasticityConfigError("config has no 'elasticity' section")
-        cfg = block if isinstance(block, ElasticityConfig) else ElasticityConfig.from_dict(block)
+    cfg = resolve_elasticity_config(ds_config)
     if isinstance(cfg, ElasticityConfig) and not cfg.enabled:
         raise ElasticityConfigError("elasticity is not enabled "
                                     "(set elasticity.enabled = true)")
@@ -134,11 +153,5 @@ def compute_elastic_config(ds_config: Dict, world_size: int = 0
             raise ElasticityIncompatibleWorldSize(
                 f"world size {world_size} not in the valid set for elastic batch "
                 f"{final_batch}: {valid[:16]}{'...' if len(valid) > 16 else ''}")
-        per_chip = final_batch // world_size
-        fits = [m for m in cfg.micro_batch_sizes if per_chip % m == 0]
-        micro = max(fits) if fits else None
-        if micro is None:
-            raise ElasticityIncompatibleWorldSize(
-                f"no micro-batch in {cfg.micro_batch_sizes} divides "
-                f"per-chip batch {per_chip}")
+        micro = micro_for_world(cfg, final_batch, world_size)
     return final_batch, valid, micro

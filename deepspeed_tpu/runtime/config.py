@@ -120,7 +120,10 @@ class ZeroConfig(ConfigModel):
     stage3_param_persistence_threshold: int = 100_000
     stage3_gather_16bit_weights_on_model_save: bool = False
     stage3_module_granularity_threshold: int = 0
-    # ZeRO++ (hpZ secondary shard / quantized weights / quantized gradients)
+    # ZeRO++ (hpZ secondary shard / quantized weights / quantized gradients).
+    # hpZ's no-second-gather guarantee is realized as a remat policy in the
+    # explicit path: zeropp_train_step_factory(remat="hpz") saves gathered
+    # weights across fwd->bwd (runtime/zero/zeropp.py hpz_remat_policy)
     zero_hpz_partition_size: int = 1
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False

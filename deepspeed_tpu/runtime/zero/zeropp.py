@@ -9,11 +9,16 @@ step gathers them with **int8-quantized allgather** (qwZ), and gradients
 return to shards via **quantized reduce-scatter** (qgZ) — 4x less traffic on
 the gather and the reduction, with error bounded by blockwise scales.
 
-hpZ note: the reference keeps a secondary intra-node fp16 copy so the
-backward gather stays off the inter-node links. Under XLA the analogue is a
-remat policy that saves the gathered weights between fwd and bwd (no second
-gather at all); the hierarchical gather itself is provided for MiCS-style
-meshes (``hierarchical_all_gather``).
+hpZ (``zero_hpz_partition_size``): the reference keeps a secondary
+intra-node fp16 copy so the backward gather stays off the inter-node links.
+Under XLA the analogue is a remat policy that saves the gathered weights
+between fwd and bwd — :func:`hpz_remat_policy`, wired into the factory as
+``remat="hpz"``: the gather runs INSIDE the checkpointed forward, activations
+are rematerialized in backward, but the gathered weights are pinned as
+residuals, so the compiled step contains exactly ONE gather per parameter
+(``remat="nothing"`` trades that for memory and re-gathers in backward;
+``tests/unit/test_zeropp.py`` counts the all-gathers in the compiled HLO).
+The hierarchical gather for MiCS-style meshes is ``hierarchical_all_gather``.
 """
 
 from functools import partial
@@ -38,6 +43,18 @@ def hierarchical_all_gather(x, inner_axis: str, outer_axis: str, tiled: bool = T
     return lax.all_gather(inner, outer_axis, tiled=tiled)
 
 
+HPZ_NAME = "hpz_gathered_weights"
+
+
+def hpz_remat_policy():
+    """Checkpoint policy realizing hpZ (reference ``utils/groups.py:531``
+    secondary-partition groups): under activation rematerialization, save
+    ONLY the gathered full weights (tagged ``HPZ_NAME``) across fwd→bwd, so
+    backward never repeats the inter-chip gather while activations still
+    recompute."""
+    return jax.checkpoint_policies.save_only_these_names(HPZ_NAME)
+
+
 class ZeroPPState(NamedTuple):
     step: jnp.ndarray
     shards: Any        # fp32 master shards: each leaf [dp, padded_n/dp]
@@ -56,7 +73,8 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
                               quantized_weights: bool = True,
                               quantized_gradients: bool = True,
                               compute_dtype=jnp.float32,
-                              quant_block: int = _PAD_QUANTUM):
+                              quant_block: int = _PAD_QUANTUM,
+                              remat: Optional[str] = None):
     """Build (init, step) for ZeRO-3 training with ZeRO++ collectives.
 
     ``init(params) -> ZeroPPState`` (shards placed over ``dp_axis``);
@@ -64,7 +82,23 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
     quantization when ``quantized_weights`` (qwZ), gradient reduction uses
     quantized reduce-scatter when ``quantized_gradients`` (qgZ); exact XLA
     collectives otherwise.
+
+    ``remat``: ``None`` keeps the gather outside autodiff (gathered weights
+    and activations both live to backward); ``"hpz"`` checkpoints the
+    forward with :func:`hpz_remat_policy` — activations recompute, gathered
+    weights are saved, ONE gather per param per step (the hpZ guarantee);
+    ``"nothing"`` saves neither — minimum memory, backward re-gathers. In
+    the remat modes gradients return through the gather's AD transpose
+    (an exact sum reduce-scatter; with qwZ the quantized gather uses a
+    straight-through estimator), so qgZ does not apply there.
     """
+    if remat not in (None, "hpz", "nothing"):
+        raise ValueError(f"remat must be None|'hpz'|'nothing', got {remat!r}")
+    if remat is not None and quantized_gradients:
+        raise ValueError(
+            "remat modes return gradients through the gather's AD transpose "
+            "(an exact reduce-scatter); the qgZ quantized reduction cannot "
+            "run there — pass quantized_gradients=False with remat")
     dp = mesh.shape[dp_axis]
     state_box = {"shapes": None, "treedef": None}
 
@@ -93,14 +127,38 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
             full = lax.all_gather(local_1d, dp_axis)
         return full.reshape(-1)[:n].reshape(shape).astype(compute_dtype)
 
+    def _scatter_sum(grad_full, m):
+        """full cotangent -> this rank's SUM shard [m] fp32 — the exact
+        transpose of the gather (shared by _reduce and the STE backward)."""
+        flat = jnp.ravel(grad_full).astype(jnp.float32)
+        flat = jnp.pad(flat, (0, dp * m - flat.shape[0]))
+        return lax.psum_scatter(flat, dp_axis, tiled=True)
+
     def _reduce(grad_full, m):
         """full grad -> this rank's mean shard [m] fp32 (qgZ)."""
-        flat = jnp.ravel(grad_full).astype(jnp.float32)
-        pad = dp * m - flat.shape[0]
-        flat = jnp.pad(flat, (0, pad))
         if quantized_gradients:
+            flat = jnp.ravel(grad_full).astype(jnp.float32)
+            flat = jnp.pad(flat, (0, dp * m - flat.shape[0]))
             return quantized_reduce_scatter(flat, dp_axis, block=quant_block)
-        return lax.psum_scatter(flat, dp_axis, tiled=True) / dp
+        return _scatter_sum(grad_full, m) / dp
+
+    def _ste_gather(m: int, shape):
+        """qwZ gather differentiable by straight-through estimation: forward
+        is the int8-quantized allgather (_gather), backward the EXACT gather
+        transpose (sum reduce-scatter) — int8 rounding has no useful
+        gradient."""
+        @jax.custom_vjp
+        def g(l):
+            return _gather(l, shape)
+
+        def fwd(l):
+            return _gather(l, shape), None
+
+        def bwd(_, ct):
+            return (_scatter_sum(ct, m),)
+
+        g.defvjp(fwd, bwd)
+        return g
 
     def step(state: ZeroPPState, batch):
         flat_shapes = state_box["shapes"]
@@ -109,19 +167,44 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
             local = jax.tree.map(lambda s: s[0], shards)   # [1, m] -> [m]
             leaves, tdef = jax.tree.flatten(local)
 
-            # gather OUTSIDE autodiff: the gather is data movement, not part
-            # of the loss — differentiating through all_gather would add its
-            # transpose (a second reduce-scatter) on top of the explicit qgZ
-            # reduction below
-            full = [_gather(jax.lax.stop_gradient(l), shp)
-                    for l, shp in zip(leaves, flat_shapes)]
+            if remat is None:
+                # gather OUTSIDE autodiff: the gather is data movement, not
+                # part of the loss — differentiating through all_gather would
+                # add its transpose (a second reduce-scatter) on top of the
+                # explicit qgZ reduction below
+                full = [_gather(jax.lax.stop_gradient(l), shp)
+                        for l, shp in zip(leaves, flat_shapes)]
 
-            def forward(full_leaves):
-                return loss_fn(jax.tree.unflatten(tdef, full_leaves), mb)
+                def forward(full_leaves):
+                    return loss_fn(jax.tree.unflatten(tdef, full_leaves), mb)
 
-            loss, grads_full = jax.value_and_grad(forward)(full)
-            grad_shards = [
-                _reduce(g, l.shape[0]) for g, l in zip(grads_full, leaves)]
+                loss, grads_full = jax.value_and_grad(forward)(full)
+                grad_shards = [
+                    _reduce(g, l.shape[0]) for g, l in zip(grads_full, leaves)]
+            else:
+                from jax.ad_checkpoint import checkpoint_name
+
+                # hpZ: gather INSIDE the checkpointed forward; the policy
+                # decides whether backward re-gathers ("nothing") or reads
+                # the saved full weights ("hpz"). Gradients return through
+                # the gather transpose: per-shard SUMS over dp.
+                def forward(leaves_local):
+                    full = []
+                    for l, shp in zip(leaves_local, flat_shapes):
+                        # _gather's exact branch is lax.all_gather — its AD
+                        # transpose is exactly _scatter_sum; the quantized
+                        # branch needs the explicit STE vjp
+                        f = (_ste_gather(l.shape[0], shp)(l)
+                             if quantized_weights else _gather(l, shp))
+                        full.append(checkpoint_name(f, HPZ_NAME))
+                    return loss_fn(jax.tree.unflatten(tdef, full), mb)
+
+                policy = (hpz_remat_policy() if remat == "hpz"
+                          else jax.checkpoint_policies.nothing_saveable)
+                loss, grads_local = jax.value_and_grad(
+                    jax.checkpoint(forward, policy=policy))(leaves)
+                grad_shards = [g / dp for g in grads_local]  # sum -> mean
+
             grad_tree = jax.tree.unflatten(tdef, [g[None] for g in grad_shards])
             updates, new_opt = tx.update(grad_tree, opt_state, shards)
             new_shards = jax.tree.map(jnp.add, shards, updates)
