@@ -116,6 +116,11 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                      "tp": engine.topo.tp_size},
         "client_state": client_state or {},
     }
+    sampler = getattr(engine, "data_sampler", None)
+    if sampler is not None:
+        # curriculum draw position (data_pipeline.DeepSpeedDataSampler) —
+        # resume must not rewalk the difficulty schedule from step 0
+        meta["data_sampler"] = sampler.state_dict()
 
     def _commit():
         # 'latest' must only ever point at a durable checkpoint: wait for the
@@ -213,6 +218,9 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     meta = json.load(open(meta_path)) if os.path.exists(meta_path) else {}
     engine.global_steps = meta.get("global_steps", int(np.asarray(step)))
     engine.skipped_steps = meta.get("skipped_steps", 0)
+    sampler = getattr(engine, "data_sampler", None)
+    if sampler is not None and meta.get("data_sampler"):
+        sampler.load_state_dict(meta["data_sampler"])
     log_dist(f"loaded checkpoint {path} (saved at topology {meta.get('topology')})")
     return path, meta.get("client_state", {})
 

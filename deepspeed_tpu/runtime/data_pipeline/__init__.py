@@ -8,12 +8,13 @@ Reference: ``deepspeed/runtime/data_pipeline/`` — ``curriculum_scheduler.py``,
 
 from .curriculum_scheduler import CurriculumScheduler
 from .data_analyzer import DataAnalyzer
-from .data_sampler import DeepSpeedDataSampler
+from .data_sampler import DeepSpeedDataSampler, build_curriculum_sampler
 from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
 from .random_ltd import RandomLTDScheduler, random_ltd_apply, random_ltd_select
 
 __all__ = [
     "CurriculumScheduler", "DataAnalyzer", "DeepSpeedDataSampler",
+    "build_curriculum_sampler",
     "MMapIndexedDataset", "MMapIndexedDatasetBuilder",
     "RandomLTDScheduler", "random_ltd_apply", "random_ltd_select",
 ]

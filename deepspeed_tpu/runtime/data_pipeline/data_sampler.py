@@ -5,9 +5,16 @@ each step, draw the global batch from the pool of samples whose analyzed
 difficulty is within the curriculum's current threshold, deterministically
 across hosts (same seed → same indices everywhere; each host then feeds its
 dp shard). Consumed samples recycle when the eligible pool is exhausted.
+
+Difficulty comes from :class:`DataAnalyzer` metric files — one metric
+(classic) or several (reference ``curriculum_metrics`` schema: a sample is
+eligible only while EVERY metric is within its own curriculum threshold).
+:func:`build_curriculum_sampler` wires the ``data_efficiency.data_sampling``
+config block to the analyzer outputs; ``initialize(training_data=...)``
+hands the result to the dataloader (reference ``deepspeed_io`` path).
 """
 
-from typing import Iterator, Optional, Sequence
+from typing import Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -15,35 +22,93 @@ from .curriculum_scheduler import CurriculumScheduler
 
 
 class DeepSpeedDataSampler:
-    def __init__(self, sample_to_metric: np.ndarray, batch_size: int,
+    def __init__(self, sample_to_metric: Optional[np.ndarray] = None,
+                 batch_size: int = 1,
                  curriculum: Optional[CurriculumScheduler] = None,
-                 seed: int = 1234, drop_last: bool = True):
-        self.metric = np.asarray(sample_to_metric)
-        self.order = np.argsort(self.metric, kind="stable")  # easy → hard
-        self.sorted_metric = self.metric[self.order]
+                 seed: int = 1234, drop_last: bool = True,
+                 metrics: Optional[Dict[str, Tuple[np.ndarray,
+                                                   CurriculumScheduler]]] = None,
+                 draws_per_opt_step: int = 1):
+        """Single-metric form: ``(sample_to_metric, batch_size, curriculum)``.
+        Multi-metric form: ``metrics={name: (values, scheduler)}`` — the
+        eligible pool is the intersection of the per-metric thresholds.
+
+        ``draws_per_opt_step``: how many batches the engine pulls per
+        optimizer step (= gradient accumulation steps); curriculum schedules
+        are written in OPTIMIZER steps, so difficulty advances every
+        ``draws_per_opt_step`` draws, keeping the schedule aligned with the
+        engine-side (seqlen) scheduler under gas > 1."""
+        if metrics is None:
+            if sample_to_metric is None:
+                raise ValueError("need sample_to_metric or metrics")
+            metrics = {"metric": (np.asarray(sample_to_metric), curriculum)}
+        elif sample_to_metric is not None:
+            raise ValueError("pass either sample_to_metric or metrics, not both")
+        self.metrics = {k: (np.asarray(v), s) for k, (v, s) in metrics.items()}
+        first = next(iter(self.metrics.values()))[0]
+        self.n_samples = len(first)
+        for name, (arr, _) in self.metrics.items():
+            if len(arr) != self.n_samples:
+                raise ValueError(f"metric {name!r} has {len(arr)} entries, "
+                                 f"expected {self.n_samples}")
+        # easy→hard order by the first metric: the pool top-up rule (training
+        # must always be able to draw one batch) follows it
+        self.metric = first
+        self.order = np.argsort(self.metric, kind="stable")
         self.batch_size = batch_size
-        self.curriculum = curriculum
         self.seed = seed
         self.drop_last = drop_last
+        self.draws_per_opt_step = max(1, int(draws_per_opt_step))
         self.global_step = 0
         self._consumed = 0
         self._perm = None
         self._perm_size = 0
         self._perm_step = 0  # step whose seed generated the live permutation
+        self._pool = None
+        self._pool_key = None  # difficulty tuple the cached pool was built at
 
     def __len__(self):
-        return len(self.metric) // self.batch_size
+        return self.n_samples // self.batch_size
 
-    def _eligible_count(self) -> int:
-        if self.curriculum is None:
-            return len(self.metric)
-        difficulty = self.curriculum.update_difficulty(self.global_step)
-        # all samples with metric <= current difficulty threshold
-        return int(np.searchsorted(self.sorted_metric, difficulty, side="right"))
+    def _eligible_pool(self) -> np.ndarray:
+        """Sample indices within every metric's current threshold, easy→hard
+        by the first metric; topped up with the easiest remaining samples
+        when smaller than one batch. Cached keyed on the difficulty tuple —
+        the O(n_samples) masks rebuild only when a threshold actually moves
+        (and a moved threshold also invalidates the live permutation, since
+        the pool's CONTENT may change even at constant size)."""
+        opt_step = self.global_step // self.draws_per_opt_step
+        key = tuple(None if sched is None else sched.update_difficulty(opt_step)
+                    for _, sched in self.metrics.values())
+        if key == self._pool_key:
+            return self._pool
+        mask = np.ones(self.n_samples, bool)
+        for diff, (arr, _) in zip(key, self.metrics.values()):
+            if diff is not None:
+                mask &= arr <= diff
+        in_pool = mask[self.order]
+        pool = self.order[in_pool]
+        floor = min(self.batch_size, self.n_samples)
+        if len(pool) < floor:
+            extra = self.order[~in_pool][:floor - len(pool)]
+            pool = np.concatenate([pool, extra])
+        if self._pool is not None and not np.array_equal(pool, self._pool):
+            # the pool's CONTENT changed (not merely a threshold value that
+            # admitted nothing new — smooth schedules move nearly every
+            # step): never reuse consumed offsets. Content-keying also makes
+            # resume exact: at save time the live pool always equals the
+            # permutation's pool (a content change would have reset it), so
+            # a load_state_dict-restored permutation pairs with the pool
+            # re-derived at the resumed step.
+            self._perm = None
+        self._pool = pool
+        self._pool_key = key
+        return pool
 
     def next_batch(self) -> np.ndarray:
         """Global batch of sample indices for the current step."""
-        n = max(self._eligible_count(), min(self.batch_size, len(self.metric)))
+        pool = self._eligible_pool()
+        n = len(pool)
         if self._perm is None or self._perm_size != n or \
                 self._consumed + self.batch_size > len(self._perm):
             rng = np.random.default_rng(self.seed + self.global_step)
@@ -54,7 +119,7 @@ class DeepSpeedDataSampler:
         sel = self._perm[self._consumed:self._consumed + self.batch_size]
         self._consumed += self.batch_size
         self.global_step += 1
-        return self.order[sel]
+        return pool[sel]
 
     def __iter__(self) -> Iterator[np.ndarray]:
         while True:
@@ -80,3 +145,53 @@ class DeepSpeedDataSampler:
             self._perm = rng.permutation(self._perm_size)
         else:
             self._perm = None
+
+
+def build_curriculum_sampler(data_sampling_cfg: dict, batch_size: int,
+                             seed: int = 1234, draws_per_opt_step: int = 1
+                             ) -> Optional[DeepSpeedDataSampler]:
+    """Wire the ``data_efficiency.data_sampling`` config block to a sampler
+    over :class:`DataAnalyzer` metric files (reference
+    ``curriculum_learning.curriculum_metrics`` schema,
+    ``data_sampling/data_sampler.py``)::
+
+        {"curriculum_learning": {
+            "enabled": true,
+            "curriculum_metrics": {
+                "vocab_rarity": {
+                    "sample_to_metric_path": "<analyzer output dir>",
+                    "min_difficulty": 10, "max_difficulty": 600,
+                    "schedule_type": "fixed_linear",
+                    "schedule_config": {"total_curriculum_step": 100}}}}}
+
+    ``sample_to_metric_path`` is the DataAnalyzer output dir (the metric
+    name keys the file) or a direct ``.npy`` path. Returns None when no
+    metric is configured — the engine's seqlen truncation hook then stands
+    alone (``runtime/engine.py`` ``train_batch``).
+    """
+    from .data_analyzer import DataAnalyzer
+
+    cl = data_sampling_cfg.get("curriculum_learning", {})
+    if not cl.get("enabled"):
+        return None
+    metrics_cfg = cl.get("curriculum_metrics") or {}
+    if not metrics_cfg:
+        return None
+    metrics = {}
+    for name, mc in metrics_cfg.items():
+        path = mc["sample_to_metric_path"]
+        arr = (np.load(path) if path.endswith(".npy")
+               else DataAnalyzer.load_sample_to_metric(path, name))
+        if np.issubdtype(arr.dtype, np.floating):
+            # CurriculumScheduler difficulties are integers (reference
+            # semantics); a float metric in (0,1) would silently truncate
+            # its thresholds to 0 and disable the curriculum
+            raise ValueError(
+                f"curriculum metric {name!r} is float-valued ({arr.dtype}); "
+                "scale it to integers in the DataAnalyzer metric fn (e.g. "
+                "metric_vocab_rarity multiplies by 100)")
+        sched = CurriculumScheduler({**mc, "curriculum_type": name})
+        metrics[name] = (arr, sched)
+    return DeepSpeedDataSampler(metrics=metrics, batch_size=batch_size,
+                                seed=cl.get("seed", seed),
+                                draws_per_opt_step=draws_per_opt_step)

@@ -43,7 +43,8 @@ class DeepSpeedDataLoader:
 
     def __init__(self, dataset, batch_size: int, shuffle: bool = True, seed: int = 0,
                  collate_fn: Optional[Callable] = None, drop_last: bool = True,
-                 curriculum_fn: Optional[Callable] = None):
+                 curriculum_fn: Optional[Callable] = None,
+                 sampler=None):
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -51,6 +52,10 @@ class DeepSpeedDataLoader:
         self.collate_fn = collate_fn or _default_collate
         self.drop_last = drop_last
         self.curriculum_fn = curriculum_fn
+        # difficulty-driven index selection (data_pipeline
+        # DeepSpeedDataSampler — reference deepspeed_io wires its sampler
+        # into the torch DataLoader the same way); overrides shuffle order
+        self.sampler = sampler
         self.epoch = 0
         self.global_step = 0
         n = len(dataset)
@@ -61,12 +66,16 @@ class DeepSpeedDataLoader:
 
     def __iter__(self) -> Iterator[Any]:
         n = len(self.dataset)
-        order = np.arange(n)
-        if self.shuffle:
-            rng = np.random.default_rng(self.seed + self.epoch)
-            rng.shuffle(order)
+        if self.sampler is None:
+            order = np.arange(n)
+            if self.shuffle:
+                rng = np.random.default_rng(self.seed + self.epoch)
+                rng.shuffle(order)
         for i in range(self.len):
-            idx = order[i * self.batch_size:(i + 1) * self.batch_size]
+            if self.sampler is not None:
+                idx = self.sampler.next_batch()
+            else:
+                idx = order[i * self.batch_size:(i + 1) * self.batch_size]
             batch = self.collate_fn([self.dataset[int(j)] for j in idx])
             if self.curriculum_fn is not None:
                 seqlen = int(self.curriculum_fn(self.epoch, self.global_step))

@@ -36,6 +36,7 @@ from ..ops.optimizers import build_optimizer
 from ..parallel.topology import Topology, TopologySpec, get_topology, set_topology
 from ..utils.logging import log_dist, logger
 from .config import DeepSpeedTPUConfig, load_config
+from .config_utils import ConfigError
 from .loss_scaler import (LossScaleState, has_overflow, make_loss_scale_state,
                           update_loss_scale)
 from .lr_schedules import build_lr_schedule
@@ -279,7 +280,13 @@ class DeepSpeedTPUEngine:
         de = config.data_efficiency
         if de.enabled:
             cl = de.data_sampling.get("curriculum_learning", {})
-            if cl.get("enabled"):
+            # legacy single-schedule form builds the engine-side scheduler
+            # (seqlen truncation in train_batch); the curriculum_metrics
+            # form instead drives sample SELECTION through the dataloader's
+            # DeepSpeedDataSampler (see initialize/build_curriculum_sampler)
+            if cl.get("enabled") and any(
+                    k in cl for k in ("curriculum_type", "schedule_type",
+                                      "schedule_config")):
                 from .data_pipeline import CurriculumScheduler
 
                 self.curriculum_scheduler = CurriculumScheduler(cl)
@@ -1139,8 +1146,27 @@ def initialize(args=None,
 
     dataloader = None
     if training_data is not None:
+        from .data_pipeline.data_sampler import build_curriculum_sampler
         from .dataloader import DeepSpeedDataLoader
 
+        # metric-file-driven curriculum selection (DataAnalyzer outputs →
+        # DeepSpeedDataSampler; reference deepspeed_io + data_sampler.py).
+        # Selection happens at the loader; the engine's seqlen hook still
+        # truncates independently when a seqlen curriculum is configured.
+        sampler = None
+        if cfg.data_efficiency.enabled:
+            sampler = build_curriculum_sampler(
+                cfg.data_efficiency.data_sampling,
+                batch_size=cfg.train_micro_batch_size_per_gpu,
+                seed=cfg.data_efficiency.seed,
+                draws_per_opt_step=engine.gas)
+            if sampler is not None and sampler.n_samples != len(training_data):
+                raise ConfigError(
+                    f"curriculum metric files cover {sampler.n_samples} "
+                    f"samples but training_data has {len(training_data)} — "
+                    "the DataAnalyzer output must come from this corpus")
+        engine.data_sampler = sampler  # checkpointed with the engine state
         dataloader = DeepSpeedDataLoader(training_data,
-                                         batch_size=cfg.train_micro_batch_size_per_gpu)
+                                         batch_size=cfg.train_micro_batch_size_per_gpu,
+                                         sampler=sampler)
     return engine, engine.tx, dataloader, engine.lr_schedule

@@ -335,3 +335,169 @@ def test_engine_memory_breakdown():
     assert "argument_size_gb" in analysis
     stat = memory_status()
     assert "device_in_use_gb" in stat and "host_max_rss_gb" in stat
+
+
+# ---------------------------------------------------------------------------
+# curriculum_metrics: DataAnalyzer metric files -> DeepSpeedDataSampler ->
+# dataloader (VERDICT r4 item 7; reference data_sampling/data_sampler.py)
+# ---------------------------------------------------------------------------
+
+
+def _rarity_corpus(tmp_path):
+    """40 sequences: first 20 use only common tokens (0-9), last 20 only
+    rare tokens (50-59). Analyzed vocab-rarity cleanly separates them."""
+    from deepspeed_tpu.runtime.data_pipeline import DataAnalyzer
+    from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+        metric_vocab_rarity)
+
+    rng = np.random.default_rng(0)
+    common = [rng.integers(0, 10, size=8).astype(np.int32) for _ in range(20)]
+    rare = [rng.integers(50, 60, size=8).astype(np.int32) for _ in range(20)]
+    ds = common + rare
+    vocab_freq = np.ones(64)
+    vocab_freq[:10] = 1000.0   # common tokens are frequent
+    an = DataAnalyzer(ds, metric_names=("vocab_rarity",),
+                      metric_fns={"vocab_rarity": metric_vocab_rarity(vocab_freq)},
+                      output_dir=str(tmp_path))
+    an.run()
+    s2m = DataAnalyzer.load_sample_to_metric(str(tmp_path), "vocab_rarity")
+    assert s2m[:20].max() < s2m[20:].min()  # the metric separates the pools
+    return ds, s2m
+
+
+def test_vocab_rarity_curriculum_end_to_end(tmp_path):
+    """Train through initialize(training_data=...) with a vocab-rarity
+    curriculum_metrics config: early steps draw ONLY common-token samples;
+    after the curriculum opens up, rare-token samples appear."""
+    import deepspeed_tpu as ds_tpu
+
+    dataset, s2m = _rarity_corpus(tmp_path)
+    hard_floor = float(s2m[20:].min())
+
+    def loss_fn(params, batch):
+        x = batch.astype(jnp.float32)
+        return jnp.mean((jnp.mean(x, axis=-1, keepdims=True) * params["w"]) ** 2)
+
+    params = {"w": jnp.ones((1,), jnp.float32)}
+    ndev = len(jax.devices())
+    cfg = {"train_micro_batch_size_per_gpu": ndev,  # loader batch == tbs
+           "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "sgd", "params": {"lr": 0.01}},
+           "data_efficiency": {
+               "enabled": True,
+               "data_sampling": {"curriculum_learning": {
+                   "enabled": True,
+                   "curriculum_metrics": {
+                       "vocab_rarity": {
+                           "sample_to_metric_path": str(tmp_path),
+                           "min_difficulty": int(s2m[:20].max()),
+                           "max_difficulty": int(s2m.max()),
+                           "schedule_type": "fixed_discrete",
+                           "schedule_config": {
+                               "difficulty": [int(s2m[:20].max()),
+                                              int(s2m.max())],
+                               "max_step": [6]}}}}}}}
+    engine, _, loader, _ = ds_tpu.initialize(
+        model=loss_fn, model_parameters=params, config=cfg,
+        training_data=dataset)
+    assert loader.sampler is not None
+    assert engine.curriculum_scheduler is None  # metrics form: no seqlen hook
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+    it = iter(RepeatingLoader(loader))
+    for step in range(10):
+        engine.train_batch(data_iter=it)
+
+    # the jitted loss can't record values; verify the SELECTION by replaying
+    # the sampler deterministically (same seed => same draws as the run)
+    from deepspeed_tpu.runtime.data_pipeline import build_curriculum_sampler
+    replay = build_curriculum_sampler(
+        cfg["data_efficiency"]["data_sampling"], batch_size=ndev, seed=1234)
+    early = np.concatenate([replay.next_batch() for _ in range(6)])
+    late = np.concatenate([replay.next_batch() for _ in range(4)])
+    assert early.max() < 20, early      # only common-token samples early
+    assert (late >= 20).any(), late     # rare samples once opened up
+
+
+def test_multi_metric_sampler_intersects(tmp_path):
+    """A sample is eligible only while EVERY metric is within threshold."""
+    from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler,
+                                                     DeepSpeedDataSampler)
+
+    m1 = np.array([1, 1, 5, 5])
+    m2 = np.array([1, 5, 1, 5])
+    sched = lambda th: CurriculumScheduler(
+        {"curriculum_type": "m", "min_difficulty": th, "max_difficulty": th,
+         "schedule_type": "fixed_discrete",
+         "schedule_config": {"difficulty": [th], "max_step": []}})
+    s = DeepSpeedDataSampler(metrics={"m1": (m1, sched(1)),
+                                      "m2": (m2, sched(1))}, batch_size=1)
+    draws = np.concatenate([s.next_batch() for _ in range(6)])
+    assert set(draws.tolist()) == {0}, draws  # only sample 0 passes both
+
+
+def test_sampler_gas_aligned_and_checkpointed(tmp_path):
+    """draws_per_opt_step keeps the schedule in OPTIMIZER steps under
+    gradient accumulation, and the sampler position rides the engine
+    checkpoint (no curriculum rewalk on resume)."""
+    from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler,
+                                                     DeepSpeedDataSampler)
+
+    metric = np.arange(20)
+    mk_sched = lambda: CurriculumScheduler(
+        {"curriculum_type": "m", "min_difficulty": 4, "max_difficulty": 19,
+         "schedule_type": "fixed_discrete",
+         "schedule_config": {"difficulty": [4, 19], "max_step": [3]}})
+    # gas=2: difficulty opens after 3 OPT steps = 6 draws (not 3)
+    s = DeepSpeedDataSampler(metric, batch_size=2, curriculum=mk_sched(),
+                             draws_per_opt_step=2)
+    draws = [s.next_batch() for _ in range(10)]
+    early = np.concatenate(draws[:6])
+    assert early.max() <= 4, early          # still closed through draw 6
+    assert np.concatenate(draws[6:]).max() > 4
+
+    # checkpoint round-trip through the engine metadata path
+    import deepspeed_tpu as ds_tpu
+    from deepspeed_tpu.checkpoint.engine import (load_checkpoint,
+                                                 save_checkpoint)
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch.astype(jnp.float32) * params["w"]) ** 2)
+
+    ndev = len(jax.devices())
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "optimizer": {"type": "sgd", "params": {"lr": 0.01}}}
+    eng, *_ = ds_tpu.initialize(model=loss_fn,
+                                model_parameters={"w": jnp.ones((1,), jnp.float32)},
+                                config=cfg)
+    eng.data_sampler = DeepSpeedDataSampler(metric, batch_size=2,
+                                            curriculum=mk_sched())
+    for _ in range(5):
+        eng.data_sampler.next_batch()
+    eng.train_batch(batch=jnp.ones((ndev, 4)))
+    save_checkpoint(eng, str(tmp_path / "ck"), tag="s")
+
+    eng2, *_ = ds_tpu.initialize(model=loss_fn,
+                                 model_parameters={"w": jnp.ones((1,), jnp.float32)},
+                                 config=cfg)
+    eng2.data_sampler = DeepSpeedDataSampler(metric, batch_size=2,
+                                             curriculum=mk_sched())
+    load_checkpoint(eng2, str(tmp_path / "ck"), tag="s")
+    assert eng2.data_sampler.global_step == 5
+    # post-resume draws continue the uninterrupted sequence exactly
+    cont = [eng.data_sampler.next_batch() for _ in range(3)]
+    resumed = [eng2.data_sampler.next_batch() for _ in range(3)]
+    for a, b in zip(cont, resumed):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_build_sampler_rejects_float_metric(tmp_path):
+    from deepspeed_tpu.runtime.data_pipeline import build_curriculum_sampler
+
+    np.save(tmp_path / "f.npy", np.linspace(0, 1, 10))
+    cfg = {"curriculum_learning": {"enabled": True, "curriculum_metrics": {
+        "f": {"sample_to_metric_path": str(tmp_path / "f.npy"),
+              "min_difficulty": 0, "max_difficulty": 1,
+              "schedule_type": "fixed_discrete",
+              "schedule_config": {"difficulty": [1], "max_step": []}}}}}
+    with pytest.raises(ValueError, match="float-valued"):
+        build_curriculum_sampler(cfg, batch_size=2)
