@@ -219,8 +219,14 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     engine.global_steps = meta.get("global_steps", int(np.asarray(step)))
     engine.skipped_steps = meta.get("skipped_steps", 0)
     sampler = getattr(engine, "data_sampler", None)
-    if sampler is not None and meta.get("data_sampler"):
-        sampler.load_state_dict(meta["data_sampler"])
+    if sampler is not None:
+        if meta.get("data_sampler"):
+            sampler.load_state_dict(meta["data_sampler"])
+        else:
+            logger.warning(
+                "checkpoint has no data_sampler state (written before the "
+                "curriculum sampler existed, or without one) — the "
+                "curriculum will rewalk its schedule from step 0")
     log_dist(f"loaded checkpoint {path} (saved at topology {meta.get('topology')})")
     return path, meta.get("client_state", {})
 

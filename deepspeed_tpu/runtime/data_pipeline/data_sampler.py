@@ -55,6 +55,7 @@ class DeepSpeedDataSampler:
         # must always be able to draw one batch) follows it
         self.metric = first
         self.order = np.argsort(self.metric, kind="stable")
+        self._sorted_metric = self.metric[self.order]
         self.batch_size = batch_size
         self.seed = seed
         self.drop_last = drop_last
@@ -82,25 +83,38 @@ class DeepSpeedDataSampler:
                     for _, sched in self.metrics.values())
         if key == self._pool_key:
             return self._pool
-        mask = np.ones(self.n_samples, bool)
-        for diff, (arr, _) in zip(key, self.metrics.values()):
-            if diff is not None:
-                mask &= arr <= diff
-        in_pool = mask[self.order]
-        pool = self.order[in_pool]
         floor = min(self.batch_size, self.n_samples)
-        if len(pool) < floor:
-            extra = self.order[~in_pool][:floor - len(pool)]
-            pool = np.concatenate([pool, extra])
-        if self._pool is not None and not np.array_equal(pool, self._pool):
-            # the pool's CONTENT changed (not merely a threshold value that
-            # admitted nothing new — smooth schedules move nearly every
-            # step): never reuse consumed offsets. Content-keying also makes
-            # resume exact: at save time the live pool always equals the
-            # permutation's pool (a content change would have reset it), so
-            # a load_state_dict-restored permutation pairs with the pool
-            # re-derived at the resumed step.
-            self._perm = None
+        if len(self.metrics) == 1:
+            # single metric: the pool is a PREFIX of the sorted order —
+            # O(log n) per threshold move, no mask rebuild
+            k = (self.n_samples if key[0] is None else
+                 int(np.searchsorted(self._sorted_metric, key[0], side="right")))
+            pool = self.order[:max(k, floor)]
+        else:
+            mask = np.ones(self.n_samples, bool)
+            for diff, (arr, _) in zip(key, self.metrics.values()):
+                if diff is not None:
+                    mask &= arr <= diff
+            in_pool = mask[self.order]
+            pool = self.order[in_pool]
+            if len(pool) < floor:
+                extra = self.order[~in_pool][:floor - len(pool)]
+                pool = np.concatenate([pool, extra])
+        if self._pool is not None:
+            same = (len(pool) == len(self._pool)
+                    # single-metric pools are prefixes of one fixed order:
+                    # equal length <=> equal content, no O(n) compare needed
+                    and (len(self.metrics) == 1
+                         or np.array_equal(pool, self._pool)))
+            if not same:
+                # the pool's CONTENT changed (not merely a threshold value
+                # that admitted nothing new — smooth schedules move nearly
+                # every step): never reuse consumed offsets. Content-keying
+                # also makes resume exact: at save time the live pool always
+                # equals the permutation's pool (a content change would have
+                # reset it), so a load_state_dict-restored permutation pairs
+                # with the pool re-derived at the resumed step.
+                self._perm = None
         self._pool = pool
         self._pool_key = key
         return pool
@@ -140,6 +154,11 @@ class DeepSpeedDataSampler:
         self.seed = sd["seed"]
         self._perm_step = sd.get("perm_step", 0)
         self._perm_size = sd.get("perm_size", 0)
+        # drop any live pool from draws made BEFORE the restore (rollback
+        # into a used sampler): stale pool state must not invalidate the
+        # restored permutation on the first post-resume draw
+        self._pool = None
+        self._pool_key = None
         if self._perm_size > 0:
             rng = np.random.default_rng(self.seed + self._perm_step)
             self._perm = rng.permutation(self._perm_size)

@@ -377,9 +377,15 @@ class DeepSpeedTPUEngine:
                                   is_leaf=lambda x: isinstance(x, P))
             opt_state = jax.jit(self.tx.init, out_shardings=opt_sh)(params)
             if self._offload_optimizer:
-                # opt_sh updates to pinned-host kinds so every later device_put
-                # (checkpoint load, reload_states) restores host residency
-                opt_state, opt_sh = _to_host_memory(opt_state, opt_sh)
+                if _host_memory_jit_supported(topo.mesh):
+                    # opt_sh updates to pinned-host kinds so every later
+                    # device_put (checkpoint load, reload_states) restores
+                    # host residency
+                    opt_state, opt_sh = _to_host_memory(opt_state, opt_sh)
+                else:
+                    log_dist("offload_optimizer: this backend cannot compile "
+                             "pinned-host operands — optimizer state stays "
+                             "device-resident (graceful degradation)")
 
         ls = make_loss_scale_state(self.config.fp16.initial_scale_power,
                                    self.config.fp16.loss_scale,
@@ -416,6 +422,19 @@ class DeepSpeedTPUEngine:
         if isinstance(out, tuple):
             return out[0].astype(jnp.float32), out[1]
         return out.astype(jnp.float32), None
+
+    def _opt_to_device(self, opt_state):
+        """Pinned-host STORAGE tier (the host-Adam decline path: frozen
+        params / custom optimizer / multi-process): optimizer state lives in
+        host memory between steps; stream it to device memory for the update
+        (XLA overlaps the transfer), and the host-kind out_shardings stream
+        the new state back. No-op when the optimizer is device-resident."""
+        if not (self._offload_optimizer and jax.tree.leaves(opt_state)):
+            return opt_state
+        return jax.tree.map(
+            lambda x, sh: (jax.device_put(x, sh.with_memory_kind("device"))
+                           if sh.memory_kind == "pinned_host" else x),
+            opt_state, self._opt_shardings)
 
     def _compile(self, donate_state):
         config, topo, rules = self.config, self.topo, self.rules
@@ -471,7 +490,8 @@ class DeepSpeedTPUEngine:
                 coef = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
                 grads = jax.tree.map(lambda g: g * coef, grads)
 
-            updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+            updates, new_opt = self.tx.update(grads, self._opt_to_device(state.opt_state),
+                                              state.params)
             new_params = jax.tree.map(
                 lambda p, u: (p.astype(jnp.float32) + u.astype(jnp.float32)).astype(p.dtype),
                 state.params, updates)
@@ -818,7 +838,8 @@ class DeepSpeedTPUEngine:
                 if clip and clip > 0:
                     coef = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
                     grads = jax.tree.map(lambda g: g * coef, grads)
-                updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+                updates, new_opt = self.tx.update(
+                    grads, self._opt_to_device(state.opt_state), state.params)
                 new_params = jax.tree.map(
                     lambda p, u: (p.astype(jnp.float32) + u.astype(jnp.float32)).astype(p.dtype),
                     state.params, updates)
@@ -833,7 +854,10 @@ class DeepSpeedTPUEngine:
                 return TrainState(step=state.step + 1, params=new_params,
                                   opt_state=new_opt, loss_scale=new_ls)
 
-            self._apply_fn = jax.jit(apply_step, donate_argnums=(1,))
+            # out_shardings keep the optimizer state's memory kind (pinned
+            # host under the offload storage tier) across compat steps
+            self._apply_fn = jax.jit(apply_step, donate_argnums=(1,),
+                                     out_shardings=self._state_shardings)
         self.state = self._apply_fn(self.state, self._compat_acc)
         self._compat_acc = None
         self._compat_count = 0
@@ -1052,6 +1076,30 @@ def _accepts_rng(fn) -> bool:
 def _draw_from_iter(data_iter, gas):
     mbs = [next(data_iter) for _ in range(gas)]
     return jax.tree.map(lambda *xs: jnp.stack(xs), *mbs)
+
+
+_HOST_JIT_PROBE: Dict[Any, bool] = {}
+
+
+def _host_memory_jit_supported(mesh) -> bool:
+    """Whether COMPILED programs on this mesh can take/return pinned-host
+    operands (the memories API). TPU yes; the multi-device CPU SPMD
+    partitioner rejects the placement annotations ('side-effect ops cannot
+    be replicated'), so the offload storage tier must probe before placing
+    optimizer state in host memory — host-resident inputs to a jit that
+    cannot express them would crash the first train step."""
+    # stable key (id() could be recycled after GC): platform + device ids
+    key = (mesh.devices.flat[0].platform,
+           tuple(d.id for d in mesh.devices.flat))
+    if key not in _HOST_JIT_PROBE:
+        try:
+            sh = NamedSharding(mesh, P()).with_memory_kind("pinned_host")
+            x = jax.device_put(jnp.zeros((1,), jnp.float32), sh)
+            jax.jit(lambda v: v + 1, in_shardings=sh, out_shardings=sh)(x)
+            _HOST_JIT_PROBE[key] = True
+        except Exception:
+            _HOST_JIT_PROBE[key] = False
+    return _HOST_JIT_PROBE[key]
 
 
 def _to_host_memory(tree, shardings, fallback: str = "keep"):
