@@ -67,9 +67,19 @@ class DeepSpeedDataSampler:
         self._perm_step = 0  # step whose seed generated the live permutation
         self._pool = None
         self._pool_key = None  # difficulty tuple the cached pool was built at
+        self._resume_pool_sig = None  # (len, checksum) of the pre-save pool
 
     def __len__(self):
         return self.n_samples // self.batch_size
+
+    def _pool_sig(self, pool) -> Tuple[int, int]:
+        """Cheap content fingerprint: single-metric pools are prefixes of
+        one fixed order (length suffices); multi-metric intersections need a
+        checksum since content can change at constant size."""
+        if len(self.metrics) == 1:
+            return (len(pool), 0)
+        return (len(pool), int(np.bitwise_xor.reduce((pool + 1) * 2654435761
+                                                     % (2 ** 31))))
 
     def _eligible_pool(self) -> np.ndarray:
         """Sample indices within every metric's current threshold, easy→hard
@@ -100,12 +110,11 @@ class DeepSpeedDataSampler:
             if len(pool) < floor:
                 extra = self.order[~in_pool][:floor - len(pool)]
                 pool = np.concatenate([pool, extra])
-        if self._pool is not None:
-            same = (len(pool) == len(self._pool)
-                    # single-metric pools are prefixes of one fixed order:
-                    # equal length <=> equal content, no O(n) compare needed
-                    and (len(self.metrics) == 1
-                         or np.array_equal(pool, self._pool)))
+        prev_sig = (self._pool_sig(self._pool) if self._pool is not None
+                    else self._resume_pool_sig)  # pre-save pool, if resuming
+        self._resume_pool_sig = None
+        if prev_sig is not None:
+            same = prev_sig == self._pool_sig(pool)
             if not same:
                 # the pool's CONTENT changed (not merely a threshold value
                 # that admitted nothing new — smooth schedules move nearly
@@ -143,7 +152,9 @@ class DeepSpeedDataSampler:
     def state_dict(self):
         return {"global_step": self.global_step, "consumed": self._consumed,
                 "seed": self.seed, "perm_step": self._perm_step,
-                "perm_size": self._perm_size}
+                "perm_size": self._perm_size,
+                "pool_sig": (None if self._pool is None
+                             else list(self._pool_sig(self._pool)))}
 
     def load_state_dict(self, sd):
         """Resume exactly: regenerate the live permutation from the seed of
@@ -156,9 +167,13 @@ class DeepSpeedDataSampler:
         self._perm_size = sd.get("perm_size", 0)
         # drop any live pool from draws made BEFORE the restore (rollback
         # into a used sampler): stale pool state must not invalidate the
-        # restored permutation on the first post-resume draw
+        # restored permutation. The SAVED pool's fingerprint survives so the
+        # first post-resume draw still detects a content change at the
+        # resume boundary exactly like an uninterrupted run would.
         self._pool = None
         self._pool_key = None
+        sig = sd.get("pool_sig")
+        self._resume_pool_sig = None if sig is None else tuple(sig)
         if self._perm_size > 0:
             rng = np.random.default_rng(self.seed + self._perm_step)
             self._perm = rng.permutation(self._perm_size)

@@ -490,14 +490,17 @@ class DeepSpeedTPUEngine:
                 coef = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
                 grads = jax.tree.map(lambda g: g * coef, grads)
 
-            updates, new_opt = self.tx.update(grads, self._opt_to_device(state.opt_state),
-                                              state.params)
+            # bound once: the overflow select below must also see the
+            # device copy — mixing a pinned-host leaf into compiled math is
+            # the crash _opt_to_device exists to prevent
+            opt_in = self._opt_to_device(state.opt_state)
+            updates, new_opt = self.tx.update(grads, opt_in, state.params)
             new_params = jax.tree.map(
                 lambda p, u: (p.astype(jnp.float32) + u.astype(jnp.float32)).astype(p.dtype),
                 state.params, updates)
             if fp16:
                 new_params = _tree_where(overflow, state.params, new_params)
-                new_opt = _tree_where(overflow, state.opt_state, new_opt)
+                new_opt = _tree_where(overflow, opt_in, new_opt)
             new_ls = update_loss_scale(
                 state.loss_scale, overflow,
                 dynamic=fp16_dynamic,
@@ -838,14 +841,14 @@ class DeepSpeedTPUEngine:
                 if clip and clip > 0:
                     coef = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
                     grads = jax.tree.map(lambda g: g * coef, grads)
-                updates, new_opt = self.tx.update(
-                    grads, self._opt_to_device(state.opt_state), state.params)
+                opt_in = self._opt_to_device(state.opt_state)
+                updates, new_opt = self.tx.update(grads, opt_in, state.params)
                 new_params = jax.tree.map(
                     lambda p, u: (p.astype(jnp.float32) + u.astype(jnp.float32)).astype(p.dtype),
                     state.params, updates)
                 if self.fp16:
                     new_params = _tree_where(overflow, state.params, new_params)
-                    new_opt = _tree_where(overflow, state.opt_state, new_opt)
+                    new_opt = _tree_where(overflow, opt_in, new_opt)
                 new_ls = update_loss_scale(state.loss_scale, overflow,
                                            dynamic=self.fp16 and config.fp16.loss_scale == 0,
                                            scale_window=config.fp16.loss_scale_window,

@@ -156,74 +156,3 @@ def test_host_offload_checkpoint_roundtrip(tmp_path):
     loss = float(engine.train_batch(batches[3]))
     assert np.isfinite(loss)
 
-
-# ---------------------------------------------------------------------------
-# silent-fallback tiers (VERDICT r4 item 8): each host-Adam decline must
-# actually engage the pinned-host tier AND train
-# ---------------------------------------------------------------------------
-
-
-def test_frozen_params_offload_fallback():
-    """frozen_params + offload cpu: the true host-Adam tier declines (it
-    does not mask updates) and the pinned-host tier trains, with the frozen
-    leaves untouched."""
-    cfg = dict(BASE, zero_optimization={
-        "stage": 2, "offload_optimizer": {"device": "cpu"}})
-    set_topology(Topology(TopologySpec()))
-    params = make_simple_params(hidden=64, seed=0)
-    frozen_before = np.asarray(params["layer_0"]["w"])
-    engine, *_ = ds.initialize(model=simple_loss, model_parameters=params,
-                               config=cfg, frozen_params=["layer_0"])
-    assert engine._host_adam is None and not engine._host_adam_mode
-    assert engine._offload_optimizer  # offload storage tier engaged
-    batch = random_batches(1, 8, hidden=64, seed=0)[0]
-    losses = [float(engine.train_batch(batch)) for _ in range(4)]
-    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
-    np.testing.assert_array_equal(
-        np.asarray(jax.device_get(engine.state.params["layer_0"]["w"])),
-        frozen_before)
-    # trainable leaves moved
-    assert not np.array_equal(
-        np.asarray(jax.device_get(engine.state.params["layer_1"]["w"])),
-        np.asarray(make_simple_params(hidden=64, seed=0)["layer_1"]["w"]))
-
-
-def test_custom_optimizer_offload_fallback():
-    """A caller-supplied optax optimizer + offload cpu: host Adam declines
-    (it only speaks the adam family), pinned-host tier trains."""
-    import optax
-
-    cfg = dict(BASE, zero_optimization={
-        "stage": 1, "offload_optimizer": {"device": "cpu"}})
-    set_topology(Topology(TopologySpec()))
-    params = make_simple_params(hidden=64, seed=0)
-    engine, *_ = ds.initialize(model=simple_loss, model_parameters=params,
-                               config=cfg, optimizer=optax.adam(1e-2))
-    assert engine._host_adam is None and not engine._host_adam_mode
-    assert engine._offload_optimizer
-    # optimizer state exists (unlike the host tier) and trains
-    assert len(jax.tree.leaves(engine.state.opt_state)) > 0
-    batch = random_batches(1, 8, hidden=64, seed=0)[0]
-    losses = [float(engine.train_batch(batch)) for _ in range(4)]
-    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
-
-
-def test_no_sync_train_batch_migration():
-    """train_batch inside no_sync is rejected with guidance to the
-    backward()/step() path — and that path works (the documented
-    accumulate-then-step migration, reference engine no_sync)."""
-    cfg = dict(BASE)
-    set_topology(Topology(TopologySpec()))
-    params = make_simple_params(hidden=64, seed=0)
-    engine, *_ = ds.initialize(model=simple_loss, model_parameters=params,
-                               config=cfg)
-    batches = random_batches(3, 8, hidden=64, seed=0)
-    with engine.no_sync():
-        with pytest.raises(RuntimeError, match="backward"):
-            engine.train_batch(batches[0])
-        # the documented migration: imperative accumulate under no_sync
-        engine.backward(batch=batches[0])
-        engine.backward(batch=batches[1])
-    engine.backward(batch=batches[2])
-    engine.step()
-    assert np.isfinite(float(engine.eval_batch(batches[0])))
