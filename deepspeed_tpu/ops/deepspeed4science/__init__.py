@@ -1,0 +1,5 @@
+"""DeepSpeed4Science ops: Evoformer (AlphaFold) fused attention analogue."""
+
+from .evoformer_attn import DS4Sci_EvoformerAttention, evoformer_attention
+
+__all__ = ["DS4Sci_EvoformerAttention", "evoformer_attention"]
