@@ -565,7 +565,91 @@ def rung5_moe_ulysses():
             "device": "cpu-mesh-8"}
 
 
+def rung3b_big_model():
+    """Rung 3b: the ≥1B-param single-chip row (VERDICT r4 item 2) — largest
+    Llama-shaped config that trains on ONE chip with bf16 + remat +
+    ZeRO-Offload (host SIMD Adam, ``csrc/adam/cpu_adam.cpp``); fp32 master +
+    moments live on host, so HBM holds only bf16 params + fp32 grad
+    accumulator + remat activations. ``docs/scaling_7b.md`` extrapolates
+    from this measurement to Llama-2-7B on a v5e pod slice.
+
+    Knobs (all optional): BIG_LAYERS/BIG_HIDDEN/BIG_INTER, BIG_BATCH,
+    BIG_GAS, BIG_GRAD_DTYPE (device->host transport: float32|bfloat16)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.transformer import (TransformerLM, init_params,
+                                                  llama_config, make_loss_fn)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    env = os.environ.get
+    if on_tpu:
+        # the "1b" preset is the TinyLlama-1.1B shape (h=2048, L=22, GQA
+        # 32/4, inter=5632) — 1.12B params with the untied head
+        over = {k[4:].lower(): int(v) for k, v in os.environ.items()
+                if k in ("BIG_LAYERS", "BIG_HIDDEN", "BIG_INTER")}
+        over = {{"layers": "num_layers", "hidden": "hidden_size",
+                 "inter": "intermediate_size"}[k]: v for k, v in over.items()}
+        cfg = llama_config("1b", max_seq_len=2048, dtype=jnp.bfloat16,
+                           remat=True, **over)
+        batch, seq = int(env("BIG_BATCH", "4")), 2048
+        gas = int(env("BIG_GAS", "8"))
+        steps, warmup = 3, 2
+    else:  # keep the rung runnable on CPU so --ladder never loses the row
+        cfg = llama_config("7b", num_layers=2, hidden_size=128,
+                           intermediate_size=256, num_heads=4, num_kv_heads=4,
+                           vocab_size=1024, max_seq_len=128, dtype=jnp.float32,
+                           remat=True)
+        batch, seq, gas, steps, warmup = 2, 128, 2, 2, 1
+
+    model = TransformerLM(cfg)
+    params = init_params(model, batch=1, seq=seq)
+    config = {"train_micro_batch_size_per_gpu": batch,
+              "gradient_accumulation_steps": gas,
+              "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+              "zero_optimization": {"stage": 3,
+                                    "offload_optimizer": {"device": "cpu"}},
+              "bf16": {"enabled": bool(on_tpu)},
+              "gradient_clipping": 1.0, "steps_per_print": 10**9}
+    gd = env("BIG_GRAD_DTYPE")
+    if gd:
+        config["zero_optimization"]["offload_optimizer"]["grad_dtype"] = gd
+    engine, *_ = ds.initialize(model=make_loss_fn(model),
+                               model_parameters=params, config=config)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(engine.state.params))
+    rng = np.random.default_rng(0)
+    batches = [{"tokens": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (gas * batch, seq)), jnp.int32)}
+        for _ in range(4)]
+    dt, final = _time_steps(engine, batches, steps, warmup)
+    tok_s = gas * batch * seq * steps / dt / len(jax.devices())
+    n_matmul = n_params - cfg.vocab_size * cfg.hidden_size
+    mfu = model_flops_per_token(cfg, seq, n_matmul) * tok_s / peak_flops(dev)
+
+    # host-link bandwidth (the ZeRO-Offload tax): measured directly so the
+    # memo can separate compute MFU from transport. 256 MiB probe.
+    probe = jnp.ones((64 * 2**20,), jnp.float32)
+    jax.block_until_ready(probe)
+    t0 = time.perf_counter(); h = jax.device_get(probe)
+    d2h = time.perf_counter() - t0
+    t0 = time.perf_counter(); jax.block_until_ready(jax.device_put(h))
+    h2d = time.perf_counter() - t0
+    nb = probe.size * 4
+
+    return {"metric": "llama_1b_offload_bf16_remat_mfu", "value": round(mfu, 4),
+            "unit": "MFU", "vs_baseline": round(mfu / TARGET_MFU, 4),
+            "tokens_per_sec_per_chip": round(tok_s, 1), "n_params": n_params,
+            "batch": batch, "gas": gas, "grad_dtype": gd or "float32",
+            "final_loss": final, "d2h_gbps": round(nb / d2h / 1e9, 2),
+            "h2d_gbps": round(nb / h2d / 1e9, 2),
+            "step_grad_bytes_gb": round(
+                (2 if gd in ("bfloat16", "bf16") else 4) * n_params / 1e9, 2),
+            "step_param_bytes_gb": round((2 if on_tpu else 4) * n_params / 1e9, 2),
+            "device": getattr(dev, "device_kind", dev.platform)}
+
+
 RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
+         "3b": rung3b_big_model,
          "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses}
 
 

@@ -69,7 +69,14 @@ def evoformer_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if chunk_size is None or chunk_size >= s:
         logits = jnp.einsum("bnqhd,bnkhd->bnhqk", qf, kf)
         logits = logits + bias_for(0, s)
-        probs = jax.nn.softmax(logits, axis=-1)
+        # fully-masked rows (bias1 all -inf, the AlphaFold padding-row mask)
+        # must yield 0, matching the chunked path's l==0 handling — plain
+        # softmax would emit NaN (exp(-inf - -inf))
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        m = jnp.where(jnp.isneginf(m), 0.0, m)
+        p = jnp.exp(logits - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        probs = p / jnp.where(l == 0.0, 1.0, l)
         out = jnp.einsum("bnhqk,bnkhd->bnqhd", probs, vf)
         return out.astype(q.dtype)
 

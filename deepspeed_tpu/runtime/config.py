@@ -79,6 +79,13 @@ class OffloadOptimizerConfig(ConfigModel):
     pipeline_write: bool = False
     fast_init: bool = False
     ratio: float = 1.0
+    # D2H gradient transport dtype for the host-Adam tier. The reference
+    # ZeRO-Offload ships the compute-dtype (fp16/bf16) grads to the CPU
+    # optimizer (zero/stage_1_and_2.py copy_grads_in_partition); "bfloat16"
+    # matches that and halves the host-link bytes. Accumulation and the
+    # grad-norm/clip math stay fp32 on device; only the final transfer
+    # narrows. "float32" (default) keeps full-width transport.
+    grad_dtype: str = "float32"
 
 
 @register_config

@@ -441,6 +441,19 @@ class DeepSpeedTPUEngine:
         gas, fp16 = self.gas, self.fp16
         clip = config.gradient_clipping
         fp16_dynamic = fp16 and config.fp16.loss_scale == 0
+        gd_raw = config.zero_optimization.offload_optimizer.grad_dtype.lower()
+        gd_table = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                    "float32": jnp.float32, "fp32": jnp.float32}
+        if gd_raw not in gd_table:
+            # fp16 is deliberately absent: transport narrowing happens after
+            # the finite check, so an fp16 overflow (|g| > 65504) would slip
+            # inf past _apply_host_adam's grad_norm gate into the masters;
+            # bf16 shares the fp32 exponent range and cannot overflow
+            raise ValueError(
+                f"offload_optimizer.grad_dtype={gd_raw!r}: use 'float32' or "
+                "'bfloat16' (fp16 transport would need its own overflow "
+                "gate — bf16 is the range-safe narrow dtype on TPU)")
+        offload_grad_dtype = jnp.dtype(gd_table[gd_raw])
         if config.prescale_gradients:
             # Reference predivide-then-SUM-allreduce (engine.py:2533) nets out
             # to the mean; SPMD grads here are already global means, so the
@@ -549,6 +562,12 @@ class DeepSpeedTPUEngine:
             if clip and clip > 0:
                 coef = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
                 grads = jax.tree.map(lambda g: g * coef, grads)
+            if offload_grad_dtype != jnp.dtype(jnp.float32):
+                # transport-dtype narrowing happens AFTER fp32 accumulation,
+                # norm and clip — only the D2H bytes shrink (reference
+                # ZeRO-Offload ships compute-dtype grads to the CPU optimizer)
+                grads = jax.tree.map(
+                    lambda g: g.astype(offload_grad_dtype), grads)
             metrics = {"loss": jnp.mean(losses), "grad_norm": grad_norm,
                        "lr": jnp.asarray(self.lr_schedule(step + 1), jnp.float32),
                        "loss_scale": jnp.asarray(1.0, jnp.float32),
@@ -601,6 +620,17 @@ class DeepSpeedTPUEngine:
                 "train_batch() applies the optimizer unconditionally and is "
                 "incompatible with an open no_sync() context; use the "
                 "imperative backward()/step() path inside no_sync()")
+        if self._compat_count > 0:
+            # reference accumulate-then-batch pattern (no_sync + backward,
+            # then train_batch for the boundary step): the fused step would
+            # silently DROP the accumulated micro-grads — fail loudly and
+            # point at the migration instead
+            raise RuntimeError(
+                f"train_batch() called with {self._compat_count} accumulated "
+                "microbatch gradient(s) pending from backward(); the fused "
+                "step would drop them. Finish the window with backward()+"
+                "step() (the no_sync migration), or discard via "
+                "zero_grad() before switching to train_batch()")
         if batch is None:
             batch = _draw_from_iter(data_iter, self.gas)
         batch = self._shape_batch(batch)
@@ -866,6 +896,16 @@ class DeepSpeedTPUEngine:
         self._compat_count = 0
         self._compat_pending = None  # see host-adam branch above
         self.global_steps += 1
+
+    def zero_grad(self):
+        """Discard accumulated compat-path micro-gradients (reference
+        ``engine.zero_grad``). The fused ``train_batch`` manages its own
+        accumulator, so this only matters when abandoning a
+        ``backward()`` window, e.g. before switching back to
+        ``train_batch``."""
+        self._compat_acc = None
+        self._compat_pending = None
+        self._compat_count = 0
 
     # ------------------------------------------------------------------
     def _shape_batch(self, batch):

@@ -118,6 +118,47 @@ def test_host_offload_compat_api():
         rtol=1e-4, atol=1e-5)
 
 
+def test_bf16_grad_transport_tracks_fp32():
+    """offload_optimizer.grad_dtype=bfloat16 (reference ZeRO-Offload ships
+    compute-dtype grads to the CPU optimizer): transport narrowing happens
+    after fp32 accumulate/norm/clip, so the loss trajectory stays within
+    bf16 rounding of the full-width transport."""
+    cfg32 = dict(BASE, zero_optimization={
+        "stage": 2, "offload_optimizer": {"device": "cpu"}})
+    cfg16 = dict(BASE, zero_optimization={
+        "stage": 2, "offload_optimizer": {"device": "cpu",
+                                          "grad_dtype": "bfloat16"}})
+    eng32, loss32 = _train(cfg32)
+    eng16, loss16 = _train(cfg16)
+    assert eng16._host_adam is not None
+    # the grad step really emits narrow grads
+    g, _ = eng16._train_steps[None](
+        eng16.state.params,
+        eng16._shape_batch(random_batches(1, 8, hidden=64, seed=0)[0]),
+        jax.random.PRNGKey(0), eng16.state.step)
+    assert all(x.dtype == jnp.bfloat16 for x in jax.tree.leaves(g))
+    # trajectory parity with full-width transport (the toy loss oscillates
+    # batch to batch, so parity — not monotonicity — is the signal)
+    np.testing.assert_allclose(loss16, loss32, rtol=2e-2, atol=2e-2)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)),
+        rtol=5e-2, atol=5e-3), eng16.state.params, eng32.state.params)
+
+
+def test_bad_grad_dtype_rejected():
+    """Typos and fp16 must fail at init, not silently ship fp32 (fp16 would
+    let a >65504 grad overflow to inf past the finite check)."""
+    for bad in ("bfloat", "fp16", "float16", "half"):
+        cfg = dict(BASE, zero_optimization={
+            "stage": 2, "offload_optimizer": {"device": "cpu",
+                                              "grad_dtype": bad}})
+        set_topology(Topology(TopologySpec()))
+        with pytest.raises(ValueError, match="grad_dtype"):
+            ds.initialize(model=simple_loss,
+                          model_parameters=make_simple_params(hidden=64, seed=0),
+                          config=cfg)
+
+
 def test_fp16_offload_rejected():
     cfg = dict(BASE, fp16={"enabled": True},
                zero_optimization={"stage": 1,

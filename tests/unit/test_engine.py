@@ -205,6 +205,28 @@ def test_no_sync_defers_the_step():
         base.state.params, deferred.state.params)
 
 
+def test_accumulate_then_train_batch_fails_loudly():
+    """Reference accumulate-then-batch pattern (no_sync + backward, then
+    train_batch at the boundary): the fused step cannot consume the compat
+    accumulator, so it must REFUSE — not silently drop the pending grads —
+    and zero_grad() is the documented escape hatch back to train_batch."""
+    engine = _make_engine(zero_stage=0)
+    b1, b2 = random_batches(2, 8, HIDDEN, seed=11)
+    with engine.no_sync():
+        engine.backward(batch=b1)
+    with pytest.raises(RuntimeError, match="accumulated"):
+        engine.train_batch(b2)
+    # migration path A: finish the window imperatively
+    engine.step()
+    assert engine.global_steps == 1
+    # migration path B: discard and return to the fused API
+    with engine.no_sync():
+        engine.backward(batch=b1)
+    engine.zero_grad()
+    engine.train_batch(b2)
+    assert engine.global_steps == 2
+
+
 def test_frozen_params_not_updated(tmp_path):
     """frozen_params (reference requires_grad=False / SimpleFrozenModel):
     matching leaves get no update and no optimizer state; checkpoints

@@ -118,3 +118,21 @@ def test_chunked_handles_fully_masked_first_chunk():
     assert np.isfinite(np.asarray(chunked)).all()
     np.testing.assert_allclose(np.asarray(chunked), np.asarray(full),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_fully_masked_rows_zero_on_both_paths():
+    """bias1 = -inf across ALL keys for one MSA row (the AlphaFold
+    padding-row mask): both the unchunked and chunked paths must return 0
+    for that row — plain softmax would NaN-poison it and every gradient."""
+    q, k, v, _, b2 = _inputs(5)
+    b1 = np.zeros((B, N, 1, 1, S), np.float32)
+    b1[:, 1] = -np.inf  # MSA row 1 entirely padded out
+    b1 = jnp.asarray(b1)
+    for cs in (None, 8):
+        out = np.asarray(evoformer_attention(q, k, v, b1, None, chunk_size=cs))
+        assert np.isfinite(out).all(), f"chunk_size={cs} emitted non-finite"
+        np.testing.assert_array_equal(out[:, 1], np.zeros_like(out[:, 1]))
+    # gradients through the masked configuration stay finite
+    g = jax.grad(lambda a: jnp.sum(
+        evoformer_attention(a, k, v, b1, b2) ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
