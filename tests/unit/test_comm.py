@@ -132,3 +132,190 @@ def test_comms_logger_traced():
 
 def test_world_size():
     assert dist.get_world_size() == 8
+
+
+# ---------------------------------------------------------------------------
+# reference API-parity surface: groups, rooted + coalesced collectives
+# ---------------------------------------------------------------------------
+
+
+def test_new_group_subset_allreduce_single_axis():
+    """new_group over ranks [0,2,4,6] of one axis: members see the subset
+    sum (mask -> full-axis psum -> member select), non-members pass
+    through unchanged (torch's not-participating contract)."""
+    from deepspeed_tpu.parallel.topology import set_topology
+
+    t = Topology(TopologySpec(pp=8))
+    set_topology(t)
+    g = dist.new_group([0, 2, 4, 6], axis="pp")
+    assert g.size() == 4 and dist.get_all_ranks_from_group(g) == [0, 2, 4, 6]
+    assert dist.get_global_rank(g, 3) == 6
+
+    @jax.jit
+    def f(x):
+        def body(x):
+            return dist.group_all_reduce(x, axis="pp", group=g)
+
+        return shard_map(body, mesh=t.mesh, in_specs=P("pp"), out_specs=P("pp"))(x)
+
+    out = np.asarray(f(jnp.arange(8.0).reshape(8, 1))).ravel()
+    expect = np.arange(8.0)
+    expect[[0, 2, 4, 6]] = 0 + 2 + 4 + 6
+    np.testing.assert_allclose(out, expect)
+
+
+def test_new_group_subset_allreduce_flat_data_axes():
+    """Default-axis groups span the flattened (dp_outer, ep) data scope,
+    where XLA has no axis_index_groups — the masked path must produce the
+    same semantics."""
+    from deepspeed_tpu.parallel.topology import set_topology
+
+    t = Topology(TopologySpec(ep=4))  # dp_outer=2 x ep=4: flat data axis of 8
+    set_topology(t)
+    g = dist.new_group([0, 1, 5])
+
+    @jax.jit
+    def f(x):
+        def body(x):
+            return dist.group_all_reduce(x, axis=("dp_outer", "ep"), group=g)
+
+        return shard_map(body, mesh=t.mesh, in_specs=P(("dp_outer", "ep")),
+                         out_specs=P(("dp_outer", "ep")))(x)
+
+    out = np.asarray(f(jnp.arange(8.0).reshape(8, 1))).ravel()
+    expect = np.arange(8.0)
+    expect[[0, 1, 5]] = 0 + 1 + 5
+    np.testing.assert_allclose(out, expect)
+
+
+def test_rooted_reduce_gather_scatter(topo8):
+    mesh = topo8.mesh
+    axes = ("dp_outer", "ep")
+
+    @jax.jit
+    def f(x):
+        def body(x):
+            r = dist.reduce(x, axis=axes, dst=3)
+            gth = dist.gather(x, axis=axes, dst=2)
+            sc = dist.scatter(gth * 0 + jnp.arange(8.0)[:, None], axis=axes,
+                              src=0)
+            return r, gth, sc
+
+        return shard_map(body, mesh=mesh, in_specs=P(axes),
+                         out_specs=(P(axes), P(axes), P(axes)))(x)
+
+    r, gth, sc = (np.asarray(o) for o in f(jnp.arange(8.0).reshape(8, 1)))
+    expect_r = np.zeros(8); expect_r[3] = 28.0
+    np.testing.assert_allclose(r.ravel(), expect_r)
+    # gather: rank 2's row-block holds all shards, other ranks zeros
+    gth = gth.reshape(8, 8)
+    np.testing.assert_allclose(gth[2], np.arange(8.0))
+    assert (gth[[0, 1, 3, 4, 5, 6, 7]] == 0).all()
+    # scatter from src=0 of a [8,1] tensor: rank i receives row i
+    np.testing.assert_allclose(sc.ravel(), np.arange(8.0))
+
+
+def test_coalesced_collectives(topo8):
+    mesh = topo8.mesh
+    axes = ("dp_outer", "ep")
+    bucket = {"a": jnp.ones((8, 2)), "b": jnp.arange(8.0).reshape(8, 1)}
+
+    @jax.jit
+    def f(bucket):
+        def body(bucket):
+            red = dist.all_reduce_coalesced(bucket, axis=axes)
+            gat = dist.all_gather_coalesced(bucket, axis=axes)
+            return red, gat
+
+        return shard_map(body, mesh=mesh, in_specs=P(axes),
+                         out_specs=(P(axes), P(axes)))(bucket)
+
+    red, gat = f(bucket)
+    np.testing.assert_allclose(np.asarray(red["a"]), np.full((8, 2), 8.0))
+    np.testing.assert_allclose(np.asarray(red["b"]),
+                               np.full((8, 1), 28.0))
+    assert gat["a"].shape == (64, 2) and gat["b"].shape == (64, 1)
+
+
+def test_capability_probes_and_aliases():
+    assert dist.is_available()
+    assert dist.has_all_gather_into_tensor()
+    assert dist.has_reduce_scatter_tensor()
+    assert dist.has_all_reduce_coalesced()
+    assert dist.has_coalescing_manager()
+    assert dist.all_gather_into_tensor is dist.all_gather
+    assert dist.reduce_scatter_tensor is dist.reduce_scatter
+    assert dist.all_to_all_single is dist.all_to_all
+    assert dist.mpi_discovery() == (0, 1)
+    mesh = dist.initialize_mesh_device((2, 4), ("a", "b"))
+    assert mesh.shape == {"a": 2, "b": 4}
+
+
+def test_world_group():
+    from deepspeed_tpu.parallel.topology import set_topology
+
+    set_topology(Topology(TopologySpec()))
+    wg = dist.get_world_group()
+    assert wg.size() == 8 and dist.get_global_rank(wg, 7) == 7
+    with pytest.raises(ValueError):
+        dist.new_group([0, 0, 1])      # duplicate ranks
+    with pytest.raises(ValueError):
+        dist.new_group([0, 99])        # out of range
+
+
+def test_group_min_integer_dtype():
+    """Subset min over int32: the neutral element must be iinfo.max, not a
+    float inf cast (which would int-overflow and poison the result)."""
+    from deepspeed_tpu.parallel.topology import set_topology
+
+    t = Topology(TopologySpec(pp=8))
+    set_topology(t)
+    g = dist.new_group([1, 3, 5], axis="pp")
+
+    @jax.jit
+    def f(x):
+        def body(x):
+            return dist.group_all_reduce(x, axis="pp", group=g, op="min")
+
+        return shard_map(body, mesh=t.mesh, in_specs=P("pp"), out_specs=P("pp"))(x)
+
+    out = np.asarray(f(jnp.arange(10, 18, dtype=jnp.int32).reshape(8, 1))).ravel()
+    expect = np.arange(10, 18)
+    expect[[1, 3, 5]] = 11  # min over members only
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_world_group_spans_all_axes():
+    """Under model parallelism the world group must cover every device,
+    matching get_world_size — not just the data axes."""
+    from deepspeed_tpu.parallel.topology import set_topology
+
+    set_topology(Topology(TopologySpec(pp=4, tp=2)))
+    wg = dist.get_world_group()
+    assert wg.size() == 8 == dist.get_world_size()
+    set_topology(Topology(TopologySpec()))
+
+
+def test_rooted_ledger_single_entry(topo8):
+    """reduce()/scatter() are ONE logical collective each: exactly one
+    ledger op per call (no double-count through an inner logged wrapper)."""
+    logger = dist.get_comms_logger()
+    logger.configure(enabled=True)
+    logger.reset()
+    mesh = topo8.mesh
+    axes = ("dp_outer", "ep")
+
+    @jax.jit
+    def f(x):
+        def body(x):
+            return (dist.reduce(x, axis=axes, dst=0),
+                    dist.scatter(jnp.tile(x, (8, 1)), axis=axes, src=0))
+
+        return shard_map(body, mesh=mesh, in_specs=P(axes),
+                         out_specs=(P(axes), P(axes)))(x)
+
+    f(jnp.ones((8, 4)))
+    ops = set(logger.comms_dict)
+    assert "reduce" in ops and "scatter" in ops
+    assert "all_reduce" not in ops and "broadcast" not in ops
+    logger.configure(enabled=False)
