@@ -1,0 +1,316 @@
+"""ZeRO parameter / gradient / optimizer-state access API.
+
+TPU re-design of the reference ``deepspeed/utils/tensor_fragment.py``
+(``safe_get_full_fp32_param`` etc., the surface RLHF/LoRA frameworks use to
+read and write training state that ZeRO has partitioned). The reference
+resolves flat-buffer fragment addresses per rank and allgathers them; here
+params are a sharded pytree, so "full" is one ``jax.device_get`` of a
+global array (orbax-style addressability) and "local" is one chip's shard.
+
+Addressing: the reference passes the ``torch.nn.Parameter`` object; a JAX
+pytree has no stable leaf identity, so leaves are addressed by **path** —
+``"blocks.attn.wq"`` (dots or slashes), with integer components indexing
+sequences. The engine argument is the ``DeepSpeedTPUEngine``.
+
+Availability contract (mirrors the reference):
+
+* params and optimizer state are always readable/writable;
+* gradients exist only inside an imperative ``backward()`` accumulation
+  window — the fused ``train_batch`` consumes its gradients inside one XLA
+  program, so ``safe_get_full_grad`` returns ``None`` there (the reference
+  likewise returns ``None`` + warns when no grad has been accumulated).
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .logging import logger
+
+__all__ = [
+    "safe_get_full_fp32_param", "safe_set_full_fp32_param",
+    "safe_get_full_grad", "safe_set_full_grad",
+    "safe_get_full_optimizer_state", "safe_set_full_optimizer_state",
+    "safe_get_local_fp32_param", "safe_get_local_grad",
+    "safe_get_local_optimizer_state", "safe_set_local_fp32_param",
+    "safe_set_local_grad", "safe_set_local_optimizer_state",
+]
+
+
+def _parts(path):
+    if isinstance(path, (list, tuple)):
+        return list(path)
+    return [p for p in str(path).replace("/", ".").split(".") if p]
+
+
+def _resolve(tree, path):
+    node = tree
+    for p in _parts(path):
+        if isinstance(node, (list, tuple)):
+            node = node[int(p)]
+        elif isinstance(node, dict):
+            if p not in node:
+                raise KeyError(
+                    f"path component {p!r} not found; available: "
+                    f"{sorted(node)[:12]}")
+            node = node[p]
+        else:
+            node = getattr(node, p)
+    return node
+
+
+def _replace(tree, path, value):
+    """Functional leaf replacement along a dict/sequence path."""
+    parts = _parts(path)
+    if not parts:
+        return value
+    head, rest = parts[0], parts[1:]
+    if isinstance(tree, dict):
+        new = dict(tree)
+        new[head] = _replace(tree[head], rest, value)
+        return new
+    if isinstance(tree, (list, tuple)):
+        i = int(head)
+        items = list(tree)
+        items[i] = _replace(items[i], rest, value)
+        return type(tree)(items) if isinstance(tree, tuple) else items
+    raise TypeError(f"cannot descend into {type(tree).__name__} at {head!r}")
+
+
+def _full_host_value(leaf) -> np.ndarray:
+    # always a WRITABLE COPY: device_get can hand back read-only zero-copy
+    # views, and get-then-mutate must never alias live training state
+    if jax.process_count() > 1 and not getattr(leaf, "is_fully_addressable", True):
+        from jax.experimental import multihost_utils
+
+        return np.array(multihost_utils.process_allgather(leaf, tiled=True))
+    return np.array(jax.device_get(leaf))
+
+
+def _local_shard(leaf, device_index: int = 0) -> np.ndarray:
+    """One chip's partition (reference 'local' = this rank's fragment;
+    rank == chip on TPU, and one process drives several chips)."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if not shards:
+        return np.asarray(leaf)
+    return np.asarray(shards[device_index].data)
+
+
+# -- params -----------------------------------------------------------------
+
+
+def safe_get_full_fp32_param(engine, path) -> np.ndarray:
+    """Full fp32 master value of a (possibly ZeRO-sharded) parameter
+    (reference ``tensor_fragment.py:214``)."""
+    if engine._host_adam is not None:  # masters live on host (ZeRO-Offload)
+        # copy, never a live alias of the master (the device path copies too)
+        return np.array(_resolve(engine._host_adam.master, path),
+                        dtype=np.float32)
+    return _full_host_value(_resolve(engine.state.params, path)).astype(
+        np.float32)
+
+
+def safe_set_full_fp32_param(engine, path, value) -> None:
+    """Write a full fp32 master value back under the existing sharding
+    (reference ``safe_set_full_fp32_param``). Under ZeRO-Offload both the
+    host master and the device compute copy are updated."""
+    old = _resolve(engine.state.params, path)
+    value = jnp.asarray(value)
+    if value.shape != old.shape:
+        raise ValueError(f"shape mismatch at {path}: {value.shape} vs {old.shape}")
+    if engine._host_adam is not None:
+        master = _resolve(engine._host_adam.master, path)
+        np.copyto(master, np.asarray(value, dtype=np.float32))
+    new_leaf = jax.device_put(value.astype(old.dtype), old.sharding)
+    engine.state = engine.state.replace(
+        params=_replace(engine.state.params, path, new_leaf))
+
+
+def safe_get_local_fp32_param(engine, path, device_index: int = 0):
+    if engine._host_adam is not None:
+        return safe_get_full_fp32_param(engine, path)
+    return _local_shard(_resolve(engine.state.params, path),
+                        device_index).astype(np.float32)
+
+
+def safe_set_local_fp32_param(engine, path, value, device_index: int = 0):
+    """Per-chip shard writes don't exist as an efficient primitive under
+    SPMD (a global array owns its layout); emulate by read-modify-write of
+    the full value — correctness over speed, like the reference's
+    narrow+copy under ZeRO-3."""
+    full = safe_get_full_fp32_param(engine, path)
+    leaf = _resolve(engine.state.params, path)
+    shards = getattr(leaf, "addressable_shards", None)
+    if not shards:
+        return safe_set_full_fp32_param(engine, path, value)
+    idx = shards[device_index].index
+    full[idx] = np.asarray(value, dtype=np.float32)
+    return safe_set_full_fp32_param(engine, path, full)
+
+
+# -- gradients --------------------------------------------------------------
+
+
+def _grad_denom(engine) -> float:
+    """The raw compat accumulator holds loss-scale-multiplied, gas-summed
+    grads (``engine.step`` divides by ``scale * gas`` before the optimizer);
+    get/set translate so callers always see TRUE gradient magnitudes —
+    the reference API contract."""
+    scale = 1.0
+    if engine.fp16:
+        scale = float(np.asarray(engine.state.loss_scale.scale))
+    return scale * engine.gas
+
+
+def safe_get_full_grad(engine, path) -> Optional[np.ndarray]:
+    """Accumulated gradient for a param in true (unscaled, gas-averaged)
+    magnitude, or ``None`` outside an imperative ``backward()`` window
+    (reference returns None + warns when the grad buffer does not exist)."""
+    if engine._compat_acc is None:
+        logger.warning(
+            "safe_get_full_grad: no accumulated gradients — the fused "
+            "train_batch consumes grads inside one XLA program; use the "
+            "backward()/step() path to inspect them")
+        return None
+    raw = _full_host_value(_resolve(engine._compat_acc, path))
+    return raw / _grad_denom(engine)
+
+
+def safe_set_full_grad(engine, path, value) -> None:
+    """Write a TRUE-magnitude gradient; it is re-scaled into the raw
+    accumulator so ``step()`` consumes exactly ``value``."""
+    if engine._compat_acc is None:
+        raise RuntimeError(
+            "safe_set_full_grad: no accumulated gradients to modify; call "
+            "backward() first (the fused train_batch path has no persistent "
+            "grad buffer)")
+    old = _resolve(engine._compat_acc, path)
+    scaled = jnp.asarray(value, dtype=old.dtype) * _grad_denom(engine)
+    new_leaf = jax.device_put(scaled, old.sharding)
+    engine._compat_acc = _replace(engine._compat_acc, path, new_leaf)
+
+
+def safe_get_local_grad(engine, path, device_index: int = 0):
+    full = safe_get_full_grad(engine, path)
+    if full is None:
+        return None
+    leaf = _resolve(engine._compat_acc, path)
+    shards = getattr(leaf, "addressable_shards", None)
+    return full[shards[device_index].index] if shards else full
+
+
+def safe_set_local_grad(engine, path, value, device_index: int = 0):
+    full = safe_get_full_grad(engine, path)
+    if full is None:
+        raise RuntimeError("safe_set_local_grad: no accumulated gradients")
+    leaf = _resolve(engine._compat_acc, path)
+    shards = getattr(leaf, "addressable_shards", None)
+    if shards:
+        full = np.array(full)  # never mutate a possibly read-only view
+        full[shards[device_index].index] = np.asarray(value)
+    else:
+        full = np.asarray(value)
+    safe_set_full_grad(engine, path, full)
+
+
+# -- optimizer state --------------------------------------------------------
+
+
+def _find_optim_subtree(opt_state, key: str):
+    """Locate the params-congruent moment tree named ``key`` (reference
+    state keys: exp_avg / exp_avg_sq; our ScaleByAdamState uses the same
+    names, optax chains/multi_transform may nest it)."""
+    found = []
+
+    def walk(node):
+        if hasattr(node, "_fields"):  # NamedTuple state
+            if key in node._fields:
+                found.append(getattr(node, key))
+            for f in node._fields:
+                walk(getattr(node, f))
+        elif isinstance(node, (list, tuple)):
+            for item in node:
+                walk(item)
+        elif isinstance(node, dict):
+            for item in node.values():
+                walk(item)
+
+    walk(opt_state)
+    return found[0] if found else None
+
+
+def safe_get_full_optimizer_state(engine, path, optim_state_key: str):
+    """Full value of one optimizer-state tensor, e.g. ``exp_avg`` /
+    ``exp_avg_sq`` (reference ``tensor_fragment.py:245``)."""
+    if engine._host_adam is not None:
+        tree = {"exp_avg": engine._host_adam.exp_avg,
+                "exp_avg_sq": engine._host_adam.exp_avg_sq}.get(optim_state_key)
+        if tree is None:
+            raise ValueError(f"unknown optimizer state key {optim_state_key!r}")
+        return np.array(_resolve(tree, path))  # copy, not a live alias
+    sub = _find_optim_subtree(engine.state.opt_state, optim_state_key)
+    if sub is None:
+        raise ValueError(
+            f"optimizer state has no {optim_state_key!r} tree (optimizer: "
+            f"{engine.config.optimizer.type})")
+    return _full_host_value(_resolve(sub, path))
+
+
+def safe_get_local_optimizer_state(engine, path, optim_state_key: str,
+                                   device_index: int = 0):
+    if engine._host_adam is not None:
+        return safe_get_full_optimizer_state(engine, path, optim_state_key)
+    sub = _find_optim_subtree(engine.state.opt_state, optim_state_key)
+    if sub is None:
+        raise ValueError(f"no {optim_state_key!r} in optimizer state")
+    return _local_shard(_resolve(sub, path), device_index)
+
+
+def safe_set_full_optimizer_state(engine, path, value, optim_state_key: str):
+    if engine._host_adam is not None:
+        tree = {"exp_avg": engine._host_adam.exp_avg,
+                "exp_avg_sq": engine._host_adam.exp_avg_sq}.get(optim_state_key)
+        if tree is None:
+            raise ValueError(f"unknown optimizer state key {optim_state_key!r}")
+        np.copyto(_resolve(tree, path), np.asarray(value, dtype=np.float32))
+        return
+    sub = _find_optim_subtree(engine.state.opt_state, optim_state_key)
+    if sub is None:
+        raise ValueError(f"no {optim_state_key!r} in optimizer state")
+    old = _resolve(sub, path)
+    new_leaf = jax.device_put(jnp.asarray(value, dtype=old.dtype), old.sharding)
+
+    def swap(node):
+        if hasattr(node, "_fields") and optim_state_key in node._fields:
+            return node._replace(**{optim_state_key: _replace(
+                getattr(node, optim_state_key), path, new_leaf)})
+        if hasattr(node, "_fields"):
+            return type(node)(*[swap(getattr(node, f)) for f in node._fields])
+        if isinstance(node, tuple):
+            return tuple(swap(x) for x in node)
+        if isinstance(node, list):
+            return [swap(x) for x in node]
+        if isinstance(node, dict):
+            return {k: swap(v) for k, v in node.items()}
+        return node
+
+    engine.state = engine.state.replace(opt_state=swap(engine.state.opt_state))
+
+
+def safe_set_local_optimizer_state(engine, path, value, optim_state_key: str,
+                                   device_index: int = 0):
+    if engine._host_adam is None:
+        sub = _find_optim_subtree(engine.state.opt_state, optim_state_key)
+        leaf = _resolve(sub, path)
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            # gather only on the sharded path — the full-value fetch is a
+            # device(+cross-host) transfer the other branches don't need
+            full = np.array(safe_get_full_optimizer_state(
+                engine, path, optim_state_key))
+            full[shards[device_index].index] = np.asarray(value)
+            return safe_set_full_optimizer_state(engine, path, full,
+                                                 optim_state_key)
+    return safe_set_full_optimizer_state(engine, path, value, optim_state_key)
