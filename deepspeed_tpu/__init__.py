@@ -9,13 +9,17 @@ profiler / monitor toolchain.
 """
 
 from . import comm
+from .accelerator import get_accelerator
 from .runtime import activation_checkpointing as checkpointing
+from .runtime import zero
 from .parallel.topology import Topology, TopologySpec, get_topology, set_topology
 from .runtime.config import DeepSpeedTPUConfig, load_config
 from .runtime.engine import DeepSpeedTPUEngine, TrainState, initialize
 from .version import __version__
 
 init_distributed = comm.init_distributed
+# reference name for the engine class (deepspeed/__init__.py:24)
+DeepSpeedEngine = DeepSpeedTPUEngine
 
 
 def init_inference(model=None, config=None, **kwargs):
@@ -23,3 +27,26 @@ def init_inference(model=None, config=None, **kwargs):
     from .inference.engine import InferenceEngine
 
     return InferenceEngine(model=model, config=config, **kwargs)
+
+
+def default_inference_config() -> dict:
+    """Reference ``deepspeed.default_inference_config``
+    (``deepspeed/__init__.py:284``)."""
+    import dataclasses
+
+    from .inference.config import DeepSpeedInferenceConfig
+
+    return dataclasses.asdict(DeepSpeedInferenceConfig())
+
+
+def add_config_arguments(parser):
+    """Attach the DeepSpeed CLI argument group (reference
+    ``deepspeed/__init__.py:268``): ``--deepspeed`` enable flag and
+    ``--deepspeed_config <json>``, so reference training scripts parse
+    unchanged."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag for user code)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="DeepSpeed json configuration file.")
+    return parser

@@ -1,0 +1,69 @@
+"""``deepspeed.zero`` surface (reference ``deepspeed/runtime/zero/__init__.py``):
+partitioning rules, memory estimators, ZeRO++ pieces, tiling, NVMe swapper.
+
+The reference's ``zero.Init`` context manager intercepts ``torch.nn`` module
+construction to shard parameters at creation. JAX construction is a pure
+function, so the analogue is the **init-closure form of
+``deepspeed.initialize``**: pass ``model_parameters=lambda: model.init(...)``
+and each leaf materializes directly into its ZeRO shard
+(``runtime/engine.py:316``, reference ``partition_parameters.py:816``).
+``Init`` below adapts reference-shaped code to that idiom.
+"""
+
+import contextlib
+
+from .memory_estimators import (estimate_zero2_model_states_mem_needs_all_live,
+                                estimate_zero3_model_states_mem_needs_all_live,
+                                estimate_zero_model_states_mem_needs)
+from .sharding import ZeroShardingRules, shard_param_spec
+from .swapper import AsyncTensorSwapper
+from .tiling import TiledLinear, tiled_matmul
+from .zeropp import (ZeroPPState, hierarchical_all_gather, hpz_remat_policy,
+                     zeropp_train_step_factory)
+
+__all__ = ["Init", "ZeroShardingRules", "shard_param_spec",
+           "estimate_zero_model_states_mem_needs",
+           "estimate_zero2_model_states_mem_needs_all_live",
+           "estimate_zero3_model_states_mem_needs_all_live",
+           "AsyncTensorSwapper", "TiledLinear", "tiled_matmul",
+           "ZeroPPState", "hierarchical_all_gather", "hpz_remat_policy",
+           "zeropp_train_step_factory"]
+
+
+class Init(contextlib.AbstractContextManager):
+    """Adapter for the reference ``with deepspeed.zero.Init(): model = M()``
+    idiom. JAX cannot intercept construction, so this wraps the init
+    CLOSURE instead::
+
+        params = zero.Init(lambda: model.init(key, dummy)["params"])
+        engine, *_ = deepspeed_tpu.initialize(model=loss_fn,
+                                              model_parameters=params, ...)
+
+    ``initialize`` recognizes the wrapper (it is itself the zero-arg
+    closure) and materializes every leaf directly into its ZeRO-3 shard —
+    no full-size copy ever exists on host or a single device. Entering it
+    as a context manager raises with this guidance, because silently
+    building the model unsharded would defeat the point.
+    """
+
+    def __init__(self, init_closure=None, config_dict_or_path=None, **_ignored):
+        if init_closure is not None and not callable(init_closure):
+            raise TypeError("zero.Init takes a zero-arg init closure, e.g. "
+                            "zero.Init(lambda: model.init(key, dummy)['params'])")
+        self._closure = init_closure
+
+    def __call__(self):
+        if self._closure is None:
+            raise ValueError("zero.Init was built without an init closure")
+        return self._closure()
+
+    def __enter__(self):
+        raise RuntimeError(
+            "JAX has no construction hook to intercept: instead of "
+            "`with zero.Init(): model = M()`, pass the init closure — "
+            "model_parameters=zero.Init(lambda: M().init(key, dummy)"
+            "['params']) or the bare lambda — to deepspeed_tpu.initialize; "
+            "leaves then materialize pre-sharded (engine.py:316)")
+
+    def __exit__(self, *exc):
+        return False

@@ -62,7 +62,9 @@ def _resolve(tree, path):
 
 
 def _replace(tree, path, value):
-    """Functional leaf replacement along a dict/sequence path."""
+    """Functional leaf replacement along a dict/sequence/NamedTuple path —
+    the write-side mirror of ``_resolve`` (which reads NamedTuples via
+    getattr, so writes must address them by field name too)."""
     parts = _parts(path)
     if not parts:
         return value
@@ -71,11 +73,14 @@ def _replace(tree, path, value):
         new = dict(tree)
         new[head] = _replace(tree[head], rest, value)
         return new
+    if hasattr(tree, "_fields"):  # NamedTuple node
+        return tree._replace(**{head: _replace(getattr(tree, head), rest,
+                                               value)})
     if isinstance(tree, (list, tuple)):
         i = int(head)
         items = list(tree)
         items[i] = _replace(items[i], rest, value)
-        return type(tree)(items) if isinstance(tree, tuple) else items
+        return tuple(items) if isinstance(tree, tuple) else items
     raise TypeError(f"cannot descend into {type(tree).__name__} at {head!r}")
 
 
@@ -91,11 +96,12 @@ def _full_host_value(leaf) -> np.ndarray:
 
 def _local_shard(leaf, device_index: int = 0) -> np.ndarray:
     """One chip's partition (reference 'local' = this rank's fragment;
-    rank == chip on TPU, and one process drives several chips)."""
+    rank == chip on TPU, and one process drives several chips). Always a
+    writable copy — same no-alias contract as ``_full_host_value``."""
     shards = getattr(leaf, "addressable_shards", None)
     if not shards:
-        return np.asarray(leaf)
-    return np.asarray(shards[device_index].data)
+        return np.array(leaf)
+    return np.array(shards[device_index].data)
 
 
 # -- params -----------------------------------------------------------------
@@ -126,6 +132,9 @@ def safe_set_full_fp32_param(engine, path, value) -> None:
     new_leaf = jax.device_put(value.astype(old.dtype), old.sharding)
     engine.state = engine.state.replace(
         params=_replace(engine.state.params, path, new_leaf))
+    # a forward() cached before this write holds grads/loss computed against
+    # the OLD params — drop it (same staleness rule as engine.step)
+    engine._compat_pending = None
 
 
 def safe_get_local_fp32_param(engine, path, device_index: int = 0):
@@ -187,9 +196,14 @@ def safe_set_full_grad(engine, path, value) -> None:
             "backward() first (the fused train_batch path has no persistent "
             "grad buffer)")
     old = _resolve(engine._compat_acc, path)
-    scaled = jnp.asarray(value, dtype=old.dtype) * _grad_denom(engine)
-    new_leaf = jax.device_put(scaled, old.sharding)
+    value = jnp.asarray(value, dtype=old.dtype)
+    if value.shape != old.shape:
+        raise ValueError(f"shape mismatch at {path}: {value.shape} vs {old.shape}")
+    new_leaf = jax.device_put(value * _grad_denom(engine), old.sharding)
     engine._compat_acc = _replace(engine._compat_acc, path, new_leaf)
+    # a cached forward() would re-commit its pre-write accumulator on the
+    # next backward(), overwriting this edit — invalidate it
+    engine._compat_pending = None
 
 
 def safe_get_local_grad(engine, path, device_index: int = 0):
@@ -274,16 +288,28 @@ def safe_set_full_optimizer_state(engine, path, value, optim_state_key: str):
                 "exp_avg_sq": engine._host_adam.exp_avg_sq}.get(optim_state_key)
         if tree is None:
             raise ValueError(f"unknown optimizer state key {optim_state_key!r}")
-        np.copyto(_resolve(tree, path), np.asarray(value, dtype=np.float32))
+        dst = _resolve(tree, path)
+        value = np.asarray(value, dtype=np.float32)
+        if value.shape != dst.shape:  # copyto would silently broadcast
+            raise ValueError(
+                f"shape mismatch at {path}: {value.shape} vs {dst.shape}")
+        np.copyto(dst, value)
         return
     sub = _find_optim_subtree(engine.state.opt_state, optim_state_key)
     if sub is None:
         raise ValueError(f"no {optim_state_key!r} in optimizer state")
     old = _resolve(sub, path)
-    new_leaf = jax.device_put(jnp.asarray(value, dtype=old.dtype), old.sharding)
+    value = jnp.asarray(value, dtype=old.dtype)
+    if value.shape != old.shape:
+        raise ValueError(f"shape mismatch at {path}: {value.shape} vs {old.shape}")
+    new_leaf = jax.device_put(value, old.sharding)
+    done = []  # write ONLY the first match — the same subtree the getter reads
 
     def swap(node):
+        if done:
+            return node
         if hasattr(node, "_fields") and optim_state_key in node._fields:
+            done.append(True)
             return node._replace(**{optim_state_key: _replace(
                 getattr(node, optim_state_key), path, new_leaf)})
         if hasattr(node, "_fields"):
@@ -303,6 +329,8 @@ def safe_set_local_optimizer_state(engine, path, value, optim_state_key: str,
                                    device_index: int = 0):
     if engine._host_adam is None:
         sub = _find_optim_subtree(engine.state.opt_state, optim_state_key)
+        if sub is None:
+            raise ValueError(f"no {optim_state_key!r} in optimizer state")
         leaf = _resolve(sub, path)
         shards = getattr(leaf, "addressable_shards", None)
         if shards:

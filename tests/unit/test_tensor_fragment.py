@@ -147,3 +147,34 @@ def test_grad_true_magnitude_under_gas():
     # reads are copies: mutating the returned array must not touch state
     g2[...] = 1e9
     assert np.abs(safe_get_full_grad(engine, "layer_0.w")).max() < 1e9
+
+
+def test_setters_invalidate_cached_forward():
+    """A forward() cached before a safe_set write holds pre-write grads;
+    the next backward() must not commit them over the edit."""
+    engine = _engine(zero_stage=0)
+    b1, b2 = random_batches(2, 8, hidden=64, seed=3)
+    with engine.no_sync():
+        engine.backward(batch=b1)            # acc = g1
+        engine.forward(b2)                   # caches (g1 + g2)
+        g1 = safe_get_full_grad(engine, "layer_0.w")
+        safe_set_full_grad(engine, "layer_0.w",
+                           np.zeros_like(g1))  # edit + invalidate cache
+        engine.backward(batch=b2)            # recompute: 0 + g2, NOT g1+g2
+        got = safe_get_full_grad(engine, "layer_0.w")
+    # isolate g2 with a fresh engine
+    probe = _engine(zero_stage=0)
+    with probe.no_sync():
+        probe.backward(batch=b2)
+        g2 = safe_get_full_grad(probe, "layer_0.w")
+    np.testing.assert_allclose(got, g2, rtol=1e-5, atol=1e-7)
+
+
+def test_set_shape_mismatch_raises():
+    engine = _engine(zero_stage=2)
+    engine.train_batch(random_batches(1, 8, hidden=64, seed=0)[0])
+    with pytest.raises(ValueError, match="shape mismatch"):
+        safe_set_full_optimizer_state(engine, "layer_0.w", np.zeros((2, 2)),
+                                      "exp_avg")
+    with pytest.raises(ValueError, match="shape mismatch"):
+        safe_set_full_fp32_param(engine, "layer_0.w", np.zeros((2, 2)))

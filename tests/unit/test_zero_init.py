@@ -116,3 +116,22 @@ def test_concrete_params_path_unchanged():
     engine, *_ = ds.initialize(model=_loss, model_parameters=fn(),
                                config=dict(BASE_CONFIG))
     assert engine.state.params["w0"].shape == (256, 256)
+
+
+def test_zero_init_wrapper_compat():
+    """``deepspeed.zero.Init`` adapter: wrapping the closure behaves exactly
+    like passing the bare closure (shard-at-creation engages), and the
+    reference context-manager form raises with migration guidance."""
+    set_topology(Topology(TopologySpec()))
+    fn, state = _init_fn()
+    engine, *_ = ds.initialize(model=_loss, model_parameters=ds.zero.Init(fn),
+                               config=dict(BASE_CONFIG))
+    assert not state["saw_concrete"]
+    leaf = engine.state.params["w0"]
+    assert int(np.prod(leaf.addressable_shards[0].data.shape)) \
+        == int(np.prod(leaf.shape)) // len(jax.devices())
+    with pytest.raises(RuntimeError, match="init closure"):
+        with ds.zero.Init():
+            pass
+    with pytest.raises(TypeError):
+        ds.zero.Init({"not": "callable"})
