@@ -1,0 +1,14 @@
+"""Compile integration (reference ``deepspeed/runtime/compiler.py``).
+
+The reference gates ``torch.compile`` support behind a version probe and
+wires a backend into the engine. Under XLA the engine's train step IS a
+compiled program — there is no opt-in. What remains useful from the
+reference surface is ahead-of-time compilation: ``engine.compile(batch)``
+lowers and compiles the train step eagerly so the first ``train_batch``
+doesn't pay the (20-40 s on TPU) JIT cost inside the training loop.
+"""
+
+
+def is_compile_supported() -> bool:
+    """Always true: jit is the execution model, not an optional backend."""
+    return True

@@ -155,6 +155,20 @@ class DeepSpeedTPUEngine:
         self.topo = topology or get_topology()
         set_topology(self.topo)
         config.finalize(world_dp_size=self.topo.dp_size)
+        if (optimizer is not None and callable(optimizer)
+                and not hasattr(optimizer, "update")):
+            # reference DeepSpeedOptimizerCallable (deepspeed/__init__.py:112):
+            # a client factory taking model parameters; here it must return
+            # an optax GradientTransformation. The factory sees the ABSTRACT
+            # tree (shapes/dtypes/structure) so the zero.Init closure form
+            # stays lazy — masked/multi_transform-style factories only need
+            # the structure anyway
+            optimizer = optimizer(_abstract_params(params))
+            if not hasattr(optimizer, "update"):
+                raise TypeError(
+                    "optimizer callable must return an optax "
+                    f"GradientTransformation, got {type(optimizer).__name__}")
+            log_dist("using client callable to create basic optimizer")
         self.loss_fn_raw = loss_fn
         self._loss_takes_rng = _accepts_rng(loss_fn)
         self._loss_takes_ltd = _accepts_kw(loss_fn, "ltd_keep")
@@ -602,6 +616,7 @@ class DeepSpeedTPUEngine:
         self._make_train_step = make_train_step
         self._train_steps = {None: make_train_step(None)}
         self._train_step = self._train_steps[None]
+        self._aot_step = None  # (executable, batch fingerprint) from compile()
         self._state_shardings = state_sh
         self._rng = jax.random.PRNGKey(config.seed)
 
@@ -650,6 +665,9 @@ class DeepSpeedTPUEngine:
         step_fn = self._train_steps.get(ltd_keep)
         if step_fn is None:
             step_fn = self._train_steps[ltd_keep] = self._make_train_step(ltd_keep)
+        if (ltd_keep is None and self._aot_step is not None
+                and self._aot_step[1] == self._batch_fingerprint(batch)):
+            step_fn = self._aot_step[0]  # AOT executable from compile()
         t0 = time.perf_counter()
         if self._host_adam is not None:
             metrics = self._host_offload_step(step_fn, batch, step_rng)
@@ -896,6 +914,41 @@ class DeepSpeedTPUEngine:
         self._compat_count = 0
         self._compat_pending = None  # see host-adam branch above
         self.global_steps += 1
+
+    def compile(self, example_batch=None, backend: str = "xla",
+                compile_kwargs=None):
+        """Ahead-of-time compile of the fused train step (reference
+        ``engine.compile``, ``runtime/engine.py:3696``; there the model is
+        re-wrapped in torch.compile — here jit is already the execution
+        model, so this EAGERLY lowers+compiles so the first ``train_batch``
+        pays no JIT cost inside the loop). ``backend``/``compile_kwargs``
+        are accepted for signature parity; only "xla" exists on TPU."""
+        if backend != "xla":
+            log_dist(f"compile backend {backend!r} ignored: XLA is the only "
+                     "execution model on TPU")
+        if example_batch is None:
+            return self  # nothing to shape the lowering with; lazy JIT stands
+        batch = self._shape_batch(example_batch)
+        rng = jax.random.PRNGKey(0)
+        # keep the executable and route matching train_batch calls through
+        # it — lower().compile() does NOT warm the jit dispatch cache, so
+        # discarding it would pay the 20-40s JIT twice
+        if self._host_adam is not None:
+            exe = self._train_step.lower(self.state.params, batch, rng,
+                                         self.state.step).compile()
+        else:
+            exe = self._train_step.lower(self.state, batch, rng).compile()
+        self._aot_step = (exe, self._batch_fingerprint(batch))
+        return self
+
+    @staticmethod
+    def _batch_fingerprint(batch):
+        return tuple((tuple(x.shape), jnp.dtype(x.dtype).name)
+                     for x in jax.tree.leaves(batch))
+
+    @property
+    def is_compiled(self) -> bool:
+        return True  # every executed step ran through XLA
 
     def zero_grad(self):
         """Discard accumulated compat-path micro-gradients (reference

@@ -1,0 +1,98 @@
+"""Parallel-group accessors (reference ``deepspeed/utils/groups.py``).
+
+The reference builds torch process groups per parallelism flavor
+(data/model/expert/sequence) and hands them to collectives. Here a "group"
+is a **named mesh-axis scope** of the live :class:`~deepspeed_tpu.parallel.
+Topology`: the accessor returns the axis name(s) — exactly what
+``deepspeed_tpu.comm`` collectives take as ``axis=`` — and the
+world-size/rank accessors read the same topology. ``initialize(ep_size=…)``
+re-carves the topology like the reference's expert-group setup.
+
+Rank accessors are **host-level**: inside a traced collective, use
+``comm.axis_index(axis)`` for the per-device index; a single host process
+drives all its chips, so "my rank along axis X" is only meaningful
+per-device under SPMD.
+"""
+
+from typing import Sequence, Tuple, Union
+
+from ..parallel.topology import Topology, TopologySpec, get_topology, set_topology
+
+Axis = Union[str, Tuple[str, ...]]
+
+
+def initialize(ep_size: int = 1, mpu=None) -> None:
+    """Reference ``groups.initialize``: carve the expert-parallel axis into
+    the current topology — every other spec field and the topology's device
+    set are preserved (a subset-device or explicit-dp topology must not be
+    silently widened to all of ``jax.devices()``)."""
+    import dataclasses
+
+    topo = get_topology()
+    set_topology(Topology(dataclasses.replace(topo.spec, ep=ep_size),
+                          devices=list(topo.mesh.devices.flat)))
+
+
+def _get_data_parallel_group() -> Axis:
+    return get_topology().dp_axes
+
+
+def _get_model_parallel_group() -> Axis:
+    return "tp"
+
+
+def _get_expert_parallel_group(group_name: str = "ep") -> Axis:
+    return "ep"
+
+
+def _get_expert_data_parallel_group(group_name: str = "ep") -> Axis:
+    # data-parallel *between* expert replicas: the dp axes minus ep
+    return "dp_outer"
+
+
+def _get_sequence_parallel_group() -> Axis:
+    return "sp"
+
+
+def _clone_world_group() -> Axis:
+    return get_topology().all_axes
+
+
+def _get_data_parallel_world_size() -> int:
+    return get_topology().dp_size
+
+
+def _get_model_parallel_world_size() -> int:
+    return get_topology().tp_size
+
+
+def _get_expert_parallel_world_size(group_name: str = "ep") -> int:
+    return get_topology().ep_size
+
+
+def _get_expert_data_parallel_world_size(group_name: str = "ep") -> int:
+    return get_topology().dp_outer_size
+
+
+def _get_sequence_parallel_world_size() -> int:
+    return get_topology().sp_size
+
+
+def _get_expert_parallel_ranks(world_size: int, mp_size: int, ep_size: int
+                               ) -> Tuple[Sequence, Sequence]:
+    """Rank layout math (reference ``groups.py:_get_expert_parallel_ranks``):
+    expert groups stride over model-parallel blocks, expert-data groups
+    stride over expert blocks. Pure arithmetic, kept for checkpoint tools
+    that reason about reference rank files."""
+    dp_size = world_size // mp_size
+    if dp_size % ep_size:
+        raise ValueError(f"dp world {dp_size} not divisible by ep {ep_size}")
+    expert_parallel_groups = []
+    expert_data_parallel_groups = []
+    for mp_rank in range(mp_size):
+        dp_ranks = list(range(mp_rank, world_size, mp_size))
+        for i in range(0, dp_size, ep_size):
+            expert_parallel_groups.append(dp_ranks[i:i + ep_size])
+        for i in range(ep_size):
+            expert_data_parallel_groups.append(dp_ranks[i::ep_size])
+    return expert_parallel_groups, expert_data_parallel_groups

@@ -319,3 +319,41 @@ def test_rooted_ledger_single_entry(topo8):
     assert "reduce" in ops and "scatter" in ops
     assert "all_reduce" not in ops and "broadcast" not in ops
     logger.configure(enabled=False)
+
+
+def test_groups_facade():
+    """deepspeed.utils.groups vocabulary: accessors return the mesh-axis
+    scope collectives take as axis=, and initialize(ep_size) re-carves the
+    topology like the reference expert-group setup."""
+    from deepspeed_tpu.parallel.topology import set_topology
+    from deepspeed_tpu.utils import groups
+
+    try:
+        set_topology(Topology(TopologySpec()))
+        groups.initialize(ep_size=4)
+        assert groups._get_expert_parallel_world_size() == 4
+        assert groups._get_data_parallel_world_size() == 8   # dp includes ep
+        assert groups._get_expert_data_parallel_world_size() == 2
+        assert groups._get_expert_parallel_group() == "ep"
+        # the returned scope IS a valid collective axis
+        t = Topology(TopologySpec(ep=4))
+        set_topology(t)
+
+        @jax.jit
+        def f(x):
+            def body(x):
+                return dist.all_reduce(
+                    x, axis=groups._get_expert_parallel_group())
+
+            return shard_map(body, mesh=t.mesh, in_specs=P(("dp_outer", "ep")),
+                             out_specs=P(("dp_outer", "ep")))(x)
+
+        out = np.asarray(f(jnp.arange(8.0).reshape(8, 1))).ravel()
+        # ep groups of 4 in each dp_outer block: [0..3] sum=6, [4..7] sum=22
+        np.testing.assert_allclose(out, [6, 6, 6, 6, 22, 22, 22, 22])
+        # reference rank-layout math
+        ep_g, edp_g = groups._get_expert_parallel_ranks(16, mp_size=2,
+                                                        ep_size=4)
+        assert ep_g[0] == [0, 2, 4, 6] and len(ep_g) == 4 and len(edp_g) == 8
+    finally:  # never leak an ep=4 topology into later tests
+        set_topology(Topology(TopologySpec()))

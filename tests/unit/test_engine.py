@@ -277,3 +277,44 @@ def test_frozen_params_not_updated(tmp_path):
     e2.load_checkpoint(str(tmp_path / "f"), tag="t")
     cont2 = [float(e2.train_batch(b)) for b in batches[3:]]
     np.testing.assert_allclose(cont1, cont2, rtol=1e-5, atol=1e-6)
+
+
+def test_optimizer_client_callable():
+    """Reference DeepSpeedOptimizerCallable: initialize(optimizer=factory)
+    where the factory takes model parameters and returns the optimizer —
+    must behave identically to passing the built optimizer."""
+    import optax
+
+    seen = {}
+
+    def factory(params):
+        seen["params"] = params
+        return optax.adam(1e-2)
+
+    direct = _make_engine(zero_stage=1, optimizer=optax.adam(1e-2))
+    viacall = _make_engine(zero_stage=1, optimizer=factory)
+    assert seen["params"] is not None
+    l1 = _train(direct, steps=3)
+    l2 = _train(viacall, steps=3)
+    np.testing.assert_allclose(l1, l2, rtol=1e-6)
+    with pytest.raises(TypeError, match="GradientTransformation"):
+        _make_engine(zero_stage=0, optimizer=lambda p: "not an optimizer")
+
+
+def test_aot_compile_and_compiler_probe():
+    """engine.compile(example_batch) pre-lowers the train step so the first
+    train_batch pays no JIT cost; is_compile_supported is always true (jit
+    IS the execution model)."""
+    from deepspeed_tpu.runtime.compiler import is_compile_supported
+
+    assert is_compile_supported()
+    engine = _make_engine(zero_stage=2)
+    batch = random_batches(1, 8, HIDDEN, seed=5)[0]
+    assert engine.compile(batch) is engine and engine.is_compiled
+    assert engine._aot_step is not None
+    # the AOT executable (not a fresh jit trace) serves matching batches
+    _, fp = engine._aot_step
+    assert fp == engine._batch_fingerprint(engine._shape_batch(batch))
+    losses = _train(engine, steps=3)
+    assert losses[-1] < losses[0]
+    assert engine.compile() is engine  # no batch: lazy JIT stands
