@@ -1,0 +1,78 @@
+"""RLHF-style loop: hybrid engine generation + tensor-fragment state surgery.
+
+The pattern RLHF frameworks build on the reference (DeepSpeed-Chat actor
+step): generate rollouts from the LIVE training weights, score them, train,
+and reach into ZeRO-partitioned state with the ``safe_get/set_*`` API —
+here freezing a value-head bias mid-run and inspecting Adam moments, all
+through the sharding. Demo-sized so it runs on the CPU mesh; on TPU the
+same script scales the config.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from examples import _bootstrap  # noqa: E402,F401  (JAX platform handling)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.transformer import TransformerLM, init_params, llama_config
+from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+from deepspeed_tpu.utils import (safe_get_full_fp32_param,
+                                 safe_get_full_optimizer_state,
+                                 safe_set_full_fp32_param)
+
+ON_TPU = jax.devices()[0].platform == "tpu"
+
+DS_CONFIG = {
+    "train_micro_batch_size_per_gpu": 4,
+    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+    "zero_optimization": {"stage": 3},
+    "bf16": {"enabled": ON_TPU},
+    "gradient_clipping": 1.0,
+    "steps_per_print": 1000,
+}
+
+
+def main():
+    cfg = llama_config("tiny", vocab_size=256, max_seq_len=64,
+                       dtype=jnp.bfloat16 if ON_TPU else jnp.float32)
+    model = TransformerLM(cfg)
+    params = init_params(model, seq=64)
+    engine = DeepSpeedHybridEngine(model, params, DS_CONFIG)
+    rng = np.random.default_rng(0)
+
+    for rlhf_step in range(3):
+        # 1. rollout: generate from the live (ZeRO-sharded) weights
+        engine.eval()
+        prompts = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 8)), jnp.int32)
+        rollouts = engine.generate(prompts, max_new_tokens=8)
+
+        # 2. "reward" + train on the rollouts (stand-in for the PPO update)
+        engine.train()
+        batch = {"tokens": np.asarray(rollouts)}
+        loss = engine.train_batch(batch)
+        print(f"step {rlhf_step}: loss {float(loss):.4f}")
+
+    # 3. state surgery through ZeRO-3 sharding: read a full param, edit it,
+    #    and check the optimizer moments — the safe_* API sees through the
+    #    partitioning on every tier (device ZeRO or host-Adam offload)
+    path = "layer_0.attn.q_proj.kernel"
+    w = safe_get_full_fp32_param(engine, path)
+    m = safe_get_full_optimizer_state(engine, path, "exp_avg")
+    print(f"{path}: {w.shape}, |exp_avg| max {np.abs(m).max():.2e}")
+    safe_set_full_fp32_param(engine, path, w * 0.999)  # e.g. a KL anchor nudge
+    after = safe_get_full_fp32_param(engine, path)
+    np.testing.assert_allclose(after, w * 0.999, rtol=1e-6)
+    print("surgical write landed in the live sharded state")
+
+    engine.train()
+    print(f"final loss {float(engine.train_batch(batch)):.4f} "
+          f"(trains from the edited weights)")
+
+
+if __name__ == "__main__":
+    main()
