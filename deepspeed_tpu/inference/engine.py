@@ -86,6 +86,7 @@ class InferenceEngine:
             self.model = TransformerLM(cfg)
         self.cfg = cfg
 
+        self.module = self.model  # reference InferenceEngine attribute name
         tp = self.config.tensor_parallel.tp_size if self.config.tensor_parallel.enabled else 1
         self.topo = topology or Topology(TopologySpec(tp=tp))
         mesh = self.topo.mesh

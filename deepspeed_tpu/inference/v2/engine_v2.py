@@ -60,6 +60,7 @@ class InferenceEngineV2:
                  config: Optional[RaggedInferenceEngineConfig] = None):
         self.config = config or RaggedInferenceEngineConfig()
         c = self.config
+        self.model = model  # reference engine_v2 `model` property
         self.cfg: TransformerConfig = model.cfg
         # families whose attention needs logit bias/masking beyond plain
         # causal (ALiBi bloom/mpt, unscaled gpt-neo, windowed gpt-neo local
@@ -114,6 +115,20 @@ class InferenceEngineV2:
     # ------------------------------------------------------------------
     # admission (reference put/query/can_schedule, engine_v2.py:107,158,184)
     # ------------------------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        """Unallocated KV pages (reference ``engine_v2.free_blocks``)."""
+        return self.kv.free_blocks
+
+    def get_remaining_block_capacity(self, uid: int) -> int:
+        """Tokens a sequence can still append before needing a new page
+        (reference ``engine_v2.get_remaining_block_capacity``)."""
+        seq = self.state_manager.get(uid)
+        if seq is None:
+            return 0
+        bs = self.config.kv_block_size
+        return (-seq.seen_tokens) % bs
+
     def put(self, uids: Sequence[int], tokens_list: Sequence[np.ndarray],
             max_new_tokens: int = 256, eos_token_id: Optional[int] = None):
         """Admit new sequences (prompts are scheduled incrementally)."""

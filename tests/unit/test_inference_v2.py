@@ -537,3 +537,41 @@ def test_v2_hf_family_breadth_matches_v1(family):
     for i, o in enumerate(outs):
         np.testing.assert_array_equal(o, np.asarray(ref)[i],
                                       err_msg=f"{family} seq {i}")
+
+
+def test_reference_surface_properties():
+    """Reference engine_v2 vocabulary: free_blocks, model,
+    get_remaining_block_capacity; v1 exposes .module."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models.transformer import (TransformerLM, init_params,
+                                                  llama_config)
+
+    cfg = llama_config("7b", num_layers=1, hidden_size=64,
+                       intermediate_size=128, num_heads=4, num_kv_heads=2,
+                       vocab_size=128, max_seq_len=64, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    params = init_params(model, batch=1, seq=16)
+    eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        token_budget=16, max_ragged_sequence_count=2, max_chunk_size=16,
+        num_kv_blocks=8, kv_block_size=16, max_blocks_per_seq=4,
+        dtype="float32"))
+    assert eng.model is model
+    total = eng.free_blocks
+    assert total > 0
+    eng.put([7], [np.arange(10, dtype=np.int32)], max_new_tokens=4)
+    while any(s.in_prefill for s in eng.state_manager.all()):
+        eng.step()
+    # 10 tokens cached in 16-token pages: 6 slots left in the open page
+    assert eng.get_remaining_block_capacity(7) == 6
+    assert eng.get_remaining_block_capacity(999) == 0  # unknown uid
+    assert eng.free_blocks < total  # pages actually allocated
+
+    v1 = InferenceEngine(model, params,
+                         DeepSpeedInferenceConfig(dtype="float32",
+                                                  max_out_tokens=32))
+    assert v1.module is v1.model
