@@ -42,6 +42,16 @@ class BF16Config(ConfigModel):
     # keep fp32 master weights + fp32 grad accumulation (reference bf16_optimizer.py:34)
     master_weights: bool = True
 
+    # loss-scaling keys copied from an fp16 section are meaningless under
+    # bf16 (fp32 exponent range — no overflow to scale around); the
+    # reference tolerates them in configs (tests/torch_compile/ds_config),
+    # so accept-and-drop rather than reject
+    _DEPRECATED_KEYS = {k: None for k in
+                        ("loss_scale", "initial_scale_power",
+                         "loss_scale_window", "hysteresis",
+                         "min_loss_scale", "consecutive_hysteresis",
+                         "fp16_master_weights_and_grads", "auto_cast")}
+
 
 # ---------------------------------------------------------------------------
 # Optimizer / scheduler
@@ -127,6 +137,27 @@ class ZeroConfig(ConfigModel):
     stage3_param_persistence_threshold: int = 100_000
     stage3_gather_16bit_weights_on_model_save: bool = False
     stage3_module_granularity_threshold: int = 0
+
+    @classmethod
+    def _migrate_legacy(cls, d):
+        # pre-0.3.16 vocabulary (reference deprecated it the same way:
+        # runtime/zero/config.py read_zero_config_deprecated)
+        if d.pop("cpu_offload", False):
+            off = dict(d.get("offload_optimizer") or {})
+            off.setdefault("device", "cpu")
+            d["offload_optimizer"] = off
+        if d.pop("cpu_offload_params", False):
+            offp = dict(d.get("offload_param") or {})
+            offp.setdefault("device", "cpu")
+            d["offload_param"] = offp
+        pin = d.pop("cpu_offload_use_pin_memory", None)
+        if pin is not None:
+            for key in ("offload_optimizer", "offload_param"):
+                if key in d:
+                    node = dict(d[key])
+                    node.setdefault("pin_memory", bool(pin))
+                    d[key] = node
+        return d
     # ZeRO++ (hpZ secondary shard / quantized weights / quantized gradients).
     # hpZ's no-second-gather guarantee is realized as a remat policy in the
     # explicit path: zeropp_train_step_factory(remat="hpz") saves gathered
@@ -359,6 +390,77 @@ class AIOConfig(ConfigModel):
 
 @register_config
 @dataclass
+class EigenvalueConfig(ConfigModel):
+    """Hessian power-iteration knobs for MoQ (reference ``eigenvalue``
+    section, ``runtime/constants.py:340``); consumed by
+    ``runtime/eigenvalue.Eigenvalue.from_config``."""
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = "bert.encoder.layer"
+    layer_num: int = 0
+    model_name: Optional[str] = None  # appears in reference test configs
+
+
+@register_config
+@dataclass
+class QuantizeBitsConfig(ConfigModel):
+    start_bits: int = 16
+    target_bits: int = 8
+
+
+@register_config
+@dataclass
+class QuantizeScheduleConfig(ConfigModel):
+    quantize_period: int = 1000
+    schedule_offset: int = 1000
+
+
+@register_config
+@dataclass
+class FP16MixedQuantizeConfig(ConfigModel):
+    enabled: bool = False
+    quantize_change_ratio: float = 0.001
+
+
+@register_config
+@dataclass
+class QuantizeTrainingConfig(ConfigModel):
+    """MoQ vocabulary (reference ``quantize_training`` section,
+    ``runtime/config.py:567``); ``runtime/quantize.MoQQuantizer.from_config``
+    builds the annealing quantizer from it."""
+    enabled: bool = True  # presence of the section implies it in the reference
+    quantize_bits: QuantizeBitsConfig = field(default_factory=QuantizeBitsConfig)
+    quantize_type: str = "symmetric"
+    quantize_schedule: QuantizeScheduleConfig = field(
+        default_factory=QuantizeScheduleConfig)
+    quantize_groups: int = 1
+    fp16_mixed_quantize: FP16MixedQuantizeConfig = field(
+        default_factory=FP16MixedQuantizeConfig)
+    quantize_verbose: bool = False
+    quantize_eigenvalue: bool = False
+    quantize_algo: Optional[Dict[str, Any]] = None
+    rounding: str = "nearest"
+
+
+@register_config
+@dataclass
+class HybridEngineConfig(ConfigModel):
+    """RLHF train/generate engine knobs (reference ``hybrid_engine``
+    section, ``runtime/config.py:544``)."""
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
+@register_config
+@dataclass
 class DeepSpeedTPUConfig(ConfigModel):
     """Root config (reference ``DeepSpeedConfig``, ``runtime/config.py:706``)."""
 
@@ -407,6 +509,9 @@ class DeepSpeedTPUConfig(ConfigModel):
     autotuning: AutotuningConfig = field(default_factory=AutotuningConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)
+    eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
+    quantize_training: Optional[QuantizeTrainingConfig] = None
+    hybrid_engine: HybridEngineConfig = field(default_factory=HybridEngineConfig)
 
     # free-form escape hatch for experiments
     extra: Dict[str, Any] = field(default_factory=dict)

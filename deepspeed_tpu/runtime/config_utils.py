@@ -34,6 +34,13 @@ class ConfigModel:
         return {f.name for f in dataclasses.fields(cls) if not f.name.startswith("_")}
 
     @classmethod
+    def _migrate_legacy(cls, d: Dict[str, Any]) -> Dict[str, Any]:
+        """Hook for structural legacy-key rewrites that a flat old->new
+        rename cannot express (e.g. ``cpu_offload: true`` becoming a nested
+        ``offload_optimizer`` node). Default: identity."""
+        return d
+
+    @classmethod
     def from_dict(cls: Type[T], d: Optional[Mapping[str, Any]], path: str = "") -> T:
         if d is None:
             d = {}
@@ -47,6 +54,7 @@ class ConfigModel:
                     d[new] = d.pop(old)
                 else:
                     d.pop(old)
+        d = cls._migrate_legacy(d)
         names = cls.field_names()
         unknown = set(d) - names
         if unknown:

@@ -20,6 +20,12 @@ def hvp(loss_fn: Callable, params, batch, vec):
 
 
 class Eigenvalue:
+    @classmethod
+    def from_config(cls, ec) -> "Eigenvalue":
+        """Build from an ``eigenvalue`` config node (reference section
+        vocabulary, ``runtime/constants.py:340``)."""
+        return cls(max_iter=ec.max_iter, tol=ec.tol, stability=ec.stability)
+
     def __init__(self, max_iter: int = 20, tol: float = 1e-2,
                  stability: float = 1e-6, seed: int = 0):
         self.max_iter = max_iter

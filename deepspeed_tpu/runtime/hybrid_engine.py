@@ -46,7 +46,6 @@ class DeepSpeedHybridEngine(DeepSpeedTPUEngine):
                  inference_config: Optional[DeepSpeedInferenceConfig] = None,
                  lora_config=None, lora_fused_generate: bool = False, **kw):
         self._model = model
-        self._inference_config = inference_config or DeepSpeedInferenceConfig()
         self._lora_fused = lora_fused_generate
         self._lora_config = lora_config
         if lora_fused_generate and lora_config is None:
@@ -58,8 +57,19 @@ class DeepSpeedHybridEngine(DeepSpeedTPUEngine):
         self.generate_count = 0
         from .config import load_config
 
+        cfg = load_config(config)
+        if inference_config is None:
+            # the reference hybrid_engine JSON section shapes the default
+            # inference view (runtime/config.py:544)
+            he = cfg.hybrid_engine
+            inference_config = DeepSpeedInferenceConfig(
+                max_out_tokens=he.max_out_tokens)
+            if he.inference_tp_size > 1:
+                inference_config.tensor_parallel.enabled = True
+                inference_config.tensor_parallel.tp_size = he.inference_tp_size
+        self._inference_config = inference_config
         super().__init__(loss_fn=loss_fn or lm_loss_fn(model), params=params,
-                         config=load_config(config), **kw)
+                         config=cfg, **kw)
 
     # mode flips (reference eval:376 / train:418) -----------------------
     def train(self, mode: bool = True):

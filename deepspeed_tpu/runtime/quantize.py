@@ -17,24 +17,39 @@ from ..utils.logging import logger
 
 
 class MoQQuantizer:
+    @classmethod
+    def from_config(cls, qt) -> "MoQQuantizer":
+        """Build from a ``quantize_training`` config node (the reference MoQ
+        JSON vocabulary, ``runtime/config.py:567``)."""
+        return cls(q_type=qt.quantize_type,
+                   start_bits=qt.quantize_bits.start_bits,
+                   target_bits=qt.quantize_bits.target_bits,
+                   quantize_period=qt.quantize_schedule.quantize_period,
+                   schedule_offset=qt.quantize_schedule.schedule_offset,
+                   quantize_groups=qt.quantize_groups)
+
     def __init__(self, q_type: str = "symmetric", start_bits: int = 16,
                  target_bits: int = 8, quantize_period: int = 100,
-                 quantize_groups: int = 1, eigenvalue_scale: Optional[Dict[str, float]] = None):
+                 quantize_groups: int = 1, eigenvalue_scale: Optional[Dict[str, float]] = None,
+                 schedule_offset: int = 0):
         self.symmetric = q_type == "symmetric"
         self.start_bits = start_bits
         self.target_bits = target_bits
         self.period = quantize_period
+        self.offset = schedule_offset  # steps at full precision before annealing
         self.groups = quantize_groups
         # larger eigenvalue -> longer effective period (quantize later)
         self.eigenvalue_scale = eigenvalue_scale or {}
         self.current_bits = start_bits
 
     def bits_at(self, step: int, key: str = "") -> int:
+        if step < self.offset:  # reference schedule_offset warmup
+            return self.start_bits
         period = self.period
         scale = self.eigenvalue_scale.get(key)
         if scale is not None:
             period = int(period * max(1.0, scale))
-        bits, s = self.start_bits, step
+        bits, s = self.start_bits, step - self.offset
         while bits > self.target_bits and s >= period:
             bits = max(self.target_bits, bits // 2)
             s -= period
