@@ -360,6 +360,9 @@ class AutotuningConfig(ConfigModel):
     tuner_type: str = "gridsearch"
     tuner_early_stopping: int = 5
     tuner_num_trials: int = 50
+    # launcher-arg rewrites per tuned knob (reference autotuning docs);
+    # consumed by the autotuner CLI when re-launching trials
+    arg_mappings: Optional[Dict[str, str]] = None
 
 
 @register_config
@@ -448,6 +451,17 @@ class QuantizeTrainingConfig(ConfigModel):
 
 @register_config
 @dataclass
+class ProgressiveLayerDropConfig(ConfigModel):
+    """PLD knobs (reference top-level ``progressive_layer_drop`` section,
+    ``runtime/config.py`` PLD group); consumed by
+    ``runtime/progressive_layer_drop.ProgressiveLayerDrop.from_config``."""
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
+@register_config
+@dataclass
 class HybridEngineConfig(ConfigModel):
     """RLHF train/generate engine knobs (reference ``hybrid_engine``
     section, ``runtime/config.py:544``)."""
@@ -512,6 +526,26 @@ class DeepSpeedTPUConfig(ConfigModel):
     eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
     quantize_training: Optional[QuantizeTrainingConfig] = None
     hybrid_engine: HybridEngineConfig = field(default_factory=HybridEngineConfig)
+    progressive_layer_drop: ProgressiveLayerDropConfig = field(
+        default_factory=ProgressiveLayerDropConfig)
+
+    @classmethod
+    def _migrate_legacy(cls, d):
+        # legacy top-level curriculum_learning (reference
+        # curriculum_enabled_legacy, docs/_tutorials/curriculum-learning.md)
+        # is the same scheduler the data_efficiency form configures — move
+        # it to the modern location the engine reads
+        cl = d.pop("curriculum_learning", None)
+        if cl:
+            de = dict(d.get("data_efficiency") or {})
+            ds = dict(de.get("data_sampling") or {})
+            ds.setdefault("curriculum_learning", dict(cl))
+            de["data_sampling"] = ds
+            # the reference legacy default is disabled; only an explicit
+            # "enabled": true switches the scheduler on
+            de.setdefault("enabled", bool(cl.get("enabled", False)))
+            d["data_efficiency"] = de
+        return d
 
     # free-form escape hatch for experiments
     extra: Dict[str, Any] = field(default_factory=dict)

@@ -315,6 +315,21 @@ class DeepSpeedTPUEngine:
                         "'ltd_keep' kwarg — token dropping will NOT be applied. "
                         "Accept ltd_keep (tokens to keep per layer) and wrap layers "
                         "with data_pipeline.random_ltd_apply.")
+        # MoQ (reference quantize_training section): fake-quantize weights in
+        # the forward at the scheduler's current bit-width; each distinct
+        # width is one compiled program (bounded by the bit halvings)
+        self.moq = None
+        qt = config.quantize_training
+        if qt is not None and qt.enabled:
+            from .quantize import MoQQuantizer
+
+            self.moq = MoQQuantizer.from_config(qt)
+        if config.progressive_layer_drop.enabled:
+            logger.warning(
+                "progressive_layer_drop is enabled in the config, but layer "
+                "drop needs model cooperation (as in the reference): build "
+                "the schedule with ProgressiveLayerDrop.from_config and gate "
+                "layers with progressive_layer_drop.pld_apply in the loss fn")
         log_dist(f"engine initialized: {self.topo}, zero_stage={zc.stage}, "
                  f"gas={self.gas}, micro_bs={self.micro_batch_size}, "
                  f"dtype={jnp.dtype(self.compute_dtype).name}")
@@ -422,10 +437,14 @@ class DeepSpeedTPUEngine:
         self.grad_spec_tree = self.rules.grad_spec_tree(self.state.params, self.param_specs_base)
 
     # ------------------------------------------------------------------
-    def _loss(self, params, batch, rng, ltd_keep=None):
+    def _loss(self, params, batch, rng, ltd_keep=None, moq_bits=None):
         p = jax.tree.map(
             lambda x: x.astype(self.compute_dtype)
             if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        if moq_bits is not None and moq_bits < 16:
+            # MoQ fake-quantize at the schedule's current width (static under
+            # jit; the step cache keys on it)
+            p = self.moq.quantize(p, step=0, training=True, bits=moq_bits)
         kw = {}
         if ltd_keep is not None and self._loss_takes_ltd:
             kw["ltd_keep"] = ltd_keep
@@ -474,7 +493,8 @@ class DeepSpeedTPUEngine:
             # knob is accepted but has no additional effect.
             log_dist("prescale_gradients is subsumed by SPMD mean-reduction; ignoring")
 
-        def train_step(state: TrainState, batch, rng, *, ltd_keep=None):
+        def train_step(state: TrainState, batch, rng, *, ltd_keep=None,
+                       moq_bits=None):
             scale = state.loss_scale.scale if fp16 else jnp.asarray(1.0, jnp.float32)
 
             def micro(carry, xs):
@@ -482,7 +502,8 @@ class DeepSpeedTPUEngine:
                 mb, mb_rng = xs
 
                 def scaled_loss(p):
-                    loss, aux = self._loss(p, mb, mb_rng, ltd_keep=ltd_keep)
+                    loss, aux = self._loss(p, mb, mb_rng, ltd_keep=ltd_keep,
+                                           moq_bits=moq_bits)
                     return loss * scale, loss
 
                 grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params)
@@ -546,7 +567,8 @@ class DeepSpeedTPUEngine:
             }
             return new_state, metrics
 
-        def grad_step(params, batch, rng, step, *, ltd_keep=None):
+        def grad_step(params, batch, rng, step, *, ltd_keep=None,
+                      moq_bits=None):
             # ZeRO-Offload device half: grads + metrics only; the optimizer
             # update happens on host (engine._host_adam). fp16 loss scaling
             # is rejected at init in this mode (bf16/fp32 only), so the
@@ -555,7 +577,8 @@ class DeepSpeedTPUEngine:
                 acc = carry
                 mb, mb_rng = xs
                 loss, grads = jax.value_and_grad(
-                    lambda p: self._loss(p, mb, mb_rng, ltd_keep=ltd_keep)[0]
+                    lambda p: self._loss(p, mb, mb_rng, ltd_keep=ltd_keep,
+                                         moq_bits=moq_bits)[0]
                 )(params)
                 grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
                 grads = jax.lax.with_sharding_constraint(
@@ -599,23 +622,24 @@ class DeepSpeedTPUEngine:
                                    self.grad_spec_tree,
                                    is_leaf=lambda x: isinstance(x, P))
 
-            def make_train_step(ltd_keep):
-                return jax.jit(partial(grad_step, ltd_keep=ltd_keep),
+            def make_train_step(ltd_keep, moq_bits=None):
+                return jax.jit(partial(grad_step, ltd_keep=ltd_keep,
+                                       moq_bits=moq_bits),
                                in_shardings=(self._param_shardings, None, None, None),
                                out_shardings=(grad_sh, None))
         else:
-            def make_train_step(ltd_keep):
-                # one compiled program per random-LTD stage (the scheduler's
-                # step_size quantization bounds how many exist)
+            def make_train_step(ltd_keep, moq_bits=None):
+                # one compiled program per (random-LTD stage, MoQ bit-width)
+                # pair — both schedules quantize their steps, bounding the set
                 return jax.jit(
-                    partial(train_step, ltd_keep=ltd_keep),
+                    partial(train_step, ltd_keep=ltd_keep, moq_bits=moq_bits),
                     in_shardings=(state_sh, None, None),
                     out_shardings=(state_sh, None),
                     donate_argnums=(0,) if donate_state else ())
 
         self._make_train_step = make_train_step
-        self._train_steps = {None: make_train_step(None)}
-        self._train_step = self._train_steps[None]
+        self._train_steps = {(None, None): make_train_step(None)}
+        self._train_step = self._train_steps[(None, None)]
         self._aot_step = None  # (executable, batch fingerprint) from compile()
         self._state_shardings = state_sh
         self._rng = jax.random.PRNGKey(config.seed)
@@ -662,10 +686,15 @@ class DeepSpeedTPUEngine:
             ltd_keep = self.random_ltd_scheduler.update(self.global_steps)
         self._last_batch = batch  # reference only; sliced lazily by flops_profile
         self._rng, step_rng = jax.random.split(self._rng)
-        step_fn = self._train_steps.get(ltd_keep)
+        moq_bits = self.moq.update(self.global_steps) if self.moq else None
+        if moq_bits is not None and moq_bits >= 16:
+            moq_bits = None  # schedule_offset warmup: unquantized program
+        key = (ltd_keep, moq_bits)
+        step_fn = self._train_steps.get(key)
         if step_fn is None:
-            step_fn = self._train_steps[ltd_keep] = self._make_train_step(ltd_keep)
-        if (ltd_keep is None and self._aot_step is not None
+            step_fn = self._train_steps[key] = self._make_train_step(
+                ltd_keep, moq_bits)
+        if (key == (None, None) and self._aot_step is not None
                 and self._aot_step[1] == self._batch_fingerprint(batch)):
             step_fn = self._aot_step[0]  # AOT executable from compile()
         t0 = time.perf_counter()

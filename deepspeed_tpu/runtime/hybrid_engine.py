@@ -59,14 +59,15 @@ class DeepSpeedHybridEngine(DeepSpeedTPUEngine):
 
         cfg = load_config(config)
         if inference_config is None:
-            # the reference hybrid_engine JSON section shapes the default
-            # inference view (runtime/config.py:544)
+            inference_config = DeepSpeedInferenceConfig()
             he = cfg.hybrid_engine
-            inference_config = DeepSpeedInferenceConfig(
-                max_out_tokens=he.max_out_tokens)
-            if he.inference_tp_size > 1:
-                inference_config.tensor_parallel.enabled = True
-                inference_config.tensor_parallel.tp_size = he.inference_tp_size
+            if he.enabled:
+                # the reference hybrid_engine JSON section shapes the default
+                # inference view (runtime/config.py:544) — only when enabled
+                inference_config.max_out_tokens = he.max_out_tokens
+                if he.inference_tp_size > 1:
+                    inference_config.tensor_parallel.enabled = True
+                    inference_config.tensor_parallel.tp_size = he.inference_tp_size
         self._inference_config = inference_config
         super().__init__(loss_fn=loss_fn or lm_loss_fn(model), params=params,
                          config=cfg, **kw)

@@ -16,6 +16,11 @@ import jax.numpy as jnp
 
 
 class ProgressiveLayerDrop:
+    @classmethod
+    def from_config(cls, pld) -> "ProgressiveLayerDrop":
+        """Build from the top-level ``progressive_layer_drop`` config node."""
+        return cls(theta=pld.theta, gamma=pld.gamma)
+
     def __init__(self, theta: float = 0.5, gamma: float = 0.001):
         self.theta = theta
         self.gamma = gamma

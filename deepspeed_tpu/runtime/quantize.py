@@ -43,8 +43,11 @@ class MoQQuantizer:
         self.current_bits = start_bits
 
     def bits_at(self, step: int, key: str = "") -> int:
-        if step < self.offset:  # reference schedule_offset warmup
-            return self.start_bits
+        if step < self.offset:
+            # reference schedule_offset warmup: NO quantization at all before
+            # the offset (quantize() skips bits >= 16), even when start_bits
+            # is already narrow
+            return 16
         period = self.period
         scale = self.eigenvalue_scale.get(key)
         if scale is not None:
@@ -59,17 +62,20 @@ class MoQQuantizer:
         self.current_bits = self.bits_at(step)
         return self.current_bits
 
-    def quantize(self, params, step: int, training: bool = True):
-        """Fake-quantize every >=2-D floating leaf at its scheduled bits."""
+    def quantize(self, params, step: int, training: bool = True,
+                 bits: Optional[int] = None):
+        """Fake-quantize every >=2-D floating leaf at its scheduled bits;
+        ``bits`` overrides the schedule (the engine passes the precomputed
+        width so the compiled step stays static)."""
         flat, treedef = jax.tree_util.tree_flatten_with_path(params)
         out = []
         for kp, leaf in flat:
             key = "/".join(str(getattr(e, "key", getattr(e, "name", e))) for e in kp)
             if hasattr(leaf, "ndim") and leaf.ndim >= 2 and \
                     jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
-                bits = self.bits_at(step, key)
-                if bits < 16:
-                    leaf = quantize_weight(leaf, bits, self.groups,
+                b = self.bits_at(step, key) if bits is None else bits
+                if b < 16:
+                    leaf = quantize_weight(leaf, b, self.groups,
                                            self.symmetric, training)
             out.append(leaf)
         return jax.tree_util.tree_unflatten(treedef, out)

@@ -132,7 +132,7 @@ def test_bf16_grad_transport_tracks_fp32():
     eng16, loss16 = _train(cfg16)
     assert eng16._host_adam is not None
     # the grad step really emits narrow grads
-    g, _ = eng16._train_steps[None](
+    g, _ = eng16._train_steps[(None, None)](
         eng16.state.params,
         eng16._shape_batch(random_batches(1, 8, hidden=64, seed=0)[0]),
         jax.random.PRNGKey(0), eng16.state.step)

@@ -46,10 +46,14 @@ def test_reference_config_loads(path):
     with open(path) as f:
         raw = json.load(f)
     cfg = load_config(dict(raw))
-    # batch triangle resolves for any world the config supports
-    if raw.get("train_batch_size") and raw.get("train_micro_batch_size_per_gpu"):
-        tb, mb = int(raw["train_batch_size"]), int(raw["train_micro_batch_size_per_gpu"])
-        gas = int(raw.get("gradient_accumulation_steps", 1) or 1)
+    # batch triangle resolves for any world the config supports ("auto"
+    # values defer to finalize-time inference and are skipped here)
+    ints = [raw.get("train_batch_size"), raw.get("train_micro_batch_size_per_gpu")]
+    if all(isinstance(v, int) and v for v in ints):
+        tb, mb = ints
+        gas = raw.get("gradient_accumulation_steps", 1) or 1
+        if not isinstance(gas, int):
+            return
         if tb % (mb * gas) == 0:
             cfg.finalize(world_dp_size=tb // (mb * gas))
             assert cfg.train_batch_size == tb
@@ -59,6 +63,70 @@ def test_corpus_is_nonempty():
     """>= 20 genuine runtime configs exist in the reference tree; if this
     shrinks the glob broke, not the vocabulary."""
     assert len(CORPUS) >= 20, CORPUS
+
+
+def _tutorial_snippets():
+    """Fenced JSON config blocks embedded in the reference docs/blogs
+    markdown — the vocabulary users actually copy-paste."""
+    import re
+
+    fence = re.compile(r"```(?:json)?\s*\n(\{.*?\})\s*\n```", re.S)
+    out = []
+    for p in sorted(glob.glob(f"{REF}/docs/**/*.md", recursive=True)
+                    + glob.glob(f"{REF}/blogs/**/*.md", recursive=True)):
+        try:
+            text = open(p, errors="ignore").read()
+        except OSError:
+            continue
+        for i, m in enumerate(fence.finditer(text)):
+            try:
+                raw = json.loads(m.group(1))
+            except Exception:
+                continue
+            if isinstance(raw, dict) and (RUNTIME_MARKERS | {"bf16"}) & raw.keys():
+                out.append((f"{p.split('reference/')[-1]}#{i}", raw))
+    return out
+
+
+SNIPPETS = _tutorial_snippets()
+
+
+@pytest.mark.parametrize("raw", [s[1] for s in SNIPPETS],
+                         ids=[s[0] for s in SNIPPETS])
+def test_tutorial_snippet_loads(raw):
+    load_config(dict(raw))
+
+
+def test_tutorial_snippets_found():
+    assert len(SNIPPETS) >= 10, [s[0] for s in SNIPPETS]
+
+
+def test_legacy_curriculum_and_pld_sections():
+    """Tutorial vocabulary pinned directly: legacy top-level
+    curriculum_learning migrates to the data_efficiency location the engine
+    reads; progressive_layer_drop and autotuning.arg_mappings parse and
+    wire into their runtimes."""
+    from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+    cfg = load_config({
+        "train_micro_batch_size_per_gpu": 2,
+        "curriculum_learning": {"enabled": True, "curriculum_type": "seqlen",
+                                "min_difficulty": 8, "max_difficulty": 1024,
+                                "schedule_type": "fixed_linear",
+                                "schedule_config": {"total_curriculum_step": 15000,
+                                                    "difficulty_step": 8}},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                   "gamma": 0.001},
+        "autotuning": {"enabled": True,
+                       "arg_mappings": {"train_micro_batch_size_per_gpu":
+                                        "--per_device_train_batch_size"}},
+    })
+    cl = cfg.data_efficiency.data_sampling["curriculum_learning"]
+    assert cfg.data_efficiency.enabled and cl["curriculum_type"] == "seqlen"
+    pld = ProgressiveLayerDrop.from_config(cfg.progressive_layer_drop)
+    assert pld.theta == 0.5 and pld.get_theta(0) == 1.0
+    assert cfg.autotuning.arg_mappings["train_micro_batch_size_per_gpu"] \
+        .startswith("--per_device")
 
 
 def test_legacy_and_moq_vocabulary():
@@ -96,9 +164,11 @@ def test_legacy_and_moq_vocabulary():
     q = Quantizer.from_config(cfg.quantize_training)
     assert (q.start_bits, q.target_bits, q.period, q.groups) == (12, 4, 400, 16)
     assert q.offset == 400
-    # schedule_offset: full precision through the warmup, anneal after
-    assert q.bits_at(399) == 12 and q.bits_at(799) == 12
-    assert q.bits_at(800) == 6 and q.bits_at(10**6) == 4
+    # schedule_offset: NO quantization through the warmup (16 = skip
+    # sentinel), start_bits after it, anneal from there
+    assert q.bits_at(399) == 16 and q.bits_at(400) == 12
+    assert q.bits_at(799) == 12 and q.bits_at(800) == 6
+    assert q.bits_at(10**6) == 4
     e = Eigenvalue.from_config(cfg.eigenvalue)
     assert e.max_iter == 50 and e.tol == 0.01
     assert cfg.hybrid_engine.max_out_tokens == 256

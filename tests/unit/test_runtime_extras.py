@@ -285,3 +285,42 @@ def test_cpu_checkpointing_offloads_and_matches():
                                    rtol=1e-5)
     finally:
         ckpt._config.update(prev)
+
+
+def test_moq_quantize_training_wired_into_engine():
+    """A quantize_training config section drives fake-quantized training
+    end-to-end: full precision through schedule_offset, annealed bit-widths
+    after, one compiled program per width (reference MoQ runtime)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel.topology import Topology, TopologySpec, set_topology
+
+    from .simple_model import make_simple_params, random_batches, simple_loss
+
+    set_topology(Topology(TopologySpec()))
+    engine, *_ = ds.initialize(
+        model=simple_loss, model_parameters=make_simple_params(hidden=64, seed=0),
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "quantize_training": {
+                    "quantize_bits": {"start_bits": 8, "target_bits": 4},
+                    "quantize_schedule": {"quantize_period": 2,
+                                          "schedule_offset": 2},
+                    "quantize_groups": 4},
+                "steps_per_print": 10**9})
+    assert engine.moq is not None
+    batches = random_batches(8, 8, hidden=64, seed=0)
+    for b in batches[:2]:
+        engine.train_batch(b)          # steps 0-1: warmup, unquantized
+    assert set(engine._train_steps) == {(None, None)}
+    for b in batches[2:4]:
+        engine.train_batch(b)          # steps 2-3: 8-bit program
+    assert (None, 8) in engine._train_steps
+    for b in batches[4:6]:
+        engine.train_batch(b)          # steps 4-5: 4-bit program
+    assert (None, 4) in engine._train_steps
+    losses = [float(engine.train_batch(b)) for b in batches[6:]]
+    assert all(np.isfinite(losses))
+    # target reached: no further programs appear
+    n = len(engine._train_steps)
+    engine.train_batch(batches[0])
+    assert len(engine._train_steps) == n
