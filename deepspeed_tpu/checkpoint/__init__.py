@@ -1,6 +1,7 @@
+from .deepspeed_checkpoint import DeepSpeedCheckpoint
 from .state_dict_factory import (SDLoader, SDLoaderFactory, merge_qkv,
                                  merge_state_dicts, split_qkv,
                                  split_state_dict)
 
-__all__ = ["SDLoaderFactory", "SDLoader", "merge_state_dicts",
-           "split_state_dict", "merge_qkv", "split_qkv"]
+__all__ = ["DeepSpeedCheckpoint", "SDLoaderFactory", "SDLoader",
+           "merge_state_dicts", "split_state_dict", "merge_qkv", "split_qkv"]

@@ -78,6 +78,16 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             self._ckptr.wait_until_finished()
 
 
+def read_latest_tag(ckpt_dir: str) -> Optional[str]:
+    """The tag the ``latest`` pointer names, or None when absent — the ONE
+    place that knows the pointer format."""
+    p = os.path.join(os.path.abspath(ckpt_dir), "latest")
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return f.read().strip()
+
+
 def _state_to_tree(engine) -> Dict[str, Any]:
     s = engine.state
     return {"step": s.step, "params": s.params, "opt_state": s.opt_state,
@@ -161,11 +171,10 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     if pending is not None and pending.is_alive():
         pending.join()  # an in-flight async save must land before we read 'latest'
     if tag is None:
-        latest_path = os.path.join(load_dir, "latest")
-        if not os.path.exists(latest_path):
+        tag = read_latest_tag(load_dir)
+        if tag is None:
             logger.warning(f"no 'latest' file in {load_dir}; nothing loaded")
             return None, {}
-        tag = open(latest_path).read().strip()
     path = os.path.join(load_dir, str(tag))
     ck = _get_ckpt_engine(engine)
 
@@ -249,7 +258,9 @@ def zero_to_fp32(checkpoint_dir: str, output_file: Optional[str] = None, tag: Op
     files; here the store is already logical-global, so this is a read)."""
     checkpoint_dir = os.path.abspath(checkpoint_dir)
     if tag is None:
-        tag = open(os.path.join(checkpoint_dir, "latest")).read().strip()
+        tag = read_latest_tag(checkpoint_dir)
+        if tag is None:
+            raise FileNotFoundError(f"no 'latest' file in {checkpoint_dir}")
     path = os.path.join(checkpoint_dir, str(tag), "state")
     ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
     tree = ckptr.restore(path)

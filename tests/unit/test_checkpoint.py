@@ -1,5 +1,6 @@
 """Checkpoint round-trips (analogue of reference tests/unit/checkpoint/)."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -201,3 +202,36 @@ def test_moe_checkpoint_under_ep_mesh(tmp_path):
     e2.load_checkpoint(str(tmp_path / "moe"), tag="t")
     cont2 = [float(e2.train_batch(batch(s))) for s in range(3, 6)]
     np.testing.assert_allclose(cont1, cont2, rtol=1e-4, atol=1e-6)
+
+
+def test_deepspeed_checkpoint_inspector(tmp_path):
+    """Reference DeepSpeedCheckpoint vocabulary over our orbax layout:
+    topology degrees, tags, client state, layer keys, state access."""
+    from deepspeed_tpu.checkpoint import DeepSpeedCheckpoint
+
+    topo = Topology(TopologySpec(tp=2))
+    e = _engine(2, topology=topo)
+    for b in random_batches(2, 8, HIDDEN):
+        e.train_batch(b)
+    e.save_checkpoint(str(tmp_path / "c"), tag="s2",
+                      client_state={"epoch": 3})
+    e.save_checkpoint(str(tmp_path / "c"))  # tag defaults to global_step2
+
+    ck = DeepSpeedCheckpoint(str(tmp_path / "c"))  # follows 'latest'
+    assert ck.tag == "global_step2" and ck.global_steps == 2
+    assert ck.tp_degree == 2 and ck.show_3d_mapping()["tp"] == 2
+    assert ck.original_world_size == 8
+    ck.validate_files()
+    # natural order: numeric tags chronological, then named
+    e.save_checkpoint(str(tmp_path / "c"), tag="global_step10",
+                      save_latest=False)
+    assert DeepSpeedCheckpoint.get_tags(str(tmp_path / "c")) == \
+        ["global_step2", "global_step10", "s2"]
+    named = DeepSpeedCheckpoint(str(tmp_path / "c"), tag="s2")
+    assert named.client_state == {"epoch": 3}
+    keys = named.get_layer_keys()
+    assert "layer_0" in keys and "head" in keys
+    tree = named.load_state_tree()
+    w = np.asarray(jax.tree.leaves(tree["params"])[0])
+    assert np.isfinite(w).all()
+
