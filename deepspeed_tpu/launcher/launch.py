@@ -24,6 +24,23 @@ def build_child_env(args, extra=None):
     for kv in getattr(args, "export", []) or []:
         k, _, v = kv.partition("=")
         env[k] = v
+    if getattr(args, "node_rank", -1) >= 0:
+        # manual bring-up (reference --node_rank): the caller runs dstpu once
+        # per host instead of letting one invocation fan out
+        if args.num_nodes <= 0:
+            raise ValueError(
+                "--node_rank needs --num_nodes: without the world size the "
+                "child would join a 1-process coordinator as rank "
+                f"{args.node_rank} and hang")
+        if args.node_rank >= args.num_nodes:
+            raise ValueError(f"--node_rank {args.node_rank} out of range for "
+                             f"--num_nodes {args.num_nodes}")
+        env["DSTPU_PROCESS_ID"] = str(args.node_rank)
+        env["DSTPU_NUM_PROCESSES"] = str(args.num_nodes)
+    if getattr(args, "num_gpus", -1) > 0:
+        # reference --num_gpus on one node: limit the chips the child sees
+        env.setdefault("TPU_VISIBLE_DEVICES",
+                       ",".join(str(i) for i in range(args.num_gpus)))
     env.setdefault("DSTPU_NUM_PROCESSES", "1")
     env.setdefault("DSTPU_PROCESS_ID", "0")
     if args.master_addr:
@@ -34,8 +51,19 @@ def build_child_env(args, extra=None):
     return env
 
 
+def user_launch_cmd(args):
+    """The child argv honoring --module / --no_python (reference
+    launch.py's python[-m]/script forms)."""
+    if getattr(args, "no_python", False):
+        return [args.user_script] + list(args.user_args)
+    base = [args.python_exec, "-u"]
+    if getattr(args, "module", False):
+        base.append("-m")
+    return base + [args.user_script] + list(args.user_args)
+
+
 def launch_local(args) -> int:
-    cmd = [args.python_exec, "-u", args.user_script] + list(args.user_args)
+    cmd = user_launch_cmd(args)
     env = build_child_env(args)
     if args.elastic_training:
         return _supervise(cmd, env, max_restarts=args.max_restarts)

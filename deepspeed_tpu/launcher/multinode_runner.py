@@ -44,11 +44,21 @@ class MultiNodeRunner(ABC):
         env = dict(self.exports)
         env["DSTPU_COORDINATOR"] = f"{coordinator}:{port}"
         env["DSTPU_NUM_PROCESSES"] = str(len(self.hosts))
+        if getattr(self.args, "num_gpus", -1) > 0:
+            # reference --num_gpus: every remote worker limits its visible
+            # chips too, not just the local-launch path
+            env["TPU_VISIBLE_DEVICES"] = ",".join(
+                str(i) for i in range(self.args.num_gpus))
         return env
 
     def user_cmd(self) -> List[str]:
-        cmd = [self.args.user_script] + list(self.args.user_args)
-        return cmd
+        """Full child argv (honors --module / --no_python)."""
+        from .launch import user_launch_cmd
+
+        return user_launch_cmd(self.args)
+
+    def extra_backend_args(self) -> List[str]:
+        return shlex.split(getattr(self.args, "launcher_args", "") or "")
 
 
 class SSHRunner(MultiNodeRunner):
@@ -73,9 +83,11 @@ class SSHRunner(MultiNodeRunner):
             env_host["DSTPU_PROCESS_ID"] = str(idx)
             exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in sorted(env_host.items()))
             remote = f"cd {shlex.quote(os.getcwd())}; {exports} " \
-                     f"{shlex.quote(self.args.python_exec)} " \
                      + " ".join(shlex.quote(c) for c in self.user_cmd())
-            cmds.append(["ssh", "-o", "StrictHostKeyChecking=no", host, remote])
+            ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+            if getattr(self.args, "ssh_port", None):
+                ssh += ["-p", str(self.args.ssh_port)]
+            cmds.append(ssh + [host, remote])
         return cmds
 
 
@@ -105,9 +117,9 @@ class PDSHRunner(MultiNodeRunner):
         remote = (f"cd {shlex.quote(os.getcwd())}; {exports} {probe} {idx_case} "
                   '[ -n "$DSTPU_PROCESS_ID" ] || { echo "dstpu: cannot map $(hostname) '
                   'to a hostfile entry" >&2; exit 1; }; '
-                  f"{shlex.quote(self.args.python_exec)} "
                   + " ".join(shlex.quote(c) for c in self.user_cmd()))
-        return ["pdsh", "-S", "-f", "1024", "-w", host_list, remote]
+        return (["pdsh", "-S", "-f", "1024"] + self.extra_backend_args()
+                + ["-w", host_list, remote])
 
 
 class OpenMPIRunner(MultiNodeRunner):
@@ -124,11 +136,11 @@ class OpenMPIRunner(MultiNodeRunner):
         port = self.args.master_port or DEFAULT_COORDINATOR_PORT
         total = len(self.hosts)
         cmd = ["mpirun", "-n", str(total), "--host", ",".join(self.hosts),
-               "--map-by", "ppr:1:node"]
+               "--map-by", "ppr:1:node"] + self.extra_backend_args()
         env = self._bootstrap_env(coordinator, port)
         for k, v in sorted(env.items()):
             cmd += ["-x", f"{k}={v}"]
-        cmd += [self.args.python_exec] + self.user_cmd()
+        cmd += self.user_cmd()
         return cmd
 
 
@@ -146,13 +158,13 @@ class SlurmRunner(MultiNodeRunner):
         port = self.args.master_port or DEFAULT_COORDINATOR_PORT
         total = len(self.hosts)
         cmd = ["srun", "--nodes", str(total), "--ntasks", str(total),
-               "--ntasks-per-node", "1"]
+               "--ntasks-per-node", "1"] + self.extra_backend_args()
         if getattr(self.args, "slurm_comment", ""):
             cmd += ["--comment", self.args.slurm_comment]
         env = self._bootstrap_env(coordinator, port)
         exports = ",".join(f"{k}={v}" for k, v in sorted(env.items()))
         cmd += [f"--export=ALL,{exports}"]
-        cmd += [self.args.python_exec] + self.user_cmd()
+        cmd += self.user_cmd()
         return cmd
 
 

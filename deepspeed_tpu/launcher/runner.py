@@ -33,6 +33,25 @@ def parse_args(args=None):
                         help="hosts to drop, e.g. 'worker-2'")
     parser.add_argument("--num_nodes", type=int, default=-1,
                         help="cap the number of hosts used")
+    parser.add_argument("--num_gpus", "--num_accelerators", type=int,
+                        default=-1, dest="num_gpus",
+                        help="chips per node (reference --num_gpus): caps "
+                             "hostfile slots; locally sets TPU_VISIBLE_DEVICES")
+    parser.add_argument("--node_rank", type=int, default=-1,
+                        help="manual multi-node bring-up: this host's process "
+                             "id (use with --num_nodes and --master_addr; no "
+                             "hostfile fan-out happens)")
+    parser.add_argument("--module", action="store_true",
+                        help="run user_script as a module (python -m), like "
+                             "the reference flag")
+    parser.add_argument("--no_python", action="store_true",
+                        help="exec user_script directly without the python "
+                             "interpreter")
+    parser.add_argument("--ssh_port", type=int, default=None,
+                        help="sshd port for the ssh launcher")
+    parser.add_argument("--launcher_args", type=str, default="",
+                        help="extra flags passed verbatim to the fanout "
+                             "backend (pdsh/mpirun/srun)")
     parser.add_argument("--master_addr", type=str, default=None,
                         help="jax.distributed coordinator address (default: first host)")
     parser.add_argument("--master_port", type=int, default=None,
@@ -146,11 +165,22 @@ def main(args=None):
             raise RuntimeError(f"autotuning support unavailable: {e}") from e
         return run_autotuning(args)
 
+    if args.node_rank >= 0:
+        # manual bring-up: the operator runs dstpu once per host; any
+        # hostfile present must NOT trigger a second fan-out from each of
+        # those invocations (N^2 workers, clashing ranks)
+        from .launch import launch_local
+
+        return launch_local(args)
+
     active = None
     if resource_pool is not None:
         active = parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
         if args.num_nodes > 0:
             active = OrderedDict(list(active.items())[:args.num_nodes])
+        if args.num_gpus > 0:  # reference --num_gpus: cap chips per node
+            active = OrderedDict((h, min(s, args.num_gpus))
+                                 for h, s in active.items())
 
     if active is None or (len(active) == 1 and not args.force_multi
                           and _is_local_host(next(iter(active)))):

@@ -384,3 +384,93 @@ def test_config_finalize_elastic_owns_batch():
     ok = load_config(cfg)
     ok.finalize(2)
     assert ok.train_batch_size == 48
+
+
+def test_reference_cli_flags(monkeypatch):
+    """--num_gpus/--module/--no_python/--ssh_port/--launcher_args/--node_rank
+    (the reference `deepspeed` CLI vocabulary) parse and wire into the
+    commands/env the launcher actually builds."""
+    from deepspeed_tpu.launcher.launch import build_child_env, user_launch_cmd
+    from deepspeed_tpu.launcher.multinode_runner import (OpenMPIRunner,
+                                                         SSHRunner)
+
+    monkeypatch.delenv("TPU_VISIBLE_DEVICES", raising=False)
+    args = parse_args(["--num_gpus", "2", "--node_rank", "3", "--num_nodes",
+                       "4", "--master_addr", "w0", "--module",
+                       "train.pkg", "--lr", "1"])
+    assert args.num_gpus == 2 and args.module
+    cmd = user_launch_cmd(args)
+    assert cmd[1:4] == ["-u", "-m", "train.pkg"] and cmd[-2:] == ["--lr", "1"]
+    env = build_child_env(args)
+    assert env["TPU_VISIBLE_DEVICES"] == "0,1"
+    assert env["DSTPU_PROCESS_ID"] == "3" and env["DSTPU_NUM_PROCESSES"] == "4"
+
+    # node_rank without/over num_nodes is a self-contradictory env: refuse
+    with pytest.raises(ValueError, match="num_nodes"):
+        build_child_env(parse_args(["--node_rank", "1", "train.py"]))
+    with pytest.raises(ValueError, match="out of range"):
+        build_child_env(parse_args(["--node_rank", "4", "--num_nodes", "4",
+                                    "train.py"]))
+
+    raw = parse_args(["--no_python", "./run.sh", "x"])
+    assert user_launch_cmd(raw) == ["./run.sh", "x"]
+
+    sshargs = parse_args(["--master_addr", "w0", "--ssh_port", "2222",
+                          "--num_gpus", "1", "train.py"])
+    r = SSHRunner(sshargs, {"w0": 2, "w1": 2})
+    cmds = r.get_host_cmds({})
+    assert cmds[0][:4] == ["ssh", "-o", "StrictHostKeyChecking=no", "-p"]
+    assert cmds[0][4] == "2222"
+    # remote workers get the chip cap too, not just the local path
+    assert "TPU_VISIBLE_DEVICES=0 " in cmds[0][-1]
+
+    mpiargs = parse_args(["--master_addr", "w0", "--launcher", "openmpi",
+                          "--launcher_args", "--mca btl tcp", "train.py"])
+    m = OpenMPIRunner(mpiargs, {"w0": 1, "w1": 1})
+    cmd = m.get_cmd({}, {"w0": 1, "w1": 1})
+    i = cmd.index("--mca")
+    assert cmd[i:i + 3] == ["--mca", "btl", "tcp"]
+
+
+def test_node_rank_suppresses_fanout(tmp_path, monkeypatch):
+    """Manual bring-up: with --node_rank the launcher must go LOCAL even
+    when a hostfile with other hosts exists (no N^2 fan-out)."""
+    import deepspeed_tpu.launcher.runner as runner_mod
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("w0 slots=4\nw1 slots=4\n")
+    called = {}
+    monkeypatch.setattr("deepspeed_tpu.launcher.launch.launch_local",
+                        lambda args: called.setdefault("local", True) and 0)
+    rc = runner_mod.main(["-H", str(hf), "--node_rank", "0", "--num_nodes",
+                          "2", "--master_addr", "w0", "train.py"])
+    assert rc == 0 and called.get("local")
+
+
+def test_num_gpus_caps_hostfile_slots(tmp_path, monkeypatch):
+    """--num_gpus flows through main() into the runner's resource pool."""
+    import deepspeed_tpu.launcher.runner as runner_mod
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("w0 slots=4\nw1 slots=4\n")
+    seen = {}
+
+    class FakeRunner:
+        def __init__(self, args, active):
+            seen["active"] = dict(active)
+
+        def add_export(self, k, v):
+            pass
+
+        def backend_exists(self):
+            return True
+
+        def get_cmd(self, env, active):
+            return ["true"]
+
+    monkeypatch.setattr(runner_mod, "get_runner",
+                        lambda name, args, active: FakeRunner(args, active))
+    monkeypatch.setattr(runner_mod.subprocess, "call", lambda cmd: 0)
+    rc = runner_mod.main(["-H", str(hf), "--num_gpus", "2",
+                          "--launcher", "openmpi", "train.py"])
+    assert rc == 0 and seen["active"] == {"w0": 2, "w1": 2}
