@@ -1,0 +1,26 @@
+"""Every example script must RUN (the in-repo DeepSpeedExamples analogue
+rots silently otherwise). Each runs in its own subprocess on the virtual
+CPU mesh with the demo shapes the scripts default to."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+EXAMPLES = ["train_gpt2_zero1", "train_llama_zero3", "train_mixtral_moe",
+            "train_pipeline", "serve_fastgen", "rlhf_state_surgery"]
+
+
+@pytest.mark.parametrize("name", EXAMPLES)
+def test_example_runs(name):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", f"{name}.py")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO)
+    assert r.returncode == 0, (
+        f"{name} failed (rc={r.returncode}):\n{r.stderr[-2000:]}")
