@@ -39,6 +39,7 @@ class BertConfig:
     type_vocab_size: int = 2
     norm_eps: float = 1e-12
     dropout: float = 0.0
+    attn_dropout: float = 0.0   # on the attention probabilities (BERT-style)
     # distilbert: no token-type embeddings, no pooler
     use_token_type: bool = True
     dtype: Any = jnp.bfloat16
@@ -56,7 +57,7 @@ class BertSelfAttention(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, mask=None, deterministic=True):
         cfg = self.cfg
         h, d = cfg.num_heads, cfg.head_dim
         dense = lambda name: nn.DenseGeneral(features=(h, d), use_bias=True,
@@ -68,6 +69,8 @@ class BertSelfAttention(nn.Module):
         if mask is not None:  # [B, S] 1=token, 0=pad
             logits = jnp.where(mask[:, None, None, :].astype(bool), logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        if cfg.attn_dropout and not deterministic:
+            probs = nn.Dropout(cfg.attn_dropout)(probs, deterministic=False)
         out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
                                use_bias=True, dtype=cfg.dtype,
@@ -80,7 +83,7 @@ class BertBlock(nn.Module):
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
         cfg = self.cfg
-        attn = BertSelfAttention(cfg, name="attn")(x, mask)
+        attn = BertSelfAttention(cfg, name="attn")(x, mask, deterministic)
         if cfg.dropout and not deterministic:
             attn = nn.Dropout(cfg.dropout)(attn, deterministic=False)
         x = _ln(cfg, "attn_norm")(x + attn)           # post-LN

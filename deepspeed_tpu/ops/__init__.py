@@ -1,0 +1,15 @@
+"""``deepspeed.ops`` namespace (reference ``deepspeed/ops/__init__.py``):
+optimizer kernels, transformer layer API, quantizers, IO, Pallas kernels."""
+
+from . import adam
+from . import aio
+from . import deepspeed4science
+from . import fp_quantizer
+from . import pallas
+from .optimizers import (adagrad, build_optimizer, fused_adam, fused_lamb,
+                         fused_lion, sgd)
+from .transformer import DeepSpeedTransformerConfig, DeepSpeedTransformerLayer
+
+__all__ = ["adam", "aio", "deepspeed4science", "fp_quantizer", "pallas", "build_optimizer",
+           "fused_adam", "fused_lamb", "fused_lion", "adagrad", "sgd",
+           "DeepSpeedTransformerConfig", "DeepSpeedTransformerLayer"]

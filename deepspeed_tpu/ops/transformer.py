@@ -1,0 +1,120 @@
+"""Transformer training-layer API (reference ``ops/transformer/transformer.py``).
+
+The reference ``DeepSpeedTransformerLayer`` is the fused CUDA encoder block
+BingBert trains with (``transformer.py:296``), configured by
+``DeepSpeedTransformerConfig`` (``transformer.py:22``) with a
+``pre_layer_norm`` switch between the preln/postln modelings. On TPU the
+fusion is XLA's job, so the same API is a flax module over the shared BERT
+blocks (``models/bert.py``) — both LN orderings, honoring the dropout
+ratios and ``initializer_range``; CUDA-runtime knobs
+(``stochastic_mode``/``local_rank``/``batch_size``) are accepted and
+ignored because shapes and placement come from the input and the mesh.
+"""
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..models.bert import BertBlock, BertConfig, BertSelfAttention
+
+__all__ = ["DeepSpeedTransformerConfig", "DeepSpeedTransformerLayer"]
+
+
+@dataclass
+class DeepSpeedTransformerConfig:
+    """Reference field vocabulary (``transformer.py:22``)."""
+    batch_size: int = 1              # shapes come from the input on TPU
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    heads: int = 12
+    attn_dropout_ratio: float = 0.0
+    hidden_dropout_ratio: float = 0.0
+    num_hidden_layers: int = 12
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    local_rank: int = -1             # device placement is the mesh's job
+    seed: int = 0
+    fp16: bool = False
+    pre_layer_norm: bool = True
+    stochastic_mode: bool = False    # CUDA-kernel knob; no TPU analogue
+    return_tuple: bool = False
+
+    @property
+    def dtype(self):
+        return jnp.bfloat16 if self.fp16 else jnp.float32
+
+
+class DeepSpeedTransformerLayer(nn.Module):
+    """One encoder block, preln or postln (reference ``transformer.py:296``).
+
+    ``apply({"params": p}, hidden_states, attention_mask)`` with
+    ``attention_mask`` of [B, S] (1 = token, 0 = pad), like the reference
+    forward. ``init_params(rng, seq)`` builds the parameter pytree.
+    """
+
+    config: DeepSpeedTransformerConfig
+
+    def _bert_cfg(self) -> BertConfig:
+        c = self.config
+        return BertConfig(hidden_size=c.hidden_size,
+                          intermediate_size=c.intermediate_size,
+                          num_heads=c.heads, norm_eps=c.layer_norm_eps,
+                          dropout=c.hidden_dropout_ratio,
+                          attn_dropout=c.attn_dropout_ratio, dtype=c.dtype)
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None,
+                 deterministic: bool = True):
+        c = self.config
+        bcfg = self._bert_cfg()
+        x = hidden_states.astype(bcfg.dtype)
+        if not c.pre_layer_norm:
+            # the postln ordering IS models/bert.BertBlock — delegate (its
+            # params nest under "block")
+            out = BertBlock(bcfg, name="block")(x, attention_mask,
+                                                deterministic)
+            return (out,) if c.return_tuple else out
+        ln = lambda name: nn.LayerNorm(epsilon=c.layer_norm_eps,
+                                       dtype=bcfg.dtype, name=name)
+
+        def drop(t):
+            if c.hidden_dropout_ratio and not deterministic:
+                return nn.Dropout(c.hidden_dropout_ratio)(t,
+                                                          deterministic=False)
+            return t
+
+        attn = BertSelfAttention(bcfg, name="attn")(ln("attn_norm")(x),
+                                                    attention_mask,
+                                                    deterministic)
+        x = x + drop(attn)
+        h = ln("mlp_norm")(x)
+        h = nn.Dense(c.intermediate_size, dtype=bcfg.dtype,
+                     param_dtype=jnp.float32, name="up_proj")(h)
+        h = nn.gelu(h, approximate=False)
+        h = nn.Dense(c.hidden_size, dtype=bcfg.dtype,
+                     param_dtype=jnp.float32, name="down_proj")(h)
+        out = x + drop(h)
+        return (out,) if c.return_tuple else out
+
+    def init_params(self, rng=None, seq: int = 16):
+        """Parameter pytree with the reference init: kernels ~ truncated
+        normal(std=initializer_range), biases/LN at their defaults."""
+        c = self.config
+        rng = jax.random.PRNGKey(c.seed) if rng is None else rng
+        x = jnp.zeros((1, seq, c.hidden_size), c.dtype)
+        params = self.init({"params": rng}, x)["params"]
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+        keys = jax.random.split(jax.random.fold_in(rng, 1), len(leaves))
+        out = []
+        for (kp, leaf), key in zip(leaves, keys):
+            names = [str(getattr(e, "key", e)) for e in kp]
+            if names[-1] == "kernel":
+                leaf = (c.initializer_range
+                        * jax.random.truncated_normal(key, -2.0, 2.0,
+                                                      leaf.shape,
+                                                      jnp.float32))
+            out.append(leaf)
+        return jax.tree_util.tree_unflatten(treedef, out)
