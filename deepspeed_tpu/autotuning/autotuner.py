@@ -182,6 +182,46 @@ def report_autotune_result(throughput: float):
 # ---------------------------------------------------------------------------
 
 
+def _load_arg_mappings(user_args):
+    """``autotuning.arg_mappings`` from the script's own --deepspeed_config
+    file (reference ``autotuner.py:1000``): maps a ds config knob to the
+    user script's OWN CLI flag, so scripts that read e.g.
+    ``--per_device_train_batch_size`` see each trial's value too."""
+    path = None
+    for i, tok in enumerate(user_args):
+        if tok == "--deepspeed_config" and i + 1 < len(user_args):
+            path = user_args[i + 1]
+        elif tok.startswith("--deepspeed_config="):  # argparse equals form
+            path = tok.split("=", 1)[1]
+    if not path:
+        return {}
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+        section = raw.get("autotuning") if isinstance(raw, dict) else None
+        mappings = section.get("arg_mappings") if isinstance(section, dict) \
+            else None
+        return mappings if isinstance(mappings, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def _apply_arg_mappings(user_args, overrides, arg_mappings):
+    """Rewrite (or append) the mapped CLI flags with this trial's values."""
+    out = list(user_args)
+    for ds_name, flag in (arg_mappings or {}).items():
+        val = overrides.get(ds_name)
+        if val is None:
+            continue
+        if flag in out:
+            i = out.index(flag)
+            if i + 1 < len(out):
+                out[i + 1] = str(val)
+        else:
+            out += [flag, str(val)]
+    return out
+
+
 def run_autotuning(args) -> int:
     """Run the user script once per candidate config (reference
     ``launcher/runner.py:498`` autotuning branch). The script must call
@@ -195,6 +235,7 @@ def run_autotuning(args) -> int:
                                   "train_micro_batch_size_per_gpu": m,
                                   "train_batch_size": None})
             for s in (0, 1, 2, 3) for m in (1, 2, 4)]
+    arg_mappings = _load_arg_mappings(list(args.user_args))
     best = None
     for exp in exps:
         result_file = os.path.join(results_dir, f"{exp.name}.json")
@@ -203,7 +244,9 @@ def run_autotuning(args) -> int:
         env = dict(os.environ)
         env[AUTOTUNE_CONFIG_ENV] = json.dumps(exp.overrides)
         env[AUTOTUNE_RESULT_ENV] = result_file
-        cmd = [args.python_exec, "-u", args.user_script] + list(args.user_args)
+        user_args = _apply_arg_mappings(args.user_args, exp.overrides,
+                                        arg_mappings)
+        cmd = [args.python_exec, "-u", args.user_script] + user_args
         rc = subprocess.call(cmd, env=env)
         if rc == 0 and os.path.exists(result_file):
             with open(result_file) as f:

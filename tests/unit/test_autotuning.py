@@ -202,3 +202,42 @@ def test_autotuner_model_type_end_to_end():
                        micro_batches=[8, 16], tuner_type="model")
     assert "zero_optimization" in best_cfg
     assert at.trials_run <= 4
+
+
+def test_arg_mappings_rewrite_user_args(tmp_path):
+    """autotuning.arg_mappings (reference autotuner.py:1000): each trial
+    rewrites the user script's OWN flags with the trial's knob values."""
+    import json as _json
+
+    from deepspeed_tpu.autotuning.autotuner import (_apply_arg_mappings,
+                                                    _load_arg_mappings)
+
+    cfgp = tmp_path / "ds.json"
+    cfgp.write_text(_json.dumps({
+        "train_micro_batch_size_per_gpu": 2,
+        "autotuning": {"enabled": True,
+                       "arg_mappings": {"train_micro_batch_size_per_gpu":
+                                        "--per_device_train_batch_size"}}}))
+    ua = ["--deepspeed_config", str(cfgp),
+          "--per_device_train_batch_size", "2", "--lr", "3e-4"]
+    m = _load_arg_mappings(ua)
+    assert m == {"train_micro_batch_size_per_gpu":
+                 "--per_device_train_batch_size"}
+    out = _apply_arg_mappings(ua, {"train_micro_batch_size_per_gpu": 4,
+                                   "zero_optimization": {"stage": 3}}, m)
+    i = out.index("--per_device_train_batch_size")
+    assert out[i + 1] == "4" and out[-2:] == ["--lr", "3e-4"]
+    # absent flag gets appended
+    out2 = _apply_arg_mappings(["--lr", "1"],
+                               {"train_micro_batch_size_per_gpu": 8}, m)
+    assert out2[-2:] == ["--per_device_train_batch_size", "8"]
+    # no config / no section -> no-op
+    assert _load_arg_mappings(["--lr", "1"]) == {}
+    # equals form resolves too
+    assert _load_arg_mappings([f"--deepspeed_config={cfgp}"]) == m
+    # malformed sections degrade to no mappings, never crash
+    bad = tmp_path / "bad.json"
+    bad.write_text(_json.dumps({"autotuning": True}))
+    assert _load_arg_mappings(["--deepspeed_config", str(bad)]) == {}
+    bad.write_text(_json.dumps([1, 2]))
+    assert _load_arg_mappings(["--deepspeed_config", str(bad)]) == {}
