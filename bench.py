@@ -87,8 +87,10 @@ def comm_bandwidth():
                 c, _ = jax.lax.scan(body, shard, None, length=reps)
                 return c[0]
 
-            return jax.jit(jax.shard_map(loop, mesh=mesh, in_specs=P("x"),
-                                         out_specs=P(), check_vma=False))
+            from deepspeed_tpu.utils.shard_map_compat import shard_map_nocheck
+
+            return jax.jit(shard_map_nocheck(loop, mesh, in_specs=P("x"),
+                                             out_specs=P()))
 
         def f_body(x):
             def body(c, _):
@@ -658,9 +660,87 @@ def rung3b_big_model():
             "device": getattr(dev, "device_kind", dev.platform)}
 
 
+def collective_matmul_bench():
+    """Latency-hiding collective matmul (ops/collective_matmul.py): time the
+    GSPMD gather-then-matmul / matmul-then-scatter composition against the
+    ring-overlapped all_gather_matmul -> matmul_reduce_scatter pair on the
+    available mesh (a Megatron-SP MLP-shaped round trip, fwd only). On a
+    multi-chip TPU mesh the ratio is the latency actually hidden; on the
+    virtual CPU mesh the line documents parity wiring (relative numbers
+    only). Emits the `collective_matmul` line either way."""
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deepspeed_tpu.ops.collective_matmul import (all_gather_matmul,
+                                                     matmul_reduce_scatter)
+    from deepspeed_tpu.utils.shard_map_compat import shard_map_nocheck
+
+    devs = np.array(jax.devices())
+    n = len(devs)
+    if n < 2:
+        return {"metric": "collective_matmul", "value": None, "unit": "ratio",
+                "vs_baseline": None, "error": "needs a >=2 device mesh"}
+    mesh = Mesh(devs, ("tp",))
+    on_tpu = devs[0].platform == "tpu"
+    if on_tpu:
+        B, S, D, F, dtype = 4, 4096, 4096, 11008 - 11008 % n, jnp.bfloat16
+        reps_lo, reps_hi = 4, 24
+    else:
+        B, S, D, F, dtype = 2, 256, 256, 1024, jnp.float32
+        reps_lo, reps_hi = 2, 6
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, S, D)) * 0.1, dtype)
+    wu = jnp.asarray(rng.normal(size=(D, F)) * 0.02, dtype)
+    wd = jnp.asarray(rng.normal(size=(F, D)) * 0.02, dtype)
+
+    def make(fused, reps):
+        def unfused_body(x_, wu_, wd_):
+            full = lax.all_gather(x_, "tp", axis=1, tiled=True)   # [B, S, D]
+            h = jnp.einsum("...k,kn->...n", full, wu_)            # [B, S, F/n]
+            out = jnp.einsum("...k,kn->...n", h, wd_)             # [B, S, D]
+            return lax.psum_scatter(out, "tp", scatter_dimension=1, tiled=True)
+
+        def fused_body(x_, wu_, wd_):
+            h = all_gather_matmul(x_, wu_, "tp")
+            return matmul_reduce_scatter(h, wd_, "tp")
+
+        body = fused_body if fused else unfused_body
+
+        def loop(x_, wu_, wd_):
+            def step(c, _):
+                return body(c, wu_, wd_) * dtype(1e-2) + c, ()
+            c, _ = jax.lax.scan(step, x_, None, length=reps)
+            return c[0, 0, 0]
+
+        return jax.jit(shard_map_nocheck(
+            loop, mesh,
+            in_specs=(P(None, "tp", None), P(None, "tp"), P("tp", None)),
+            out_specs=P()))
+
+    def timed(fused):
+        f_lo, f_hi = make(fused, reps_lo), make(fused, reps_hi)
+        float(f_lo(x, wu, wd)); float(f_hi(x, wu, wd))  # compile + drain
+        t0 = time.perf_counter(); float(f_lo(x, wu, wd))
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter(); float(f_hi(x, wu, wd))
+        t_hi = time.perf_counter() - t0
+        return (t_hi - t_lo) / (reps_hi - reps_lo)
+
+    t_unfused = timed(fused=False)
+    t_fused = timed(fused=True)
+    return {"metric": "collective_matmul",
+            "value": round(t_unfused / t_fused, 4), "unit": "ratio",
+            "vs_baseline": None,
+            "t_fused_s": round(t_fused, 6), "t_unfused_s": round(t_unfused, 6),
+            "shape": {"B": B, "S": S, "D": D, "F": F},
+            "devices": n,
+            "device": "tpu" if on_tpu else f"cpu-mesh-{n}"}
+
+
 RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "3b": rung3b_big_model,
-         "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses}
+         "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses,
+         "cm": collective_matmul_bench}
 
 
 def run_ladder():
@@ -676,7 +756,13 @@ def run_ladder():
             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
     cpu1 = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""}
     chip = {} if healthy else cpu1
-    plan = [("1", cpu1), ("2", chip), ("3", chip), ("4", cpu8), ("5", cpu8)]
+    # device count via subprocess probe: touching the backend HERE would hold
+    # the TPU exclusively and starve the rung subprocesses
+    from deepspeed_tpu.utils.health import accelerator_device_count
+
+    multichip = healthy and accelerator_device_count() > 1
+    plan = [("1", cpu1), ("2", chip), ("3", chip), ("4", cpu8), ("5", cpu8),
+            ("cm", {} if multichip else cpu8)]
     results = []
     for rung, env_over in plan:
         env = dict(os.environ)
@@ -717,8 +803,17 @@ if __name__ == "__main__":
     elif args.rung:
         from deepspeed_tpu.utils.health import accelerator_healthy
 
-        if args.rung in ("4", "5") and "--xla_force_host_platform_device_count" \
-                not in os.environ.get("XLA_FLAGS", ""):
+        flags_preset = ("--xla_force_host_platform_device_count"
+                        in os.environ.get("XLA_FLAGS", ""))
+        needs_cpu8 = args.rung in ("4", "5")
+        if args.rung == "cm" and not flags_preset:
+            # cm runs on the real mesh only when it's healthy AND >1 chip
+            # (subprocess probes; this process must not init the backend yet)
+            from deepspeed_tpu.utils.health import accelerator_device_count
+
+            needs_cpu8 = not (accelerator_healthy()
+                              and accelerator_device_count() > 1)
+        if needs_cpu8 and not flags_preset:
             # these rungs need the 8-device mesh; harmless if the backend was
             # already initialized by an outer harness with its own flags
             os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")

@@ -207,18 +207,27 @@ def _load_arg_mappings(user_args):
 
 
 def _apply_arg_mappings(user_args, overrides, arg_mappings):
-    """Rewrite (or append) the mapped CLI flags with this trial's values."""
+    """Rewrite (or append) the mapped CLI flags with this trial's values.
+    Handles both ``--flag value`` and ``--flag=value`` token forms in place;
+    a flag sitting as the trailing token gets its value appended."""
     out = list(user_args)
     for ds_name, flag in (arg_mappings or {}).items():
         val = overrides.get(ds_name)
         if val is None:
             continue
-        if flag in out:
-            i = out.index(flag)
-            if i + 1 < len(out):
-                out[i + 1] = str(val)
+        sval = str(val)
+        for i, tok in enumerate(out):
+            if tok == flag:
+                if i + 1 < len(out):
+                    out[i + 1] = sval
+                else:
+                    out.append(sval)
+                break
+            if tok.startswith(flag + "="):
+                out[i] = f"{flag}={sval}"
+                break
         else:
-            out += [flag, str(val)]
+            out += [flag, sval]
     return out
 
 

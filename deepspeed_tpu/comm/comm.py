@@ -149,6 +149,14 @@ def _log_traced(op: str, x) -> None:
     _COMMS_LOGGER.append(op, _nbytes(x), traced=True)
 
 
+def log_chunked(op: str, nbytes: int) -> None:
+    """Trace-time ledger entry for ring-chunked collectives
+    (``ops/collective_matmul.py``): the chunk hops of one ring pass are
+    recorded as a single entry covering the full ``(p-1)/p`` wire traffic,
+    so ledger totals match what a fused collective would have reported."""
+    _COMMS_LOGGER.append(op, int(nbytes), traced=True)
+
+
 def all_reduce(x, axis: Axis, op: str = "sum"):
     """SUM/MAX/MIN/MEAN allreduce over a mesh axis (reference ``comm.py:497``)."""
     _log_traced("all_reduce", x)
@@ -219,9 +227,11 @@ def axis_index(axis: Axis):
 
 
 def get_axis_size(names: Tuple[str, ...]) -> int:
+    from ..utils.shard_map_compat import axis_size
+
     s = 1
     for n in names:
-        s *= lax.axis_size(n)
+        s *= axis_size(n)
     return s
 
 
@@ -411,11 +421,13 @@ def has_coalescing_manager() -> bool:
     return True  # pytree collectives; XLA fuses the bucket
 
 
-def monitored_barrier(timeout=None, wait_all_ranks: bool = False,
+def monitored_barrier(group=None, timeout=None, wait_all_ranks: bool = False,
                       name: str = "monitored_barrier"):
-    """Reference ``monitored_barrier``: under jax.distributed a straggler
-    surfaces as the coordinator's own timeout, so this is ``barrier`` with
-    the reference signature accepted."""
+    """Reference ``monitored_barrier(group=None, timeout=...)``
+    (``comm.py:412``): under jax.distributed a straggler surfaces as the
+    coordinator's own timeout, so this is ``barrier`` with the reference
+    signature accepted — including the leading ``group``, so a positional
+    group argument is not silently consumed as ``timeout``."""
     barrier(name)
 
 

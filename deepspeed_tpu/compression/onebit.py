@@ -81,7 +81,9 @@ def packed_allreduce(x: jnp.ndarray, worker_error: jnp.ndarray,
     from .. import comm as dist
     from ..ops.pallas.quant import pack_signs, unpack_signs
 
-    world = jax.lax.axis_size(axis)
+    from ..utils.shard_map_compat import axis_size
+
+    world = axis_size(axis)
     shape = x.shape
     n = int(np.prod(shape))
     chunk = server_error_shape(shape, world)[0]  # single source of layout math

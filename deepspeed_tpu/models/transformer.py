@@ -92,6 +92,13 @@ class TransformerConfig:
     sequence_parallel: bool = False             # SP over the 'sp' axis
     sp_impl: str = "ulysses"                    # ulysses (all-to-all) | ring
     attn_impl: str = "auto"                     # auto | xla | flash (pallas)
+    # ring-overlapped collective matmul (ops/collective_matmul.py): run the
+    # column/row-parallel linears (and the Ulysses projection exchange) as
+    # shard_map rings that hide the tp/sp collective behind the partial
+    # matmuls (T3-style). Also switchable fleet-wide via the runtime knob
+    # TensorParallelConfig.overlap_collective_matmul; falls back to the
+    # declarative GSPMD path when shapes don't chunk evenly over the axis.
+    overlap_collective_matmul: bool = False
 
     @property
     def head_dim(self):
@@ -318,6 +325,46 @@ def merge_partial_attention(out1, m1, l1, out2, m2, l2):
     return num / den[..., None]
 
 
+# ---------------------------------------------------------------------------
+# Ring-overlapped collective matmul wiring (ops/collective_matmul.py).
+# The flax modules express TP declaratively (param_specs + GSPMD inserts the
+# collectives); with the overlap knob on, the column/row-parallel matmuls
+# instead run inside an explicit shard_map where the tp (or Ulysses sp)
+# collective is decomposed into ppermute ring chunks interleaved with the
+# partial matmuls — T3-style latency hiding. Activations cross the block
+# sequence-sharded over the axis (Megatron-SP layout), so consecutive
+# layers chain gather->matmul / matmul->scatter without extra reshards.
+# Any shape that doesn't chunk evenly falls back to the declarative path.
+# ---------------------------------------------------------------------------
+
+
+def _overlap_active(cfg) -> bool:
+    if cfg.overlap_collective_matmul:
+        return True
+    from ..ops.collective_matmul import overlap_enabled
+
+    return overlap_enabled()
+
+
+def _overlap_ctx(cfg, x, mod):
+    """The live topology when the overlapped path could engage, else None
+    (knob off, flax init trace, non-[B,S,D] input, or a batch that doesn't
+    shard over the dp axes)."""
+    if not _overlap_active(cfg) or mod.is_initializing() or x.ndim != 3:
+        return None
+    from ..parallel.topology import get_topology
+    from ..utils.shard_map_compat import manual_axes
+
+    if manual_axes():
+        # already inside a manual region (e.g. the SPMD pipeline body) —
+        # shard_map does not nest; stay declarative there
+        return None
+    topo = get_topology()
+    if x.shape[0] % topo.axis_size(*topo.dp_axes):
+        return None
+    return topo
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
     window: Optional[int] = None   # gpt-neo per-layer local attention
@@ -332,9 +379,15 @@ class Attention(nn.Module):
         scale, window = cfg.attn_scale, self.window
         dense = partial(nn.DenseGeneral, use_bias=cfg.qkv_bias,
                         dtype=cfg.dtype, param_dtype=jnp.float32)
-        q = dense(features=(h, d), name="q_proj")(x)
-        k = dense(features=(hk, d), name="k_proj")(x)
-        v = dense(features=(hk, d), name="v_proj")(x)
+        ulysses_mm, tp_mm = self._overlap_mode(x, cache, window_kv)
+        if tp_mm:
+            q, k, v = self._overlap_qkv(x)
+        elif ulysses_mm:
+            q = k = v = None  # projections fuse into the Ulysses ring below
+        else:
+            q = dense(features=(h, d), name="q_proj")(x)
+            k = dense(features=(hk, d), name="k_proj")(x)
+            v = dense(features=(hk, d), name="v_proj")(x)
 
         if cfg.position == "rope":
             cos, sin = rope_table(cfg.max_seq_len, cfg.rotary_dim, cfg.rope_theta)
@@ -439,7 +492,8 @@ class Attention(nn.Module):
                 out = ring_attention(q, k, v, apply_pos=apply_pos,
                                      causal=True, scale=scale)
             else:
-                from ..sequence.layer import ulysses_attention
+                from ..sequence.layer import (ulysses_attention,
+                                              ulysses_matmul_attention)
 
                 def local_attn(q_, k_, v_, pos):
                     if cfg.position == "rope":
@@ -448,6 +502,18 @@ class Attention(nn.Module):
                     return attention_core(q_, k_, v_, causal=True, impl=impl,
                                           scale=scale, window=window)
 
+                if ulysses_mm:
+                    # qkv + o projections fused into the sp exchange: the
+                    # ring all-gather-matmul/matmul-reduce-scatter replace
+                    # the four all-to-alls AND the separate projections
+                    p = self.variables["params"]
+                    out = ulysses_matmul_attention(
+                        local_attn, x, p["q_proj"], p["k_proj"], p["v_proj"],
+                        p["o_proj"], dtype=cfg.dtype)
+                    if cfg.dropout > 0 and not deterministic:
+                        out = nn.Dropout(rate=cfg.dropout)(
+                            out, deterministic=False)
+                    return out
                 out = ulysses_attention(local_attn, q, k, v)
         else:
             if cfg.position == "rope":
@@ -457,10 +523,92 @@ class Attention(nn.Module):
                                  scale=scale, window=window,
                                  alibi_post_scale=cfg.alibi_post_scale)
 
-        out = o_proj(out)
+        out = self._overlap_o(out) if tp_mm else o_proj(out)
         if cfg.dropout > 0 and not deterministic:
             out = nn.Dropout(rate=cfg.dropout)(out, deterministic=False)
         return out
+
+    # -- ring-overlapped collective matmul paths ---------------------------
+
+    def _overlap_mode(self, x, cache, window_kv):
+        """(ulysses_mm, tp_mm): which overlapped projection path applies.
+        Decode/cache paths and ragged shapes stay on the declarative path."""
+        cfg = self.cfg
+        if cache is not None or window_kv is not None:
+            return False, False
+        topo = _overlap_ctx(cfg, x, self)
+        if topo is None or "q_proj" not in self.variables.get("params", {}):
+            return False, False
+        h, hk, s = cfg.num_heads, cfg.kv_heads, x.shape[1]
+        from ..ops.collective_matmul import overlap_ready
+
+        if cfg.sequence_parallel:
+            ok = (cfg.sp_impl == "ulysses" and topo.tp_size == 1
+                  and cfg.position != "alibi"
+                  and overlap_ready(topo.sp_size, h, hk, s))
+            return ok, False
+        ok = topo.sp_size == 1 and overlap_ready(topo.tp_size, h, hk, s)
+        return False, ok
+
+    def _overlap_qkv(self, x):
+        """Fused qkv: one ring all-gather-matmul over tp — x arrives
+        sequence-sharded (the previous row-parallel output's layout), the
+        gather hides behind the three projections run as one matmul."""
+        from ..ops.collective_matmul import fused_qkv_all_gather_matmul
+        from ..parallel.topology import TP_AXIS, get_topology
+        from ..utils.shard_map_compat import shard_map_nocheck
+
+        cfg = self.cfg
+        dt, dh = cfg.dtype, cfg.head_dim
+        topo = get_topology()
+        dp = topo.dp_axes
+        params = self.variables["params"]
+        wq, wk, wv = (params[n]["kernel"].astype(dt)
+                      for n in ("q_proj", "k_proj", "v_proj"))
+        w_spec = P(None, TP_AXIS, None)
+        args = [x.astype(dt), wq, wk, wv]
+        specs = [P(dp, TP_AXIS, None), w_spec, w_spec, w_spec]
+        if cfg.qkv_bias:
+            args += [params[n]["bias"].astype(dt)
+                     for n in ("q_proj", "k_proj", "v_proj")]
+            specs += [P(TP_AXIS, None)] * 3
+
+        def body(x_, wq_, wk_, wv_, *bs):
+            return fused_qkv_all_gather_matmul(x_, wq_, wk_, wv_, bs, dh,
+                                               TP_AXIS)
+
+        head_spec = P(dp, None, TP_AXIS, None)
+        return shard_map_nocheck(body, topo.mesh, tuple(specs),
+                                 (head_spec, head_spec, head_spec))(*args)
+
+    def _overlap_o(self, out):
+        """Row-parallel output projection as a ring matmul-reduce-scatter:
+        the tp reduction hides behind the chunked o matmul and the result
+        leaves sequence-sharded for the next block's gather."""
+        from ..ops.collective_matmul import matmul_reduce_scatter
+        from ..parallel.topology import TP_AXIS, get_topology
+        from ..utils.shard_map_compat import shard_map_nocheck
+
+        cfg = self.cfg
+        dt = cfg.dtype
+        topo = get_topology()
+        dp = topo.dp_axes
+        params = self.variables["params"]["o_proj"]
+        wo = params["kernel"].astype(dt)  # [H, Dh, D]
+
+        def body(o_, wo_):
+            hl, dhl = wo_.shape[:2]
+            b_, s_ = o_.shape[:2]
+            return matmul_reduce_scatter(o_.reshape(b_, s_, hl * dhl),
+                                         wo_.reshape(hl * dhl, -1), TP_AXIS)
+
+        y = shard_map_nocheck(body, topo.mesh,
+                              (P(dp, None, TP_AXIS, None),
+                               P(TP_AXIS, None, None)),
+                              P(dp, TP_AXIS, None))(out.astype(dt), wo)
+        if cfg.out_bias:
+            y = y + params["bias"].astype(dt)
+        return y
 
 
 class MLP(nn.Module):
@@ -470,6 +618,9 @@ class MLP(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         bias = cfg.ffn_bias
+        topo = _overlap_ctx(cfg, x, self)
+        if topo is not None and self._overlap_ok(topo, x):
+            return self._overlapped(topo, x)
         if cfg.activation == "swiglu":
             gate = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype,
                             param_dtype=jnp.float32, name="gate_proj")(x)
@@ -482,6 +633,66 @@ class MLP(nn.Module):
             hidden = apply_activation(cfg.activation, hidden)
         return nn.Dense(cfg.hidden_size, use_bias=bias, dtype=cfg.dtype,
                         param_dtype=jnp.float32, name="down_proj")(hidden)
+
+    # -- ring-overlapped collective matmul path ----------------------------
+
+    def _overlap_ok(self, topo, x):
+        from ..ops.collective_matmul import overlap_ready
+
+        return (topo.sp_size == 1
+                and overlap_ready(topo.tp_size, x.shape[1],
+                                  self.cfg.intermediate_size)
+                and "down_proj" in self.variables.get("params", {}))
+
+    def _overlapped(self, topo, x):
+        """Column linear as ring all-gather-matmul (gate|up fused into one
+        gather), row linear as ring matmul-reduce-scatter — the tp
+        collectives hide behind the partial matmuls, and activations cross
+        the MLP sequence-sharded over tp (Megatron-SP layout)."""
+        from ..ops.collective_matmul import (all_gather_matmul,
+                                             matmul_reduce_scatter)
+        from ..parallel.topology import TP_AXIS
+        from ..utils.shard_map_compat import shard_map_nocheck
+
+        cfg = self.cfg
+        dt = cfg.dtype
+        params = self.variables["params"]
+        gated = cfg.activation == "swiglu"
+        col_names = ("gate_proj", "up_proj") if gated else ("up_proj",)
+        n_col = len(col_names)
+        has_bias = "bias" in params[col_names[0]]
+        dp = topo.dp_axes
+        args = [x.astype(dt)]
+        specs = [P(dp, TP_AXIS, None)]
+        for name in col_names:
+            args.append(params[name]["kernel"].astype(dt))
+            specs.append(P(None, TP_AXIS))
+        args.append(params["down_proj"]["kernel"].astype(dt))
+        specs.append(P(TP_AXIS, None))
+        if has_bias:
+            for name in col_names:
+                args.append(params[name]["bias"].astype(dt))
+                specs.append(P(TP_AXIS))
+
+        def body(x_, *rest):
+            cols, wd_ = rest[:n_col], rest[n_col]
+            bs = rest[n_col + 1:]
+            # local concat keeps each rank's [gate_shard | up_shard] layout
+            h = all_gather_matmul(x_, jnp.concatenate(cols, axis=-1), TP_AXIS)
+            if bs:
+                h = h + jnp.concatenate(bs, axis=-1)
+            if gated:
+                g, u = jnp.split(h, 2, axis=-1)
+                h = nn.silu(g) * u
+            else:
+                h = apply_activation(cfg.activation, h)
+            return matmul_reduce_scatter(h, wd_, TP_AXIS)
+
+        out = shard_map_nocheck(body, topo.mesh, tuple(specs),
+                                P(dp, TP_AXIS, None))(*args)
+        if has_bias:
+            out = out + params["down_proj"]["bias"].astype(dt)
+        return out
 
 
 class Block(nn.Module):

@@ -3,13 +3,19 @@ optimizer kernels, transformer layer API, quantizers, IO, Pallas kernels."""
 
 from . import adam
 from . import aio
+from . import collective_matmul
 from . import deepspeed4science
 from . import fp_quantizer
 from . import pallas
+from .collective_matmul import (all_gather_matmul, matmul_reduce_scatter,
+                                ring_all_gather, ring_reduce_scatter)
 from .optimizers import (adagrad, build_optimizer, fused_adam, fused_lamb,
                          fused_lion, sgd)
 from .transformer import DeepSpeedTransformerConfig, DeepSpeedTransformerLayer
 
-__all__ = ["adam", "aio", "deepspeed4science", "fp_quantizer", "pallas", "build_optimizer",
+__all__ = ["adam", "aio", "collective_matmul", "deepspeed4science",
+           "fp_quantizer", "pallas", "build_optimizer",
            "fused_adam", "fused_lamb", "fused_lion", "adagrad", "sgd",
+           "all_gather_matmul", "matmul_reduce_scatter",
+           "ring_all_gather", "ring_reduce_scatter",
            "DeepSpeedTransformerConfig", "DeepSpeedTransformerLayer"]

@@ -1,0 +1,346 @@
+"""Latency-hiding collective matmul: ring-overlapped gather/scatter + matmul.
+
+The declarative TP/ZeRO path lets XLA insert each collective *then* run the
+matmul as two serial ops — ICI idles during compute, MXU idles during the
+gather. T3 (arxiv 2401.16677) and fused computation-collective ops (arxiv
+2305.06942) decompose the collective into ring chunks interleaved with
+partial matmuls so the permutes hide behind the MXU. On TPU this is
+expressible natively: ``shard_map`` + ``lax.ppermute`` double buffering —
+each step's partial matmul reads the *current* buffer while the next chunk's
+permute is already in flight (read-read independence; XLA's async
+collective-permute overlaps them), no custom runtime needed.
+
+Primitives (called INSIDE ``shard_map``, per-shard values, single mesh-axis
+name — the same calling convention as ``comm.comm`` collectives):
+
+* :func:`all_gather_matmul` — ``all_gather(x) @ w`` with the gather ring
+  hidden behind the partial products. A ``bidirectional`` ring sends chunks
+  both ways and halves the step count (both ICI directions busy).
+* :func:`matmul_reduce_scatter` — ``psum_scatter(x @ w)`` with the reduction
+  ring hidden behind the chunked matmul.
+
+Each carries a ``custom_vjp`` realizing the transpose duality: the backward
+of ``all_gather_matmul`` *is* ``matmul_reduce_scatter`` (and vice versa), so
+training steps hide latency in both directions. Each falls back to the plain
+``all_gather``/``psum_scatter`` + ``jnp.einsum`` composition when the axis
+size is 1; ragged global shapes (dims that don't chunk evenly over the
+axis) are handled one level up — the consumer wiring
+(``models/transformer.py``, ``sequence/layer.py``) checks
+:func:`overlap_ready` and falls back to the declarative GSPMD composition.
+
+:func:`ring_all_gather` / :func:`ring_reduce_scatter` are the exact,
+matmul-free ring halves — ZeRO-3/ZeRO++ wires them into the unquantized
+qwZ/qgZ param gather and gradient scatter (``runtime/zero/zeropp.py``) so
+XLA can interleave one parameter's chunked transfer with another's compute.
+
+All ring traffic is recorded in the comms ledger at trace time
+(``comm.log_chunked``) so ``_COMMS_LOGGER`` totals stay truthful.
+"""
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = [
+    "all_gather_matmul", "matmul_reduce_scatter",
+    "ring_all_gather", "ring_reduce_scatter",
+    "overlap_ready", "overlap_enabled", "set_overlap_enabled",
+]
+
+# Config-knob default (TensorParallelConfig.overlap_collective_matmul):
+# initialize() sets this so model code built from a DeepSpeed JSON config
+# picks the overlapped path up without a model-config edit.
+_OVERLAP_DEFAULT = False
+
+
+def set_overlap_enabled(on: bool) -> None:
+    global _OVERLAP_DEFAULT
+    _OVERLAP_DEFAULT = bool(on)
+
+
+def overlap_enabled() -> bool:
+    return _OVERLAP_DEFAULT
+
+
+def overlap_ready(axis_size: int, *dims: int) -> bool:
+    """True when the ring path applies: a real axis and every ``dim`` chunks
+    evenly over it. Callers fall back to the unfused composition otherwise."""
+    return axis_size > 1 and all(d % axis_size == 0 for d in dims)
+
+
+def _axis_size(axis: str) -> int:
+    from ..utils.shard_map_compat import axis_size
+
+    return axis_size(axis)
+
+
+def _fwd_perm(p: int):
+    return [(i, (i + 1) % p) for i in range(p)]
+
+
+def _bwd_perm(p: int):
+    return [(i, (i - 1) % p) for i in range(p)]
+
+
+def _log_ring(op: str, nbytes: int) -> None:
+    from ..comm.comm import log_chunked
+
+    log_chunked(op, int(nbytes))
+
+
+def _nbytes(x) -> int:
+    return int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize
+
+
+def _mm(x, w):
+    """The partial product: contract x's last dim with w's first."""
+    return jnp.einsum("...k,kn->...n", x, w)
+
+
+# ---------------------------------------------------------------------------
+# all_gather_matmul
+# ---------------------------------------------------------------------------
+
+
+def _agmm_impl(x, w, axis: str, bidirectional: bool):
+    p = _axis_size(axis)
+    idx = lax.axis_index(axis)
+    m = x.shape[-2]
+    _log_ring("all_gather_matmul", (p - 1) * _nbytes(x))
+    out = jnp.zeros(x.shape[:-2] + (p * m, w.shape[-1]), jnp.result_type(x, w))
+
+    def put(o, val, j):
+        return lax.dynamic_update_slice_in_dim(o, val, j * m, axis=-2)
+
+    # local chunk first: its matmul runs while the first permute is in flight
+    out = put(out, _mm(x, w), idx)
+    if not bidirectional:
+        buf = x
+        for s in range(1, p):
+            buf = lax.ppermute(buf, axis, _fwd_perm(p))
+            out = put(out, _mm(buf, w), (idx - s) % p)
+        return out
+    # bidirectional: chunks idx-1..idx-ceil((p-1)/2) arrive over the forward
+    # ring, idx+1..idx+floor((p-1)/2) over the backward ring — same total
+    # bytes, both ICI directions busy, half the ring steps
+    n_f = (p - 1 + 1) // 2
+    n_b = (p - 1) // 2
+    buf_f = buf_b = x
+    for s in range(1, n_f + 1):
+        buf_f = lax.ppermute(buf_f, axis, _fwd_perm(p))
+        out = put(out, _mm(buf_f, w), (idx - s) % p)
+        if s <= n_b:
+            buf_b = lax.ppermute(buf_b, axis, _bwd_perm(p))
+            out = put(out, _mm(buf_b, w), (idx + s) % p)
+    return out
+
+
+def _ring_weight_grad(rot, full, axis: str):
+    """``sum_j rot_j^T @ full[chunk j]`` with ``rot`` circulating the ring —
+    the weight-cotangent form shared by both primitives' backwards (the
+    gathered operand is re-walked chunkwise instead of re-materialized)."""
+    p = _axis_size(axis)
+    idx = lax.axis_index(axis)
+    m = rot.shape[-2]
+    _log_ring("collective_matmul_wgrad", (p - 1) * _nbytes(rot))
+
+    def chunk(s):
+        j = (idx - s) % p
+        return lax.dynamic_slice_in_dim(full, j * m, m, axis=-2)
+
+    acc = jnp.einsum("...ma,...mb->ab", rot, chunk(0))
+    for s in range(1, p):
+        rot = lax.ppermute(rot, axis, _fwd_perm(p))
+        acc = acc + jnp.einsum("...ma,...mb->ab", rot, chunk(s))
+    return acc
+
+
+def all_gather_matmul(x, w, axis: str, *, bidirectional: bool = False):
+    """``all_gather(x, axis) @ w`` with the gather hidden behind the matmul.
+
+    Call inside ``shard_map``. ``x: [..., m, k]`` (this rank's row chunk of
+    the gathered operand), ``w: [k, n]`` (this rank's column shard) →
+    ``[..., p*m, n]``. The ring rotates ``x`` chunks via ``ppermute`` while
+    each resident chunk's partial product lands in its output rows —
+    column-parallel linears consume this with sequence-sharded activations
+    (Megatron-SP / T3 all-gather side).
+
+    Differentiable: ``dx`` returns through :func:`matmul_reduce_scatter`
+    (the transpose dual), ``dw`` through a chunked ring accumulation.
+    Falls back to the unfused ``all_gather`` + einsum when the axis size
+    is 1.
+    """
+    p = _axis_size(axis)
+    if p == 1:
+        return _mm(lax.all_gather(x, axis, axis=0, tiled=True), w)
+
+    @jax.custom_vjp
+    def agmm(x, w):
+        return _agmm_impl(x, w, axis, bidirectional)
+
+    def fwd(x, w):
+        return _agmm_impl(x, w, axis, bidirectional), (x, w)
+
+    def bwd(res, dy):
+        x, w = res
+        dx = matmul_reduce_scatter(dy, jnp.swapaxes(w, 0, 1), axis)
+        dw = _ring_weight_grad(x, dy, axis)
+        return dx, dw
+
+    agmm.defvjp(fwd, bwd)
+    return agmm(x, w)
+
+
+def fused_qkv_all_gather_matmul(x, wq, wk, wv, biases, head_dim, axis,
+                                *, bidirectional: bool = False):
+    """Per-shard fused qkv projection: concat the three kernels, ONE ring
+    :func:`all_gather_matmul` (the sequence gathers while only this rank's
+    head blocks compute), split back into ``[b, S, heads, dh]``. Shared by
+    the TP attention wiring (axis='tp') and the Ulysses projection exchange
+    (axis='sp'). ``wq/wk/wv: [D, h_l, dh]`` local kernel shards; ``biases``
+    is empty or the three matching ``[h_l, dh]`` bias shards."""
+    dmodel, dh = wq.shape[0], head_dim
+    hl, hkl = wq.shape[1], wk.shape[1]
+    wcat = jnp.concatenate([w.reshape(dmodel, -1) for w in (wq, wk, wv)],
+                           axis=-1)
+    qkv = all_gather_matmul(x, wcat, axis, bidirectional=bidirectional)
+    if biases:
+        qkv = qkv + jnp.concatenate([b.reshape(-1) for b in biases])
+    q, k, v = jnp.split(qkv, [hl * dh, (hl + hkl) * dh], axis=-1)
+    b_, s_ = q.shape[:2]
+    return (q.reshape(b_, s_, hl, dh), k.reshape(b_, s_, hkl, dh),
+            v.reshape(b_, s_, hkl, dh))
+
+
+# ---------------------------------------------------------------------------
+# matmul_reduce_scatter
+# ---------------------------------------------------------------------------
+
+
+def _mmrs_impl(x, w, axis: str):
+    p = _axis_size(axis)
+    idx = lax.axis_index(axis)
+    m = x.shape[-2] // p
+
+    def part(s):
+        # chunk resident at this rank at step s: it entered the ring at rank
+        # j+1 and lands fully-reduced at rank j after p-1 permutes
+        j = (idx - s - 1) % p
+        xs = lax.dynamic_slice_in_dim(x, j * m, m, axis=-2)
+        return _mm(xs, w)
+
+    acc = part(0)
+    _log_ring("matmul_reduce_scatter", (p - 1) * _nbytes(acc))
+    for s in range(1, p):
+        acc = lax.ppermute(acc, axis, _fwd_perm(p)) + part(s)
+    return acc
+
+
+def matmul_reduce_scatter(x, w, axis: str):
+    """``psum_scatter(x @ w, axis)`` (scatter over the row dim) with the
+    reduction ring hidden behind the chunked matmul.
+
+    Call inside ``shard_map``. ``x: [..., M, k]`` (this rank's contraction
+    shard), ``w: [k, n]`` (row-parallel shard) → ``[..., M/p, n]``: each
+    rank ends with its row chunk of the summed product — row-parallel
+    linears consume this to hand sequence-sharded activations to the next
+    layer (Megatron-SP / T3 reduce-scatter side). Requires ``M % p == 0``
+    (wiring checks :func:`overlap_ready` and falls back otherwise).
+
+    Differentiable: ``dx`` returns through :func:`all_gather_matmul` (the
+    transpose dual). Falls back to einsum + ``psum_scatter`` composition
+    semantics when the axis size is 1 (a no-op scatter).
+    """
+    p = _axis_size(axis)
+    if p == 1:
+        return _mm(x, w)
+    if x.shape[-2] % p:
+        raise ValueError(
+            f"matmul_reduce_scatter: rows {x.shape[-2]} don't chunk over "
+            f"axis {axis!r} of size {p}; use overlap_ready() and fall back")
+
+    @jax.custom_vjp
+    def mmrs(x, w):
+        return _mmrs_impl(x, w, axis)
+
+    def fwd(x, w):
+        return _mmrs_impl(x, w, axis), (x, w)
+
+    def bwd(res, dy):
+        x, w = res
+        dx = all_gather_matmul(dy, jnp.swapaxes(w, 0, 1), axis)
+        dw = jnp.swapaxes(_ring_weight_grad(dy, x, axis), 0, 1)
+        return dx, dw
+
+    mmrs.defvjp(fwd, bwd)
+    return mmrs(x, w)
+
+
+# ---------------------------------------------------------------------------
+# Exact ring collectives (no fused matmul) — the ZeRO-3 qwZ/qgZ wiring
+# ---------------------------------------------------------------------------
+
+
+def ring_all_gather(x, axis, *, bidirectional: bool = False):
+    """Tiled all-gather along dim 0 decomposed into ``p-1`` ``ppermute``
+    chunk hops — numerically identical to ``lax.all_gather(tiled=True)``
+    but chunked so XLA can interleave one tensor's transfer with another's
+    compute (the ZeRO-3 param-gather stream). Falls back to the fused
+    ``lax.all_gather`` for non-string axes and axis size 1. Differentiable
+    (the AD transpose of the ppermute chain is the exact chunked
+    reduce-scatter)."""
+    if not isinstance(axis, str):
+        return lax.all_gather(x, axis, axis=0, tiled=True)
+    p = _axis_size(axis)
+    if p == 1:
+        return lax.all_gather(x, axis, axis=0, tiled=True)
+    idx = lax.axis_index(axis)
+    m = x.shape[0]
+    _log_ring("ring_all_gather", (p - 1) * _nbytes(x))
+    out = jnp.zeros((p * m,) + x.shape[1:], x.dtype)
+    out = lax.dynamic_update_slice_in_dim(out, x, idx * m, axis=0)
+    if not bidirectional:
+        buf = x
+        for s in range(1, p):
+            buf = lax.ppermute(buf, axis, _fwd_perm(p))
+            out = lax.dynamic_update_slice_in_dim(out, buf, ((idx - s) % p) * m,
+                                                  axis=0)
+        return out
+    n_f, n_b = (p - 1 + 1) // 2, (p - 1) // 2
+    buf_f = buf_b = x
+    for s in range(1, n_f + 1):
+        buf_f = lax.ppermute(buf_f, axis, _fwd_perm(p))
+        out = lax.dynamic_update_slice_in_dim(out, buf_f, ((idx - s) % p) * m,
+                                              axis=0)
+        if s <= n_b:
+            buf_b = lax.ppermute(buf_b, axis, _bwd_perm(p))
+            out = lax.dynamic_update_slice_in_dim(out, buf_b,
+                                                  ((idx + s) % p) * m, axis=0)
+    return out
+
+
+def ring_reduce_scatter(x, axis):
+    """Tiled sum reduce-scatter along dim 0 decomposed into ring chunk hops —
+    numerically the same reduction tree as a ring ``psum_scatter`` (exact
+    qgZ gradient path). ``x: [p*m, ...] -> [m, ...]``. Falls back to
+    ``lax.psum_scatter`` for non-string axes and axis size 1."""
+    if not isinstance(axis, str):
+        return lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    p = _axis_size(axis)
+    if p == 1:
+        return lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    idx = lax.axis_index(axis)
+    m = x.shape[0] // p
+
+    def chunk(s):
+        j = (idx - s - 1) % p
+        return lax.dynamic_slice_in_dim(x, j * m, m, axis=0)
+
+    acc = chunk(0)
+    _log_ring("ring_reduce_scatter", (p - 1) * _nbytes(acc))
+    for s in range(1, p):
+        acc = lax.ppermute(acc, axis, _fwd_perm(p)) + chunk(s)
+    return acc

@@ -124,7 +124,9 @@ def quantized_reduce_scatter(x, axis, block: int = BLOCK):
     """
     from ... import comm as dist
 
-    world = jax.lax.axis_size(axis)
+    from ...utils.shard_map_compat import axis_size
+
+    world = axis_size(axis)
     n = int(np.prod(x.shape))
     if n % world:
         raise ValueError(f"size {n} not divisible by axis size {world}")

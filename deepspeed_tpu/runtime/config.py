@@ -200,6 +200,12 @@ class TensorParallelConfig(ConfigModel):
     """Training tensor parallelism (reference AutoTP / external mpu)."""
     enabled: bool = False
     tp_size: int = 1
+    # latency-hiding collective matmul (ops/collective_matmul.py): run the
+    # column/row-parallel linears, the Ulysses projection exchange, and the
+    # exact ZeRO-3 gather/scatter as ppermute rings overlapped with the
+    # partial matmuls (T3, arxiv 2401.16677). Ragged shapes fall back to
+    # the declarative GSPMD composition per call site.
+    overlap_collective_matmul: bool = False
 
 
 @register_config

@@ -952,6 +952,14 @@ class DeepSpeedTPUEngine:
         model, so this EAGERLY lowers+compiles so the first ``train_batch``
         pays no JIT cost inside the loop). ``backend``/``compile_kwargs``
         are accepted for signature parity; only "xla" exists on TPU."""
+        if isinstance(example_batch, str):
+            # reference signature compile(backend, compile_kwargs)
+            # (engine.py:3696): a string first positional arg IS the backend,
+            # not an example batch — shift the arguments accordingly
+            if compile_kwargs is None and not isinstance(backend, str):
+                compile_kwargs = backend
+            backend = example_batch
+            example_batch = None
         if backend != "xla":
             log_dist(f"compile backend {backend!r} ignored: XLA is the only "
                      "execution model on TPU")
@@ -1299,6 +1307,11 @@ def initialize(args=None,
                             tp=cfg.tensor_parallel.tp_size if cfg.tensor_parallel.enabled else 1)
         topology = Topology(spec)
     set_topology(topology)
+    # latency-hiding collective matmul: the runtime knob flips the fleet-wide
+    # default the model wiring reads (model configs can also opt in per-model
+    # via TransformerConfig.overlap_collective_matmul)
+    from ..ops.collective_matmul import set_overlap_enabled
+    set_overlap_enabled(bool(cfg.tensor_parallel.overlap_collective_matmul))
 
     loss_fn = model
     if hasattr(model, "apply") and hasattr(model, "init"):  # flax module

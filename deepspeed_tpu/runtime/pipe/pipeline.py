@@ -79,7 +79,9 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, microbatches: jnp.ndarr
     them).
     """
     stage = lax.axis_index(PP_AXIS)
-    n_stages = lax.axis_size(PP_AXIS)
+    from ...utils.shard_map_compat import axis_size
+
+    n_stages = axis_size(PP_AXIS)
     v = int(virtual_stages)
     m = jax.tree.leaves(microbatches)[0].shape[0]
     if v > 1 and m % n_stages:
@@ -316,12 +318,14 @@ def make_pipeline_loss_fn(embed_fn: Callable, block_fn: Callable, head_loss_fn: 
         # ALL mesh axes manual: grad-of-checkpoint inside a partial shard_map
         # emits residual specs over the auto axes and trips the out_specs
         # check; unused axes (sp/tp here) just see replicated values
-        losses = jax.shard_map(
-            pipe_body, mesh=mesh,
+        from ...utils.shard_map_compat import shard_map_nocheck_manual
+
+        losses = shard_map_nocheck_manual(
+            pipe_body, mesh,
             in_specs=(blocks_spec, rep, rep_h, mb_spec),
             out_specs=P(),
-            axis_names=set(mesh.axis_names),
-            check_vma=False)(blocks, params["embed"], params["head"], mbs)
+            axis_names=set(mesh.axis_names))(
+                blocks, params["embed"], params["head"], mbs)
         return jnp.mean(losses)
 
     # metadata for initialize() to cross-check against PipelineConfig

@@ -51,7 +51,9 @@ def sparse_all_reduce(st: SparseTensor, axis) -> jnp.ndarray:
     allgather the compact (indices, values), densify once, divide by world —
     comm volume is R·D per rank instead of V·D (reference
     ``sparse_allreduce_bucket``)."""
-    world = lax.axis_size(axis)
+    from ..utils.shard_map_compat import axis_size
+
+    world = axis_size(axis)
     all_idx = lax.all_gather(st.indices, axis)          # [W, R]
     all_val = lax.all_gather(st.values, axis)           # [W, R, D]
     merged = SparseTensor(indices=all_idx.reshape(-1),

@@ -74,7 +74,8 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
                               quantized_gradients: bool = True,
                               compute_dtype=jnp.float32,
                               quant_block: int = _PAD_QUANTUM,
-                              remat: Optional[str] = None):
+                              remat: Optional[str] = None,
+                              overlap_collective_matmul: Optional[bool] = None):
     """Build (init, step) for ZeRO-3 training with ZeRO++ collectives.
 
     ``init(params) -> ZeroPPState`` (shards placed over ``dp_axis``);
@@ -91,7 +92,21 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
     the remat modes gradients return through the gather's AD transpose
     (an exact sum reduce-scatter; with qwZ the quantized gather uses a
     straight-through estimator), so qgZ does not apply there.
+
+    ``overlap_collective_matmul``: route the EXACT (unquantized) param
+    gather and gradient reduction through the ring-chunked collectives of
+    ``ops/collective_matmul.py`` (``ring_all_gather`` /
+    ``ring_reduce_scatter``) — same numerics, but each tensor's transfer
+    is p-1 ppermute chunk hops XLA can interleave with another tensor's
+    matmuls (the T3-style latency hiding the fused primitives give TP).
+    ``None`` (default) follows the fleet-wide
+    ``TensorParallelConfig.overlap_collective_matmul`` knob set by
+    ``initialize()``. The quantized (qwZ/qgZ) paths are unaffected.
     """
+    if overlap_collective_matmul is None:
+        from ...ops.collective_matmul import overlap_enabled
+
+        overlap_collective_matmul = overlap_enabled()
     if remat not in (None, "hpz", "nothing"):
         raise ValueError(f"remat must be None|'hpz'|'nothing', got {remat!r}")
     if remat is not None and quantized_gradients:
@@ -123,6 +138,12 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
         n = int(np.prod(shape)) if shape else 1
         if quantized_weights:
             full = quantized_all_gather(local_1d, dp_axis, block=quant_block)
+        elif overlap_collective_matmul:
+            # ring-chunked exact gather: p-1 ppermute hops the scheduler can
+            # overlap with neighbouring params' matmuls
+            from ...ops.collective_matmul import ring_all_gather
+
+            full = ring_all_gather(local_1d, dp_axis)
         else:
             full = lax.all_gather(local_1d, dp_axis)
         return full.reshape(-1)[:n].reshape(shape).astype(compute_dtype)
@@ -132,6 +153,10 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
         transpose of the gather (shared by _reduce and the STE backward)."""
         flat = jnp.ravel(grad_full).astype(jnp.float32)
         flat = jnp.pad(flat, (0, dp * m - flat.shape[0]))
+        if overlap_collective_matmul:
+            from ...ops.collective_matmul import ring_reduce_scatter
+
+            return ring_reduce_scatter(flat, dp_axis)
         return lax.psum_scatter(flat, dp_axis, tiled=True)
 
     def _reduce(grad_full, m):

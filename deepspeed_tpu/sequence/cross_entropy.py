@@ -105,9 +105,11 @@ def vocab_sequence_parallel_cross_entropy(logits, targets, *, z_loss: float = 0.
         return vocab_parallel_cross_entropy(lg, tg, axis_name=TP_AXIS,
                                             z_loss=z_loss)
 
-    return jax.shard_map(body, mesh=topo.mesh,
-                         in_specs=(lg_spec, tg_spec), out_specs=tg_spec,
-                         check_vma=False)(logits, targets)
+    from ..utils.shard_map_compat import shard_map_nocheck
+
+    return shard_map_nocheck(body, topo.mesh,
+                             in_specs=(lg_spec, tg_spec),
+                             out_specs=tg_spec)(logits, targets)
 
 
 def sharded_lm_loss(hidden, head_kernel, tokens, *, loss_mask=None,
@@ -163,15 +165,17 @@ def _vocab_sharded_head_nll(hidden, head_kernel, targets, *, head_bias,
         return vocab_parallel_cross_entropy(lg, tg, axis_name=TP_AXIS,
                                             z_loss=z_loss)
 
+    from ..utils.shard_map_compat import shard_map_nocheck
+
     if head_bias is None:
-        return jax.shard_map(lambda h, k, tg: body(h, k, None, tg),
-                             mesh=topo.mesh,
-                             in_specs=(h_spec, k_spec, tg_spec),
-                             out_specs=tg_spec, check_vma=False)(
-                                 hidden, head_kernel, targets)
-    return jax.shard_map(body, mesh=topo.mesh,
-                         in_specs=(h_spec, k_spec, P(TP_AXIS), tg_spec),
-                         out_specs=tg_spec, check_vma=False)(
-                             hidden, head_kernel, head_bias, targets)
+        return shard_map_nocheck(lambda h, k, tg: body(h, k, None, tg),
+                                 topo.mesh,
+                                 in_specs=(h_spec, k_spec, tg_spec),
+                                 out_specs=tg_spec)(
+                                     hidden, head_kernel, targets)
+    return shard_map_nocheck(body, topo.mesh,
+                             in_specs=(h_spec, k_spec, P(TP_AXIS), tg_spec),
+                             out_specs=tg_spec)(
+                                 hidden, head_kernel, head_bias, targets)
 
 

@@ -149,9 +149,64 @@ def ulysses_attention(local_attn: Callable, q, k, v):
         out = local_attn(qg, kg, vg, None)  # full seq -> global positions
         return _all_to_all_seq_to_heads(out, sp)
 
-    out = jax.shard_map(body, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec),
-                        out_specs=q_spec, check_vma=False)(q, k, v)
+    from ..utils.shard_map_compat import shard_map_nocheck
+
+    out = shard_map_nocheck(body, mesh, in_specs=(q_spec, kv_spec, kv_spec),
+                            out_specs=q_spec)(q, k, v)
     return out[:, :, :h, :] if h_pad != h else out
+
+
+def ulysses_matmul_attention(local_attn, x, q_params, k_params, v_params,
+                             o_params, *, dtype=None):
+    """Ulysses with the projections fused into the sp exchange
+    (``ops/collective_matmul.py`` ring primitives, T3-style).
+
+    Instead of project-then-all-to-all, the qkv projections run as one ring
+    ``all_gather_matmul`` over ``sp`` — each rank gathers the sequence while
+    computing only its own head block — and the output projection runs as
+    ``matmul_reduce_scatter``, whose reduction ring re-scatters the sequence.
+    This replaces all four all-to-alls AND hides the remaining comm behind
+    the projection matmuls; bytes/rank stay O(S*D) like the a2a path.
+
+    ``x``: ``[B, S, D]`` with S sharded over sp (the engine batch layout);
+    ``*_params`` are the flax DenseGeneral param dicts (``kernel``
+    ``[D, H, Dh]`` for qkv / ``[H, Dh, D]`` for o, optional ``bias``).
+    Caller guarantees ``h % sp == 0``, ``hk % sp == 0``, ``S % sp == 0`` and
+    ``tp == 1`` (``ulysses_attention`` covers everything else). Returns the
+    projected attention output ``[B, S, D]``.
+    """
+    from ..ops.collective_matmul import (fused_qkv_all_gather_matmul,
+                                         matmul_reduce_scatter)
+    from ..utils.shard_map_compat import shard_map_nocheck
+
+    topo = get_topology()
+    dp = topo.dp_axes
+    dt = dtype or x.dtype
+    wq, wk, wv = (p["kernel"].astype(dt)
+                  for p in (q_params, k_params, v_params))
+    wo = o_params["kernel"].astype(dt)
+    dh = wq.shape[2]
+    w_spec = P(None, SP_AXIS, None)
+    args = [x.astype(dt), wq, wk, wv, wo]
+    specs = [P(dp, SP_AXIS, None), w_spec, w_spec, w_spec,
+             P(SP_AXIS, None, None)]
+    if "bias" in q_params:
+        args += [p["bias"].astype(dt) for p in (q_params, k_params, v_params)]
+        specs += [P(SP_AXIS, None)] * 3
+
+    def body(x_, wq_, wk_, wv_, wo_, *bs):
+        q_, k_, v_ = fused_qkv_all_gather_matmul(x_, wq_, wk_, wv_, bs, dh,
+                                                 SP_AXIS)
+        out = local_attn(q_, k_, v_, None)  # full seq, this rank's heads
+        b_, s_, hl = out.shape[:3]
+        return matmul_reduce_scatter(out.reshape(b_, s_, hl * dh),
+                                     wo_.reshape(hl * dh, -1), SP_AXIS)
+
+    out = shard_map_nocheck(body, topo.mesh, tuple(specs),
+                            P(dp, SP_AXIS, None))(*args)
+    if "bias" in o_params:
+        out = out + o_params["bias"].astype(dt)
+    return out
 
 
 def _ledger_note(op: str, k_local, sp: int, hk_local: int, rep: int = 1):

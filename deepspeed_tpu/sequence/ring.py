@@ -125,6 +125,8 @@ def ring_attention(q, k, v, *, apply_pos: Optional[Callable] = None,
         return ring_attention_local(q_, k_, v_, axis_size=sp, causal=causal,
                                     scale=scale)
 
-    return jax.shard_map(body, mesh=topo.mesh,
-                         in_specs=(io_spec, io_spec, io_spec),
-                         out_specs=io_spec, check_vma=False)(q, k, v)
+    from ..utils.shard_map_compat import shard_map_nocheck
+
+    return shard_map_nocheck(body, topo.mesh,
+                             in_specs=(io_spec, io_spec, io_spec),
+                             out_specs=io_spec)(q, k, v)

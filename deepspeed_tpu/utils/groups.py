@@ -25,9 +25,22 @@ def initialize(ep_size: int = 1, mpu=None) -> None:
     """Reference ``groups.initialize``: carve the expert-parallel axis into
     the current topology — every other spec field and the topology's device
     set are preserved (a subset-device or explicit-dp topology must not be
-    silently widened to all of ``jax.devices()``)."""
+    silently widened to all of ``jax.devices()``).
+
+    ``mpu`` is accepted for signature parity only: the reference would build
+    model-parallel groups from it, but here mesh-axis topology supersedes an
+    external model-parallel unit — warn so the caller gets a signal instead
+    of silently topology-derived groups."""
     import dataclasses
 
+    if mpu is not None:
+        from .logging import logger
+
+        logger.warning(
+            "groups.initialize: ignoring mpu=%r — named mesh-axis topology "
+            "supersedes an external model-parallel unit on TPU; set tensor/"
+            "sequence degrees via TopologySpec (parallel/topology.py) or the "
+            "tensor_parallel/sequence_parallel_size config knobs", mpu)
     topo = get_topology()
     set_topology(Topology(dataclasses.replace(topo.spec, ep=ep_size),
                           devices=list(topo.mesh.devices.flat)))

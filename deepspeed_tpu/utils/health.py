@@ -22,3 +22,21 @@ def accelerator_healthy(timeout_s: int = 180) -> bool:
         return r.returncode == 0 and r.stdout.strip().endswith("ok")
     except subprocess.TimeoutExpired:
         return False
+
+
+_COUNT_PROBE = "import jax; print(jax.device_count())"
+
+
+def accelerator_device_count(timeout_s: int = 180) -> int:
+    """Device count of the default backend, probed in a subprocess so the
+    CALLER never initializes the backend (same rationale as
+    ``accelerator_healthy``: a parent that touches the TPU holds it
+    exclusively and starves its child processes). 0 on hang/crash."""
+    try:
+        r = subprocess.run([sys.executable, "-c", _COUNT_PROBE],
+                           capture_output=True, text=True, timeout=timeout_s)
+        if r.returncode != 0:
+            return 0
+        return int(r.stdout.strip().splitlines()[-1])
+    except (subprocess.TimeoutExpired, ValueError, IndexError):
+        return 0

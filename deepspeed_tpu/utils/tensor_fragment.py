@@ -48,7 +48,9 @@ def _parts(path):
 def _resolve(tree, path):
     node = tree
     for p in _parts(path):
-        if isinstance(node, (list, tuple)):
+        if hasattr(node, "_fields") and isinstance(p, str) and p in node._fields:
+            node = getattr(node, p)  # NamedTuple by field name, like _replace
+        elif isinstance(node, (list, tuple)):
             node = node[int(p)]
         elif isinstance(node, dict):
             if p not in node:

@@ -17,6 +17,7 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.utils.shard_map_compat import shard_map_nocheck
 from deepspeed_tpu.parallel import Topology, TopologySpec, set_topology
 from deepspeed_tpu.runtime.domino import DominoTransformerLayer, domino_chunked
 
@@ -31,9 +32,9 @@ def _tp_block_fn(topo):
             h = jnp.tanh(x_ @ w1_)           # col-parallel: [B, F/tp]
             y = h @ w2_                      # row-parallel partial: [B, D]
             return jax.lax.psum(y, "tp")     # the TP allreduce
-        return jax.shard_map(body, mesh=mesh,
-                             in_specs=(P(), P(None, "tp"), P("tp", None)),
-                             out_specs=P(), check_vma=False)(x, w1, w2)
+        return shard_map_nocheck(body, mesh,
+                                 in_specs=(P(), P(None, "tp"), P("tp", None)),
+                                 out_specs=P())(x, w1, w2)
     return block
 
 

@@ -12,6 +12,7 @@ from deepspeed_tpu.ops.optimizers import fused_adam
 from deepspeed_tpu.ops.pallas.fused_adam import adam_update
 from deepspeed_tpu.ops.pallas.quant import (dequantize_int8, quantize_int8,
                                             quantized_all_gather, quantized_reduce_scatter)
+from deepspeed_tpu.utils.shard_map_compat import shard_map_nocheck
 from deepspeed_tpu.parallel import Topology, TopologySpec
 
 
@@ -80,8 +81,8 @@ def test_quantized_all_gather():
         def body(x):
             return quantized_all_gather(x[0], ("dp_outer", "ep"))
 
-        return jax.shard_map(body, mesh=mesh, in_specs=P(("dp_outer", "ep")),
-                             out_specs=P(None), check_vma=False)(x)
+        return shard_map_nocheck(body, mesh, in_specs=P(("dp_outer", "ep")),
+                                 out_specs=P(None))(x)
 
     out = np.asarray(f(x))  # [8, 256] gathered on every rank
     ref = np.asarray(x)
@@ -100,8 +101,8 @@ def test_quantized_reduce_scatter():
         def body(x):
             return quantized_reduce_scatter(x[0], ("dp_outer", "ep"))[None]
 
-        return jax.shard_map(body, mesh=mesh, in_specs=P(("dp_outer", "ep")),
-                             out_specs=P(("dp_outer", "ep")), check_vma=False)(xs)
+        return shard_map_nocheck(body, mesh, in_specs=P(("dp_outer", "ep")),
+                                 out_specs=P(("dp_outer", "ep")))(xs)
 
     out = np.asarray(f(xs)).reshape(-1)   # concatenated shards = full mean vector
     ref = np.asarray(xs).mean(axis=0)
