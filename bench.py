@@ -252,8 +252,14 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     import deepspeed_tpu as ds
+    import deepspeed_tpu.comm as dscomm
     from deepspeed_tpu.models.transformer import (TransformerLM, init_params,
                                                   llama_config, make_loss_fn)
+
+    # comms ledger on before the step traces: the headline row carries the
+    # per-op logical/wire byte profile like every ladder rung
+    dscomm.get_comms_logger().configure(enabled=True, prof_all=True)
+    dscomm.get_comms_logger().reset()
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
@@ -340,6 +346,9 @@ def main():
     mfu_incl_embed = (model_flops_per_token(cfg, seq, n_params)
                       * tokens_per_sec / peak_flops(dev))
 
+    ledger = dscomm.get_comms_logger().totals()
+    dscomm.get_comms_logger().configure(enabled=False)
+
     comm = comm_bandwidth()
     try:
         decode = decode_bench()
@@ -360,6 +369,7 @@ def main():
         "final_loss": final_loss,
         **comm,
         **decode,
+        **({"comms_ledger": ledger} if ledger else {}),
     }))
 
 
@@ -737,10 +747,106 @@ def collective_matmul_bench():
             "device": "tpu" if on_tpu else f"cpu-mesh-{n}"}
 
 
+def quantized_collectives_bench():
+    """Rung qx (compressed collectives, comm/compressed.py): time the exact
+    fp32 mean all-reduce against the EQuARX-style two-stage int8
+    quantized_all_reduce on a gradient-sized vector, and report the comms
+    ledger's logical-vs-wire bytes (the ≥3.5x on-wire reduction). On a
+    multi-chip TPU mesh the time ratio is real bandwidth recovered; on the
+    virtual CPU mesh the ledger numbers are the metric (both meshes run the
+    same program)."""
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.comm.compressed import quantized_all_reduce
+    from deepspeed_tpu.utils.shard_map_compat import shard_map_nocheck
+
+    devs = np.array(jax.devices())
+    n = len(devs)
+    if n < 2:
+        return {"metric": "quantized_allreduce", "value": None, "unit": "ratio",
+                "vs_baseline": None, "error": "needs a >=2 device mesh"}
+    mesh = Mesh(devs, ("dp",))
+    on_tpu = devs[0].platform == "tpu"
+    count = (32 * 2**20) if on_tpu else 2**22  # fp32 elements ("DP grads")
+    reps_lo, reps_hi = (4, 24) if on_tpu else (2, 6)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(count,)) * 0.1, jnp.float32)
+
+    def make(quant, reps):
+        def loop(v):
+            def body(c, _):
+                r = (quantized_all_reduce(c, "dp") if quant
+                     else lax.pmean(c, "dp"))
+                return r * jnp.float32(0.999) + c * jnp.float32(1e-3), ()
+            c, _ = lax.scan(body, v, None, length=reps)
+            return c[0]
+
+        return jax.jit(shard_map_nocheck(loop, mesh, in_specs=P(),
+                                         out_specs=P()))
+
+    def timed(quant):
+        f_lo, f_hi = make(quant, reps_lo), make(quant, reps_hi)
+        float(f_lo(x)); float(f_hi(x))  # compile + drain
+        t0 = time.perf_counter(); float(f_lo(x))
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter(); float(f_hi(x))
+        t_hi = time.perf_counter() - t0
+        return (t_hi - t_lo) / (reps_hi - reps_lo)
+
+    # ledger: probe exactly ONE traced quantized reduction -> logical vs
+    # on-wire bytes, then drop the probe entry so the _with_ledger snapshot
+    # attached to this row doesn't mix it with the timed compiles below.
+    # Restore enablement as found (the --ladder harness already has it on).
+    logger = dist.get_comms_logger()
+    was_enabled = logger.enabled
+    logger.configure(enabled=True, prof_all=True)
+    logger.reset()
+    jax.eval_shape(make(True, 1), x)
+    row = logger.totals().get("quantized_all_reduce", {})
+    logger.reset()
+    if not was_enabled:
+        logger.configure(enabled=False)
+    wire_reduction = (row["bytes"] / row["wire_bytes"]
+                      if row.get("wire_bytes") else None)
+
+    t_exact = timed(quant=False)
+    t_quant = timed(quant=True)
+    return {"metric": "quantized_allreduce",
+            "value": round(t_exact / t_quant, 4), "unit": "ratio",
+            "vs_baseline": None,
+            "t_exact_s": round(t_exact, 6), "t_quantized_s": round(t_quant, 6),
+            "elements": count, "devices": n,
+            "logical_bytes": row.get("bytes"), "wire_bytes": row.get("wire_bytes"),
+            "wire_reduction": round(wire_reduction, 2) if wire_reduction else None,
+            "device": "tpu" if on_tpu else f"cpu-mesh-{n}"}
+
+
 RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "3b": rung3b_big_model,
          "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses,
-         "cm": collective_matmul_bench}
+         "cm": collective_matmul_bench, "qx": quantized_collectives_bench}
+
+
+def _with_ledger(fn):
+    """Run one rung with the comms ledger enabled and attach the per-op
+    totals (logical and wire bytes per collective) to its JSON row, so
+    LADDER.json carries the communication profile alongside the timing."""
+    import deepspeed_tpu.comm as dist
+
+    logger = dist.get_comms_logger()
+    logger.configure(enabled=True, prof_all=True)
+    logger.reset()
+    try:
+        rec = fn()
+    finally:
+        totals = logger.totals()
+        logger.configure(enabled=False)
+        logger.reset()
+    if totals:
+        rec["comms_ledger"] = totals
+    return rec
 
 
 def run_ladder():
@@ -762,7 +868,8 @@ def run_ladder():
 
     multichip = healthy and accelerator_device_count() > 1
     plan = [("1", cpu1), ("2", chip), ("3", chip), ("4", cpu8), ("5", cpu8),
-            ("cm", {} if multichip else cpu8)]
+            ("cm", {} if multichip else cpu8),
+            ("qx", {} if multichip else cpu8)]
     results = []
     for rung, env_over in plan:
         env = dict(os.environ)
@@ -806,8 +913,8 @@ if __name__ == "__main__":
         flags_preset = ("--xla_force_host_platform_device_count"
                         in os.environ.get("XLA_FLAGS", ""))
         needs_cpu8 = args.rung in ("4", "5")
-        if args.rung == "cm" and not flags_preset:
-            # cm runs on the real mesh only when it's healthy AND >1 chip
+        if args.rung in ("cm", "qx") and not flags_preset:
+            # these run on the real mesh only when it's healthy AND >1 chip
             # (subprocess probes; this process must not init the backend yet)
             from deepspeed_tpu.utils.health import accelerator_device_count
 
@@ -823,6 +930,6 @@ if __name__ == "__main__":
         elif not accelerator_healthy():
             os.environ["JAX_PLATFORMS"] = "cpu"
             jax.config.update("jax_platforms", "cpu")
-        print(json.dumps(RUNGS[args.rung]()))
+        print(json.dumps(_with_ledger(RUNGS[args.rung])))
     else:
         main()

@@ -149,12 +149,21 @@ def _log_traced(op: str, x) -> None:
     _COMMS_LOGGER.append(op, _nbytes(x), traced=True)
 
 
-def log_chunked(op: str, nbytes: int) -> None:
+def log_chunked(op: str, nbytes: int, wire_bytes: Optional[int] = None) -> None:
     """Trace-time ledger entry for ring-chunked collectives
     (``ops/collective_matmul.py``): the chunk hops of one ring pass are
     recorded as a single entry covering the full ``(p-1)/p`` wire traffic,
     so ledger totals match what a fused collective would have reported."""
-    _COMMS_LOGGER.append(op, int(nbytes), traced=True)
+    _COMMS_LOGGER.append(op, int(nbytes), traced=True, wire_bytes=wire_bytes)
+
+
+def log_compressed(op: str, logical_bytes: int, wire_bytes: int) -> None:
+    """Trace-time ledger entry for a compressed collective
+    (``comm/compressed.py``): ``logical_bytes`` is what the exact collective
+    would have moved, ``wire_bytes`` what the int8 payload + scale lanes
+    actually ride the links with — ``log_summary`` reports the ratio."""
+    _COMMS_LOGGER.append(op, int(logical_bytes), traced=True,
+                         wire_bytes=int(wire_bytes))
 
 
 def all_reduce(x, axis: Axis, op: str = "sum"):

@@ -18,8 +18,9 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
-from .sharded_moe import (compute_capacity, dropless_moe, load_balance_aux,
-                          moe_combine, moe_dispatch, topk_gating)
+from .sharded_moe import (compute_capacity, dropless_moe, expert_ffn,
+                          load_balance_aux, moe_combine, moe_dispatch,
+                          quantized_ep_moe, quantized_ep_ready, topk_gating)
 
 
 def _constrain(x, spec, skip: bool = False):
@@ -197,28 +198,26 @@ class MoEBlock(nn.Module):
         dispatch = _constrain(dispatch, tok_mask_spec, skip)
         combine = _constrain(combine, tok_mask_spec, skip)
 
-        # expert-major dispatch: [E, G, C, D], experts over the ep axis
-        expert_in = moe_dispatch(x, dispatch)
-        expert_in = _constrain(expert_in, P("ep", ("dp_outer",), None, None), skip)
-
-        u = jnp.einsum("egcd,edf->egcf", expert_in, w_up.astype(x.dtype))
-        if b_up is not None:
-            u = u + b_up.astype(x.dtype)[:, None, None, :]
-        if swiglu:
-            h = jnp.einsum("egcd,edf->egcf", expert_in, w_gate.astype(x.dtype))
-            if b_gate is not None:
-                h = h + b_gate.astype(x.dtype)[:, None, None, :]
-            h = nn.silu(h) * u
-        else:
-            h = nn.gelu(u)
-        out = jnp.einsum("egcf,efd->egcd", h, w_down.astype(x.dtype))
-        if b_down is not None:
-            out = out + b_down.astype(x.dtype)[:, None, None, :]
-        out = _constrain(out, P("ep", ("dp_outer",), None, None), skip)
-
         self._sow_exp_counts(jax.nn.softmax(logits, axis=-1), k, e, used_token)
 
-        y = moe_combine(out, combine)
+        if not skip and quantized_ep_ready(e, g):
+            # compressed_collectives MoE site: the EP dispatch/combine
+            # exchange runs explicitly with int8 payloads (sharded_moe.py
+            # quantized_ep_moe) instead of the partitioner's exact a2a
+            y = quantized_ep_moe(
+                x, dispatch, combine, w_up, w_down, w_gate=w_gate,
+                b_up=b_up, b_down=b_down, b_gate=b_gate,
+                activation=cfg.activation)
+        else:
+            # expert-major dispatch: [E, G, C, D], experts over the ep axis
+            expert_in = moe_dispatch(x, dispatch)
+            expert_in = _constrain(expert_in, P("ep", ("dp_outer",), None, None), skip)
+            out = expert_ffn(expert_in, w_up, w_down, w_gate=w_gate,
+                             b_up=b_up, b_down=b_down, b_gate=b_gate,
+                             activation=cfg.activation)
+            out = _constrain(out, P("ep", ("dp_outer",), None, None), skip)
+
+            y = moe_combine(out, combine)
         y = add_shared(y.astype(x.dtype))
         y = _constrain(y, P(("dp_outer", "ep"), "sp", None), skip)
         return y.astype(x.dtype), aux * cfg.moe_aux_loss_weight

@@ -144,6 +144,108 @@ def moe_dispatch(x: jnp.ndarray, dispatch: jnp.ndarray) -> jnp.ndarray:
     return jnp.einsum("gsec,gsd->egcd", dispatch.astype(x.dtype), x)
 
 
+def expert_ffn(expert_in: jnp.ndarray, w_up, w_down, *, w_gate=None,
+               b_up=None, b_down=None, b_gate=None,
+               activation: str = "swiglu") -> jnp.ndarray:
+    """Expert-major FFN on ``[E, G, C, D]`` inputs — the ONE definition of
+    the per-expert compute, shared by the declarative capacity path
+    (``moe/layer.py``) and the explicit int8 EP path
+    (:func:`quantized_ep_moe`) so the two branches cannot drift."""
+    dt = expert_in.dtype
+    u = jnp.einsum("egcd,edf->egcf", expert_in, w_up.astype(dt))
+    if b_up is not None:
+        u = u + b_up.astype(dt)[:, None, None, :]
+    if activation == "swiglu":
+        h = jnp.einsum("egcd,edf->egcf", expert_in, w_gate.astype(dt))
+        if b_gate is not None:
+            h = h + b_gate.astype(dt)[:, None, None, :]
+        h = jax.nn.silu(h) * u
+    else:
+        h = jax.nn.gelu(u)
+    out = jnp.einsum("egcf,efd->egcd", h, w_down.astype(dt))
+    if b_down is not None:
+        out = out + b_down.astype(dt)[:, None, None, :]
+    return out
+
+
+def quantized_ep_ready(num_experts: int, num_groups: Optional[int] = None) -> bool:
+    """True when the explicit int8 EP exchange applies: a real ep axis the
+    experts split evenly over, full sequences rank-local (sp == 1 — the
+    dispatch slot einsum is exact only over the whole S axis), token groups
+    that shard evenly over the data axes (shard_map hard-requires the
+    divisibility the declarative constraints merely prefer), and the MoE
+    site enabled in ``compressed_collectives``."""
+    from ..comm.compressed import compression_mode
+    from ..parallel.topology import get_topology
+
+    # inside an enclosing shard_map (e.g. the SPMD pipeline body) the mesh
+    # axes are manual and a nested shard_map cannot open — declarative path
+    from ..utils.shard_map_compat import manual_axes
+
+    if manual_axes():
+        return False
+    topo = get_topology()
+    if num_groups is not None and num_groups % (topo.dp_outer_size
+                                                * topo.ep_size) != 0:
+        return False
+    return (compression_mode("moe") != "none" and topo.ep_size > 1
+            and topo.sp_size == 1 and num_experts % topo.ep_size == 0)
+
+
+def quantized_ep_moe(x, dispatch, combine, w_up, w_down, *, w_gate=None,
+                     b_up=None, b_down=None, b_gate=None,
+                     activation: str = "swiglu") -> jnp.ndarray:
+    """Capacity-path MoE with the EP dispatch/combine exchange carried as
+    int8 all-to-alls (``comm/compressed.py``).
+
+    The declarative path hands XLA the expert-major sharding constraint and
+    lets the partitioner insert EXACT all-to-alls for the token->expert
+    resharding; this runs the same exchange explicitly inside ``shard_map``
+    with quantized payloads — ~4x fewer EP-link bytes each way:
+
+      local dispatch einsum -> [E, G_l, C, D] full-E
+      quantized all-to-all (split E, concat tokens) -> [E/ep, G_l*ep, C, D]
+      expert FFN on local experts
+      quantized all-to-all back (split tokens, concat E) -> [E, G_l, C, D]
+      local combine einsum -> [G_l, S, D]
+
+    Backward rides the exchanges' straight-through vjp (exact transposed
+    all-to-alls). Callers check :func:`quantized_ep_ready` first.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..comm.compressed import quantized_all_to_all
+    from ..parallel.topology import EP_AXIS, get_topology
+    from ..utils.shard_map_compat import shard_map_nocheck
+
+    topo = get_topology()
+    tok = P(("dp_outer", EP_AXIS), None, None)
+    tok4 = P(("dp_outer", EP_AXIS), None, None, None)
+    exp_w = P(EP_AXIS)  # leading E dim sharded; trailing dims replicated
+    args = [x, dispatch, combine, w_up, w_down]
+    specs = [tok, tok4, tok4, exp_w, exp_w]
+    flags = []
+    for name, val in (("gate", w_gate), ("b_up", b_up), ("b_down", b_down),
+                      ("b_gate", b_gate)):
+        if val is not None:
+            flags.append(name)
+            args.append(val)
+            specs.append(exp_w)
+
+    def body(x_, d_, c_, wu_, wd_, *rest):
+        opt = dict(zip(flags, rest))
+        ei = moe_dispatch(x_, d_)                            # [E, G_l, C, D]
+        ei = quantized_all_to_all(ei, EP_AXIS, split_dim=0, concat_dim=1)
+        out = expert_ffn(ei, wu_, wd_, w_gate=opt.get("gate"),
+                         b_up=opt.get("b_up"), b_down=opt.get("b_down"),
+                         b_gate=opt.get("b_gate"), activation=activation)
+        out = quantized_all_to_all(out, EP_AXIS, split_dim=1, concat_dim=0)
+        return moe_combine(out, c_)                          # [G_l, S, D]
+
+    return shard_map_nocheck(body, topo.mesh, in_specs=tuple(specs),
+                             out_specs=tok)(*args)
+
+
 def moe_combine(expert_out: jnp.ndarray, combine: jnp.ndarray) -> jnp.ndarray:
     """expert outputs [E,G,C,D] x combine [G,S,E,C] -> tokens [G,S,D]."""
     return jnp.einsum("egcd,gsec->gsd", expert_out, combine.astype(expert_out.dtype))

@@ -38,6 +38,20 @@ def _quant_kernel(x_ref, q_ref, s_ref):
     s_ref[:] = jnp.broadcast_to(scale, s_ref.shape)
 
 
+def _quant_sr_kernel(x_ref, u_ref, q_ref, s_ref):
+    """Stochastic-rounding variant: ``floor(x/scale + u)`` with ``u~U[0,1)``
+    is unbiased per element (``E[q*scale] = x``), so gradient compression
+    carries no systematic rounding drift (the EQuARX argument for why int8
+    reductions train clean). Zero padding stays exactly zero
+    (``floor(0+u) = 0`` for ``u < 1``)."""
+    x = x_ref[:]
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.floor(x / scale + u_ref[:]), -127, 127).astype(jnp.int8)
+    q_ref[:] = q
+    s_ref[:] = jnp.broadcast_to(scale, s_ref.shape)
+
+
 def _dequant_kernel(q_ref, s_ref, x_ref):
     x_ref[:] = q_ref[:].astype(jnp.float32) * s_ref[:, :1]
 
@@ -49,27 +63,56 @@ def _tile_rows(nb: int) -> int:
     return t
 
 
+def shard_layout(n: int, world: int, block: int) -> Tuple[int, int, int]:
+    """(shard, shard_padded, block) for an n-element tensor split into equal
+    per-rank shards: ceil-divide, pad each shard to the 128-lane quantum, and
+    fall back to 128-element blocks when the padded shard doesn't hold whole
+    blocks. The SINGLE source of this arithmetic — the collectives here and
+    the ledger wire-bytes accounting in ``comm/compressed.py`` must agree on
+    it or the reported on-wire bytes drift from what actually moves."""
+    shard = -(-n // world)
+    shard_p = -(-shard // 128) * 128
+    if shard_p % block != 0:
+        block = 128
+    return shard, shard_p, block
+
+
 def quantize_int8(x: jnp.ndarray, block: int = BLOCK,
-                  interpret=None) -> Tuple[jnp.ndarray, jnp.ndarray, tuple]:
+                  interpret=None, *, stochastic: bool = False,
+                  key=None) -> Tuple[jnp.ndarray, jnp.ndarray, tuple]:
     """-> (int8 values [nb, block], fp32 scales [nb, 128], original shape).
     Scales are lane-replicated (nb, 128) for TPU tiling; column 0 is
-    authoritative. Gridded so arbitrarily large tensors stream through VMEM."""
+    authoritative. Gridded so arbitrarily large tensors stream through VMEM.
+
+    ``stochastic=True`` rounds with uniform dither (``key`` required): each
+    element rounds to a neighbouring int8 level with probability equal to its
+    fractional part, making the compression unbiased — the right mode for
+    gradient reductions, where nearest-rounding bias compounds over steps."""
     shape = x.shape
     n = int(np.prod(shape)) if shape else 1
     nb = -(-n // block)
     flat = jnp.pad(jnp.ravel(x).astype(jnp.float32), (0, nb * block - n))
     x2 = flat.reshape(nb, block)
     t = _tile_rows(nb)
-    q, s = pl.pallas_call(
-        _quant_kernel,
-        grid=(nb // t,),
-        in_specs=[pl.BlockSpec((t, block), lambda i: (i, 0), memory_space=pltpu.VMEM)],
-        out_specs=[pl.BlockSpec((t, block), lambda i: (i, 0), memory_space=pltpu.VMEM),
-                   pl.BlockSpec((t, 128), lambda i: (i, 0), memory_space=pltpu.VMEM)],
-        out_shape=[jax.ShapeDtypeStruct((nb, block), jnp.int8),
-                   jax.ShapeDtypeStruct((nb, 128), jnp.float32)],
-        interpret=_interp(interpret),
-    )(x2)
+    spec = pl.BlockSpec((t, block), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    out_specs = [spec, pl.BlockSpec((t, 128), lambda i: (i, 0), memory_space=pltpu.VMEM)]
+    out_shape = [jax.ShapeDtypeStruct((nb, block), jnp.int8),
+                 jax.ShapeDtypeStruct((nb, 128), jnp.float32)]
+    if stochastic:
+        if key is None:
+            raise ValueError("stochastic rounding needs a PRNG key")
+        u = jax.random.uniform(key, (nb, block), jnp.float32)
+        q, s = pl.pallas_call(
+            _quant_sr_kernel, grid=(nb // t,), in_specs=[spec, spec],
+            out_specs=out_specs, out_shape=out_shape,
+            interpret=_interp(interpret),
+        )(x2, u)
+    else:
+        q, s = pl.pallas_call(
+            _quant_kernel, grid=(nb // t,), in_specs=[spec],
+            out_specs=out_specs, out_shape=out_shape,
+            interpret=_interp(interpret),
+        )(x2)
     return q, s, shape
 
 
@@ -97,16 +140,19 @@ def dequantize_int8(q: jnp.ndarray, s: jnp.ndarray, shape, dtype=jnp.float32,
 # ---------------------------------------------------------------------------
 
 
-def quantized_all_gather(x, axis, block: int = BLOCK):
+def quantized_all_gather(x, axis, block: int = BLOCK, *,
+                         stochastic: bool = False, key=None):
     """qwZ-style allgather: int8 payload + scales over the wire (reference
     quantized weight allgather, ``partition_parameters.py:761``
-    ``CUDAQuantizer``). Call inside shard_map; returns ``[world, *x.shape]``."""
-    from ... import comm as dist
+    ``CUDAQuantizer``). Call inside shard_map; returns ``[world, *x.shape]``.
 
-    q, s, shape = quantize_int8(x, block)
+    Exchanges lower through ``lax`` directly — ledger accounting (logical vs
+    on-wire bytes) is the caller's job (``comm/compressed.py`` logs one
+    ``quantized_all_gather`` entry per call)."""
+    q, s, shape = quantize_int8(x, block, stochastic=stochastic, key=key)
     nb = q.shape[0]
-    qg = dist.all_gather(q, axis=axis, tiled=False)           # [world, nb, block]
-    sg = dist.all_gather(s[:, :1], axis=axis, tiled=False)    # [world, nb, 1] — one lane on the wire
+    qg = jax.lax.all_gather(q, axis, axis=0, tiled=False)         # [world, nb, block]
+    sg = jax.lax.all_gather(s[:, :1], axis, axis=0, tiled=False)  # [world, nb, 1] — one lane on the wire
     world = qg.shape[0]
     n = int(np.prod(shape))
     deq = dequantize_int8(qg.reshape(world * nb, block), sg.reshape(world * nb, 1),
@@ -114,38 +160,38 @@ def quantized_all_gather(x, axis, block: int = BLOCK):
     return deq.reshape(world, nb * block)[:, :n].reshape((world,) + tuple(shape))
 
 
-def quantized_reduce_scatter(x, axis, block: int = BLOCK):
+def quantized_reduce_scatter(x, axis, block: int = BLOCK, *,
+                             stochastic: bool = False, key=None):
     """qgZ-flavored gradient reduction: quantize the local full-size grad,
     all-to-all the int8 shards, dequantize and mean locally (reference qgZ
     quantized grad all-to-all, ``engine.py:1193``; quant_reduce.cu). The
-    result is this rank's shard of the mean, fp32.
+    result is this rank's shard of the mean, fp32, ``[ceil(n/world)]``.
 
-    Requires ``x.size`` divisible by the axis size; caller pads.
+    Arbitrary ``x.size`` works: the flat tensor pads up to a whole number of
+    equal per-rank shards, and each shard pads to the 128-lane block
+    boundary; pad lanes quantize to exact zeros and the trailing zeros land
+    in the LAST rank's shard tail (callers slicing the concatenated shards
+    back to ``n`` drop them). Ledger accounting lives in the
+    ``comm/compressed.py`` wrapper.
     """
-    from ... import comm as dist
-
     from ...utils.shard_map_compat import axis_size
 
     world = axis_size(axis)
     n = int(np.prod(x.shape))
-    if n % world:
-        raise ValueError(f"size {n} not divisible by axis size {world}")
-    shard = n // world
     # block boundaries must align with shard boundaries so each rank's blocks
-    # are contiguous in the [nb, block] layout
-    if shard % block != 0:
-        if shard % 128 == 0:
-            block = 128
-        else:
-            raise ValueError(f"shard size {shard} must be a multiple of 128")
-    # lay out as [world, shard] so the all-to-all exchanges equal shards
-    parts = jnp.reshape(x.astype(jnp.float32), (world, shard))
-    q, s, _ = quantize_int8(parts, block)              # [nb, block] covering all parts
+    # are contiguous in the [nb, block] layout; pad ragged tails up to the
+    # 128-lane quantum instead of rejecting them
+    shard, shard_p, block = shard_layout(n, world, block)
+    flat = jnp.pad(jnp.ravel(x).astype(jnp.float32), (0, world * shard - n))
+    # lay out as [world, shard_p] so the all-to-all exchanges equal shards
+    parts = jnp.pad(flat.reshape(world, shard), ((0, 0), (0, shard_p - shard)))
+    q, s, _ = quantize_int8(parts, block,              # [nb, block] covering all parts
+                            stochastic=stochastic, key=key)
     nb_per = q.shape[0] // world
     q = q.reshape(world, nb_per, block)
     s1 = s[:, :1].reshape(world, nb_per, 1)  # one scale lane over the wire
-    qt = dist.all_to_all(q, axis=axis, split_dim=0, concat_dim=0, tiled=False)
-    st = dist.all_to_all(s1, axis=axis, split_dim=0, concat_dim=0, tiled=False)
+    qt = jax.lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
+    st = jax.lax.all_to_all(s1, axis, split_axis=0, concat_axis=0, tiled=False)
     deq = dequantize_int8(qt.reshape(world * nb_per, block),
                           st.reshape(world * nb_per, 1),
                           (world * nb_per * block,))

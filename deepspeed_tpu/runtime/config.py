@@ -210,6 +210,37 @@ class TensorParallelConfig(ConfigModel):
 
 @register_config
 @dataclass
+class CompressedCollectivesConfig(ConfigModel):
+    """EQuARX-style quantized collectives (``comm/compressed.py``).
+
+    ``mode``: ``none`` (default — every wired site stays the bit-identical
+    exact path), ``int8`` (block-quantized payloads, nearest rounding), or
+    ``int8_sr`` (stochastic rounding on gradient reductions — unbiased
+    compression). Per-site toggles gate the four consumers independently;
+    ``hierarchical`` switches the DP gradient all-reduce to the two-level
+    form (inner mesh hop exact, outer hops quantized). Also accepted as a
+    bare string: ``"compressed_collectives": "int8"``.
+    """
+    mode: str = "none"           # none | int8 | int8_sr
+    block: int = 2048            # quantization block (elements per scale)
+    hierarchical: bool = False
+    # per-site toggles (only meaningful when mode != none)
+    dp_gradients: bool = True    # engine DP gradient reduction
+    zero_weights: bool = True    # ZeRO++ qwZ param gather
+    zero_gradients: bool = True  # ZeRO++ qgZ gradient reduce-scatter
+    moe_alltoall: bool = True    # MoE EP dispatch/combine exchange
+    ulysses_alltoall: bool = True  # Ulysses head/sequence exchanges
+
+    def site_map(self):
+        return {"dp_gradients": self.dp_gradients,
+                "zero_weights": self.zero_weights,
+                "zero_gradients": self.zero_gradients,
+                "moe": self.moe_alltoall,
+                "ulysses": self.ulysses_alltoall}
+
+
+@register_config
+@dataclass
 class MoEConfig(ConfigModel):
     """Expert parallelism (reference ``deepspeed/moe/``)."""
     enabled: bool = False
@@ -509,6 +540,8 @@ class DeepSpeedTPUConfig(ConfigModel):
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
     moe: MoEConfig = field(default_factory=MoEConfig)
+    compressed_collectives: CompressedCollectivesConfig = field(
+        default_factory=CompressedCollectivesConfig)
 
     # topology: sizes multiply to world size; dp is inferred
     sequence_parallel_size: int = 1
@@ -541,6 +574,10 @@ class DeepSpeedTPUConfig(ConfigModel):
         # curriculum_enabled_legacy, docs/_tutorials/curriculum-learning.md)
         # is the same scheduler the data_efficiency form configures — move
         # it to the modern location the engine reads
+        # string shorthand: "compressed_collectives": "int8" == {"mode": "int8"}
+        cc = d.get("compressed_collectives")
+        if isinstance(cc, str):
+            d["compressed_collectives"] = {"mode": cc}
         cl = d.pop("curriculum_learning", None)
         if cl:
             de = dict(d.get("data_efficiency") or {})

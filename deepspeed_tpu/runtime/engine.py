@@ -155,6 +155,13 @@ class DeepSpeedTPUEngine:
         self.topo = topology or get_topology()
         set_topology(self.topo)
         config.finalize(world_dp_size=self.topo.dp_size)
+        # compressed collectives: flip the fleet-wide default the wiring
+        # reads (comm/compressed.py — the set_overlap_enabled pattern)
+        cc = config.compressed_collectives
+        from ..comm.compressed import configure_compression
+        configure_compression(cc.mode, block=cc.block,
+                              hierarchical=cc.hierarchical,
+                              sites=cc.site_map())
         if (optimizer is not None and callable(optimizer)
                 and not hasattr(optimizer, "update")):
             # reference DeepSpeedOptimizerCallable (deepspeed/__init__.py:112):
@@ -493,6 +500,37 @@ class DeepSpeedTPUEngine:
             # knob is accepted but has no additional effect.
             log_dist("prescale_gradients is subsumed by SPMD mean-reduction; ignoring")
 
+        # compressed DP gradient reduction (comm/compressed.py): compute
+        # PER-SHARD grads under shard_map and reduce them with the int8
+        # two-stage all-reduce instead of letting SPMD insert the exact
+        # fp32 psum. Pure-DP stage-0 only: sharded params (ZeRO 1-3), model
+        # parallel axes, and MoE expert grads keep the exact path — their
+        # reductions live inside the declarative program. With the knob off
+        # this branch doesn't exist and the step is bit-identical to before.
+        # fp16 is excluded: the quantizer's where(absmax > 0) maps NaN grads
+        # to finite zeros, so an overflow would slip past the loss-scale
+        # skip gate — the exact psum propagates NaN and skips correctly
+        cc = config.compressed_collectives
+        compressed_dp = (cc.mode != "none" and cc.dp_gradients
+                         and config.zero_optimization.stage == 0
+                         and topo.pp_size == 1 and topo.tp_size == 1
+                         and topo.sp_size == 1 and not config.moe.enabled
+                         and topo.dp_size > 1 and self._host_adam is None
+                         and not fp16)
+        cc_hier = (cc.hierarchical and topo.ep_size > 1
+                   and topo.dp_outer_size > 1)
+        if cc.mode != "none" and cc.dp_gradients and not compressed_dp:
+            log_dist("compressed_collectives: DP gradient site needs pure "
+                     "data parallelism at ZeRO stage 0 without fp16 loss "
+                     "scaling — keeping the exact reduction (ZeRO++/MoE/"
+                     "Ulysses sites gate separately)")
+        if compressed_dp:
+            log_dist(f"compressed_collectives: DP gradients ride the "
+                     f"{cc.mode} all-reduce (block={cc.block}"
+                     f"{', hierarchical' if cc_hier else ''})")
+        self._compressed_dp = compressed_dp  # imperative backward() reads it
+        self._cc_hier = cc_hier
+
         def train_step(state: TrainState, batch, rng, *, ltd_keep=None,
                        moq_bits=None):
             scale = state.loss_scale.scale if fp16 else jnp.asarray(1.0, jnp.float32)
@@ -513,16 +551,21 @@ class DeepSpeedTPUEngine:
                 acc = jax.tree.map(jnp.add, acc, grads)
                 return acc, loss
 
-            zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-            zeros = jax.lax.with_sharding_constraint(zeros, rules.shardings(self.grad_spec_tree))
             rngs = jax.random.split(rng, gas)
-            acc, losses = lax.scan(micro, zeros, (batch, rngs))
+            if compressed_dp:
+                grads, losses = self._compressed_grad_phase(
+                    state.params, batch, rngs, rng, scale,
+                    ltd_keep=ltd_keep, moq_bits=moq_bits)
+            else:
+                zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                zeros = jax.lax.with_sharding_constraint(zeros, rules.shardings(self.grad_spec_tree))
+                acc, losses = lax.scan(micro, zeros, (batch, rngs))
 
-            # unscale (+ average over gas; per-microbatch losses are already
-            # global-batch means under SPMD — matches reference GAS loss
-            # scaling, engine.py:2023)
-            denom = scale * gas
-            grads = jax.tree.map(lambda g: g / denom, acc)
+                # unscale (+ average over gas; per-microbatch losses are
+                # already global-batch means under SPMD — matches reference
+                # GAS loss scaling, engine.py:2023)
+                denom = scale * gas
+                grads = jax.tree.map(lambda g: g / denom, acc)
             if self.frozen_patterns:
                 # requires_grad=False semantics: frozen grads are zeroed
                 # BEFORE the norm so clipping of trained params matches an
@@ -639,10 +682,91 @@ class DeepSpeedTPUEngine:
 
         self._make_train_step = make_train_step
         self._train_steps = {(None, None): make_train_step(None)}
+        self._compile_finish(state_sh)
+
+    def _compressed_grad_phase(self, params, batch, rngs, step_rng, scale,
+                               *, ltd_keep=None, moq_bits=None):
+        """GAS scan + quantized mean all-reduce, per-shard under shard_map.
+
+        The exact path lets SPMD insert fp32 psums where replicated params
+        meet dp-sharded batches; here each dp rank accumulates LOCAL grads
+        over the microbatch scan, flattens the whole tree into one vector
+        (one collective per step, the flat-buffer transport of
+        ``compression/onebit.py``), and reduces it with
+        ``comm.compressed.quantized_all_reduce`` — int8 payloads + one-lane
+        scales on the wire, ~3.5x fewer bytes than the psum pair. ``int8_sr``
+        dithers the rounding so the compressed mean is unbiased. Returns
+        (replicated fp32 grads — already unscaled and gas-averaged — and the
+        per-micro global-mean losses).
+
+        Semantics note: the reduction equal-weights the RANKS. A loss that
+        normalizes by a data-dependent count (e.g. a ragged valid-token
+        mask) is averaged as mean-of-per-rank-means here, while the exact
+        SPMD path computes the global count-weighted mean — identical for
+        the engine's fixed-shape microbatches, different when per-rank valid
+        counts diverge (the same contract as ``compression/onebit.py``'s
+        per-shard reduction)."""
+        from ..utils.shard_map_compat import shard_map_nocheck
+
+        topo, gas = self.topo, self.gas
+        dpaxes = topo.dp_axes
+        sr_key = jax.random.fold_in(step_rng, 0x0151)
+
+        def per_shard(p, b_l, rngs_l, k):
+            def micro_l(acc, xs):
+                mb, mb_rng = xs
+
+                def scaled_loss(pp):
+                    loss, _ = self._loss(pp, mb, mb_rng, ltd_keep=ltd_keep,
+                                         moq_bits=moq_bits)
+                    return loss * scale, loss
+
+                g, loss = jax.grad(scaled_loss, has_aux=True)(p)
+                g = jax.tree.map(lambda t: t.astype(jnp.float32), g)
+                return jax.tree.map(jnp.add, acc, g), loss
+
+            zeros = jax.tree.map(lambda l: jnp.zeros(l.shape, jnp.float32), p)
+            acc, losses = lax.scan(micro_l, zeros, (b_l, rngs_l))
+            acc = jax.tree.map(lambda g: g / (scale * gas), acc)
+            return (self._quantized_grad_reduce(acc, k),
+                    lax.pmean(losses, dpaxes))
+
+        return shard_map_nocheck(
+            per_shard, topo.mesh,
+            in_specs=(P(), P(None, dpaxes), P(), P()),
+            out_specs=(P(), P()))(params, batch, rngs, sr_key)
+
+    def _quantized_grad_reduce(self, grads, sr_key):
+        """Flatten a per-shard fp32 grad tree into ONE vector (the
+        flat-buffer transport — one collective per reduction, padding paid
+        once), mean-reduce it with the quantized (optionally hierarchical)
+        all-reduce, unflatten. Called INSIDE shard_map over the dp axes;
+        shared by the GAS-scan and imperative-backward() paths."""
+        from ..comm.compressed import (hierarchical_quantized_all_reduce,
+                                       quantized_all_reduce)
+
+        cc = self.config.compressed_collectives
+        sr = cc.mode == "int8_sr"
+        flat, tdef = jax.tree.flatten(grads)
+        sizes = [int(np.prod(g.shape)) for g in flat]
+        shapes = [g.shape for g in flat]
+        vec = jnp.concatenate([jnp.ravel(g) for g in flat])
+        kw = dict(block=cc.block, stochastic=sr, key=sr_key if sr else None)
+        if self._cc_hier:
+            # inner (ICI-local) hop exact, only the outer hops quantize
+            red = hierarchical_quantized_all_reduce(vec, "ep", "dp_outer", **kw)
+        else:
+            red = quantized_all_reduce(vec, self.topo.dp_axes, **kw)
+        offs = np.cumsum([0] + sizes)
+        return jax.tree.unflatten(tdef, [
+            red[offs[i]:offs[i + 1]].reshape(shapes[i])
+            for i in range(len(sizes))])
+
+    def _compile_finish(self, state_sh):
         self._train_step = self._train_steps[(None, None)]
         self._aot_step = None  # (executable, batch fingerprint) from compile()
         self._state_shardings = state_sh
-        self._rng = jax.random.PRNGKey(config.seed)
+        self._rng = jax.random.PRNGKey(self.config.seed)
 
     # ------------------------------------------------------------------
     # primary API
@@ -813,8 +937,16 @@ class DeepSpeedTPUEngine:
                     l, aux = self._loss(p, mb, rng)
                     return l * scale, l
 
-                grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params)
-                grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+                if self._compressed_dp:
+                    # imperative half of the compressed DP wiring: this
+                    # microbatch's per-shard grads ride the int8 all-reduce
+                    # (the site excludes fp16, so scale == 1 and the
+                    # accumulator contract is unchanged)
+                    grads, loss = self._compressed_micro_grads(
+                        state.params, mb, rng)
+                else:
+                    grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params)
+                    grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
                 acc = jax.tree.map(jnp.add, acc, grads)
                 return acc, loss
 
@@ -824,6 +956,30 @@ class DeepSpeedTPUEngine:
                                             self.state.params)
         self._rng, r = jax.random.split(self._rng)
         return self._micro_step_fn(self.state, self._compat_acc, batch, r)
+
+    def _compressed_micro_grads(self, params, mb, rng):
+        """Imperative ``backward()`` analogue of ``_compressed_grad_phase``:
+        ONE microbatch's per-shard grads, mean-reduced through the shared
+        ``_quantized_grad_reduce`` flat-buffer transport. Same rank-mean
+        semantics note as the GAS-scan path applies."""
+        from ..utils.shard_map_compat import shard_map_nocheck
+
+        dpaxes = self.topo.dp_axes
+
+        def per_shard(p, mb_l, r):
+            def loss_fn(pp):
+                l, _ = self._loss(pp, mb_l, r)
+                return l, l
+
+            g, loss = jax.grad(loss_fn, has_aux=True)(p)
+            g = jax.tree.map(lambda t: t.astype(jnp.float32), g)
+            return (self._quantized_grad_reduce(g, jax.random.fold_in(r, 0x0151)),
+                    lax.pmean(loss, dpaxes))
+
+        return shard_map_nocheck(
+            per_shard, self.topo.mesh,
+            in_specs=(P(), P(dpaxes), P()),
+            out_specs=(P(), P()))(params, mb, rng)
 
     def forward(self, batch):
         """Compute the loss for one microbatch (reference ``engine.forward:1848``).

@@ -30,7 +30,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ...ops.pallas.quant import quantized_all_gather, quantized_reduce_scatter
+from ...comm.compressed import quantized_all_gather, quantized_reduce_scatter
 from ...utils.shard_map_compat import shard_map_nocheck as _sm
 
 _PAD_QUANTUM = 128  # quantized_reduce_scatter block alignment
@@ -70,12 +70,13 @@ def _shard_leaf(p, dp: int) -> jnp.ndarray:
 
 def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
                               dp_axis: str = "dp",
-                              quantized_weights: bool = True,
-                              quantized_gradients: bool = True,
+                              quantized_weights: Optional[bool] = None,
+                              quantized_gradients: Optional[bool] = None,
                               compute_dtype=jnp.float32,
                               quant_block: int = _PAD_QUANTUM,
                               remat: Optional[str] = None,
-                              overlap_collective_matmul: Optional[bool] = None):
+                              overlap_collective_matmul: Optional[bool] = None,
+                              stochastic_rounding: Optional[bool] = None):
     """Build (init, step) for ZeRO-3 training with ZeRO++ collectives.
 
     ``init(params) -> ZeroPPState`` (shards placed over ``dp_axis``);
@@ -102,7 +103,33 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
     ``None`` (default) follows the fleet-wide
     ``TensorParallelConfig.overlap_collective_matmul`` knob set by
     ``initialize()``. The quantized (qwZ/qgZ) paths are unaffected.
+
+    ``stochastic_rounding``: dither the qgZ gradient quantization
+    (``compressed_collectives: int8_sr``) so the int8 reduction is unbiased
+    per element — rounding drift can't accumulate in the master shards over
+    steps. It applies ONLY to that reduction: weight gathers (qwZ) keep
+    nearest rounding (fresh masters re-quantize each step, no residual to
+    carry), and the remat modes have no qgZ reduction at all (gradients
+    return through the gather's exact AD transpose), so the flag is inert
+    there.
+
+    ``quantized_weights`` / ``quantized_gradients`` / ``stochastic_rounding``
+    default to ``None`` = follow the fleet-wide ``compressed_collectives``
+    knobs set by ``initialize()``: the ``zero_weights`` / ``zero_gradients``
+    site toggles gate qwZ/qgZ and ``int8_sr`` turns the dither on. With no
+    compression configured (mode ``none``) the legacy factory default —
+    both quantized paths ON — applies; explicit booleans always win.
     """
+    from ...comm.compressed import compression_mode
+
+    legacy = compression_mode() == "none"  # knob untouched: factory default
+    if quantized_weights is None:
+        quantized_weights = legacy or compression_mode("zero_weights") != "none"
+    if quantized_gradients is None:
+        quantized_gradients = (legacy
+                               or compression_mode("zero_gradients") != "none")
+    if stochastic_rounding is None:
+        stochastic_rounding = compression_mode("zero_gradients") == "int8_sr"
     if overlap_collective_matmul is None:
         from ...ops.collective_matmul import overlap_enabled
 
@@ -159,12 +186,14 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
             return ring_reduce_scatter(flat, dp_axis)
         return lax.psum_scatter(flat, dp_axis, tiled=True)
 
-    def _reduce(grad_full, m):
+    def _reduce(grad_full, m, sr_key=None):
         """full grad -> this rank's mean shard [m] fp32 (qgZ)."""
         if quantized_gradients:
             flat = jnp.ravel(grad_full).astype(jnp.float32)
             flat = jnp.pad(flat, (0, dp * m - flat.shape[0]))
-            return quantized_reduce_scatter(flat, dp_axis, block=quant_block)
+            return quantized_reduce_scatter(
+                flat, dp_axis, block=quant_block,
+                stochastic=sr_key is not None, key=sr_key)
         return _scatter_sum(grad_full, m) / dp
 
     def _ste_gather(m: int, shape):
@@ -185,12 +214,23 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
         g.defvjp(fwd, bwd)
         return g
 
+    # remat needs no term here: remat + quantized_gradients already raised
+    use_sr = stochastic_rounding and quantized_gradients
+
     def step(state: ZeroPPState, batch):
         flat_shapes = state_box["shapes"]
 
-        def body(shards, opt_state, mb):
+        def body(shards, opt_state, mb, step_ctr):
             local = jax.tree.map(lambda s: s[0], shards)   # [1, m] -> [m]
             leaves, tdef = jax.tree.flatten(local)
+            sr_base = None
+            if use_sr:
+                # per-(step, leaf, rank) dither streams decorrelate the
+                # rounding noise; unbiasedness needs none of that, but
+                # correlated dither would make the residual coherent
+                sr_base = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.PRNGKey(0x51), step_ctr),
+                    lax.axis_index(dp_axis))
 
             if remat is None:
                 # gather OUTSIDE autodiff: the gather is data movement, not
@@ -205,7 +245,10 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
 
                 loss, grads_full = jax.value_and_grad(forward)(full)
                 grad_shards = [
-                    _reduce(g, l.shape[0]) for g, l in zip(grads_full, leaves)]
+                    _reduce(g, l.shape[0],
+                            None if sr_base is None
+                            else jax.random.fold_in(sr_base, i))
+                    for i, (g, l) in enumerate(zip(grads_full, leaves))]
             else:
                 from jax.ad_checkpoint import checkpoint_name
 
@@ -239,9 +282,9 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
         opt_spec = shard_spec_tree(state.opt_state)
         new_shards, new_opt, loss = _sm(
             body, mesh,
-            in_specs=(sh_spec, opt_spec, P(dp_axis)),
+            in_specs=(sh_spec, opt_spec, P(dp_axis), P()),
             out_specs=(sh_spec, opt_spec, P()))(
-                state.shards, state.opt_state, batch)
+                state.shards, state.opt_state, batch, state.step)
         return ZeroPPState(step=state.step + 1, shards=new_shards,
                            opt_state=new_opt), loss
 

@@ -35,12 +35,24 @@ from ..parallel.topology import SP_AXIS, TP_AXIS, get_topology
 
 
 def _all_to_all_heads_to_seq(x, sp: int):
-    """[B, S/sp, H, D] -> [B, S, H/sp, D] over the sp axis."""
+    """[B, S/sp, H, D] -> [B, S, H/sp, D] over the sp axis. With the
+    ``compressed_collectives`` Ulysses site on, the payload rides int8 +
+    one-lane scales (``comm/compressed.py``; backward stays the exact
+    transposed exchange); ragged head counts fall back to the exact a2a."""
+    from ..comm.compressed import compression_mode, quantized_all_to_all
+
+    if compression_mode("ulysses") != "none" and x.shape[2] % sp == 0:
+        return quantized_all_to_all(x, SP_AXIS, split_dim=2, concat_dim=1)
     return jax.lax.all_to_all(x, SP_AXIS, split_axis=2, concat_axis=1, tiled=True)
 
 
 def _all_to_all_seq_to_heads(x, sp: int):
-    """[B, S, H/sp, D] -> [B, S/sp, H, D]."""
+    """[B, S, H/sp, D] -> [B, S/sp, H, D] (reverse exchange; same
+    compression gate as :func:`_all_to_all_heads_to_seq`)."""
+    from ..comm.compressed import compression_mode, quantized_all_to_all
+
+    if compression_mode("ulysses") != "none" and x.shape[1] % sp == 0:
+        return quantized_all_to_all(x, SP_AXIS, split_dim=1, concat_dim=2)
     return jax.lax.all_to_all(x, SP_AXIS, split_axis=1, concat_axis=2, tiled=True)
 
 

@@ -10,7 +10,7 @@ count/size/latency/algbw/busbw table the reference does.
 
 import time
 from collections import defaultdict
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 
 def get_msg_size(nbytes: int) -> str:
@@ -46,8 +46,12 @@ class CommsLogger:
         self.debug = debug
         self.prof_all = prof_all
         self.prof_ops = prof_ops or []
-        # op_name -> msg_size -> [count, total_latency_s, traced_count]
-        self.comms_dict: Dict[str, Dict[int, List[float]]] = defaultdict(lambda: defaultdict(lambda: [0, 0.0, 0]))
+        # op_name -> msg_size -> [count, total_latency_s, traced_count, wire_bytes_total]
+        # msg_size is the LOGICAL payload (what the exact collective would
+        # move); wire_bytes_total accumulates what actually rides the links —
+        # compressed collectives report int8 payload + scale lanes there.
+        self.comms_dict: Dict[str, Dict[int, List[float]]] = defaultdict(
+            lambda: defaultdict(lambda: [0, 0.0, 0, 0]))
 
     def configure(self, enabled=None, verbose=None, prof_all=None, prof_ops=None, debug=None):
         if enabled is not None:
@@ -66,35 +70,63 @@ class CommsLogger:
             return False
         return self.prof_all or op_name in self.prof_ops
 
-    def append(self, op_name: str, size_bytes: int, latency_s: float = 0.0, traced: bool = False):
+    def append(self, op_name: str, size_bytes: int, latency_s: float = 0.0, traced: bool = False,
+               wire_bytes: Optional[int] = None):
+        """``wire_bytes`` defaults to ``size_bytes`` (exact collectives move
+        what they carry); compressed collectives pass the smaller on-wire
+        total so the ledger can report the compression ratio."""
         if not self._should_log(op_name):
             return
         rec = self.comms_dict[op_name][size_bytes]
         rec[0] += 1
         rec[1] += latency_s
         rec[2] += 1 if traced else 0
+        rec[3] += int(size_bytes if wire_bytes is None else wire_bytes)
         if self.verbose:
             from .logging import logger
 
             kind = "traced" if traced else f"{latency_s*1e3:.2f} ms"
             logger.info(f"comm op: {op_name} | size: {get_msg_size(size_bytes)} | {kind}")
 
-    def log_summary(self, world_size: int = 1, show_straggler: bool = False) -> str:
+    def totals(self) -> Dict[str, Dict[str, Any]]:
+        """Aggregate per-op totals: op -> {count, bytes, wire_bytes,
+        total_latency_ms} — logical bytes are count-weighted (one entry per
+        issued collective), wire bytes are the accumulated on-wire totals."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for op_name, sizes in self.comms_dict.items():
+            count = byts = wire = 0
+            lat = 0.0
+            for size, rec in sizes.items():
+                count += rec[0]
+                byts += size * rec[0]
+                lat += rec[1]
+                wire += rec[3]
+            out[op_name] = {"count": count, "bytes": byts, "wire_bytes": wire,
+                            "total_latency_ms": lat * 1e3}
+        return out
+
+    def log_summary(self, world_size: int = 1, show_straggler: bool = False) -> Dict[str, Dict[str, Any]]:
+        """Print the reference count/size/latency/bw table and RETURN the
+        per-op totals dict (op -> {count, bytes, wire_bytes, ...}) so bench
+        and the monitor can record the numbers without re-parsing stdout."""
         lines = []
-        header = f"{'Comm op':<28}{'Message size':<16}{'Count':<8}{'Total lat(ms)':<15}{'Avg lat(ms)':<13}{'algbw(GB/s)':<13}{'busbw(GB/s)':<13}"
+        header = (f"{'Comm op':<28}{'Message size':<16}{'Count':<8}{'Total lat(ms)':<15}"
+                  f"{'Avg lat(ms)':<13}{'algbw(GB/s)':<13}{'busbw(GB/s)':<13}{'wire':<10}")
         lines.append(header)
         lines.append("-" * len(header))
         for op_name, sizes in sorted(self.comms_dict.items()):
-            for size, (count, total_lat, traced) in sorted(sizes.items()):
+            for size, (count, total_lat, traced, wire) in sorted(sizes.items()):
                 timed_count = count - traced
                 avg = total_lat / timed_count if timed_count else 0.0
                 algbw, busbw = calc_bw(op_name, size, avg, world_size)
+                logical = size * count
+                ratio = f"{logical / wire:.2f}x" if wire and wire < logical else "1x"
                 note = f"(+{traced} traced)" if traced else ""
                 lines.append(f"{op_name:<28}{get_msg_size(size):<16}{count:<8}"
-                             f"{total_lat*1e3:<15.2f}{avg*1e3:<13.3f}{algbw:<13.2f}{busbw:<13.2f}{note}")
-        out = "\n".join(lines)
-        print(out, flush=True)
-        return out
+                             f"{total_lat*1e3:<15.2f}{avg*1e3:<13.3f}{algbw:<13.2f}{busbw:<13.2f}"
+                             f"{ratio:<10}{note}")
+        print("\n".join(lines), flush=True)
+        return self.totals()
 
     def reset(self):
         self.comms_dict.clear()

@@ -108,3 +108,79 @@ def test_quantized_reduce_scatter():
     ref = np.asarray(xs).mean(axis=0)
     # quantization error ~ per-block absmax/127, mean over 8 ranks
     assert np.abs(out - ref).max() <= np.abs(np.asarray(xs)).max() / 127 + 1e-5
+
+
+@pytest.mark.parametrize("n", [1000, 1001, 8 * 200])
+def test_quantized_reduce_scatter_ragged_tail(n):
+    """Regression: per-rank shards that are NOT a multiple of 128 (and sizes
+    not divisible by the axis) pad to the block boundary instead of raising —
+    arbitrary gradient sizes work."""
+    topo = Topology(TopologySpec())
+    mesh = topo.mesh
+    rng = np.random.default_rng(5)
+    xs = jnp.asarray(rng.normal(size=(8, n)), jnp.float32)
+    shard = -(-n // 8)
+
+    @jax.jit
+    def f(xs):
+        def body(x):
+            return quantized_reduce_scatter(x[0], ("dp_outer", "ep"))[None]
+
+        return shard_map_nocheck(body, mesh, in_specs=P(("dp_outer", "ep")),
+                                 out_specs=P(("dp_outer", "ep")))(xs)
+
+    out = np.asarray(f(xs)).reshape(-1)
+    assert out.shape == (8 * shard,)
+    ref = np.asarray(xs).mean(axis=0)
+    assert np.abs(out[:n] - ref).max() <= np.abs(np.asarray(xs)).max() / 127 + 1e-5
+    np.testing.assert_array_equal(out[n:], 0.0)  # padding reduces to zeros
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("shape", [(1000,), (2048,), (3, 7, 11)])
+def test_quant_roundtrip_error_bound_dtypes(shape, dtype):
+    """Round-trip error stays within the per-block absmax/127 bound for fp32
+    AND bf16 inputs, including ragged (non-block-multiple) tails."""
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.normal(size=shape) * 3, dtype)
+    q, s, sh = quantize_int8(x)
+    y = dequantize_int8(q, s, sh, dtype=dtype)
+    xf = np.asarray(x, np.float32)
+    err = np.abs(np.asarray(y, np.float32) - xf)
+    # bf16 adds its own representation error on top of the int8 level
+    eps = 0.0 if dtype == jnp.float32 else 0.01 * np.abs(xf).max()
+    assert err.max() <= np.abs(xf).max() / 127 + eps + 1e-6
+
+
+def test_stochastic_rounding_unbiased():
+    """Statistical unbiasedness: values sitting between int8 levels round to
+    ZERO under nearest rounding (systematic bias) but average back to
+    themselves under stochastic rounding."""
+    n, draws = 512, 200
+    # absmax pins the scale; the payload sits at 0.3 levels — below the
+    # nearest-rounding threshold, so the deterministic kernel drops it all
+    scale = 1.27 / 127.0
+    x = np.full((n,), 0.3 * scale, np.float32)
+    x[0] = 1.27
+    xj = jnp.asarray(x)
+
+    q, s, sh = quantize_int8(xj)
+    det = np.asarray(dequantize_int8(q, s, sh))
+    np.testing.assert_array_equal(det[1:], 0.0)  # nearest: all dropped
+
+    def draw(i):
+        q, s, sh = quantize_int8(xj, stochastic=True, key=jax.random.PRNGKey(i))
+        return np.asarray(dequantize_int8(q, s, sh))
+
+    avg = np.mean([draw(i) for i in range(draws)], axis=0)
+    # E[q*scale] = x; sem of the mean is scale*sqrt(p(1-p)/draws) ~ 0.033*scale
+    sem = scale * np.sqrt(0.3 * 0.7 / draws)
+    assert np.abs(avg[1:] - 0.3 * scale).max() < 5 * sem
+    # and each single draw only ever lands on adjacent levels
+    one = draw(0)
+    assert set(np.round(one[1:] / scale).astype(int)) <= {0, 1}
+
+
+def test_stochastic_rounding_needs_key():
+    with pytest.raises(ValueError, match="key"):
+        quantize_int8(jnp.ones((256,)), stochastic=True)
