@@ -823,10 +823,80 @@ def quantized_collectives_bench():
             "device": "tpu" if on_tpu else f"cpu-mesh-{n}"}
 
 
+def planner_bench():
+    """Rung plan (comm/planner): resolve the five wired collective sites on
+    this mesh with the planner in static mode, then time each resolved
+    implementation against the XLA-native default through the SAME
+    microbenchmark harness ``measure`` mode uses — the planned-vs-default
+    line. On a multi-chip TPU mesh the ratios are real; on the virtual CPU
+    mesh the decisions + plan table are the artifact (ratios are relative
+    wiring numbers only)."""
+    import tempfile
+
+    from deepspeed_tpu.comm.planner import (benchmark_site, configure_planner,
+                                            make_site)
+    from deepspeed_tpu.parallel.topology import (Topology, TopologySpec,
+                                                 get_topology, set_topology)
+
+    devs = np.array(jax.devices())
+    n = len(devs)
+    if n < 4:
+        return {"metric": "comm_planner", "value": None, "unit": "ratio",
+                "vs_baseline": None, "error": "needs a >=4 device mesh"}
+    # a mesh exercising every wired axis: sp/tp/ep all real when 8+ devices
+    spec = (TopologySpec(ep=2, sp=2, tp=2) if n % 8 == 0
+            else TopologySpec(ep=2))
+    set_topology(Topology(spec))
+    topo = get_topology()
+    on_tpu = devs[0].platform == "tpu"
+    grad_n = (32 * 2**20) if on_tpu else 2**20
+    planner = configure_planner("static",
+                                cache_dir=tempfile.mkdtemp(prefix="dstpu_plan_"))
+    sites = [
+        make_site(op="all_reduce", shape=(grad_n,), dtype="float32",
+                  axes=topo.dp_axes, consumer="dp-grad"),
+        make_site(op="all_to_all", shape=(4, 256, 8, 64), dtype="float32",
+                  axes=("sp",), consumer="ulysses"),
+        make_site(op="all_to_all", shape=(8, 4, 64, 128), dtype="float32",
+                  axes=("ep",), consumer="moe-a2a"),
+        make_site(op="all_gather", shape=(grad_n // 8,), dtype="float32",
+                  axes=("dp_outer", "ep"), consumer="zeropp"),
+        make_site(op="reduce_scatter", shape=(grad_n // 4,), dtype="float32",
+                  axes=("dp_outer", "ep"), consumer="zeropp"),
+        make_site(op="gather_matmul", shape=(4, 512, 256), dtype="float32",
+                  axes=("tp",), consumer="tp-linear"),
+    ]
+    max_elems = (1 << 22) if on_tpu else (1 << 16)
+    rows, ratios = [], []
+    for site in sites:
+        d = planner.resolve(site)
+        row = {"site": site.signature(), "impl": d.impl, "source": d.source,
+               "est_us": d.est_us}
+        try:
+            t_def = benchmark_site(site, "xla", max_elems=max_elems)
+            t_plan = (t_def if d.impl == "xla"
+                      else benchmark_site(site, d.impl, block=d.block,
+                                          max_elems=max_elems))
+            row.update(t_default_s=round(t_def, 6),
+                       t_planned_s=round(t_plan, 6),
+                       ratio=round(t_def / t_plan, 4) if t_plan else None)
+            if row["ratio"]:
+                ratios.append(row["ratio"])
+        except Exception as e:  # keep the rung row even if one probe fails
+            row["error"] = str(e)[:160]
+        rows.append(row)
+    value = round(float(np.prod(ratios)) ** (1 / len(ratios)), 4) if ratios else None
+    return {"metric": "comm_planner", "value": value, "unit": "ratio",
+            "vs_baseline": None, "devices": n,
+            "mesh": {k: int(v) for k, v in topo.mesh.shape.items()},
+            "plan": rows, "device": "tpu" if on_tpu else f"cpu-mesh-{n}"}
+
+
 RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "3b": rung3b_big_model,
          "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses,
-         "cm": collective_matmul_bench, "qx": quantized_collectives_bench}
+         "cm": collective_matmul_bench, "qx": quantized_collectives_bench,
+         "plan": planner_bench}
 
 
 def _with_ledger(fn):
@@ -869,7 +939,8 @@ def run_ladder():
     multichip = healthy and accelerator_device_count() > 1
     plan = [("1", cpu1), ("2", chip), ("3", chip), ("4", cpu8), ("5", cpu8),
             ("cm", {} if multichip else cpu8),
-            ("qx", {} if multichip else cpu8)]
+            ("qx", {} if multichip else cpu8),
+            ("plan", {} if multichip else cpu8)]
     results = []
     for rung, env_over in plan:
         env = dict(os.environ)
@@ -888,7 +959,9 @@ def run_ladder():
         except Exception as e:
             rec = {"metric": f"rung{rung}", "value": None, "unit": "error",
                    "vs_baseline": None, "error": str(e)[:400]}
-        rec["rung"] = int(rung)
+        # numeric ladder rungs keep their integer id; named rungs (cm/qx/
+        # plan) keep the name — int("cm") used to throw and kill the ladder
+        rec["rung"] = int(rung) if rung.isdigit() else rung
         print(json.dumps(rec))
         results.append(rec)
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -913,7 +986,7 @@ if __name__ == "__main__":
         flags_preset = ("--xla_force_host_platform_device_count"
                         in os.environ.get("XLA_FLAGS", ""))
         needs_cpu8 = args.rung in ("4", "5")
-        if args.rung in ("cm", "qx") and not flags_preset:
+        if args.rung in ("cm", "qx", "plan") and not flags_preset:
             # these run on the real mesh only when it's healthy AND >1 chip
             # (subprocess probes; this process must not init the backend yet)
             from deepspeed_tpu.utils.health import accelerator_device_count

@@ -1,0 +1,26 @@
+"""Collective planner: topology-aware plan IR + microbenchmark autotuner.
+
+PR 1 (ring-overlapped collective matmul) and PR 2 (quantized collectives)
+added the fast-path *menu*; this subsystem is the *selector* that turns the
+menu into an automatic, measured, cached per-site decision (GC3, arxiv
+2201.11840; The Big Send-off, arxiv 2504.18658). See
+``docs/comm_planner.md`` for the IR, cache format, and tuning workflow.
+"""
+
+from .cache import PlanCache, default_cache_dir
+from .ir import (CONSUMERS, IMPLEMENTATIONS, OP_MENU, CollectiveSite, Plan,
+                 PlanDecision, make_site)
+from .microbench import benchmark_site
+from .planner import (MODES, CollectivePlanner, configure_from_config,
+                      configure_planner, get_planner, planner_active,
+                      reset_planner, resolve_site)
+from .topo import CostModel, LinkParams, MeshFingerprint
+
+__all__ = [
+    "CONSUMERS", "IMPLEMENTATIONS", "OP_MENU", "MODES",
+    "CollectiveSite", "Plan", "PlanDecision", "make_site",
+    "MeshFingerprint", "CostModel", "LinkParams",
+    "PlanCache", "default_cache_dir", "benchmark_site",
+    "CollectivePlanner", "configure_planner", "configure_from_config",
+    "get_planner", "planner_active", "reset_planner", "resolve_site",
+]

@@ -1,0 +1,83 @@
+"""On-disk plan cache: tuning runs once per topology.
+
+One JSON file per mesh fingerprint digest (``plan_<digest>.json``), holding
+the fingerprint (human-readable provenance) and the site->decision map. The
+default location is ``~/.cache/deepspeed_tpu/comm_plans`` overridable via
+``DSTPU_PLAN_CACHE`` or the ``comm_planner.cache_dir`` config knob. Writes
+are atomic (tmp + rename) and merge with what is already on disk, so
+concurrent jobs on the same topology only add sites, never lose them.
+"""
+
+import json
+import os
+import tempfile
+from typing import Optional
+
+from .ir import Plan
+from .topo import MeshFingerprint
+
+_ENV_VAR = "DSTPU_PLAN_CACHE"
+
+
+def default_cache_dir() -> str:
+    env = os.environ.get(_ENV_VAR)
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu",
+                        "comm_plans")
+
+
+class PlanCache:
+    def __init__(self, cache_dir: Optional[str] = None):
+        self.cache_dir = cache_dir or default_cache_dir()
+
+    def path_for(self, fp: MeshFingerprint) -> str:
+        return os.path.join(self.cache_dir, f"plan_{fp.digest()}.json")
+
+    def load(self, fp: MeshFingerprint) -> Optional[Plan]:
+        """The cached plan for this fingerprint, or None. A corrupt or
+        foreign-format file reads as a miss, never an error — the planner
+        just re-tunes and overwrites it."""
+        path = self.path_for(fp)
+        try:
+            with open(path) as f:
+                plan = Plan.from_dict(json.load(f))
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+        return plan if plan.fingerprint == fp.digest() else None
+
+    def store(self, fp: MeshFingerprint, plan: Plan) -> str:
+        """Merge ``plan`` into the on-disk plan for ``fp`` (new decisions
+        win) and write atomically. An exclusive flock serializes the whole
+        read-merge-write against concurrent writers (two jobs on a shared
+        home dir) so neither can drop the other's decisions; tmp+rename
+        additionally keeps readers from ever seeing a torn file. Returns
+        the file path."""
+        os.makedirs(self.cache_dir, exist_ok=True)
+        path = self.path_for(fp)
+        lock = open(path + ".lock", "w")
+        try:
+            try:
+                import fcntl
+
+                fcntl.flock(lock, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                pass  # no flock (non-POSIX / odd FS): best-effort merge
+            merged = self.load(fp) or Plan(fingerprint=fp.digest())
+            merged.decisions.update(plan.decisions)
+            body = {"fingerprint": fp.digest(), "mesh": fp.to_dict(),
+                    **merged.to_dict()}
+            fd, tmp = tempfile.mkstemp(dir=self.cache_dir, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(body, f, indent=1, sort_keys=True)
+                os.replace(tmp, path)
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        finally:
+            lock.close()
+        return path

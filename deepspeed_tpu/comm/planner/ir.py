@@ -1,0 +1,181 @@
+"""Plan IR: collective sites and per-site implementation decisions.
+
+GC3 (arxiv 2201.11840) compiles collectives from a small IR; The Big
+Send-off (arxiv 2504.18658) shows the *choice* of algorithm per topology and
+message size is itself the optimization. This module is the vocabulary that
+choice is expressed in: a :class:`CollectiveSite` names one collective call
+site in the training program (op kind, shape/dtype, mesh axes, consumer
+tag), a :class:`PlanDecision` names one concrete implementation drawn from
+the menu PR 1/PR 2 built (XLA native, ppermute rings, hierarchical, int8
+block-quantized, fused collective-matmul), and a :class:`Plan` maps sites to
+decisions for one mesh fingerprint. Everything serializes to JSON so plans
+cache on disk and survive relaunches (``planner/cache.py``).
+"""
+
+import dataclasses
+import json
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+# The implementation menu (what the existing fast paths can actually run):
+#   xla          — the fused XLA-native collective (psum / all_gather /
+#                  psum_scatter / all_to_all); today's default everywhere
+#   ring         — p-1 ppermute chunk hops (ops/collective_matmul.py
+#                  ring_all_gather / ring_reduce_scatter), exact
+#   bidir_ring   — both ICI directions busy, half the ring steps, exact
+#   hierarchical — two-level all-reduce: inner (ICI) axis exact, outer
+#                  (DCN) hops int8 (comm/compressed.py)
+#   int8         — block-quantized payload, nearest rounding
+#   int8_sr      — block-quantized + stochastic rounding (gradient paths)
+#   fused_matmul — collective matmul: the gather/reduction ring hidden
+#                  behind the partial matmuls (all_gather_matmul /
+#                  matmul_reduce_scatter)
+IMPLEMENTATIONS = ("xla", "ring", "bidir_ring", "hierarchical", "int8",
+                   "int8_sr", "fused_matmul")
+
+# op kind -> implementations that can realize it
+OP_MENU: Dict[str, Tuple[str, ...]] = {
+    "all_reduce": ("xla", "int8", "int8_sr", "hierarchical"),
+    "all_gather": ("xla", "ring", "bidir_ring", "int8"),
+    "reduce_scatter": ("xla", "ring", "int8", "int8_sr"),
+    "all_to_all": ("xla", "int8"),
+    "gather_matmul": ("xla", "fused_matmul"),
+}
+
+# the five wired consumers (ISSUE 3 vocabulary)
+CONSUMERS = ("tp-linear", "ulysses", "moe-a2a", "dp-grad", "zeropp")
+
+# consumers whose payload is a gradient: stochastic rounding is admissible
+# (unbiased compression matters there); activation exchanges keep nearest
+GRADIENT_CONSUMERS = ("dp-grad", "zeropp")
+
+
+@dataclass(frozen=True)
+class CollectiveSite:
+    """One collective call site: what moves, over which axes, for whom.
+
+    ``shape`` is the per-rank tensor the call site passes (the ledger's
+    "logical" convention), ``axes`` the mesh axis names the collective runs
+    over, ``consumer`` one of :data:`CONSUMERS`. ``axis_size`` overrides the
+    mesh fingerprint's axis-size lookup — for sites living on a mesh other
+    than the fleet topology (the zeropp factory takes its own ``mesh`` and
+    ``dp_axis``); when set it is part of the site identity.
+    """
+    op: str
+    shape: Tuple[int, ...]
+    dtype: str
+    axes: Tuple[str, ...]
+    consumer: str
+    axis_size: Optional[int] = None
+
+    def __post_init__(self):
+        if self.op not in OP_MENU:
+            raise ValueError(f"unknown collective op {self.op!r}; "
+                             f"known: {sorted(OP_MENU)}")
+        if self.consumer not in CONSUMERS:
+            raise ValueError(f"unknown consumer tag {self.consumer!r}; "
+                             f"known: {CONSUMERS}")
+
+    @property
+    def nbytes(self) -> int:
+        n = int(np.prod(self.shape)) if self.shape else 1
+        return n * int(np.dtype(self.dtype).itemsize)
+
+    def signature(self) -> str:
+        """Canonical site key — the cache/ledger identity of this site."""
+        dims = "x".join(str(d) for d in self.shape) or "scalar"
+        axes = ",".join(self.axes)
+        if self.axis_size is not None:
+            axes += f"*{self.axis_size}"
+        return f"{self.consumer}:{self.op}:{dims}:{self.dtype}@{axes}"
+
+
+def make_site(*, op: str, shape: Sequence[int], dtype: Any,
+              axes: Sequence[str], consumer: str,
+              axis_size: Optional[int] = None) -> CollectiveSite:
+    """Normalizing constructor: any shape sequence / dtype-like goes in,
+    a canonical (hashable, JSON-stable) :class:`CollectiveSite` comes out."""
+    return CollectiveSite(op=str(op),
+                          shape=tuple(int(d) for d in shape),
+                          dtype=np.dtype(dtype).name,
+                          axes=tuple(str(a) for a in axes),
+                          consumer=str(consumer),
+                          axis_size=None if axis_size is None
+                          else int(axis_size))
+
+
+@dataclass(frozen=True)
+class PlanDecision:
+    """One site's resolved implementation.
+
+    ``source`` records WHO decided: ``knob`` (an explicitly-set raw config
+    knob — always wins), ``cache`` (loaded from the on-disk plan),
+    ``cost-model`` (static alpha-beta ranking), ``measured`` (microbenchmark
+    winner), or ``default`` (planner off — today's behavior).
+    ``est_us`` is the model's (or measurement's) cost estimate.
+    """
+    impl: str
+    block: Optional[int] = None
+    source: str = "default"
+    est_us: Optional[float] = None
+
+    def __post_init__(self):
+        if self.impl not in IMPLEMENTATIONS:
+            raise ValueError(f"unknown implementation {self.impl!r}; "
+                             f"menu: {IMPLEMENTATIONS}")
+
+    @property
+    def quantized(self) -> bool:
+        return self.impl in ("int8", "int8_sr", "hierarchical")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PlanDecision":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+class Plan:
+    """Site signature -> :class:`PlanDecision` for one mesh fingerprint."""
+
+    def __init__(self, fingerprint: str = "",
+                 decisions: Optional[Dict[str, PlanDecision]] = None):
+        self.fingerprint = fingerprint
+        self.decisions: Dict[str, PlanDecision] = dict(decisions or {})
+
+    def get(self, site: CollectiveSite) -> Optional[PlanDecision]:
+        return self.decisions.get(site.signature())
+
+    def set(self, site: CollectiveSite, decision: PlanDecision) -> None:
+        self.decisions[site.signature()] = decision
+
+    def __len__(self) -> int:
+        return len(self.decisions)
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Plan)
+                and self.fingerprint == other.fingerprint
+                and self.decisions == other.decisions)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"fingerprint": self.fingerprint,
+                "sites": {sig: d.to_dict()
+                          for sig, d in sorted(self.decisions.items())}}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Plan":
+        return cls(fingerprint=d.get("fingerprint", ""),
+                   decisions={sig: PlanDecision.from_dict(dd)
+                              for sig, dd in d.get("sites", {}).items()})
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=1, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Plan":
+        return cls.from_dict(json.loads(s))

@@ -1,0 +1,180 @@
+"""Microbenchmark harness: time one (site, implementation) pair for real.
+
+``measure`` mode's ground truth. Each candidate becomes a tiny shard_map
+program over the live mesh exercising the SAME primitive the wiring would
+run (``lax`` native / ``ops.collective_matmul`` rings / ``comm.compressed``
+int8 paths), on a probe tensor shaped from the site but capped at
+``max_elems`` so tuning stays cheap. Chained through a ``lax.scan`` carry so
+XLA cannot CSE the collective away, timed as min-over-reps after a compile
+warmup (the ``bench.py`` convention).
+"""
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from .ir import CollectiveSite
+
+
+def _probe_elems(site: CollectiveSite, p: int, max_elems: int) -> int:
+    n = int(np.prod(site.shape)) if site.shape else 1
+    n = min(n, int(max_elems))
+    # the quantized paths pad to the 128-lane quantum per rank; the a2a /
+    # scatter paths need divisibility by p — round up to a shared quantum
+    quantum = 128 * p
+    return max(quantum, -(-n // quantum) * quantum)
+
+
+def build_probe(site: CollectiveSite, impl: str, *, mesh=None,
+                block: Optional[int] = None, reps: int = 4,
+                max_elems: int = 1 << 16):
+    """(jitted_fn, probe_array): a compiled program running ``reps`` chained
+    executions of ``impl`` for ``site`` on ``mesh``. The probe is fp32 and
+    replicated (each rank holds the same flat vector — per-shard calling
+    convention, like every ``comm.comm`` collective)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ...parallel.topology import get_topology
+    from ...utils.shard_map_compat import shard_map_nocheck
+
+    topo = get_topology()
+    mesh = mesh or topo.mesh
+    names = tuple(site.axes)
+    if any(a not in mesh.shape for a in names):
+        # foreign-mesh site (zeropp's own dp axis): probe on a fresh mesh
+        # of the site's declared size over the leading devices
+        from jax.sharding import Mesh
+
+        p_want = site.axis_size
+        devs = np.array(jax.devices())
+        if len(names) != 1 or not p_want or p_want > devs.size:
+            raise ValueError(
+                f"cannot build a probe mesh for axes {names} "
+                f"(axis_size={site.axis_size}, {devs.size} devices)")
+        mesh = Mesh(devs[:p_want], (names[0],))
+    axes = names if len(names) > 1 else names[0]
+    p = 1
+    for a in names:
+        p *= int(mesh.shape[a])
+    n = _probe_elems(site, p, max_elems)
+    blk = min(block or 2048, max(128, n // p))
+    blk = max(128, blk - blk % 128)
+    x = jnp.linspace(-1.0, 1.0, n, dtype=jnp.float32)
+
+    def one(v):
+        if site.op == "all_reduce":
+            if impl == "xla":
+                return lax.pmean(v, axes)
+            if impl in ("int8", "int8_sr"):
+                from ..compressed import quantized_all_reduce
+
+                sr = impl == "int8_sr"
+                return quantized_all_reduce(
+                    v, axes, block=blk, stochastic=sr,
+                    key=jax.random.PRNGKey(0) if sr else None)
+            if impl == "hierarchical":
+                from ..compressed import hierarchical_quantized_all_reduce
+
+                return hierarchical_quantized_all_reduce(
+                    v, names[-1], names[:-1], block=blk)
+        elif site.op == "all_gather":
+            if impl == "xla":
+                full = lax.all_gather(v, axes, axis=0, tiled=True)
+            elif impl in ("ring", "bidir_ring"):
+                from ...ops.collective_matmul import ring_all_gather
+
+                # chain one ring per axis so a multi-axis site moves the
+                # SAME total bytes as the fused gather it competes against
+                full = v
+                for a in names:
+                    full = ring_all_gather(full, a,
+                                           bidirectional=impl == "bidir_ring")
+            elif impl == "int8":
+                from ..compressed import quantized_all_gather
+
+                full = quantized_all_gather(v, axes, block=blk).reshape(-1)
+            else:
+                raise ValueError(impl)
+            return full[:n]  # keep the carry shape closed
+        elif site.op == "reduce_scatter":
+            if impl == "xla":
+                shard = lax.psum_scatter(v, axes, scatter_dimension=0,
+                                         tiled=True)
+            elif impl == "ring":
+                from ...ops.collective_matmul import ring_reduce_scatter
+
+                shard = v  # per-axis chain: same bytes as the fused scatter
+                for a in names:
+                    shard = ring_reduce_scatter(shard, a)
+            elif impl in ("int8", "int8_sr"):
+                from ..compressed import quantized_reduce_scatter
+
+                sr = impl == "int8_sr"
+                shard = quantized_reduce_scatter(
+                    v, axes, block=blk, stochastic=sr,
+                    key=jax.random.PRNGKey(0) if sr else None)
+            else:
+                raise ValueError(impl)
+            return jnp.tile(shard, p)[:n]
+        elif site.op == "all_to_all":
+            vv = v.reshape(p, n // p)
+            if impl == "xla":
+                out = lax.all_to_all(vv, names[0], split_axis=0,
+                                     concat_axis=0, tiled=True)
+            elif impl == "int8":
+                from ..compressed import quantized_all_to_all
+
+                out = quantized_all_to_all(vv, names[0], split_dim=0,
+                                           concat_dim=0, block=blk)
+            else:
+                raise ValueError(impl)
+            return out.reshape(-1)
+        elif site.op == "gather_matmul":
+            # activation gather + projection, the TP-linear shape: the probe
+            # matmul is deliberately small so the collective dominates on
+            # xla and the overlap credit is what the fused path must earn
+            k = 128
+            m = max(1, n // (k * p))  # per-rank row chunk; m*k*p <= n
+            xm = v[:m * k].reshape(m, k)
+            w = jnp.eye(k, dtype=jnp.float32)
+            if impl == "xla":
+                full = lax.all_gather(xm, axes, axis=0, tiled=True)
+                out = jnp.einsum("mk,kn->mn", full, w)
+            elif impl == "fused_matmul":
+                from ...ops.collective_matmul import all_gather_matmul
+
+                out = all_gather_matmul(xm, w, names[0])
+            else:
+                raise ValueError(impl)
+            return jnp.tile(out.reshape(-1), -(-n // out.size))[:n]
+        raise ValueError(f"unsupported probe {site.op}/{impl}")
+
+    def loop(v):
+        def body(c, _):
+            return one(c) * jnp.float32(0.5) + v * jnp.float32(0.5), ()
+
+        c, _ = lax.scan(body, v, None, length=reps)
+        return c[0]
+
+    fn = jax.jit(shard_map_nocheck(loop, mesh, in_specs=P(), out_specs=P()))
+    return fn, x
+
+
+def benchmark_site(site: CollectiveSite, impl: str, *, mesh=None,
+                   block: Optional[int] = None, reps: int = 4,
+                   repeats: int = 3, max_elems: int = 1 << 16) -> float:
+    """Min-of-``repeats`` wall-clock seconds per single execution of
+    ``impl`` at (a capped version of) ``site``. Compile excluded."""
+    fn, x = build_probe(site, impl, mesh=mesh, block=block, reps=reps,
+                        max_elems=max_elems)
+    float(fn(x))  # compile + drain
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        float(fn(x))
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return best

@@ -1,0 +1,261 @@
+"""The collective planner: per-site implementation selection, cached.
+
+Resolution order for ``resolve(site)``:
+
+1. **knob** — a raw config knob the user explicitly set always wins
+   (``compressed_collectives.mode != none``, ``overlap_collective_matmul``);
+   the planner never overrides an explicit choice.
+2. **memo / cache** — a decision already made this run, or loaded from the
+   on-disk plan for this mesh fingerprint (``planner/cache.py``).
+3. **off** — today's defaults, bit-identical to the pre-planner tree (the
+   wiring short-circuits before even calling resolve in this mode; resolve
+   still answers for direct callers).
+4. **static** — the alpha-beta cost model's argmin (``planner/topo.py``).
+5. **measure** — cost-model pruning, then microbenchmarks pick the winner
+   (``planner/microbench.py``); written through to the disk cache.
+
+Every resolution is recorded once in the comms ledger
+(``CommsLogger.record_plan``) so ``comm.log_summary()`` prints the plan
+table next to the traffic table.
+"""
+
+from typing import Any, Dict, Optional
+
+from .cache import PlanCache
+from .ir import (GRADIENT_CONSUMERS, CollectiveSite, Plan, PlanDecision,
+                 make_site)
+from .microbench import benchmark_site
+from .topo import CostModel, MeshFingerprint
+
+MODES = ("off", "static", "measure")
+
+
+class CollectivePlanner:
+    def __init__(self, mode: str = "off", *,
+                 knobs: Optional[Dict[str, Any]] = None,
+                 cache_dir: Optional[str] = None,
+                 use_cache: bool = True,
+                 margin: float = 3.0,
+                 measure_reps: int = 4,
+                 measure_max_elems: int = 1 << 16,
+                 block: int = 2048,
+                 topology=None):
+        if mode not in MODES:
+            raise ValueError(f"comm_planner mode must be one of {MODES}, "
+                             f"got {mode!r}")
+        self.mode = mode
+        self.knobs = dict(knobs or {})
+        self.margin = float(margin)
+        self.measure_reps = int(measure_reps)
+        self.measure_max_elems = int(measure_max_elems)
+        self.block = int(block)
+        self.fingerprint = MeshFingerprint.capture(topology)
+        self.cost = CostModel(self.fingerprint, block=self.block)
+        self.cache = PlanCache(cache_dir) if use_cache else None
+        self.plan = Plan(fingerprint=self.fingerprint.digest())
+        self._from_cache = set()
+        if self.cache is not None and mode != "off":
+            cached = self.cache.load(self.fingerprint)
+            if cached is not None:
+                self.plan.decisions.update(cached.decisions)
+                self._from_cache = set(cached.decisions)
+        self._recorded = set()
+        self._agreed = set()  # sigs already broadcast-synced across hosts
+
+    # ------------------------------------------------------------------
+    def resolve(self, site: CollectiveSite) -> PlanDecision:
+        sig = site.signature()
+        knob = self._knob_decision(site)
+        if knob is not None:
+            # an explicit raw knob is answered directly and NEVER stored:
+            # a knob choice is the user's, not a tuned plan — it must not
+            # leak into the cache a later knob-less run would load
+            self._record(site, knob)
+            return knob
+        decision = self.plan.decisions.get(sig)
+        if decision is not None and sig in self._from_cache:
+            decision = PlanDecision(impl=decision.impl, block=decision.block,
+                                    source="cache", est_us=decision.est_us)
+        if decision is None:
+            if self.mode == "off":
+                decision = self._default_decision(site)
+            elif self.mode == "static":
+                decision = self.cost.decide(site, margin=self.margin)
+            else:
+                decision = self._measure(site)
+        if sig not in self._agreed:
+            # multi-host: every process MUST run the same implementation or
+            # the SPMD programs issue mismatched collectives and deadlock —
+            # measured timings (and per-host caches) can disagree, so rank
+            # 0's decision is broadcast. Every host resolves the same sites
+            # in the same order (same program construction), and knob
+            # decisions come from the shared config, so the broadcasts
+            # align; memoized re-resolutions never re-broadcast.
+            decision = self._agree(decision)
+            self._agreed.add(sig)
+        self.plan.decisions[sig] = decision
+        if self.cache is not None and self.mode != "off" \
+                and sig not in self._from_cache:
+            # write-through: one file per mesh fingerprint, merge-on-store
+            try:
+                self.cache.store(self.fingerprint, self.plan)
+            except OSError:
+                pass  # read-only FS: plan still lives in memory
+        self._record(site, decision)
+        return decision
+
+    def _agree(self, decision: PlanDecision) -> PlanDecision:
+        """Rank 0's decision, on every process (no-op single-process)."""
+        import jax
+
+        if jax.process_count() <= 1:
+            return decision
+        from ..comm import broadcast_host_data
+
+        return PlanDecision.from_dict(broadcast_host_data(decision.to_dict(),
+                                                          src=0))
+
+    # ------------------------------------------------------------------
+    def _knob_decision(self, site: CollectiveSite) -> Optional[PlanDecision]:
+        """Explicitly-set raw knobs win over any planning."""
+        if site.op == "gather_matmul":
+            if self.knobs.get("overlap"):
+                return PlanDecision(impl="fused_matmul", source="knob")
+            return None
+        comp = self.knobs.get("compression")
+        if comp is None:
+            return None
+        site_key = {"dp-grad": "dp_gradients", "ulysses": "ulysses",
+                    "moe-a2a": "moe"}.get(site.consumer)
+        if site.consumer == "zeropp":
+            site_key = ("zero_gradients" if site.op == "reduce_scatter"
+                        else "zero_weights")
+        if site_key is None or not comp.get("sites", {}).get(site_key, True):
+            return PlanDecision(impl="xla", source="knob")
+        mode = comp["mode"]
+        if site.consumer not in GRADIENT_CONSUMERS:
+            mode = "int8"  # activation exchanges never dither
+        if site.consumer == "dp-grad" and comp.get("hierarchical"):
+            # same gate as the engine wiring: both split levels must be real
+            p_in, p_out = self.cost._split_axes(site)
+            if p_in > 1 and p_out > 1:
+                return PlanDecision(impl="hierarchical",
+                                    block=comp.get("block"), source="knob")
+        return PlanDecision(impl=mode, block=comp.get("block"), source="knob")
+
+    def _default_decision(self, site: CollectiveSite) -> PlanDecision:
+        """Planner off, no knob: what the tree does today."""
+        if site.consumer == "zeropp":
+            # zeropp_train_step_factory's legacy default is quantized ON
+            return PlanDecision(impl="int8", block=self.block,
+                                source="default")
+        return PlanDecision(impl="xla", source="default")
+
+    def _measure(self, site: CollectiveSite) -> PlanDecision:
+        survivors = self.cost.prune(site, margin=self.margin)
+        if len(survivors) == 1:
+            impl, est = survivors[0]
+            return self._finish(impl, est_s=est, source="cost-model")
+        timed, errs = [], []
+        for impl, _ in survivors:
+            try:
+                t = benchmark_site(site, impl, block=self.block,
+                                   reps=self.measure_reps,
+                                   max_elems=self.measure_max_elems)
+            except Exception as e:  # a candidate that fails to build loses
+                errs.append(f"{impl}: {type(e).__name__}: {e}")
+                continue
+            timed.append((impl, t))
+        if not timed:
+            # degrade loudly, not silently: the user asked for measurement
+            from ...utils.logging import logger
+
+            logger.warning(
+                f"comm_planner: no candidate probe ran for "
+                f"{site.signature()} — falling back to the cost model "
+                f"({'; '.join(errs)[:300]})")
+            impl, est = survivors[0]
+            return self._finish(impl, est_s=est, source="cost-model")
+        impl, t = min(timed, key=lambda kv: kv[1])
+        return self._finish(impl, est_s=t, source="measured")
+
+    def _finish(self, impl: str, *, est_s: float, source: str) -> PlanDecision:
+        block = self.block if impl in ("int8", "int8_sr",
+                                       "hierarchical") else None
+        return PlanDecision(impl=impl, block=block, source=source,
+                            est_us=round(est_s * 1e6, 3))
+
+    def _record(self, site: CollectiveSite, decision: PlanDecision) -> None:
+        sig = site.signature()
+        if sig in self._recorded:
+            return
+        self._recorded.add(sig)
+        from ..comm import get_comms_logger
+
+        get_comms_logger().record_plan(sig, {
+            "consumer": site.consumer, "op": site.op,
+            "shape": "x".join(str(d) for d in site.shape) or "scalar",
+            "axes": ",".join(site.axes), "impl": decision.impl,
+            "block": decision.block, "source": decision.source,
+            "est_us": decision.est_us, "mode": self.mode,
+        })
+
+
+# ---------------------------------------------------------------------------
+# Fleet-wide planner instance (the configure_compression pattern):
+# initialize() maps config.comm_planner onto this; the wiring reads it.
+# ---------------------------------------------------------------------------
+
+_PLANNER: Optional[CollectivePlanner] = None
+
+
+def configure_planner(mode: str = "off", **kwargs) -> CollectivePlanner:
+    global _PLANNER
+    _PLANNER = CollectivePlanner(mode, **kwargs)
+    return _PLANNER
+
+
+def reset_planner() -> None:
+    global _PLANNER
+    _PLANNER = None
+
+
+def get_planner() -> CollectivePlanner:
+    global _PLANNER
+    if _PLANNER is None:
+        _PLANNER = CollectivePlanner("off")
+    return _PLANNER
+
+
+def planner_active() -> bool:
+    """True when a planner with mode static|measure is configured — the
+    wiring's gate: inactive means every site keeps today's exact behavior
+    (``comm_planner: off`` is bit-identical to the pre-planner tree)."""
+    return _PLANNER is not None and _PLANNER.mode != "off"
+
+
+def resolve_site(**kwargs) -> PlanDecision:
+    """Build a site from keyword parts and resolve it against the fleet
+    planner — the one-liner the five wirings call."""
+    return get_planner().resolve(make_site(**kwargs))
+
+
+def configure_from_config(config, topology=None) -> CollectivePlanner:
+    """Map the runtime config onto the fleet planner: the ``comm_planner``
+    block picks the mode/cache knobs, and the explicitly-set raw fast-path
+    knobs (``compressed_collectives``, ``overlap_collective_matmul``) are
+    snapshotted so they keep winning at their sites."""
+    pl = config.comm_planner
+    knobs: Dict[str, Any] = {}
+    cc = config.compressed_collectives
+    if cc.mode != "none":
+        knobs["compression"] = {"mode": cc.mode, "block": cc.block,
+                                "hierarchical": cc.hierarchical,
+                                "sites": cc.site_map()}
+    if config.tensor_parallel.overlap_collective_matmul:
+        knobs["overlap"] = True
+    return configure_planner(pl.mode, knobs=knobs, cache_dir=pl.cache_dir,
+                             use_cache=pl.use_cache, margin=pl.margin,
+                             measure_reps=pl.measure_reps,
+                             measure_max_elems=pl.measure_max_elems,
+                             block=cc.block, topology=topology)

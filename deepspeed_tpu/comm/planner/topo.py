@@ -1,0 +1,269 @@
+"""Topology model: mesh fingerprint + alpha-beta cost model.
+
+The fingerprint identifies WHAT we are planning for — axis sizes, device
+kind, host span, which mesh axes cross hosts (DCN) vs stay on-chip
+interconnect (ICI) — and keys the on-disk plan cache. The cost model is a
+classical alpha-beta (latency + inverse-bandwidth) estimate per (site,
+implementation) pair, the Big-Send-off observation made executable: it is
+deliberately coarse — its job is to PRUNE obviously-dominated candidates
+(and rank the survivors in ``static`` mode), not to replace measurement.
+``measure`` mode times the survivors for real (``planner/microbench.py``).
+"""
+
+import dataclasses
+import hashlib
+import json
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .ir import (GRADIENT_CONSUMERS, OP_MENU, CollectiveSite, PlanDecision)
+
+# default quantization block (elements per scale) — matches ops/pallas/quant
+_DEFAULT_BLOCK = 2048
+
+
+@dataclass(frozen=True)
+class LinkParams:
+    alpha: float  # per-hop latency, seconds
+    beta: float   # seconds per byte (inverse bandwidth)
+
+
+# Link classes by locality. Numbers are order-of-magnitude public figures
+# (TPU ICI ~100 GB/s/link-direction, DCN ~12.5 GB/s, virtual CPU mesh =
+# memcpy); the model only needs the RATIOS to rank candidates sanely.
+LINK_TABLE: Dict[str, LinkParams] = {
+    "ici": LinkParams(alpha=1e-6, beta=1.0 / 9e10),
+    "dcn": LinkParams(alpha=25e-6, beta=1.0 / 12.5e9),
+    "host": LinkParams(alpha=5e-6, beta=1.0 / 2e10),
+}
+
+# int8 quantize+dequantize compute, seconds per (logical) byte processed —
+# the term that makes exact transport win for small messages. Per platform:
+# the TPU VPU streams the block quant at memory speed; the virtual CPU mesh
+# pays real vectorized-numpy rates
+QUANT_COST_PER_BYTE = {"tpu": 1.0 / 2e11, "cpu": 1.0 / 1e10}
+_QUANT_DEFAULT = 1.0 / 5e10
+# fixed per-quantization-stage overhead (kernel launch, scale lanes): the
+# term that keeps tiny alpha-dominated messages on the exact path
+QUANT_FIXED = 5e-6
+# fraction of the wire time a ring-chunked transfer hides behind compute
+# (T3-style overlap); the credit the fused/chunked impls get over xla
+OVERLAP_CREDIT = 0.55
+# extra per-chunk scheduling overhead of an explicit ppermute ring vs the
+# fused XLA collective
+RING_HOP_PENALTY = 1.5
+
+
+@dataclass(frozen=True)
+class MeshFingerprint:
+    """What the planner keys plans on: if two jobs land on meshes with the
+    same fingerprint, the same plan applies."""
+    platform: str
+    device_kind: str
+    n_devices: int
+    n_processes: int
+    axis_sizes: Tuple[Tuple[str, int], ...]
+    dcn_axes: Tuple[str, ...]
+
+    @classmethod
+    def capture(cls, topology=None) -> "MeshFingerprint":
+        """Fingerprint the live mesh (``jax.devices()`` + the resolved
+        ``parallel.topology``). An axis is DCN when stepping along it
+        changes the owning host process."""
+        import jax
+
+        from ...parallel.topology import get_topology
+
+        topo = topology or get_topology()
+        devs = jax.devices()
+        d0 = devs[0]
+        mesh = topo.mesh
+        arr = np.asarray(mesh.devices)
+        names = tuple(mesh.axis_names)
+        dcn = []
+        for i, name in enumerate(names):
+            if arr.shape[i] <= 1:
+                continue
+            step = np.moveaxis(arr, i, 0)
+            procs0 = np.vectorize(lambda d: d.process_index)(step[0])
+            procs1 = np.vectorize(lambda d: d.process_index)(step[1])
+            if (procs0 != procs1).any():
+                dcn.append(name)
+        return cls(platform=str(d0.platform),
+                   device_kind=str(getattr(d0, "device_kind", d0.platform)),
+                   n_devices=len(devs),
+                   n_processes=int(jax.process_count()),
+                   axis_sizes=tuple((n, int(mesh.shape[n])) for n in names),
+                   dcn_axes=tuple(dcn))
+
+    def axis_size(self, axes: Tuple[str, ...]) -> int:
+        table = dict(self.axis_sizes)
+        p = 1
+        for a in axes:
+            p *= int(table.get(a, 1))
+        return p
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    def digest(self) -> str:
+        """Short stable hash — the plan-cache file key."""
+        blob = json.dumps(self.to_dict(), sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+class CostModel:
+    """Alpha-beta estimates per (site, implementation)."""
+
+    def __init__(self, fingerprint: MeshFingerprint,
+                 block: int = _DEFAULT_BLOCK):
+        self.fp = fingerprint
+        self.block = block
+        self.quant_cost = QUANT_COST_PER_BYTE.get(fingerprint.platform,
+                                                  _QUANT_DEFAULT)
+        self.quant_fixed = QUANT_FIXED
+
+    def link(self, axes: Tuple[str, ...]) -> LinkParams:
+        if any(a in self.fp.dcn_axes for a in axes):
+            return LINK_TABLE["dcn"]
+        if self.fp.platform == "tpu":
+            return LINK_TABLE["ici"]
+        return LINK_TABLE["host"]
+
+    def axis_size_of(self, site: CollectiveSite) -> int:
+        """The collective's rank count: the site's explicit override (a
+        foreign-mesh site, e.g. zeropp's own dp axis) or the fingerprint."""
+        if site.axis_size is not None:
+            return int(site.axis_size)
+        return self.fp.axis_size(site.axes)
+
+    # -- wire-byte model ---------------------------------------------------
+    def _wire_ratio(self, dtype: str) -> float:
+        """on-wire bytes / logical bytes for an int8 payload + one fp32
+        scale lane per block (comm/compressed.py accounting)."""
+        item = max(1, int(np.dtype(dtype).itemsize))
+        return (1.0 + 4.0 / self.block) / item
+
+    # -- per-impl estimate -------------------------------------------------
+    def estimate(self, site: CollectiveSite, impl: str) -> float:
+        """Predicted seconds for one execution of ``site`` via ``impl``."""
+        p = self.axis_size_of(site)
+        if p <= 1:
+            return 0.0
+        lp = self.link(site.axes)
+        n = float(site.nbytes)
+        q = self._wire_ratio(site.dtype)
+        hops = p - 1
+
+        if site.op == "all_reduce":
+            exact = 2 * hops * lp.alpha + 2 * n * hops / p * lp.beta
+            if impl == "xla":
+                return exact
+            if impl in ("int8", "int8_sr"):
+                t = 2 * hops * lp.alpha + 2 * n * q * hops / p * lp.beta \
+                    + 2 * n * self.quant_cost + 2 * self.quant_fixed
+                return t * (1.02 if impl == "int8_sr" else 1.0)
+            if impl == "hierarchical":
+                # inner axis exact (cheap links), outer hops quantized
+                p_in, p_out = self._split_axes(site)
+                if p_in <= 1 or p_out <= 1:
+                    return float("inf")
+                inner = self.link(site.axes[-1:])
+                t = 2 * (p_in - 1) * inner.alpha \
+                    + 2 * n * (p_in - 1) / p_in * inner.beta
+                outer = self.link(site.axes[:1])
+                t += 2 * (p_out - 1) * outer.alpha \
+                    + 2 * n * q * (p_out - 1) / p_out * outer.beta \
+                    + 2 * n * self.quant_cost + 2 * self.quant_fixed
+                return t
+        elif site.op == "all_gather":
+            # site.shape is the local shard; (p-1)*n bytes ride per rank
+            if impl == "xla":
+                return hops * lp.alpha + hops * n * lp.beta
+            if impl == "ring":
+                return (hops * lp.alpha * RING_HOP_PENALTY
+                        + hops * n * lp.beta * (1 - OVERLAP_CREDIT))
+            if impl == "bidir_ring":
+                return (-(-hops // 2) * lp.alpha * RING_HOP_PENALTY
+                        + hops * n * lp.beta * (1 - OVERLAP_CREDIT))
+            if impl == "int8":
+                return (hops * lp.alpha + hops * n * q * lp.beta
+                        + n * self.quant_cost * p + self.quant_fixed)
+        elif site.op == "reduce_scatter":
+            # site.shape is the full local input; (p-1)/p*n bytes per rank
+            frac = n * hops / p
+            if impl == "xla":
+                return hops * lp.alpha + frac * lp.beta
+            if impl == "ring":
+                return (hops * lp.alpha * RING_HOP_PENALTY
+                        + frac * lp.beta * (1 - OVERLAP_CREDIT))
+            if impl in ("int8", "int8_sr"):
+                t = hops * lp.alpha + frac * q * lp.beta \
+                    + n * self.quant_cost + self.quant_fixed
+                return t * (1.02 if impl == "int8_sr" else 1.0)
+        elif site.op == "all_to_all":
+            frac = n * hops / p
+            if impl == "xla":
+                return hops * lp.alpha + frac * lp.beta
+            if impl == "int8":
+                return (hops * lp.alpha + frac * q * lp.beta
+                        + 2 * n * self.quant_cost + 2 * self.quant_fixed)
+        elif site.op == "gather_matmul":
+            # the collective half of a TP/Ulysses linear: gather n bytes of
+            # activations; fused_matmul hides the ring behind the matmul
+            if impl == "xla":
+                return hops * lp.alpha + hops * n * lp.beta
+            if impl == "fused_matmul":
+                return (hops * lp.alpha * RING_HOP_PENALTY
+                        + hops * n * lp.beta * (1 - OVERLAP_CREDIT))
+        return float("inf")
+
+    def _split_axes(self, site: CollectiveSite) -> Tuple[int, int]:
+        """(inner, outer) sizes for the hierarchical split: last axis is the
+        inner (ICI-local) hop, the rest the outer — the zeropp
+        hierarchical_all_gather convention. A foreign-mesh site (explicit
+        axis_size) is one flat axis: no split."""
+        axes = site.axes
+        if len(axes) < 2 or site.axis_size is not None:
+            return (1, self.axis_size_of(site))
+        return (self.fp.axis_size(axes[-1:]), self.fp.axis_size(axes[:-1]))
+
+    # -- candidate enumeration + pruning -----------------------------------
+    def candidates(self, site: CollectiveSite) -> List[str]:
+        """Structurally-valid implementations for ``site``."""
+        out = []
+        for impl in OP_MENU[site.op]:
+            if impl == "hierarchical":
+                p_in, p_out = self._split_axes(site)
+                if p_in <= 1 or p_out <= 1:
+                    continue
+            if impl == "int8_sr" and site.consumer not in GRADIENT_CONSUMERS:
+                continue  # activations round to nearest, never dithered
+            out.append(impl)
+        return out
+
+    def prune(self, site: CollectiveSite,
+              margin: float = 3.0) -> List[Tuple[str, float]]:
+        """Rank candidates by estimated cost; drop any whose estimate
+        exceeds ``margin`` x the best (dominated — not worth measuring).
+        Ties keep menu order (xla first), so ranking is deterministic."""
+        ests = [(impl, self.estimate(site, impl))
+                for impl in self.candidates(site)]
+        ests.sort(key=lambda kv: kv[1])
+        if not ests:
+            raise ValueError(f"no candidate implementation for {site}")
+        best = ests[0][1]
+        cut = best * margin if best > 0 else float("inf")
+        survivors = [(i, e) for i, e in ests if e <= cut]
+        return survivors or ests[:1]
+
+    def decide(self, site: CollectiveSite,
+               margin: float = 3.0) -> PlanDecision:
+        """Static-mode decision: the cost model's argmin."""
+        impl, est = self.prune(site, margin=margin)[0]
+        block = self.block if impl in ("int8", "int8_sr",
+                                       "hierarchical") else None
+        return PlanDecision(impl=impl, block=block, source="cost-model",
+                            est_us=round(est * 1e6, 3))

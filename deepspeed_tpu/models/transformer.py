@@ -348,9 +348,9 @@ def _overlap_active(cfg) -> bool:
 
 def _overlap_ctx(cfg, x, mod):
     """The live topology when the overlapped path could engage, else None
-    (knob off, flax init trace, non-[B,S,D] input, or a batch that doesn't
-    shard over the dp axes)."""
-    if not _overlap_active(cfg) or mod.is_initializing() or x.ndim != 3:
+    (knob off and planner declines, flax init trace, non-[B,S,D] input, or
+    a batch that doesn't shard over the dp axes)."""
+    if mod.is_initializing() or x.ndim != 3:
         return None
     from ..parallel.topology import get_topology
     from ..utils.shard_map_compat import manual_axes
@@ -362,6 +362,20 @@ def _overlap_ctx(cfg, x, mod):
     topo = get_topology()
     if x.shape[0] % topo.axis_size(*topo.dp_axes):
         return None
+    if not _overlap_active(cfg):
+        # comm-planner tp-linear / ulysses site: with the raw knob unset,
+        # fused-matmul engagement is the planner's call per mesh + shape
+        from ..comm.planner import planner_active, resolve_site
+
+        sp = cfg.sequence_parallel and cfg.sp_impl == "ulysses"
+        axis = "sp" if sp else "tp"
+        size = topo.sp_size if sp else topo.tp_size
+        if not planner_active() or size <= 1:
+            return None
+        d = resolve_site(op="gather_matmul", shape=x.shape, dtype=x.dtype,
+                         axes=(axis,), consumer="ulysses" if sp else "tp-linear")
+        if d.impl != "fused_matmul":
+            return None
     return topo
 
 

@@ -200,10 +200,12 @@ class MoEBlock(nn.Module):
 
         self._sow_exp_counts(jax.nn.softmax(logits, axis=-1), k, e, used_token)
 
-        if not skip and quantized_ep_ready(e, g):
-            # compressed_collectives MoE site: the EP dispatch/combine
-            # exchange runs explicitly with int8 payloads (sharded_moe.py
-            # quantized_ep_moe) instead of the partitioner's exact a2a
+        if not skip and quantized_ep_ready(e, g, site_shape=(e, g, capacity, d),
+                                           site_dtype=x.dtype):
+            # compressed_collectives / comm-planner MoE site: the EP
+            # dispatch/combine exchange runs explicitly with int8 payloads
+            # (sharded_moe.py quantized_ep_moe) instead of the partitioner's
+            # exact a2a
             y = quantized_ep_moe(
                 x, dispatch, combine, w_up, w_down, w_gate=w_gate,
                 b_up=b_up, b_down=b_down, b_gate=b_gate,

@@ -168,15 +168,20 @@ def expert_ffn(expert_in: jnp.ndarray, w_up, w_down, *, w_gate=None,
     return out
 
 
-def quantized_ep_ready(num_experts: int, num_groups: Optional[int] = None) -> bool:
+def quantized_ep_ready(num_experts: int, num_groups: Optional[int] = None,
+                       site_shape: Optional[Tuple[int, ...]] = None,
+                       site_dtype=None) -> bool:
     """True when the explicit int8 EP exchange applies: a real ep axis the
     experts split evenly over, full sequences rank-local (sp == 1 — the
     dispatch slot einsum is exact only over the whole S axis), token groups
     that shard evenly over the data axes (shard_map hard-requires the
     divisibility the declarative constraints merely prefer), and the MoE
-    site enabled in ``compressed_collectives``."""
+    site switched on — by the ``compressed_collectives`` knob when that is
+    explicitly configured, else by the collective planner (``comm/planner``
+    mode static|measure) resolving the moe-a2a site (``site_shape`` /
+    ``site_dtype`` describe the dispatch tensor the exchange would carry)."""
     from ..comm.compressed import compression_mode
-    from ..parallel.topology import get_topology
+    from ..parallel.topology import EP_AXIS, get_topology
 
     # inside an enclosing shard_map (e.g. the SPMD pipeline body) the mesh
     # axes are manual and a nested shard_map cannot open — declarative path
@@ -188,8 +193,20 @@ def quantized_ep_ready(num_experts: int, num_groups: Optional[int] = None) -> bo
     if num_groups is not None and num_groups % (topo.dp_outer_size
                                                 * topo.ep_size) != 0:
         return False
-    return (compression_mode("moe") != "none" and topo.ep_size > 1
-            and topo.sp_size == 1 and num_experts % topo.ep_size == 0)
+    if not (topo.ep_size > 1 and topo.sp_size == 1
+            and num_experts % topo.ep_size == 0):
+        return False
+    if compression_mode() != "none":  # raw knob set (incl. site toggles)
+        return compression_mode("moe") != "none"
+    from ..comm.planner import planner_active, resolve_site
+
+    if not planner_active():
+        return False
+    d = resolve_site(op="all_to_all",
+                     shape=site_shape or (num_experts,),
+                     dtype=site_dtype or "float32",
+                     axes=(EP_AXIS,), consumer="moe-a2a")
+    return d.impl in ("int8", "int8_sr")
 
 
 def quantized_ep_moe(x, dispatch, combine, w_up, w_down, *, w_gate=None,

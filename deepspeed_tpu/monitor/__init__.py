@@ -1,3 +1,5 @@
-from .monitor import MonitorMaster, TensorBoardMonitor, WandbMonitor, csvMonitor
+from .monitor import (JSONLMonitor, MonitorMaster, TensorBoardMonitor,
+                      WandbMonitor, csvMonitor)
 
-__all__ = ["MonitorMaster", "TensorBoardMonitor", "WandbMonitor", "csvMonitor"]
+__all__ = ["JSONLMonitor", "MonitorMaster", "TensorBoardMonitor",
+           "WandbMonitor", "csvMonitor"]

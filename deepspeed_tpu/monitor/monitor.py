@@ -31,13 +31,50 @@ class Monitor:
         raise NotImplementedError
 
 
+class JSONLMonitor(Monitor):
+    """Pure-Python event writer: one JSON line per ``(name, value, step)``
+    event. The torch-free fallback behind :class:`TensorBoardMonitor` and a
+    standalone backend — the file is trivially greppable/parseable and a
+    post-hoc script can replay it into any dashboard."""
+
+    def __init__(self, config, filename: str = "events.jsonl"):
+        super().__init__(config)
+        self.path = None
+        if not (self.enabled and _is_rank_0()):
+            self.enabled = False
+            return
+        try:
+            log_dir = os.path.join(
+                getattr(config, "output_path", "") or "./runs",
+                getattr(config, "job_name", "DeepSpeedTPUJob"))
+            os.makedirs(log_dir, exist_ok=True)
+            self.path = os.path.join(log_dir, filename)
+        except Exception:
+            self.enabled = False
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        if not self.enabled or self.path is None:
+            return
+        import json
+
+        with open(self.path, "a") as f:
+            for name, value, step in event_list:
+                if value is None:
+                    continue
+                f.write(json.dumps({"name": name, "value": float(value),
+                                    "step": int(step)}) + "\n")
+
+
 class TensorBoardMonitor(Monitor):
     """Reference ``monitor/tensorboard.py:13``. Uses torch's SummaryWriter
-    when tensorboard is importable; silently disables otherwise."""
+    when tensorboard is importable; on the torch-free TPU image it degrades
+    to the :class:`JSONLMonitor` event file in the same log dir (monitoring
+    keeps recording instead of silently disabling)."""
 
     def __init__(self, config):
         super().__init__(config)
         self.summary_writer = None
+        self._fallback = None
         if not (self.enabled and _is_rank_0()):
             self.enabled = False
             return
@@ -48,10 +85,15 @@ class TensorBoardMonitor(Monitor):
             os.makedirs(log_dir, exist_ok=True)
             self.summary_writer = SummaryWriter(log_dir=log_dir)
         except Exception:
-            self.enabled = False
+            self._fallback = JSONLMonitor(config)
+            self.enabled = self._fallback.enabled
 
     def write_events(self, event_list: Sequence[Event]) -> None:
-        if not self.enabled or self.summary_writer is None:
+        if not self.enabled:
+            return
+        if self.summary_writer is None:
+            if self._fallback is not None:
+                self._fallback.write_events(event_list)
             return
         for name, value, step in event_list:
             if value is None:

@@ -241,6 +241,29 @@ class CompressedCollectivesConfig(ConfigModel):
 
 @register_config
 @dataclass
+class CommPlannerConfig(ConfigModel):
+    """Collective planner (``comm/planner/``): topology-aware per-site
+    selection of the PR1/PR2 fast paths.
+
+    ``mode``: ``off`` (default — every wired site behaves bit-identically
+    to a planner-less tree), ``static`` (alpha-beta cost model picks each
+    site's implementation from the mesh fingerprint, deterministic), or
+    ``measure`` (cost-model pruning then microbenchmarks pick the winner;
+    results cache on disk keyed by mesh fingerprint so tuning runs once per
+    topology). Explicitly-set raw knobs (``compressed_collectives``,
+    ``overlap_collective_matmul``) always win at their sites. Also accepted
+    as a bare string: ``"comm_planner": "static"``.
+    """
+    mode: str = "off"            # off | static | measure
+    cache_dir: Optional[str] = None  # default ~/.cache/deepspeed_tpu/comm_plans
+    use_cache: bool = True
+    margin: float = 3.0          # cost-model pruning margin (x best estimate)
+    measure_reps: int = 4        # chained executions per timed probe
+    measure_max_elems: int = 1 << 16  # probe tensor cap (elements)
+
+
+@register_config
+@dataclass
 class MoEConfig(ConfigModel):
     """Expert parallelism (reference ``deepspeed/moe/``)."""
     enabled: bool = False
@@ -542,6 +565,7 @@ class DeepSpeedTPUConfig(ConfigModel):
     moe: MoEConfig = field(default_factory=MoEConfig)
     compressed_collectives: CompressedCollectivesConfig = field(
         default_factory=CompressedCollectivesConfig)
+    comm_planner: CommPlannerConfig = field(default_factory=CommPlannerConfig)
 
     # topology: sizes multiply to world size; dp is inferred
     sequence_parallel_size: int = 1
@@ -578,6 +602,10 @@ class DeepSpeedTPUConfig(ConfigModel):
         cc = d.get("compressed_collectives")
         if isinstance(cc, str):
             d["compressed_collectives"] = {"mode": cc}
+        # string shorthand: "comm_planner": "static" == {"mode": "static"}
+        cp = d.get("comm_planner")
+        if isinstance(cp, str):
+            d["comm_planner"] = {"mode": cp}
         cl = d.pop("curriculum_learning", None)
         if cl:
             de = dict(d.get("data_efficiency") or {})

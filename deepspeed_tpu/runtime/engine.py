@@ -162,6 +162,11 @@ class DeepSpeedTPUEngine:
         configure_compression(cc.mode, block=cc.block,
                               hierarchical=cc.hierarchical,
                               sites=cc.site_map())
+        # collective planner (comm/planner): snapshot the explicitly-set
+        # raw knobs (they keep winning at their sites) and stand up the
+        # fleet planner in the configured mode — off is inert
+        from ..comm.planner import configure_from_config
+        configure_from_config(config, topology=self.topo)
         if (optimizer is not None and callable(optimizer)
                 and not hasattr(optimizer, "update")):
             # reference DeepSpeedOptimizerCallable (deepspeed/__init__.py:112):
@@ -511,25 +516,47 @@ class DeepSpeedTPUEngine:
         # to finite zeros, so an overflow would slip past the loss-scale
         # skip gate — the exact psum propagates NaN and skips correctly
         cc = config.compressed_collectives
-        compressed_dp = (cc.mode != "none" and cc.dp_gradients
-                         and config.zero_optimization.stage == 0
+        site_eligible = (config.zero_optimization.stage == 0
                          and topo.pp_size == 1 and topo.tp_size == 1
                          and topo.sp_size == 1 and not config.moe.enabled
                          and topo.dp_size > 1 and self._host_adam is None
                          and not fp16)
-        cc_hier = (cc.hierarchical and topo.ep_size > 1
-                   and topo.dp_outer_size > 1)
-        if cc.mode != "none" and cc.dp_gradients and not compressed_dp:
-            log_dist("compressed_collectives: DP gradient site needs pure "
-                     "data parallelism at ZeRO stage 0 without fp16 loss "
-                     "scaling — keeping the exact reduction (ZeRO++/MoE/"
-                     "Ulysses sites gate separately)")
+        dp_grad_impl = None  # (mode, block, hierarchical) when compressed
+        if cc.mode != "none":  # raw knob explicitly set: it wins as before
+            compressed_dp = cc.dp_gradients and site_eligible
+            if cc.dp_gradients and not compressed_dp:
+                log_dist("compressed_collectives: DP gradient site needs pure "
+                         "data parallelism at ZeRO stage 0 without fp16 loss "
+                         "scaling — keeping the exact reduction (ZeRO++/MoE/"
+                         "Ulysses sites gate separately)")
+            if compressed_dp:
+                cc_hier = (cc.hierarchical and topo.ep_size > 1
+                           and topo.dp_outer_size > 1)
+                dp_grad_impl = (cc.mode, cc.block, cc_hier)
+        else:
+            # comm-planner dp-grad site: with no raw knob set, the planner
+            # (mode static|measure) picks the reduction implementation per
+            # mesh + message size; off keeps the exact psum (bit-identical)
+            compressed_dp = False
+            from ..comm.planner import planner_active, resolve_site
+            if planner_active() and site_eligible:
+                n_elems = sum(int(np.prod(p.shape)) if p.shape else 1
+                              for p in jax.tree.leaves(self.state.params))
+                d = resolve_site(op="all_reduce", shape=(n_elems,),
+                                 dtype="float32", axes=topo.dp_axes,
+                                 consumer="dp-grad")
+                if d.impl in ("int8", "int8_sr", "hierarchical"):
+                    hier = (d.impl == "hierarchical" and topo.ep_size > 1
+                            and topo.dp_outer_size > 1)
+                    mode_ = "int8" if d.impl == "hierarchical" else d.impl
+                    dp_grad_impl = (mode_, d.block or cc.block, hier)
+                    compressed_dp = True
         if compressed_dp:
-            log_dist(f"compressed_collectives: DP gradients ride the "
-                     f"{cc.mode} all-reduce (block={cc.block}"
-                     f"{', hierarchical' if cc_hier else ''})")
+            mode_, block_, hier_ = dp_grad_impl
+            log_dist(f"DP gradients ride the {mode_} all-reduce "
+                     f"(block={block_}{', hierarchical' if hier_ else ''})")
         self._compressed_dp = compressed_dp  # imperative backward() reads it
-        self._cc_hier = cc_hier
+        self._dp_grad_impl = dp_grad_impl
 
         def train_step(state: TrainState, batch, rng, *, ltd_keep=None,
                        moq_bits=None):
@@ -745,14 +772,14 @@ class DeepSpeedTPUEngine:
         from ..comm.compressed import (hierarchical_quantized_all_reduce,
                                        quantized_all_reduce)
 
-        cc = self.config.compressed_collectives
-        sr = cc.mode == "int8_sr"
+        mode_, block_, hier_ = self._dp_grad_impl  # knob- or planner-resolved
+        sr = mode_ == "int8_sr"
         flat, tdef = jax.tree.flatten(grads)
         sizes = [int(np.prod(g.shape)) for g in flat]
         shapes = [g.shape for g in flat]
         vec = jnp.concatenate([jnp.ravel(g) for g in flat])
-        kw = dict(block=cc.block, stochastic=sr, key=sr_key if sr else None)
-        if self._cc_hier:
+        kw = dict(block=block_, stochastic=sr, key=sr_key if sr else None)
+        if hier_:
             # inner (ICI-local) hop exact, only the outer hops quantize
             red = hierarchical_quantized_all_reduce(vec, "ep", "dp_outer", **kw)
         else:
@@ -1173,11 +1200,18 @@ class DeepSpeedTPUEngine:
             log_dist(f"step={self.global_steps} loss={m.get('loss', float('nan')):.4f} "
                      f"lr={m.get('lr', 0):.3e} grad_norm={m.get('grad_norm', 0):.3f}")
         if self.monitor is not None:
-            self.monitor.write_events(
-                [(f"Train/Samples/train_loss", self._last_metrics.get("loss"),
-                  self.global_steps * self.train_batch_size),
-                 (f"Train/Samples/lr", self._last_metrics.get("lr"),
-                  self.global_steps * self.train_batch_size)])
+            events = [
+                (f"Train/Samples/train_loss", self._last_metrics.get("loss"),
+                 self.global_steps * self.train_batch_size),
+                (f"Train/Samples/lr", self._last_metrics.get("lr"),
+                 self.global_steps * self.train_batch_size)]
+            # ledger -> monitor bridge: per-op logical/wire bytes + latency
+            # totals reach TensorBoard/CSV, not just stdout
+            from ..comm import get_comms_logger
+            ledger = get_comms_logger()
+            if ledger.enabled:
+                events += ledger.monitor_events(self.global_steps)
+            self.monitor.write_events(events)
         fp_cfg = self.config.flops_profiler
         if fp_cfg.enabled and self.global_steps == fp_cfg.profile_step:
             self.flops_profile(output_file=fp_cfg.output_file,

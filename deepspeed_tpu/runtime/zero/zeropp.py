@@ -117,17 +117,31 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
     default to ``None`` = follow the fleet-wide ``compressed_collectives``
     knobs set by ``initialize()``: the ``zero_weights`` / ``zero_gradients``
     site toggles gate qwZ/qgZ and ``int8_sr`` turns the dither on. With no
-    compression configured (mode ``none``) the legacy factory default —
-    both quantized paths ON — applies; explicit booleans always win.
+    compression configured (mode ``none``) and the collective planner
+    INACTIVE the legacy factory default — both quantized paths ON —
+    applies; with the planner active (``comm_planner: static|measure``) the
+    zeropp gather/scatter sites resolve through ``planner.resolve`` at
+    ``init(params)`` time, when the true flat sizes are known. Explicit
+    booleans always win over both.
     """
     from ...comm.compressed import compression_mode
+    from ...comm.planner import planner_active
 
     legacy = compression_mode() == "none"  # knob untouched: factory default
+    # every knob left to default + planner on: the planner owns the choice,
+    # resolved lazily in init() where the flat param sizes are known
+    plan_pending = (legacy and planner_active()
+                    and quantized_weights is None
+                    and quantized_gradients is None
+                    and stochastic_rounding is None)
     if quantized_weights is None:
-        quantized_weights = legacy or compression_mode("zero_weights") != "none"
+        quantized_weights = (not plan_pending
+                             and (legacy
+                                  or compression_mode("zero_weights") != "none"))
     if quantized_gradients is None:
-        quantized_gradients = (legacy
-                               or compression_mode("zero_gradients") != "none")
+        quantized_gradients = (not plan_pending
+                               and (legacy
+                                    or compression_mode("zero_gradients") != "none"))
     if stochastic_rounding is None:
         stochastic_rounding = compression_mode("zero_gradients") == "int8_sr"
     if overlap_collective_matmul is None:
@@ -143,6 +157,12 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
             "run there — pass quantized_gradients=False with remat")
     dp = mesh.shape[dp_axis]
     state_box = {"shapes": None, "treedef": None}
+    # the live knob state closures read: filled from the explicit/legacy
+    # resolution above, overwritten by the planner in init() when pending
+    kn = {"qw": quantized_weights, "qg": quantized_gradients,
+          "sr": stochastic_rounding, "ring_g": overlap_collective_matmul,
+          "ring_s": overlap_collective_matmul, "bidir": False,
+          "pending": plan_pending}
 
     def shard_spec_tree(tree):
         return jax.tree.map(
@@ -154,6 +174,26 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
         state_box["shapes"] = [tuple(p.shape) for p in flat]
         state_box["treedef"] = treedef
         shards = jax.tree.map(lambda p: _shard_leaf(p, dp), params)
+        if kn["pending"]:
+            # comm-planner zeropp sites: the qwZ gather and qgZ scatter each
+            # resolve to one implementation for the ACTUAL flat sizes
+            kn["pending"] = False
+            from ...comm.planner import resolve_site
+
+            total = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shards))
+            dg = resolve_site(op="all_gather", shape=(max(1, total // dp),),
+                              dtype="float32", axes=(dp_axis,),
+                              consumer="zeropp", axis_size=dp)
+            kn["qw"] = dg.impl == "int8"
+            kn["ring_g"] = dg.impl in ("ring", "bidir_ring")
+            kn["bidir"] = dg.impl == "bidir_ring"
+            if remat is None:  # remat modes have no qgZ reduction at all
+                ds_ = resolve_site(op="reduce_scatter", shape=(total,),
+                                   dtype="float32", axes=(dp_axis,),
+                                   consumer="zeropp", axis_size=dp)
+                kn["qg"] = ds_.impl in ("int8", "int8_sr")
+                kn["sr"] = ds_.impl == "int8_sr"
+                kn["ring_s"] = ds_.impl == "ring"
         shards = jax.device_put(
             shards, jax.tree.map(lambda s: NamedSharding(mesh, P(dp_axis)), shards))
         opt_state = tx.init(shards)
@@ -163,14 +203,15 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
     def _gather(local_1d, shape):
         """shard [m] -> full param [shape] at compute dtype (qwZ)."""
         n = int(np.prod(shape)) if shape else 1
-        if quantized_weights:
+        if kn["qw"]:
             full = quantized_all_gather(local_1d, dp_axis, block=quant_block)
-        elif overlap_collective_matmul:
+        elif kn["ring_g"]:
             # ring-chunked exact gather: p-1 ppermute hops the scheduler can
             # overlap with neighbouring params' matmuls
             from ...ops.collective_matmul import ring_all_gather
 
-            full = ring_all_gather(local_1d, dp_axis)
+            full = ring_all_gather(local_1d, dp_axis,
+                                   bidirectional=kn["bidir"])
         else:
             full = lax.all_gather(local_1d, dp_axis)
         return full.reshape(-1)[:n].reshape(shape).astype(compute_dtype)
@@ -180,7 +221,7 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
         transpose of the gather (shared by _reduce and the STE backward)."""
         flat = jnp.ravel(grad_full).astype(jnp.float32)
         flat = jnp.pad(flat, (0, dp * m - flat.shape[0]))
-        if overlap_collective_matmul:
+        if kn["ring_s"]:
             from ...ops.collective_matmul import ring_reduce_scatter
 
             return ring_reduce_scatter(flat, dp_axis)
@@ -188,7 +229,7 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
 
     def _reduce(grad_full, m, sr_key=None):
         """full grad -> this rank's mean shard [m] fp32 (qgZ)."""
-        if quantized_gradients:
+        if kn["qg"]:
             flat = jnp.ravel(grad_full).astype(jnp.float32)
             flat = jnp.pad(flat, (0, dp * m - flat.shape[0]))
             return quantized_reduce_scatter(
@@ -214,11 +255,11 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
         g.defvjp(fwd, bwd)
         return g
 
-    # remat needs no term here: remat + quantized_gradients already raised
-    use_sr = stochastic_rounding and quantized_gradients
-
     def step(state: ZeroPPState, batch):
         flat_shapes = state_box["shapes"]
+        # read at trace time (first call, after init resolved any pending
+        # plan); remat needs no term: remat + explicit qgZ already raised
+        use_sr = kn["sr"] and kn["qg"]
 
         def body(shards, opt_state, mb, step_ctr):
             local = jax.tree.map(lambda s: s[0], shards)   # [1, m] -> [m]
@@ -263,7 +304,7 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
                         # transpose is exactly _scatter_sum; the quantized
                         # branch needs the explicit STE vjp
                         f = (_ste_gather(l.shape[0], shp)(l)
-                             if quantized_weights else _gather(l, shp))
+                             if kn["qw"] else _gather(l, shp))
                         full.append(checkpoint_name(f, HPZ_NAME))
                     return loss_fn(jax.tree.unflatten(tdef, full), mb)
 

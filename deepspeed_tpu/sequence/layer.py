@@ -34,24 +34,45 @@ from jax.sharding import PartitionSpec as P
 from ..parallel.topology import SP_AXIS, TP_AXIS, get_topology
 
 
+def _a2a_quantized(x, sp: int, split_dim: int) -> bool:
+    """Whether this Ulysses exchange rides the int8 all-to-all. The
+    ``compressed_collectives`` knob wins when explicitly configured;
+    otherwise the collective planner (``comm/planner``, mode
+    static|measure) decides per site — off keeps the exact a2a."""
+    from ..comm.compressed import compression_mode
+
+    if x.shape[split_dim] % sp != 0:
+        return False  # ragged split: always the exact collective
+    if compression_mode() != "none":  # raw knob set (incl. site toggles)
+        return compression_mode("ulysses") != "none"
+    from ..comm.planner import planner_active, resolve_site
+
+    if not planner_active():
+        return False
+    d = resolve_site(op="all_to_all", shape=x.shape, dtype=x.dtype,
+                     axes=(SP_AXIS,), consumer="ulysses")
+    return d.impl in ("int8", "int8_sr")
+
+
 def _all_to_all_heads_to_seq(x, sp: int):
     """[B, S/sp, H, D] -> [B, S, H/sp, D] over the sp axis. With the
-    ``compressed_collectives`` Ulysses site on, the payload rides int8 +
-    one-lane scales (``comm/compressed.py``; backward stays the exact
-    transposed exchange); ragged head counts fall back to the exact a2a."""
-    from ..comm.compressed import compression_mode, quantized_all_to_all
+    ``compressed_collectives`` Ulysses site on (or the comm planner
+    choosing int8 for this site), the payload rides int8 + one-lane scales
+    (``comm/compressed.py``; backward stays the exact transposed
+    exchange); ragged head counts fall back to the exact a2a."""
+    from ..comm.compressed import quantized_all_to_all
 
-    if compression_mode("ulysses") != "none" and x.shape[2] % sp == 0:
+    if _a2a_quantized(x, sp, split_dim=2):
         return quantized_all_to_all(x, SP_AXIS, split_dim=2, concat_dim=1)
     return jax.lax.all_to_all(x, SP_AXIS, split_axis=2, concat_axis=1, tiled=True)
 
 
 def _all_to_all_seq_to_heads(x, sp: int):
     """[B, S, H/sp, D] -> [B, S/sp, H, D] (reverse exchange; same
-    compression gate as :func:`_all_to_all_heads_to_seq`)."""
-    from ..comm.compressed import compression_mode, quantized_all_to_all
+    compression/planner gate as :func:`_all_to_all_heads_to_seq`)."""
+    from ..comm.compressed import quantized_all_to_all
 
-    if compression_mode("ulysses") != "none" and x.shape[1] % sp == 0:
+    if _a2a_quantized(x, sp, split_dim=1):
         return quantized_all_to_all(x, SP_AXIS, split_dim=1, concat_dim=2)
     return jax.lax.all_to_all(x, SP_AXIS, split_axis=1, concat_axis=2, tiled=True)
 

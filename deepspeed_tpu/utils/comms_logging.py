@@ -52,6 +52,9 @@ class CommsLogger:
         # compressed collectives report int8 payload + scale lanes there.
         self.comms_dict: Dict[str, Dict[int, List[float]]] = defaultdict(
             lambda: defaultdict(lambda: [0, 0.0, 0, 0]))
+        # site signature -> planner decision info (comm/planner): per-mesh
+        # facts, not per-step counters — reset() deliberately keeps them
+        self.plan_records: Dict[str, Dict[str, Any]] = {}
 
     def configure(self, enabled=None, verbose=None, prof_all=None, prof_ops=None, debug=None):
         if enabled is not None:
@@ -87,6 +90,46 @@ class CommsLogger:
 
             kind = "traced" if traced else f"{latency_s*1e3:.2f} ms"
             logger.info(f"comm op: {op_name} | size: {get_msg_size(size_bytes)} | {kind}")
+
+    def record_plan(self, signature: str, info: Dict[str, Any]) -> None:
+        """Record one resolved planner decision (``comm/planner``). Stored
+        unconditionally — plan facts are cheap and ``log_summary`` prints
+        them as the plan table; unlike traffic rows they survive
+        ``reset()`` (the plan is per-topology, not per-step)."""
+        self.plan_records[signature] = dict(info)
+
+    def plan_table_lines(self) -> List[str]:
+        """The resolved-plan table (one row per site), empty when no
+        planner decision has been recorded."""
+        if not self.plan_records:
+            return []
+        header = (f"{'Consumer':<12}{'Op':<16}{'Shape':<18}"
+                  f"{'Axes':<16}{'Impl':<14}{'Block':<8}{'Source':<12}"
+                  f"{'Est(us)':<10}")
+        lines = ["Collective plan:", header, "-" * len(header)]
+        for sig in sorted(self.plan_records):
+            r = self.plan_records[sig]
+            lines.append(
+                f"{r.get('consumer', '?'):<12}{r.get('op', '?'):<16}"
+                f"{r.get('shape', '?'):<18}{r.get('axes', '?'):<16}"
+                f"{r.get('impl', '?'):<14}{str(r.get('block') or '-'):<8}"
+                f"{r.get('source', '?'):<12}"
+                f"{str(r.get('est_us') if r.get('est_us') is not None else '-'):<10}")
+        return lines
+
+    def monitor_events(self, step: int, prefix: str = "Train/Comms"):
+        """``Monitor.write_events``-compatible events from the per-op totals
+        — the bridge that gets ledger data into TensorBoard/CSV/W&B instead
+        of only stdout. One event per (op, measure) at ``step``."""
+        events = []
+        for op_name, t in sorted(self.totals().items()):
+            events.append((f"{prefix}/{op_name}/bytes", t["bytes"], step))
+            events.append((f"{prefix}/{op_name}/wire_bytes",
+                           t["wire_bytes"], step))
+            events.append((f"{prefix}/{op_name}/total_latency_ms",
+                           t["total_latency_ms"], step))
+            events.append((f"{prefix}/{op_name}/count", t["count"], step))
+        return events
 
     def totals(self) -> Dict[str, Dict[str, Any]]:
         """Aggregate per-op totals: op -> {count, bytes, wire_bytes,
@@ -125,6 +168,9 @@ class CommsLogger:
                 lines.append(f"{op_name:<28}{get_msg_size(size):<16}{count:<8}"
                              f"{total_lat*1e3:<15.2f}{avg*1e3:<13.3f}{algbw:<13.2f}{busbw:<13.2f}"
                              f"{ratio:<10}{note}")
+        plan = self.plan_table_lines()
+        if plan:
+            lines += [""] + plan
         print("\n".join(lines), flush=True)
         return self.totals()
 

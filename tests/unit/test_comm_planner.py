@@ -1,0 +1,531 @@
+"""Collective planner (comm/planner): plan IR, mesh fingerprint, cost-model
+pruning, disk cache keying/round-trip, static-mode determinism, and the five
+consumer wirings (engine DP grads, TP linears, Ulysses, MoE EP, ZeRO++)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.comm.compressed import configure_compression
+from deepspeed_tpu.comm.planner import (CollectivePlanner, CostModel,
+                                        IMPLEMENTATIONS, MeshFingerprint,
+                                        Plan, PlanCache, PlanDecision,
+                                        configure_planner, get_planner,
+                                        make_site, planner_active,
+                                        reset_planner, resolve_site)
+from deepspeed_tpu.parallel import Topology, TopologySpec, set_topology
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+
+
+@pytest.fixture(autouse=True)
+def _reset_planner_state():
+    yield
+    reset_planner()
+    configure_compression("none")
+    set_topology(Topology(TopologySpec()))
+    dist.get_comms_logger().plan_records.clear()
+
+
+# ---------------------------------------------------------------------------
+# plan IR
+# ---------------------------------------------------------------------------
+
+
+def test_site_signature_and_validation():
+    s = make_site(op="all_reduce", shape=(1024,), dtype=jnp.float32,
+                  axes=("dp_outer", "ep"), consumer="dp-grad")
+    assert s.signature() == "dp-grad:all_reduce:1024:float32@dp_outer,ep"
+    assert s.nbytes == 4096
+    s2 = make_site(op="all_gather", shape=[256], dtype="float32",
+                   axes=["dp"], consumer="zeropp", axis_size=4)
+    assert s2.signature().endswith("@dp*4")  # foreign-mesh size is identity
+    with pytest.raises(ValueError, match="unknown collective op"):
+        make_site(op="gossip", shape=(1,), dtype="float32", axes=("dp",),
+                  consumer="dp-grad")
+    with pytest.raises(ValueError, match="unknown consumer"):
+        make_site(op="all_reduce", shape=(1,), dtype="float32", axes=("dp",),
+                  consumer="mystery")
+    with pytest.raises(ValueError, match="unknown implementation"):
+        PlanDecision(impl="telepathy")
+
+
+def test_plan_json_roundtrip():
+    site = make_site(op="all_to_all", shape=(2, 8, 4, 16), dtype="float32",
+                     axes=("sp",), consumer="ulysses")
+    plan = Plan(fingerprint="abc123")
+    plan.set(site, PlanDecision(impl="int8", block=512, source="measured",
+                                est_us=12.5))
+    back = Plan.from_json(plan.to_json())
+    assert back == plan
+    assert back.get(site).impl == "int8" and back.get(site).block == 512
+
+
+# ---------------------------------------------------------------------------
+# fingerprint + cost model
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_captures_mesh_and_is_stable():
+    set_topology(Topology(TopologySpec(ep=2, tp=2)))
+    fp1 = MeshFingerprint.capture()
+    fp2 = MeshFingerprint.capture()
+    assert fp1 == fp2 and fp1.digest() == fp2.digest()
+    sizes = dict(fp1.axis_sizes)
+    assert sizes["ep"] == 2 and sizes["tp"] == 2 and fp1.n_devices == 8
+    assert fp1.dcn_axes == ()  # single host: every axis is local
+    # a different mesh shape keys a different plan file
+    set_topology(Topology(TopologySpec()))
+    assert MeshFingerprint.capture().digest() != fp1.digest()
+
+
+def _tpu_fp(dcn=("dp_outer",), ep=1):
+    return MeshFingerprint(platform="tpu", device_kind="TPU v5e",
+                           n_devices=64, n_processes=8,
+                           axis_sizes=(("pp", 1), ("dp_outer", 8), ("ep", ep),
+                                       ("sp", 1), ("tp", 8 // max(1, ep))),
+                           dcn_axes=tuple(dcn))
+
+
+def test_cost_model_prefers_int8_on_dcn_and_exact_for_tiny():
+    cm = CostModel(_tpu_fp())
+    big = make_site(op="all_reduce", shape=(128 * 2**20,), dtype="float32",
+                    axes=("dp_outer", "ep"), consumer="dp-grad")
+    assert cm.estimate(big, "int8") < cm.estimate(big, "xla")
+    assert cm.decide(big).impl in ("int8", "int8_sr", "hierarchical")
+    tiny = make_site(op="all_reduce", shape=(64,), dtype="float32",
+                     axes=("dp_outer", "ep"), consumer="dp-grad")
+    assert cm.decide(tiny).impl == "xla"  # alpha-dominated: quant can't pay
+
+
+def test_cost_model_candidate_gating_and_pruning():
+    # hierarchical needs BOTH split levels real
+    cm_flat = CostModel(_tpu_fp(ep=1))
+    site = make_site(op="all_reduce", shape=(2**20,), dtype="float32",
+                     axes=("dp_outer", "ep"), consumer="dp-grad")
+    assert "hierarchical" not in cm_flat.candidates(site)
+    cm_two = CostModel(_tpu_fp(ep=2))
+    assert "hierarchical" in cm_two.candidates(site)
+    # stochastic rounding never offered to activation exchanges
+    act = make_site(op="reduce_scatter", shape=(2**20,), dtype="float32",
+                    axes=("ep",), consumer="moe-a2a")
+    assert "int8_sr" not in cm_two.candidates(act)
+    grad = make_site(op="reduce_scatter", shape=(2**20,), dtype="float32",
+                     axes=("dp_outer",), consumer="zeropp")
+    assert "int8_sr" in cm_two.candidates(grad)
+    # pruning drops dominated candidates and keeps rank order
+    ranked = cm_two.prune(site, margin=1.05)
+    assert ranked == sorted(ranked, key=lambda kv: kv[1])
+    assert len(ranked) < len(cm_two.candidates(site))
+
+
+# ---------------------------------------------------------------------------
+# cache keying + round-trip, static determinism (tier-1 smoke)
+# ---------------------------------------------------------------------------
+
+
+def _site_list():
+    return [
+        make_site(op="all_reduce", shape=(2**18,), dtype="float32",
+                  axes=("dp_outer", "ep"), consumer="dp-grad"),
+        make_site(op="all_to_all", shape=(1, 8, 4, 8), dtype="float32",
+                  axes=("sp",), consumer="ulysses"),
+        make_site(op="all_to_all", shape=(4, 1, 16, 32), dtype="float32",
+                  axes=("ep",), consumer="moe-a2a"),
+        make_site(op="all_gather", shape=(2**15,), dtype="float32",
+                  axes=("dp_outer", "ep"), consumer="zeropp"),
+        make_site(op="gather_matmul", shape=(2, 64, 32), dtype="float32",
+                  axes=("tp",), consumer="tp-linear"),
+    ]
+
+
+def test_static_mode_resolves_every_site_deterministically():
+    """The acceptance smoke: static mode on the CPU mesh resolves every
+    wired-site shape to a concrete implementation, and two consecutive
+    fresh planners resolve the IDENTICAL plan."""
+    set_topology(Topology(TopologySpec(ep=2, sp=2, tp=2)))
+    a = CollectivePlanner("static", use_cache=False)
+    b = CollectivePlanner("static", use_cache=False)
+    for site in _site_list():
+        da, db = a.resolve(site), b.resolve(site)
+        assert da.impl in IMPLEMENTATIONS and da.source == "cost-model"
+        assert (da.impl, da.block) == (db.impl, db.block)
+    assert a.plan.decisions == b.plan.decisions
+
+
+def test_plan_cache_roundtrips_to_fresh_planner(tmp_path):
+    set_topology(Topology(TopologySpec(ep=2, sp=2, tp=2)))
+    site = _site_list()[0]
+    a = CollectivePlanner("static", cache_dir=str(tmp_path))
+    da = a.resolve(site)
+    path = a.cache.path_for(a.fingerprint)
+    assert os.path.exists(path)
+    body = json.load(open(path))
+    assert site.signature() in body["sites"]  # keyed by site signature
+    assert body["fingerprint"] == a.fingerprint.digest()
+    # a FRESH planner instance loads the decision from disk
+    b = CollectivePlanner("static", cache_dir=str(tmp_path))
+    db = b.resolve(site)
+    assert db.source == "cache" and db.impl == da.impl
+    # a corrupt cache file reads as a miss, not an error
+    open(path, "w").write("not json{")
+    c = CollectivePlanner("static", cache_dir=str(tmp_path))
+    assert c.resolve(site).source == "cost-model"
+
+
+def test_cache_ignores_foreign_fingerprint(tmp_path):
+    set_topology(Topology(TopologySpec(ep=2)))
+    a = CollectivePlanner("static", cache_dir=str(tmp_path))
+    a.resolve(_site_list()[0])
+    set_topology(Topology(TopologySpec(tp=2)))  # different mesh shape
+    b = CollectivePlanner("static", cache_dir=str(tmp_path))
+    assert b.cache.load(b.fingerprint) is None  # plan keyed off-topology
+
+
+def test_explicit_knobs_win_over_planning():
+    set_topology(Topology(TopologySpec()))
+    p = CollectivePlanner("static", use_cache=False, knobs={
+        "compression": {"mode": "int8_sr", "block": 512,
+                        "hierarchical": False,
+                        "sites": {"dp_gradients": True, "ulysses": False,
+                                  "moe": True, "zero_weights": True,
+                                  "zero_gradients": True}}})
+    d = p.resolve(make_site(op="all_reduce", shape=(64,), dtype="float32",
+                            axes=("dp_outer", "ep"), consumer="dp-grad"))
+    assert d.impl == "int8_sr" and d.source == "knob"  # even for a tiny site
+    # site toggled off -> exact, still by knob
+    d2 = p.resolve(make_site(op="all_to_all", shape=(2, 8, 4, 8),
+                             dtype="float32", axes=("sp",),
+                             consumer="ulysses"))
+    assert d2.impl == "xla" and d2.source == "knob"
+    p_ov = CollectivePlanner("static", use_cache=False,
+                             knobs={"overlap": True})
+    d3 = p_ov.resolve(make_site(op="gather_matmul", shape=(2, 8, 32),
+                                dtype="float32", axes=("tp",),
+                                consumer="tp-linear"))
+    assert d3.impl == "fused_matmul" and d3.source == "knob"
+
+
+def test_hierarchical_knob_resolves_when_split_is_real():
+    """The explicit hierarchical knob: two-level only when BOTH split
+    levels are real (same gate as the engine wiring), flat int8 otherwise."""
+    knobs = {"compression": {"mode": "int8", "block": 2048,
+                             "hierarchical": True,
+                             "sites": {"dp_gradients": True}}}
+    site = make_site(op="all_reduce", shape=(2**16,), dtype="float32",
+                     axes=("dp_outer", "ep"), consumer="dp-grad")
+    set_topology(Topology(TopologySpec(ep=2)))  # dp_outer=4, ep=2: real split
+    d = CollectivePlanner("static", use_cache=False, knobs=knobs).resolve(site)
+    assert d.impl == "hierarchical" and d.source == "knob"
+    set_topology(Topology(TopologySpec()))  # ep=1: no inner level
+    d2 = CollectivePlanner("static", use_cache=False, knobs=knobs).resolve(site)
+    assert d2.impl == "int8" and d2.source == "knob"
+
+
+def test_measure_probes_foreign_mesh_site():
+    """A zeropp-style site on a mesh axis the fleet topology doesn't have:
+    the probe builds its own mesh from the declared axis_size instead of
+    silently degrading to the cost model."""
+    set_topology(Topology(TopologySpec()))
+    p = CollectivePlanner("measure", use_cache=False, measure_reps=2,
+                          measure_max_elems=1 << 12, margin=50.0)
+    d = p.resolve(make_site(op="all_gather", shape=(4096,), dtype="float32",
+                            axes=("dp",), consumer="zeropp", axis_size=8))
+    assert d.source == "measured", d
+
+
+def test_log_summary_prints_plan_table(capsys):
+    set_topology(Topology(TopologySpec(ep=2)))
+    configure_planner("static", use_cache=False)
+    resolve_site(op="all_reduce", shape=(2**16,), dtype="float32",
+                 axes=("dp_outer", "ep"), consumer="dp-grad")
+    totals = dist.log_summary()
+    out = capsys.readouterr().out
+    assert "Collective plan" in out and "dp-grad" in out
+    assert isinstance(totals, dict)  # the PR2 contract is unchanged
+    recs = dist.get_comms_logger().plan_records
+    assert any(v["consumer"] == "dp-grad" for v in recs.values())
+
+
+# ---------------------------------------------------------------------------
+# consumer wirings
+# ---------------------------------------------------------------------------
+
+
+def _simple_problem(dim=64):
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.normal(size=(dim, 10)) * 0.1, jnp.float32),
+              "b": jnp.zeros((10,), jnp.float32)}
+
+    def loss_fn(p, batch):
+        logits = batch["x"] @ p["w"] + p["b"]
+        one_hot = jax.nn.one_hot(batch["y"], 10)
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * one_hot, -1))
+
+    def batch(i, n):
+        r = np.random.default_rng(100 + i)
+        return {"x": jnp.asarray(r.normal(size=(n, dim)), jnp.float32),
+                "y": jnp.asarray(r.integers(0, 10, n), jnp.int32)}
+
+    return loss_fn, params, batch
+
+
+def _run_engine(extra_cfg, steps=3, dim=64):
+    import deepspeed_tpu as ds
+
+    loss_fn, params, batch = _simple_problem(dim)
+    set_topology(Topology(TopologySpec()))
+    cfg = {"train_micro_batch_size_per_gpu": 16,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": 0}, "steps_per_print": 10**9}
+    cfg.update(extra_cfg or {})
+    eng, *_ = ds.initialize(model=loss_fn,
+                            model_parameters=jax.tree.map(jnp.copy, params),
+                            config=cfg)
+    return eng, [float(eng.train_batch(batch(i, 16 * 8))) for i in range(steps)]
+
+
+def test_engine_planner_off_bit_identical_and_inert():
+    eng_ref, ref = _run_engine(None)
+    assert not planner_active()  # default config leaves the planner off
+    assert eng_ref._compressed_dp is False
+    eng_off, off = _run_engine({"comm_planner": "off"})
+    assert ref == off  # off IS the default path, bit for bit
+
+
+def test_engine_dp_grad_site_resolves_under_static():
+    eng, losses = _run_engine({"comm_planner": {"mode": "static",
+                                                "use_cache": False}})
+    recs = dist.get_comms_logger().plan_records
+    dp = [v for v in recs.values() if v["consumer"] == "dp-grad"]
+    assert dp and dp[0]["impl"] in IMPLEMENTATIONS and dp[0]["mode"] == "static"
+    # the engine's compiled path matches the recorded decision
+    quant = dp[0]["impl"] in ("int8", "int8_sr", "hierarchical")
+    assert eng._compressed_dp == quant
+    assert all(np.isfinite(losses))
+
+
+def test_engine_dp_grad_cached_plan_drives_compression(tmp_path):
+    """A plan cache written for this mesh fingerprint is loaded by the
+    engine's fresh planner and switches the DP-grad reduction to int8; the
+    losses track the exact run (the PR2 tolerance)."""
+    loss_fn, params, _ = _simple_problem()
+    n_elems = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    topo = Topology(TopologySpec())
+    fp = MeshFingerprint.capture(topo)
+    site = make_site(op="all_reduce", shape=(n_elems,), dtype="float32",
+                     axes=topo.dp_axes, consumer="dp-grad")
+    plan = Plan(fingerprint=fp.digest())
+    plan.set(site, PlanDecision(impl="int8", block=512, source="measured"))
+    PlanCache(str(tmp_path)).store(fp, plan)
+
+    _, ref = _run_engine(None)
+    eng, got = _run_engine({"comm_planner": {"mode": "static",
+                                             "cache_dir": str(tmp_path)}})
+    assert eng._compressed_dp is True
+    assert eng._dp_grad_impl == ("int8", 512, False)
+    assert got[0] == ref[0]  # first loss predates any reduction effect
+    for a, b in zip(ref, got):
+        assert abs(a - b) < 0.02 * abs(a) + 1e-3, (ref, got)
+
+
+def test_tp_linear_site_fused_by_plan_matches_declarative():
+    """tp-linear wiring: a planner decision of fused_matmul engages the
+    ring-overlapped linears with NO knob set, and the logits/grads match
+    the declarative model at the PR1 tolerances."""
+    import dataclasses
+
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM, init_params,
+                                                  make_loss_fn)
+
+    cfg = TransformerConfig(vocab_size=128, hidden_size=32,
+                            intermediate_size=64, num_layers=2, num_heads=4,
+                            num_kv_heads=2, max_seq_len=64, dtype=jnp.float32)
+    assert not cfg.overlap_collective_matmul  # the knob stays untouched
+    set_topology(Topology(TopologySpec(tp=4)))
+    model = TransformerLM(cfg)
+    params = init_params(model, batch=1, seq=32)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, 128, (2, 32)),
+                         jnp.int32)
+
+    reset_planner()
+    logits_off = jax.jit(lambda p, t: model.apply({"params": p}, t))(
+        params, tokens)
+    g_off = jax.jit(jax.grad(make_loss_fn(model)))(params, {"tokens": tokens})
+
+    planner = configure_planner("static", use_cache=False)
+    site = make_site(op="gather_matmul", shape=(2, 32, 32), dtype="float32",
+                     axes=("tp",), consumer="tp-linear")
+    planner.plan.decisions[site.signature()] = PlanDecision(
+        impl="fused_matmul", source="measured")
+    logger = dist.get_comms_logger()
+    logger.configure(enabled=True, prof_all=True)
+    logger.reset()
+    try:
+        logits_on = jax.jit(lambda p, t: model.apply({"params": p}, t))(
+            params, tokens)
+        # the ring primitives actually ran (ledger sees the chunk traffic)
+        assert "all_gather_matmul" in logger.totals()
+    finally:
+        logger.configure(enabled=False)
+        logger.reset()
+    np.testing.assert_allclose(np.asarray(logits_on), np.asarray(logits_off),
+                               rtol=2e-5, atol=2e-5)
+    g_on = jax.jit(jax.grad(make_loss_fn(model)))(params, {"tokens": tokens})
+    for a, b in zip(jax.tree.leaves(g_on), jax.tree.leaves(g_off)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=1e-5)
+
+
+def test_ulysses_site_planner_picks_int8_when_transport_bound():
+    """ulysses wiring: with the quantizer modeled as free (transport-bound
+    regime) the planner resolves int8 for the sp exchange and the quantized
+    a2a actually runs; output tracks the exact exchange."""
+    from deepspeed_tpu.models.transformer import attention_core
+    from deepspeed_tpu.sequence.layer import ulysses_attention
+
+    set_topology(Topology(TopologySpec(sp=2)))
+    rng = np.random.default_rng(7)
+    b, s, h, d = 4, 16, 4, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+               for _ in range(3))
+
+    def local_attn(q_, k_, v_, pos):
+        return attention_core(q_, k_, v_, causal=True, impl="xla")
+
+    def run():
+        return np.asarray(jax.jit(
+            lambda a, b_, c: ulysses_attention(local_attn, a, b_, c))(q, k, v))
+
+    reset_planner()
+    exact = run()
+
+    planner = configure_planner("static", use_cache=False)
+    # transport-bound regime: quantization modeled as free -> int8 wins
+    planner.cost.quant_cost = 0.0
+    planner.cost.quant_fixed = 0.0
+    logger = dist.get_comms_logger()
+    logger.configure(enabled=True, prof_all=True)
+    logger.reset()
+    try:
+        planned = run()
+        assert "quantized_all_to_all" in logger.totals()
+    finally:
+        logger.configure(enabled=False)
+        logger.reset()
+    recs = dist.get_comms_logger().plan_records
+    assert any(v["consumer"] == "ulysses" and v["impl"] == "int8"
+               for v in recs.values())
+    assert np.abs(exact - planned).max() < 0.05 * max(np.abs(exact).max(), 1.0)
+
+
+def test_moe_site_gates_quantized_ep_through_planner():
+    from deepspeed_tpu.moe.sharded_moe import quantized_ep_ready
+
+    set_topology(Topology(TopologySpec(ep=4)))
+    shape = (8, 8, 16, 32)
+    reset_planner()
+    assert not quantized_ep_ready(8, 8, site_shape=shape)  # planner off
+    planner = configure_planner("static", use_cache=False)
+    planner.cost.quant_cost = 0.0
+    planner.cost.quant_fixed = 0.0
+    assert quantized_ep_ready(8, 8, site_shape=shape)
+    recs = dist.get_comms_logger().plan_records
+    assert any(v["consumer"] == "moe-a2a" for v in recs.values())
+    # structural gates still bind regardless of the plan
+    assert not quantized_ep_ready(9, 8, site_shape=shape)  # 9 % ep != 0
+
+
+def test_zeropp_sites_resolve_at_init_and_train():
+    import optax
+    from jax.sharding import Mesh
+
+    from deepspeed_tpu.runtime.zero.zeropp import zeropp_train_step_factory
+
+    rng = np.random.default_rng(0)
+    params = {"w1": jnp.asarray(rng.normal(size=(32, 16)) * 0.3, jnp.float32),
+              "w2": jnp.asarray(rng.normal(size=(16, 8)) * 0.3, jnp.float32)}
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((jnp.tanh(x @ p["w1"]) @ p["w2"] - y) ** 2)
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+    planner = configure_planner("static", use_cache=False)
+    init, step, _ = zeropp_train_step_factory(
+        loss_fn, optax.adam(1e-2), mesh, dp_axis="dp")
+    state = init(params)
+    recs = dist.get_comms_logger().plan_records
+    zp = [v for v in recs.values() if v["consumer"] == "zeropp"]
+    assert len(zp) == 2  # the qwZ gather and qgZ scatter sites
+    assert {v["op"] for v in zp} == {"all_gather", "reduce_scatter"}
+    x = jnp.asarray(rng.normal(size=(8, 32)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(8, 8)), jnp.float32)
+    state, loss = step(state, (x, y))
+    assert np.isfinite(float(loss))
+
+
+def test_zeropp_planner_inactive_keeps_legacy_default():
+    """Without a planner the factory's legacy default (qwZ+qgZ on) is
+    untouched — the off mode changes nothing."""
+    import optax
+    from jax.sharding import Mesh
+
+    from deepspeed_tpu.runtime.zero import zeropp as zpp
+
+    reset_planner()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+    captured = {}
+    orig = zpp.quantized_all_gather
+
+    def spy(x, axis, block=None, **kw):
+        captured["hit"] = True
+        return orig(x, axis, block=block, **kw)
+
+    init, step, _ = zpp.zeropp_train_step_factory(
+        lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2),
+        optax.sgd(1e-2), mesh, dp_axis="dp")
+    zpp.quantized_all_gather = spy
+    try:
+        rng = np.random.default_rng(0)
+        state = init({"w": jnp.asarray(rng.normal(size=(16, 4)), jnp.float32)})
+        x = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+        y = jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)
+        state, loss = step(state, (x, y))
+    finally:
+        zpp.quantized_all_gather = orig
+    assert captured.get("hit")  # legacy qwZ gather still the default
+    assert np.isfinite(float(loss))
+
+
+def test_config_string_shorthand_and_mode_validation():
+    from deepspeed_tpu.runtime.config import load_config
+
+    cfg = load_config({"comm_planner": "static"})
+    assert cfg.comm_planner.mode == "static"
+    cfg2 = load_config({"comm_planner": {"mode": "measure",
+                                         "measure_reps": 2}})
+    assert cfg2.comm_planner.measure_reps == 2
+    with pytest.raises(ValueError, match="comm_planner mode"):
+        CollectivePlanner("turbo")
+
+
+def test_measure_mode_times_survivors():
+    """measure mode: microbenchmarks run for the pruned candidate set and
+    the winner is recorded with source 'measured' (or cost-model when only
+    one survivor exists)."""
+    set_topology(Topology(TopologySpec(ep=2)))
+    p = CollectivePlanner("measure", use_cache=False, measure_reps=2,
+                          measure_max_elems=1 << 12, margin=50.0)
+    d = p.resolve(make_site(op="all_gather", shape=(4096,), dtype="float32",
+                            axes=("dp_outer", "ep"), consumer="zeropp"))
+    assert d.impl in IMPLEMENTATIONS
+    assert d.source in ("measured", "cost-model")
+    assert d.est_us is not None and d.est_us > 0

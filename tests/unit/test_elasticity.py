@@ -1,0 +1,79 @@
+"""Elasticity batch-schedule edges (elasticity/elasticity.py, elastic_agent):
+invalid world sizes, clamp-to-largest-valid, and RescaleDecision round-trip."""
+
+import dataclasses
+
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import RescaleDecision, decide_world
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityConfigError, ElasticityIncompatibleWorldSize,
+    compute_elastic_config, micro_for_world, resolve_elasticity_config,
+    valid_chip_counts)
+
+
+def _cfg(**over):
+    base = {"enabled": True, "max_train_batch_size": 100,
+            "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 10}
+    base.update(over)
+    return {"elasticity": base}
+
+
+def test_schedule_resolves_and_rejects_world_outside_valid_set():
+    final_batch, valid, micro = compute_elastic_config(_cfg(), world_size=0)
+    assert micro is None and valid and final_batch <= 100
+    # every valid world really divides the schedule
+    for w in valid:
+        assert any(final_batch % (m * w) == 0 for m in (2, 4))
+    # a world OUTSIDE the valid set raises the incompatible-world error
+    bad = next(w for w in range(1, max(valid) + 2) if w not in valid)
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(_cfg(), world_size=bad)
+    # a valid world also picks the LARGEST dividing micro-batch
+    good = max(valid)
+    fb, _, micro = compute_elastic_config(_cfg(), world_size=good)
+    assert fb == final_batch
+    assert micro == max(m for m in (2, 4) if (final_batch // good) % m == 0)
+
+
+def test_micro_for_world_no_fit_raises():
+    cfg = resolve_elasticity_config(_cfg())
+    with pytest.raises(ElasticityIncompatibleWorldSize, match="micro-batch"):
+        micro_for_world(cfg, final_batch=100, world_size=100)  # per-chip 1
+
+
+def test_valid_chip_counts_bounded_by_batch():
+    # no chip count beyond batch/min(micro) can ever qualify
+    assert valid_chip_counts(8, [2, 4], 1, 10000) == [1, 2, 4]
+
+
+def test_disabled_config_rejected():
+    with pytest.raises(ElasticityConfigError, match="not enabled"):
+        compute_elastic_config(_cfg(enabled=False))
+
+
+def test_decide_world_clamps_to_largest_valid():
+    """The agent must pick a world it CAN run: largest valid <= available."""
+    _, valid, _ = compute_elastic_config(_cfg(), world_size=0)
+    # available lands between two valid worlds -> clamp DOWN to the largest
+    available = max(valid) + 1
+    d = decide_world(_cfg(), available)
+    assert d.world_size == max(valid)
+    bad = next(w for w in range(1, max(valid) + 2) if w not in valid)
+    d2 = decide_world(_cfg(), bad)
+    assert d2.world_size == max(w for w in valid if w <= bad)
+    # nothing fits below the smallest valid world
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        decide_world(_cfg(micro_batch_sizes=[8], max_train_batch_size=64,
+                          min_gpus=2), available=1)
+
+
+def test_rescale_decision_roundtrip_and_consistency():
+    d = decide_world(_cfg(), available=8)
+    # the decision is internally consistent: batch = micro * world * gas
+    assert d.final_batch == d.micro_batch * d.world_size * d.gradient_accumulation
+    assert d.gradient_accumulation >= 1
+    # dataclass round-trip (what an agent would persist between rounds)
+    back = RescaleDecision(**dataclasses.asdict(d))
+    assert back == d
+    assert back.gradient_accumulation == d.gradient_accumulation
