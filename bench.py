@@ -892,11 +892,72 @@ def planner_bench():
             "plan": rows, "device": "tpu" if on_tpu else f"cpu-mesh-{n}"}
 
 
+def resilience_bench():
+    """Rung rz (resilience subsystem, runtime/resilience/): snapshot and
+    restore latency for a training-state-sized pytree. The number that
+    matters for the step loop is the ASYNC call-return latency (device→host
+    fetch only — the disk write overlaps training on the writer thread);
+    the sync write gives the disk-bound MB/s floor and the ratio between
+    them is the stall the background writer removes from every cadence
+    snapshot."""
+    import shutil as _shutil
+    import tempfile
+
+    from deepspeed_tpu.runtime.resilience import SnapshotManager
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    mb = 256 if on_tpu else 64
+    n = (mb << 20) // 4
+    rng = np.random.default_rng(0)
+    # a realistic state mix: params + two adam moments + a few scalars
+    third = n // 3
+    tree = {"params": jnp.asarray(rng.normal(size=(third,)), jnp.float32),
+            "exp_avg": jnp.asarray(rng.normal(size=(third,)), jnp.float32),
+            "exp_avg_sq": jnp.asarray(rng.normal(size=(third,)), jnp.float32),
+            "step": jnp.asarray(3, jnp.int32)}
+    jax.block_until_ready(tree)
+    total_mb = sum(x.nbytes for x in jax.tree.leaves(tree)) / 2**20
+
+    d = tempfile.mkdtemp(prefix="dstpu_rz_")
+    try:
+        sm = SnapshotManager(d, keep=4, use_async=False)
+        sm.snapshot(tree, step=0)  # warm the path (dir creation, imports)
+        t0 = time.perf_counter()
+        sm.snapshot(tree, step=1)
+        sync_s = time.perf_counter() - t0
+
+        sma = SnapshotManager(d, keep=4, use_async=True)
+        t0 = time.perf_counter()
+        sma.snapshot(tree, step=2)
+        async_call_s = time.perf_counter() - t0  # the step-path stall
+        t0 = time.perf_counter()
+        sma.wait()
+        drain_s = time.perf_counter() - t0
+        sma.close()
+
+        t0 = time.perf_counter()
+        sm.restore_tree(tree)
+        restore_s = time.perf_counter() - t0
+    finally:
+        _shutil.rmtree(d, ignore_errors=True)
+
+    return {"metric": "resilience_snapshot_overlap",
+            "value": round(sync_s / async_call_s, 2), "unit": "x",
+            "vs_baseline": None, "state_mb": round(total_mb, 1),
+            "sync_ms": round(sync_s * 1e3, 2),
+            "sync_mb_per_s": round(total_mb / sync_s, 1),
+            "async_call_ms": round(async_call_s * 1e3, 2),
+            "async_drain_ms": round(drain_s * 1e3, 2),
+            "restore_ms": round(restore_s * 1e3, 2),
+            "restore_mb_per_s": round(total_mb / restore_s, 1),
+            "device": "tpu" if on_tpu else "cpu"}
+
+
 RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "3b": rung3b_big_model,
          "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses,
          "cm": collective_matmul_bench, "qx": quantized_collectives_bench,
-         "plan": planner_bench}
+         "plan": planner_bench, "rz": resilience_bench}
 
 
 def _with_ledger(fn):
@@ -940,7 +1001,8 @@ def run_ladder():
     plan = [("1", cpu1), ("2", chip), ("3", chip), ("4", cpu8), ("5", cpu8),
             ("cm", {} if multichip else cpu8),
             ("qx", {} if multichip else cpu8),
-            ("plan", {} if multichip else cpu8)]
+            ("plan", {} if multichip else cpu8),
+            ("rz", chip)]
     results = []
     for rung, env_over in plan:
         env = dict(os.environ)

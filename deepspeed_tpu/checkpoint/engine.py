@@ -21,11 +21,13 @@ Layout under ``<save_dir>/<tag>/``:
 
 import json
 import os
+import threading
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
+from ..utils.fs import fsync_write_json, fsync_write_text
 from ..utils.logging import log_dist, logger
 
 try:
@@ -68,8 +70,12 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             if partial:  # restore a subtree only (skips reading dropped keys)
                 ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
                 restore_args = ocp.checkpoint_utils.construct_restore_args(abstract)
+                # transforms={} is the partial-restore spelling this orbax
+                # line supports: keys absent from ``item`` are dropped
+                # unread (the newer ``partial_restore=True`` kwarg does not
+                # exist here)
                 return ckptr.restore(path, args=ocp.args.PyTreeRestore(
-                    item=abstract, restore_args=restore_args, partial_restore=True))
+                    item=abstract, restore_args=restore_args, transforms={}))
             return self._ckptr.restore(path, args=ocp.args.StandardRestore(abstract))
         return self._ckptr.restore(path)
 
@@ -78,14 +84,76 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             self._ckptr.wait_until_finished()
 
 
+# In-flight async commit threads, keyed by abspath(save_dir). The commit
+# (array-write wait + metadata + 'latest') runs on a background thread; a
+# reader — possibly a DIFFERENT engine pointed at the same directory, as in
+# restart-recovery — must be able to rendezvous with it, so the registry is
+# module-global rather than an attribute of the writing engine.
+_PENDING_COMMITS: Dict[str, threading.Thread] = {}
+_PENDING_LOCK = threading.Lock()
+
+
+def wait_pending_commits(ckpt_dir: str) -> None:
+    """Join any in-flight async checkpoint commit targeting ``ckpt_dir``."""
+    with _PENDING_LOCK:
+        t = _PENDING_COMMITS.get(os.path.abspath(ckpt_dir))
+    if t is not None and t is not threading.current_thread() and t.is_alive():
+        t.join()
+
+
+def _is_committed(ckpt_dir: str, tag: str) -> bool:
+    # metadata.json doubles as the commit marker: it is written atomically
+    # AFTER the array write lands, so its presence certifies the tag
+    return os.path.exists(os.path.join(ckpt_dir, str(tag), "metadata.json"))
+
+
 def read_latest_tag(ckpt_dir: str) -> Optional[str]:
-    """The tag the ``latest`` pointer names, or None when absent — the ONE
-    place that knows the pointer format."""
-    p = os.path.join(os.path.abspath(ckpt_dir), "latest")
+    """The newest COMMITTED tag the ``latest`` pointer names — the ONE place
+    that knows the pointer format.
+
+    A pointed tag missing its commit marker (a torn write: the process died
+    between the array write and the metadata commit) is skipped in favor of
+    the newest tag that did commit, so restore never dereferences a
+    half-written checkpoint. No pointer at all still means None — a
+    directory of ``save_latest=False`` checkpoints never designated a
+    latest, and inventing one would silently load state the user did not
+    ask for."""
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    wait_pending_commits(ckpt_dir)
+    p = os.path.join(ckpt_dir, "latest")
     if not os.path.exists(p):
         return None
     with open(p) as f:
-        return f.read().strip()
+        tag = f.read().strip()
+    if not tag:
+        return None
+    if _is_committed(ckpt_dir, tag):
+        return tag
+    # torn pointer target: fall back to the newest committed tag that was
+    # itself saved into the 'latest' lineage — a save_latest=False side
+    # checkpoint (its metadata records that) must not be resurrected as
+    # the latest just because its mtime is newest
+    candidates = []
+    for name in os.listdir(ckpt_dir):
+        meta = os.path.join(ckpt_dir, name, "metadata.json")
+        if os.path.isdir(os.path.join(ckpt_dir, name)) and os.path.exists(meta):
+            try:
+                with open(meta) as f:
+                    in_lineage = json.load(f).get("save_latest", True)
+            except (OSError, json.JSONDecodeError):
+                continue  # its own commit is damaged; not a fallback target
+            if in_lineage:
+                candidates.append((os.path.getmtime(meta), name))
+    if not candidates:
+        logger.warning(
+            f"checkpoint tag {tag!r} in {ckpt_dir} has no commit marker "
+            "(torn write?) and no earlier committed tag exists")
+        return None
+    newest = max(candidates)[1]
+    logger.warning(
+        f"checkpoint tag {tag!r} in {ckpt_dir} has no commit marker "
+        f"(torn write?) — falling back to committed tag {newest!r}")
+    return newest
 
 
 def _state_to_tree(engine) -> Dict[str, Any]:
@@ -100,8 +168,14 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     """Reference ``engine.save_checkpoint:3140``. Collective: every process
     must call it (orbax coordinates multi-host writes)."""
     tag = tag if tag is not None else f"global_step{engine.global_steps}"
-    path = os.path.join(os.path.abspath(save_dir), str(tag))
+    save_dir = os.path.abspath(save_dir)
+    path = os.path.join(save_dir, str(tag))
     ck = _get_ckpt_engine(engine)
+    # ordering: an async checkpointer rejects a second save() while the
+    # previous one is still writing — the wait must come BEFORE this save,
+    # not only inside the commit thread (which used to race this call)
+    wait_pending_commits(save_dir)
+    ck.wait()
     ck.save(_state_to_tree(engine), os.path.join(path, "state"))
     host_adam = getattr(engine, "_host_adam", None)
     if host_adam is not None and jax.process_index() == 0:
@@ -118,6 +192,9 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         np.savez(os.path.join(path, "host_optimizer.npz"), **flat)
     meta = {
         "tag": str(tag),
+        # recorded so the torn-pointer fallback can tell pointer-lineage
+        # checkpoints from side saves the user never designated as latest
+        "save_latest": bool(save_latest),
         "global_steps": engine.global_steps,
         "skipped_steps": engine.skipped_steps,
         "config": engine.config.to_dict(),
@@ -136,24 +213,24 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         # 'latest' must only ever point at a durable checkpoint: wait for the
         # array write to land before committing the pointer. Runs on a
         # background thread for async saves so training overlaps the write.
+        # Both files go down as write-temp + fsync + atomic rename, and
+        # metadata.json (the commit marker read_latest_tag checks) lands
+        # BEFORE the pointer — a crash between the two leaves a valid,
+        # merely unpointed, checkpoint rather than a pointed torn one.
         ck.wait()
         if jax.process_index() == 0:
-            with open(os.path.join(path, "metadata.json"), "w") as f:
-                json.dump(meta, f, indent=2, default=str)
+            fsync_write_json(os.path.join(path, "metadata.json"), meta,
+                             indent=2, default=str)
             if save_latest:
-                with open(os.path.join(os.path.abspath(save_dir), "latest"), "w") as f:
-                    f.write(str(tag))
+                fsync_write_text(os.path.join(save_dir, "latest"), str(tag))
         log_dist(f"saved checkpoint {path}")
 
     if getattr(ck, "use_async", False):
-        import threading
-
-        prev = getattr(engine, "_ckpt_commit_thread", None)
-        if prev is not None and prev.is_alive():
-            prev.join()  # serialize commits so 'latest' ordering is preserved
         t = threading.Thread(target=_commit, daemon=False)
+        with _PENDING_LOCK:
+            _PENDING_COMMITS[save_dir] = t
         t.start()
-        engine._ckpt_commit_thread = t
+        engine._ckpt_commit_thread = t  # load_checkpoint also joins via registry
     else:
         _commit()
     return path
@@ -167,9 +244,13 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     ``load_universal_checkpoint`` flag ``engine.py:867``): the stored global
     arrays are re-laid-out onto this engine's shardings."""
     load_dir = os.path.abspath(load_dir)
+    # an in-flight async save must land before we read 'latest' — including
+    # one started by a DIFFERENT engine in this process (the registry), and
+    # this engine's own writes to other directories (the attribute)
+    wait_pending_commits(load_dir)
     pending = getattr(engine, "_ckpt_commit_thread", None)
     if pending is not None and pending.is_alive():
-        pending.join()  # an in-flight async save must land before we read 'latest'
+        pending.join()
     if tag is None:
         tag = read_latest_tag(load_dir)
         if tag is None:

@@ -108,6 +108,35 @@ def _supervise(cmd: List[str], env, max_restarts: int = 100,
         time.sleep(backoff_s)
 
 
+def install_signal_handlers(handler, signals=(signal.SIGINT, signal.SIGTERM),
+                            chain: bool = False):
+    """The launcher's signal plumbing, shared with the resilience tier
+    (``runtime/resilience/preempt.py``): install ``handler(signum, frame)``
+    for each signal, tolerating non-main-thread contexts (tests) where
+    ``signal.signal`` raises. With ``chain=True`` the previously-installed
+    Python handler still runs after ``handler`` — an engine-level watcher
+    must not silently disarm a launcher/supervisor handler. Python's default
+    SIGINT handler is deliberately NOT chained: it raises KeyboardInterrupt
+    at an arbitrary bytecode, which would abort the very drain the watcher
+    installed itself to perform — only handlers someone explicitly installed
+    keep running. Returns the {signum: previous_handler} map for the signals
+    actually installed."""
+    previous = {}
+
+    def chained(signum, frame):
+        handler(signum, frame)
+        prev = previous.get(signum)
+        if chain and callable(prev) and prev is not signal.default_int_handler:
+            prev(signum, frame)
+
+    for sig in signals:
+        try:
+            previous[sig] = signal.signal(sig, chained)
+        except ValueError:  # not main thread (tests)
+            pass
+    return previous
+
+
 def _forward_signals(proc: subprocess.Popen, stop_flag: Optional[list] = None):
     def handler(signum, frame):
         if stop_flag is not None:
@@ -117,11 +146,7 @@ def _forward_signals(proc: subprocess.Popen, stop_flag: Optional[list] = None):
         except ProcessLookupError:
             pass
 
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        try:
-            signal.signal(sig, handler)
-        except ValueError:  # not main thread (tests)
-            pass
+    install_signal_handlers(handler)
 
 
 def main(argv=None):  # pragma: no cover - CLI shim

@@ -427,6 +427,64 @@ class AutotuningConfig(ConfigModel):
 
 @register_config
 @dataclass
+class SentinelConfig(ConfigModel):
+    """Divergence sentinel (``runtime/resilience/sentinel.py``): NaN/inf-loss
+    streaks and grad-norm spikes trip ``policy``."""
+    enabled: bool = True          # within an enabled resilience block
+    nan_streak: int = 3           # consecutive non-finite steps to trip
+    spike_factor: float = 10.0    # grad_norm > factor * rolling median
+    spike_streak: int = 2         # consecutive spike steps to trip
+    spike_window: int = 64        # rolling-median history length
+    min_history: int = 8          # samples before spike verdicts start
+    policy: str = "rollback"      # rollback | warn | halt
+    lr_drop_factor: float = 1.0   # <1.0 multiplies the LR on each rollback
+
+
+@register_config
+@dataclass
+class PreemptionConfig(ConfigModel):
+    """Preemption watcher (``runtime/resilience/preempt.py``)."""
+    enabled: bool = True
+    install_signal_handler: bool = True
+    signals: List[str] = field(default_factory=lambda: ["SIGTERM"])
+    probe_file: Optional[str] = None  # also honors $DSTPU_PREEMPT_FILE
+
+
+@register_config
+@dataclass
+class FaultInjectionConfig(ConfigModel):
+    """Deterministic fault harness (``runtime/resilience/faults.py``) —
+    test/chaos-drill use only; every injection is off by default."""
+    enabled: bool = False
+    nan_loss_at_steps: List[int] = field(default_factory=list)
+    grad_spike_at_steps: List[int] = field(default_factory=list)
+    spike_magnitude: float = 1e6
+    preempt_at_step: Optional[int] = None
+    torn_write_at_steps: List[int] = field(default_factory=list)
+    crash_before_commit_at_steps: List[int] = field(default_factory=list)
+
+
+@register_config
+@dataclass
+class ResilienceConfig(ConfigModel):
+    """Resilience subsystem (``runtime/resilience/``): async snapshots,
+    divergence sentinel with rollback, preemption drain, restore-on-restart.
+    Disabled by default — the engine step is then bit-identical to a tree
+    without the subsystem."""
+    enabled: bool = False
+    snapshot_dir: Optional[str] = None  # REQUIRED when enabled
+    snapshot_interval: int = 100        # steps between cadence snapshots
+    async_snapshot: bool = True         # background writer thread
+    keep_snapshots: int = 2             # manifest entries retained
+    shard_mb: int = 256                 # target checksummed-shard size
+    restore_on_start: bool = True       # resume from latest valid at init
+    sentinel: SentinelConfig = field(default_factory=SentinelConfig)
+    preemption: PreemptionConfig = field(default_factory=PreemptionConfig)
+    faults: FaultInjectionConfig = field(default_factory=FaultInjectionConfig)
+
+
+@register_config
+@dataclass
 class CheckpointConfig(ConfigModel):
     tag_validation: str = "Warn"  # Ignore | Warn | Fail
     load_universal: bool = False
@@ -585,6 +643,7 @@ class DeepSpeedTPUConfig(ConfigModel):
     data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
     autotuning: AutotuningConfig = field(default_factory=AutotuningConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)
     eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
     quantize_training: Optional[QuantizeTrainingConfig] = None
@@ -606,6 +665,10 @@ class DeepSpeedTPUConfig(ConfigModel):
         cp = d.get("comm_planner")
         if isinstance(cp, str):
             d["comm_planner"] = {"mode": cp}
+        # string shorthand: "resilience": "<dir>" enables snapshots there
+        rz = d.get("resilience")
+        if isinstance(rz, str):
+            d["resilience"] = {"enabled": True, "snapshot_dir": rz}
         cl = d.pop("curriculum_learning", None)
         if cl:
             de = dict(d.get("data_efficiency") or {})

@@ -181,6 +181,7 @@ class DeepSpeedTPUEngine:
                     "optimizer callable must return an optax "
                     f"GradientTransformation, got {type(optimizer).__name__}")
             log_dist("using client callable to create basic optimizer")
+        self._client_optimizer = optimizer is not None  # resilience lr_drop warning
         self.loss_fn_raw = loss_fn
         self._loss_takes_rng = _accepts_rng(loss_fn)
         self._loss_takes_ltd = _accepts_kw(loss_fn, "ltd_keep")
@@ -246,10 +247,24 @@ class DeepSpeedTPUEngine:
             self.lr_schedule = lr_scheduler
         else:
             self.lr_schedule = build_lr_schedule(config.scheduler.type, sched_params, base_lr)
+        # resilience rollback may drop the LR (sentinel lr_drop_factor):
+        # the scale is a trace-time constant read when a step (re)compiles;
+        # ResilienceManager invalidates the compiled steps when it changes.
+        # Only wrapped when the subsystem is on — off stays byte-for-byte
+        # the schedule the optimizer was always built with.
+        self._lr_scale = 1.0
+        if config.resilience.enabled:
+            _base_schedule = self.lr_schedule
+            self.lr_schedule = lambda step: _base_schedule(step) * self._lr_scale
         if optimizer is not None:
             self.tx = optimizer
         else:
-            opt_params["lr"] = self.lr_schedule if config.scheduler.type else base_lr
+            # with resilience on, the optimizer must see the WRAPPED schedule
+            # even when no scheduler is configured — a constant base_lr float
+            # here would make the sentinel's lr_drop_factor a silent no-op on
+            # the actual updates while the metrics reported the drop
+            use_schedule = config.scheduler.type or config.resilience.enabled
+            opt_params["lr"] = self.lr_schedule if use_schedule else base_lr
             self.tx = build_optimizer(config.optimizer.type, opt_params)
 
         # --- frozen parameters (reference requires_grad=False / the
@@ -342,6 +357,16 @@ class DeepSpeedTPUEngine:
                 "drop needs model cooperation (as in the reference): build "
                 "the schedule with ProgressiveLayerDrop.from_config and gate "
                 "layers with progressive_layer_drop.pld_apply in the loss fn")
+        # resilience (runtime/resilience/): snapshots + sentinel + preemption.
+        # Constructed only when enabled, restore-on-restart runs before the
+        # first step so a relaunch continues where the last snapshot left off.
+        self.resilience = None
+        if config.resilience.enabled:
+            from .resilience import ResilienceManager
+
+            self.resilience = ResilienceManager(self, config.resilience)
+            if config.resilience.restore_on_start:
+                self.resilience.maybe_restore()
         log_dist(f"engine initialized: {self.topo}, zero_stage={zc.stage}, "
                  f"gas={self.gas}, micro_bs={self.micro_batch_size}, "
                  f"dtype={jnp.dtype(self.compute_dtype).name}")
@@ -866,6 +891,11 @@ class DeepSpeedTPUEngine:
             self._skipped_dev = self._skipped_dev + metrics["overflow"].astype(jnp.int32)
         self._step_times.append(time.perf_counter() - t0)
         self._maybe_report()
+        if self.resilience is not None:
+            # fault injection -> preemption drain -> sentinel -> cadence
+            # snapshot (runtime/resilience/supervisor.py). Not a hot-path
+            # cost when disabled: the attribute is None and nothing runs.
+            self.resilience.post_step()
         at = self.config.autotuning
         if self.global_steps == at.end_profile_step:
             from ..autotuning.autotuner import AUTOTUNE_RESULT_ENV, report_autotune_result
@@ -1360,6 +1390,13 @@ class DeepSpeedTPUEngine:
                 os.path.join(path, "dstpu_swap"),
                 num_threads=aio.thread_count, block_size=aio.block_size)
         return swappers[path]
+
+    def should_stop(self) -> bool:
+        """True once the resilience tier drained for a preemption: the final
+        snapshot is durable and the training loop should exit so the grace
+        window is not spent on steps that will be lost."""
+        r = self.resilience
+        return bool(r is not None and r.stop_requested)
 
     # checkpointing (delegates to checkpoint subsystem) -----------------
     def save_checkpoint(self, save_dir, tag=None, client_state=None, **kw):

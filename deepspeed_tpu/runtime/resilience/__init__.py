@@ -1,0 +1,34 @@
+"""Resilience subsystem: survive preemption, divergence, and torn writes.
+
+PRs 1-3 made the stack fast; this layer makes a run *outlive* the fleet it
+runs on (the reference ships elasticity + pluggable checkpoint engines for
+the same reason — production training happens on preemptible capacity):
+
+- :mod:`snapshot` — double-buffered async device→host snapshots on a
+  background writer thread; checksummed shards, write-temp + atomic-rename
+  commit, a JSON manifest of valid tags so torn writes are skipped.
+- :mod:`sentinel` — in-loop health monitor: NaN/inf-loss streaks and
+  grad-norm spikes trip a configurable policy (rollback to last-good,
+  optionally dropping the LR).
+- :mod:`preempt` — SIGTERM / maintenance-event watcher reusing the
+  launcher's signal plumbing; drains in-flight steps and forces a final
+  snapshot.
+- :mod:`faults` — deterministic fault injection for tests (NaN at step N,
+  simulated preemption, torn write, crash-before-commit).
+- :mod:`supervisor` — restore-on-restart: resolve the latest *valid*
+  manifest entry and (with elasticity enabled) the world to restart at, so
+  a resume onto a different chip count reshards correctly.
+
+Everything is gated behind the ``resilience:`` config block; with it off
+(the default) no hook exists and engine stepping is bit-identical.
+"""
+
+from .faults import FaultPlan, InjectedCrash
+from .preempt import PreemptionWatcher
+from .sentinel import Sentinel, SentinelEvent, SentinelHalt
+from .snapshot import SnapshotManager
+from .supervisor import ResilienceManager, resolve_restore
+
+__all__ = ["SnapshotManager", "Sentinel", "SentinelEvent", "SentinelHalt",
+           "PreemptionWatcher", "FaultPlan", "InjectedCrash",
+           "ResilienceManager", "resolve_restore"]

@@ -1,0 +1,101 @@
+"""Deterministic fault injection: the test harness the subsystem is sworn to.
+
+A resilience layer that is only exercised by real failures is untested by
+definition. This module turns each failure mode into a scheduled, repeatable
+event so the suite can prove end-to-end recovery:
+
+- ``nan_loss_at_steps`` — the step's observed loss becomes NaN (a streak of
+  N consecutive steps trips the sentinel deterministically);
+- ``grad_spike_at_steps`` — the observed grad norm is multiplied by
+  ``spike_magnitude``;
+- ``preempt_at_step`` — the preemption watcher's flag is raised as if
+  SIGTERM had arrived;
+- ``torn_write_at_steps`` — the snapshot taken at that step has its newest
+  shard corrupted AFTER checksumming (restore must detect and skip it);
+- ``crash_before_commit_at_steps`` — the snapshot writer raises
+  :class:`InjectedCrash` after the data directory lands but before the
+  manifest commit (restore must resolve the previous tag).
+
+Loss/grad injections rewrite the *observed* metrics fed to the sentinel,
+not the device state — the rollback that follows is the real code path
+(restore last-good snapshot, continue), executed on healthy arrays so the
+test can assert training actually continues.
+
+Each scheduled injection fires ONCE: a rollback rewinds the step counter
+past an already-fired step, and a transient fault that re-fired on every
+replay would turn the recovery test into an infinite loop. The ``fired``
+audit trail records what actually happened.
+"""
+
+from dataclasses import dataclass, field
+from typing import Optional, Set, Tuple
+
+
+class InjectedCrash(RuntimeError):
+    """A scheduled crash-before-commit (never raised outside fault plans)."""
+
+
+def _steps(v) -> Tuple[int, ...]:
+    if v is None:
+        return ()
+    if isinstance(v, int):
+        return (v,)
+    return tuple(int(s) for s in v)
+
+
+@dataclass
+class FaultPlan:
+    nan_loss_at_steps: Tuple[int, ...] = ()
+    grad_spike_at_steps: Tuple[int, ...] = ()
+    spike_magnitude: float = 1e6
+    preempt_at_step: Optional[int] = None
+    torn_write_at_steps: Tuple[int, ...] = ()
+    crash_before_commit_at_steps: Tuple[int, ...] = ()
+
+    fired: list = field(default_factory=list)  # (step, kind) audit trail
+    _spent: Set[Tuple[int, str]] = field(default_factory=set)
+
+    @classmethod
+    def from_config(cls, cfg) -> "FaultPlan":
+        """Build from a ``resilience.faults`` config block (or any object
+        with the same attribute names)."""
+        return cls(
+            nan_loss_at_steps=_steps(getattr(cfg, "nan_loss_at_steps", ())),
+            grad_spike_at_steps=_steps(getattr(cfg, "grad_spike_at_steps", ())),
+            spike_magnitude=float(getattr(cfg, "spike_magnitude", 1e6)),
+            preempt_at_step=getattr(cfg, "preempt_at_step", None),
+            torn_write_at_steps=_steps(getattr(cfg, "torn_write_at_steps", ())),
+            crash_before_commit_at_steps=_steps(
+                getattr(cfg, "crash_before_commit_at_steps", ())),
+        )
+
+    def _fire(self, step: int, kind: str, scheduled) -> bool:
+        if step not in _steps(scheduled) or (step, kind) in self._spent:
+            return False
+        self._spent.add((step, kind))
+        self.fired.append((step, kind))
+        return True
+
+    # -- metric injections (consumed by ResilienceManager.post_step) -----
+    def observe_loss(self, step: int, loss: float) -> float:
+        if self._fire(step, "nan_loss", self.nan_loss_at_steps):
+            return float("nan")
+        return loss
+
+    def observe_grad_norm(self, step: int, grad_norm: float) -> float:
+        if self._fire(step, "grad_spike", self.grad_spike_at_steps):
+            return float(grad_norm) * self.spike_magnitude
+        return grad_norm
+
+    def preempt_now(self, step: int) -> bool:
+        return self._fire(step, "preempt", self.preempt_at_step)
+
+    # -- snapshot write hook (SnapshotManager.fault_hook) ----------------
+    def snapshot_hook(self, stage: str, step: int) -> Optional[str]:
+        if stage == "post_data" and self._fire(step, "torn_write",
+                                               self.torn_write_at_steps):
+            return "torn"
+        if stage == "pre_manifest" and self._fire(
+                step, "crash_before_commit", self.crash_before_commit_at_steps):
+            return "crash"
+        return None

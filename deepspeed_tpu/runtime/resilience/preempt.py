@@ -1,0 +1,104 @@
+"""Preemption watcher: SIGTERM / maintenance-event → drain → final snapshot.
+
+Preemptible TPU capacity announces eviction ahead of time — Cloud delivers
+SIGTERM to the workload, and TPU maintenance events surface through the
+metadata server (operationally often relayed as a touched sentinel file or
+an env-named flag). Either way the job gets a grace window; spending it on
+one more snapshot turns an eviction from "lose everything since the last
+checkpoint" into "lose nothing".
+
+The watcher only *records* the request (signal handlers must stay tiny and
+async-signal-safe); the engine's post-step hook notices it at the next step
+boundary — a natural drain point, since the in-flight compiled step has then
+retired — and the ResilienceManager forces a synchronous final snapshot.
+
+Signal installation reuses the launcher's plumbing
+(:func:`deepspeed_tpu.launcher.launch.install_signal_handlers`) with
+``chain=True``, so a supervising launcher's own SIGTERM forwarding keeps
+working underneath this watcher.
+"""
+
+import os
+import signal as _signal
+import time
+from typing import Callable, Iterable, Optional
+
+from ...utils.logging import logger
+
+# operational escape hatch: if this env names a path and the path exists,
+# the watcher treats it as a maintenance notice (k8s preStop hooks and TPU
+# maintenance relays can `touch` it without knowing anything about us)
+PREEMPT_FILE_ENV = "DSTPU_PREEMPT_FILE"
+
+
+def _resolve_signals(names: Iterable) -> tuple:
+    out = []
+    for n in names:
+        if isinstance(n, int):
+            out.append(n)
+        else:
+            sig = getattr(_signal, str(n).upper(), None)
+            if sig is None:
+                raise ValueError(f"unknown signal name {n!r}")
+            out.append(sig)
+    return tuple(out)
+
+
+class PreemptionWatcher:
+    """Flag-carrier between the grace-window notice and the step loop.
+
+    ``probes`` are zero-arg callables polled by :meth:`requested`; any
+    returning truthy raises the flag (pluggable: scheduler APIs, metadata
+    servers). A ``probe_file`` (or the ``DSTPU_PREEMPT_FILE`` env) adds the
+    touched-file probe.
+    """
+
+    def __init__(self, signals: Iterable = ("SIGTERM",),
+                 probe_file: Optional[str] = None,
+                 probes: Iterable[Callable[[], bool]] = (),
+                 install: bool = True):
+        self._flag = False
+        self.reason: Optional[str] = None
+        self.requested_at: Optional[float] = None
+        self.probes = list(probes)
+        probe_file = probe_file or os.environ.get(PREEMPT_FILE_ENV)
+        if probe_file:
+            self.probes.append(
+                lambda p=probe_file: os.path.exists(p) and f"probe file {p}")
+        self.installed_signals = ()
+        if install:
+            from ...launcher.launch import install_signal_handlers
+
+            sigs = _resolve_signals(signals)
+            installed = install_signal_handlers(self._on_signal, signals=sigs,
+                                                chain=True)
+            self.installed_signals = tuple(installed)
+
+    # handler body stays minimal: set flags, no I/O, no allocation-heavy work
+    def _on_signal(self, signum, frame):
+        self._flag = True
+        if self.reason is None:
+            self.reason = f"signal {signum}"
+            self.requested_at = time.time()
+
+    def request(self, reason: str = "programmatic") -> None:
+        """Raise the flag from code (fault injection, scheduler callbacks)."""
+        self._flag = True
+        if self.reason is None:
+            self.reason = reason
+            self.requested_at = time.time()
+
+    def requested(self) -> bool:
+        """Poll: signal already seen, or any probe reporting eviction."""
+        if self._flag:
+            return True
+        for probe in self.probes:
+            try:
+                hit = probe()
+            except Exception as e:  # a broken probe must not kill the step loop
+                logger.warning(f"preemption probe raised {e!r}; ignoring")
+                continue
+            if hit:
+                self.request(hit if isinstance(hit, str) else "probe")
+                return True
+        return False

@@ -1,0 +1,108 @@
+"""In-loop divergence sentinel: NaN streaks and grad-norm spikes.
+
+A production run dies two ways the loss curve can warn about: a NaN/inf
+loss that persists (data corruption, fp16 blow-up past the skip gate, a
+bad node) and a gradient-norm explosion that precedes divergence. The
+sentinel watches the per-step metrics the engine already computes and trips
+a configurable policy — ``rollback`` (restore last-good snapshot, optionally
+dropping the LR), ``warn``, or ``halt``.
+
+Transient single-step wobble is expected (fp16's loss-scale skip gate
+already handles one-off overflow); the sentinel fires on *streaks*.
+"""
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from ...utils.logging import logger
+
+
+class SentinelHalt(RuntimeError):
+    """Raised when the sentinel trips under ``policy: halt``."""
+
+
+@dataclass
+class SentinelEvent:
+    step: int
+    kind: str        # "nan_loss" | "grad_spike"
+    value: float
+    action: str      # "rollback" | "warn" | "halt"
+    detail: str = ""
+
+
+@dataclass
+class Sentinel:
+    """Streak detectors over (loss, grad_norm) step metrics.
+
+    ``observe`` returns the policy action when a detector trips, else None.
+    The caller (ResilienceManager) executes the action and then calls
+    ``reset`` so a rollback does not instantly re-trip on stale streaks.
+    """
+
+    nan_streak: int = 3          # consecutive non-finite steps before tripping
+    spike_factor: float = 10.0   # grad_norm > factor * rolling median
+    spike_streak: int = 2        # consecutive spike steps before tripping
+    spike_window: int = 64       # rolling history length
+    min_history: int = 8         # no spike verdicts before this many samples
+    policy: str = "rollback"     # rollback | warn | halt
+
+    events: List[SentinelEvent] = field(default_factory=list)
+    _nan_run: int = 0
+    _spike_run: int = 0
+    _norms: deque = field(default_factory=lambda: deque(maxlen=64))
+
+    def __post_init__(self):
+        if self.policy not in ("rollback", "warn", "halt"):
+            raise ValueError(f"sentinel policy {self.policy!r}: use "
+                             "'rollback', 'warn', or 'halt'")
+        self._norms = deque(maxlen=int(self.spike_window))
+
+    def observe(self, step: int, loss: float, grad_norm: float
+                ) -> Optional[str]:
+        loss = float(loss)
+        grad_norm = float(grad_norm)
+        if not (np.isfinite(loss) and np.isfinite(grad_norm)):
+            self._nan_run += 1
+            if self._nan_run >= self.nan_streak:
+                return self._trip(step, "nan_loss", loss,
+                                  f"{self._nan_run} consecutive non-finite steps")
+            return None
+        self._nan_run = 0
+
+        spiking = (len(self._norms) >= self.min_history
+                   and grad_norm > self.spike_factor * float(
+                       np.median(self._norms)))
+        if spiking:
+            self._spike_run += 1
+            if self._spike_run >= self.spike_streak:
+                return self._trip(
+                    step, "grad_spike", grad_norm,
+                    f"grad_norm {grad_norm:.3g} > {self.spike_factor}x "
+                    f"median {float(np.median(self._norms)):.3g} "
+                    f"for {self._spike_run} steps")
+        else:
+            self._spike_run = 0
+            # only healthy norms feed the baseline: a spike streak must not
+            # drag the median up and grant itself amnesty
+            self._norms.append(grad_norm)
+        return None
+
+    def _trip(self, step: int, kind: str, value: float, detail: str) -> str:
+        ev = SentinelEvent(step=step, kind=kind, value=float(value),
+                           action=self.policy, detail=detail)
+        self.events.append(ev)
+        logger.warning(f"sentinel tripped at step {step}: {kind} ({detail}) "
+                       f"-> {self.policy}")
+        if self.policy == "halt":
+            raise SentinelHalt(f"sentinel: {kind} at step {step} ({detail})")
+        return self.policy
+
+    def reset(self) -> None:
+        """Clear streaks and history (after a rollback restored older state
+        the old baseline no longer describes)."""
+        self._nan_run = 0
+        self._spike_run = 0
+        self._norms.clear()

@@ -1,0 +1,342 @@
+"""Async double-buffered device→host snapshots with torn-write immunity.
+
+The checkpoint tier (``checkpoint/engine.py``) is the durable, reshardable,
+orbax-backed store a user points at object storage. Snapshots are the
+*recovery* tier underneath it: small, frequent, local, and cheap enough to
+take every N steps, so a NaN spike or a preemption loses minutes — not the
+hours since the last user checkpoint. Reference analogue: the DataStates/
+Nebula async checkpoint engines layered under DeepSpeed's save path.
+
+Design:
+
+- **double-buffered, off the step path** — ``snapshot()`` fetches the state
+  to host (the only device-synchronizing part) and hands the host tree to a
+  background writer thread; one snapshot may be queued while another is
+  being written, so training overlaps the disk write. A third request
+  blocks until a buffer frees (backpressure rather than unbounded RAM).
+- **checksummed shards** — leaves are packed into ``shard_NNN.bin`` files
+  (raw little-endian bytes, ~``shard_mb`` each); each shard's SHA-256 goes
+  in the manifest, so restore *verifies* before it trusts.
+- **atomic commit** — shards are written into a dot-temp directory which is
+  ``os.replace``d to ``step_<N>/`` only when fully written; the manifest
+  (``MANIFEST.json``, the single source of valid tags) is then rewritten
+  via write-temp + fsync + rename. A crash at ANY point leaves either the
+  previous manifest (new snapshot invisible) or the new one (snapshot fully
+  durable) — never a pointer to garbage.
+- **restore skips torn writes** — ``latest_valid()`` walks manifest entries
+  newest-first and returns the first whose shards all exist and hash clean.
+"""
+
+import hashlib
+import json
+import os
+import queue
+import shutil
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...utils.fs import fsync_dir, fsync_write_json
+from ...utils.logging import logger
+
+MANIFEST = "MANIFEST.json"
+
+
+def _keystr(kp) -> str:
+    return jax.tree_util.keystr(kp)
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class SnapshotError(RuntimeError):
+    pass
+
+
+class SnapshotManager:
+    """Owns one snapshot directory: write, prune, validate, load.
+
+    ``fault_hook(stage, step) -> Optional[str]`` is the fault-injection
+    seam (``faults.FaultPlan.snapshot_hook``): called at ``"post_data"``
+    (shards written, data dir committed) and ``"pre_manifest"`` (about to
+    commit the manifest); returning ``"torn"`` corrupts the newest shard,
+    ``"crash"`` raises :class:`faults.InjectedCrash`.
+    """
+
+    def __init__(self, directory: str, keep: int = 2, use_async: bool = True,
+                 shard_mb: int = 256,
+                 fault_hook: Optional[Callable[[str, int], Optional[str]]] = None):
+        self.dir = os.path.abspath(directory)
+        os.makedirs(self.dir, exist_ok=True)
+        self.keep = max(1, int(keep))
+        self.use_async = bool(use_async)
+        self.shard_bytes = max(1, int(shard_mb)) << 20
+        self.fault_hook = fault_hook
+        self.stats: Dict[str, float] = {"snapshots": 0, "bytes": 0,
+                                        "d2h_ms": 0.0, "write_ms": 0.0}
+        self._err: Optional[BaseException] = None
+        self._queue: "queue.Queue[Optional[Tuple[list, int, dict]]]" = \
+            queue.Queue(maxsize=1)
+        # queued + in-progress jobs, counted under a condition variable:
+        # incremented BEFORE the (possibly blocking) put, decremented by the
+        # writer when a job fully finishes — wait() sleeps on the condition,
+        # immune to the set-then-clear race an Event would have
+        self._inflight = 0
+        self._cond = threading.Condition()
+        self._writer: Optional[threading.Thread] = None
+
+    # -- write path -----------------------------------------------------
+    def snapshot(self, tree: Any, step: int, meta: Optional[dict] = None,
+                 final: bool = False) -> str:
+        """Fetch ``tree`` to host and commit it as tag ``step_<step>``.
+
+        Returns the tag. Async mode returns as soon as the host copy exists
+        and the write job is enqueued; ``final=True`` (the preemption drain)
+        waits for the write to land before returning.
+        """
+        self._raise_pending()
+        t0 = time.perf_counter()
+        flat = [(_keystr(kp), np.asarray(jax.device_get(leaf)))
+                for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]]
+        self.stats["d2h_ms"] = (time.perf_counter() - t0) * 1e3
+        job = (flat, int(step), dict(meta or {}))
+        if not self.use_async or final:
+            self.wait()
+            self._write(*job)
+        else:
+            self._ensure_writer()
+            with self._cond:
+                self._inflight += 1
+            self._queue.put(job)  # blocks when both buffers are in flight
+        self._raise_pending()
+        return f"step_{int(step)}"
+
+    def wait(self) -> None:
+        """Drain queued + in-progress writes; re-raise a writer failure."""
+        with self._cond:
+            while self._inflight > 0:
+                self._cond.wait()
+        self._raise_pending()
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._queue.join()
+            self._queue.put(None)
+            self._writer.join()
+            self._writer = None
+
+    def _raise_pending(self):
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def _ensure_writer(self):
+        if self._writer is None or not self._writer.is_alive():
+            self._writer = threading.Thread(target=self._writer_loop,
+                                            daemon=True,
+                                            name="dstpu-snapshot-writer")
+            self._writer.start()
+
+    def _writer_loop(self):
+        while True:
+            job = self._queue.get()
+            if job is None:
+                self._queue.task_done()
+                return
+            try:
+                self._write(*job)
+            except BaseException as e:  # surfaced on the next snapshot()/wait()
+                self._err = e
+            finally:
+                self._queue.task_done()
+                with self._cond:
+                    self._inflight -= 1
+                    self._cond.notify_all()
+
+    def _write(self, flat: List[Tuple[str, np.ndarray]], step: int, meta: dict):
+        from .faults import InjectedCrash  # local: avoid import cycle
+
+        t0 = time.perf_counter()
+        tag = f"step_{step}"
+        # a snapshot IS the rollback target: refuse to commit non-finite
+        # state (the sentinel's health view is one step delayed, so a
+        # just-diverged state could otherwise land as "last-good"). Runs on
+        # the writer thread — the step path never pays for this scan.
+        for key, arr in flat:
+            try:
+                bad = (np.issubdtype(np.asarray(arr).dtype, np.floating)
+                       and not np.isfinite(np.asarray(arr, np.float32)).all())
+            except (TypeError, ValueError):  # exotic dtype: trust it
+                bad = False
+            if bad:
+                logger.warning(
+                    f"snapshot step_{step}: leaf {key!r} contains non-finite "
+                    "values — refusing to commit (the previous valid "
+                    "snapshot stays the restore target)")
+                return
+        final_dir = os.path.join(self.dir, tag)
+        tmp_dir = os.path.join(self.dir, f".tmp.{tag}.{os.getpid()}")
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        os.makedirs(tmp_dir)
+
+        # group leaves into ~shard_bytes raw-byte shards; the manifest holds
+        # (key, dtype, shape, offset, size) per leaf so restore needs no
+        # pickle and bf16 (no native numpy serialization) rides as bytes
+        shards: List[dict] = []
+        cur: List[dict] = []
+        cur_arrays: List[np.ndarray] = []
+        cur_size = 0
+        groups: List[Tuple[dict, List[np.ndarray]]] = []
+
+        def flush():
+            nonlocal cur, cur_arrays, cur_size
+            if cur:
+                shard = {"file": f"shard_{len(shards):03d}.bin", "leaves": cur}
+                shards.append(shard)
+                groups.append((shard, cur_arrays))
+                cur, cur_arrays, cur_size = [], [], 0
+
+        for key, arr in flat:
+            nbytes = int(arr.nbytes)
+            if cur_size and cur_size + nbytes > self.shard_bytes:
+                flush()
+            cur.append({"key": key, "dtype": str(arr.dtype),
+                        "shape": list(arr.shape), "offset": cur_size,
+                        "size": nbytes})
+            cur_arrays.append(arr)
+            cur_size += nbytes
+        flush()
+
+        total = 0
+        for shard, arrays in groups:
+            p = os.path.join(tmp_dir, shard["file"])
+            h = hashlib.sha256()
+            with open(p, "wb") as f:
+                for arr in arrays:
+                    # stream leaf-by-leaf to disk and into the hash: peak
+                    # extra memory is ONE leaf's byte copy, never the whole
+                    # state (tobytes, not memoryview — ml_dtypes leaves like
+                    # bf16 reject the buffer protocol)
+                    raw = arr.tobytes()
+                    f.write(raw)
+                    h.update(raw)
+                f.flush()
+                os.fsync(f.fileno())
+            shard["sha256"] = h.hexdigest()
+            total += os.path.getsize(p)
+        # a stale tag dir can legally exist here (crash-before-commit left
+        # its data unmanifested; a rollback re-reached the same step) —
+        # os.replace onto a non-empty dir raises, so clear it first
+        if os.path.isdir(final_dir):
+            shutil.rmtree(final_dir)
+        os.replace(tmp_dir, final_dir)  # data commit (atomic on one fs)
+        fsync_dir(self.dir)
+
+        action = self.fault_hook("post_data", step) if self.fault_hook else None
+        if action == "torn":
+            # deterministic torn write: flip bytes in the newest shard AFTER
+            # its checksum was recorded — the manifest will name it valid,
+            # restore's verification must prove otherwise
+            victim = os.path.join(final_dir, shards[-1]["file"])
+            with open(victim, "r+b") as f:
+                f.write(b"\xde\xad\xbe\xef")
+        if self.fault_hook and self.fault_hook("pre_manifest", step) == "crash":
+            raise InjectedCrash(f"injected crash before manifest commit of {tag}")
+
+        entry = {"tag": tag, "step": step, "meta": meta, "shards": shards,
+                 "bytes": total, "wall_time": time.time()}
+        man = self.manifest()
+        man["entries"] = [e for e in man.get("entries", [])
+                          if e["tag"] != tag] + [entry]
+        man["entries"].sort(key=lambda e: e["step"])
+        pruned = man["entries"][:-self.keep]
+        man["entries"] = man["entries"][-self.keep:]
+        fsync_write_json(os.path.join(self.dir, MANIFEST), man, indent=2)
+        for old in pruned:
+            shutil.rmtree(os.path.join(self.dir, old["tag"]),
+                          ignore_errors=True)
+        self.stats["snapshots"] += 1
+        self.stats["bytes"] = total
+        self.stats["write_ms"] = (time.perf_counter() - t0) * 1e3
+
+    # -- read path ------------------------------------------------------
+    def manifest(self) -> dict:
+        p = os.path.join(self.dir, MANIFEST)
+        if not os.path.exists(p):
+            return {"entries": []}
+        try:
+            with open(p) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            # the manifest itself is written atomically, so a parse failure
+            # means external damage; treat as empty rather than crashing
+            logger.warning(f"unreadable snapshot manifest {p}; ignoring")
+            return {"entries": []}
+
+    def _entry_valid(self, entry: dict) -> bool:
+        d = os.path.join(self.dir, entry["tag"])
+        for shard in entry.get("shards", []):
+            p = os.path.join(d, shard["file"])
+            if not os.path.exists(p) or _sha256(p) != shard["sha256"]:
+                return False
+        return True
+
+    def latest_valid(self) -> Optional[dict]:
+        """Newest manifest entry whose shards all exist and hash clean."""
+        for entry in reversed(self.manifest().get("entries", [])):
+            if self._entry_valid(entry):
+                return entry
+            logger.warning(
+                f"snapshot {entry['tag']} fails checksum validation "
+                "(torn write?) — falling back to the previous entry")
+        return None
+
+    def load(self, entry: Optional[dict] = None) -> Tuple[Dict[str, np.ndarray], dict]:
+        """Read one snapshot into ``{keystr: np.ndarray}`` (host)."""
+        if entry is None:
+            entry = self.latest_valid()
+        if entry is None:
+            raise SnapshotError(f"no valid snapshot in {self.dir}")
+        out: Dict[str, np.ndarray] = {}
+        d = os.path.join(self.dir, entry["tag"])
+        for shard in entry["shards"]:
+            with open(os.path.join(d, shard["file"]), "rb") as f:
+                blob = f.read()
+            for leaf in shard["leaves"]:
+                import jax.numpy as jnp  # ml_dtypes-aware dtype resolution
+                dt = np.dtype(jnp.dtype(leaf["dtype"]))
+                raw = blob[leaf["offset"]:leaf["offset"] + leaf["size"]]
+                out[leaf["key"]] = np.frombuffer(raw, dtype=dt).reshape(
+                    leaf["shape"])
+        return out, entry
+
+    def restore_tree(self, template: Any, entry: Optional[dict] = None
+                     ) -> Tuple[Any, dict]:
+        """Rebuild a pytree shaped like ``template`` from a snapshot. Arrays
+        are logical-global host copies, so the caller can ``device_put`` them
+        onto ANY sharding — restore onto a different world count is the same
+        code path as same-world restore."""
+        flat_keys, entry = self.load(entry)
+        leaves, treedef = [], None
+        flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+        for kp, leaf in flat:
+            key = _keystr(kp)
+            if key not in flat_keys:
+                raise SnapshotError(
+                    f"snapshot {entry['tag']} has no leaf {key!r} — the "
+                    "training state structure changed since it was taken")
+            arr = flat_keys[key]
+            if tuple(arr.shape) != tuple(np.shape(leaf)):
+                raise SnapshotError(
+                    f"snapshot leaf {key!r} has shape {arr.shape}, "
+                    f"engine expects {np.shape(leaf)}")
+            leaves.append(arr)
+        return jax.tree_util.tree_unflatten(treedef, leaves), entry

@@ -1,0 +1,296 @@
+"""Restore-on-restart + the per-step orchestration glue.
+
+:class:`ResilienceManager` is the one object the engine talks to: it owns
+the :class:`~.snapshot.SnapshotManager`, the :class:`~.sentinel.Sentinel`,
+the :class:`~.preempt.PreemptionWatcher`, and the optional
+:class:`~.faults.FaultPlan`, and exposes exactly three hooks —
+``maybe_restore()`` at engine init, ``post_step()`` after every
+``train_batch``, and ``drain()`` (also reachable via SIGTERM). With the
+``resilience:`` block disabled none of this is constructed and the engine
+is bit-identical to a tree without the subsystem.
+
+Elastic restarts: a relaunch that comes back on a *different* chip count
+calls :func:`resolve_restore` before building the engine — it resolves the
+latest valid snapshot AND (when elasticity is configured) the
+:class:`~...elasticity.elastic_agent.RescaleDecision` for the capacity
+actually available, so the engine is built at a valid world and the batch
+schedule stays consistent. The snapshot itself holds logical-global host
+arrays, so restoring onto the new mesh is just ``device_put`` with the new
+engine's shardings — the same resharding-by-construction the checkpoint
+tier relies on.
+"""
+
+import time
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...utils.logging import log_dist, logger
+from ..config_utils import ConfigError
+from .faults import FaultPlan
+from .preempt import PreemptionWatcher
+from .sentinel import Sentinel
+from .snapshot import SnapshotManager
+
+
+def resolve_restore(snapshot_dir: str, ds_config=None,
+                    available: Optional[int] = None
+                    ) -> Tuple[Optional[dict], Optional[Any]]:
+    """Pre-engine restart resolution: (latest valid snapshot entry or None,
+    RescaleDecision or None).
+
+    Call this FIRST in a restart script: the decision tells you what world
+    (and batch schedule) to build the engine at; the entry tells you whether
+    a restore will happen. Torn/corrupt newest snapshots are already skipped
+    by manifest validation."""
+    entry = SnapshotManager(snapshot_dir).latest_valid()
+    decision = None
+    if ds_config is not None and available is not None:
+        elastic = getattr(ds_config, "elasticity", None)
+        if elastic is not None and getattr(elastic, "enabled", False):
+            from ...elasticity.elastic_agent import decide_world
+
+            decision = decide_world(elastic, available)
+            log_dist(f"elastic restore: {available} chips available -> "
+                     f"world {decision.world_size} "
+                     f"(batch {decision.final_batch}, "
+                     f"micro {decision.micro_batch})")
+    return entry, decision
+
+
+class ResilienceManager:
+    """Wires snapshots, sentinel, preemption, and fault injection into one
+    engine. Constructed only when ``config.resilience.enabled``."""
+
+    def __init__(self, engine, cfg):
+        if not cfg.snapshot_dir:
+            raise ConfigError(
+                "resilience.enabled needs resilience.snapshot_dir — the "
+                "subsystem is defined by having somewhere durable to "
+                "snapshot to")
+        self.engine = engine
+        self.cfg = cfg
+        self.faults: Optional[FaultPlan] = (
+            FaultPlan.from_config(cfg.faults) if cfg.faults.enabled else None)
+        self.snap = SnapshotManager(
+            cfg.snapshot_dir, keep=cfg.keep_snapshots,
+            use_async=cfg.async_snapshot, shard_mb=cfg.shard_mb,
+            fault_hook=self.faults.snapshot_hook if self.faults else None)
+        sc = cfg.sentinel
+        self.sentinel: Optional[Sentinel] = None
+        if sc.enabled:
+            self.sentinel = Sentinel(
+                nan_streak=sc.nan_streak, spike_factor=sc.spike_factor,
+                spike_streak=sc.spike_streak, spike_window=sc.spike_window,
+                min_history=sc.min_history, policy=sc.policy)
+        if (self.sentinel is not None and sc.lr_drop_factor != 1.0
+                and getattr(engine, "_client_optimizer", False)):
+            logger.warning(
+                "sentinel.lr_drop_factor is set but the engine was built "
+                "with a CLIENT optimizer, which never sees the engine's LR "
+                "schedule — rollbacks will report a dropped LR in metrics "
+                "while the client optimizer keeps applying its own; wire "
+                "engine.lr_schedule into the client optimizer (or use the "
+                "config optimizer) for the drop to take effect")
+        pc = cfg.preemption
+        self.watcher: Optional[PreemptionWatcher] = None
+        if pc.enabled:
+            self.watcher = PreemptionWatcher(
+                signals=tuple(pc.signals), probe_file=pc.probe_file,
+                install=pc.install_signal_handler)
+        if jax.process_count() > 1:
+            logger.warning(
+                "resilience snapshots fetch logical-global arrays to host "
+                "(jax.device_get) and are wired for single-controller "
+                "worlds; on this multi-host mesh use the checkpoint tier "
+                "(orbax coordinates multi-host writes) for recovery")
+        if getattr(engine, "_host_adam", None) is not None:
+            logger.warning(
+                "resilience snapshots cover the device TrainState only; the "
+                "host-Adam offload tier's CPU optimizer state is NOT "
+                "snapshotted — a restore re-seeds fp32 masters from params "
+                "(use checkpoint save/load for exact host-Adam recovery)")
+        self.rollbacks = 0
+        self.restores = 0
+        self.stop_requested = False
+        self.drained = False
+        # (step, metrics_dev) awaiting processing: the sentinel reads each
+        # step's metrics one step LATE, off an async D2H copy started the
+        # step before — post_step never stalls the dispatch pipeline on a
+        # device sync (the engine's metrics-stay-on-device design holds
+        # with resilience enabled)
+        self._pending_metrics = None
+
+    # ------------------------------------------------------------------
+    # engine hooks
+    # ------------------------------------------------------------------
+    def maybe_restore(self) -> Optional[str]:
+        """Engine-init hook: restore the latest valid snapshot, if any.
+        Returns the restored tag or None."""
+        entry = self.snap.latest_valid()
+        if entry is None:
+            return None
+        self._restore(entry)
+        self.restores += 1
+        log_dist(f"resilience: restored snapshot {entry['tag']} "
+                 f"(global_steps={self.engine.global_steps}"
+                 f"{', preempted run' if entry['meta'].get('final') else ''})")
+        return entry["tag"]
+
+    def post_step(self) -> None:
+        """Per-step hook (engine.train_batch, after the step was DISPATCHED).
+
+        Order matters: a pending preemption wins over everything (the grace
+        window is short); then the sentinel rules on the PREVIOUS step's
+        metrics — read one step late off an async copy started last time,
+        so no device sync serializes the dispatch pipeline; injections
+        rewrite those observed metrics; a cadence snapshot only fires while
+        no NaN streak is live, and the snapshot writer independently
+        refuses to commit non-finite state (closing the one-step window in
+        which a just-diverged state could otherwise pose as last-good)."""
+        engine = self.engine
+        step = engine.global_steps
+        if self.faults is not None and self.faults.preempt_now(step):
+            if self.watcher is not None:
+                self.watcher.request("injected preemption")
+            else:
+                self.drain()
+                return
+        if self.watcher is not None and self.watcher.requested():
+            self.drain()
+            return
+
+        prev, self._pending_metrics = self._pending_metrics, \
+            (step, engine._metrics_dev)
+        for leaf in jax.tree.leaves(engine._metrics_dev):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()  # lands before next post_step
+        if prev is not None and self.sentinel is not None:
+            pstep, pm = prev
+            loss = float(np.asarray(pm["loss"]))
+            grad_norm = float(np.asarray(pm["grad_norm"]))
+            if self.faults is not None:
+                loss = self.faults.observe_loss(pstep, loss)
+                grad_norm = self.faults.observe_grad_norm(pstep, grad_norm)
+            action = self.sentinel.observe(pstep, loss, grad_norm)
+            if action == "rollback":
+                self._rollback()
+                return
+            # "warn" already logged inside the sentinel; "halt" raised
+        streak_live = (self.sentinel is not None
+                       and self.sentinel._nan_run > 0)
+        if not streak_live and self.cfg.snapshot_interval > 0 \
+                and step % self.cfg.snapshot_interval == 0:
+            self.take_snapshot()
+
+    def drain(self) -> None:
+        """Preemption path: retire in-flight device work, land any async
+        checkpoint commit, force a synchronous final snapshot, and tell the
+        training loop to stop (``engine.should_stop()``)."""
+        if self.drained:
+            self.stop_requested = True
+            return
+        engine = self.engine
+        reason = self.watcher.reason if self.watcher else "drain()"
+        log_dist(f"resilience: draining for preemption ({reason})")
+        jax.block_until_ready(engine.state)
+        pending = getattr(engine, "_ckpt_commit_thread", None)
+        if pending is not None and pending.is_alive():
+            pending.join()
+        self.take_snapshot(final=True)
+        self.snap.wait()
+        self.drained = True
+        self.stop_requested = True
+        self._emit([("Resilience/preempt_drain", 1.0, engine.global_steps)])
+        log_dist(f"resilience: final snapshot committed at step "
+                 f"{engine.global_steps}; safe to terminate")
+
+    # ------------------------------------------------------------------
+    def take_snapshot(self, final: bool = False) -> str:
+        engine = self.engine
+        t0 = time.perf_counter()
+        tag = self.snap.snapshot(
+            engine.state, step=engine.global_steps,
+            meta={"global_steps": engine.global_steps,
+                  "skipped_steps": engine.skipped_steps,
+                  "lr_scale": getattr(engine, "_lr_scale", 1.0),
+                  "final": bool(final),
+                  "topology": {"pp": engine.topo.pp_size,
+                               "dp": engine.topo.dp_size,
+                               "ep": engine.topo.ep_size,
+                               "sp": engine.topo.sp_size,
+                               "tp": engine.topo.tp_size},
+                  "world_devices": engine.topo.n_devices},
+            final=final)
+        call_ms = (time.perf_counter() - t0) * 1e3
+        self._emit([
+            ("Resilience/snapshot_call_ms", call_ms, engine.global_steps),
+            ("Resilience/snapshot_d2h_ms", self.snap.stats["d2h_ms"],
+             engine.global_steps),
+            ("Resilience/snapshot_bytes", self.snap.stats["bytes"],
+             engine.global_steps)])
+        return tag
+
+    def _restore(self, entry: dict) -> None:
+        engine = self.engine
+        host_tree, entry = self.snap.restore_tree(engine.state, entry)
+        engine.state = jax.device_put(host_tree, engine._state_shardings)
+        meta = entry.get("meta", {})
+        engine.global_steps = int(meta.get("global_steps", entry["step"]))
+        engine.skipped_steps = int(meta.get("skipped_steps", 0))
+        host_adam = getattr(engine, "_host_adam", None)
+        if host_adam is not None:
+            host_adam.reseed_masters(jax.device_get(engine.state.params))
+        saved_scale = float(meta.get("lr_scale", 1.0))
+        if saved_scale != getattr(engine, "_lr_scale", 1.0):
+            engine._lr_scale = saved_scale
+            self._invalidate_compiled_steps()
+
+    def _rollback(self) -> None:
+        engine = self.engine
+        tripped_at = engine.global_steps
+        self.snap.wait()  # an in-flight async write may BE the last-good
+        entry = self.snap.latest_valid()
+        if entry is None:
+            logger.warning(
+                "sentinel rollback requested but no valid snapshot exists "
+                "yet — continuing without rollback (raise "
+                "snapshot_interval coverage or pre-seed with a snapshot)")
+            if self.sentinel is not None:
+                self.sentinel.reset()
+            return
+        self._restore(entry)
+        self._pending_metrics = None  # metrics of the rolled-away step
+        drop = float(self.cfg.sentinel.lr_drop_factor)
+        if drop != 1.0:
+            engine._lr_scale = getattr(engine, "_lr_scale", 1.0) * drop
+            self._invalidate_compiled_steps()
+        self.rollbacks += 1
+        if self.sentinel is not None:
+            self.sentinel.reset()
+        self._emit([("Resilience/rollback", 1.0, tripped_at),
+                    ("Resilience/lr_scale",
+                     getattr(engine, "_lr_scale", 1.0), tripped_at)])
+        log_dist(f"resilience: rolled back from step {tripped_at} to "
+                 f"snapshot {entry['tag']} (global_steps="
+                 f"{engine.global_steps}, lr_scale="
+                 f"{getattr(engine, '_lr_scale', 1.0):g})")
+
+    def _invalidate_compiled_steps(self) -> None:
+        """An LR-scale change is a trace-time constant: drop every compiled
+        step so the next call retraces with the new scale. Rollbacks are
+        rare; a recompile is the honest cost of changing the schedule."""
+        engine = self.engine
+        engine._train_steps = {(None, None): engine._make_train_step(None)}
+        engine._train_step = engine._train_steps[(None, None)]
+        engine._aot_step = None
+        engine._apply_fn = None
+        engine._micro_step_fn = None
+
+    def _emit(self, events) -> None:
+        if getattr(self.engine, "monitor", None) is not None:
+            self.engine.monitor.write_events(events)
+
+    def close(self) -> None:
+        self.snap.close()

@@ -100,6 +100,73 @@ def test_async_save_roundtrip(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Torn-write hardening: 'latest' must never dereference an uncommitted tag
+# ---------------------------------------------------------------------------
+
+
+def test_read_latest_skips_torn_tag(tmp_path):
+    """A tag directory without its commit marker (metadata.json — the process
+    died between the array write and the commit) is skipped in favor of the
+    newest committed tag."""
+    from deepspeed_tpu.checkpoint.engine import read_latest_tag
+
+    e = _engine(0)
+    e.train_batch(random_batches(1, 8, HIDDEN)[0])
+    e.save_checkpoint(str(tmp_path / "c"), tag="good")
+    # simulate a torn write: state data landed, commit marker did not,
+    # but the 'latest' pointer was (wrongly, or by a racing writer) updated
+    torn = tmp_path / "c" / "torn"
+    (torn / "state").mkdir(parents=True)
+    (torn / "state" / "junk").write_bytes(b"\x00" * 64)
+    (tmp_path / "c" / "latest").write_text("torn")
+    assert read_latest_tag(str(tmp_path / "c")) == "good"
+
+
+def test_read_latest_all_torn_returns_none(tmp_path):
+    from deepspeed_tpu.checkpoint.engine import read_latest_tag
+
+    (tmp_path / "c" / "only" / "state").mkdir(parents=True)
+    (tmp_path / "c" / "latest").write_text("only")
+    assert read_latest_tag(str(tmp_path / "c")) is None
+
+
+def test_no_latest_pointer_means_none_even_with_committed_tags(tmp_path):
+    """save_latest=False checkpoints never designate a latest; the torn-write
+    fallback must not invent one from directory mtimes."""
+    from deepspeed_tpu.checkpoint.engine import read_latest_tag
+
+    e = _engine(0)
+    e.train_batch(random_batches(1, 8, HIDDEN)[0])
+    e.save_checkpoint(str(tmp_path / "c"), tag="side", save_latest=False)
+    assert read_latest_tag(str(tmp_path / "c")) is None
+    path, client = e.load_checkpoint(str(tmp_path / "c"))  # warns, loads nothing
+    assert path is None and client == {}
+
+
+def test_load_falls_back_past_torn_write(tmp_path):
+    """End-to-end: the newest checkpoint is torn; load_checkpoint restores
+    the previous committed one instead of crashing or reading garbage."""
+    e1 = _engine(0)
+    batches = random_batches(4, 8, HIDDEN)
+    for b in batches[:2]:
+        e1.train_batch(b)
+    e1.save_checkpoint(str(tmp_path / "c"), tag="t2")
+    good = np.asarray(e1.state.params["head"]["w"]).copy()
+    e1.train_batch(batches[2])
+    e1.save_checkpoint(str(tmp_path / "c"), tag="t3")
+    # tear the newest: drop its commit marker ('latest' still names t3)
+    import os
+
+    os.remove(str(tmp_path / "c" / "t3" / "metadata.json"))
+    e2 = _engine(0)
+    path, _ = e2.load_checkpoint(str(tmp_path / "c"))
+    assert path.endswith("t2")
+    assert e2.global_steps == 2
+    np.testing.assert_allclose(np.asarray(e2.state.params["head"]["w"]),
+                               good, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
 # Per-optimizer x per-stage matrix (reference tests/unit/checkpoint/
 # test_zero_optimizer.py runs the same grid over its optimizer zoo;
 # VERDICT r3 weak #6). Continuation-equality is the strong property: after

@@ -1,0 +1,438 @@
+"""Resilience subsystem: snapshots, sentinel rollback, preemption drain,
+fault harness, restore-on-restart (incl. onto a different elastic world)."""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.parallel import Topology, TopologySpec
+from deepspeed_tpu.runtime.resilience import (FaultPlan, InjectedCrash,
+                                              Sentinel, SentinelHalt,
+                                              SnapshotManager, resolve_restore)
+
+from .simple_model import make_simple_params, random_batches, simple_loss
+
+HIDDEN = 64
+
+
+def _engine(snapshot_dir=None, resilience=None, topology=None, seed=42):
+    cfg = {"train_micro_batch_size_per_gpu": 8,
+           "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 1000, "seed": seed}
+    if resilience is not None:
+        rz = {"enabled": True, "snapshot_dir": str(snapshot_dir)}
+        rz.update(resilience)
+        cfg["resilience"] = rz
+    engine, *_ = ds.initialize(model=simple_loss,
+                               model_parameters=make_simple_params(HIDDEN),
+                               config=cfg, topology=topology)
+    return engine
+
+
+# ---------------------------------------------------------------------------
+# default-off bit identity
+# ---------------------------------------------------------------------------
+
+
+def test_off_default_is_bit_identical():
+    """An explicit resilience:{enabled:false} block changes nothing about
+    the compiled step — losses match a config without the block bitwise."""
+    batches = random_batches(4, 8, HIDDEN)
+    e1 = _engine()
+    cfg = {"train_micro_batch_size_per_gpu": 8,
+           "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 1000, "seed": 42,
+           "resilience": {"enabled": False}}
+    e2, *_ = ds.initialize(model=simple_loss,
+                           model_parameters=make_simple_params(HIDDEN),
+                           config=cfg)
+    assert e2.resilience is None
+    for b in batches:
+        l1 = float(np.asarray(e1.train_batch(b)))
+        l2 = float(np.asarray(e2.train_batch(b)))
+        assert l1 == l2  # bitwise, not allclose
+
+
+# ---------------------------------------------------------------------------
+# snapshot manager
+# ---------------------------------------------------------------------------
+
+
+def _tree():
+    return {"a": jnp.arange(16, dtype=jnp.float32).reshape(4, 4),
+            "b": {"w": jnp.ones((8,), jnp.bfloat16),
+                  "n": jnp.asarray(3, jnp.int32)}}
+
+
+@pytest.mark.parametrize("use_async", [False, True])
+def test_snapshot_roundtrip(tmp_path, use_async):
+    sm = SnapshotManager(str(tmp_path), use_async=use_async)
+    tree = _tree()
+    tag = sm.snapshot(tree, step=7, meta={"k": 1})
+    sm.wait()
+    assert tag == "step_7"
+    out, entry = sm.restore_tree(tree)
+    assert entry["meta"]["k"] == 1
+    for got, want in zip(jax.tree.leaves(out), jax.tree.leaves(tree)):
+        np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                      np.asarray(want, np.float32))
+    sm.close()
+
+
+def test_snapshot_keep_prunes_old_tags(tmp_path):
+    sm = SnapshotManager(str(tmp_path), keep=2, use_async=False)
+    tree = _tree()
+    for s in (1, 2, 3):
+        sm.snapshot(tree, step=s)
+    tags = [e["tag"] for e in sm.manifest()["entries"]]
+    assert tags == ["step_2", "step_3"]
+    assert not os.path.exists(tmp_path / "step_1")
+
+
+def test_snapshot_overwrites_stale_unmanifested_tag(tmp_path):
+    """crash-before-commit leaves an orphaned data dir for its tag; a later
+    run that re-reaches the same step must be able to re-snapshot it (the
+    atomic rename cannot rely on the target being absent)."""
+    plan = FaultPlan(crash_before_commit_at_steps=(4,))
+    sm = SnapshotManager(str(tmp_path), use_async=False,
+                         fault_hook=plan.snapshot_hook)
+    tree = _tree()
+    sm.snapshot(tree, step=2)
+    with pytest.raises(InjectedCrash):
+        sm.snapshot(tree, step=4)  # data dir step_4/ landed, unmanifested
+    assert os.path.isdir(tmp_path / "step_4")
+    sm2 = SnapshotManager(str(tmp_path), use_async=False)  # "the restart"
+    assert sm2.latest_valid()["tag"] == "step_2"
+    sm2.snapshot(tree, step=4)  # re-reached the same step: must not raise
+    assert sm2.latest_valid()["tag"] == "step_4"
+
+
+def test_snapshot_wait_never_hangs_across_many_cycles(tmp_path):
+    """Hammer the async queue accounting: every snapshot()+wait() pair must
+    terminate even when the writer finishes before/after the caller's
+    bookkeeping (the Event-based design had a set/clear race here)."""
+    sm = SnapshotManager(str(tmp_path), keep=2, use_async=True)
+    tree = {"a": jnp.arange(64, dtype=jnp.float32)}
+    for s in range(30):
+        sm.snapshot(tree, step=s)
+        if s % 3 == 0:
+            sm.wait()
+    sm.wait()
+    assert sm.latest_valid()["tag"] == "step_29"
+    sm.close()
+
+
+def test_snapshot_refuses_nonfinite_state(tmp_path):
+    """The writer validates finiteness before committing: a diverged state
+    must never become the last-good rollback target (the sentinel's health
+    view is one step delayed, so this is the backstop)."""
+    sm = SnapshotManager(str(tmp_path), use_async=False)
+    good = _tree()
+    sm.snapshot(good, step=1)
+    bad = {"a": jnp.full((4, 4), jnp.nan, jnp.float32),
+           "b": good["b"]}
+    sm.snapshot(bad, step=2)  # refused, logged, no exception
+    assert sm.latest_valid()["tag"] == "step_1"
+    assert not os.path.exists(tmp_path / "step_2")
+
+
+def test_snapshot_structure_mismatch_raises(tmp_path):
+    sm = SnapshotManager(str(tmp_path), use_async=False)
+    sm.snapshot(_tree(), step=1)
+    with pytest.raises(Exception, match="no leaf|shape"):
+        sm.restore_tree({"different": jnp.zeros((2,))})
+
+
+# ---------------------------------------------------------------------------
+# sentinel unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_nan_streak_trips_after_threshold():
+    s = Sentinel(nan_streak=3)
+    assert s.observe(1, float("nan"), 1.0) is None
+    assert s.observe(2, float("inf"), 1.0) is None
+    assert s.observe(3, float("nan"), 1.0) == "rollback"
+    assert s.events[-1].kind == "nan_loss"
+
+
+def test_sentinel_single_nan_does_not_trip():
+    s = Sentinel(nan_streak=2)
+    assert s.observe(1, float("nan"), 1.0) is None
+    assert s.observe(2, 0.5, 1.0) is None  # streak broken
+    assert s.observe(3, float("nan"), 1.0) is None
+
+
+def test_sentinel_grad_spike_vs_median():
+    s = Sentinel(spike_factor=10.0, spike_streak=2, min_history=4)
+    for i in range(6):
+        assert s.observe(i, 0.5, 1.0) is None
+    assert s.observe(6, 0.5, 50.0) is None   # first spike: streak=1
+    assert s.observe(7, 0.5, 60.0) == "rollback"
+    assert s.events[-1].kind == "grad_spike"
+    # spikes were NOT folded into the baseline
+    assert max(s._norms) <= 1.0
+
+
+def test_sentinel_halt_policy_raises():
+    s = Sentinel(nan_streak=1, policy="halt")
+    with pytest.raises(SentinelHalt):
+        s.observe(1, float("nan"), 1.0)
+
+
+def test_sentinel_bad_policy_rejected():
+    with pytest.raises(ValueError):
+        Sentinel(policy="explode")
+
+
+# ---------------------------------------------------------------------------
+# fault harness semantics
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_fires_once_and_audits():
+    plan = FaultPlan(nan_loss_at_steps=(3,), grad_spike_at_steps=(4,),
+                     spike_magnitude=100.0, preempt_at_step=5)
+    assert np.isnan(plan.observe_loss(3, 1.0))
+    assert plan.observe_loss(3, 1.0) == 1.0  # spent: fires once
+    assert plan.observe_grad_norm(4, 2.0) == 200.0
+    assert plan.preempt_now(5) and not plan.preempt_now(5)
+    assert [k for _, k in plan.fired] == ["nan_loss", "grad_spike", "preempt"]
+
+
+def test_fault_plan_snapshot_hooks(tmp_path):
+    plan = FaultPlan(torn_write_at_steps=(2,), crash_before_commit_at_steps=(4,))
+    sm = SnapshotManager(str(tmp_path), use_async=False,
+                         fault_hook=plan.snapshot_hook)
+    tree = _tree()
+    sm.snapshot(tree, step=1)
+    sm.snapshot(tree, step=2)  # torn AFTER checksumming
+    assert sm.latest_valid()["tag"] == "step_1"
+    sm.snapshot(tree, step=3)
+    with pytest.raises(InjectedCrash):
+        sm.snapshot(tree, step=4)  # data landed, manifest did not
+    assert sm.latest_valid()["tag"] == "step_3"
+
+
+# ---------------------------------------------------------------------------
+# engine integration: NaN streak -> rollback -> training continues
+# ---------------------------------------------------------------------------
+
+
+def test_nan_streak_rolls_back_and_training_continues(tmp_path):
+    e = _engine(tmp_path, {"snapshot_interval": 2,
+                           "sentinel": {"nan_streak": 2},
+                           "faults": {"enabled": True,
+                                      "nan_loss_at_steps": [5, 6]}})
+    batches = random_batches(10, 8, HIDDEN)
+    losses = []
+    for b in batches:
+        losses.append(float(np.asarray(e.train_batch(b))))
+    # the sentinel reads metrics one step late: step 6's injected NaN
+    # completes the streak during post_step of step 7 -> rollback restores
+    # snapshot step_4 (the streak suppressed the step-6 cadence snapshot)
+    assert e.resilience.rollbacks == 1
+    assert [k for _, k in e.resilience.faults.fired] == ["nan_loss", "nan_loss"]
+    assert e.global_steps == 7  # 10 stepped - rolled back from 7 to 4
+    assert all(np.isfinite(losses))  # device state was never NaN
+
+
+def test_rollback_restores_lastgood_params_and_drops_lr(tmp_path):
+    e = _engine(tmp_path, {"snapshot_interval": 2,
+                           "sentinel": {"nan_streak": 1,
+                                        "lr_drop_factor": 0.5},
+                           "faults": {"enabled": True,
+                                      "nan_loss_at_steps": [3]}})
+    batches = random_batches(5, 8, HIDDEN)
+    e.train_batch(batches[0])
+    e.train_batch(batches[1])  # cadence snapshot at step 2
+    e.resilience.snap.wait()
+    good = np.asarray(e.state.params["head"]["w"]).copy()
+    e.train_batch(batches[2])  # step 3: its NaN is observed one step later
+    assert e.resilience.rollbacks == 0
+    e.train_batch(batches[3])  # post_step observes step 3 -> rollback
+    assert e.resilience.rollbacks == 1
+    assert e.global_steps == 2
+    assert e._lr_scale == 0.5
+    np.testing.assert_allclose(np.asarray(e.state.params["head"]["w"]),
+                               good, rtol=0, atol=0)
+    # LR actually observed by the next step reflects the drop
+    e.train_batch(batches[4])
+    assert abs(e._last_metrics["lr"] - 0.5 * 1e-2) < 1e-9
+
+
+def test_lr_drop_scales_actual_updates_not_just_metrics(tmp_path):
+    """The dropped LR must reach the OPTIMIZER (no scheduler configured —
+    the case where a constant base_lr would silently ignore the scale):
+    after identical rollbacks, the dropped engine's param delta is half the
+    undropped engine's."""
+    def deltas(snapdir, drop):
+        e = _engine(snapdir, {"snapshot_interval": 2,
+                              "sentinel": {"nan_streak": 1,
+                                           "lr_drop_factor": drop},
+                              "faults": {"enabled": True,
+                                         "nan_loss_at_steps": [3]}})
+        batches = random_batches(5, 8, HIDDEN)
+        for b in batches[:4]:
+            e.train_batch(b)  # snapshot at 2; step-3 NaN observed at post 4
+        assert e.resilience.rollbacks == 1
+        before = np.asarray(e.state.params["head"]["w"]).copy()
+        e.train_batch(batches[4])
+        return np.asarray(e.state.params["head"]["w"]) - before
+
+    d_full = deltas(tmp_path / "a", 1.0)
+    d_half = deltas(tmp_path / "b", 0.5)
+    # identical restored state + batch: adam's update scales linearly in lr
+    np.testing.assert_allclose(d_half, 0.5 * d_full, rtol=1e-4)
+
+
+def test_rollback_without_snapshot_warns_and_continues(tmp_path):
+    e = _engine(tmp_path, {"snapshot_interval": 1000,
+                           "sentinel": {"nan_streak": 1},
+                           "faults": {"enabled": True,
+                                      "nan_loss_at_steps": [1]}})
+    for b in random_batches(2, 8, HIDDEN):
+        e.train_batch(b)  # step-1 NaN observed at post_step 2 -> trip
+    assert e.resilience.rollbacks == 0  # nothing to roll back to; no crash
+    assert e.global_steps == 2
+
+
+# ---------------------------------------------------------------------------
+# preemption: drain -> final snapshot -> restore
+# ---------------------------------------------------------------------------
+
+
+def test_simulated_preemption_drains_and_restores(tmp_path):
+    e = _engine(tmp_path, {"snapshot_interval": 100,
+                           "faults": {"enabled": True, "preempt_at_step": 3}})
+    batches = random_batches(6, 8, HIDDEN)
+    stepped = 0
+    for b in batches:
+        e.train_batch(b)
+        stepped += 1
+        if e.should_stop():
+            break
+    assert stepped == 3 and e.resilience.drained
+    entry, _ = resolve_restore(str(tmp_path))
+    assert entry["tag"] == "step_3" and entry["meta"]["final"]
+    # a fresh engine (the relaunch) restores and continues
+    e2 = _engine(tmp_path, {"snapshot_interval": 100})
+    assert e2.global_steps == 3
+    np.testing.assert_allclose(np.asarray(e2.state.params["head"]["w"]),
+                               np.asarray(e.state.params["head"]["w"]),
+                               rtol=0, atol=0)
+    e2.train_batch(batches[3])
+    assert e2.global_steps == 4
+
+
+def test_sigterm_triggers_drain(tmp_path):
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        e = _engine(tmp_path, {"snapshot_interval": 100})
+        assert signal.SIGTERM in e.resilience.watcher.installed_signals
+        e.train_batch(random_batches(1, 8, HIDDEN)[0])
+        os.kill(os.getpid(), signal.SIGTERM)  # delivered to this process
+        e.train_batch(random_batches(1, 8, HIDDEN)[0])
+        assert e.should_stop() and e.resilience.drained
+        assert SnapshotManager(str(tmp_path)).latest_valid()["meta"]["final"]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_preempt_probe_file(tmp_path):
+    e = _engine(tmp_path / "snaps",
+                {"snapshot_interval": 100,
+                 "preemption": {"install_signal_handler": False,
+                                "probe_file": str(tmp_path / "evict")}})
+    e.train_batch(random_batches(1, 8, HIDDEN)[0])
+    assert not e.should_stop()
+    (tmp_path / "evict").touch()  # maintenance notice lands
+    e.train_batch(random_batches(1, 8, HIDDEN)[0])
+    assert e.should_stop()
+
+
+# ---------------------------------------------------------------------------
+# torn / crashed newest snapshot: restore falls back to the previous tag
+# ---------------------------------------------------------------------------
+
+
+def test_crash_before_commit_restores_previous_tag(tmp_path):
+    e = _engine(tmp_path, {"snapshot_interval": 2, "async_snapshot": False,
+                           "faults": {"enabled": True,
+                                      "crash_before_commit_at_steps": [4]}})
+    batches = random_batches(4, 8, HIDDEN)
+    e.train_batch(batches[0])
+    e.train_batch(batches[1])
+    ref = np.asarray(e.state.params["head"]["w"]).copy()
+    e.train_batch(batches[2])
+    with pytest.raises(InjectedCrash):
+        e.train_batch(batches[3])  # dies mid-snapshot, pre-manifest
+    e2 = _engine(tmp_path, {"snapshot_interval": 2})
+    assert e2.global_steps == 2  # step_4's data dir exists but is unmanifested
+    np.testing.assert_allclose(np.asarray(e2.state.params["head"]["w"]),
+                               ref, rtol=0, atol=0)
+
+
+def test_torn_newest_snapshot_restores_previous_tag(tmp_path):
+    e = _engine(tmp_path, {"snapshot_interval": 2, "async_snapshot": False,
+                           "faults": {"enabled": True,
+                                      "torn_write_at_steps": [4]}})
+    batches = random_batches(4, 8, HIDDEN)
+    for b in batches[:2]:
+        e.train_batch(b)
+    ref = np.asarray(e.state.params["head"]["w"]).copy()
+    for b in batches[2:]:
+        e.train_batch(b)  # step_4 snapshot is committed but corrupt
+    assert [t["tag"] for t in e.resilience.snap.manifest()["entries"]] == \
+        ["step_2", "step_4"]
+    e2 = _engine(tmp_path, {"snapshot_interval": 2})
+    assert e2.global_steps == 2
+    np.testing.assert_allclose(np.asarray(e2.state.params["head"]["w"]),
+                               ref, rtol=0, atol=0)
+
+
+# ---------------------------------------------------------------------------
+# elastic restore: resume onto a different (smaller) world
+# ---------------------------------------------------------------------------
+
+
+def test_restore_onto_smaller_elastic_world(tmp_path):
+    e = _engine(tmp_path, {"snapshot_interval": 2})
+    for b in random_batches(4, 8, HIDDEN):
+        e.train_batch(b)
+    e.resilience.snap.wait()
+    ref = np.asarray(e.state.params["head"]["w"]).copy()
+
+    small = Topology(TopologySpec(), devices=jax.devices()[:4])  # dp=8 -> dp=4
+    e2 = _engine(tmp_path, {"snapshot_interval": 2}, topology=small)
+    assert e2.topo.dp_size == 4 and e2.global_steps == 4
+    np.testing.assert_allclose(np.asarray(e2.state.params["head"]["w"]),
+                               ref, rtol=0, atol=0)
+    e2.train_batch(random_batches(1, 4 * 1, HIDDEN)[0])  # still trains
+
+
+def test_resolve_restore_returns_rescale_decision(tmp_path):
+    from deepspeed_tpu.runtime.config import load_config
+
+    SnapshotManager(str(tmp_path), use_async=False).snapshot(_tree(), step=9)
+    cfg = load_config({"elasticity": {"enabled": True,
+                                      "max_train_batch_size": 64,
+                                      "micro_batch_sizes": [2, 4],
+                                      "ignore_non_elastic_batch_info": True}})
+    entry, decision = resolve_restore(str(tmp_path), ds_config=cfg, available=5)
+    assert entry["tag"] == "step_9"
+    assert decision is not None and decision.world_size <= 5
+    assert decision.final_batch % (decision.micro_batch *
+                                   decision.world_size) == 0
+
+
+def test_resilience_requires_snapshot_dir():
+    from deepspeed_tpu.runtime.config_utils import ConfigError
+
+    with pytest.raises(ConfigError, match="snapshot_dir"):
+        _engine(None, {"snapshot_interval": 2, "snapshot_dir": None})
