@@ -244,6 +244,8 @@ def v1_generate_bench(cfg, model, params, on_tpu):
 def main():
     from deepspeed_tpu.utils.health import accelerator_healthy
 
+    # probe timeout follows $DSTPU_HEALTH_TIMEOUT (default 180s) — CI that
+    # wants instant CPU verdicts sets it to a small value fleet-wide
     if not accelerator_healthy():
         # wedged accelerator: pin THIS process to CPU before any backend
         # initialization so the smoke path below still completes (a healthy
@@ -953,11 +955,68 @@ def resilience_bench():
             "device": "tpu" if on_tpu else "cpu"}
 
 
+def watchdog_bench():
+    """Rung wd (fleet watchdog, runtime/resilience/watchdog.py +
+    heartbeat.py): per-step arm/disarm overhead — the only fleet-tier cost
+    that rides the hot step path, so the target is noise level (single-digit
+    microseconds: one lock acquire and a deque append) — plus heartbeat
+    beacon write/read latency, which is off the step path but bounds the
+    usable beacon cadence on a shared filesystem."""
+    import shutil as _shutil
+    import tempfile
+
+    from deepspeed_tpu.runtime.resilience import (FileHeartbeatTransport,
+                                                  HealthTable,
+                                                  HeartbeatWriter,
+                                                  StepWatchdog)
+
+    d = tempfile.mkdtemp(prefix="dstpu_wd_")
+    try:
+        wd = StepWatchdog(d, floor_s=120.0, cap_s=600.0)
+        for i in range(100):  # warm the lock/deque path
+            wd.arm(i)
+            wd.disarm()
+        n = 5000
+        t0 = time.perf_counter()
+        for i in range(n):
+            wd.arm(i)
+            wd.disarm()
+        arm_disarm_us = (time.perf_counter() - t0) / n * 1e6
+        assert not wd.fired, "watchdog fired during the overhead bench"
+        wd.stop()
+
+        transport = FileHeartbeatTransport(d)
+        writer = HeartbeatWriter(transport, rank=0)
+        table = HealthTable(transport)
+        for r in range(1, 4):  # a small fleet so read parses several beacons
+            HeartbeatWriter(transport, rank=r).beat(step=10, step_time_s=0.1)
+        m = 200
+        t0 = time.perf_counter()
+        for i in range(m):
+            writer.beat(step=i, step_time_s=0.1)
+        hb_write_ms = (time.perf_counter() - t0) / m * 1e3
+        t0 = time.perf_counter()
+        for _ in range(m):
+            table.read()
+        hb_read_ms = (time.perf_counter() - t0) / m * 1e3
+    finally:
+        _shutil.rmtree(d, ignore_errors=True)
+
+    return {"metric": "watchdog_arm_disarm_us",
+            "value": round(arm_disarm_us, 2), "unit": "us/step",
+            "vs_baseline": None,
+            "heartbeat_write_ms": round(hb_write_ms, 3),
+            "heartbeat_read_ms": round(hb_read_ms, 3),
+            "fleet_beacons_read": 4,
+            "device": jax.devices()[0].platform}
+
+
 RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "3b": rung3b_big_model,
          "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses,
          "cm": collective_matmul_bench, "qx": quantized_collectives_bench,
-         "plan": planner_bench, "rz": resilience_bench}
+         "plan": planner_bench, "rz": resilience_bench,
+         "wd": watchdog_bench}
 
 
 def _with_ledger(fn):
@@ -1002,7 +1061,7 @@ def run_ladder():
             ("cm", {} if multichip else cpu8),
             ("qx", {} if multichip else cpu8),
             ("plan", {} if multichip else cpu8),
-            ("rz", chip)]
+            ("rz", chip), ("wd", cpu1)]
     results = []
     for rung, env_over in plan:
         env = dict(os.environ)

@@ -433,11 +433,51 @@ def has_coalescing_manager() -> bool:
 def monitored_barrier(group=None, timeout=None, wait_all_ranks: bool = False,
                       name: str = "monitored_barrier"):
     """Reference ``monitored_barrier(group=None, timeout=...)``
-    (``comm.py:412``): under jax.distributed a straggler surfaces as the
-    coordinator's own timeout, so this is ``barrier`` with the reference
-    signature accepted — including the leading ``group``, so a positional
-    group argument is not silently consumed as ``timeout``."""
-    barrier(name)
+    (``comm.py:412``), with the ``timeout`` actually ENFORCED: the barrier
+    runs on a helper thread and a barrier that does not complete in time
+    raises :class:`TimeoutError` naming the barrier — a wedged host then
+    surfaces as a catchable, restartable failure instead of an eternal
+    stall. ``timeout`` is seconds or a ``datetime.timedelta`` (the torch
+    signature); ``None`` keeps the plain blocking barrier. The leading
+    ``group`` is accepted positionally so it is not silently consumed as
+    ``timeout``.
+
+    CONTRACT: after a timeout the caller must ESCALATE — snapshot and exit
+    (e.g. with the launcher's watchdog-hang code) so the restart policy
+    relaunches the world. The helper thread is daemonic and abandoned still
+    inside the barrier; continuing to issue collectives (or retrying the
+    barrier) from this process while a stale participant is parked in the
+    old one desynchronizes the cross-host collective order — undefined
+    behavior under jax.distributed. Timeout-then-exit is the only safe
+    sequence, which is exactly what the fleet tier automates."""
+    if timeout is None:
+        barrier(name)
+        return
+    secs = (timeout.total_seconds() if hasattr(timeout, "total_seconds")
+            else float(timeout))
+    import threading
+
+    done = threading.Event()
+    err: list = []
+
+    def _run():
+        try:
+            barrier(name)
+        except BaseException as e:  # surfaced on the caller's thread
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name=f"dstpu-monitored-barrier-{name}")
+    t.start()
+    if not done.wait(max(0.0, secs)):
+        raise TimeoutError(
+            f"monitored_barrier {name!r} did not complete within {secs:g}s "
+            f"— a rank is missing or a collective is wedged (process "
+            f"{jax.process_index()}/{jax.process_count()})")
+    if err:
+        raise err[0]
 
 
 def destroy_process_group():

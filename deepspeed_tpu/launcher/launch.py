@@ -9,14 +9,112 @@ supervises it and restarts on failure (reference ``DSElasticAgent._invoke_run``,
 """
 
 import os
+import random
 import signal
 import subprocess
 import sys
 import time
-from typing import List, Optional
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
 
 from ..utils.logging import logger
 from .multinode_runner import DEFAULT_COORDINATOR_PORT
+
+# Exit-code vocabulary shared with the resilience tier (which mirrors these
+# constants rather than importing them — the launcher must stay importable
+# without jax, and the engine-side modules are jax-bound):
+#   runtime/resilience/supervisor.py::PREEMPT_EXIT_CODE
+#   runtime/resilience/watchdog.py::WATCHDOG_EXIT_CODE
+EXIT_CLEAN = 0
+EXIT_PREEMPT_DRAIN = 82   # drained preemption: restart without charging budget
+EXIT_WATCHDOG_HANG = 83   # step watchdog fired: hangdump written, restartable
+
+
+def classify_exit(rc: int) -> str:
+    """Map a child exit code onto the restart policy's failure classes:
+    ``clean`` / ``preempt`` / ``hang`` / ``crash``. Signal deaths
+    (negative rc from ``Popen.wait``) are crashes — the *forwarded*-signal
+    stop case is decided by the supervisor's stop flag, not the code."""
+    if rc == EXIT_CLEAN:
+        return "clean"
+    if rc == EXIT_PREEMPT_DRAIN:
+        return "preempt"
+    if rc == EXIT_WATCHDOG_HANG:
+        return "hang"
+    return "crash"
+
+
+@dataclass
+class RestartPolicy:
+    """Exit-code-aware supervision policy (the reference elastic agent's
+    restart loop, grown the failure classes a TPU fleet actually emits).
+
+    ``max_restarts`` bounds *total* restarts over the job's life;
+    ``crash_loop_budget`` bounds *consecutive* quick failures (uptime below
+    ``min_uptime_s``) — a healthy stretch resets the consecutive counter,
+    matching the reference's reset-on-uptime. Backoff is exponential with
+    jitter so a fleet of supervisors does not relaunch in lockstep."""
+    max_restarts: int = 100
+    min_uptime_s: float = 10.0
+    backoff_base_s: float = 1.0
+    backoff_max_s: float = 60.0
+    jitter_frac: float = 0.25
+    crash_loop_budget: int = 5
+
+    def backoff_s(self, consecutive: int, rng: random.Random) -> float:
+        base = min(self.backoff_base_s * (2 ** max(0, consecutive - 1)),
+                   self.backoff_max_s)
+        return base * (1.0 + self.jitter_frac * rng.random())
+
+
+def make_rescale_fn(ds_config_path: str) -> Callable[[int], Optional[Dict[str, str]]]:
+    """Build the membership-change hook for ``_supervise``: on each restart
+    re-probe the available chips and re-query ``elasticity.decide_world`` so
+    the relaunch targets the LARGEST valid world for the capacity that is
+    actually there (a dead host must not wedge the job on a world it can no
+    longer form). Returns env overrides for the child, or None to relaunch
+    unchanged."""
+
+    def rescale(restarts: int) -> Optional[Dict[str, str]]:
+        import json
+
+        try:
+            with open(ds_config_path) as f:
+                cfg = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            logger.warning(f"rescale: unreadable ds_config {ds_config_path}: {e}")
+            return None
+        if not cfg.get("elasticity", {}).get("enabled", False):
+            return None
+        from ..utils.health import accelerator_device_count
+
+        available = accelerator_device_count()
+        if available <= 0:
+            logger.warning("rescale: no healthy chips visible; relaunching "
+                           "unchanged and letting the child's own probe decide")
+            return None
+        from ..elasticity.elastic_agent import decide_world
+
+        try:
+            d = decide_world(cfg, available)
+        except Exception as e:
+            logger.warning(f"rescale: decide_world failed ({e}); "
+                           "relaunching unchanged")
+            return None
+        logger.info(f"rescale: {available} chips available -> world "
+                    f"{d.world_size} (batch {d.final_batch}, "
+                    f"micro {d.micro_batch})")
+        # DSTPU_ELASTIC_BATCH/_MICRO are consumed by config.finalize (the
+        # supervisor's schedule wins over each host's local recompute);
+        # TPU_VISIBLE_DEVICES caps this LOCAL child to the decided world so
+        # a single-host relaunch actually forms it when chips went away
+        return {"DSTPU_ELASTIC_WORLD": str(d.world_size),
+                "DSTPU_ELASTIC_BATCH": str(d.final_batch),
+                "DSTPU_ELASTIC_MICRO": str(d.micro_batch),
+                "TPU_VISIBLE_DEVICES": ",".join(
+                    str(i) for i in range(d.world_size))}
+
+    return rescale
 
 
 def build_child_env(args, extra=None):
@@ -66,7 +164,14 @@ def launch_local(args) -> int:
     cmd = user_launch_cmd(args)
     env = build_child_env(args)
     if args.elastic_training:
-        return _supervise(cmd, env, max_restarts=args.max_restarts)
+        rescale_fn = None
+        cfg_path = getattr(args, "elastic_config", None)
+        if cfg_path:
+            rescale_fn = make_rescale_fn(cfg_path)
+        return _supervise(cmd, env, max_restarts=args.max_restarts,
+                          restart_policy=getattr(args, "restart_policy",
+                                                 "default"),
+                          rescale_fn=rescale_fn)
     return _run_once(cmd, env)
 
 
@@ -77,15 +182,107 @@ def _run_once(cmd: List[str], env) -> int:
 
 
 def _supervise(cmd: List[str], env, max_restarts: int = 100,
-               min_uptime_s: float = 10.0, backoff_s: float = 3.0) -> int:
-    """Restart-on-failure supervision (elastic agent). A child that exits
-    non-zero is relaunched (with backoff) up to ``max_restarts`` times;
-    crashes after a healthy uptime reset the restart budget — matching the
-    membership-change restart loop of the reference elastic agent. A
-    SIGINT/SIGTERM delivered to the supervisor terminates the job instead of
-    triggering a restart."""
+               min_uptime_s: float = 10.0, backoff_s: float = 3.0,
+               restart_policy: str = "default",
+               policy: Optional[RestartPolicy] = None,
+               rescale_fn: Optional[Callable[[int], Optional[Dict[str, str]]]] = None,
+               sleep: Callable[[float], None] = time.sleep,
+               rng: Optional[random.Random] = None) -> int:
+    """Restart-on-failure supervision (elastic agent).
+
+    ``restart_policy="default"`` classifies child exits
+    (:func:`classify_exit`) and maps the classes to actions:
+
+    - **clean** (0) — job done, return 0;
+    - **preempt-drain** (:data:`EXIT_PREEMPT_DRAIN`) — the child committed a
+      final snapshot and exited on purpose; relaunch WITHOUT charging the
+      crash-loop budget (the preemption will end; the restart resumes);
+    - **watchdog-hang** (:data:`EXIT_WATCHDOG_HANG`) — a hangdump was
+      written; relaunch with backoff, charging the budget;
+    - **crash** (anything else, incl. signal deaths) — relaunch with
+      exponential backoff + jitter, charging the budget.
+
+    The budget is ``policy.crash_loop_budget`` *consecutive* failures that
+    died before ``policy.min_uptime_s`` of healthy uptime (a healthy stretch
+    resets it), plus ``max_restarts`` total over the job's life; when either
+    is exhausted the child's REAL exit code propagates. Before each relaunch
+    ``rescale_fn(restarts)`` may re-query elasticity for the membership that
+    actually survives and returns env overrides for the child.
+
+    ``restart_policy="legacy"`` keeps the PR4-era loop bit-for-bit: fixed
+    ``backoff_s``, ``max_restarts`` consecutive quick failures, no exit-code
+    classes. A SIGINT/SIGTERM delivered to the supervisor terminates the
+    job instead of triggering a restart in both modes."""
+    if restart_policy == "legacy":
+        return _supervise_legacy(cmd, env, max_restarts=max_restarts,
+                                 min_uptime_s=min_uptime_s,
+                                 backoff_s=backoff_s, sleep=sleep)
+    if restart_policy != "default":
+        raise ValueError(f"unknown restart_policy {restart_policy!r} "
+                         "(default|legacy)")
+    pol = policy or RestartPolicy(max_restarts=max_restarts,
+                                  min_uptime_s=min_uptime_s)
+    rng = rng or random.Random()
+    env = dict(env)
+    total_restarts = 0
+    consecutive = 0
+    stop_requested: list = []
+    while True:
+        start = time.monotonic()
+        proc = subprocess.Popen(cmd, env=env)
+        _forward_signals(proc, stop_requested)
+        rc = proc.wait()
+        uptime = time.monotonic() - start
+        cls = classify_exit(rc)
+        if cls == "clean":
+            return 0
+        if stop_requested:
+            logger.info(f"worker stopped by signal {stop_requested[0]}; "
+                        "not restarting")
+            return rc
+        quick = uptime <= pol.min_uptime_s
+        if not quick:
+            consecutive = 0
+        if cls != "preempt":
+            consecutive += 1
+        total_restarts += 1
+        if total_restarts > pol.max_restarts:
+            logger.error(f"worker failed rc={rc} ({cls}); total restart "
+                         f"budget ({pol.max_restarts}) exhausted")
+            return rc
+        if cls != "preempt" and consecutive > pol.crash_loop_budget:
+            logger.error(
+                f"worker failed rc={rc} ({cls}); {consecutive} consecutive "
+                f"failures under {pol.min_uptime_s:.0f}s uptime — crash "
+                "loop, giving up with the child's exit code")
+            return rc
+        if cls == "preempt":
+            delay = pol.backoff_base_s
+            logger.warning(f"worker drained for preemption (rc={rc}); "
+                           f"relaunching in {delay:.1f}s without charging "
+                           "the crash-loop budget")
+        else:
+            delay = pol.backoff_s(consecutive, rng)
+            hint = (" — see hangdump-<rank>.txt in the snapshot dir"
+                    if cls == "hang" else "")
+            logger.warning(
+                f"worker failed rc={rc} ({cls}) after {uptime:.1f}s{hint}; "
+                f"restart {total_restarts}/{pol.max_restarts} "
+                f"(consecutive {consecutive}/{pol.crash_loop_budget}) "
+                f"in {delay:.1f}s")
+        sleep(delay)
+        if rescale_fn is not None:
+            overrides = rescale_fn(total_restarts)
+            if overrides:
+                env.update(overrides)
+
+
+def _supervise_legacy(cmd: List[str], env, max_restarts: int = 100,
+                      min_uptime_s: float = 10.0, backoff_s: float = 3.0,
+                      sleep: Callable[[float], None] = time.sleep) -> int:
+    """The PR4-era loop, kept verbatim under ``restart_policy: legacy``."""
     restarts = 0
-    stop_requested = []
+    stop_requested: list = []
     while True:
         start = time.time()
         proc = subprocess.Popen(cmd, env=env)
@@ -105,7 +302,7 @@ def _supervise(cmd: List[str], env, max_restarts: int = 100,
             return rc
         logger.warning(f"worker failed rc={rc} after {uptime:.1f}s; "
                        f"restart {restarts}/{max_restarts} in {backoff_s}s")
-        time.sleep(backoff_s)
+        sleep(backoff_s)
 
 
 def install_signal_handlers(handler, signals=(signal.SIGINT, signal.SIGTERM),

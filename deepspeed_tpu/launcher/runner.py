@@ -64,6 +64,17 @@ def parse_args(args=None):
     parser.add_argument("--elastic_training", action="store_true",
                         help="supervise and restart the local worker on failure")
     parser.add_argument("--max_restarts", type=int, default=100)
+    parser.add_argument("--restart_policy", type=str, default="default",
+                        choices=["default", "legacy"],
+                        help="default: exit-code classes (clean/preempt-"
+                             "drain/watchdog-hang/crash), exponential "
+                             "backoff with jitter, crash-loop budget; "
+                             "legacy: the fixed-backoff PR4 loop")
+    parser.add_argument("--elastic_config", type=str, default=None,
+                        help="ds_config JSON path with an elasticity block: "
+                             "each supervised relaunch re-probes capacity "
+                             "and re-queries decide_world so the restart "
+                             "targets the largest valid world")
     parser.add_argument("--python_exec", type=str, default=sys.executable)
     parser.add_argument("--export", action="append", default=[],
                         help="KEY=VALUE env to forward to workers (repeatable)")

@@ -11,10 +11,12 @@ models). Keeps the same knob vocabulary — ``train_batch_size``,
 
 import dataclasses
 import json
+import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Mapping, Optional, Union
 
 from .config_utils import AUTO, ConfigError, ConfigModel, register_config
+from ..utils.logging import logger
 
 # ---------------------------------------------------------------------------
 # Precision
@@ -462,6 +464,52 @@ class FaultInjectionConfig(ConfigModel):
     preempt_at_step: Optional[int] = None
     torn_write_at_steps: List[int] = field(default_factory=list)
     crash_before_commit_at_steps: List[int] = field(default_factory=list)
+    hang_at_step: Optional[int] = None      # step wedges; watchdog must fire
+    slow_rank: Optional[int] = None         # steady straggler rank
+    slow_step_s: float = 0.25               # per-step sleep on slow_rank
+    heartbeat_loss_at_steps: List[int] = field(default_factory=list)
+
+
+@register_config
+@dataclass
+class WatchdogConfig(ConfigModel):
+    """Step watchdog (``runtime/resilience/watchdog.py``): a deadline
+    derived from the rolling median step time; on expiry all-thread stacks
+    are dumped to ``hangdump-<rank>.txt`` and the process exits with the
+    distinctive watchdog code so the launcher restarts it."""
+    enabled: bool = False
+    factor: float = 8.0        # deadline = factor * rolling median step time
+    floor_s: float = 30.0      # never below (short steps jitter)
+    cap_s: float = 600.0       # never above (also the pre-history deadline)
+    window: int = 32           # rolling-median history length
+    dump_dir: Optional[str] = None  # default: resilience.snapshot_dir
+
+
+@register_config
+@dataclass
+class HeartbeatConfig(ConfigModel):
+    """Cross-host health beacons (``runtime/resilience/heartbeat.py``):
+    per-host files in a shared dir carrying step/step-time; readers derive
+    dead-host and straggler verdicts and emit Resilience/* events."""
+    enabled: bool = False
+    interval_steps: int = 1         # beacon (and table check) cadence
+    dir: Optional[str] = None       # default: <snapshot_dir>/heartbeats
+    dead_after_s: float = 60.0      # beacon older than this = dead host
+    straggler_factor: float = 3.0   # step_time > k * fleet median
+
+
+@register_config
+@dataclass
+class DegradedModeConfig(ConfigModel):
+    """Degraded-mode collective fallback: after ``rollback_threshold``
+    sentinel rollbacks within ``window_s`` seconds, the run drops every
+    approximate collective (compressed int8 paths, planner decisions) back
+    to exact XLA collectives. Persisted in snapshot meta so restarts
+    inherit it; re-escalation only via operator action
+    (``ResilienceManager.clear_degraded()``)."""
+    enabled: bool = False
+    rollback_threshold: int = 2
+    window_s: float = 600.0
 
 
 @register_config
@@ -481,6 +529,10 @@ class ResilienceConfig(ConfigModel):
     sentinel: SentinelConfig = field(default_factory=SentinelConfig)
     preemption: PreemptionConfig = field(default_factory=PreemptionConfig)
     faults: FaultInjectionConfig = field(default_factory=FaultInjectionConfig)
+    # fleet-robustness block (all off by default — stepping stays bit-identical)
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+    heartbeat: HeartbeatConfig = field(default_factory=HeartbeatConfig)
+    degraded_mode: DegradedModeConfig = field(default_factory=DegradedModeConfig)
 
 
 @register_config
@@ -766,6 +818,30 @@ class DeepSpeedTPUConfig(ConfigModel):
                     "remove them or set elasticity.ignore_non_elastic_batch_info")
             final_batch, _, micro = compute_elastic_config(
                 self.elasticity, world_size=world_dp_size)
+            # a supervised relaunch carries the launcher's rescale decision
+            # (launcher/launch.py::make_rescale_fn → DSTPU_ELASTIC_BATCH/
+            # _MICRO): the SUPERVISOR's schedule wins over a local recompute
+            # so every host of the relaunch runs the same triangle even if
+            # their capacity probes disagree transiently — but only when it
+            # is consistent with the world this engine actually formed
+            env_b = os.environ.get("DSTPU_ELASTIC_BATCH")
+            env_m = os.environ.get("DSTPU_ELASTIC_MICRO")
+            if env_b and env_m:
+                try:
+                    eb, em = int(env_b), int(env_m)
+                except ValueError:
+                    eb = em = 0
+                if eb > 0 and em > 0 and eb % (em * world_dp_size) == 0:
+                    final_batch, micro = eb, em
+                    logger.info(
+                        f"elasticity: batch schedule from the supervisor's "
+                        f"rescale decision (DSTPU_ELASTIC_BATCH={eb}, "
+                        f"micro={em}, dp={world_dp_size})")
+                else:
+                    logger.warning(
+                        f"elasticity: ignoring DSTPU_ELASTIC_BATCH={env_b}/"
+                        f"MICRO={env_m} — inconsistent with the actual dp "
+                        f"world {world_dp_size}; recomputed locally")
             self._user_batch = (final_batch, micro, None)
         self._resolve_batch_sizes(world_dp_size)
         if self.fp16.enabled and self.bf16.enabled:

@@ -58,20 +58,45 @@ class DeepSpeedDataLoader:
         self.sampler = sampler
         self.epoch = 0
         self.global_step = 0
+        self.batch_in_epoch = 0   # batches YIELDED in the current epoch
+        self._resume_offset = 0   # batches to fast-forward on next __iter__
         n = len(dataset)
         self.len = n // batch_size if drop_last else (n + batch_size - 1) // batch_size
 
     def __len__(self):
         return self.len
 
+    # -- resumable data stream (recorded in snapshot meta) ---------------
+    def state_dict(self) -> dict:
+        """The loader's position: restoring it into a FRESH loader over the
+        same dataset/seed and iterating reproduces the exact batch sequence
+        an uninterrupted run would have yielded from here."""
+        return {"epoch": self.epoch, "batch_in_epoch": self.batch_in_epoch,
+                "seed": self.seed, "global_step": self.global_step}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.epoch = int(state["epoch"])
+        self.seed = int(state.get("seed", self.seed))
+        self.global_step = int(state.get("global_step", 0))
+        self.batch_in_epoch = 0
+        self._resume_offset = int(state.get("batch_in_epoch", 0))
+
     def __iter__(self) -> Iterator[Any]:
         n = len(self.dataset)
+        start, self._resume_offset = self._resume_offset, 0
         if self.sampler is None:
             order = np.arange(n)
             if self.shuffle:
                 rng = np.random.default_rng(self.seed + self.epoch)
                 rng.shuffle(order)
-        for i in range(self.len):
+        elif start:
+            # curriculum sampler: fast-forward by consuming (and discarding)
+            # the skipped draws — the sampler's stream is deterministic, so
+            # position IS the resume state
+            for _ in range(start):
+                self.sampler.next_batch()
+        self.batch_in_epoch = start
+        for i in range(start, self.len):
             if self.sampler is not None:
                 idx = self.sampler.next_batch()
             else:
@@ -81,8 +106,10 @@ class DeepSpeedDataLoader:
                 seqlen = int(self.curriculum_fn(self.epoch, self.global_step))
                 batch = _truncate_seq(batch, seqlen)
             self.global_step += 1
+            self.batch_in_epoch = i + 1
             yield batch
         self.epoch += 1
+        self.batch_in_epoch = 0
 
 
 class PrefetchLoader:
@@ -109,6 +136,32 @@ class PrefetchLoader:
         self.loader = loader
         self.sharding = sharding
         self.depth = max(1, int(depth))
+        self._inflight = 0  # batches drawn from the wrapped loader, not yet yielded
+
+    # -- resumable data stream: delegate, corrected for prefetch depth ---
+    def state_dict(self) -> dict:
+        """Wrapped-loader state at the CONSUMED position: batches sitting in
+        the prefetch queue were drawn but never reached the trainer, so the
+        wrapped position is rolled back by the in-flight count (wrapping an
+        epoch boundary when needed)."""
+        inner = getattr(self.loader, "state_dict", None)
+        if inner is None:
+            raise TypeError("PrefetchLoader wraps a loader without "
+                            "state_dict(); wrap a DeepSpeedDataLoader for "
+                            "resumable iteration")
+        state = dict(inner())
+        bi = int(state.get("batch_in_epoch", 0)) - self._inflight
+        gs = int(state.get("global_step", 0)) - self._inflight
+        if bi < 0:
+            state["epoch"] = int(state["epoch"]) - 1
+            bi += len(self.loader)
+        state["batch_in_epoch"] = bi
+        state["global_step"] = max(0, gs)
+        return state
+
+    def load_state_dict(self, state: dict) -> None:
+        self.loader.load_state_dict(state)
+        self._inflight = 0
 
     def _put(self, batch):
         if self.sharding is None:
@@ -120,17 +173,21 @@ class PrefetchLoader:
 
         queue = collections.deque()
         it = iter(self.loader)
+        self._inflight = 0
         try:
             for _ in range(self.depth):
                 queue.append(self._put(next(it)))
+                self._inflight += 1
         except StopIteration:
             pass
         while queue:
             out = queue.popleft()
             try:
                 queue.append(self._put(next(it)))
+                self._inflight += 1
             except StopIteration:
                 pass
+            self._inflight -= 1
             yield out
 
     def __len__(self):

@@ -604,7 +604,10 @@ class DeepSpeedTPUEngine:
                 return acc, loss
 
             rngs = jax.random.split(rng, gas)
-            if compressed_dp:
+            # trace-time read of the ATTRIBUTE (not the _compile-time local):
+            # degraded mode flips it off and invalidates compiled steps, and
+            # the retrace must land on the exact psum path
+            if self._compressed_dp:
                 grads, losses = self._compressed_grad_phase(
                     state.params, batch, rngs, rng, scale,
                     ltd_keep=ltd_keep, moq_bits=moq_bits)
@@ -848,6 +851,27 @@ class DeepSpeedTPUEngine:
                 "zero_grad() before switching to train_batch()")
         if batch is None:
             batch = _draw_from_iter(data_iter, self.gas)
+        if self.resilience is not None:
+            # arm the step watchdog AFTER the batch draw (the routine
+            # epoch-end StopIteration must not leave a deadline armed over
+            # whatever the caller does next) but BEFORE dispatch: the
+            # deadline then covers dispatch plus every blocking device sync
+            # post_step performs — the window a wedged collective actually
+            # hangs in. Exceptions the caller handles (XLA errors, shape
+            # mismatches) disarm via abort_step instead of leaving a live
+            # deadline behind.
+            self.resilience.pre_step()
+            try:
+                return self._train_batch_armed(batch)
+            except BaseException:
+                self.resilience.abort_step()
+                raise
+        return self._train_batch_armed(batch)
+
+    def _train_batch_armed(self, batch):
+        """The body of ``train_batch`` from batch shaping through the
+        resilience post-step hook; runs with the step watchdog armed when
+        resilience is enabled (``train_batch`` handles arm/abort)."""
         batch = self._shape_batch(batch)
         if self.curriculum_scheduler is not None:
             # seqlen curriculum: truncate [gas, micro, seq] leaves to the
@@ -1582,4 +1606,10 @@ def initialize(args=None,
         dataloader = DeepSpeedDataLoader(training_data,
                                          batch_size=cfg.train_micro_batch_size_per_gpu,
                                          sampler=sampler)
+    if dataloader is not None and engine.resilience is not None:
+        # resumable data stream: the loader's position rides in snapshot
+        # meta, and a restore (which already happened at engine init)
+        # fast-forwards it so the post-restore batch sequence matches an
+        # uninterrupted run
+        engine.resilience.register_dataloader(dataloader)
     return engine, engine.tx, dataloader, engine.lr_schedule

@@ -18,17 +18,27 @@ the same reason — production training happens on preemptible capacity):
 - :mod:`supervisor` — restore-on-restart: resolve the latest *valid*
   manifest entry and (with elasticity enabled) the world to restart at, so
   a resume onto a different chip count reshards correctly.
+- :mod:`watchdog` — per-step deadline monitor (rolling-median-derived):
+  a hung collective becomes hangdump + distinctive exit code + supervised
+  restart instead of an eternal silent stall.
+- :mod:`heartbeat` — per-host beacons in a shared dir; readers derive
+  dead-host and straggler verdicts (step-time vs fleet median).
 
 Everything is gated behind the ``resilience:`` config block; with it off
 (the default) no hook exists and engine stepping is bit-identical.
 """
 
 from .faults import FaultPlan, InjectedCrash
+from .heartbeat import (FileHeartbeatTransport, HealthTable, HeartbeatWriter,
+                        HostHealth)
 from .preempt import PreemptionWatcher
 from .sentinel import Sentinel, SentinelEvent, SentinelHalt
 from .snapshot import SnapshotManager
-from .supervisor import ResilienceManager, resolve_restore
+from .supervisor import PREEMPT_EXIT_CODE, ResilienceManager, resolve_restore
+from .watchdog import WATCHDOG_EXIT_CODE, StepWatchdog
 
 __all__ = ["SnapshotManager", "Sentinel", "SentinelEvent", "SentinelHalt",
            "PreemptionWatcher", "FaultPlan", "InjectedCrash",
-           "ResilienceManager", "resolve_restore"]
+           "ResilienceManager", "resolve_restore", "StepWatchdog",
+           "WATCHDOG_EXIT_CODE", "PREEMPT_EXIT_CODE", "HeartbeatWriter",
+           "HealthTable", "HostHealth", "FileHeartbeatTransport"]

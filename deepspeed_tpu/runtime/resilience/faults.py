@@ -14,7 +14,15 @@ event so the suite can prove end-to-end recovery:
   shard corrupted AFTER checksumming (restore must detect and skip it);
 - ``crash_before_commit_at_steps`` — the snapshot writer raises
   :class:`InjectedCrash` after the data directory lands but before the
-  manifest commit (restore must resolve the previous tag).
+  manifest commit (restore must resolve the previous tag);
+- ``hang_at_step`` — the step never completes (the post-step hook spins
+  until released), so the armed step watchdog must fire: hangdump +
+  distinctive exit code + supervised restart;
+- ``slow_rank`` — the named rank sleeps ``slow_step_s`` every step (a
+  steady straggler the heartbeat table must call out);
+- ``heartbeat_loss_at_steps`` — the host's beacon write is suppressed at
+  those steps (peers must derive a dead-host verdict once the beacon ages
+  past the threshold).
 
 Loss/grad injections rewrite the *observed* metrics fed to the sentinel,
 not the device state — the rollback that follows is the real code path
@@ -51,6 +59,10 @@ class FaultPlan:
     preempt_at_step: Optional[int] = None
     torn_write_at_steps: Tuple[int, ...] = ()
     crash_before_commit_at_steps: Tuple[int, ...] = ()
+    hang_at_step: Optional[int] = None
+    slow_rank: Optional[int] = None
+    slow_step_s: float = 0.25
+    heartbeat_loss_at_steps: Tuple[int, ...] = ()
 
     fired: list = field(default_factory=list)  # (step, kind) audit trail
     _spent: Set[Tuple[int, str]] = field(default_factory=set)
@@ -67,6 +79,11 @@ class FaultPlan:
             torn_write_at_steps=_steps(getattr(cfg, "torn_write_at_steps", ())),
             crash_before_commit_at_steps=_steps(
                 getattr(cfg, "crash_before_commit_at_steps", ())),
+            hang_at_step=getattr(cfg, "hang_at_step", None),
+            slow_rank=getattr(cfg, "slow_rank", None),
+            slow_step_s=float(getattr(cfg, "slow_step_s", 0.25)),
+            heartbeat_loss_at_steps=_steps(
+                getattr(cfg, "heartbeat_loss_at_steps", ())),
         )
 
     def _fire(self, step: int, kind: str, scheduled) -> bool:
@@ -89,6 +106,27 @@ class FaultPlan:
 
     def preempt_now(self, step: int) -> bool:
         return self._fire(step, "preempt", self.preempt_at_step)
+
+    # -- fleet injections (consumed by ResilienceManager.post_step) ------
+    def hang_now(self, step: int) -> bool:
+        """One-shot: this step wedges (the manager spins until released or
+        the watchdog kills the process)."""
+        return self._fire(step, "hang", self.hang_at_step)
+
+    def slow_now(self, step: int, rank: int) -> float:
+        """Per-step straggler sleep for ``slow_rank`` (seconds; 0 elsewhere).
+        Deliberately NOT one-shot — a straggler is a *steady* condition the
+        heartbeat median must surface; only the first firing is audited."""
+        if self.slow_rank is None or int(rank) != int(self.slow_rank):
+            return 0.0
+        if ("slow", "slow") not in self._spent:
+            self._spent.add(("slow", "slow"))
+            self.fired.append((step, "slow"))
+        return float(self.slow_step_s)
+
+    def heartbeat_lost(self, step: int) -> bool:
+        """One-shot per scheduled step: suppress this step's beacon write."""
+        return self._fire(step, "heartbeat_loss", self.heartbeat_loss_at_steps)
 
     # -- snapshot write hook (SnapshotManager.fault_hook) ----------------
     def snapshot_hook(self, stage: str, step: int) -> Optional[str]:

@@ -1,0 +1,160 @@
+"""Cross-host heartbeats: per-host beacons → dead-host / straggler verdicts.
+
+The watchdog (:mod:`watchdog`) notices *this* host's step not finishing; the
+heartbeat table is the complementary fleet view — every host periodically
+publishes a small beacon (rank, step, recent step time), and any reader can
+derive:
+
+- **dead host** — beacon older than ``dead_after_s`` (the host stopped
+  publishing: wedged, preempted, or gone);
+- **straggler** — a host whose reported step time exceeds ``factor`` × the
+  fleet median (the EQuARX/TPU-pod failure mode where one slow host drags
+  every collective; a straggler is *detectable* here long before the
+  watchdog's absolute deadline trips).
+
+Transport is pluggable via the two-method protocol of
+:class:`FileHeartbeatTransport` (``write(rank, payload)`` /
+``read_all() -> {rank: payload}``); the default is beacon files in a shared
+directory (GCS-fuse / NFS on real pods, tmpdir in tests) written via
+temp + ``os.replace`` so readers never observe a torn beacon.
+
+Stdlib-only (no jax import) for the same reason as :mod:`watchdog`: the
+launcher and standalone drill scripts import it without touching a backend.
+"""
+
+import json
+import os
+import socket
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+try:
+    from ...utils.logging import logger
+except ImportError:  # loaded standalone (file-path import in drill scripts)
+    import logging
+
+    logger = logging.getLogger("deepspeed_tpu.heartbeat")
+
+_BEACON_PREFIX = "hb-"
+
+
+class FileHeartbeatTransport:
+    """Beacon files ``hb-<rank>.json`` in a shared directory."""
+
+    def __init__(self, directory: str):
+        self.dir = os.path.abspath(directory)
+        os.makedirs(self.dir, exist_ok=True)
+
+    def write(self, rank: int, payload: dict) -> None:
+        path = os.path.join(self.dir, f"{_BEACON_PREFIX}{int(rank)}.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)  # readers see old-or-new, never torn
+
+    def read_all(self) -> Dict[int, dict]:
+        out: Dict[int, dict] = {}
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith(_BEACON_PREFIX) and name.endswith(".json")):
+                continue
+            try:
+                rank = int(name[len(_BEACON_PREFIX):-len(".json")])
+                with open(os.path.join(self.dir, name)) as f:
+                    out[rank] = json.load(f)
+            except (ValueError, OSError, json.JSONDecodeError):
+                continue  # partially-deleted or foreign file: not a beacon
+        return out
+
+
+class HeartbeatWriter:
+    """Publishes this host's beacon. ``clock`` is injectable so tests can
+    fabricate beacon ages deterministically."""
+
+    def __init__(self, transport, rank: int,
+                 clock: Callable[[], float] = time.time):
+        self.transport = transport
+        self.rank = int(rank)
+        self.clock = clock
+        self.beats = 0
+
+    def beat(self, step: int, step_time_s: Optional[float] = None) -> None:
+        self.transport.write(self.rank, {
+            "rank": self.rank,
+            "step": int(step),
+            "step_time_s": None if step_time_s is None else float(step_time_s),
+            "wall_time": float(self.clock()),
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+        })
+        self.beats += 1
+
+
+@dataclass
+class HostHealth:
+    """One row of the fleet health table."""
+    rank: int
+    step: int
+    step_time_s: Optional[float]
+    age_s: float
+    alive: bool
+    straggler: bool
+    ratio: float  # step_time / fleet median (1.0 when undefined)
+
+
+def _median(xs: List[float]) -> float:
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+class HealthTable:
+    """Derives per-host verdicts from the beacon set.
+
+    A host is a straggler only relative to *peers*: each host is compared
+    against the median of the OTHER live hosts' step times (leave-one-out —
+    an all-hosts median would let a 2-host fleet's straggler drag the
+    reference up and cap its own ratio below 2×, making the verdict
+    unreachable). With no live peer reporting a step time there is no
+    reference and no straggler verdict.
+    """
+
+    def __init__(self, transport, *, dead_after_s: float = 60.0,
+                 straggler_factor: float = 3.0,
+                 clock: Callable[[], float] = time.time):
+        self.transport = transport
+        self.dead_after_s = float(dead_after_s)
+        self.straggler_factor = float(straggler_factor)
+        self.clock = clock
+
+    def read(self) -> List[HostHealth]:
+        beacons = self.transport.read_all()
+        now = self.clock()
+        rows: List[HostHealth] = []
+        for rank in sorted(beacons):
+            b = beacons[rank]
+            age = max(0.0, now - float(b.get("wall_time", 0.0)))
+            alive = age <= self.dead_after_s
+            st = b.get("step_time_s")
+            rows.append(HostHealth(rank=rank, step=int(b.get("step", -1)),
+                                   step_time_s=st, age_s=age, alive=alive,
+                                   straggler=False, ratio=1.0))
+        reporting = [r for r in rows if r.alive and r.step_time_s is not None]
+        if len(reporting) >= 2:
+            for row in reporting:
+                peers = [float(r.step_time_s) for r in reporting if r is not row]
+                ref = _median(peers)
+                if ref > 0:
+                    row.ratio = float(row.step_time_s) / ref
+                    row.straggler = row.ratio > self.straggler_factor
+        return rows
+
+    def verdicts(self) -> Dict[str, List[int]]:
+        """Condensed view: ``{"dead": [ranks], "stragglers": [ranks]}``."""
+        rows = self.read()
+        return {"dead": [r.rank for r in rows if not r.alive],
+                "stragglers": [r.rank for r in rows if r.straggler]}

@@ -20,7 +20,10 @@ engine's shardings — the same resharding-by-construction the checkpoint
 tier relies on.
 """
 
+import os
+import threading
 import time
+from collections import deque
 from typing import Any, Optional, Tuple
 
 import jax
@@ -32,6 +35,12 @@ from .faults import FaultPlan
 from .preempt import PreemptionWatcher
 from .sentinel import Sentinel
 from .snapshot import SnapshotManager
+from .watchdog import StepWatchdog
+
+# exit code a drained (preempted) run should hand back to the launcher so
+# the restart policy can tell "wait out the preemption" from "crash";
+# mirrored in launcher/launch.py (which must not import this jax-bound tier)
+PREEMPT_EXIT_CODE = 82
 
 
 def resolve_restore(snapshot_dir: str, ds_config=None,
@@ -122,6 +131,36 @@ class ResilienceManager:
         # with resilience enabled)
         self._pending_metrics = None
 
+        # -- fleet-robustness tier (watchdog / heartbeat / degraded mode) --
+        self._rank = jax.process_index()
+        wc = cfg.watchdog
+        self.watchdog: Optional[StepWatchdog] = None
+        if wc.enabled:
+            self.watchdog = StepWatchdog(
+                wc.dump_dir or cfg.snapshot_dir, factor=wc.factor,
+                floor_s=wc.floor_s, cap_s=wc.cap_s, window=wc.window,
+                rank=self._rank)
+        hc = cfg.heartbeat
+        self.heartbeat = None
+        self.health = None
+        if hc.enabled:
+            from .heartbeat import (FileHeartbeatTransport, HealthTable,
+                                    HeartbeatWriter)
+
+            transport = FileHeartbeatTransport(
+                hc.dir or os.path.join(cfg.snapshot_dir, "heartbeats"))
+            self.heartbeat = HeartbeatWriter(transport, rank=self._rank)
+            self.health = HealthTable(transport,
+                                      dead_after_s=hc.dead_after_s,
+                                      straggler_factor=hc.straggler_factor)
+        self.degraded = False
+        self._rollback_times: "deque[float]" = deque(maxlen=64)
+        self._recent_step_times: "deque[float]" = deque(maxlen=16)
+        self._step_t0: Optional[float] = None
+        self._hang_release = threading.Event()
+        self._dataloader = None
+        self._restored_data_state = None
+
     # ------------------------------------------------------------------
     # engine hooks
     # ------------------------------------------------------------------
@@ -133,18 +172,92 @@ class ResilienceManager:
             return None
         self._restore(entry)
         self.restores += 1
+        meta = entry.get("meta", {})
+        if meta.get("degraded_collectives"):
+            # the run had already fallen back to exact collectives when this
+            # snapshot was taken: a restart inherits the degraded mode (only
+            # an operator's clear_degraded() re-escalates)
+            self.enter_degraded(persist=False,
+                                reason="inherited from snapshot meta")
+        self._restored_data_state = meta.get("data_state")
+        if self._restored_data_state and self._dataloader is not None:
+            self._apply_data_state()
         log_dist(f"resilience: restored snapshot {entry['tag']} "
                  f"(global_steps={self.engine.global_steps}"
                  f"{', preempted run' if entry['meta'].get('final') else ''})")
         return entry["tag"]
 
+    def register_dataloader(self, loader) -> None:
+        """Attach the training dataloader so its position rides in snapshot
+        meta (``state_dict``) and a restart fast-forwards it
+        (``load_state_dict``) — the post-restore batch sequence then matches
+        an uninterrupted run. Called by ``initialize()``; loaders without
+        the state protocol are ignored."""
+        if loader is None or not hasattr(loader, "state_dict"):
+            return
+        self._dataloader = loader
+        if self._restored_data_state:
+            self._apply_data_state()
+
+    def _apply_data_state(self) -> None:
+        state, self._restored_data_state = self._restored_data_state, None
+        try:
+            self._dataloader.load_state_dict(state)
+            log_dist(f"resilience: data stream fast-forwarded to epoch "
+                     f"{state.get('epoch')}, batch {state.get('batch_in_epoch')}")
+        except Exception as e:
+            logger.warning(f"resilience: could not restore data-stream "
+                           f"state ({e}); the loader restarts from scratch")
+
+    def pre_step(self) -> None:
+        """Per-step hook BEFORE dispatch: arm the watchdog around the step
+        (the deadline covers dispatch plus every blocking sync post_step
+        performs — exactly the window a wedged collective hangs in)."""
+        if self.watchdog is not None:
+            self.watchdog.arm(self.engine.global_steps)
+        self._step_t0 = time.monotonic()
+
+    def abort_step(self) -> None:
+        """Exception escape hatch for an armed step (engine.train_batch):
+        the step never reached post_step, so disarm WITHOUT recording — an
+        aborted step is neither a hang nor a step-time sample, and the
+        caller may legitimately catch the exception and idle."""
+        if self.watchdog is not None:
+            self.watchdog.disarm(record=False)
+        self._step_t0 = None
+
     def post_step(self) -> None:
         """Per-step hook (engine.train_batch, after the step was DISPATCHED).
 
-        Order matters: a pending preemption wins over everything (the grace
-        window is short); then the sentinel rules on the PREVIOUS step's
-        metrics — read one step late off an async copy started last time,
-        so no device sync serializes the dispatch pipeline; injections
+        The fleet injections run first (a slow rank sleeps, a hang spins —
+        both while the watchdog is still armed, so the drill exercises the
+        REAL detection path); the inner logic then runs under a finally that
+        disarms the watchdog and publishes the heartbeat, so a rollback's
+        early return can't leave the deadline armed across non-step work."""
+        if self.faults is not None:
+            s = self.faults.slow_now(self.engine.global_steps, self._rank)
+            if s > 0:
+                time.sleep(s)
+            if self.faults.hang_now(self.engine.global_steps):
+                self._simulate_hang()
+        try:
+            self._post_step_inner()
+        finally:
+            dt = None
+            if self.watchdog is not None:
+                dt = self.watchdog.disarm()
+            elif self._step_t0 is not None:
+                dt = time.monotonic() - self._step_t0
+            if dt is not None:
+                self._recent_step_times.append(dt)
+            self._step_t0 = None
+            self._heartbeat_tick()
+
+    def _post_step_inner(self) -> None:
+        """Order matters: a pending preemption wins over everything (the
+        grace window is short); then the sentinel rules on the PREVIOUS
+        step's metrics — read one step late off an async copy started last
+        time, so no device sync serializes the dispatch pipeline; injections
         rewrite those observed metrics; a cadence snapshot only fires while
         no NaN streak is live, and the snapshot writer independently
         refuses to commit non-finite state (closing the one-step window in
@@ -176,6 +289,7 @@ class ResilienceManager:
             action = self.sentinel.observe(pstep, loss, grad_norm)
             if action == "rollback":
                 self._rollback()
+                self._maybe_degrade()
                 return
             # "warn" already logged inside the sentinel; "halt" raised
         streak_live = (self.sentinel is not None
@@ -191,6 +305,10 @@ class ResilienceManager:
         if self.drained:
             self.stop_requested = True
             return
+        if self.watchdog is not None:
+            # the drain's block_until_ready + sync snapshot legitimately
+            # exceed a per-step deadline; do not let the watchdog call it a hang
+            self.watchdog.disarm(record=False)
         engine = self.engine
         reason = self.watcher.reason if self.watcher else "drain()"
         log_dist(f"resilience: draining for preemption ({reason})")
@@ -204,17 +322,157 @@ class ResilienceManager:
         self.stop_requested = True
         self._emit([("Resilience/preempt_drain", 1.0, engine.global_steps)])
         log_dist(f"resilience: final snapshot committed at step "
-                 f"{engine.global_steps}; safe to terminate")
+                 f"{engine.global_steps}; safe to terminate (exit with "
+                 f"suggested_exit_code={self.suggested_exit_code} so the "
+                 f"launcher classifies this as a preempt-drain)")
+
+    @property
+    def suggested_exit_code(self) -> int:
+        """What the training script should ``sys.exit`` with once
+        ``engine.should_stop()`` turns true: :data:`PREEMPT_EXIT_CODE` after
+        a preemption drain (the launcher's restart policy then waits out the
+        preemption without charging the crash-loop budget), 0 otherwise."""
+        return PREEMPT_EXIT_CODE if self.drained else 0
+
+    # ------------------------------------------------------------------
+    # fleet tier: heartbeat, hang drill, degraded-mode fallback
+    # ------------------------------------------------------------------
+    def _heartbeat_tick(self) -> None:
+        if self.heartbeat is None:
+            return
+        step = self.engine.global_steps
+        hc = self.cfg.heartbeat
+        if step % max(1, hc.interval_steps) != 0:
+            return
+        lost = (self.faults is not None
+                and self.faults.heartbeat_lost(step))
+        if not lost:
+            st = (sum(self._recent_step_times) / len(self._recent_step_times)
+                  if self._recent_step_times else None)
+            self.heartbeat.beat(step, step_time_s=st)
+        if self.health is not None:
+            events = []
+            for row in self.health.read():
+                if not row.alive:
+                    events.append(("Resilience/dead_host",
+                                   float(row.rank), step))
+                elif row.straggler:
+                    events.append(("Resilience/straggler",
+                                   float(row.rank), step))
+                    events.append(("Resilience/straggler_ratio",
+                                   row.ratio, step))
+            if events:
+                self._emit(events)
+
+    def _simulate_hang(self) -> None:
+        """``faults.hang_at_step`` drill: spin until the armed watchdog fires
+        (its default action dumps stacks and kills the process; a test
+        overrides ``on_expire`` and calls :meth:`release_hang`)."""
+        if self.watchdog is None:
+            logger.warning("faults.hang_at_step fired but the watchdog is "
+                           "disabled — skipping the hang (nothing would "
+                           "ever detect it)")
+            return
+        log_dist("resilience: injected hang — spinning until the watchdog "
+                 "deadline expires")
+        self._hang_release.clear()
+        while not self._hang_release.wait(0.02):
+            pass
+
+    def release_hang(self) -> None:
+        """Unblock a simulated hang (test hook, typically from
+        ``watchdog.on_expire``)."""
+        self._hang_release.set()
+
+    def _maybe_degrade(self) -> None:
+        """After the configured number of rollbacks inside the window, stop
+        trusting the approximate collectives: repeated divergence with int8
+        transports on the hot path is exactly the signature EQuARX-style
+        compression failing on this model/data — fall back to exact XLA
+        collectives instead of rolling back forever."""
+        dm = self.cfg.degraded_mode
+        now = time.monotonic()
+        self._rollback_times.append(now)
+        if not dm.enabled or self.degraded:
+            return
+        recent = [t for t in self._rollback_times if now - t <= dm.window_s]
+        if len(recent) >= dm.rollback_threshold:
+            self.enter_degraded(
+                reason=f"{len(recent)} rollbacks within {dm.window_s:g}s")
+
+    def enter_degraded(self, persist: bool = True,
+                       reason: str = "operator") -> None:
+        """Override every approximate-collective knob back to exact XLA
+        collectives: fleet compression state off, planner off, and the
+        engine's resolved DP-grad implementation cleared; compiled steps are
+        invalidated so the next call retraces on the exact paths. With
+        ``persist`` a snapshot is taken immediately so the flag rides in
+        snapshot meta and restarts inherit it."""
+        if self.degraded:
+            return
+        engine = self.engine
+        from ...comm.compressed import configure_compression
+        from ...comm.planner import configure_planner
+
+        configure_compression("none")
+        configure_planner("off")
+        self._saved_dp_impl = (engine._compressed_dp, engine._dp_grad_impl)
+        engine._compressed_dp = False
+        engine._dp_grad_impl = None
+        engine._degraded_collectives = True
+        self.degraded = True
+        self._invalidate_compiled_steps()
+        self._emit([("Resilience/degraded_mode", 1.0, engine.global_steps)])
+        logger.warning(
+            f"resilience: entering DEGRADED MODE ({reason}) — compressed/"
+            "planned collectives are overridden to exact XLA collectives; "
+            "re-escalate only via ResilienceManager.clear_degraded()")
+        if persist:
+            self.take_snapshot()
+            self.snap.wait()
+
+    def clear_degraded(self) -> None:
+        """Operator re-escalation: restore the config-derived collective
+        knobs (the only way out of degraded mode — an automatic re-escalation
+        would re-enter the very divergence loop that triggered the fallback)."""
+        if not self.degraded:
+            return
+        engine = self.engine
+        cc = engine.config.compressed_collectives
+        from ...comm.compressed import configure_compression
+        from ...comm.planner import configure_from_config
+
+        configure_compression(cc.mode, block=cc.block,
+                              hierarchical=cc.hierarchical,
+                              sites=cc.site_map())
+        configure_from_config(engine.config, topology=engine.topo)
+        engine._compressed_dp, engine._dp_grad_impl = self._saved_dp_impl
+        engine._degraded_collectives = False
+        self.degraded = False
+        self._rollback_times.clear()
+        self._invalidate_compiled_steps()
+        self._emit([("Resilience/degraded_mode", 0.0, engine.global_steps)])
+        log_dist("resilience: degraded mode cleared by operator — config "
+                 "collective knobs restored (next step retraces)")
 
     # ------------------------------------------------------------------
     def take_snapshot(self, final: bool = False) -> str:
         engine = self.engine
         t0 = time.perf_counter()
+        data_state = None
+        if self._dataloader is not None:
+            try:
+                data_state = self._dataloader.state_dict()
+            except Exception as e:
+                logger.warning(f"resilience: dataloader state_dict failed "
+                               f"({e}); snapshot carries no data position")
         tag = self.snap.snapshot(
             engine.state, step=engine.global_steps,
             meta={"global_steps": engine.global_steps,
                   "skipped_steps": engine.skipped_steps,
                   "lr_scale": getattr(engine, "_lr_scale", 1.0),
+                  "degraded_collectives": self.degraded,
+                  "data_state": data_state,
                   "final": bool(final),
                   "topology": {"pp": engine.topo.pp_size,
                                "dp": engine.topo.dp_size,
@@ -250,6 +508,9 @@ class ResilienceManager:
     def _rollback(self) -> None:
         engine = self.engine
         tripped_at = engine.global_steps
+        if self.watchdog is not None:
+            # restore + retrace legitimately exceed a per-step deadline
+            self.watchdog.disarm(record=False)
         self.snap.wait()  # an in-flight async write may BE the last-good
         entry = self.snap.latest_valid()
         if entry is None:
@@ -293,4 +554,6 @@ class ResilienceManager:
             self.engine.monitor.write_events(events)
 
     def close(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
         self.snap.close()

@@ -1,0 +1,193 @@
+"""Step watchdog: turn a hung collective into a restartable failure.
+
+On TPU pods the dominant fleet failure is not a crash but a *wedge*: one
+host stops making progress and every collective the others issue blocks
+forever — no exception, no exit code, nothing for a supervisor to act on.
+The reference stack leans on NCCL's ``TORCH_NCCL_HEARTBEAT_TIMEOUT_SEC`` /
+flight-recorder machinery for this; XLA has no equivalent surface, so the
+detection must live in the runtime.
+
+:class:`StepWatchdog` is a monitor thread armed around each engine step:
+
+- ``arm(step)`` sets a deadline derived from a **rolling median** of recent
+  step times (``factor`` × median, clamped to ``[floor_s, cap_s]``). Before
+  any history exists the deadline is ``cap_s`` — the first step legitimately
+  includes XLA compilation.
+- ``disarm()`` clears the deadline and feeds the observed step time into
+  the history.
+- on expiry the watchdog dumps **all-thread stacks** to
+  ``<dump_dir>/hangdump-<rank>.txt`` (via :mod:`faulthandler`, so even
+  C-blocked threads show their Python frames) and terminates the process
+  with :data:`WATCHDOG_EXIT_CODE` via ``os._exit`` — a hung collective
+  cannot be unwound with an exception, and the *supervisor* (launcher
+  ``_supervise``) is the layer that knows how to restart. Tests override
+  ``on_expire`` to observe the firing without dying.
+
+This module is deliberately stdlib-only (no jax import) so the launcher and
+standalone drill scripts can load it without touching an accelerator
+backend.
+"""
+
+import faulthandler
+import os
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+try:
+    from ...utils.logging import logger
+except ImportError:  # loaded standalone (file-path import in drill scripts)
+    import logging
+
+    logger = logging.getLogger("deepspeed_tpu.watchdog")
+
+# Distinctive exit code the launcher's restart policy maps to the
+# "watchdog-hang" class (deliberately outside the 1/2/126-165 shell range).
+# Mirrored in launcher/launch.py: the launcher must classify this without
+# importing the resilience tier.
+WATCHDOG_EXIT_CODE = 83
+
+
+def hangdump_path(dump_dir: str, rank: int) -> str:
+    return os.path.join(dump_dir, f"hangdump-{rank}.txt")
+
+
+def write_hangdump(dump_dir: str, rank: int, step: Optional[int],
+                   deadline_s: Optional[float]) -> str:
+    """Dump all-thread stacks to ``hangdump-<rank>.txt`` and return the path.
+
+    Append mode: a restart loop that wedges repeatedly accumulates evidence
+    instead of overwriting the first (often most informative) dump."""
+    os.makedirs(dump_dir, exist_ok=True)
+    path = hangdump_path(dump_dir, rank)
+    with open(path, "a") as f:
+        f.write(f"==== watchdog hangdump rank={rank} pid={os.getpid()} "
+                f"step={step} deadline_s={deadline_s} "
+                f"wall={time.time():.3f} ====\n")
+        faulthandler.dump_traceback(file=f, all_threads=True)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return path
+
+
+class StepWatchdog:
+    """Deadline monitor armed around each engine step.
+
+    ``on_expire(step)`` replaces the default kill action when set (tests,
+    custom supervisors); the default writes the hangdump and exits the
+    process with ``exit_code``.
+    """
+
+    def __init__(self, dump_dir: str, *, factor: float = 8.0,
+                 floor_s: float = 30.0, cap_s: float = 600.0,
+                 window: int = 32, rank: int = 0,
+                 on_expire: Optional[Callable[[Optional[int]], None]] = None,
+                 exit_code: int = WATCHDOG_EXIT_CODE):
+        if cap_s < floor_s:
+            raise ValueError(f"watchdog cap_s ({cap_s}) < floor_s ({floor_s})")
+        self.dump_dir = dump_dir
+        self.factor = float(factor)
+        self.floor_s = float(floor_s)
+        self.cap_s = float(cap_s)
+        self.rank = int(rank)
+        self.on_expire = on_expire
+        self.exit_code = int(exit_code)
+        self.fired = False
+        self.fired_step: Optional[int] = None
+        self._times: "deque[float]" = deque(maxlen=max(1, int(window)))
+        self._cond = threading.Condition()
+        self._deadline: Optional[float] = None  # monotonic, None = disarmed
+        self._armed_at: Optional[float] = None
+        self._armed_deadline_s: Optional[float] = None
+        self._step: Optional[int] = None
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="dstpu-step-watchdog")
+        self._thread.start()
+
+    # -- deadline policy -------------------------------------------------
+    def deadline_s(self) -> float:
+        """Current per-step deadline: ``factor`` × rolling median, clamped to
+        ``[floor_s, cap_s]``; ``cap_s`` while no history exists (compile)."""
+        with self._cond:
+            times = list(self._times)
+        if not times:
+            return self.cap_s
+        med = statistics.median(times)
+        return min(self.cap_s, max(self.floor_s, self.factor * med))
+
+    # -- arm/disarm (the per-step hot path: one lock, no syscalls) -------
+    def arm(self, step: Optional[int] = None) -> None:
+        d = self.deadline_s()
+        with self._cond:
+            self._step = step
+            self._armed_at = time.monotonic()
+            self._armed_deadline_s = d
+            self._deadline = self._armed_at + d
+            self._cond.notify_all()
+
+    def disarm(self, record: bool = True) -> Optional[float]:
+        """Clear the deadline; with ``record`` feed the observed step time
+        into the rolling history (pass ``record=False`` around known-slow
+        non-step work like rollbacks and drains). Returns the observed
+        step time, if armed."""
+        with self._cond:
+            dt = None
+            if self._armed_at is not None:
+                dt = time.monotonic() - self._armed_at
+                if record:
+                    self._times.append(dt)
+            self._armed_at = None
+            self._armed_deadline_s = None
+            self._deadline = None
+            self._step = None
+            self._cond.notify_all()
+            return dt
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5.0)
+
+    # -- monitor thread --------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stop and self._deadline is None:
+                    self._cond.wait()
+                if self._stop:
+                    return
+                remaining = self._deadline - time.monotonic()
+                if remaining > 0:
+                    self._cond.wait(remaining)
+                    continue
+                step = self._step
+                deadline_s = self._armed_deadline_s
+                self._deadline = None
+                self._armed_at = None
+                self.fired = True
+                self.fired_step = step
+            self._fire(step, deadline_s)
+            if self.on_expire is None:
+                return  # unreachable after os._exit; keeps tests honest
+
+    def _fire(self, step: Optional[int], deadline_s: Optional[float]) -> None:
+        try:
+            path = write_hangdump(self.dump_dir, self.rank, step, deadline_s)
+            logger.error(
+                f"watchdog: step {step} exceeded its {deadline_s:.1f}s "
+                f"deadline — all-thread stacks dumped to {path}; "
+                f"{'notifying on_expire' if self.on_expire else f'exiting with code {self.exit_code} for the supervisor to restart'}")
+        except Exception as e:  # the dump must never mask the kill
+            logger.error(f"watchdog: hangdump failed ({e}); proceeding")
+        if self.on_expire is not None:
+            self.on_expire(step)
+            return
+        # A hung collective holds locks and C frames no exception can unwind;
+        # os._exit skips atexit/finalizers by design — the snapshot tier's
+        # atomic manifest commit makes that safe (a torn write is skipped).
+        os._exit(self.exit_code)

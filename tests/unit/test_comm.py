@@ -357,3 +357,54 @@ def test_groups_facade():
         assert ep_g[0] == [0, 2, 4, 6] and len(ep_g) == 4 and len(edp_g) == 8
     finally:  # never leak an ep=4 topology into later tests
         set_topology(Topology(TopologySpec()))
+
+
+# ---------------------------------------------------------------------------
+# monitored_barrier: the timeout is ENFORCED (regression — it used to be
+# accepted and ignored, so a wedged host hung the caller forever)
+# ---------------------------------------------------------------------------
+
+
+def test_monitored_barrier_timeout_raises_with_name(monkeypatch):
+    import threading
+    import time as _time
+
+    import deepspeed_tpu.comm.comm as comm_mod
+
+    release = threading.Event()
+
+    def never_arrives(name="barrier"):
+        release.wait(30.0)  # a rank that never shows up
+
+    monkeypatch.setattr(comm_mod, "barrier", never_arrives)
+    t0 = _time.perf_counter()
+    with pytest.raises(TimeoutError, match="'sync_embeddings'.*0.2s"):
+        comm_mod.monitored_barrier(timeout=0.2, name="sync_embeddings")
+    assert _time.perf_counter() - t0 < 5.0  # raised promptly, not after 30s
+    release.set()  # let the daemon helper finish
+
+
+def test_monitored_barrier_timedelta_and_completion(monkeypatch):
+    import datetime
+
+    import deepspeed_tpu.comm.comm as comm_mod
+
+    calls = []
+    monkeypatch.setattr(comm_mod, "barrier", lambda name: calls.append(name))
+    # torch-style timedelta timeout; an arriving barrier completes quietly
+    comm_mod.monitored_barrier(timeout=datetime.timedelta(seconds=5),
+                               name="ok_barrier")
+    # no timeout: the plain blocking path (also via the leading group arg)
+    comm_mod.monitored_barrier(None, None, False, "plain")
+    assert calls == ["ok_barrier", "plain"]
+
+
+def test_monitored_barrier_propagates_helper_error(monkeypatch):
+    import deepspeed_tpu.comm.comm as comm_mod
+
+    def boom(name):
+        raise RuntimeError("coordinator gone")
+
+    monkeypatch.setattr(comm_mod, "barrier", boom)
+    with pytest.raises(RuntimeError, match="coordinator gone"):
+        comm_mod.monitored_barrier(timeout=5.0, name="errors")

@@ -1,0 +1,771 @@
+"""Fleet robustness tier: step watchdog (hang detection), cross-host
+heartbeats (dead-host/straggler verdicts), the launcher's exit-code-aware
+restart policy, degraded-mode collective fallback, and the resumable data
+stream — every path driven by deterministic fault injection."""
+
+import importlib.util
+import os
+import random
+import subprocess
+import sys
+import textwrap
+import time
+import types
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.launcher.launch import (EXIT_PREEMPT_DRAIN,
+                                           EXIT_WATCHDOG_HANG, RestartPolicy,
+                                           _supervise, classify_exit,
+                                           make_rescale_fn)
+from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                              PrefetchLoader)
+from deepspeed_tpu.runtime.resilience import (PREEMPT_EXIT_CODE,
+                                              WATCHDOG_EXIT_CODE, FaultPlan,
+                                              FileHeartbeatTransport,
+                                              HealthTable, HeartbeatWriter,
+                                              SnapshotManager, StepWatchdog)
+
+from .simple_model import make_simple_params, random_batches, simple_loss
+
+HIDDEN = 64
+WATCHDOG_PY = os.path.join(os.path.dirname(ds.__file__), "runtime",
+                           "resilience", "watchdog.py")
+
+
+def _engine(snapshot_dir=None, resilience=None, seed=42, extra_cfg=None):
+    cfg = {"train_micro_batch_size_per_gpu": 8,
+           "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 1000, "seed": seed}
+    if resilience is not None:
+        rz = {"enabled": True, "snapshot_dir": str(snapshot_dir)}
+        rz.update(resilience)
+        cfg["resilience"] = rz
+    if extra_cfg:
+        cfg.update(extra_cfg)
+    engine, *_ = ds.initialize(model=simple_loss,
+                               model_parameters=make_simple_params(HIDDEN),
+                               config=cfg)
+    return engine
+
+
+def _recorder(engine):
+    events = []
+    engine.monitor = types.SimpleNamespace(
+        write_events=lambda evs: events.extend(evs))
+    return events
+
+
+# ---------------------------------------------------------------------------
+# step watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_deadline_from_rolling_median(tmp_path):
+    wd = StepWatchdog(str(tmp_path), factor=10.0, floor_s=1.0, cap_s=5.0)
+    try:
+        assert wd.deadline_s() == 5.0  # no history: cap (first step compiles)
+        wd._times.extend([0.01] * 5)
+        assert wd.deadline_s() == 1.0  # 10*0.01 clamped up to the floor
+        wd._times.clear()
+        wd._times.extend([0.3] * 5)
+        assert wd.deadline_s() == pytest.approx(3.0)  # in-band: factor*median
+        wd._times.clear()
+        wd._times.extend([2.0] * 5)
+        assert wd.deadline_s() == 5.0  # clamped down to the cap
+    finally:
+        wd.stop()
+
+
+def test_watchdog_fast_steps_never_fire(tmp_path):
+    wd = StepWatchdog(str(tmp_path), floor_s=5.0, cap_s=30.0)
+    try:
+        for i in range(50):
+            wd.arm(i)
+            wd.disarm()
+        time.sleep(0.05)
+        assert not wd.fired
+        assert len(wd._times) == 32  # capped at the rolling window
+    finally:
+        wd.stop()
+    assert not os.path.exists(os.path.join(str(tmp_path), "hangdump-0.txt"))
+
+
+def test_watchdog_expiry_dumps_stacks_and_fires_hook(tmp_path):
+    fired = []
+    wd = StepWatchdog(str(tmp_path), floor_s=0.05, cap_s=0.15, rank=3,
+                      on_expire=fired.append)
+    try:
+        wd.arm(7)
+        deadline = time.monotonic() + 5.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fired == [7] and wd.fired and wd.fired_step == 7
+        dump = tmp_path / "hangdump-3.txt"
+        assert dump.exists()
+        text = dump.read_text()
+        assert "watchdog hangdump rank=3" in text and "step=7" in text
+        assert "Thread" in text  # faulthandler all-thread stacks
+    finally:
+        wd.stop()
+
+
+def test_watchdog_disarm_no_record_keeps_median_clean(tmp_path):
+    wd = StepWatchdog(str(tmp_path), floor_s=1.0, cap_s=9.0)
+    try:
+        wd.arm(0)
+        time.sleep(0.02)
+        assert wd.disarm(record=False) is not None
+        assert len(wd._times) == 0  # rollback/drain time never enters history
+        assert wd.deadline_s() == 9.0
+    finally:
+        wd.stop()
+
+
+def test_watchdog_hangdump_appends_across_firings(tmp_path):
+    from deepspeed_tpu.runtime.resilience.watchdog import write_hangdump
+    write_hangdump(str(tmp_path), rank=0, step=1, deadline_s=0.1)
+    write_hangdump(str(tmp_path), rank=0, step=2, deadline_s=0.1)
+    text = (tmp_path / "hangdump-0.txt").read_text()
+    assert text.count("watchdog hangdump") == 2  # evidence accumulates
+
+
+# ---------------------------------------------------------------------------
+# heartbeats: beacons -> dead-host / straggler verdicts
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_roundtrip(tmp_path):
+    tr = FileHeartbeatTransport(str(tmp_path))
+    HeartbeatWriter(tr, rank=2).beat(step=17, step_time_s=0.25)
+    beacons = tr.read_all()
+    assert set(beacons) == {2}
+    assert beacons[2]["step"] == 17
+    assert beacons[2]["step_time_s"] == pytest.approx(0.25)
+    assert beacons[2]["pid"] == os.getpid()
+
+
+def test_heartbeat_ignores_corrupt_and_foreign_files(tmp_path):
+    tr = FileHeartbeatTransport(str(tmp_path))
+    HeartbeatWriter(tr, rank=0).beat(step=1, step_time_s=0.1)
+    (tmp_path / "hb-1.json").write_text("{not json")
+    (tmp_path / "hb-x.json").write_text("{}")
+    (tmp_path / "notes.txt").write_text("hi")
+    assert set(tr.read_all()) == {0}
+
+
+def test_heartbeat_dead_host_by_beacon_age(tmp_path):
+    tr = FileHeartbeatTransport(str(tmp_path))
+    now = [1000.0]
+    HeartbeatWriter(tr, rank=0, clock=lambda: now[0]).beat(0, 0.1)
+    HeartbeatWriter(tr, rank=1, clock=lambda: now[0] - 120.0).beat(0, 0.1)
+    table = HealthTable(tr, dead_after_s=60.0, clock=lambda: now[0])
+    rows = {r.rank: r for r in table.read()}
+    assert rows[0].alive and not rows[1].alive
+    assert table.verdicts()["dead"] == [1]
+
+
+def test_heartbeat_straggler_vs_fleet_median(tmp_path):
+    tr = FileHeartbeatTransport(str(tmp_path))
+    now = [50.0]
+    for rank, st in ((0, 0.10), (1, 0.11), (2, 0.09), (3, 0.50)):
+        HeartbeatWriter(tr, rank=rank, clock=lambda: now[0]).beat(5, st)
+    table = HealthTable(tr, straggler_factor=3.0, clock=lambda: now[0])
+    rows = {r.rank: r for r in table.read()}
+    assert table.verdicts()["stragglers"] == [3]
+    # leave-one-out reference: rank 3 vs median(0.10, 0.11, 0.09) = 0.10
+    assert rows[3].ratio == pytest.approx(0.50 / 0.10, rel=1e-6)
+    assert not rows[0].straggler
+
+
+def test_heartbeat_two_host_fleet_can_flag_straggler(tmp_path):
+    """Leave-one-out regression: an all-hosts median caps a 2-host
+    straggler's ratio below 2x (its own slowness drags the reference up),
+    making the default 3x threshold unreachable."""
+    tr = FileHeartbeatTransport(str(tmp_path))
+    now = [80.0]
+    HeartbeatWriter(tr, rank=0, clock=lambda: now[0]).beat(3, 0.1)
+    HeartbeatWriter(tr, rank=1, clock=lambda: now[0]).beat(3, 10.0)
+    table = HealthTable(tr, straggler_factor=3.0, clock=lambda: now[0])
+    rows = {r.rank: r for r in table.read()}
+    assert table.verdicts()["stragglers"] == [1]
+    assert rows[1].ratio == pytest.approx(100.0)  # vs the peer, not the mix
+    assert not rows[0].straggler
+
+
+def test_heartbeat_no_straggler_without_peers(tmp_path):
+    tr = FileHeartbeatTransport(str(tmp_path))
+    HeartbeatWriter(tr, rank=0).beat(1, 10.0)  # slow, but alone
+    assert HealthTable(tr).verdicts()["stragglers"] == []
+
+
+# ---------------------------------------------------------------------------
+# fault-plan extensions
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_hang_is_one_shot():
+    plan = FaultPlan(hang_at_step=4)
+    assert not plan.hang_now(3)
+    assert plan.hang_now(4) and not plan.hang_now(4)
+    assert plan.fired == [(4, "hang")]
+
+
+def test_fault_plan_slow_rank_is_steady_and_rank_gated():
+    plan = FaultPlan(slow_rank=1, slow_step_s=0.5)
+    assert plan.slow_now(0, rank=0) == 0.0
+    assert plan.slow_now(0, rank=1) == 0.5
+    assert plan.slow_now(1, rank=1) == 0.5  # NOT one-shot: steady straggler
+    assert [k for _, k in plan.fired] == ["slow"]  # audited once
+
+
+def test_fault_plan_heartbeat_loss_and_config_parse():
+    plan = FaultPlan.from_config(types.SimpleNamespace(
+        hang_at_step=9, slow_rank=2, slow_step_s=0.125,
+        heartbeat_loss_at_steps=[3, 5]))
+    assert plan.hang_at_step == 9 and plan.slow_rank == 2
+    assert plan.slow_step_s == 0.125
+    assert plan.heartbeat_lost(3) and not plan.heartbeat_lost(3)
+    assert plan.heartbeat_lost(5) and not plan.heartbeat_lost(4)
+
+
+# ---------------------------------------------------------------------------
+# launcher restart policy
+# ---------------------------------------------------------------------------
+
+
+def test_classify_exit_classes():
+    assert classify_exit(0) == "clean"
+    assert classify_exit(EXIT_PREEMPT_DRAIN) == "preempt"
+    assert classify_exit(EXIT_WATCHDOG_HANG) == "hang"
+    assert classify_exit(1) == "crash"
+    assert classify_exit(-9) == "crash"  # signal death
+    # the engine-side mirrors must agree with the launcher's table
+    assert WATCHDOG_EXIT_CODE == EXIT_WATCHDOG_HANG
+    assert PREEMPT_EXIT_CODE == EXIT_PREEMPT_DRAIN
+
+
+def _script(tmp_path, body, name="child.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return [sys.executable, str(p)]
+
+
+def test_supervise_exponential_backoff_and_crash_loop_budget(tmp_path):
+    cmd = _script(tmp_path, "import sys; sys.exit(1)")
+    sleeps = []
+    pol = RestartPolicy(max_restarts=100, min_uptime_s=60.0,
+                        backoff_base_s=1.0, backoff_max_s=8.0,
+                        jitter_frac=0.0, crash_loop_budget=3)
+    rc = _supervise(cmd, dict(os.environ), policy=pol,
+                    sleep=sleeps.append, rng=random.Random(0))
+    assert rc == 1  # the child's REAL exit code propagates
+    assert sleeps == [1.0, 2.0, 4.0]  # 3 restarts, then the budget trips
+
+
+def test_supervise_backoff_jitter_is_bounded(tmp_path):
+    cmd = _script(tmp_path, "import sys; sys.exit(1)")
+    sleeps = []
+    pol = RestartPolicy(backoff_base_s=1.0, backoff_max_s=8.0,
+                        jitter_frac=0.25, crash_loop_budget=2,
+                        min_uptime_s=60.0)
+    _supervise(cmd, dict(os.environ), policy=pol, sleep=sleeps.append,
+               rng=random.Random(7))
+    assert len(sleeps) == 2
+    assert 1.0 <= sleeps[0] <= 1.25 and 2.0 <= sleeps[1] <= 2.5
+
+
+def test_supervise_preempt_drain_not_charged(tmp_path):
+    marker = tmp_path / "runs"
+    cmd = _script(tmp_path, f"""\
+        import os, sys
+        m = {str(marker)!r}
+        runs = int(open(m).read()) if os.path.exists(m) else 0
+        open(m, 'w').write(str(runs + 1))
+        sys.exit({EXIT_PREEMPT_DRAIN} if runs < 2 else 0)
+        """)
+    sleeps = []
+    pol = RestartPolicy(crash_loop_budget=1, min_uptime_s=60.0,
+                        backoff_base_s=0.0, jitter_frac=0.0)
+    rc = _supervise(cmd, dict(os.environ), policy=pol, sleep=sleeps.append)
+    assert rc == 0  # two drains did not trip a budget of ONE
+    assert marker.read_text() == "3"
+
+
+def test_supervise_hang_exit_restarts(tmp_path):
+    marker = tmp_path / "marker"
+    cmd = _script(tmp_path, f"""\
+        import os, sys
+        m = {str(marker)!r}
+        if not os.path.exists(m):
+            open(m, 'w').close()
+            sys.exit({EXIT_WATCHDOG_HANG})
+        sys.exit(0)
+        """)
+    pol = RestartPolicy(backoff_base_s=0.0, jitter_frac=0.0)
+    rc = _supervise(cmd, dict(os.environ), policy=pol, sleep=lambda s: None)
+    assert rc == 0 and marker.exists()
+
+
+def test_supervise_total_budget_returns_real_rc(tmp_path):
+    cmd = _script(tmp_path, "import sys; sys.exit(7)")
+    pol = RestartPolicy(max_restarts=1, crash_loop_budget=99,
+                        backoff_base_s=0.0, jitter_frac=0.0,
+                        min_uptime_s=60.0)
+    rc = _supervise(cmd, dict(os.environ), policy=pol, sleep=lambda s: None)
+    assert rc == 7
+
+
+def test_supervise_legacy_keeps_fixed_backoff(tmp_path):
+    marker = tmp_path / "runs"
+    cmd = _script(tmp_path, f"""\
+        import os, sys
+        m = {str(marker)!r}
+        runs = int(open(m).read()) if os.path.exists(m) else 0
+        open(m, 'w').write(str(runs + 1))
+        sys.exit(3 if runs < 2 else 0)
+        """)
+    sleeps = []
+    rc = _supervise(cmd, dict(os.environ), max_restarts=5, min_uptime_s=0.0,
+                    backoff_s=3.0, restart_policy="legacy",
+                    sleep=sleeps.append)
+    assert rc == 0
+    assert sleeps == [3.0, 3.0]  # fixed, no classes, no jitter
+    with pytest.raises(ValueError, match="restart_policy"):
+        _supervise(cmd, dict(os.environ), restart_policy="bogus")
+
+
+def test_supervise_rescale_overrides_child_env(tmp_path):
+    out = tmp_path / "world.txt"
+    marker = tmp_path / "marker"
+    cmd = _script(tmp_path, f"""\
+        import os, sys
+        open({str(out)!r}, 'w').write(os.environ.get('DSTPU_ELASTIC_WORLD', 'unset'))
+        m = {str(marker)!r}
+        if not os.path.exists(m):
+            open(m, 'w').close()
+            sys.exit(1)
+        sys.exit(0)
+        """)
+    pol = RestartPolicy(backoff_base_s=0.0, jitter_frac=0.0)
+    calls = []
+
+    def rescale(restarts):
+        calls.append(restarts)
+        return {"DSTPU_ELASTIC_WORLD": "4"}
+
+    rc = _supervise(cmd, dict(os.environ), policy=pol, sleep=lambda s: None,
+                    rescale_fn=rescale)
+    assert rc == 0 and calls == [1]
+    assert out.read_text() == "4"  # the relaunch ran at the re-decided world
+
+
+def test_make_rescale_fn_requeries_decide_world(tmp_path, monkeypatch):
+    import json
+
+    import deepspeed_tpu.utils.health as health
+
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 12,
+                          "micro_batch_sizes": [2, 3],
+                          "min_gpus": 1, "max_gpus": 8}}
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps(cfg))
+    monkeypatch.setattr(health, "accelerator_device_count",
+                        lambda timeout_s=None: 3)
+    overrides = make_rescale_fn(str(p))(1)
+    # valid worlds for batch 12 / micro {2,3} are [1,2,3,4,6]: largest <= 3
+    assert overrides["DSTPU_ELASTIC_WORLD"] == "3"
+    assert int(overrides["DSTPU_ELASTIC_BATCH"]) % 3 == 0
+    assert overrides["TPU_VISIBLE_DEVICES"] == "0,1,2"  # local world cap
+    # non-elastic config: relaunch unchanged
+    p2 = tmp_path / "plain.json"
+    p2.write_text("{}")
+    assert make_rescale_fn(str(p2))(1) is None
+
+
+def test_elastic_env_overrides_consumed_by_finalize(monkeypatch):
+    """The rescale decision must not be inert: a relaunched engine's batch
+    triangle follows the supervisor's DSTPU_ELASTIC_BATCH/_MICRO when they
+    are consistent with the world it actually formed."""
+    from deepspeed_tpu.runtime.config import load_config
+
+    cfg_d = {"elasticity": {"enabled": True, "max_train_batch_size": 12,
+                            "micro_batch_sizes": [2, 3],
+                            "min_gpus": 1, "max_gpus": 8}}
+    monkeypatch.setenv("DSTPU_ELASTIC_BATCH", "12")
+    monkeypatch.setenv("DSTPU_ELASTIC_MICRO", "2")
+    cfg = load_config(dict(cfg_d)).finalize(world_dp_size=2)
+    assert cfg.train_batch_size == 12
+    assert cfg.train_micro_batch_size_per_gpu == 2
+    assert cfg.gradient_accumulation_steps == 3
+    # inconsistent with the actual dp world: ignored, recomputed locally
+    monkeypatch.setenv("DSTPU_ELASTIC_MICRO", "5")
+    cfg2 = load_config(dict(cfg_d)).finalize(world_dp_size=2)
+    assert cfg2.train_batch_size % (cfg2.train_micro_batch_size_per_gpu * 2) == 0
+    assert cfg2.train_micro_batch_size_per_gpu in (2, 3)
+
+
+def test_watchdog_exit_code_end_to_end_supervised_restart(tmp_path):
+    """The full drill with real processes: a child arms the (standalone,
+    jax-free) watchdog and wedges; the watchdog dumps stacks and kills it
+    with the distinctive code; the supervisor classifies the hang and the
+    relaunch completes."""
+    marker = tmp_path / "marker"
+    dump_dir = tmp_path / "dumps"
+    body = f"""\
+        import importlib.util, os, sys, time
+        spec = importlib.util.spec_from_file_location("wdmod", {WATCHDOG_PY!r})
+        wd_mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(wd_mod)
+        m = {str(marker)!r}
+        if os.path.exists(m):
+            sys.exit(0)  # the restart "resumes" and completes
+        open(m, 'w').close()
+        wd = wd_mod.StepWatchdog({str(dump_dir)!r}, floor_s=0.1, cap_s=0.4)
+        wd.arm(5)
+        time.sleep(60)  # wedged collective: never disarms
+        """
+    cmd = _script(tmp_path, body)
+    direct = subprocess.run(cmd, timeout=60)
+    assert direct.returncode == EXIT_WATCHDOG_HANG
+    dump = dump_dir / "hangdump-0.txt"
+    assert dump.exists() and "step=5" in dump.read_text()
+    marker.unlink()
+    pol = RestartPolicy(backoff_base_s=0.0, jitter_frac=0.0)
+    rc = _supervise(cmd, dict(os.environ), policy=pol, sleep=lambda s: None)
+    assert rc == 0  # hang -> restart -> clean finish
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_block_disabled_is_bit_identical(tmp_path):
+    """With resilience ON but every fleet knob at its (disabled) default,
+    stepping matches a resilience-ON config that never mentions the fleet
+    blocks — and the default-OFF engine — bitwise."""
+    batches = random_batches(4, 8, HIDDEN)
+    e_plain = _engine()
+    e_rz = _engine(tmp_path / "a", {"snapshot_interval": 0})
+    e_fleet_off = _engine(tmp_path / "b", {
+        "snapshot_interval": 0,
+        "watchdog": {"enabled": False}, "heartbeat": {"enabled": False},
+        "degraded_mode": {"enabled": False}})
+    assert e_rz.resilience.watchdog is None
+    assert e_fleet_off.resilience.heartbeat is None
+    for b in batches:
+        l0 = float(np.asarray(e_plain.train_batch(b)))
+        l1 = float(np.asarray(e_rz.train_batch(b)))
+        l2 = float(np.asarray(e_fleet_off.train_batch(b)))
+        assert l0 == l1 == l2  # bitwise, not allclose
+
+
+def test_watchdog_armed_around_engine_steps(tmp_path):
+    e = _engine(tmp_path, {"snapshot_interval": 0,
+                           "watchdog": {"enabled": True, "floor_s": 60.0,
+                                        "cap_s": 600.0}})
+    wd = e.resilience.watchdog
+    assert wd is not None
+    for b in random_batches(3, 8, HIDDEN):
+        e.train_batch(b)
+    assert len(wd._times) == 3 and not wd.fired
+    e.resilience.close()  # stops the monitor thread
+
+
+def test_hang_at_step_drill_fires_watchdog_and_dumps(tmp_path):
+    e = _engine(tmp_path, {
+        "snapshot_interval": 1,
+        "watchdog": {"enabled": True, "floor_s": 0.15, "cap_s": 2.0,
+                     "factor": 2.0},
+        "faults": {"enabled": True, "hang_at_step": 2}})
+    rz = e.resilience
+    rz.watchdog.on_expire = lambda step: rz.release_hang()
+    for b in random_batches(3, 8, HIDDEN):
+        e.train_batch(b)
+    assert rz.watchdog.fired
+    assert (2, "hang") in rz.faults.fired
+    dump = tmp_path / "hangdump-0.txt"
+    assert dump.exists() and "watchdog hangdump" in dump.read_text()
+    rz.close()
+    # the restart leg: a fresh engine on the same dir resumes from the
+    # latest snapshot instead of step 0
+    e2 = _engine(tmp_path, {"snapshot_interval": 1})
+    assert e2.global_steps > 0
+    e2.resilience.close()
+
+
+def test_exceptions_do_not_leave_watchdog_armed(tmp_path, monkeypatch):
+    """A caller-handled failure must not leave a live deadline behind: an
+    idle process after a caught exception would otherwise be killed as a
+    'hang' once the deadline expires."""
+    e = _engine(tmp_path, {"snapshot_interval": 0,
+                           "watchdog": {"enabled": True, "floor_s": 60.0}})
+    wd = e.resilience.watchdog
+    # the routine epoch-end StopIteration: never even arms
+    with pytest.raises(StopIteration):
+        e.train_batch(data_iter=iter([]))
+    with wd._cond:
+        assert wd._deadline is None
+    # a failure after arming: abort_step disarms without polluting history
+    monkeypatch.setattr(
+        e, "_shape_batch",
+        lambda b: (_ for _ in ()).throw(RuntimeError("boom")))
+    with pytest.raises(RuntimeError, match="boom"):
+        e.train_batch(random_batches(1, 8, HIDDEN)[0])
+    with wd._cond:
+        assert wd._deadline is None
+    assert len(wd._times) == 0 and not wd.fired
+    e.resilience.close()
+
+
+def test_hang_without_watchdog_is_skipped(tmp_path):
+    e = _engine(tmp_path, {"snapshot_interval": 0,
+                           "faults": {"enabled": True, "hang_at_step": 1}})
+    for b in random_batches(2, 8, HIDDEN):
+        e.train_batch(b)  # must not wedge: nothing would ever detect it
+    assert (1, "hang") in e.resilience.faults.fired
+
+
+def test_slow_rank_yields_straggler_event(tmp_path):
+    hb_dir = tmp_path / "hb"
+    e = _engine(tmp_path, {
+        "snapshot_interval": 0,
+        "heartbeat": {"enabled": True, "interval_steps": 1,
+                      "dir": str(hb_dir), "straggler_factor": 3.0},
+        "faults": {"enabled": True, "slow_rank": 0, "slow_step_s": 0.05}})
+    events = _recorder(e)
+    # two healthy peers publish fast step times into the shared table
+    tr = FileHeartbeatTransport(str(hb_dir))
+    HeartbeatWriter(tr, rank=1).beat(step=1, step_time_s=0.001)
+    HeartbeatWriter(tr, rank=2).beat(step=1, step_time_s=0.001)
+    for b in random_batches(3, 8, HIDDEN):
+        e.train_batch(b)
+    stragglers = [ev for ev in events if ev[0] == "Resilience/straggler"]
+    assert stragglers and stragglers[-1][1] == 0.0  # this rank called out
+    assert any(ev[0] == "Resilience/straggler_ratio" and ev[1] > 3.0
+               for ev in events)
+    assert e.resilience.heartbeat.beats >= 3
+
+
+def test_heartbeat_loss_suppresses_beacon(tmp_path):
+    hb_dir = tmp_path / "hb"
+    e = _engine(tmp_path, {
+        "snapshot_interval": 0,
+        "heartbeat": {"enabled": True, "interval_steps": 1,
+                      "dir": str(hb_dir)},
+        "faults": {"enabled": True, "heartbeat_loss_at_steps": [1, 2, 3]}})
+    for b in random_batches(3, 8, HIDDEN):
+        e.train_batch(b)
+    assert e.resilience.heartbeat.beats == 0  # every beacon was lost
+    assert [k for _, k in e.resilience.faults.fired] == ["heartbeat_loss"] * 3
+
+
+def test_degraded_mode_after_repeated_rollbacks(tmp_path):
+    e = _engine(tmp_path, {
+        "snapshot_interval": 1,
+        "sentinel": {"nan_streak": 1},
+        "degraded_mode": {"enabled": True, "rollback_threshold": 2,
+                          "window_s": 600.0},
+        "faults": {"enabled": True, "nan_loss_at_steps": [3, 6]}},
+        extra_cfg={"compressed_collectives": "int8"})
+    from deepspeed_tpu.comm.compressed import compression_mode
+
+    events = _recorder(e)
+    assert compression_mode() == "int8"
+    for b in random_batches(12, 8, HIDDEN):
+        e.train_batch(b)
+        if e.resilience.degraded:
+            break
+    assert e.resilience.rollbacks == 2
+    assert e.resilience.degraded
+    assert compression_mode() == "none"  # exact collectives fleet-wide
+    assert e._compressed_dp is False and e._dp_grad_impl is None
+    assert any(ev[0] == "Resilience/degraded_mode" and ev[1] == 1.0
+               for ev in events)
+    # the flag rides in snapshot meta (the restart-inheritance vehicle)
+    e.resilience.snap.wait()
+    entry = SnapshotManager(str(tmp_path)).latest_valid()
+    assert entry["meta"]["degraded_collectives"] is True
+    # training continues on the exact path after the fallback
+    loss = float(np.asarray(e.train_batch(random_batches(1, 8, HIDDEN)[0])))
+    assert np.isfinite(loss)
+
+
+def test_degraded_mode_persists_across_restart(tmp_path):
+    test_degraded_mode_after_repeated_rollbacks(tmp_path)
+    e2 = _engine(tmp_path, {"snapshot_interval": 0},
+                 extra_cfg={"compressed_collectives": "int8"})
+    from deepspeed_tpu.comm.compressed import compression_mode
+
+    # engine init configured int8 from the config, then maybe_restore saw
+    # the degraded flag in snapshot meta and re-entered degraded mode
+    assert e2.resilience.degraded
+    assert compression_mode() == "none"
+
+
+def test_degraded_mode_is_bitwise_the_exact_path(tmp_path):
+    """A degraded int8-configured engine steps bitwise identically to a
+    plain engine that never had compression — the fallback really is the
+    exact XLA collective program, not a different approximation."""
+    e = _engine(tmp_path, {"snapshot_interval": 0,
+                           "degraded_mode": {"enabled": True}},
+                extra_cfg={"compressed_collectives": "int8"})
+    e.resilience.enter_degraded(persist=False, reason="test")
+    batches = random_batches(3, 8, HIDDEN)
+    degraded = [float(np.asarray(e.train_batch(b))) for b in batches]
+    plain = _engine()
+    exact = [float(np.asarray(plain.train_batch(b))) for b in batches]
+    assert degraded == exact  # bitwise, not allclose
+
+
+def test_clear_degraded_is_operator_reescalation(tmp_path):
+    e = _engine(tmp_path, {"snapshot_interval": 0,
+                           "degraded_mode": {"enabled": True}},
+                extra_cfg={"compressed_collectives": "int8"})
+    from deepspeed_tpu.comm.compressed import compression_mode
+
+    rz = e.resilience
+    rz.enter_degraded(persist=False, reason="test")
+    assert rz.degraded and compression_mode() == "none"
+    rz.clear_degraded()
+    assert not rz.degraded
+    assert compression_mode() == "int8"  # config knobs restored
+    loss = float(np.asarray(e.train_batch(random_batches(1, 8, HIDDEN)[0])))
+    assert np.isfinite(loss)
+
+
+def test_drain_suggests_preempt_exit_code(tmp_path):
+    e = _engine(tmp_path, {"snapshot_interval": 0,
+                           "preemption": {"enabled": False}})
+    assert e.resilience.suggested_exit_code == 0
+    e.resilience.drain()
+    assert e.resilience.suggested_exit_code == PREEMPT_EXIT_CODE
+    assert e.should_stop()
+
+
+# ---------------------------------------------------------------------------
+# resumable data stream
+# ---------------------------------------------------------------------------
+
+
+def _dataset(n=40):
+    return [{"x": np.full((HIDDEN,), i, np.float32)} for i in range(n)]
+
+
+def _head(batch):
+    """Identifying scalar of a batch: the first sample's fill value."""
+    return int(np.asarray(batch["x"])[0, 0])
+
+
+def test_dataloader_state_roundtrip_matches_uninterrupted():
+    ref = DeepSpeedDataLoader(_dataset(), batch_size=4, seed=7)
+    reference = [_head(b) for _ in range(2) for b in ref]
+
+    loader = DeepSpeedDataLoader(_dataset(), batch_size=4, seed=7)
+    consumed = []
+    it = iter(loader)
+    for _ in range(7):  # mid-epoch stop (10 batches/epoch)
+        consumed.append(_head(next(it)))
+    state = loader.state_dict()
+    assert state["epoch"] == 0 and state["batch_in_epoch"] == 7
+
+    resumed = DeepSpeedDataLoader(_dataset(), batch_size=4, seed=7)
+    resumed.load_state_dict(state)
+    tail = [_head(b) for _ in range(2) for b in resumed]
+    assert consumed + tail == reference[:len(consumed) + len(tail)]
+
+
+def test_dataloader_resume_at_epoch_boundary():
+    ref = DeepSpeedDataLoader(_dataset(8), batch_size=4, seed=3)
+    reference = [_head(b) for _ in range(2) for b in ref]
+    loader = DeepSpeedDataLoader(_dataset(8), batch_size=4, seed=3)
+    first_epoch = [_head(b) for b in loader]  # full epoch
+    state = loader.state_dict()
+    assert state == {"epoch": 1, "batch_in_epoch": 0, "seed": 3,
+                     "global_step": 2}
+    resumed = DeepSpeedDataLoader(_dataset(8), batch_size=4, seed=3)
+    resumed.load_state_dict(state)
+    second_epoch = [_head(b) for b in resumed]
+    assert first_epoch + second_epoch == reference
+
+
+def test_prefetch_loader_state_accounts_for_inflight():
+    inner = DeepSpeedDataLoader(_dataset(), batch_size=4, seed=5)
+    pf = PrefetchLoader(inner, depth=3)
+    it = iter(pf)
+    consumed = [_head(next(it)) for _ in range(2)]
+    state = pf.state_dict()
+    # the wrapped loader prefetched ahead; the recorded position is what
+    # the TRAINER consumed, not what the queue drew
+    assert state["batch_in_epoch"] == 2 and state["global_step"] == 2
+    resumed = DeepSpeedDataLoader(_dataset(), batch_size=4, seed=5)
+    resumed.load_state_dict(state)
+    nxt = _head(next(iter(resumed)))
+    ref_seq = [_head(b) for b in DeepSpeedDataLoader(_dataset(),
+                                                     batch_size=4, seed=5)]
+    assert consumed + [nxt] == ref_seq[:3]
+    with pytest.raises(TypeError, match="state_dict"):
+        PrefetchLoader(iter([])).state_dict()
+
+
+def test_snapshot_meta_carries_data_state_and_restores_it(tmp_path):
+    data = [{"x": np.full((HIDDEN,), i, np.float32),
+             "y": np.full((HIDDEN,), i, np.float32)} for i in range(64)]
+    cfg = {"train_micro_batch_size_per_gpu": 8,
+           "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 1000, "seed": 42,
+           "resilience": {"enabled": True, "snapshot_dir": str(tmp_path),
+                          "snapshot_interval": 0}}
+    engine, _, loader, _ = ds.initialize(
+        model=simple_loss, model_parameters=make_simple_params(HIDDEN),
+        config=cfg, training_data=data)
+    it = iter(loader)
+    for _ in range(3):
+        next(it)
+    engine.resilience.take_snapshot()
+    engine.resilience.snap.wait()
+    entry = SnapshotManager(str(tmp_path)).latest_valid()
+    assert entry["meta"]["data_state"]["batch_in_epoch"] == 3
+
+    engine2, _, loader2, _ = ds.initialize(
+        model=simple_loss, model_parameters=make_simple_params(HIDDEN),
+        config=cfg, training_data=data)
+    # maybe_restore stashed the data state; initialize() registered the
+    # fresh loader, which fast-forwards to the recorded position
+    assert loader2._resume_offset == 3
+    ref = iter(DeepSpeedDataLoader(data, batch_size=8, seed=0))
+    for _ in range(3):
+        next(ref)
+    np.testing.assert_array_equal(next(iter(loader2))["x"], next(ref)["x"])
+
+
+# ---------------------------------------------------------------------------
+# health-probe timeout env (DSTPU_HEALTH_TIMEOUT)
+# ---------------------------------------------------------------------------
+
+
+def test_health_zero_timeout_reports_unhealthy_fast(monkeypatch):
+    from deepspeed_tpu.utils.health import (accelerator_device_count,
+                                            accelerator_healthy)
+
+    t0 = time.perf_counter()
+    assert accelerator_healthy(0) is False
+    assert accelerator_device_count(0) == 0
+    monkeypatch.setenv("DSTPU_HEALTH_TIMEOUT", "0")
+    assert accelerator_healthy() is False  # env-resolved default
+    assert accelerator_device_count() == 0
+    assert time.perf_counter() - t0 < 5.0  # no probe spawned, no hang
+
+
+def test_health_timeout_env_parsing(monkeypatch):
+    from deepspeed_tpu.utils.health import health_timeout_s
+
+    monkeypatch.delenv("DSTPU_HEALTH_TIMEOUT", raising=False)
+    assert health_timeout_s() == 180.0
+    monkeypatch.setenv("DSTPU_HEALTH_TIMEOUT", "12.5")
+    assert health_timeout_s() == 12.5
+    monkeypatch.setenv("DSTPU_HEALTH_TIMEOUT", "garbage")
+    assert health_timeout_s() == 180.0  # unparseable: fall back, don't crash
