@@ -1011,12 +1011,87 @@ def watchdog_bench():
             "device": jax.devices()[0].platform}
 
 
+def fused_hotpath_bench():
+    """Rung fl (fused training hot path, ISSUE 6): time the XLA loss
+    epilogue — full-vocab fp32 logits materialized, then CE — against the
+    Pallas fused LM loss (ops/pallas/fused_loss.py), and the XLA attention
+    against the flash kernel, both fwd+bwd (the training direction). On a
+    real TPU the ratios are HBM traffic actually removed from the step; on
+    CPU the kernels run in interpret mode, so the row documents wiring
+    parity and the ledger, not speed."""
+    from deepspeed_tpu.models.transformer import attention_core
+    from deepspeed_tpu.sequence.cross_entropy import sharded_lm_loss
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    rng = np.random.default_rng(0)
+    if on_tpu:
+        # the headline bench's loss shape: batch 6 x seq 2048 x vocab 32000
+        B, S, E, V = 6, 2048, 1536, 32000
+        AB, AS, AH, AHK, AD = 6, 2048, 12, 12, 128
+        dtype, repeats = jnp.bfloat16, 3
+    else:
+        B, S, E, V = 2, 64, 32, 256
+        AB, AS, AH, AHK, AD = 1, 256, 4, 2, 32
+        dtype, repeats = jnp.float32, 1
+
+    def timed(fn, *args):
+        jax.block_until_ready(fn(*args))  # compile
+        best = float("inf")
+        for _ in range(max(1, repeats)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # -- loss: fwd+bwd wrt hidden and head kernel --------------------------
+    hidden = jnp.asarray(rng.normal(size=(B, S, E)) * 0.1, dtype)
+    kernel = jnp.asarray(rng.normal(size=(E, V)) * 0.02, dtype)
+    tokens = jnp.asarray(rng.integers(0, V, (B, S)), jnp.int32)
+
+    def loss_fn(impl):
+        def f(h, k):
+            return sharded_lm_loss(h, k, tokens, loss_impl=impl)
+        return jax.jit(jax.value_and_grad(f, argnums=(0, 1)))
+
+    t_loss_xla = timed(loss_fn("xla"), hidden, kernel)
+    t_loss_fused = timed(loss_fn("fused"), hidden, kernel)
+
+    # -- attention: fwd+bwd, GQA + explicit sm_scale -----------------------
+    q = jnp.asarray(rng.normal(size=(AB, AS, AH, AD)) * 0.1, dtype)
+    k = jnp.asarray(rng.normal(size=(AB, AS, AHK, AD)) * 0.1, dtype)
+    v = jnp.asarray(rng.normal(size=(AB, AS, AHK, AD)) * 0.1, dtype)
+
+    def attn_fn(impl):
+        def f(q_, k_, v_):
+            out = attention_core(q_, k_, v_, causal=True, impl=impl)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+        return jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+
+    t_attn_xla = timed(attn_fn("xla"), q, k, v)
+    t_attn_flash = timed(attn_fn("flash"), q, k, v)
+
+    logits_mb = B * S * V * 4 / 2**20  # the tensor the fused loss deletes
+    return {"metric": "fused_hotpath_loss_speedup",
+            "value": round(t_loss_xla / t_loss_fused, 4), "unit": "ratio",
+            "vs_baseline": None,
+            "attn_flash_speedup": round(t_attn_xla / t_attn_flash, 4),
+            "t_loss_xla_s": round(t_loss_xla, 6),
+            "t_loss_fused_s": round(t_loss_fused, 6),
+            "t_attn_xla_s": round(t_attn_xla, 6),
+            "t_attn_flash_s": round(t_attn_flash, 6),
+            "loss_shape": {"B": B, "S": S, "E": E, "V": V},
+            "attn_shape": {"B": AB, "S": AS, "H": AH, "Hk": AHK, "D": AD},
+            "logits_mb_removed": round(logits_mb, 1),
+            "device": getattr(dev, "device_kind", dev.platform)}
+
+
 RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "3b": rung3b_big_model,
          "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses,
          "cm": collective_matmul_bench, "qx": quantized_collectives_bench,
          "plan": planner_bench, "rz": resilience_bench,
-         "wd": watchdog_bench}
+         "wd": watchdog_bench, "fl": fused_hotpath_bench}
 
 
 def _with_ledger(fn):
@@ -1061,7 +1136,7 @@ def run_ladder():
             ("cm", {} if multichip else cpu8),
             ("qx", {} if multichip else cpu8),
             ("plan", {} if multichip else cpu8),
-            ("rz", chip), ("wd", cpu1)]
+            ("rz", chip), ("wd", cpu1), ("fl", chip)]
     results = []
     for rung, env_over in plan:
         env = dict(os.environ)

@@ -42,10 +42,15 @@ OP_MENU: Dict[str, Tuple[str, ...]] = {
     "reduce_scatter": ("xla", "ring", "int8", "int8_sr"),
     "all_to_all": ("xla", "int8"),
     "gather_matmul": ("xla", "fused_matmul"),
+    # the vocab-sharded embedding table gather (shape = the per-rank table
+    # shard): xla is all_gather(table) + take, ring/bidir_ring hide the
+    # chunk hops behind the resident chunk's row lookups
+    # (ops/collective_matmul.py ring_embedding_gather / ring_tied_lm_head)
+    "embed_gather": ("xla", "ring", "bidir_ring"),
 }
 
-# the five wired consumers (ISSUE 3 vocabulary)
-CONSUMERS = ("tp-linear", "ulysses", "moe-a2a", "dp-grad", "zeropp")
+# the wired consumers (PR 3's five + the PR 6 embedding site)
+CONSUMERS = ("tp-linear", "ulysses", "moe-a2a", "dp-grad", "zeropp", "embed")
 
 # consumers whose payload is a gradient: stochastic rounding is admissible
 # (unbiased compression matters there); activation exchanges keep nearest

@@ -133,6 +133,24 @@ def build_probe(site: CollectiveSite, impl: str, *, mesh=None,
             else:
                 raise ValueError(impl)
             return out.reshape(-1)
+        elif site.op == "embed_gather":
+            # the vocab-sharded embedding site: per-rank table shard of
+            # n/(128*p) rows x 128 lanes, a fixed probe token set
+            e = 128
+            rows = max(8, n // (e * p))
+            tab = v[:rows * e].reshape(rows, e)
+            tok = (lax.iota(jnp.int32, 128) * 131) % (rows * p)
+            if impl == "xla":
+                full = lax.all_gather(tab, names[0], axis=0, tiled=True)
+                out = jnp.take(full, tok, axis=0)
+            elif impl in ("ring", "bidir_ring"):
+                from ...ops.collective_matmul import ring_embedding_gather
+
+                out = ring_embedding_gather(tok, tab, names[0],
+                                            bidirectional=impl == "bidir_ring")
+            else:
+                raise ValueError(impl)
+            return jnp.tile(out.reshape(-1), -(-n // out.size))[:n]
         elif site.op == "gather_matmul":
             # activation gather + projection, the TP-linear shape: the probe
             # matmul is deliberately small so the collective dominates on

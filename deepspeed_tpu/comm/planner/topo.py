@@ -178,8 +178,12 @@ class CostModel:
                     + 2 * n * q * (p_out - 1) / p_out * outer.beta \
                     + 2 * n * self.quant_cost + 2 * self.quant_fixed
                 return t
-        elif site.op == "all_gather":
-            # site.shape is the local shard; (p-1)*n bytes ride per rank
+        elif site.op in ("all_gather", "embed_gather"):
+            # site.shape is the local shard; (p-1)*n bytes ride per rank.
+            # embed_gather (the vocab-sharded table ring) has the same wire
+            # profile — its menu simply has no int8 arm, and ring means the
+            # chunk hops hide behind the resident chunk's row lookups
+            # (ops/collective_matmul.py ring_embedding_gather)
             if impl == "xla":
                 return hops * lp.alpha + hops * n * lp.beta
             if impl == "ring":

@@ -92,6 +92,15 @@ class TransformerConfig:
     sequence_parallel: bool = False             # SP over the 'sp' axis
     sp_impl: str = "ulysses"                    # ulysses (all-to-all) | ring
     attn_impl: str = "auto"                     # auto | xla | flash (pallas)
+    # Pallas fused LM loss (ops/pallas/fused_loss.py): the lm-head matmul +
+    # online-softmax + NLL run blockwise so [B, S, V] logits never
+    # materialize; 'auto' defers to the training_fastpath fleet knob then
+    # the accelerator heuristic (docs/training_fastpath.md)
+    loss_impl: str = "auto"                     # auto | xla | fused
+    # ring-overlapped vocab-sharded embedding gather + tied lm head
+    # (ops/collective_matmul.py): 'auto' lets the collective planner pick
+    # ring vs xla per topology; 'ring' forces it where structurally possible
+    embed_overlap: str = "auto"                 # auto | xla | ring
     # ring-overlapped collective matmul (ops/collective_matmul.py): run the
     # column/row-parallel linears (and the Ulysses projection exchange) as
     # shard_map rings that hide the tp/sp collective behind the partial
@@ -207,38 +216,75 @@ def alibi_slopes(num_heads: int, bf16_round: bool = True) -> np.ndarray:
     return slopes.astype(ml_dtypes.bfloat16).astype(np.float32)
 
 
+_FLASH_FALLBACK_WARNED = set()
+
+
+def _warn_flash_fallback(reason: str) -> None:
+    """One-time notice when ``attn_impl: flash`` was requested but a feature
+    the Pallas kernel doesn't take forces the XLA path — silent degradation
+    was the r2-r5 failure mode that kept real configs off the kernel."""
+    if reason in _FLASH_FALLBACK_WARNED:
+        return
+    _FLASH_FALLBACK_WARNED.add(reason)
+    from ..utils.logging import logger
+
+    logger.warning(
+        f"attn_impl=flash requested but {reason} is unsupported by the "
+        f"Pallas flash kernel — using the XLA attention for these call "
+        f"sites (one-time notice)")
+
+
 def attention_core(q, k, v, *, causal: bool = True, impl: str = "auto",
                    positions_q=None, positions_kv=None, alibi=None,
                    scale=None, window=None, alibi_post_scale=False):
-    """[B, S, H, D] attention. ``flash`` uses the Pallas kernel on TPU;
-    ``xla`` is the jnp reference (fused well by XLA on small shapes).
+    """[B, S, H, D] attention. ``flash`` uses the Pallas kernel on TPU
+    (native GQA + ``sm_scale`` — kv heads are never repeat-materialized);
+    ``xla`` is the jnp reference (fused well by XLA on small shapes), which
+    also indexes kv heads directly via a grouped einsum under GQA.
     ``alibi``: per-head slopes [H] — adds ``-slope * (pos_q - pos_k)`` to the
     logits (Press et al.; reference bloom/falcon containers).
     ``scale``: logits multiplier (default 1/sqrt(d); gpt-neo uses 1.0).
     ``window``: local attention — key j visible iff q_pos - j < window."""
-    if impl == "flash" and alibi is None and scale is None and window is None:
-        from ..ops.pallas.flash_attention import flash_attention
+    if impl == "flash":
+        if alibi is None and window is None:
+            from ..ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal)
+            return flash_attention(q, k, v, causal=causal, sm_scale=scale)
+        _warn_flash_fallback("an ALiBi bias" if alibi is not None
+                             else "a local attention window")
     b, sq, h, d = q.shape
-    skv = k.shape[1]
-    # GQA: repeat kv heads
-    if k.shape[2] != h:
-        rep = h // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    skv, hk = k.shape[1], k.shape[2]
     scale = (1.0 / np.sqrt(d)) if scale is None else float(scale)
+    pq = positions_q if positions_q is not None else jnp.arange(sq)[:, None]
+    pk = positions_kv if positions_kv is not None else jnp.arange(skv)[None, :]
+    # falcon/bloom apply the alibi bias BEFORE the 1/sqrt(d) scaling (HF
+    # modeling_falcon.py: (scores + alibi) * inv_norm_factor) — fold the
+    # scale into the slope to match; MPT adds the raw slope AFTER scaling
+    sl_factor = 1.0 if alibi_post_scale else scale
+    if hk != h:
+        # GQA without materializing repeated kv heads: group the q heads per
+        # kv head (the cached_attention layout) so the kv operands stream at
+        # their true size — logits [b, hk, rep, sq, skv]
+        rep = h // hk
+        qg = q.reshape(b, sq, hk, rep, d)
+        logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k,
+                            preferred_element_type=jnp.float32) * scale
+        if alibi is not None:
+            dist = (pq - pk).astype(jnp.float32)             # [sq, skv]
+            sl = (sl_factor * jnp.asarray(alibi)).reshape(hk, rep)
+            logits = logits - sl[None, :, :, None, None] * dist[None, None, None]
+        if causal:
+            mask = pq >= pk
+            if window is not None:
+                mask = mask & (pq - pk < window)
+            logits = jnp.where(mask[None, None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v)
+        return out.reshape(b, sq, h, d)
     # fp32 accumulation off the MXU (free on TPU), so softmax sees full precision
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
-    pq = positions_q if positions_q is not None else jnp.arange(sq)[:, None]
-    pk = positions_kv if positions_kv is not None else jnp.arange(skv)[None, :]
     if alibi is not None:
-        # falcon/bloom apply the bias BEFORE the 1/sqrt(d) scaling (HF
-        # modeling_falcon.py: (scores + alibi) * inv_norm_factor) — fold the
-        # scale into the slope to match; MPT adds the raw slope AFTER
-        # scaling (modeling_mpt: qk * softmax_scale + alibi)
-        sl_factor = 1.0 if alibi_post_scale else scale
         dist = (pq - pk).astype(jnp.float32)                 # [sq, skv]
         logits = logits - (sl_factor * jnp.asarray(alibi))[None, :, None, None] * dist[None, None]
     if causal:
@@ -379,6 +425,52 @@ def _overlap_ctx(cfg, x, mod):
     return topo
 
 
+def _embed_ring_ctx(cfg, mod, batch_size):
+    """The live topology when the ring-overlapped embedding paths could
+    engage, else None. The ring runs the Megatron VocabParallelEmbedding
+    layout over tp: the table circulates in ppermute chunks while the
+    resident chunk's lookups (or the tied head's chunk matmuls) execute
+    (ops/collective_matmul.py). Resolution: model field > fleet knob
+    (training_fastpath.embedding_overlap) > planner per-site decision."""
+    if mod.is_initializing():
+        return None
+    if "embed" not in mod.variables.get("params", {}):
+        return None
+    impl = cfg.embed_overlap
+    if impl == "auto":
+        from ..ops.fastpath import fastpath
+
+        impl = fastpath("embedding_overlap")
+    if impl == "xla":
+        return None
+    from ..utils.shard_map_compat import manual_axes
+
+    if manual_axes():
+        return None  # already inside a manual region: stay declarative
+    from ..parallel.topology import get_topology
+
+    topo = get_topology()
+    from ..ops.collective_matmul import embedding_overlap_ready
+
+    if not embedding_overlap_ready(topo.tp_size, cfg.vocab_size):
+        return None
+    if batch_size % topo.axis_size(*topo.dp_axes):
+        return None
+    if impl == "auto":
+        # planner site: ring vs xla is a per-topology call (PR 3)
+        from ..comm.planner import planner_active, resolve_site
+
+        if not planner_active():
+            return None
+        d = resolve_site(op="embed_gather",
+                         shape=(cfg.vocab_size // topo.tp_size,
+                                cfg.hidden_size),
+                         dtype=cfg.dtype, axes=("tp",), consumer="embed")
+        if d.impl not in ("ring", "bidir_ring"):
+            return None
+    return topo
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
     window: Optional[int] = None   # gpt-neo per-layer local attention
@@ -476,13 +568,17 @@ class Attention(nn.Module):
 
         impl = cfg.attn_impl
         if impl == "auto":
+            from ..ops.fastpath import fastpath
+
+            impl = fastpath("attn_impl")
+        if impl == "auto":
             # flash on real accelerators when the seq tiles cleanly; the XLA
-            # reference (O(S^2) logits) on CPU tests, odd shapes, and alibi
-            # (the flash kernel takes no additive bias)
+            # reference (O(S^2) logits) on CPU tests, odd shapes, and alibi/
+            # window (the flash kernel takes no additive bias). An explicit
+            # sm_scale no longer disqualifies — the kernel takes it.
             seq = x.shape[1]
             impl = "flash" if (jax.default_backend() != "cpu" and seq % 128 == 0
-                               and alibi is None and scale is None
-                               and window is None) else "xla"
+                               and alibi is None and window is None) else "xla"
 
         # Ulysses only in real execution: flax init traces tiny batches that
         # need not divide the mesh, and attention adds no params anyway.
@@ -779,7 +875,13 @@ class TransformerLM(nn.Module):
         cfg = self.cfg
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                          param_dtype=jnp.float32, name="embed")
-        x = embed(tokens)
+        x = None
+        if cache is None and window is None and tokens.ndim == 2:
+            # training path: ring-overlapped vocab-sharded gather when the
+            # knob/planner picks it (decode paths stay declarative)
+            x = self._embed_table_ring(tokens)
+        if x is None:
+            x = embed(tokens)
         if cfg.embed_norm:  # bloom word_embeddings_layernorm
             x = _norm(cfg, "embed_norm")(x)
         if cfg.position == "learned":
@@ -817,7 +919,11 @@ class TransformerLM(nn.Module):
         if cfg.no_lm_head or return_hidden:  # clip text / vocab-parallel loss
             return (x, new_cache) if (cache is not None or window is not None) else x
         if cfg.tie_embeddings:
-            logits = embed.attend(x.astype(jnp.float32))
+            logits = None
+            if cache is None and window is None:
+                logits = self._tied_head_ring(x)  # the gather's transpose
+            if logits is None:
+                logits = embed.attend(x.astype(jnp.float32))
         else:
             logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias,
                               dtype=jnp.float32,
@@ -825,6 +931,56 @@ class TransformerLM(nn.Module):
         if cache is not None or window is not None:
             return logits, new_cache
         return logits
+
+    # -- ring-overlapped embedding paths (ops/collective_matmul.py) --------
+
+    def _embed_table_ring(self, tokens):
+        """[B, S] -> [B, S, E] via ring_embedding_gather, or None when the
+        knob/planner/topology says the declarative gather stays."""
+        cfg = self.cfg
+        topo = _embed_ring_ctx(cfg, self, tokens.shape[0])
+        if topo is None:
+            return None
+        from ..ops.collective_matmul import ring_embedding_gather
+        from ..parallel.topology import TP_AXIS
+        from ..utils.shard_map_compat import shard_map_nocheck
+
+        table = self.variables["params"]["embed"]["embedding"]
+        dp = topo.dp_axes
+
+        def body(tok, tab):
+            return ring_embedding_gather(tok, tab, TP_AXIS)
+
+        return shard_map_nocheck(body, topo.mesh,
+                                 (P(dp, None), P(TP_AXIS, None)),
+                                 P(dp, None, None))(
+                                     tokens, table.astype(cfg.dtype))
+
+    def _tied_head_ring(self, x):
+        """Tied lm head as the embedding ring's transpose: logits [.., V]
+        from the vocab-sharded table via ring_tied_lm_head, or None."""
+        cfg = self.cfg
+        if x.ndim != 3:
+            return None
+        topo = _embed_ring_ctx(cfg, self, x.shape[0])
+        if topo is None:
+            return None
+        from ..ops.collective_matmul import ring_tied_lm_head
+        from ..parallel.topology import TP_AXIS
+        from ..utils.shard_map_compat import shard_map_nocheck
+
+        table = self.variables["params"]["embed"]["embedding"]
+        dp = topo.dp_axes
+
+        def body(x_, tab):
+            return ring_tied_lm_head(x_, tab, TP_AXIS)
+
+        # operands in cfg.dtype — nn.Embed.attend's promote_dtype convention
+        return shard_map_nocheck(body, topo.mesh,
+                                 (P(dp, None, None), P(TP_AXIS, None)),
+                                 P(dp, None, None))(
+                                     x.astype(cfg.dtype),
+                                     table.astype(cfg.dtype))
 
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: Optional[int] = None,
@@ -882,18 +1038,37 @@ def make_loss_fn(model: TransformerLM):
         head = params["lm_head"]
         return head["kernel"], head.get("bias")
 
-    def _ce(out, params, tokens, mask):
+    def _headless():
+        """True when the loss should consume hidden states + the head kernel
+        (never materializing [B, S, V] logits): the vocab-parallel knob, or
+        the fused Pallas loss resolving active (docs/training_fastpath.md).
+        Evaluated at trace time so the fleet knob set by initialize() is
+        seen; tp > 1 without vocab_parallel_loss keeps the dense path (the
+        vocab may not shard)."""
         if cfg.vocab_parallel_loss:
+            return True
+        if cfg.no_lm_head or cfg.lm_head_bias:
+            return False
+        from ..parallel.topology import get_topology
+        from ..sequence.cross_entropy import resolve_loss_impl
+
+        if get_topology().tp_size != 1:
+            return False
+        return resolve_loss_impl(cfg.loss_impl, cfg.vocab_size) == "fused"
+
+    def _ce(out, params, tokens, mask, headless):
+        if headless:
             from ..sequence.cross_entropy import sharded_lm_loss
             kernel, bias = _head_kernel_bias(params)
             return sharded_lm_loss(out, kernel, tokens, loss_mask=mask,
-                                   head_bias=bias)
+                                   head_bias=bias, loss_impl=cfg.loss_impl)
         return causal_lm_loss(out, tokens, mask)
 
     def loss_fn(params, batch, rng=None):
         tokens = batch["tokens"] if isinstance(batch, dict) else batch
         mask = batch.get("loss_mask") if isinstance(batch, dict) else None
-        kwargs = {"return_hidden": True} if cfg.vocab_parallel_loss else {}
+        headless = _headless()
+        kwargs = {"return_hidden": True} if headless else {}
         deterministic = True
         if rng is not None and cfg.dropout > 0:
             kwargs["rngs"] = {"dropout": rng}
@@ -906,9 +1081,9 @@ def make_loss_fn(model: TransformerLM):
             aux_losses = [leaf for path, leaf in flat
                           if any("moe_aux_loss" in str(getattr(e, "key", e)) for e in path)]
             aux = sum(aux_losses) / max(len(aux_losses), 1) if aux_losses else 0.0
-            return _ce(out, params, tokens, mask) + aux
+            return _ce(out, params, tokens, mask, headless) + aux
         out = model.apply({"params": params}, tokens, deterministic=deterministic, **kwargs)
-        return _ce(out, params, tokens, mask)
+        return _ce(out, params, tokens, mask, headless)
 
     return loss_fn
 

@@ -47,6 +47,8 @@ from jax import lax
 __all__ = [
     "all_gather_matmul", "matmul_reduce_scatter",
     "ring_all_gather", "ring_reduce_scatter",
+    "ring_embedding_gather", "ring_tied_lm_head",
+    "embedding_overlap_ready",
     "overlap_ready", "overlap_enabled", "set_overlap_enabled",
 ]
 
@@ -320,6 +322,186 @@ def ring_all_gather(x, axis, *, bidirectional: bool = False):
             out = lax.dynamic_update_slice_in_dim(out, buf_b,
                                                   ((idx + s) % p) * m, axis=0)
     return out
+
+
+def embedding_overlap_ready(axis_size: int, vocab: int) -> bool:
+    """True when the ring embedding paths apply: a real axis and a vocab
+    that shards evenly over it (Megatron VocabParallelEmbedding layout)."""
+    return axis_size > 1 and vocab % axis_size == 0
+
+
+def _chunk_lookup(chunk, j, tok):
+    """Rows of ``chunk`` (vocab block ``j``) for the tokens that live in it;
+    zeros elsewhere — summing over all ring steps resolves every token."""
+    vloc = chunk.shape[0]
+    rel = tok - j * vloc
+    hit = (rel >= 0) & (rel < vloc)
+    rows = jnp.take(chunk, jnp.clip(rel, 0, vloc - 1), axis=0)
+    return jnp.where(hit[..., None], rows, jnp.zeros((), chunk.dtype))
+
+
+def ring_embedding_gather(tokens, table, axis, *, bidirectional: bool = False):
+    """Sharded embedding lookup with the table ring hidden behind the gather.
+
+    Call inside ``shard_map``. ``tokens: [...]`` int32 GLOBAL ids
+    (replicated over ``axis``), ``table: [V/p, E]`` this rank's contiguous
+    vocab shard (shard ``i`` covers ids ``[i*V/p, (i+1)*V/p)``) →
+    ``[..., E]``, replicated over ``axis``. Instead of all-gathering the
+    table and then gathering rows (two serial phases, ICI idle during the
+    lookup), the table circulates in ``p-1`` ``ppermute`` chunk hops while
+    each resident chunk's row lookups run — the T3 overlap applied to the
+    input-embedding collective the headline MFU now includes.
+
+    Differentiable: the cotangent of the output is replicated over the ring
+    axis (every rank walked every chunk), so the transpose needs NO
+    collective — each rank masked-scatter-adds its local rows into its own
+    shard, and shard_map's replicated-input transpose supplies the
+    data-parallel psum. Falls back to ``all_gather`` + take for non-string
+    axes and axis size 1.
+    """
+    if not isinstance(axis, str):
+        full = lax.all_gather(table, axis, axis=0, tiled=True)
+        return jnp.take(full, tokens, axis=0)
+    p = _axis_size(axis)
+    if p == 1:
+        return jnp.take(table, tokens, axis=0)
+    vloc, e = table.shape
+    tdtype = table.dtype
+
+    def impl(tok, tab):
+        idx = lax.axis_index(axis)
+        _log_ring("ring_embed_gather", (p - 1) * _nbytes(tab))
+        out = _chunk_lookup(tab, idx, tok)
+        if not bidirectional:
+            buf = tab
+            for s in range(1, p):
+                buf = lax.ppermute(buf, axis, _fwd_perm(p))
+                out = out + _chunk_lookup(buf, (idx - s) % p, tok)
+            return out
+        n_f, n_b = (p - 1 + 1) // 2, (p - 1) // 2
+        buf_f = buf_b = tab
+        for s in range(1, n_f + 1):
+            buf_f = lax.ppermute(buf_f, axis, _fwd_perm(p))
+            out = out + _chunk_lookup(buf_f, (idx - s) % p, tok)
+            if s <= n_b:
+                buf_b = lax.ppermute(buf_b, axis, _bwd_perm(p))
+                out = out + _chunk_lookup(buf_b, (idx + s) % p, tok)
+        return out
+
+    @jax.custom_vjp
+    def gather(tok, tab):
+        return impl(tok, tab)
+
+    def fwd(tok, tab):
+        return impl(tok, tab), tok
+
+    def bwd(tok, dy):
+        # the output is replicated over the ring axis, so shard_map's
+        # conservative (check_rep=False) transpose hands each rank 1/p of
+        # the true cotangent — psum restores it. The table cotangent is a
+        # SHARDED input's: this rank's value IS the shard gradient, so it
+        # must carry the full sum; the scatter itself is purely local.
+        dy = lax.psum(dy, axis)
+        idx = lax.axis_index(axis)
+        rel = tok.reshape(-1) - idx * vloc
+        hit = (rel >= 0) & (rel < vloc)
+        contrib = jnp.where(hit[:, None], dy.reshape(-1, e), 0.0)
+        dtab = jnp.zeros((vloc, e), dy.dtype).at[
+            jnp.clip(rel, 0, vloc - 1)].add(contrib)
+        return (np.zeros(tok.shape, jax.dtypes.float0),
+                dtab.astype(tdtype))
+
+    gather.defvjp(fwd, bwd)
+    return gather(tokens, table)
+
+
+def ring_tied_lm_head(x, table, axis, *, bidirectional: bool = False):
+    """``x @ all_gather(table).T`` with the table ring hidden behind the
+    per-chunk matmuls — the transpose consumer of the embedding ring, for
+    the tied-embedding lm head (``TransformerLM`` ``embed.attend``).
+
+    Call inside ``shard_map``. ``x: [..., E]`` (replicated over ``axis``),
+    ``table: [V/p, E]`` this rank's vocab shard → logits ``[..., V]``
+    replicated over ``axis``: each ring step computes the resident chunk's
+    column block while the next chunk's permute is in flight.
+
+    Differentiable: ``dx`` re-walks the same ring consuming the matching
+    cotangent columns; ``dtable`` is the local column block's outer product
+    (the cotangent is replicated over the ring axis, so no collective —
+    shard_map's transpose supplies the batch psum).
+    """
+    if not isinstance(axis, str):
+        full = lax.all_gather(table, axis, axis=0, tiled=True)
+        return jnp.einsum("...e,ve->...v", x, full)
+    p = _axis_size(axis)
+    if p == 1:
+        return jnp.einsum("...e,ve->...v", x, table)
+    vloc = table.shape[0]
+
+    def put(o, val, j):
+        return lax.dynamic_update_slice_in_dim(o, val, j * vloc, axis=-1)
+
+    def impl(x_, tab):
+        idx = lax.axis_index(axis)
+        _log_ring("ring_tied_lm_head", (p - 1) * _nbytes(tab))
+        out = jnp.zeros(x_.shape[:-1] + (p * vloc,), jnp.result_type(x_, tab))
+        out = put(out, jnp.einsum("...e,ve->...v", x_, tab), idx)
+        if not bidirectional:
+            buf = tab
+            for s in range(1, p):
+                buf = lax.ppermute(buf, axis, _fwd_perm(p))
+                out = put(out, jnp.einsum("...e,ve->...v", x_, buf),
+                          (idx - s) % p)
+            return out
+        n_f, n_b = (p - 1 + 1) // 2, (p - 1) // 2
+        buf_f = buf_b = tab
+        for s in range(1, n_f + 1):
+            buf_f = lax.ppermute(buf_f, axis, _fwd_perm(p))
+            out = put(out, jnp.einsum("...e,ve->...v", x_, buf_f),
+                      (idx - s) % p)
+            if s <= n_b:
+                buf_b = lax.ppermute(buf_b, axis, _bwd_perm(p))
+                out = put(out, jnp.einsum("...e,ve->...v", x_, buf_b),
+                          (idx + s) % p)
+        return out
+
+    @jax.custom_vjp
+    def tied(x_, tab):
+        return impl(x_, tab)
+
+    def fwd(x_, tab):
+        return impl(x_, tab), (x_, tab)
+
+    def bwd(res, dy):
+        x_, tab = res
+        idx = lax.axis_index(axis)
+        _log_ring("ring_tied_lm_head_bwd", (p - 1) * _nbytes(tab))
+
+        def take(d, j):
+            return lax.dynamic_slice_in_dim(d, j * vloc, vloc, axis=-1)
+
+        # dx: x is a REPLICATED input, whose transpose psums the per-rank
+        # contributions over the axis — so each rank walks the ring with
+        # its (1/p-scaled, check_rep=False convention) local cotangent and
+        # the psum restores the total
+        dx = jnp.einsum("...v,ve->...e", take(dy, idx), tab)
+        buf = tab
+        for s in range(1, p):
+            buf = lax.ppermute(buf, axis, _fwd_perm(p))
+            dx = dx + jnp.einsum("...v,ve->...e", take(dy, (idx - s) % p),
+                                 buf)
+        # dtab: a SHARDED input — this rank's value IS the shard gradient,
+        # so the cotangent must carry the full cross-rank sum. Each rank only
+        # consumes its own V/p column block of that sum, so a tiled
+        # psum_scatter (rank r keeps summed chunk r = this rank's idx) moves
+        # 1/p of the bytes a full-vocab psum would
+        dy_blk = lax.psum_scatter(dy, axis, scatter_dimension=dy.ndim - 1,
+                                  tiled=True)
+        dtab = jnp.einsum("...v,...e->ve", dy_blk, x_)
+        return dx.astype(x_.dtype), dtab.astype(tab.dtype)
+
+    tied.defvjp(fwd, bwd)
+    return tied(x, table)
 
 
 def ring_reduce_scatter(x, axis):

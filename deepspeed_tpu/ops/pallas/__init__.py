@@ -1,5 +1,6 @@
 from .flash_attention import flash_attention
 from .fused_adam import adam_update
+from .fused_loss import fused_loss_ready, fused_vocab_nll
 from .paged_attention import paged_attention
 from .quant import dequantize_int8, quantize_int8
 from .sparse_attention import (bigbird_layout, bslongformer_layout,
@@ -7,7 +8,8 @@ from .sparse_attention import (bigbird_layout, bslongformer_layout,
                                local_sliding_window_layout, sparse_attention,
                                variable_layout)
 
-__all__ = ["flash_attention", "paged_attention", "sparse_attention",
+__all__ = ["flash_attention", "fused_vocab_nll", "fused_loss_ready",
+           "paged_attention", "sparse_attention",
            "fixed_layout", "bigbird_layout", "bslongformer_layout",
            "variable_layout", "local_sliding_window_layout",
            "causal_layout", "adam_update", "quantize_int8", "dequantize_int8"]

@@ -243,6 +243,27 @@ class CompressedCollectivesConfig(ConfigModel):
 
 @register_config
 @dataclass
+class TrainingFastpathConfig(ConfigModel):
+    """Fused training hot path (``ops/fastpath.py`` fleet knobs).
+
+    ``attn_impl``: ``auto`` (flash on a real accelerator for eligible
+    shapes), ``flash`` (force the Pallas kernel; alibi/window sites warn
+    once and fall back), or ``xla`` (the reference attention everywhere).
+    ``loss_impl``: ``auto`` / ``fused`` (Pallas online-softmax LM loss,
+    ``ops/pallas/fused_loss.py`` — the ``[B, S, V]`` logits tensor is never
+    materialized) / ``xla``. ``embedding_overlap``: ``auto`` (planner
+    decides per topology) / ``ring`` (ring-overlapped vocab-sharded
+    embedding gather, ``ops/collective_matmul.py``) / ``xla``. Model-level
+    ``TransformerConfig`` fields (non-auto) win over these fleet defaults;
+    the all-``xla`` setting is bit-identical to the pre-fastpath tree.
+    """
+    attn_impl: str = "auto"          # auto | xla | flash
+    loss_impl: str = "auto"          # auto | xla | fused
+    embedding_overlap: str = "auto"  # auto | xla | ring
+
+
+@register_config
+@dataclass
 class CommPlannerConfig(ConfigModel):
     """Collective planner (``comm/planner/``): topology-aware per-site
     selection of the PR1/PR2 fast paths.
@@ -676,6 +697,8 @@ class DeepSpeedTPUConfig(ConfigModel):
     compressed_collectives: CompressedCollectivesConfig = field(
         default_factory=CompressedCollectivesConfig)
     comm_planner: CommPlannerConfig = field(default_factory=CommPlannerConfig)
+    training_fastpath: TrainingFastpathConfig = field(
+        default_factory=TrainingFastpathConfig)
 
     # topology: sizes multiply to world size; dp is inferred
     sequence_parallel_size: int = 1

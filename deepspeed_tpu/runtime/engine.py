@@ -167,6 +167,13 @@ class DeepSpeedTPUEngine:
         # fleet planner in the configured mode — off is inert
         from ..comm.planner import configure_from_config
         configure_from_config(config, topology=self.topo)
+        # training fast path (ops/fastpath.py): flip the fleet defaults the
+        # attention/loss/embedding wirings read when the model config says
+        # 'auto' — same pattern as configure_compression above
+        tf = config.training_fastpath
+        from ..ops.fastpath import configure_fastpath
+        configure_fastpath(attn_impl=tf.attn_impl, loss_impl=tf.loss_impl,
+                           embedding_overlap=tf.embedding_overlap)
         if (optimizer is not None and callable(optimizer)
                 and not hasattr(optimizer, "update")):
             # reference DeepSpeedOptimizerCallable (deepspeed/__init__.py:112):

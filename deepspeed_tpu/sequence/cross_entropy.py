@@ -39,7 +39,48 @@ __all__ = [
     "vocab_parallel_cross_entropy",
     "vocab_sequence_parallel_cross_entropy",
     "sharded_lm_loss",
+    "resolve_loss_impl",
 ]
+
+
+def resolve_loss_impl(impl: Optional[str] = None,
+                      vocab_shard: Optional[int] = None) -> str:
+    """``auto|xla|fused`` -> the implementation this call should run.
+
+    An explicit (non-auto) argument wins; an ``auto`` argument defers to the
+    fleet knob (``ops/fastpath.py``, mapped from the ``training_fastpath``
+    config block); a still-``auto`` result resolves to ``fused`` on a real
+    accelerator when the vocab shard tiles (``fused_loss_ready``) and to the
+    XLA reference otherwise — so CPU test runs keep today's path untouched.
+    """
+    impl = impl or "auto"
+    if impl == "auto":
+        from ..ops.fastpath import fastpath
+
+        impl = fastpath("loss_impl")
+    if impl == "auto":
+        import jax
+
+        from ..ops.pallas.fused_loss import fused_loss_ready
+
+        impl = ("fused" if jax.default_backend() != "cpu"
+                and vocab_shard is not None and fused_loss_ready(vocab_shard)
+                else "xla")
+    return impl
+
+
+_FUSED_FALLBACK_WARNED = set()
+
+
+def _warn_fused_fallback(reason: str) -> None:
+    if reason in _FUSED_FALLBACK_WARNED:
+        return
+    _FUSED_FALLBACK_WARNED.add(reason)
+    from ..utils.logging import logger
+
+    logger.warning(
+        f"loss_impl=fused requested but {reason} — falling back to the XLA "
+        f"cross-entropy for these call sites (one-time notice)")
 
 
 def vocab_parallel_cross_entropy(local_logits, targets, *, axis_name: str = TP_AXIS,
@@ -114,7 +155,7 @@ def vocab_sequence_parallel_cross_entropy(logits, targets, *, z_loss: float = 0.
 
 def sharded_lm_loss(hidden, head_kernel, tokens, *, loss_mask=None,
                     z_loss: float = 0.0, head_bias=None, topo=None,
-                    logit_dtype=jnp.float32):
+                    logit_dtype=jnp.float32, loss_impl: Optional[str] = None):
     """Fused vocab-sharded head matmul + cross entropy, next-token shifted.
 
     ``hidden`` is ``[B, S, E]`` (sp-sharded on S), ``head_kernel`` is
@@ -123,21 +164,48 @@ def sharded_lm_loss(hidden, head_kernel, tokens, *, loss_mask=None,
     full-vocab activation is never resident. This is the composition the
     reference reaches with Megatron's parallel lm-head + its
     ``_VocabSequenceParallelCrossEntropy``.
+
+    ``loss_impl``: ``auto`` (default — :func:`resolve_loss_impl`), ``xla``
+    (today's composition, bit-identical), or ``fused`` — the Pallas online-
+    softmax kernel (``ops/pallas/fused_loss.py``): the local logits tile
+    never materializes even inside the shard, and the per-shard ``(lse,
+    target-logit)`` pair combines with the same tp psum structure, so the
+    vocab/sequence-parallel layout is preserved. A head bias or a non-128-
+    multiple vocab shard falls back to ``xla`` (one-time warning when fused
+    was requested explicitly).
     """
     topo = topo or get_topology()
+    vocab = head_kernel.shape[-1]
+    vshard = vocab // max(topo.tp_size, 1)
+    requested = loss_impl if loss_impl not in (None, "auto") else None
+    impl = resolve_loss_impl(loss_impl, vshard)
+    if impl == "fused":
+        from ..ops.pallas.fused_loss import fused_loss_ready
+
+        reason = None
+        if head_bias is not None:
+            reason = "the fused kernel takes no head bias"
+        elif topo.tp_size > 1 and vocab % topo.tp_size:
+            reason = (f"vocab {vocab} does not shard over tp {topo.tp_size}")
+        elif not fused_loss_ready(vshard):
+            reason = (f"vocab shard {vshard} is not a 128-multiple")
+        elif (hidden.shape[0] % topo.axis_size(*topo.dp_axes)
+              or hidden.shape[1] % topo.sp_size):
+            reason = "the batch does not shard over the dp/sp axes"
+        if reason is None:
+            return _fused_lm_loss(hidden, head_kernel, tokens,
+                                  loss_mask=loss_mask, z_loss=z_loss,
+                                  topo=topo)
+        if requested == "fused" or _knob_is("fused"):
+            _warn_fused_fallback(reason)
+        impl = "xla"
     if topo.tp_size != 1:
         if head_kernel.shape[-1] % topo.tp_size != 0:
             raise ValueError(
                 f"vocab_parallel_loss needs vocab_size ({head_kernel.shape[-1]}) "
                 f"divisible by tp ({topo.tp_size}); pad the vocab up to a "
                 "multiple of tp (Megatron pads for the same reason)")
-        # Keep S full-length (divisible by sp): shift targets with a dummy
-        # final position and fold the shift into the mask instead of slicing.
-        targets_full = jnp.concatenate([tokens[:, 1:], tokens[:, -1:]], axis=1)
-        w = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
-        if loss_mask is not None:
-            lm = loss_mask.astype(jnp.float32)
-            w = w * jnp.concatenate([lm[:, 1:], jnp.zeros_like(lm[:, -1:])], axis=1)
+        targets_full, w = _shifted_targets_and_weights(tokens, loss_mask)
         nll = _vocab_sharded_head_nll(hidden, head_kernel, targets_full,
                                       head_bias=head_bias, z_loss=z_loss,
                                       topo=topo, logit_dtype=logit_dtype)
@@ -148,6 +216,20 @@ def sharded_lm_loss(hidden, head_kernel, tokens, *, loss_mask=None,
     if head_bias is not None:
         logits = logits + head_bias.astype(logit_dtype)
     return causal_lm_loss(logits, tokens, loss_mask=loss_mask, z_loss=z_loss)
+
+
+def _shifted_targets_and_weights(tokens, loss_mask):
+    """Next-token shift keeping S full-length (divisible by sp): targets
+    shift with a dummy final position whose weight is zero, and the shift
+    folds into the weight mask instead of slicing — shared by the xla tp
+    branch and the fused path so the convention cannot drift."""
+    targets_full = jnp.concatenate([tokens[:, 1:], tokens[:, -1:]], axis=1)
+    w = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
+    if loss_mask is not None:
+        lm = loss_mask.astype(jnp.float32)
+        w = w * jnp.concatenate([lm[:, 1:], jnp.zeros_like(lm[:, -1:])],
+                                axis=1)
+    return targets_full, w
 
 
 def _vocab_sharded_head_nll(hidden, head_kernel, targets, *, head_bias,
@@ -177,5 +259,42 @@ def _vocab_sharded_head_nll(hidden, head_kernel, targets, *, head_bias,
                              in_specs=(h_spec, k_spec, P(TP_AXIS), tg_spec),
                              out_specs=tg_spec)(
                                  hidden, head_kernel, head_bias, targets)
+
+
+def _knob_is(impl: str) -> bool:
+    from ..ops.fastpath import fastpath
+
+    return fastpath("loss_impl") == impl
+
+
+def _fused_lm_loss(hidden, head_kernel, tokens, *, loss_mask, z_loss, topo):
+    """The Pallas fused path, one shard_map for every tp size.
+
+    S stays full-length (divisible by sp): targets shift with a dummy final
+    position whose weight is zero (the same trick as the XLA tp branch), so
+    the fused kernel sees the unshifted ``[B, S, E]`` layout. At ``tp == 1``
+    the body needs no collective at all — the kernel's per-token ``(lse,
+    tgt)`` IS the loss; at ``tp > 1`` the pmax/psum combine runs on the tiny
+    ``[B, S]`` stats instead of anything vocab-sized.
+    """
+    targets_full, w = _shifted_targets_and_weights(tokens, loss_mask)
+    from ..ops.pallas.fused_loss import fused_vocab_nll
+    from ..utils.shard_map_compat import shard_map_nocheck
+
+    dp = topo.dp_axes
+    tp = topo.tp_size
+    h_spec = P(dp, SP_AXIS, None)
+    tg_spec = P(dp, SP_AXIS)
+    k_spec = P(None, TP_AXIS) if tp > 1 else P(None, None)
+    axis = TP_AXIS if tp > 1 else None
+
+    def body(h, k, tg):
+        return fused_vocab_nll(h, k, tg, axis_name=axis, z_loss=z_loss)
+
+    nll = shard_map_nocheck(body, topo.mesh,
+                            in_specs=(h_spec, k_spec, tg_spec),
+                            out_specs=tg_spec)(hidden, head_kernel,
+                                               targets_full)
+    return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 

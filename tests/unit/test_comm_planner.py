@@ -529,3 +529,72 @@ def test_measure_mode_times_survivors():
     assert d.impl in IMPLEMENTATIONS
     assert d.source in ("measured", "cost-model")
     assert d.est_us is not None and d.est_us > 0
+
+
+# ---------------------------------------------------------------------------
+# r6: the embedding-gather site (ring-overlapped vocab-sharded embedding)
+# ---------------------------------------------------------------------------
+
+
+def test_embed_gather_site_cost_model_and_static_resolution():
+    """embed_gather is a first-class op: the cost model ranks its menu
+    (xla vs the table ring) and static mode resolves + records it in the
+    plan table next to the PR 1 sites."""
+    cm = CostModel(_tpu_fp())
+    site = make_site(op="embed_gather", shape=(32000 // 4, 4096),
+                     dtype=jnp.bfloat16, axes=("tp",), consumer="embed")
+    cands = cm.candidates(site)
+    assert set(cands) == {"xla", "ring", "bidir_ring"}
+    # the ring's overlap credit beats the serial gather+take on a big table
+    assert cm.estimate(site, "ring") < cm.estimate(site, "xla")
+    assert np.isfinite(cm.estimate(site, "bidir_ring"))
+
+    set_topology(Topology(TopologySpec(tp=4)))
+    configure_planner("static", use_cache=False)
+    d = resolve_site(op="embed_gather", shape=(32000 // 4, 4096),
+                     dtype=jnp.bfloat16, axes=("tp",), consumer="embed")
+    assert d.impl in ("xla", "ring", "bidir_ring")
+    assert d.source == "cost-model"
+    recs = dist.get_comms_logger().plan_records
+    assert any(v["consumer"] == "embed" for v in recs.values())
+
+
+def test_embed_gather_microbench_probe_runs():
+    """measure mode's ground truth: the embed_gather probes build and run
+    on the live mesh for every menu member."""
+    from deepspeed_tpu.comm.planner import benchmark_site
+
+    set_topology(Topology(TopologySpec(tp=4)))
+    site = make_site(op="embed_gather", shape=(2048, 128), dtype="float32",
+                     axes=("tp",), consumer="embed")
+    for impl in ("xla", "ring", "bidir_ring"):
+        t = benchmark_site(site, impl, reps=2, repeats=1, max_elems=1 << 14)
+        assert t > 0.0
+
+
+def test_model_embed_auto_defers_to_planner():
+    """embed_overlap='auto' + an active planner: the model consults the
+    embed site; with the planner off the declarative gather stays (the
+    bit-identical default)."""
+    import dataclasses
+
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM, init_params)
+
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32,
+                            intermediate_size=64, num_layers=1, num_heads=4,
+                            max_seq_len=16, dtype=jnp.float32)
+    set_topology(Topology(TopologySpec()))
+    params = init_params(TransformerLM(cfg), seq=16)
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 64, (4, 16)),
+                       jnp.int32)
+    ref = TransformerLM(cfg).apply({"params": params}, toks)
+
+    set_topology(Topology(TopologySpec(tp=4)))
+    configure_planner("static", use_cache=False)
+    got = jax.jit(lambda t: TransformerLM(cfg).apply({"params": params}, t))(
+        toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+    recs = dist.get_comms_logger().plan_records
+    assert any(v["consumer"] == "embed" for v in recs.values())
