@@ -1086,12 +1086,93 @@ def fused_hotpath_bench():
             "device": getattr(dev, "device_kind", dev.platform)}
 
 
+def serving_bench():
+    """Rung sv (serving tier, deepspeed_tpu/serving/): seeded OPEN-LOOP
+    Poisson traffic against an LLMServer over the v2 ragged engine —
+    arrivals follow the fixed schedule regardless of completions, so the
+    recorded TTFT/e2e percentiles include real queueing, not a closed
+    loop's self-throttled flattery. Reports tokens/s-per-chip as the value
+    plus p50/p99 TTFT and e2e latency; on CPU a tiny model documents the
+    serving-path wiring and relative latencies, on a TPU the decode-bench
+    model shape makes the row a real serving number."""
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models.transformer import (TransformerLM, init_params,
+                                                  llama_config)
+    from deepspeed_tpu.serving import (LengthDist, LLMServer, OpenLoopTraffic,
+                                       TrafficConfig)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = llama_config("7b", num_layers=12, hidden_size=1536,
+                           intermediate_size=4096, num_heads=12, num_kv_heads=4,
+                           vocab_size=32000, max_seq_len=4096,
+                           dtype=jnp.bfloat16)
+        eng_cfg = RaggedInferenceEngineConfig(
+            token_budget=512, max_ragged_sequence_count=16, max_chunk_size=256,
+            num_kv_blocks=400, kv_block_size=128, max_blocks_per_seq=8,
+            dtype="bfloat16")
+        traffic = TrafficConfig(rate_rps=8.0, num_requests=64, seed=7,
+                                vocab_size=cfg.vocab_size,
+                                prompt_len=LengthDist("uniform", 64, 256),
+                                output_len=LengthDist("uniform", 32, 96),
+                                deadline_s=60.0)
+    else:
+        cfg = llama_config("7b", num_layers=2, hidden_size=128,
+                           intermediate_size=256, num_heads=4, num_kv_heads=2,
+                           vocab_size=1024, max_seq_len=256, dtype=jnp.float32)
+        eng_cfg = RaggedInferenceEngineConfig(
+            token_budget=64, max_ragged_sequence_count=8, max_chunk_size=16,
+            num_kv_blocks=96, kv_block_size=8, max_blocks_per_seq=8,
+            dtype="float32")
+        traffic = TrafficConfig(rate_rps=40.0, num_requests=32, seed=7,
+                                vocab_size=cfg.vocab_size,
+                                prompt_len=LengthDist("uniform", 8, 24),
+                                output_len=LengthDist("uniform", 8, 16),
+                                deadline_s=30.0)
+
+    model = TransformerLM(cfg)
+    params = init_params(model, batch=1, seq=64)
+    engine = InferenceEngineV2(model, params, eng_cfg)
+    # warm the compile caches OFF the clock (the packed-step program), then
+    # serve the seeded schedule
+    engine.generate([np.arange(1, 9, dtype=np.int32)], max_new_tokens=4)
+    server = LLMServer(engine, policy="deadline", max_queue=512).start()
+    t0 = time.perf_counter()
+    resps, rejected = OpenLoopTraffic(traffic).run(
+        lambda req: server.submit(req))
+    drained = server.drain(timeout=1800)
+    wall = time.perf_counter() - t0
+    m = server.metrics
+    snap = m.snapshot()
+    n_chips = len(jax.devices())
+    tps_chip = m.tokens_out / wall / n_chips
+    return {"metric": "serving_open_loop_tokens_per_sec_per_chip",
+            "value": round(tps_chip, 1), "unit": "tok/s/chip",
+            "vs_baseline": None,
+            "ttft_p50_ms": snap["ttft"]["p50_ms"],
+            "ttft_p99_ms": snap["ttft"]["p99_ms"],
+            "e2e_p50_ms": snap["e2e"]["p50_ms"],
+            "e2e_p99_ms": snap["e2e"]["p99_ms"],
+            "queue_wait_p50_ms": snap["queue_wait"]["p50_ms"],
+            "completed": snap["completed"], "rejected": len(rejected),
+            "preemptions": snap["preemptions"],
+            "sla_violations": snap["sla_violations"],
+            "tokens_out": snap["tokens_out"],
+            "rate_rps": traffic.rate_rps, "num_requests": traffic.num_requests,
+            "drained": drained, "wall_s": round(wall, 3),
+            "policy": "deadline", "seed": traffic.seed,
+            "device": getattr(dev, "device_kind", dev.platform)}
+
+
 RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "3b": rung3b_big_model,
          "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses,
          "cm": collective_matmul_bench, "qx": quantized_collectives_bench,
          "plan": planner_bench, "rz": resilience_bench,
-         "wd": watchdog_bench, "fl": fused_hotpath_bench}
+         "wd": watchdog_bench, "fl": fused_hotpath_bench,
+         "sv": serving_bench}
 
 
 def _with_ledger(fn):
@@ -1136,7 +1217,7 @@ def run_ladder():
             ("cm", {} if multichip else cpu8),
             ("qx", {} if multichip else cpu8),
             ("plan", {} if multichip else cpu8),
-            ("rz", chip), ("wd", cpu1), ("fl", chip)]
+            ("rz", chip), ("wd", cpu1), ("fl", chip), ("sv", chip)]
     results = []
     for rung, env_over in plan:
         env = dict(os.environ)

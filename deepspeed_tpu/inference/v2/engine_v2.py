@@ -39,6 +39,9 @@ class RaggedInferenceEngineConfig:
     # "float8_e4m3fn" halves KV memory vs bf16; None = the compute dtype.
     # Writers/readers already cast through the pool dtype, so this is purely
     # a storage-precision knob; the gather path dequantizes on read.
+    # "int8" selects QUANTIZED storage instead of a cast: per-row absmax
+    # scales ride alongside the pool (ops/pallas/quant.py quantize_rows),
+    # writers quantize on scatter and the gather path dequantizes on read.
     kv_cache_dtype: Optional[str] = None
     greedy: bool = True
     temperature: float = 1.0
@@ -120,6 +123,13 @@ class InferenceEngineV2:
         """Unallocated KV pages (reference ``engine_v2.free_blocks``)."""
         return self.kv.free_blocks
 
+    @property
+    def uncommitted_free_blocks(self) -> int:
+        """Free pages not yet promised to admitted sequences — what
+        admission can actually spend (the serving scheduler's feasibility
+        input)."""
+        return self.kv.free_blocks - self._outstanding_blocks()
+
     def get_remaining_block_capacity(self, uid: int) -> int:
         """Tokens a sequence can still append before needing a new page
         (reference ``engine_v2.get_remaining_block_capacity``)."""
@@ -161,7 +171,7 @@ class InferenceEngineV2:
         if blocks_needed > self.config.max_blocks_per_seq:
             return False, (f"sequence needs {blocks_needed} blocks > "
                            f"max_blocks_per_seq {self.config.max_blocks_per_seq}")
-        available = self.kv.free_blocks - self._outstanding_blocks()
+        available = self.uncommitted_free_blocks
         if blocks_needed > available:
             return False, (f"KV pool has {available} uncommitted free blocks "
                            f"(of {self.kv.free_blocks} free), need {blocks_needed}")
@@ -240,8 +250,9 @@ class InferenceEngineV2:
             return {}
         batch = self.wrapper.pack(scheduled, self.config.kv_block_size)
         self._key, step_key = jax.random.split(self._key)
+        kv_k, kv_v = self.kv.pool_args()
         sampled, new_k, new_v = ragged_step(
-            self.params, self.cfg, self.kv.k, self.kv.v,
+            self.params, self.cfg, kv_k, kv_v,
             jnp.asarray(batch.tokens), jnp.asarray(batch.positions),
             jnp.asarray(batch.gather_idx), jnp.asarray(batch.block_table),
             jnp.asarray(batch.kv_len), jnp.asarray(batch.logits_idx),
@@ -298,8 +309,9 @@ class InferenceEngineV2:
             active[slot] = True
         bt = self._slice_block_table(bt, pos0, n)
         self._key, step_key = jax.random.split(self._key)
+        kv_k, kv_v = self.kv.pool_args()
         toks, new_k, new_v = decode_loop(
-            self.params, self.cfg, self.kv.k, self.kv.v,
+            self.params, self.cfg, kv_k, kv_v,
             jnp.asarray(tokens0), jnp.asarray(pos0), jnp.asarray(bt),
             jnp.asarray(active), step_key, jnp.float32(c.temperature),
             n_steps=n, attn_impl=self.decode_attn_impl, greedy=c.greedy)
@@ -397,8 +409,9 @@ class InferenceEngineV2:
             active[slot] = True
         bt = self._slice_block_table(bt, pos0, n)
         self._key, step_key = jax.random.split(self._key)
+        kv_k, kv_v = self.kv.pool_args()
         toks, new_k, new_v = decode_loop(
-            self.params, self.cfg, self.kv.k, self.kv.v,
+            self.params, self.cfg, kv_k, kv_v,
             jnp.asarray(tokens0), jnp.asarray(pos0), jnp.asarray(bt),
             jnp.asarray(active), step_key, jnp.float32(c.temperature),
             n_steps=n, attn_impl=self.decode_attn_impl, greedy=c.greedy)

@@ -32,6 +32,48 @@ from ...models.transformer import (TransformerConfig, alibi_slopes,
                                    rope_table)
 from ...ops.pallas.paged_attention import NEG_INF
 from ...ops.pallas.paged_attention import paged_attention as paged_attention_pallas
+from ...ops.pallas.quant import dequantize_rows, quantize_rows
+
+
+# ---------------------------------------------------------------------------
+# KV pool forms. A pool argument is either a plain array
+# [L, N, Hk, bs, D] or, for int8 storage (kv_cache_dtype="int8"), a
+# (values int8, scales fp32 [L, N, Hk, bs]) tuple — quantize-on-scatter,
+# dequantize-on-gather with the quant.py row convention. The tuple form is
+# only served by the gather (einsum) attention path; the engine forbids it
+# for attn_backend="pallas".
+# ---------------------------------------------------------------------------
+
+
+def _pool_values(pool):
+    return pool[0] if isinstance(pool, tuple) else pool
+
+
+def _kv_layer(pool, i):
+    """Layer ``i``'s view of a pool argument, preserving its form."""
+    if isinstance(pool, tuple):
+        return (pool[0][i], pool[1][i])
+    return pool[i]
+
+
+def _kv_write(pool, i, tgt_block, tgt_slot, vals):
+    """Scatter new KV rows ``vals`` [T', Hk, D] into layer ``i``'s pages."""
+    if isinstance(pool, tuple):
+        q, s = pool
+        qv, sv = quantize_rows(vals)
+        return (q.at[i, tgt_block, :, tgt_slot].set(qv),
+                s.at[i, tgt_block, :, tgt_slot].set(sv))
+    return pool.at[i, tgt_block, :, tgt_slot].set(vals.astype(pool.dtype))
+
+
+def _gather_pages(pool, block_table, dtype):
+    """Gather a (possibly layer-sliced) pool's pages: [S, B, Hk, bs, D].
+    Quantized pools dequantize on the gather; plain pools keep their storage
+    dtype (consumers cast at the einsum)."""
+    if isinstance(pool, tuple):
+        q, s = pool
+        return dequantize_rows(q[block_table], s[block_table], dtype)
+    return pool[block_table]
 
 
 def _rms_norm(x, scale, eps):
@@ -156,12 +198,15 @@ def paged_attention(qg, k_pool, v_pool, block_table, positions_g, q_valid,
     masks keys at distance >= window (gpt-neo local layers).
     """
     s, q, hq, d = qg.shape
-    hk = k_pool.shape[1]
-    bs = k_pool.shape[2]
+    hk = _pool_values(k_pool).shape[1]
+    bs = _pool_values(k_pool).shape[2]
     rep = hq // hk
     # gather pages [S, B, Hk, bs, D] -> slot-major [S, B*bs, Hk, D]
-    kg = k_pool[block_table].transpose(0, 1, 3, 2, 4).reshape(s, -1, hk, d)
-    vg = v_pool[block_table].transpose(0, 1, 3, 2, 4).reshape(s, -1, hk, d)
+    # (int8 pools dequantize on this gather)
+    kg = _gather_pages(k_pool, block_table, qg.dtype)
+    vg = _gather_pages(v_pool, block_table, qg.dtype)
+    kg = kg.transpose(0, 1, 3, 2, 4).reshape(s, -1, hk, d)
+    vg = vg.transpose(0, 1, 3, 2, 4).reshape(s, -1, hk, d)
     m = kg.shape[1]
     qq = qg.reshape(s, q, hk, rep, d)
     scale = (1.0 / np.sqrt(d)) if scale is None else float(scale)
@@ -206,7 +251,7 @@ def _ragged_forward_impl(params, cfg: TransformerConfig, kv_k, kv_v, tokens,
     """
     T = tokens.shape[0]
     S, Q = gather_idx.shape
-    bs = kv_k.shape[3]
+    bs = _pool_values(kv_k).shape[3]
     dtype = cfg.dtype
 
     x = params["embed"]["embedding"].astype(dtype)[tokens]          # [T, H]
@@ -243,16 +288,15 @@ def _ragged_forward_impl(params, cfg: TransformerConfig, kv_k, kv_v, tokens,
         vg = jnp.concatenate([vt, jnp.zeros_like(vt[:1])])[gather_idx]
         # write new kv into pages ([i, block, :, slot] — advanced indices
         # around the head slice put the token axis first: values [T', Hk, D])
-        kv_k = kv_k.at[i, tgt_block, :, tgt_slot].set(
-            kg.reshape(-1, hk, d).astype(kv_k.dtype))
-        kv_v = kv_v.at[i, tgt_block, :, tgt_slot].set(
-            vg.reshape(-1, hk, d).astype(kv_v.dtype))
+        kv_k = _kv_write(kv_k, i, tgt_block, tgt_slot, kg.reshape(-1, hk, d))
+        kv_v = _kv_write(kv_v, i, tgt_block, tgt_slot, vg.reshape(-1, hk, d))
         if attn_impl == "pallas":
             out = paged_attention_pallas(qg, kv_k[i], kv_v[i], block_table,
                                          start_pos, chunk_len, kv_len)
         else:
             win = cfg.layer_windows[i] if cfg.layer_windows else None
-            out = paged_attention(qg, kv_k[i], kv_v[i], block_table, pos_g,
+            out = paged_attention(qg, _kv_layer(kv_k, i), _kv_layer(kv_v, i),
+                                  block_table, pos_g,
                                   q_valid, kv_len, alibi=alibi,
                                   alibi_post_scale=cfg.alibi_post_scale,
                                   scale=cfg.attn_scale,
@@ -360,7 +404,7 @@ def decode_loop(params, cfg: TransformerConfig, kv_k, kv_v, tokens0, pos0,
     slots write to the trash block). Returns (tokens [S, n_steps], kv pools).
     """
     S = tokens0.shape[0]
-    bs = kv_k.shape[3]
+    bs = _pool_values(kv_k).shape[3]
     L, Hq, Hk, D = cfg.num_layers, cfg.num_heads, cfg.kv_heads, cfg.head_dim
     G = Hq // Hk
     W = n_steps
@@ -402,7 +446,8 @@ def decode_loop(params, cfg: TransformerConfig, kv_k, kv_v, tokens0, pos0,
                     return_stats=True)
             else:
                 o1, m1, l1 = paged_attention(
-                    qg, kv_k[i], kv_v[i], block_table, pos[:, None],
+                    qg, _kv_layer(kv_k, i), _kv_layer(kv_v, i), block_table,
+                    pos[:, None],
                     active[:, None], pool_len, return_stats=True,
                     alibi=alibi, alibi_post_scale=cfg.alibi_post_scale,
                     scale=cfg.attn_scale, window=win)
@@ -473,6 +518,6 @@ def decode_loop(params, cfg: TransformerConfig, kv_k, kv_v, tokens0, pos0,
     wkt = wk.transpose(0, 2, 1, 3, 4).reshape(L, S * W, Hk, D)      # [L,S*W,..]
     wvt = wv.transpose(0, 2, 1, 3, 4).reshape(L, S * W, Hk, D)
     for i in range(L):
-        kv_k = kv_k.at[i, blk, :, slot].set(wkt[i].astype(kv_k.dtype))
-        kv_v = kv_v.at[i, blk, :, slot].set(wvt[i].astype(kv_v.dtype))
+        kv_k = _kv_write(kv_k, i, blk, slot, wkt[i])
+        kv_v = _kv_write(kv_v, i, blk, slot, wvt[i])
     return toks.T, kv_k, kv_v                                       # [S, n_steps]

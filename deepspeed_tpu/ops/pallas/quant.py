@@ -136,6 +136,37 @@ def dequantize_int8(q: jnp.ndarray, s: jnp.ndarray, shape, dtype=jnp.float32,
 
 
 # ---------------------------------------------------------------------------
+# Row-wise quantization (int8 KV-cache storage)
+# ---------------------------------------------------------------------------
+
+
+def quantize_rows(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row absmax int8 quantization over the LAST axis: the row-wise form
+    of :func:`quantize_int8`'s ``_quant_kernel`` (same absmax/127 convention)
+    for tensors whose natural scale granularity is a row, not a 2048-element
+    block — the KV cache stores one ``[head_dim]`` row per (page, slot, head)
+    and keeps its scale alongside the pool (``inference/v2``). Plain jnp on
+    purpose: the rows here are head_dim-sized (often < the 128-lane tile
+    quantum), and XLA fuses the absmax/round into the surrounding KV
+    scatter/gather, so a dedicated kernel would only add dispatch overhead.
+
+    Returns ``(int8 values x.shape, fp32 scales x.shape[:-1])``.
+    """
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_rows(q: jnp.ndarray, scale: jnp.ndarray,
+                    dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of :func:`quantize_rows`: ``q * scale`` with the scale
+    broadcast over the last axis (dequant-on-gather for the int8 KV pool)."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
 # Quantized collectives (ZeRO++ qwZ / qgZ equivalents)
 # ---------------------------------------------------------------------------
 

@@ -558,6 +558,33 @@ class ResilienceConfig(ConfigModel):
 
 @register_config
 @dataclass
+class ServingConfig(ConfigModel):
+    """Serving tier (``deepspeed_tpu/serving/``): continuous-batching
+    ``LLMServer`` over the ``inference/v2`` ragged engine.
+
+    ``policy`` orders admission: ``fcfs`` (arrival), ``priority``
+    (``Request.priority``, with preempt-and-requeue of lower-priority
+    prefills when the KV pool runs dry), or ``deadline`` (earliest SLA
+    deadline first). ``engine`` holds ``RaggedInferenceEngineConfig``
+    overrides (token_budget, num_kv_blocks, kv_block_size,
+    kv_cache_dtype, ...). ``heartbeat_dir`` enables the PR 5 beacon
+    transport for replica health (``ReplicaRouter``)."""
+    enabled: bool = False
+    policy: str = "fcfs"                 # fcfs | priority | deadline
+    preempt: bool = True                 # preempt prefills under block pressure
+    max_queue: int = 256                 # bounded ingress (overload sheds)
+    default_deadline_s: Optional[float] = None  # SLA stamped when unset
+    idle_s: float = 0.001                # engine-thread sleep when idle
+    metrics_interval_steps: int = 50     # Serving/* monitor event cadence
+    replica_id: int = 0
+    heartbeat_dir: Optional[str] = None  # shared dir for replica beacons
+    heartbeat_interval_s: float = 2.0
+    dead_after_s: float = 10.0           # beacon staler than this = dead
+    engine: Dict[str, Any] = field(default_factory=dict)
+
+
+@register_config
+@dataclass
 class CheckpointConfig(ConfigModel):
     tag_validation: str = "Warn"  # Ignore | Warn | Fail
     load_universal: bool = False
@@ -719,6 +746,7 @@ class DeepSpeedTPUConfig(ConfigModel):
     autotuning: AutotuningConfig = field(default_factory=AutotuningConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)
     eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
     quantize_training: Optional[QuantizeTrainingConfig] = None
@@ -744,6 +772,11 @@ class DeepSpeedTPUConfig(ConfigModel):
         rz = d.get("resilience")
         if isinstance(rz, str):
             d["resilience"] = {"enabled": True, "snapshot_dir": rz}
+        # string shorthand: "serving": "priority" == {"enabled": true,
+        # "policy": "priority"}
+        sv = d.get("serving")
+        if isinstance(sv, str):
+            d["serving"] = {"enabled": True, "policy": sv}
         cl = d.pop("curriculum_learning", None)
         if cl:
             de = dict(d.get("data_efficiency") or {})

@@ -1,0 +1,29 @@
+"""Request-level serving tier over ``inference/v2`` (FastGen front end).
+
+The ragged engine (``inference/v2/engine_v2.py``) exposes a synchronous
+``put``/``step`` API; this package turns it into a server: request
+lifecycle with SLA deadlines and streaming (:mod:`request`), a
+continuous-batching admission scheduler with KV-block backpressure and
+priority preemption (:mod:`scheduler`), a background-stepping
+:class:`LLMServer` with a bounded ingress queue and graceful drain
+(:mod:`server`), TTFT/TPOT/e2e latency metrics bridged to the monitor tier
+(:mod:`metrics`), a multi-replica router on the PR 5 heartbeat health table
+(:mod:`replica`), and a seedable open-loop traffic generator for the
+``bench.py --rung sv`` latency bench (:mod:`traffic`).
+"""
+
+from .metrics import LatencyHistogram, ServingMetrics
+from .replica import ReplicaRouter
+from .request import (FINISH_CANCELLED, FINISH_EOS, FINISH_FAILED,
+                      FINISH_LENGTH, Request, ServedResponse)
+from .scheduler import ContinuousBatchScheduler
+from .server import LLMServer, ServerClosed, ServerOverloaded
+from .traffic import LengthDist, OpenLoopTraffic, TrafficConfig
+
+__all__ = [
+    "Request", "ServedResponse",
+    "FINISH_EOS", "FINISH_LENGTH", "FINISH_CANCELLED", "FINISH_FAILED",
+    "ContinuousBatchScheduler", "LLMServer", "ServerClosed",
+    "ServerOverloaded", "ServingMetrics", "LatencyHistogram",
+    "ReplicaRouter", "TrafficConfig", "LengthDist", "OpenLoopTraffic",
+]

@@ -1,0 +1,233 @@
+"""Multi-replica routing with heartbeat-derived health.
+
+A serving fleet is N independent :class:`~.server.LLMServer` replicas (one
+engine each — model replicas, not shards); the router in front of them:
+
+* **dispatches** each request to the least-loaded replica that is alive
+  (PR 5 ``HealthTable`` verdict over the replicas' heartbeat beacons) and
+  not draining;
+* **requeues** on failure: the router tracks every in-flight assignment
+  itself, so when a replica's beacon goes stale (``dead_after_s``) its
+  unfinished requests are resubmitted to the survivors with the SAME
+  response handles — the client's ``wait()`` never learns which replica
+  served it (generated tokens restart from the prompt; the SLA clock keeps
+  running and ``preemptions`` counts the restart);
+* **drains** gracefully: ``drain_replica`` stops dispatch to one replica
+  and lets its in-flight work finish (maintenance), ``drain()`` does the
+  fleet.
+
+Transport is the resilience tier's pluggable beacon protocol
+(``runtime/resilience/heartbeat.py`` ``FileHeartbeatTransport``): in one
+process it is a tmpdir, on a real fleet a shared bucket — the router only
+reads verdicts, never the replicas' memory, so the same logic serves both.
+"""
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..runtime.resilience.heartbeat import HealthTable, HeartbeatWriter
+from ..utils.logging import logger
+from .request import FINISH_FAILED, Request, ServedResponse
+from .server import LLMServer, ServerClosed, ServerOverloaded
+
+
+class ReplicaRouter:
+    def __init__(self, replicas: List[LLMServer], *, transport=None,
+                 dead_after_s: float = 10.0,
+                 clock: Callable[[], float] = time.time,
+                 response_clock: Callable[[], float] = time.monotonic):
+        if not replicas:
+            raise ValueError("need at least one replica")
+        ids = [r.replica_id for r in replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {ids}")
+        self.replicas: Dict[int, LLMServer] = {r.replica_id: r
+                                               for r in replicas}
+        self.clock = clock              # wall time, for beacon ages only
+        # timestamps stamped ONTO responses must share the servers' clock
+        # domain (LLMServer defaults to time.monotonic) — mixing wall time
+        # into arrival/finish stamps would corrupt e2e_s / sla_violated()
+        self.response_clock = response_clock
+        self.health: Optional[HealthTable] = None
+        if transport is not None:
+            self.health = HealthTable(transport, dead_after_s=dead_after_s,
+                                      clock=clock)
+            for r in replicas:
+                if r.heartbeat is None:
+                    r.heartbeat = HeartbeatWriter(transport, r.replica_id,
+                                                  clock=clock)
+        self._lock = threading.Lock()
+        # router-side assignment book: uid is replica-local, so key by the
+        # response object itself
+        self._assigned: Dict[int, Dict[int, ServedResponse]] = \
+            {rid: {} for rid in self.replicas}
+        self._draining: set = set()
+        self._dead: set = set()
+        self.requeues = 0
+
+    # ------------------------------------------------------------------
+    def start(self) -> "ReplicaRouter":
+        for r in self.replicas.values():
+            r.start()
+        return self
+
+    def alive_ids(self) -> List[int]:
+        """Replica ids currently eligible for dispatch."""
+        # copy under the lock: check()/_take_over()/drain_replica() mutate
+        # these sets from an operator thread while client submits read them
+        with self._lock:
+            dead = set(self._dead)
+            draining = set(self._draining)
+        if self.health is not None:
+            beacons = {row.rank: row for row in self.health.read()}
+            for rid in self.replicas:
+                row = beacons.get(rid)
+                # no beacon yet = still warming up, give benefit of the doubt
+                if row is not None and not row.alive:
+                    dead.add(rid)
+        return [rid for rid in self.replicas
+                if rid not in dead and rid not in draining
+                and self.replicas[rid].error is None]
+
+    def _pick(self, exclude=()) -> LLMServer:
+        alive = [rid for rid in self.alive_ids() if rid not in exclude]
+        if not alive:
+            raise ServerClosed("no live replica available")
+        # a replica's own `outstanding` already counts every unfinished
+        # request it holds — router-dispatched AND direct submits alike; the
+        # assignment book is requeue tracking, adding it would double-weight
+        # router traffic
+        rid = min(alive, key=lambda i: (self.replicas[i].outstanding, i))
+        return self.replicas[rid]
+
+    # ------------------------------------------------------------------
+    def submit(self, request: Request, *, block: bool = False,
+               timeout: Optional[float] = None) -> ServedResponse:
+        """Dispatch to the least-loaded live replica. Raises
+        :class:`ServerOverloaded` only when EVERY live replica sheds."""
+        last_err: Optional[Exception] = None
+        tried: set = set()              # a shed replica is out for THIS call:
+        for _ in range(len(self.replicas)):  # retrying it would starve peers
+            try:
+                server = self._pick(exclude=tried)
+            except ServerClosed as e:
+                last_err = last_err or e
+                break
+            try:
+                resp = server.submit(request, block=block, timeout=timeout)
+            except (ServerOverloaded, ServerClosed) as e:
+                last_err = e
+                tried.add(server.replica_id)
+                if isinstance(e, ServerClosed):
+                    # conclusively not accepting (halted/closed outside the
+                    # router): take it over NOW — merely excluding it would
+                    # leave its in-flight work unrequeued until (never, if
+                    # its beacon stays fresh) check() notices
+                    self._take_over(server.replica_id)
+                continue
+            self._track(server.replica_id, resp)
+            return resp
+        raise last_err if last_err is not None else ServerClosed("no replica")
+
+    def _track(self, rid: int, resp: ServedResponse) -> None:
+        with self._lock:
+            self._assigned[rid][id(resp)] = resp
+        resp.on_finish = lambda r, rid=rid: self._untrack(rid, r)
+        if resp.done:     # finished before the hook landed: untrack now
+            self._untrack(rid, resp)
+
+    def _untrack(self, rid: int, resp: ServedResponse) -> None:
+        with self._lock:
+            self._assigned[rid].pop(id(resp), None)
+
+    # ------------------------------------------------------------------
+    def check(self) -> List[int]:
+        """Poll replica health; requeue every unfinished request of a newly
+        dead replica onto the survivors. Returns the replica ids declared
+        dead by this call. Call periodically (or after a suspicious
+        latency) — the router has no background thread of its own."""
+        if self.health is None:
+            return []
+        newly_dead = []
+        rows = {row.rank: row for row in self.health.read()}
+        with self._lock:
+            already_dead = set(self._dead)
+        for rid in list(self.replicas):
+            if rid in already_dead:
+                continue
+            row = rows.get(rid)
+            if row is not None and not row.alive:
+                newly_dead.append(rid)
+        return [rid for rid in newly_dead if self._take_over(rid)]
+
+    def _take_over(self, rid: int) -> bool:
+        server = self.replicas[rid]
+        server.halt()
+        if server._thread is not None and server._thread.is_alive():
+            # live-but-wedged (e.g. stuck in a long compile): requeueing now
+            # would race its engine thread mutating the same response
+            # handles. Defer — a later check() (or submit failure) retries.
+            logger.warning(f"serving: replica {rid} looks dead but its "
+                           f"engine thread is still running; deferring "
+                           f"takeover")
+            return False
+        with self._lock:
+            self._dead.add(rid)
+            tracked = list(self._assigned[rid].values())
+            self._assigned[rid].clear()
+        logger.warning(f"serving: replica {rid} declared dead; "
+                       f"requeueing its work")
+        # the authoritative set is the router's own book; stealing from the
+        # halted server only resets engine-side state for handles we track
+        # (a truly lost process leaves nothing to steal — the book suffices)
+        try:
+            server.steal_unfinished()
+        except Exception:
+            pass
+        for resp in tracked:
+            if resp.done:
+                continue
+            resp._on_requeue()          # the one place restarts are counted
+            self.requeues += 1
+            # a resubmit failure (no live replica, a survivor shedding or
+            # closing between _pick and submit) must fail THIS response,
+            # never abort the loop — the rest of the dead replica's work
+            # still has to be requeued
+            try:
+                target = self._pick()
+                target.submit(resp.request, block=True, _response=resp)
+            except (ServerClosed, ServerOverloaded) as e:
+                logger.warning(f"serving: could not requeue a request from "
+                               f"dead replica {rid}: {e!r}")
+                resp._on_finish(FINISH_FAILED, self.response_clock())
+                # every other finish path reports to a ServingMetrics; use
+                # the dead replica's (which admitted it) so failed counters
+                # still reconcile with submissions
+                server.metrics.on_finish(resp)
+                continue
+            self._track(target.replica_id, resp)
+        return True
+
+    # ------------------------------------------------------------------
+    def drain_replica(self, rid: int, timeout: Optional[float] = None) -> bool:
+        """Graceful maintenance drain: stop dispatching to ``rid``, let its
+        in-flight requests finish, then stop its engine thread."""
+        with self._lock:
+            self._draining.add(rid)
+        return self.replicas[rid].drain(timeout)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        with self._lock:
+            dead = set(self._dead)
+        ok = True
+        for rid in list(self.replicas):
+            if rid in dead:
+                continue
+            ok = self.drain_replica(rid, timeout) and ok
+        return ok
+
+    @property
+    def outstanding(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._assigned.values())

@@ -1,0 +1,419 @@
+"""LLMServer: a live serving front end over ``InferenceEngineV2``.
+
+Reference: FastGen's ``MIIAsyncPipeline`` (mii/batching/ragged_batching.py)
+— a background thread owns the ragged engine and steps it continuously
+while clients submit/await requests from any thread. Same shape here:
+
+* **ingress** — a bounded ``queue.Queue``; a full queue rejects with
+  :class:`ServerOverloaded` (load shedding at the door instead of unbounded
+  latency inside), the admission policy itself lives in
+  :class:`~.scheduler.ContinuousBatchScheduler`;
+* **engine thread** — drains ingress, admits per policy, runs
+  ``engine.step()`` (SplitFuse packed prefill+decode), streams sampled
+  tokens into each request's :class:`~.request.ServedResponse`;
+* **drain** — ``drain()`` stops admission of NEW requests and returns once
+  every in-flight sequence has finished (the graceful half of the replica
+  lifecycle; the abrupt half is the router's dead-replica takeover);
+* **health** — an optional PR 5 ``HeartbeatWriter`` publishes this
+  replica's beacon each ``heartbeat_interval_s`` so a
+  :class:`~.replica.ReplicaRouter` (or any fleet observer) can derive
+  liveness without touching the serving thread.
+
+Engine-affinity rule: every engine/scheduler touch happens on the engine
+thread; client threads only enqueue, cancel (a flag), and wait on events.
+"""
+
+import itertools
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.logging import logger
+from .metrics import ServingMetrics
+from .request import (FINISH_CANCELLED, FINISH_EOS, FINISH_FAILED,
+                      FINISH_LENGTH, Request, ServedResponse)
+from .scheduler import ContinuousBatchScheduler
+
+
+class ServerClosed(RuntimeError):
+    """Submit after close()/drain() started."""
+
+
+class ServerOverloaded(RuntimeError):
+    """Bounded ingress queue is full — shed load upstream."""
+
+
+class LLMServer:
+    def __init__(self, engine, *, policy: str = "fcfs", preempt: bool = True,
+                 max_queue: int = 256, idle_s: float = 0.001,
+                 metrics: Optional[ServingMetrics] = None,
+                 monitor=None, metrics_interval_steps: int = 50,
+                 replica_id: int = 0,
+                 heartbeat=None, heartbeat_interval_s: float = 2.0,
+                 default_deadline_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.engine = engine
+        self.replica_id = int(replica_id)
+        self.clock = clock
+        self.idle_s = float(idle_s)
+        self.default_deadline_s = default_deadline_s
+        self.metrics = metrics or ServingMetrics(clock=clock)
+        self.monitor = monitor              # Monitor.write_events provider
+        self.metrics_interval_steps = int(metrics_interval_steps)
+        self.scheduler = ContinuousBatchScheduler(engine, policy,
+                                                  preempt=preempt,
+                                                  metrics=self.metrics,
+                                                  clock=clock)
+        self._ingress: "queue.Queue[ServedResponse]" = queue.Queue(max_queue)
+        self._uid = itertools.count()
+        # serializes the accepting/draining flags against submit's admission
+        # check, and _submitting counts submits between that check and their
+        # enqueue landing — so a submit that passed the check can never land
+        # its put AFTER the draining loop observed an empty ingress and
+        # exited (a stranded request would hang its client forever). The
+        # enqueue itself happens OUTSIDE the lock: a blocking put under it
+        # would deadlock against the crash handler's ingress sweep.
+        self._flags = threading.Lock()
+        self._submitting = 0
+        self._accepting = True
+        self._running = False
+        self._draining = False
+        self._thread: Optional[threading.Thread] = None
+        self._beat_thread: Optional[threading.Thread] = None
+        self._beat_stop = threading.Event()
+        self._steps = 0
+        self._last_emit_step = 0
+        self._last_step_time: Optional[float] = None
+        self.heartbeat = heartbeat          # resilience.HeartbeatWriter
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.suppress_heartbeat = False     # FaultPlan-style drill hook
+        self.error: Optional[BaseException] = None
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_config(cls, model, params, config, *, monitor=None,
+                    replica_id: Optional[int] = None) -> "LLMServer":
+        """Build an engine + server from a ``serving:`` config block
+        (``runtime/config.py`` ServingConfig, a dict of its fields, or a
+        whole ds_config dict/``DeepSpeedTPUConfig``). ``serving.engine``
+        carries ``RaggedInferenceEngineConfig`` overrides."""
+        from ..inference.v2 import (InferenceEngineV2,
+                                    RaggedInferenceEngineConfig)
+        from ..runtime.config import DeepSpeedTPUConfig, ServingConfig
+
+        if isinstance(config, DeepSpeedTPUConfig):
+            sv = config.serving
+        elif isinstance(config, ServingConfig):
+            sv = config
+        else:
+            import dataclasses
+            d = dict(config or {})
+            if "serving" in d:
+                raw = d["serving"]
+            else:
+                # a bare dict of ServingConfig fields is taken as-is; any
+                # other dict is a full ds_config without a serving block —
+                # defaults, not a ConfigError on its training keys
+                fields = {f.name for f in dataclasses.fields(ServingConfig)}
+                raw = d if set(d) <= fields else {}
+            if isinstance(raw, str):  # the "serving": "<policy>" shorthand
+                raw = {"enabled": True, "policy": raw}
+            sv = ServingConfig.from_dict(raw)
+        engine = InferenceEngineV2(
+            model, params, RaggedInferenceEngineConfig(**dict(sv.engine)))
+        rid = sv.replica_id if replica_id is None else int(replica_id)
+        heartbeat = None
+        if sv.heartbeat_dir:
+            from ..runtime.resilience.heartbeat import (FileHeartbeatTransport,
+                                                        HeartbeatWriter)
+
+            heartbeat = HeartbeatWriter(FileHeartbeatTransport(sv.heartbeat_dir),
+                                        rank=rid)
+        return cls(engine, policy=sv.policy, preempt=sv.preempt,
+                   max_queue=sv.max_queue, idle_s=sv.idle_s,
+                   monitor=monitor,
+                   metrics_interval_steps=sv.metrics_interval_steps,
+                   replica_id=rid, heartbeat=heartbeat,
+                   heartbeat_interval_s=sv.heartbeat_interval_s,
+                   default_deadline_s=sv.default_deadline_s)
+
+    # ------------------------------------------------------------------
+    # client side
+    # ------------------------------------------------------------------
+    def start(self) -> "LLMServer":
+        # under _flags: start() is called from every submit(), and two
+        # first-submits racing the None check would each spawn a _loop
+        # thread — two threads stepping one single-threaded engine
+        with self._flags:
+            if self._thread is None or not self._thread.is_alive():
+                self._running = True
+                self._thread = threading.Thread(
+                    target=self._loop, name=f"llm-server-{self.replica_id}",
+                    daemon=True)
+                self._thread.start()
+            self._start_beater()
+        return self
+
+    def submit(self, request: Request, *, block: bool = False,
+               timeout: Optional[float] = None,
+               _response: Optional[ServedResponse] = None) -> ServedResponse:
+        """Enqueue a request; returns its live response handle.
+
+        ``block=False`` (the default) makes a full ingress queue an
+        immediate :class:`ServerOverloaded` — open-loop clients must shed
+        load, not stack it. ``_response`` re-enqueues an existing handle
+        (router requeue path): the response keeps its arrival time/SLA clock
+        but gets a fresh engine uid on this replica."""
+        with self._flags:
+            if not (self._accepting and not self._draining):
+                raise ServerClosed(f"server replica={self.replica_id} is not "
+                                   "accepting requests")
+            if request.deadline_s is None and self.default_deadline_s is not None:
+                request.deadline_s = self.default_deadline_s
+            uid = next(self._uid)
+            if _response is None:
+                resp = ServedResponse(request, uid, self.clock())
+            else:
+                resp = _response
+                resp.uid = uid
+                self.metrics.requeues += 1   # replica-loss / drain restart
+            resp.replica_id = self.replica_id
+            self._submitting += 1
+        try:
+            self._ingress.put(resp, block=block, timeout=timeout)
+        except queue.Full:
+            self.metrics.on_reject()
+            raise ServerOverloaded(
+                f"ingress queue full ({self._ingress.maxsize}); "
+                f"request rejected") from None
+        finally:
+            with self._flags:
+                self._submitting -= 1
+        self.metrics.on_submit(resp)
+        self.start()
+        return resp
+
+    def generate(self, prompts: Sequence[np.ndarray],
+                 max_new_tokens: int = 64,
+                 eos_token_id: Optional[int] = None,
+                 timeout: Optional[float] = None) -> List[np.ndarray]:
+        """Synchronous convenience wrapper: submit all, wait, return tokens."""
+        resps = [self.submit(Request(p, max_new_tokens=max_new_tokens,
+                                     eos_token_id=eos_token_id), block=True)
+                 for p in prompts]
+        return [r.result(timeout) for r in resps]
+
+    def cancel(self, resp: ServedResponse) -> None:
+        resp.cancel()
+
+    @property
+    def queue_depth(self) -> int:
+        return self._ingress.qsize() + self.scheduler.queue_depth
+
+    @property
+    def inflight_count(self) -> int:
+        return len(self.scheduler.inflight)
+
+    @property
+    def outstanding(self) -> int:
+        """Requests accepted but not yet finished (load, for routing)."""
+        return self.queue_depth + self.inflight_count
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop accepting new requests, finish every in-flight one, then
+        stop the engine thread. Returns True when everything completed."""
+        with self._flags:
+            self._accepting = False
+            self._draining = True
+        self.start()                       # a never-started server still drains
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._thread.is_alive():
+            self._thread.join(0.05)
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+        return self.error is None
+
+    def close(self) -> None:
+        """Cancel everything outstanding and stop."""
+        with self._flags:
+            self._accepting = False
+        # flag scheduler-held AND still-ingress-queued requests: once
+        # _accepting is off nothing new lands, so a mutex-held snapshot of
+        # the queue covers everything the drain loop will ever see (the
+        # engine thread finishes them as cancelled instead of serving them)
+        with self._ingress.mutex:
+            queued = list(self._ingress.queue)
+        for resp in (list(self.scheduler.inflight.values())
+                     + list(self.scheduler.pending) + queued):
+            resp.cancel()
+        with self._flags:
+            self._draining = True
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(5.0)
+
+    # -- fleet hooks --------------------------------------------------------
+    def halt(self) -> None:
+        """Abrupt stop WITHOUT finishing in-flight work — the dead-replica
+        drill (process loss leaves exactly this state behind, beacon
+        included: a real process loss kills the beater thread too)."""
+        with self._flags:
+            self._accepting = False
+            self._running = False
+        self._beat_stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(5.0)
+
+    def steal_unfinished(self) -> List[ServedResponse]:
+        """Take every unfinished request off this (halted or draining-idle)
+        server for requeue elsewhere. Only call once the engine thread is
+        stopped — the router's takeover of a dead replica."""
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("steal_unfinished on a live server "
+                               "(halt() or drain() it first)")
+        out = self.scheduler.evict_all()
+        while True:
+            try:
+                out.append(self._ingress.get_nowait())
+            except queue.Empty:
+                break
+        return [r for r in out if not r.done]
+
+    # ------------------------------------------------------------------
+    # engine thread
+    # ------------------------------------------------------------------
+    def _loop(self) -> None:
+        try:
+            while self._running:
+                now = self.clock()
+                self._drain_ingress()
+                self._process_cancellations(now)
+                self.scheduler.admit(now)
+                progressed = False
+                if self.engine.has_work():
+                    t0 = self.clock()
+                    out = self.engine.step()
+                    self._last_step_time = self.clock() - t0
+                    self._steps += 1
+                    self._deliver(out)
+                    progressed = (self.engine.last_num_scheduled > 0
+                                  or bool(out))
+                self._sample_gauges()
+                self._maybe_emit()
+                if self._draining and not self.scheduler.has_work():
+                    # under the flags lock, with no submit between its
+                    # admission check and its enqueue (_submitting == 0),
+                    # an empty ingress is conclusive
+                    with self._flags:
+                        if self._submitting == 0 and self._ingress.empty():
+                            self._running = False
+                            break
+                if not progressed:
+                    time.sleep(self.idle_s)
+        except BaseException as e:  # noqa: BLE001 - fail requests, not silently
+            self.error = e
+            logger.error(f"serving: replica {self.replica_id} engine thread "
+                         f"died: {e!r}")
+            now = self.clock()
+            with self._flags:
+                self._accepting = False   # no NEW submit passes the check...
+            while True:                   # ...and in-progress ones must land
+                self._drain_ingress()     # (consuming frees any blocked put)
+                with self._flags:
+                    if self._submitting == 0 and self._ingress.empty():
+                        break
+                time.sleep(0.001)
+            for resp in self.scheduler.evict_all():   # not-yet-pulled requests
+                resp._on_finish(FINISH_FAILED, now)   # fail too, not strand
+                self.metrics.on_finish(resp)          # their client
+        finally:
+            self._running = False
+            self._beat_stop.set()   # stopped serving = stop advertising
+
+    def _drain_ingress(self) -> None:
+        while True:
+            try:
+                resp = self._ingress.get_nowait()
+            except queue.Empty:
+                return
+            self.scheduler.add(resp)
+
+    def _process_cancellations(self, now: float) -> None:
+        for resp in [r for r in self.scheduler.pending if r.cancelled]:
+            self.scheduler.cancel_queued(resp.uid)
+            resp._on_finish(FINISH_CANCELLED, now)
+            self.metrics.on_finish(resp)
+        for resp in [r for r in self.scheduler.inflight.values()
+                     if r.cancelled]:
+            self.engine.flush(resp.uid)   # frees KV blocks mid-generation
+            self.scheduler.complete(resp.uid)
+            resp._on_finish(FINISH_CANCELLED, now)
+            self.metrics.on_finish(resp)
+
+    def _deliver(self, out: Dict[int, int]) -> None:
+        now = self.clock()
+        for uid, tok in out.items():
+            resp = self.scheduler.inflight.get(uid)
+            if resp is None:
+                continue                   # flushed by a cancel this loop
+            resp._on_token(tok, now)
+            seq = self.engine.state_manager.get(uid)
+            if seq is not None and seq.done:
+                reason = (FINISH_EOS
+                          if (resp.request.eos_token_id is not None
+                              and resp.tokens
+                              and resp.tokens[-1] == resp.request.eos_token_id)
+                          else FINISH_LENGTH)
+                self.engine.flush(uid)
+                self.scheduler.complete(uid)
+                resp._on_finish(reason, now)
+                self.metrics.on_finish(resp)
+
+    def _sample_gauges(self) -> None:
+        m = self.metrics
+        m.preemptions = self.scheduler.preemptions
+        m.sample(queue_depth=self.queue_depth,
+                 inflight=self.inflight_count,
+                 kv_free_blocks=self.engine.kv.free_blocks,
+                 kv_total_blocks=self.engine.kv.num_blocks)
+
+    def _start_beater(self) -> None:
+        if self.heartbeat is None:
+            return
+        if self._beat_thread is not None and self._beat_thread.is_alive():
+            return
+        self._beat_stop.clear()
+        self._beat_thread = threading.Thread(
+            target=self._beat_loop,
+            name=f"llm-server-{self.replica_id}-beat", daemon=True)
+        self._beat_thread.start()
+
+    def _beat_loop(self) -> None:
+        """Process-liveness beacon on its OWN thread. The engine loop can sit
+        inside a single step for tens of seconds (first XLA compile, a long
+        packed prefill) — a loop-driven beat would starve past the router's
+        ``dead_after_s`` and a merely-warming-up replica would be declared
+        dead and its whole backlog requeued. Step/step-time ride along for
+        straggler observation; liveness itself only asserts the process."""
+        while not self._beat_stop.is_set():
+            if not self.suppress_heartbeat:
+                try:
+                    self.heartbeat.beat(step=self._steps,
+                                        step_time_s=self._last_step_time)
+                except Exception as e:  # a full disk must not kill serving
+                    logger.warning(f"serving: heartbeat write failed: {e!r}")
+            self._beat_stop.wait(self.heartbeat_interval_s)
+
+    def _maybe_emit(self) -> None:
+        if self.monitor is None or self.metrics_interval_steps <= 0:
+            return
+        if (self._steps and self._steps != self._last_emit_step
+                and self._steps % self.metrics_interval_steps == 0):
+            self._last_emit_step = self._steps
+            try:
+                self.monitor.write_events(
+                    self.metrics.monitor_events(self._steps))
+            except Exception as e:  # monitoring must never stall serving
+                logger.warning(f"serving: monitor write failed: {e!r}")

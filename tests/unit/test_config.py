@@ -59,3 +59,22 @@ def test_roundtrip_dict():
     d = cfg.to_dict()
     assert d["gradient_clipping"] == 1.0
     assert d["optimizer"]["type"] == "adam"
+
+
+def test_serving_config_block():
+    cfg = load_config({"serving": {"enabled": True, "policy": "deadline",
+                                   "max_queue": 32, "default_deadline_s": 2.0,
+                                   "heartbeat_dir": "/tmp/hb",
+                                   "engine": {"num_kv_blocks": 64,
+                                              "kv_cache_dtype": "int8"}}})
+    assert cfg.serving.enabled and cfg.serving.policy == "deadline"
+    assert cfg.serving.max_queue == 32
+    assert cfg.serving.default_deadline_s == 2.0
+    assert cfg.serving.engine["kv_cache_dtype"] == "int8"
+    # default-off
+    assert load_config(None).serving.enabled is False
+    # string shorthand: "serving": "<policy>"
+    cfg2 = load_config({"serving": "priority"})
+    assert cfg2.serving.enabled and cfg2.serving.policy == "priority"
+    with pytest.raises(ConfigError):
+        load_config({"serving": {"enabled": True, "bogus_knob": 1}})

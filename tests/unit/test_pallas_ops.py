@@ -71,6 +71,32 @@ def test_quant_zero_block():
     np.testing.assert_array_equal(np.asarray(y), 0.0)
 
 
+@pytest.mark.parametrize("shape", [(16,), (6, 16), (2, 5, 3, 4, 12)])
+def test_quantize_rows_roundtrip(shape):
+    """Row-wise absmax int8 (the int8 KV-cache storage form): per-row error
+    bounded by that ROW's absmax/254 (round-to-nearest), scales shaped like
+    the leading axes."""
+    from deepspeed_tpu.ops.pallas.quant import dequantize_rows, quantize_rows
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=shape) * 3, jnp.float32)
+    q, s = quantize_rows(x)
+    assert q.dtype == jnp.int8 and q.shape == x.shape
+    assert s.dtype == jnp.float32 and s.shape == x.shape[:-1]
+    y = dequantize_rows(q, s, jnp.float32)
+    err = np.abs(np.asarray(y) - np.asarray(x))
+    bound = np.abs(np.asarray(x)).max(axis=-1) / 254 + 1e-6   # half-ULP/row
+    assert (err.max(axis=-1) <= bound).all()
+    # zero rows quantize to zero payload with scale 1.0 (exact dequant)
+    q0, s0 = quantize_rows(jnp.zeros((4, 8), jnp.float32))
+    np.testing.assert_array_equal(np.asarray(q0), 0)
+    np.testing.assert_array_equal(np.asarray(s0), 1.0)
+    np.testing.assert_array_equal(np.asarray(dequantize_rows(q0, s0)), 0.0)
+    # requested output dtype is honored (the KV gather dequantizes into the
+    # compute dtype)
+    assert dequantize_rows(q, s, jnp.bfloat16).dtype == jnp.bfloat16
+
+
 def test_quantized_all_gather():
     topo = Topology(TopologySpec())
     mesh = topo.mesh

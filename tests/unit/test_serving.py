@@ -1,0 +1,700 @@
+"""Serving tier tests (`deepspeed_tpu/serving/`).
+
+Reference shape: FastGen's MIIAsyncPipeline tests — a background thread owns
+the ragged engine while clients submit/await from other threads. Coverage:
+
+* request/response lifecycle + latency views (units, fake clock);
+* scheduler policy units against a fake engine (FCFS / priority with
+  preempt-and-requeue / EDF deadline, head-of-line blocking, permanent
+  rejects) — deterministic, no jax;
+* LLMServer end-to-end on a real tiny engine: greedy parity vs the bare
+  engine, drain() finishing all in-flight work, overload shedding,
+  queued + in-flight cancellation freeing KV blocks;
+* the seeded open-loop run (satellite): schedule determinism, the
+  block-reservation invariant checked at every engine.put, drain
+  completing every admitted request;
+* the replica-death drill (satellite): a halted replica's stale beacon
+  makes the router requeue its in-flight requests onto the survivor with
+  no request lost;
+* metrics histograms + Serving/* monitor events; from_config wiring;
+* a `slow`-marked soak kept out of tier-1.
+"""
+
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.models.transformer import TransformerConfig, TransformerLM
+from deepspeed_tpu.serving import (FINISH_CANCELLED, FINISH_EOS, FINISH_FAILED,
+                                   FINISH_LENGTH, ContinuousBatchScheduler,
+                                   LatencyHistogram, LLMServer, OpenLoopTraffic,
+                                   ReplicaRouter, Request, ServedResponse,
+                                   ServerOverloaded, ServingMetrics,
+                                   TrafficConfig)
+from deepspeed_tpu.serving.traffic import LengthDist
+
+
+# ---------------------------------------------------------------------------
+# fixtures / fakes
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = TransformerConfig(vocab_size=97, hidden_size=48, intermediate_size=96,
+                            num_layers=2, num_heads=4, num_kv_heads=2,
+                            max_seq_len=128, dtype=jnp.float32,
+                            norm="rmsnorm", activation="swiglu")
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _engine(tiny_model, **over):
+    model, params = tiny_model
+    kw = dict(token_budget=16, max_ragged_sequence_count=4, max_chunk_size=8,
+              num_kv_blocks=64, kv_block_size=8, max_blocks_per_seq=8,
+              dtype="float32")
+    kw.update(over)
+    return InferenceEngineV2(model, params, RaggedInferenceEngineConfig(**kw))
+
+
+class _FakeEngine:
+    """The exact surface the scheduler touches — can_schedule/put/flush with
+    real worst-case block accounting, state_manager.get for victim picks —
+    so policy tests are deterministic and jax-free."""
+
+    def __init__(self, num_blocks=8, block_size=4, max_seqs=8,
+                 max_seq_len=1024, max_blocks_per_seq=64):
+        self.config = SimpleNamespace(max_ragged_sequence_count=max_seqs,
+                                      kv_block_size=block_size,
+                                      max_blocks_per_seq=max_blocks_per_seq)
+        self.cfg = SimpleNamespace(max_seq_len=max_seq_len)
+        # ``num_blocks`` here is the USABLE pool; the real cache counts the
+        # trash block too (usable = kv.num_blocks - 1), so mirror that
+        self.kv = SimpleNamespace(num_blocks=num_blocks + 1)
+        self.free = num_blocks
+        self.seqs = {}
+        self.put_order = []
+        self.state_manager = SimpleNamespace(get=self.seqs.get)
+
+    def _need(self, plen, mnt):
+        return -(-(plen + mnt) // self.config.kv_block_size)
+
+    def can_schedule(self, plen, mnt):
+        if plen + mnt > self.cfg.max_seq_len:
+            return False, "exceeds the model's max_seq_len"
+        need = self._need(plen, mnt)
+        if need > self.config.max_blocks_per_seq:
+            return False, f"needs {need} blocks > max_blocks_per_seq"
+        if need > self.free:
+            return False, f"KV pool has {self.free} uncommitted free blocks"
+        return True, ""
+
+    def put(self, uids, prompts, max_new_tokens=256, eos_token_id=None):
+        for uid, p in zip(uids, prompts):
+            need = self._need(len(p), max_new_tokens)
+            assert need <= self.free, "put past can_schedule (over-commit)"
+            self.free -= need
+            self.seqs[uid] = SimpleNamespace(done=False, in_prefill=True,
+                                             blocks=need)
+            self.put_order.append(uid)
+
+    def flush(self, uid):
+        seq = self.seqs.pop(uid, None)
+        if seq is not None:
+            self.free += seq.blocks
+
+    @property
+    def uncommitted_free_blocks(self):
+        return self.free                # put() already commits worst-case
+
+
+def _resp(uid, *, plen=4, mnt=4, arrival=0.0, priority=0, deadline=None):
+    req = Request(np.arange(1, plen + 1, dtype=np.int32),
+                  max_new_tokens=mnt, priority=priority, deadline_s=deadline)
+    return ServedResponse(req, uid, arrival)
+
+
+# ---------------------------------------------------------------------------
+# request / response lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_request_validation():
+    with pytest.raises(ValueError, match="empty prompt"):
+        Request(np.array([], np.int32))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        Request(np.array([1], np.int32), max_new_tokens=0)
+    r = Request([3, 4, 5])  # lists coerce to int32
+    assert r.prompt.dtype == np.int32 and r.prompt.shape == (3,)
+
+
+def test_response_lifecycle_and_latency_views():
+    resp = _resp(0, plen=3, mnt=4, arrival=10.0, deadline=2.5)
+    assert resp.ttft_s is None and resp.e2e_s is None and resp.tpot_s is None
+    assert resp.sla_violated() is None
+    resp._on_admit(10.5)
+    resp._on_token(7, 11.0)
+    resp._on_token(8, 11.5)
+    resp._on_token(9, 12.0)
+    resp._on_finish(FINISH_LENGTH, 12.0)
+    assert resp.done and resp.wait(0)
+    np.testing.assert_array_equal(resp.result(), [7, 8, 9])
+    assert resp.ttft_s == pytest.approx(1.0)
+    assert resp.e2e_s == pytest.approx(2.0)
+    assert resp.tpot_s == pytest.approx(0.5)   # (12.0-11.0)/(3-1)
+    assert resp.deadline_time == pytest.approx(12.5)
+    assert resp.sla_violated() is False
+
+
+def test_response_requeue_keeps_sla_clock():
+    resp = _resp(1, arrival=5.0)
+    resp._on_admit(5.1)
+    resp._on_token(42, 5.2)
+    resp._on_requeue()
+    assert resp.tokens == [] and resp.first_token_time is None
+    assert resp.arrival_time == 5.0 and resp.preemptions == 1
+
+
+def test_response_cancel_and_stream_callback():
+    got = []
+    req = Request(np.array([1], np.int32),
+                  stream=lambda tok, r: got.append(tok))
+    resp = ServedResponse(req, 2, 0.0)
+    resp._on_token(5, 1.0)
+    resp._on_token(6, 2.0)
+    assert got == [5, 6]
+    resp.cancel()
+    assert resp.cancelled
+    resp._on_finish(FINISH_CANCELLED, 3.0)
+    with pytest.raises(RuntimeError, match="cancelled"):
+        resp.result(0)
+    # a raising stream callback never propagates
+    req2 = Request(np.array([1], np.int32),
+                   stream=lambda tok, r: 1 / 0)
+    resp2 = ServedResponse(req2, 3, 0.0)
+    resp2._on_token(9, 1.0)   # does not raise
+    assert resp2.tokens == [9]
+
+
+# ---------------------------------------------------------------------------
+# scheduler policy units (fake engine, fake clock)
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_fcfs_arrival_order():
+    eng = _FakeEngine(num_blocks=64)
+    s = ContinuousBatchScheduler(eng, "fcfs", clock=lambda: 100.0)
+    for uid, t in ((3, 2.0), (1, 0.5), (2, 1.0)):
+        s.add(_resp(uid, arrival=t))
+    admitted = s.admit()
+    assert [r.uid for r in admitted] == [1, 2, 3]
+    assert eng.put_order == [1, 2, 3]
+
+
+def test_scheduler_deadline_edf_order_under_contention():
+    eng = _FakeEngine(num_blocks=64)
+    s = ContinuousBatchScheduler(eng, "deadline", max_inflight=1,
+                                 clock=lambda: 0.0)
+    s.add(_resp(1, arrival=0.0, deadline=9.0))
+    s.add(_resp(2, arrival=0.0, deadline=3.0))
+    s.add(_resp(3, arrival=0.0))               # no deadline sorts last
+    s.add(_resp(4, arrival=0.0, deadline=6.0))
+    order = []
+    while s.pending:
+        (got,) = s.admit()
+        order.append(got.uid)
+        eng.seqs[got.uid].done = True          # finishes; frees the slot
+        eng.flush(got.uid)
+        s.complete(got.uid)
+    assert order == [2, 4, 1, 3]
+
+
+def test_scheduler_priority_preempts_prefill():
+    # pool = 4 blocks; one request commits all of them
+    eng = _FakeEngine(num_blocks=4, block_size=4)
+    s = ContinuousBatchScheduler(eng, "priority", clock=lambda: 0.0)
+    low = _resp(1, plen=8, mnt=8, priority=0)       # needs 4 blocks
+    s.add(low)
+    s.admit()
+    assert 1 in s.inflight and eng.free == 0
+    high = _resp(2, plen=8, mnt=8, priority=5)
+    s.add(high)
+    s.admit()
+    assert 2 in s.inflight and s.preemptions == 1
+    assert low in s.pending and low.preemptions == 1
+    assert 1 not in eng.seqs                        # victim's blocks freed
+
+
+def test_scheduler_never_preempts_decode_or_equal_rank():
+    eng = _FakeEngine(num_blocks=4, block_size=4)
+    s = ContinuousBatchScheduler(eng, "priority", clock=lambda: 0.0)
+    victim = _resp(1, plen=8, mnt=8, priority=0)
+    s.add(victim)
+    s.admit()
+    eng.seqs[1].in_prefill = False                  # now decoding
+    s.add(_resp(2, plen=8, mnt=8, priority=5))
+    assert s.admit() == []                          # decode never evicted
+    assert 1 in s.inflight and s.preemptions == 0
+    # back in prefill but the candidate only TIES: no thrash
+    eng.seqs[1].in_prefill = True
+    s.pending[0].request.priority = 0
+    assert s.admit() == [] and s.preemptions == 0
+
+
+def test_scheduler_head_of_line_blocking():
+    eng = _FakeEngine(num_blocks=4, block_size=4)
+    s = ContinuousBatchScheduler(eng, "fcfs", clock=lambda: 0.0)
+    s.add(_resp(0, plen=4, mnt=4, arrival=0.0))     # commits 2 of 4 blocks
+    s.admit()
+    # head needs 4: fits an EMPTY pool (so not a permanent reject) but not
+    # the 2 free now — a transient refusal that must hold the line
+    s.add(_resp(1, plen=8, mnt=8, arrival=1.0))
+    s.add(_resp(2, plen=2, mnt=2, arrival=2.0))     # would fit the 2 free
+    assert s.admit() == []                          # 2 must not skip ahead
+    assert len(s.pending) == 2 and eng.put_order == [0]
+
+
+def test_scheduler_permanent_reject_fails_fast():
+    eng = _FakeEngine(num_blocks=64, max_seq_len=16)
+    m = ServingMetrics()
+    s = ContinuousBatchScheduler(eng, "fcfs", metrics=m, clock=lambda: 0.0)
+    doomed = _resp(1, plen=12, mnt=12, arrival=0.0)  # 24 > max_seq_len 16
+    ok = _resp(2, plen=4, mnt=4, arrival=1.0)
+    s.add(doomed)
+    s.add(ok)
+    admitted = s.admit()
+    assert [r.uid for r in admitted] == [2]
+    assert doomed.done and doomed.finish_reason == FINISH_FAILED
+    assert s.failed == 1 and m.failed == 1           # telemetry sees it too
+    with pytest.raises(RuntimeError, match="failed"):
+        doomed.result(0)                             # never reads as success
+
+
+def test_scheduler_cancelled_never_admitted():
+    eng = _FakeEngine(num_blocks=64)
+    s = ContinuousBatchScheduler(eng, "fcfs", clock=lambda: 0.0)
+    resp = _resp(1)
+    resp.cancel()
+    s.add(resp)
+    assert s.admit() == []
+    assert resp.done and resp.finish_reason == FINISH_CANCELLED
+    assert eng.put_order == []
+
+
+def test_scheduler_evict_all_returns_everything():
+    eng = _FakeEngine(num_blocks=64)
+    s = ContinuousBatchScheduler(eng, "fcfs", clock=lambda: 0.0)
+    a, b = _resp(1, arrival=0.0), _resp(2, arrival=1.0)
+    s.add(a)
+    s.admit()
+    s.add(b)                                        # still queued
+    out = s.evict_all()
+    assert {r.uid for r in out} == {1, 2}
+    # engine state released, response state untouched — the router's requeue
+    # loop is the single place restarts are counted
+    assert a.preemptions == 0 and b.preemptions == 0
+    assert not s.inflight and not s.pending and eng.free == 64
+
+
+def test_scheduler_skips_futile_preemption():
+    """A candidate that cannot fit even after evicting every outranked
+    prefill must not evict anything — the victims' prefill progress would be
+    thrown away for zero gain."""
+    eng = _FakeEngine(num_blocks=8, block_size=4)
+    s = ContinuousBatchScheduler(eng, "priority", clock=lambda: 0.0)
+    small = _resp(1, plen=4, mnt=4, priority=0)     # commits 2 blocks
+    decoding = _resp(2, plen=8, mnt=8, priority=0)  # commits 4 blocks
+    s.add(small)
+    s.add(decoding)
+    s.admit()
+    eng.seqs[2].in_prefill = False                  # not preemptable
+    assert eng.free == 2
+    # fits an empty pool (8 = usable 8, not permanent) but the only eligible
+    # victim frees 2 against a deficit of 6: evicting would be futile
+    huge = _resp(3, plen=16, mnt=16, priority=9)
+    s.add(huge)
+    assert s.admit() == []
+    assert s.preemptions == 0 and 1 in s.inflight   # victim survives
+    assert huge in s.pending                        # still waiting, not failed
+
+
+def test_scheduler_pool_infeasible_fails_fast():
+    """A request whose worst-case footprint exceeds the WHOLE usable pool
+    (even though it fits max_blocks_per_seq / max_seq_len) can never be
+    admitted — it must fail fast instead of wedging the head of the queue
+    forever (regression: _permanent only checked the per-seq limits)."""
+    eng = _FakeEngine(num_blocks=7, block_size=4, max_blocks_per_seq=16)
+    s = ContinuousBatchScheduler(eng, "fcfs", clock=lambda: 0.0)
+    doomed = _resp(1, plen=16, mnt=16, arrival=0.0)  # needs 8 > usable 7
+    ok = _resp(2, plen=4, mnt=4, arrival=1.0)
+    s.add(doomed)
+    s.add(ok)
+    admitted = s.admit()
+    assert [r.uid for r in admitted] == [2]          # the line moved
+    assert doomed.done and doomed.finish_reason == FINISH_FAILED
+    assert s.failed == 1 and s.preemptions == 0
+
+
+# ---------------------------------------------------------------------------
+# LLMServer end-to-end (real engine)
+# ---------------------------------------------------------------------------
+
+
+def test_server_greedy_parity_and_drain(tiny_model):
+    engine = _engine(tiny_model)
+    free0 = engine.kv.free_blocks
+    prompts = [np.array([5, 6, 7, 8, 9], np.int32),
+               np.array([40, 41, 42], np.int32),
+               np.array([3, 1, 4, 1, 5, 9, 2, 6], np.int32)]
+    server = LLMServer(engine).start()
+    resps = [server.submit(Request(p, max_new_tokens=6)) for p in prompts]
+    assert server.drain(timeout=300)
+    ref = _engine(tiny_model).generate(prompts, max_new_tokens=6)
+    for resp, want in zip(resps, ref):
+        assert resp.done and resp.finish_reason == FINISH_LENGTH
+        np.testing.assert_array_equal(resp.result(), want)
+        assert resp.ttft_s is not None and resp.e2e_s is not None
+    m = server.metrics
+    assert m.completed == 3 and m.ttft.count == 3 and m.e2e.count == 3
+    assert m.tokens_out == 18
+    assert engine.kv.free_blocks == free0          # drain left nothing behind
+    assert engine._outstanding_blocks() == 0
+
+
+def test_server_eos_finish_reason(tiny_model):
+    engine = _engine(tiny_model)
+    server = LLMServer(engine).start()
+    # eos = the greedy first token of this prompt => generation stops at 1
+    probe = _engine(tiny_model).generate(
+        [np.array([5, 6, 7], np.int32)], max_new_tokens=1)[0]
+    resp = server.submit(Request(np.array([5, 6, 7], np.int32),
+                                 max_new_tokens=8, eos_token_id=int(probe[0])))
+    assert server.drain(timeout=300)
+    assert resp.finish_reason == FINISH_EOS and len(resp.tokens) == 1
+
+
+def test_server_overload_sheds_at_the_door():
+    server = LLMServer(_FakeEngine(), max_queue=2)
+    server.start = lambda: server                   # engine thread never runs
+    for _ in range(2):
+        server.submit(Request(np.array([1, 2], np.int32)))
+    with pytest.raises(ServerOverloaded):
+        server.submit(Request(np.array([1, 2], np.int32)))
+    assert server.metrics.rejected == 1 and server.metrics.submitted == 2
+
+
+def test_server_cancel_queued_and_inflight_frees_blocks(tiny_model):
+    engine = _engine(tiny_model, num_kv_blocks=32)
+    free0 = engine.kv.free_blocks
+    server = LLMServer(engine).start()
+    # 6 submits vs max_inflight=4: the tail waits in the scheduler queue
+    resps = [server.submit(Request(np.arange(1, 9, dtype=np.int32),
+                                   max_new_tokens=24)) for _ in range(6)]
+    # cancel one once it is actually generating (in-flight flush path)
+    t0 = time.monotonic()
+    while not resps[0].tokens and time.monotonic() - t0 < 60:
+        time.sleep(0.005)
+    assert resps[0].tokens, "first request never started generating"
+    resps[0].cancel()
+    resps[5].cancel()                               # tail: queued-cancel path
+    assert server.drain(timeout=300)
+    cancelled = [r for r in resps if r.finish_reason == FINISH_CANCELLED]
+    finished = [r for r in resps if r.finish_reason == FINISH_LENGTH]
+    assert len(cancelled) == 2 and len(finished) == 4
+    for r in finished:
+        assert len(r.tokens) == 24
+    assert server.metrics.cancelled == 2 and server.metrics.completed == 4
+    assert engine.kv.free_blocks == free0           # cancels freed their KV
+    assert engine._outstanding_blocks() == 0
+
+
+def test_server_monitor_events(tiny_model):
+    events = []
+    monitor = SimpleNamespace(write_events=events.extend)
+    server = LLMServer(_engine(tiny_model), monitor=monitor,
+                       metrics_interval_steps=1).start()
+    server.submit(Request(np.arange(1, 6, dtype=np.int32), max_new_tokens=4))
+    assert server.drain(timeout=300)
+    names = {name for name, _, _ in events}
+    assert "Serving/tokens_per_sec" in names
+    assert "Serving/queue_depth" in names
+    assert "Serving/kv_occupancy" in names
+    assert any(n == "Serving/ttft_p50_ms" for n in names)
+
+
+def test_server_monitor_no_idle_reemission(tiny_model):
+    """Once the queue empties, the idle engine loop spins with _steps frozen
+    — the monitor batch for that step must be emitted exactly once, not on
+    every idle iteration (regression: the step-multiple check alone re-fired
+    ~1/idle_s with identical events)."""
+    calls = []
+    monitor = SimpleNamespace(write_events=lambda ev: calls.append(len(ev)))
+    server = LLMServer(_engine(tiny_model), monitor=monitor,
+                       metrics_interval_steps=1).start()
+    resp = server.submit(Request(np.arange(1, 6, dtype=np.int32),
+                                 max_new_tokens=4))
+    assert resp.wait(300)
+    time.sleep(0.05)                  # the loop keeps idling past the finish
+    n = len(calls)
+    assert n >= 1
+    time.sleep(0.25)                  # no steps happen while idle...
+    assert len(calls) == n            # ...so no batch may be re-emitted
+    assert server.drain(timeout=300)
+
+
+def test_server_from_config(tiny_model):
+    model, params = tiny_model
+    server = LLMServer.from_config(model, params, {
+        "serving": {"enabled": True, "policy": "deadline", "max_queue": 7,
+                    "default_deadline_s": 9.0,
+                    "engine": {"token_budget": 16,
+                               "max_ragged_sequence_count": 4,
+                               "max_chunk_size": 8, "max_blocks_per_seq": 8,
+                               "num_kv_blocks": 24, "kv_block_size": 8,
+                               "dtype": "float32"}}})
+    assert server.scheduler.policy == "deadline"
+    assert server._ingress.maxsize == 7
+    assert server.engine.config.num_kv_blocks == 24
+    # the default SLA is stamped onto deadline-less requests
+    resp = server.submit(Request(np.array([1, 2, 3], np.int32),
+                                 max_new_tokens=2))
+    assert resp.request.deadline_s == 9.0
+    assert server.drain(timeout=300)
+    # string shorthand
+    server2 = LLMServer.from_config(model, params, {"serving": "priority"})
+    assert server2.scheduler.policy == "priority"
+    # a full ds_config with NO serving block builds a default server instead
+    # of raising ConfigError on its training keys (regression)
+    server3 = LLMServer.from_config(model, params, {"train_batch_size": 8})
+    assert server3.scheduler.policy == "fcfs"
+    # while a bare dict of ServingConfig fields is taken as the block itself
+    server4 = LLMServer.from_config(model, params, {"policy": "deadline"})
+    assert server4.scheduler.policy == "deadline"
+
+
+# ---------------------------------------------------------------------------
+# seeded open-loop runs (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_traffic_schedule_deterministic():
+    cfg = TrafficConfig(rate_rps=50.0, num_requests=20, seed=3,
+                        prompt_len=LengthDist("lognormal", 8, 32),
+                        priorities=(0, 1, 2), deadline_s=5.0)
+    a, b = OpenLoopTraffic(cfg).schedule(), OpenLoopTraffic(cfg).schedule()
+    assert [t for t, _ in a] == [t for t, _ in b]
+    for (_, ra), (_, rb) in zip(a, b):
+        np.testing.assert_array_equal(ra.prompt, rb.prompt)
+        assert (ra.max_new_tokens, ra.priority) == (rb.max_new_tokens, rb.priority)
+        assert ra.deadline_s == 5.0
+    c = OpenLoopTraffic(TrafficConfig(rate_rps=50.0, num_requests=20,
+                                      seed=4)).schedule()
+    assert [t for t, _ in a] != [t for t, _ in c]
+
+
+def test_open_loop_block_reservation_invariant_and_drain(tiny_model):
+    """The acceptance drill: a seeded open-loop run where every admission is
+    checked against the pool invariant (free - outstanding >= 0 after every
+    put), and drain() completes every admitted request."""
+    engine = _engine(tiny_model)
+    violations = []
+    orig_put = engine.put
+
+    def checked_put(uids, toks, **kw):              # runs on the engine thread
+        orig_put(uids, toks, **kw)
+        slack = engine.kv.free_blocks - engine._outstanding_blocks()
+        if slack < 0:
+            violations.append((list(uids), slack))
+
+    engine.put = checked_put
+    server = LLMServer(engine, policy="deadline", max_queue=64).start()
+    traffic = TrafficConfig(rate_rps=200.0, num_requests=16, seed=11,
+                            vocab_size=97,
+                            prompt_len=LengthDist("uniform", 4, 12),
+                            output_len=LengthDist("uniform", 4, 8),
+                            deadline_s=120.0)
+    resps, rejected = OpenLoopTraffic(traffic).run(server.submit)
+    assert server.drain(timeout=600)
+    assert not violations, f"block reservation exceeded: {violations}"
+    assert not rejected                             # queue of 64 never filled
+    assert len(resps) == 16
+    for r in resps:
+        assert r.done and r.finish_reason == FINISH_LENGTH
+        assert len(r.tokens) == r.request.max_new_tokens
+    m = server.metrics
+    assert m.completed == 16 and m.sla_tracked == 16 and m.sla_violations == 0
+    assert engine._outstanding_blocks() == 0
+
+
+@pytest.mark.slow
+def test_open_loop_soak_slow(tiny_model):
+    """Long soak (excluded from tier-1): sustained overload-adjacent traffic
+    with priorities under the priority policy — no request lost, histograms
+    stay bounded by decimation."""
+    engine = _engine(tiny_model, num_kv_blocks=96)
+    server = LLMServer(engine, policy="priority", max_queue=256).start()
+    traffic = TrafficConfig(rate_rps=300.0, num_requests=200, seed=5,
+                            vocab_size=97, priorities=(0, 1, 5),
+                            prompt_len=LengthDist("uniform", 4, 16),
+                            output_len=LengthDist("uniform", 4, 12))
+    resps, rejected = OpenLoopTraffic(traffic).run(server.submit)
+    assert server.drain(timeout=1800)
+    m = server.metrics
+    assert m.completed == len(resps)
+    assert m.completed + len(rejected) == 200
+    assert engine._outstanding_blocks() == 0
+    assert engine.kv.free_blocks == engine.config.num_kv_blocks - 1
+
+
+# ---------------------------------------------------------------------------
+# replica routing + the dead-replica drill (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_replica_death_drill_requeues_in_flight(tiny_model, tmp_path):
+    """Two replicas behind the router; replica 0 halts (simulated process
+    loss) and its beacon goes stale. router.check() must declare it dead and
+    requeue every one of its unfinished requests onto replica 1 — the
+    drill's contract is that NO request is lost."""
+    from deepspeed_tpu.runtime.resilience.heartbeat import FileHeartbeatTransport
+
+    e0 = _engine(tiny_model, num_kv_blocks=96, max_blocks_per_seq=16)
+    e1 = _engine(tiny_model, num_kv_blocks=96, max_blocks_per_seq=16)
+    # warm the jitted step so replica steps are ms-scale from the start
+    _engine(tiny_model, num_kv_blocks=96, max_blocks_per_seq=16).generate(
+        [np.arange(1, 9, dtype=np.int32)], max_new_tokens=2)
+    r0 = LLMServer(e0, replica_id=0, heartbeat_interval_s=0.02)
+    r1 = LLMServer(e1, replica_id=1, heartbeat_interval_s=0.02)
+    transport = FileHeartbeatTransport(str(tmp_path))
+    router = ReplicaRouter([r0, r1], transport=transport,
+                           dead_after_s=0.5).start()
+    resps = [router.submit(Request(np.arange(1, 11, dtype=np.int32),
+                                   max_new_tokens=64), block=True)
+             for _ in range(8)]
+    # least-loaded dispatch interleaves the two replicas
+    assert {r.replica_id for r in resps} == {0, 1}
+    time.sleep(0.08)                  # both loops ran: first beacons exist
+    r0.halt()                         # simulated replica loss mid-serving
+    victims = [r for r in resps if r.replica_id == 0 and not r.done]
+    assert victims, "replica 0 finished everything before the drill halt"
+    time.sleep(0.7)                   # r0's beacon goes stale (> dead_after_s)
+    assert router.check() == [0]
+    assert router.requeues == len(victims)
+    for r in resps:
+        assert r.wait(300), f"request {r} lost after replica death"
+        assert r.finish_reason == FINISH_LENGTH
+        assert len(r.tokens) == 64
+    for v in victims:
+        assert v.preemptions >= 1 and v.replica_id == 1
+    assert r1.metrics.requeues == len(victims)   # survivor's gauge saw them
+    assert router.check() == []       # no double takeover (r1 still fresh)
+    assert router.drain(timeout=300)
+    assert e1._outstanding_blocks() == 0
+
+
+def test_router_least_loaded_and_validation(tiny_model):
+    e = _engine(tiny_model)
+    with pytest.raises(ValueError, match="duplicate"):
+        ReplicaRouter([LLMServer(e, replica_id=0), LLMServer(e, replica_id=0)])
+    with pytest.raises(ValueError, match="at least one"):
+        ReplicaRouter([])
+
+
+def test_router_drain_replica_stops_dispatch(tiny_model):
+    r0 = LLMServer(_engine(tiny_model), replica_id=0)
+    r1 = LLMServer(_engine(tiny_model), replica_id=1)
+    router = ReplicaRouter([r0, r1]).start()
+    assert router.drain_replica(0, timeout=300)
+    assert router.alive_ids() == [1]
+    resp = router.submit(Request(np.array([1, 2, 3], np.int32),
+                                 max_new_tokens=4), block=True)
+    assert resp.replica_id == 1
+    assert router.drain(timeout=300)
+    assert resp.done and resp.finish_reason == FINISH_LENGTH
+
+
+def test_heartbeat_beats_through_a_long_step(tiny_model, tmp_path):
+    """The beacon asserts PROCESS liveness from its own beater thread: a
+    step that outlasts ``dead_after_s`` (first XLA compile, a long packed
+    prefill) must not starve it. The regression here was a loop-driven beat
+    — the router would declare a merely-warming-up replica dead and requeue
+    its whole backlog onto survivors (or fail it all with none left)."""
+    from deepspeed_tpu.runtime.resilience.heartbeat import (
+        FileHeartbeatTransport, HealthTable, HeartbeatWriter)
+
+    eng = _engine(tiny_model)
+    orig_step = eng.step
+    def slow_step():                      # each step outlasts dead_after_s
+        time.sleep(0.4)
+        return orig_step()
+    eng.step = slow_step
+    transport = FileHeartbeatTransport(str(tmp_path))
+    table = HealthTable(transport, dead_after_s=0.2)
+    server = LLMServer(eng, replica_id=0, heartbeat_interval_s=0.02)
+    server.heartbeat = HeartbeatWriter(transport, 0)  # as the router attaches
+    server.start()
+    resp = server.submit(Request(np.array([1, 2, 3], np.int32),
+                                 max_new_tokens=4))
+    checked = 0
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not resp.done:
+        rows = table.read()
+        if rows:
+            assert all(r.alive for r in rows), \
+                "beacon starved while the engine thread sat in a slow step"
+            checked += 1
+        time.sleep(0.05)
+    assert checked > 0 and resp.done
+    assert server.drain(timeout=300)
+    time.sleep(0.3)                       # stopped server = beacon goes stale
+    assert all(not r.alive for r in table.read())
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+def test_latency_histogram_percentiles_and_decimation():
+    h = LatencyHistogram(cap=64)
+    for v in range(1, 101):                         # 1..100 ms as seconds
+        h.record(v / 1e3)
+    assert h.count == 100
+    assert len(h._xs) < 64                          # decimated, bounded
+    assert max(h._xs) == pytest.approx(0.100)       # the max survives it
+    assert h.p50 == pytest.approx(0.050, abs=0.02)
+    assert h.p99 == pytest.approx(0.100, abs=0.02)
+    snap = h.snapshot_ms()
+    assert snap["count"] == 100 and snap["p99_ms"] >= snap["p50_ms"]
+    empty = LatencyHistogram()
+    assert empty.p50 is None and empty.snapshot_ms()["p50_ms"] is None
+
+
+def test_serving_metrics_sla_and_events():
+    clock = [0.0]
+    m = ServingMetrics(clock=lambda: clock[0])
+    ok = _resp(1, deadline=10.0)
+    ok._on_admit(0.5); ok._on_token(1, 1.0); ok._on_token(2, 2.0)
+    ok._on_finish(FINISH_LENGTH, 2.0)
+    late = _resp(2, arrival=0.0, deadline=1.0)
+    late._on_admit(0.5); late._on_token(1, 3.0)
+    late._on_finish(FINISH_LENGTH, 3.0)
+    m.on_finish(ok); m.on_finish(late)
+    assert m.completed == 2 and m.sla_tracked == 2 and m.sla_violations == 1
+    m.sample(queue_depth=3, inflight=2, kv_free_blocks=10, kv_total_blocks=40)
+    assert m.kv_occupancy() == pytest.approx(0.75)
+    clock[0] = 2.0
+    events = dict((name, val) for name, val, _ in m.monitor_events(7))
+    assert events["Serving/completed"] == 2
+    assert events["Serving/sla_violations"] == 1
+    assert events["Serving/kv_occupancy"] == pytest.approx(0.75)
+    assert events["Serving/tokens_per_sec"] == pytest.approx(3 / 2.0)
