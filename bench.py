@@ -1166,13 +1166,117 @@ def serving_bench():
             "device": getattr(dev, "device_kind", dev.platform)}
 
 
+def dcn_hierarchical_bench():
+    """Rung ds (multi-slice DCN tier, comm/planner + comm/compressed.py):
+    hierarchical-vs-flat DP-grad reduction on a 2-axis dp mesh — dp_outer=4
+    declared the DCN axis via the planner's ``dcn_axes`` override, ep=2 as
+    the slice-local ICI axis (simulated DCN split on the virtual CPU mesh;
+    both arms run the same program a real multi-slice fleet would). Arms:
+    flat int8 all-reduce over the whole dp span (every link, including the
+    slow cross-slice one, carries the full quantized payload) vs the
+    planner-synthesized multi-phase program (exact reduce-scatter over ICI,
+    int8+error-feedback all-reduce over the DCN axis on the 1/ici-sized
+    shard, all-gather back over ICI). Metric: DCN-class wire bytes per step
+    from the comms ledger hop buckets — the bytes that actually cross the
+    ~8x-slower link — with flat's full payload as the DCN-equivalent
+    baseline; step times ride along (noise on CPU, as in rung qx: the
+    ledger numbers are the measurement)."""
+    import deepspeed_tpu as ds
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.comm.planner import reset_planner
+    from deepspeed_tpu.parallel import Topology, TopologySpec
+
+    if len(jax.devices()) < 8:
+        return {"metric": "dcn_hierarchical", "value": None, "unit": "ratio",
+                "vs_baseline": None, "error": "needs an 8-device mesh"}
+
+    rng = np.random.default_rng(0)
+    params = {"w1": jnp.asarray(rng.normal(size=(512, 1024)) * 0.05,
+                                jnp.float32),
+              "w2": jnp.asarray(rng.normal(size=(1024, 64)) * 0.05,
+                                jnp.float32)}  # ~0.59M params, ~2.4MB grads
+
+    def loss_fn(p, batch, rng=None):
+        x, y = batch
+        pred = jnp.tanh(x @ p["w1"]) @ p["w2"]
+        return jnp.mean((pred - y) ** 2)
+
+    def batch(i, n=8 * 8):
+        r = np.random.default_rng(1000 + i)
+        x = jnp.asarray(r.normal(size=(n, 512)), jnp.float32)
+        return (x, jnp.asarray(x[:, :64] * 0.5, jnp.float32))
+
+    base = {"train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0}, "steps_per_print": 10**9,
+            # ledger on via the CONFIG: initialize() reconfigures the
+            # fleet logger from it, so enabling by hand beforehand is wiped
+            "comms_logger": {"enabled": True, "prof_all": True}}
+    logger = dist.get_comms_logger()
+    steps = 4
+
+    def run(extra):
+        cfg = dict(base)
+        cfg.update(extra)
+        logger.reset()
+        eng, *_ = ds.initialize(model=loss_fn,
+                                model_parameters=jax.tree.map(jnp.copy,
+                                                              params),
+                                config=cfg,
+                                topology=Topology(TopologySpec(ep=2)))
+        float(eng.train_batch(batch(0)))  # compile + first step
+        totals, hops = logger.totals(), logger.hop_totals()
+        logger.reset()
+        t0 = time.perf_counter()
+        losses = [float(eng.train_batch(batch(1 + i))) for i in range(steps)]
+        dt = (time.perf_counter() - t0) / steps
+        logger.reset()
+        return eng, totals, hops, dt, losses
+
+    # flat arm: int8 over the full dp span, no planner
+    _, flat_tot, _, t_flat, _ = run({"compressed_collectives": "int8"})
+    reset_planner()
+    eng, prog_tot, prog_hops, t_prog, losses = run(
+        {"comm_planner": {"mode": "static", "use_cache": False,
+                          "dcn_axes": ["dp_outer"]}})
+    from deepspeed_tpu.comm.planner import program_summary
+    impl = eng._dp_grad_impl  # None when the planner picked the exact psum
+    program = (program_summary(impl[2]) if impl and impl[0] == "program"
+               else impl[0] if impl else "exact-xla")
+
+    # per-trace normalization: each arm's collectives log once per trace of
+    # the step function; the op counts say how many traces the arm saw
+    flat_row = flat_tot.get("quantized_all_reduce", {})
+    n_flat = max(flat_row.get("count", 1), 1)
+    flat_wire = flat_row.get("wire_bytes", 0) // n_flat  # full span = DCN-class
+    n_prog = max(prog_tot.get("program_reduce_scatter", {}).get("count", 1), 1)
+    dcn_wire = prog_hops.get("dcn", 0) // n_prog
+    ici_wire = prog_hops.get("ici", 0) // n_prog
+    exact_bytes = 4 * sum(int(np.prod(p.shape)) for p in
+                          jax.tree.leaves(params))  # what flat fp32 moves
+    return {"metric": "dcn_hierarchical",
+            "value": round(flat_wire / dcn_wire, 2) if dcn_wire else None,
+            "unit": "dcn-wire-reduction",
+            "vs_baseline": None, "program": program,
+            "flat_int8_wire_bytes": flat_wire,
+            "program_dcn_wire_bytes": dcn_wire,
+            "program_ici_wire_bytes": ici_wire,
+            "exact_flat_bytes": exact_bytes,
+            "dcn_reduction_vs_exact": (round(exact_bytes / dcn_wire, 2)
+                                       if dcn_wire else None),
+            "t_flat_s": round(t_flat, 6), "t_program_s": round(t_prog, 6),
+            "final_loss": round(losses[-1], 6),
+            "devices": len(jax.devices()),
+            "device": jax.devices()[0].platform}
+
+
 RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "3b": rung3b_big_model,
          "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses,
          "cm": collective_matmul_bench, "qx": quantized_collectives_bench,
          "plan": planner_bench, "rz": resilience_bench,
          "wd": watchdog_bench, "fl": fused_hotpath_bench,
-         "sv": serving_bench}
+         "sv": serving_bench, "ds": dcn_hierarchical_bench}
 
 
 def _with_ledger(fn):
@@ -1217,7 +1321,10 @@ def run_ladder():
             ("cm", {} if multichip else cpu8),
             ("qx", {} if multichip else cpu8),
             ("plan", {} if multichip else cpu8),
-            ("rz", chip), ("wd", cpu1), ("fl", chip), ("sv", chip)]
+            ("rz", chip), ("wd", cpu1), ("fl", chip), ("sv", chip),
+            # ds simulates the DCN split (dcn_axes override) — the virtual
+            # CPU mesh IS the measurement substrate, even beside a real chip
+            ("ds", cpu8)]
     results = []
     for rung, env_over in plan:
         env = dict(os.environ)
@@ -1262,7 +1369,7 @@ if __name__ == "__main__":
 
         flags_preset = ("--xla_force_host_platform_device_count"
                         in os.environ.get("XLA_FLAGS", ""))
-        needs_cpu8 = args.rung in ("4", "5")
+        needs_cpu8 = args.rung in ("4", "5", "ds")
         if args.rung in ("cm", "qx", "plan") and not flags_preset:
             # these run on the real mesh only when it's healthy AND >1 chip
             # (subprocess probes; this process must not init the backend yet)

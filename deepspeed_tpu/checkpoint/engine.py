@@ -279,8 +279,14 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         ls = LossScaleState(scale=tree["loss_scale"]["scale"],
                             good_steps=tree["loss_scale"]["good_steps"],
                             hysteresis=tree["loss_scale"]["hysteresis"])
+    # loading a checkpoint jumps to different params: a stale error-feedback
+    # residual must not replay into them — keep the structure (compiled
+    # steps expect it) but zero the carry
     engine.state = TrainState(step=step, params=tree["params"], opt_state=opt_state,
-                              loss_scale=ls)
+                              loss_scale=ls,
+                              comm_feedback=jax.tree.map(
+                                  jax.numpy.zeros_like,
+                                  engine.state.comm_feedback))
 
     host_adam = getattr(engine, "_host_adam", None)
     if host_adam is not None:

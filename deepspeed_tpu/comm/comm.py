@@ -157,13 +157,16 @@ def log_chunked(op: str, nbytes: int, wire_bytes: Optional[int] = None) -> None:
     _COMMS_LOGGER.append(op, int(nbytes), traced=True, wire_bytes=wire_bytes)
 
 
-def log_compressed(op: str, logical_bytes: int, wire_bytes: int) -> None:
+def log_compressed(op: str, logical_bytes: int, wire_bytes: int,
+                   link: Optional[str] = None) -> None:
     """Trace-time ledger entry for a compressed collective
     (``comm/compressed.py``): ``logical_bytes`` is what the exact collective
     would have moved, ``wire_bytes`` what the int8 payload + scale lanes
-    actually ride the links with — ``log_summary`` reports the ratio."""
+    actually ride the links with — ``log_summary`` reports the ratio.
+    ``link`` (ici/dcn/host) buckets the wire bytes per hop class for
+    multi-phase program phases (``CommsLogger.hop_totals``)."""
     _COMMS_LOGGER.append(op, int(logical_bytes), traced=True,
-                         wire_bytes=int(wire_bytes))
+                         wire_bytes=int(wire_bytes), hop_class=link)
 
 
 def all_reduce(x, axis: Axis, op: str = "sum"):

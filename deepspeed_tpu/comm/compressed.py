@@ -53,6 +53,9 @@ __all__ = [
     "quantized_all_to_all", "quantized_all_gather", "quantized_reduce_scatter",
     "configure_compression", "compression_mode", "compression_block",
     "compression_hierarchical", "allreduce_feedback_init",
+    "run_collective_program", "program_feedback_layout",
+    "program_feedback_init", "feedback_state", "store_feedback",
+    "clear_feedback",
 ]
 
 # ---------------------------------------------------------------------------
@@ -124,10 +127,11 @@ def _nbytes(x) -> int:
     return nbytes(x)
 
 
-def _log(op: str, logical: int, wire: int) -> None:
+def _log(op: str, logical: int, wire: int,
+         link: Optional[str] = None) -> None:
     from .comm import log_compressed
 
-    log_compressed(op, logical, wire)
+    log_compressed(op, logical, wire, link=link)
 
 
 def _quantize_parts(parts, block, stochastic, key):
@@ -167,7 +171,7 @@ def allreduce_feedback_init(shape, world: int):
 
 def quantized_all_reduce(x, axis: Axis, *, block: Optional[int] = None,
                          stochastic: bool = False, key=None,
-                         feedback=None):
+                         feedback=None, link: Optional[str] = None):
     """Mean all-reduce over ``axis`` with int8 payloads on every hop.
 
     Two stages (the EQuARX decomposition):
@@ -242,7 +246,7 @@ def quantized_all_reduce(x, axis: Axis, *, block: Optional[int] = None,
     nb1 = world * (shard_p // b1)
     nb2 = shard_p // b1
     wire = (world * shard_p + 4 * nb1) + (shard_p + 4 * nb2)
-    _log("quantized_all_reduce", _nbytes(x), wire)
+    _log("quantized_all_reduce", _nbytes(x), wire, link)
     if feedback is not None:
         return out, type(feedback)(worker_error=new_worker,
                                    server_error=new_server)
@@ -255,11 +259,202 @@ def hierarchical_quantized_all_reduce(x, inner_axis: Axis, outer_axis: Axis,
     ``zeropp.hierarchical_all_gather``'s axis split): the INNER mesh axis —
     the ICI-local hop, where bandwidth is cheap — reduces EXACT; only the
     outer hops (cross-slice / DCN) carry quantized payloads. Error model:
-    one quantization round-trip regardless of inner axis size."""
+    one quantization round-trip regardless of inner axis size.
+
+    Note the inner hop here is a full-width all-reduce — every rank moves
+    the WHOLE tensor twice over ICI before the outer hop sees it. The
+    planner-synthesized program form (:func:`run_collective_program`) is
+    strictly better when the mesh distinguishes DCN axes: exact
+    reduce-scatter over ICI shrinks the DCN payload by the inner span
+    before the quantized outer hop, and an all-gather restores it after."""
     from . import comm as dist
 
     inner_mean = dist.all_reduce(x, inner_axis, op="mean")
     return quantized_all_reduce(inner_mean, outer_axis, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# multi-phase collective programs (comm/planner plan-IR execution)
+# ---------------------------------------------------------------------------
+
+
+def _phase_sizes(n: int, phase_op: str, p: int) -> tuple:
+    """(padded_in, out_len) for one phase on an ``n``-element payload over a
+    ``p``-rank span. Reduce-scatter pads to the ``p * 128`` quantum so every
+    downstream shard stays 128-lane aligned for the quantized hops."""
+    if phase_op == "reduce_scatter":
+        quantum = p * 128
+        n_p = -(-n // quantum) * quantum
+        return n_p, n_p // p
+    if phase_op == "all_gather":
+        return n, n * p
+    return n, n  # all_reduce keeps the payload width
+
+
+def program_feedback_layout(n: int, program, axis_sizes) -> Optional[tuple]:
+    """``(worker_shape, server_shape)`` of the ``ErrorFeedbackState`` the
+    program's ``int8_ef`` phase carries for a flat ``n``-element input, or
+    ``None`` when no phase uses error feedback. ``axis_sizes`` maps axis
+    name -> size (host-side mesh facts — the engine calls this at compile
+    time to allocate the cross-step residual buffers). Mirrors
+    :func:`run_collective_program`'s padding exactly; a drifting copy of
+    this arithmetic would silently zero the residual every step."""
+    cur = int(n)
+    for st in program:
+        p = 1
+        for a in st.axes:
+            p *= int(axis_sizes.get(a, 1) if hasattr(axis_sizes, "get")
+                     else axis_sizes(a))
+        if p <= 1:
+            continue
+        if st.phase_op == "all_reduce" and st.wire_dtype == "int8_ef":
+            return ((cur,), (-(-cur // p),))
+        cur = _phase_sizes(cur, st.phase_op, p)[1]
+    return None
+
+
+def program_feedback_init(n: int, program, axis_sizes):
+    """Zero ``ErrorFeedbackState`` matching :func:`program_feedback_layout`
+    (``None`` for a feedback-free program)."""
+    from ..compression.onebit import ErrorFeedbackState
+
+    layout = program_feedback_layout(n, program, axis_sizes)
+    if layout is None:
+        return None
+    w, s = layout
+    return ErrorFeedbackState(worker_error=jnp.zeros(w, jnp.float32),
+                              server_error=jnp.zeros(s, jnp.float32))
+
+
+def run_collective_program(x, program, *, feedback=None, key=None):
+    """Execute a planner-synthesized multi-phase MEAN all-reduce program on
+    a per-shard tensor (called inside ``shard_map``, the ``comm.comm``
+    calling convention).
+
+    ``program`` is an ordered tuple of ``planner.ir.PhaseStep``; the
+    canonical shape is the DCN-aware hierarchy — exact reduce-scatter over
+    the ICI (slice-local) axes, int8(+error-feedback) all-reduce over the
+    DCN axis on the 1/p_inner-sized shard, all-gather back over ICI — but
+    any composition whose phase algebra nets out to a full mean reduction
+    runs. Each phase logs its own comms-ledger entry tagged with the
+    phase's ``link`` class, so ``hop_totals()`` reports ICI- vs DCN-class
+    wire bytes separately.
+
+    ``feedback`` (an ``ErrorFeedbackState`` shaped by
+    :func:`program_feedback_init`) feeds the ``int8_ef`` phase; pass
+    ``None`` to run that phase as plain int8 (microbench probes, degraded
+    mode). Returns ``(out, new_feedback)`` — ``new_feedback`` is ``None``
+    unless feedback was both requested by the program and supplied.
+    """
+    shape = x.shape
+    n0 = int(np.prod(shape)) if shape else 1
+    cur = x.astype(jnp.float32).reshape(-1)
+    new_fb = None
+    for st in program:
+        names = tuple(st.axes)
+        p = _axis_size(names)
+        if p <= 1:
+            continue
+        n = int(cur.shape[0])
+        sr = st.wire_dtype == "int8_sr"
+        if st.phase_op == "reduce_scatter":
+            n_p, _ = _phase_sizes(n, "reduce_scatter", p)
+            padded = jnp.pad(cur, (0, n_p - n))
+            if st.wire_dtype == "exact":
+                cur = lax.psum_scatter(padded, names, scatter_dimension=0,
+                                       tiled=True) / p
+                moved = 4 * n_p * (p - 1) // p
+                _log("program_reduce_scatter", moved, moved, st.link)
+            else:
+                cur = quantized_reduce_scatter(padded, names, block=st.block,
+                                               stochastic=sr, key=key,
+                                               link=st.link)
+        elif st.phase_op == "all_reduce":
+            if st.wire_dtype == "exact":
+                cur = lax.pmean(cur, names)
+                moved = 2 * 4 * n * (p - 1) // p
+                _log("program_all_reduce", moved, moved, st.link)
+            else:
+                fb = feedback if st.wire_dtype == "int8_ef" else None
+                out = quantized_all_reduce(cur, names, block=st.block,
+                                           stochastic=sr, key=key,
+                                           feedback=fb, link=st.link)
+                if fb is not None:
+                    cur, new_fb = out
+                else:
+                    cur = out
+        elif st.phase_op == "all_gather":
+            if st.via in ("ring", "bidir_ring"):
+                from ..ops.collective_matmul import ring_all_gather
+                from .comm import get_comms_logger
+
+                for a in names:  # per-axis chain: same bytes as the fused op
+                    if st.link is not None:
+                        # the ring logs its own chunked per-op ledger entry
+                        # without hop awareness; bucket its wire bytes here
+                        # so hop_totals() still sees this phase's traffic
+                        pa = _axis_size((a,))
+                        get_comms_logger().log_hop_bytes(
+                            st.link, 4 * int(cur.shape[0]) * (pa - 1))
+                    cur = ring_all_gather(cur, a,
+                                          bidirectional=st.via == "bidir_ring")
+            elif st.wire_dtype == "exact":
+                cur = lax.all_gather(cur, names, axis=0, tiled=True)
+                moved = 4 * n * (p - 1)
+                _log("program_all_gather", moved, moved, st.link)
+            else:
+                cur = quantized_all_gather(cur, names, block=st.block,
+                                           link=st.link).reshape(-1)
+    return cur[:n0].reshape(shape), new_fb
+
+
+# ---------------------------------------------------------------------------
+# keyed error-feedback registry
+# ---------------------------------------------------------------------------
+#
+# allreduce_feedback_init builds a FRESH zero state — a call site that
+# re-invokes it each step (or each retrace) silently resets the residual and
+# the error-feedback carry never happens. Host-side callers that cannot
+# thread the state through their own signatures (imperative loops, drill
+# scripts) register it here under a stable key instead: the first fetch
+# creates the zeros, every later fetch returns the LAST STORED state, and
+# store_feedback() commits the post-reduction residual. (The engine's fused
+# train step owns its residual explicitly — TrainState.comm_feedback — so
+# it rides snapshots; the registry is for everything outside that loop.)
+
+_FEEDBACK_REGISTRY: dict = {}
+
+
+def feedback_state(name: str, shape=None, world: Optional[int] = None,
+                   init=None):
+    """The registered residual for ``name``, created on first use from
+    ``init()`` (or :func:`allreduce_feedback_init`\\ ``(shape, world)``)."""
+    if name not in _FEEDBACK_REGISTRY:
+        if init is not None:
+            _FEEDBACK_REGISTRY[name] = init()
+        else:
+            if shape is None or world is None:
+                raise ValueError(
+                    f"feedback_state({name!r}): first use needs shape+world "
+                    "(or an init callable) to build the zero state")
+            _FEEDBACK_REGISTRY[name] = allreduce_feedback_init(shape, world)
+    return _FEEDBACK_REGISTRY[name]
+
+
+def store_feedback(name: str, state) -> None:
+    """Commit the post-reduction residual for ``name`` (the write half of
+    the carry; the next :func:`feedback_state` fetch returns it)."""
+    _FEEDBACK_REGISTRY[name] = state
+
+
+def clear_feedback(name: Optional[str] = None) -> None:
+    """Drop one registered residual (or all of them): degraded mode and
+    rollback paths must not re-inject a residual from an abandoned
+    trajectory."""
+    if name is None:
+        _FEEDBACK_REGISTRY.clear()
+    else:
+        _FEEDBACK_REGISTRY.pop(name, None)
 
 
 # ---------------------------------------------------------------------------
@@ -336,21 +531,23 @@ def quantized_all_to_all(x, axis: str, *, split_dim: int, concat_dim: int,
 
 
 def quantized_all_gather(x, axis: Axis, block: Optional[int] = None, *,
-                         stochastic: bool = False, key=None):
+                         stochastic: bool = False, key=None,
+                         link: Optional[str] = None):
     """qwZ int8 weight allgather: quantize the local shard once, gather int8
     payload + one-lane scales, dequantize on arrival. Returns
     ``[world, *x.shape]`` fp32. One ledger entry with on-wire bytes."""
     block = compression_block() if block is None else block
     n = int(np.prod(x.shape)) if x.shape else 1
     nb = -(-n // block)
-    _log("quantized_all_gather", _nbytes(x), nb * block + 4 * nb)
+    _log("quantized_all_gather", _nbytes(x), nb * block + 4 * nb, link)
     from ..ops.pallas.quant import quantized_all_gather as _qag
 
     return _qag(x, axis, block, stochastic=stochastic, key=key)
 
 
 def quantized_reduce_scatter(x, axis: Axis, block: Optional[int] = None, *,
-                             stochastic: bool = False, key=None):
+                             stochastic: bool = False, key=None,
+                             link: Optional[str] = None):
     """qgZ int8 gradient reduce-scatter (mean): quantize the full local
     grad, all-to-all the int8 shards, dequantize + average locally. Returns
     this rank's ``[ceil(n/world)]`` fp32 mean shard — arbitrary sizes pad to
@@ -360,7 +557,7 @@ def quantized_reduce_scatter(x, axis: Axis, block: Optional[int] = None, *,
     n = int(np.prod(x.shape)) if x.shape else 1
     _, shard_p, b = _shard_layout(n, world, block)
     nb = world * (shard_p // b)
-    _log("quantized_reduce_scatter", _nbytes(x), world * shard_p + 4 * nb)
+    _log("quantized_reduce_scatter", _nbytes(x), world * shard_p + 4 * nb, link)
     from ..ops.pallas.quant import quantized_reduce_scatter as _qrs
 
     return _qrs(x, axis, block, stochastic=stochastic, key=key)

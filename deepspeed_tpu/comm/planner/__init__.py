@@ -8,17 +8,20 @@ menu into an automatic, measured, cached per-site decision (GC3, arxiv
 """
 
 from .cache import PlanCache, default_cache_dir
-from .ir import (CONSUMERS, IMPLEMENTATIONS, OP_MENU, CollectiveSite, Plan,
-                 PlanDecision, make_site)
+from .ir import (CONSUMERS, IMPLEMENTATIONS, LINK_CLASSES, OP_MENU, PHASE_OPS,
+                 WIRE_DTYPES, CollectiveSite, PhaseStep, Plan, PlanDecision,
+                 make_phase, make_site, program_summary)
 from .microbench import benchmark_site
 from .planner import (MODES, CollectivePlanner, configure_from_config,
                       configure_planner, get_planner, planner_active,
-                      reset_planner, resolve_site)
+                      reset_planner, resolve_site, synthesize_programs)
 from .topo import CostModel, LinkParams, MeshFingerprint
 
 __all__ = [
     "CONSUMERS", "IMPLEMENTATIONS", "OP_MENU", "MODES",
-    "CollectiveSite", "Plan", "PlanDecision", "make_site",
+    "PHASE_OPS", "WIRE_DTYPES", "LINK_CLASSES",
+    "CollectiveSite", "Plan", "PlanDecision", "PhaseStep",
+    "make_site", "make_phase", "program_summary", "synthesize_programs",
     "MeshFingerprint", "CostModel", "LinkParams",
     "PlanCache", "default_cache_dir", "benchmark_site",
     "CollectivePlanner", "configure_planner", "configure_from_config",

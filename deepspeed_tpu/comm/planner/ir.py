@@ -32,8 +32,28 @@ import numpy as np
 #   fused_matmul — collective matmul: the gather/reduction ring hidden
 #                  behind the partial matmuls (all_gather_matmul /
 #                  matmul_reduce_scatter)
+#   program      — not a fixed impl at all: the decision carries an ordered
+#                  multi-phase PROGRAM of PhaseStep entries (GC3-style
+#                  synthesis) executed by comm.compressed.
+#                  run_collective_program — e.g. exact reduce-scatter over
+#                  the ICI axes, int8+error-feedback all-reduce over the
+#                  DCN axis, all-gather back over ICI
 IMPLEMENTATIONS = ("xla", "ring", "bidir_ring", "hierarchical", "int8",
-                   "int8_sr", "fused_matmul")
+                   "int8_sr", "fused_matmul", "program")
+
+# the phase vocabulary a program decision is built from; each phase lowers
+# to one collective primitive over its own axes with its own wire dtype
+PHASE_OPS = ("reduce_scatter", "all_reduce", "all_gather")
+# exact     — native-dtype payload, bit-faithful transport
+# int8      — block-quantized payload + one-lane scales, nearest rounding
+# int8_sr   — block-quantized + stochastic rounding (unbiased per element)
+# int8_ef   — block-quantized + ErrorFeedbackState residual carry (the DCN
+#             gradient hop: quantization error re-injected next step)
+WIRE_DTYPES = ("exact", "int8", "int8_sr", "int8_ef")
+# how a phase lowers: the fused XLA collective or a ppermute chunk ring
+PHASE_VIAS = ("xla", "ring", "bidir_ring")
+# link classes a phase's traffic is accounted under in the comms ledger
+LINK_CLASSES = ("ici", "dcn", "host")
 
 # op kind -> implementations that can realize it
 OP_MENU: Dict[str, Tuple[str, ...]] = {
@@ -112,6 +132,92 @@ def make_site(*, op: str, shape: Sequence[int], dtype: Any,
 
 
 @dataclass(frozen=True)
+class PhaseStep:
+    """One phase of a multi-phase collective program.
+
+    ``phase_op`` is the collective primitive, ``axes`` the mesh axes THIS
+    phase runs over (each phase gets its own axes — the whole point:
+    different hops ride different links), ``wire_dtype`` what rides those
+    links, ``via`` whether the phase lowers to the fused XLA collective or
+    a ppermute chunk ring, and ``link`` the ledger hop class the phase's
+    wire bytes are accounted under (``ici``/``dcn``/``host``; synthesis
+    stamps it from the mesh fingerprint so the ledger can report DCN-class
+    bytes without re-deriving topology at trace time).
+    """
+    phase_op: str
+    axes: Tuple[str, ...]
+    wire_dtype: str = "exact"
+    block: Optional[int] = None
+    via: str = "xla"
+    link: Optional[str] = None
+
+    def __post_init__(self):
+        if self.phase_op not in PHASE_OPS:
+            raise ValueError(f"unknown phase op {self.phase_op!r}; "
+                             f"menu: {PHASE_OPS}")
+        if self.wire_dtype not in WIRE_DTYPES:
+            raise ValueError(f"unknown wire dtype {self.wire_dtype!r}; "
+                             f"menu: {WIRE_DTYPES}")
+        if self.via not in PHASE_VIAS:
+            raise ValueError(f"unknown phase via {self.via!r}; "
+                             f"menu: {PHASE_VIAS}")
+        if self.link is not None and self.link not in LINK_CLASSES:
+            raise ValueError(f"unknown link class {self.link!r}; "
+                             f"menu: {LINK_CLASSES}")
+        if not self.axes:
+            raise ValueError("a PhaseStep needs at least one mesh axis")
+
+    @property
+    def quantized(self) -> bool:
+        return self.wire_dtype != "exact"
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"phase_op": self.phase_op, "axes": list(self.axes)}
+        if self.wire_dtype != "exact":
+            d["wire_dtype"] = self.wire_dtype
+        if self.block is not None:
+            d["block"] = self.block
+        if self.via != "xla":
+            d["via"] = self.via
+        if self.link is not None:
+            d["link"] = self.link
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PhaseStep":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in d.items() if k in known}
+        kw["axes"] = tuple(str(a) for a in kw.get("axes", ()))
+        return cls(**kw)
+
+
+def make_phase(phase_op: str, axes: Sequence[str], *,
+               wire_dtype: str = "exact", block: Optional[int] = None,
+               via: str = "xla", link: Optional[str] = None) -> PhaseStep:
+    """Normalizing :class:`PhaseStep` constructor (the ``make_site`` twin)."""
+    return PhaseStep(phase_op=str(phase_op),
+                     axes=tuple(str(a) for a in axes),
+                     wire_dtype=str(wire_dtype),
+                     block=None if block is None else int(block),
+                     via=str(via), link=link)
+
+
+def program_summary(program: Sequence[PhaseStep]) -> str:
+    """Compact one-line program rendering for logs and the plan table:
+    ``rs(ep)>ar.int8_ef(dp_outer)>ag(ep)``."""
+    short = {"reduce_scatter": "rs", "all_reduce": "ar", "all_gather": "ag"}
+    parts = []
+    for s in program:
+        tag = short[s.phase_op]
+        if s.wire_dtype != "exact":
+            tag += f".{s.wire_dtype}"
+        if s.via != "xla":
+            tag += f"~{s.via}"
+        parts.append(f"{tag}({','.join(s.axes)})")
+    return ">".join(parts)
+
+
+@dataclass(frozen=True)
 class PlanDecision:
     """One site's resolved implementation.
 
@@ -120,29 +226,53 @@ class PlanDecision:
     ``cost-model`` (static alpha-beta ranking), ``measured`` (microbenchmark
     winner), or ``default`` (planner off — today's behavior).
     ``est_us`` is the model's (or measurement's) cost estimate.
+
+    ``impl == "program"`` decisions carry the synthesized multi-phase
+    ``program`` (a tuple of :class:`PhaseStep`) instead of naming a fixed
+    implementation; every other impl keeps ``program is None``, so
+    single-impl decisions serialize byte-identically to the pre-program
+    plan-cache format.
     """
     impl: str
     block: Optional[int] = None
     source: str = "default"
     est_us: Optional[float] = None
+    program: Optional[Tuple[PhaseStep, ...]] = None
 
     def __post_init__(self):
         if self.impl not in IMPLEMENTATIONS:
             raise ValueError(f"unknown implementation {self.impl!r}; "
                              f"menu: {IMPLEMENTATIONS}")
+        if self.impl == "program":
+            if not self.program:
+                raise ValueError("impl='program' needs a non-empty program")
+            object.__setattr__(self, "program", tuple(self.program))
+        elif self.program is not None:
+            raise ValueError(f"impl={self.impl!r} must not carry a program")
 
     @property
     def quantized(self) -> bool:
+        if self.impl == "program":
+            return any(s.quantized for s in self.program)
         return self.impl in ("int8", "int8_sr", "hierarchical")
 
     def to_dict(self) -> Dict[str, Any]:
-        return {k: v for k, v in dataclasses.asdict(self).items()
-                if v is not None}
+        d = {k: v for k, v in dataclasses.asdict(self).items()
+             if v is not None and k != "program"}
+        if self.program is not None:
+            d["program"] = [s.to_dict() for s in self.program]
+        return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "PlanDecision":
         known = {f.name for f in dataclasses.fields(cls)}
-        return cls(**{k: v for k, v in d.items() if k in known})
+        kw = {k: v for k, v in d.items() if k in known}
+        prog = kw.get("program")
+        if prog is not None:
+            kw["program"] = tuple(
+                s if isinstance(s, PhaseStep) else PhaseStep.from_dict(s)
+                for s in prog)
+        return cls(**kw)
 
 
 class Plan:

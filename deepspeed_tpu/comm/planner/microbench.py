@@ -28,11 +28,19 @@ def _probe_elems(site: CollectiveSite, p: int, max_elems: int) -> int:
 
 def build_probe(site: CollectiveSite, impl: str, *, mesh=None,
                 block: Optional[int] = None, reps: int = 4,
-                max_elems: int = 1 << 16):
+                max_elems: int = 1 << 16, program=None):
     """(jitted_fn, probe_array): a compiled program running ``reps`` chained
     executions of ``impl`` for ``site`` on ``mesh``. The probe is fp32 and
     replicated (each rank holds the same flat vector — per-shard calling
-    convention, like every ``comm.comm`` collective)."""
+    convention, like every ``comm.comm`` collective).
+
+    ``impl == "program"`` probes a synthesized multi-phase plan-IR program
+    (``program`` = tuple of ``ir.PhaseStep``) through the same executor the
+    engine wiring runs (``comm.compressed.run_collective_program``), so
+    measured mode validates synthesis against reality, not against the
+    cost model's own assumptions. Error-feedback phases probe stateless
+    (feedback=None → plain int8): the timing is identical and the probe
+    carries no cross-step residual."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -66,6 +74,13 @@ def build_probe(site: CollectiveSite, impl: str, *, mesh=None,
     x = jnp.linspace(-1.0, 1.0, n, dtype=jnp.float32)
 
     def one(v):
+        if impl == "program":
+            if not program:
+                raise ValueError("impl='program' probe needs a program")
+            from ..compressed import run_collective_program
+
+            out, _ = run_collective_program(v, program)
+            return out
         if site.op == "all_reduce":
             if impl == "xla":
                 return lax.pmean(v, axes)
@@ -184,11 +199,12 @@ def build_probe(site: CollectiveSite, impl: str, *, mesh=None,
 
 def benchmark_site(site: CollectiveSite, impl: str, *, mesh=None,
                    block: Optional[int] = None, reps: int = 4,
-                   repeats: int = 3, max_elems: int = 1 << 16) -> float:
+                   repeats: int = 3, max_elems: int = 1 << 16,
+                   program=None) -> float:
     """Min-of-``repeats`` wall-clock seconds per single execution of
     ``impl`` at (a capped version of) ``site``. Compile excluded."""
     fn, x = build_probe(site, impl, mesh=mesh, block=block, reps=reps,
-                        max_elems=max_elems)
+                        max_elems=max_elems, program=program)
     float(fn(x))  # compile + drain
     best = float("inf")
     for _ in range(max(1, repeats)):

@@ -19,15 +19,60 @@ Every resolution is recorded once in the comms ledger
 table next to the traffic table.
 """
 
-from typing import Any, Dict, Optional
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .cache import PlanCache
-from .ir import (GRADIENT_CONSUMERS, CollectiveSite, Plan, PlanDecision,
-                 make_site)
+from .ir import (GRADIENT_CONSUMERS, CollectiveSite, PhaseStep, Plan,
+                 PlanDecision, make_phase, make_site, program_summary)
 from .microbench import benchmark_site
 from .topo import CostModel, MeshFingerprint
 
 MODES = ("off", "static", "measure")
+
+
+def synthesize_programs(site: CollectiveSite, cost: CostModel,
+                        block: int = 2048) -> List[Tuple[PhaseStep, ...]]:
+    """Candidate multi-phase programs for a multi-axis site (the GC3 move:
+    the planner doesn't just pick among fixed impls, it COMPOSES phase
+    sequences and lets the cost model / microbench rank them).
+
+    For an all-reduce whose span splits into (inner slice-local, outer
+    cross-slice) axes, the candidates are the bandwidth-optimal hierarchy:
+
+    - ``rs(inner) > ar.int8[_ef](outer) > ag(inner)`` — exact reduce-scatter
+      over ICI shrinks the per-rank payload by the inner span, the DCN hop
+      carries int8 (+error feedback on gradient consumers), the all-gather
+      restores full width over ICI;
+    - the same shape with an exact outer hop (hierarchical-exact); and
+    - a bidirectional-ring all-gather variant (both ICI directions busy).
+
+    Flat single-impl candidates stay in the normal menu — synthesis only
+    ADDS programs; an all-ICI mesh still gets them as candidates and the
+    cost model prices the extra phases honestly (they lose there).
+    """
+    if site.op != "all_reduce" or site.axis_size is not None:
+        return []
+    inner, outer = cost.dcn_split(site)
+    if not inner or not outer:
+        return []
+    fp = cost.fp
+    if fp.axis_size(inner) <= 1 or fp.axis_size(outer) <= 1:
+        return []
+    in_link = "ici" if (fp.platform == "tpu" or fp.dcn_axes) else "host"
+    out_link = ("dcn" if any(a in fp.dcn_axes for a in outer) else in_link)
+    wire = "int8_ef" if site.consumer in GRADIENT_CONSUMERS else "int8"
+    rs = make_phase("reduce_scatter", inner, link=in_link)
+    ag = make_phase("all_gather", inner, link=in_link)
+    ag_bidir = make_phase("all_gather", inner, via="bidir_ring", link=in_link)
+    ar_exact = make_phase("all_reduce", outer, link=out_link)
+    ar_int8 = make_phase("all_reduce", outer, wire_dtype=wire, block=block,
+                         link=out_link)
+    return [
+        (rs, ar_int8, ag),        # hierarchical-int8-outer (the DCN shape)
+        (rs, ar_exact, ag),       # hierarchical-exact
+        (rs, ar_int8, ag_bidir),  # bidir-ring gather variant
+    ]
 
 
 class CollectivePlanner:
@@ -39,6 +84,7 @@ class CollectivePlanner:
                  measure_reps: int = 4,
                  measure_max_elems: int = 1 << 16,
                  block: int = 2048,
+                 dcn_axes: Optional[Sequence[str]] = None,
                  topology=None):
         if mode not in MODES:
             raise ValueError(f"comm_planner mode must be one of {MODES}, "
@@ -50,7 +96,31 @@ class CollectivePlanner:
         self.measure_max_elems = int(measure_max_elems)
         self.block = int(block)
         self.fingerprint = MeshFingerprint.capture(topology)
-        self.cost = CostModel(self.fingerprint, block=self.block)
+        forced = ()
+        if dcn_axes:
+            # operator-forced DCN axes (``comm_planner.dcn_axes``): rehearse
+            # a multi-slice plan on a single-slice (or CPU) dev box. The
+            # override is part of the fingerprint, so forced plans never
+            # collide with this mesh's organic plan cache entry.
+            known = {n for n, s in self.fingerprint.axis_sizes if s > 1}
+            forced = tuple(a for a in dcn_axes if a in known)
+            dropped = [a for a in dcn_axes if a not in known]
+            if dropped:
+                from ...utils.logging import logger
+
+                logger.warning(
+                    f"comm_planner.dcn_axes: {dropped} match no multi-rank "
+                    f"mesh axis (known: {sorted(known)}) — ignored; no "
+                    f"cross-slice program will be synthesized for them")
+            if forced:
+                self.fingerprint = dataclasses.replace(
+                    self.fingerprint,
+                    dcn_axes=tuple(sorted(set(self.fingerprint.dcn_axes)
+                                          | set(forced))))
+        # fleet costing only when an override actually took: a typo'd
+        # dcn_axes must not silently switch quantization to TPU rates
+        self.cost = CostModel(self.fingerprint, block=self.block,
+                              assume_fleet=bool(forced))
         self.cache = PlanCache(cache_dir) if use_cache else None
         self.plan = Plan(fingerprint=self.fingerprint.digest())
         self._from_cache = set()
@@ -74,13 +144,12 @@ class CollectivePlanner:
             return knob
         decision = self.plan.decisions.get(sig)
         if decision is not None and sig in self._from_cache:
-            decision = PlanDecision(impl=decision.impl, block=decision.block,
-                                    source="cache", est_us=decision.est_us)
+            decision = dataclasses.replace(decision, source="cache")
         if decision is None:
             if self.mode == "off":
                 decision = self._default_decision(site)
             elif self.mode == "static":
-                decision = self.cost.decide(site, margin=self.margin)
+                decision = self._static_decision(site)
             else:
                 decision = self._measure(site)
         if sig not in self._agreed:
@@ -151,21 +220,46 @@ class CollectivePlanner:
                                 source="default")
         return PlanDecision(impl="xla", source="default")
 
+    def _candidates(self, site: CollectiveSite):
+        """Cost-ranked, margin-pruned ``(impl, est_s, program)`` candidates:
+        the single-impl menu (``CostModel.prune``) PLUS every synthesized
+        multi-phase program, priced on the same alpha-beta scale. Stable
+        sort keeps synthesis order on ties (int8-outer before its bidir
+        variant)."""
+        cands = [(impl, est, None)
+                 for impl, est in self.cost.prune(site, margin=self.margin)]
+        for prog in synthesize_programs(site, self.cost, block=self.block):
+            cands.append(("program", self.cost.estimate_program(site, prog),
+                          prog))
+        cands.sort(key=lambda t: t[1])
+        best = cands[0][1]
+        cut = best * self.margin if best > 0 else float("inf")
+        return [c for c in cands if c[1] <= cut] or cands[:1]
+
+    def _static_decision(self, site: CollectiveSite) -> PlanDecision:
+        """Static-mode decision: argmin over single impls AND programs."""
+        impl, est, prog = self._candidates(site)[0]
+        return self._finish(impl, est_s=est, source="cost-model",
+                            program=prog)
+
     def _measure(self, site: CollectiveSite) -> PlanDecision:
-        survivors = self.cost.prune(site, margin=self.margin)
+        survivors = self._candidates(site)
         if len(survivors) == 1:
-            impl, est = survivors[0]
-            return self._finish(impl, est_s=est, source="cost-model")
+            impl, est, prog = survivors[0]
+            return self._finish(impl, est_s=est, source="cost-model",
+                                program=prog)
         timed, errs = [], []
-        for impl, _ in survivors:
+        for impl, _, prog in survivors:
             try:
                 t = benchmark_site(site, impl, block=self.block,
+                                   program=prog,
                                    reps=self.measure_reps,
                                    max_elems=self.measure_max_elems)
             except Exception as e:  # a candidate that fails to build loses
-                errs.append(f"{impl}: {type(e).__name__}: {e}")
+                name = impl if prog is None else program_summary(prog)
+                errs.append(f"{name}: {type(e).__name__}: {e}")
                 continue
-            timed.append((impl, t))
+            timed.append((impl, t, prog))
         if not timed:
             # degrade loudly, not silently: the user asked for measurement
             from ...utils.logging import logger
@@ -174,16 +268,19 @@ class CollectivePlanner:
                 f"comm_planner: no candidate probe ran for "
                 f"{site.signature()} — falling back to the cost model "
                 f"({'; '.join(errs)[:300]})")
-            impl, est = survivors[0]
-            return self._finish(impl, est_s=est, source="cost-model")
-        impl, t = min(timed, key=lambda kv: kv[1])
-        return self._finish(impl, est_s=t, source="measured")
+            impl, est, prog = survivors[0]
+            return self._finish(impl, est_s=est, source="cost-model",
+                                program=prog)
+        impl, t, prog = min(timed, key=lambda kv: kv[1])
+        return self._finish(impl, est_s=t, source="measured", program=prog)
 
-    def _finish(self, impl: str, *, est_s: float, source: str) -> PlanDecision:
-        block = self.block if impl in ("int8", "int8_sr",
-                                       "hierarchical") else None
+    def _finish(self, impl: str, *, est_s: float, source: str,
+                program=None) -> PlanDecision:
+        block = self.block if impl in ("int8", "int8_sr", "hierarchical",
+                                       "program") else None
         return PlanDecision(impl=impl, block=block, source=source,
-                            est_us=round(est_s * 1e6, 3))
+                            est_us=round(est_s * 1e6, 3),
+                            program=program)
 
     def _record(self, site: CollectiveSite, decision: PlanDecision) -> None:
         sig = site.signature()
@@ -192,13 +289,16 @@ class CollectivePlanner:
         self._recorded.add(sig)
         from ..comm import get_comms_logger
 
-        get_comms_logger().record_plan(sig, {
+        info = {
             "consumer": site.consumer, "op": site.op,
             "shape": "x".join(str(d) for d in site.shape) or "scalar",
             "axes": ",".join(site.axes), "impl": decision.impl,
             "block": decision.block, "source": decision.source,
             "est_us": decision.est_us, "mode": self.mode,
-        })
+        }
+        if decision.program is not None:
+            info["program"] = program_summary(decision.program)
+        get_comms_logger().record_plan(sig, info)
 
 
 # ---------------------------------------------------------------------------
@@ -258,4 +358,5 @@ def configure_from_config(config, topology=None) -> CollectivePlanner:
                              use_cache=pl.use_cache, margin=pl.margin,
                              measure_reps=pl.measure_reps,
                              measure_max_elems=pl.measure_max_elems,
-                             block=cc.block, topology=topology)
+                             block=cc.block, dcn_axes=pl.dcn_axes,
+                             topology=topology)

@@ -18,7 +18,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .ir import (GRADIENT_CONSUMERS, OP_MENU, CollectiveSite, PlanDecision)
+from .ir import (GRADIENT_CONSUMERS, OP_MENU, CollectiveSite, PhaseStep,
+                 PlanDecision)
 
 # default quantization block (elements per scale) — matches ops/pallas/quant
 _DEFAULT_BLOCK = 2048
@@ -115,22 +116,49 @@ class MeshFingerprint:
 
 
 class CostModel:
-    """Alpha-beta estimates per (site, implementation)."""
+    """Alpha-beta estimates per (site, implementation).
+
+    ``assume_fleet`` plans AS the target fleet rather than as this host:
+    quantization is costed at the accelerator's streaming rate even when
+    the live platform is the virtual CPU mesh. Set when the operator
+    force-marked DCN axes (``comm_planner.dcn_axes`` — rehearsing a
+    multi-slice plan on a dev box); without it the CPU's vectorized-numpy
+    quant rate would veto every compressed candidate the real fleet wants.
+    """
 
     def __init__(self, fingerprint: MeshFingerprint,
-                 block: int = _DEFAULT_BLOCK):
+                 block: int = _DEFAULT_BLOCK, assume_fleet: bool = False):
         self.fp = fingerprint
         self.block = block
-        self.quant_cost = QUANT_COST_PER_BYTE.get(fingerprint.platform,
-                                                  _QUANT_DEFAULT)
+        platform = "tpu" if assume_fleet else fingerprint.platform
+        self.quant_cost = QUANT_COST_PER_BYTE.get(platform, _QUANT_DEFAULT)
         self.quant_fixed = QUANT_FIXED
 
     def link(self, axes: Tuple[str, ...]) -> LinkParams:
         if any(a in self.fp.dcn_axes for a in axes):
             return LINK_TABLE["dcn"]
-        if self.fp.platform == "tpu":
+        if self.fp.platform == "tpu" or self.fp.dcn_axes:
+            # a mesh that DISTINGUISHES DCN axes makes every other axis
+            # slice-local interconnect by definition
             return LINK_TABLE["ici"]
         return LINK_TABLE["host"]
+
+    def dcn_split(self, site: CollectiveSite) -> Tuple[Tuple[str, ...],
+                                                       Tuple[str, ...]]:
+        """Partition ``site.axes`` into (inner slice-local axes, outer
+        cross-slice axes) for hierarchical program synthesis. Programs only
+        make sense when the span actually CROSSES ``fp.dcn_axes`` — on an
+        all-ICI mesh the extra full-width phases buy nothing (the legacy
+        single-impl ``hierarchical`` estimate already prices that shape and
+        loses there), so either side empty means: no split, no program."""
+        axes = site.axes
+        if site.axis_size is not None or len(axes) < 2:
+            return ((), ())
+        outer = tuple(a for a in axes if a in self.fp.dcn_axes)
+        inner = tuple(a for a in axes if a not in self.fp.dcn_axes)
+        if not outer or not inner:
+            return ((), ())
+        return inner, outer
 
     def axis_size_of(self, site: CollectiveSite) -> int:
         """The collective's rank count: the site's explicit override (a
@@ -223,6 +251,48 @@ class CostModel:
                 return (hops * lp.alpha * RING_HOP_PENALTY
                         + hops * n * lp.beta * (1 - OVERLAP_CREDIT))
         return float("inf")
+
+    def estimate_program(self, site: CollectiveSite,
+                         program: Tuple[PhaseStep, ...]) -> float:
+        """Predicted seconds for one execution of a multi-phase program at
+        ``site``. Each phase is costed with ITS OWN link params (the
+        distinct DCN alpha/beta in :data:`LINK_TABLE` — the term that makes
+        'exact on ICI, int8 on DCN' beat both flat variants the moment a
+        slice boundary enters the span) and the per-rank payload tracks
+        the phase algebra: a reduce-scatter shrinks it by the axis span, an
+        all-gather grows it back."""
+        if site.axis_size is not None:
+            return float("inf")  # foreign-mesh sites are one flat axis
+        n = float(site.nbytes)
+        t = 0.0
+        for st in program:
+            p = self.fp.axis_size(st.axes)
+            if p <= 1:
+                continue
+            lp = LINK_TABLE[st.link] if st.link else self.link(st.axes)
+            hops = p - 1
+            q = self._wire_ratio(site.dtype) if st.quantized else 1.0
+            if st.via == "ring":
+                alpha_t = hops * RING_HOP_PENALTY * lp.alpha
+            elif st.via == "bidir_ring":
+                alpha_t = -(-hops // 2) * RING_HOP_PENALTY * lp.alpha
+            else:
+                alpha_t = hops * lp.alpha
+            if st.phase_op == "reduce_scatter":
+                t += alpha_t + n * hops / p * q * lp.beta
+                if st.quantized:
+                    t += n * self.quant_cost + self.quant_fixed
+                n = n / p
+            elif st.phase_op == "all_reduce":
+                t += 2 * alpha_t + 2 * n * q * hops / p * lp.beta
+                if st.quantized:
+                    t += 2 * n * self.quant_cost + 2 * self.quant_fixed
+            elif st.phase_op == "all_gather":
+                t += alpha_t + hops * n * q * lp.beta
+                if st.quantized:
+                    t += n * p * self.quant_cost + self.quant_fixed
+                n = n * p
+        return t
 
     def _split_axes(self, site: CollectiveSite) -> Tuple[int, int]:
         """(inner, outer) sizes for the hierarchical split: last axis is the

@@ -276,6 +276,13 @@ class CommPlannerConfig(ConfigModel):
     topology). Explicitly-set raw knobs (``compressed_collectives``,
     ``overlap_collective_matmul``) always win at their sites. Also accepted
     as a bare string: ``"comm_planner": "static"``.
+
+    ``dcn_axes`` force-marks mesh axes as cross-slice (DCN) in the planner's
+    fingerprint — the multi-slice rehearsal knob: a single-host (or CPU)
+    mesh plans exactly as the target fleet would (hierarchical multi-phase
+    programs with int8+error-feedback DCN hops become eligible for the
+    DP-grad site; see ``docs/multislice.md``). On a real multi-slice mesh
+    leave it unset — DCN axes are detected from process boundaries.
     """
     mode: str = "off"            # off | static | measure
     cache_dir: Optional[str] = None  # default ~/.cache/deepspeed_tpu/comm_plans
@@ -283,6 +290,7 @@ class CommPlannerConfig(ConfigModel):
     margin: float = 3.0          # cost-model pruning margin (x best estimate)
     measure_reps: int = 4        # chained executions per timed probe
     measure_max_elems: int = 1 << 16  # probe tensor cap (elements)
+    dcn_axes: Optional[List[str]] = None  # force-mark axes as DCN (simulation)
 
 
 @register_config

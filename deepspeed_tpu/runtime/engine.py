@@ -52,11 +52,22 @@ except ImportError:  # pragma: no cover
 class TrainState:
     """Engine state pytree. ``params`` are fp32 master weights (reference
     FP16/BF16 optimizer master copies, ``runtime/fp16/fused_optimizer.py:33``,
-    ``bf16_optimizer.py:34``) unless master weights are disabled."""
+    ``bf16_optimizer.py:34``) unless master weights are disabled.
+
+    ``comm_feedback`` is the cross-step error-feedback residual of a
+    DCN-compressed gradient program (``comm/compressed.py``
+    ``run_collective_program`` with an ``int8_ef`` hop): engine-OWNED state,
+    threaded through the jitted step like the optimizer state, so one
+    residual accumulates across steps (instead of a fresh zero per trace)
+    and it rides resilience snapshots — a rollback restores the snapshot's
+    residual rather than replaying the abandoned trajectory's. Empty
+    (``()`` — zero pytree leaves) whenever feedback is off, which keeps
+    every default-off path structurally and bitwise identical."""
     step: jnp.ndarray
     params: Any
     opt_state: Any
     loss_scale: LossScaleState
+    comm_feedback: Any = ()
 
 
 def _tree_where(pred, a, b):
@@ -577,7 +588,16 @@ class DeepSpeedTPUEngine:
                 d = resolve_site(op="all_reduce", shape=(n_elems,),
                                  dtype="float32", axes=topo.dp_axes,
                                  consumer="dp-grad")
-                if d.impl in ("int8", "int8_sr", "hierarchical"):
+                if d.impl == "program":
+                    # planner-synthesized multi-phase program (the DCN
+                    # shape: exact reduce-scatter over ICI, int8+error-
+                    # feedback all-reduce over the cross-slice axis,
+                    # all-gather back) — executed per step by
+                    # comm.compressed.run_collective_program
+                    dp_grad_impl = ("program", d.block or cc.block,
+                                    d.program)
+                    compressed_dp = True
+                elif d.impl in ("int8", "int8_sr", "hierarchical"):
                     hier = (d.impl == "hierarchical" and topo.ep_size > 1
                             and topo.dp_outer_size > 1)
                     mode_ = "int8" if d.impl == "hierarchical" else d.impl
@@ -585,10 +605,43 @@ class DeepSpeedTPUEngine:
                     compressed_dp = True
         if compressed_dp:
             mode_, block_, hier_ = dp_grad_impl
-            log_dist(f"DP gradients ride the {mode_} all-reduce "
-                     f"(block={block_}{', hierarchical' if hier_ else ''})")
+            if mode_ == "program":
+                from ..comm.planner import program_summary
+                log_dist(f"DP gradients ride a planner program: "
+                         f"{program_summary(hier_)}")
+            else:
+                log_dist(f"DP gradients ride the {mode_} all-reduce "
+                         f"(block={block_}{', hierarchical' if hier_ else ''})")
         self._compressed_dp = compressed_dp  # imperative backward() reads it
         self._dp_grad_impl = dp_grad_impl
+
+        # cross-step error-feedback residual for a program with an int8_ef
+        # hop: engine-owned (TrainState.comm_feedback — global arrays with
+        # the per-rank layout on the leading dp dim) so the GAS step carries
+        # ONE residual across steps, snapshots include it, and rollback
+        # restores the snapshot's copy instead of replaying a stale one
+        fb = ()
+        if dp_grad_impl is not None and dp_grad_impl[0] == "program":
+            from ..comm.compressed import program_feedback_init
+
+            # n_elems comes from the planner-resolution branch above — the
+            # only producer of a program decision, so it is always bound here
+            per_rank = program_feedback_init(n_elems, dp_grad_impl[2],
+                                             dict(topo.mesh.shape))
+            if per_rank is not None:
+                fb_sh = NamedSharding(topo.mesh, P(topo.dp_axes))
+                fb = type(per_rank)(
+                    worker_error=jax.device_put(
+                        jnp.zeros((topo.dp_size,)
+                                  + per_rank.worker_error.shape, jnp.float32),
+                        fb_sh),
+                    server_error=jax.device_put(
+                        jnp.zeros((topo.dp_size,)
+                                  + per_rank.server_error.shape, jnp.float32),
+                        fb_sh))
+        # () vs a 2-field NamedTuple: length check only, no array compares
+        self._dp_feedback = fb != ()
+        self.state = self.state.replace(comm_feedback=fb)
 
         def train_step(state: TrainState, batch, rng, *, ltd_keep=None,
                        moq_bits=None):
@@ -615,10 +668,13 @@ class DeepSpeedTPUEngine:
             # degraded mode flips it off and invalidates compiled steps, and
             # the retrace must land on the exact psum path
             if self._compressed_dp:
-                grads, losses = self._compressed_grad_phase(
+                grads, losses, new_fb = self._compressed_grad_phase(
                     state.params, batch, rngs, rng, scale,
+                    feedback=(state.comm_feedback if self._dp_feedback
+                              else None),
                     ltd_keep=ltd_keep, moq_bits=moq_bits)
             else:
+                new_fb = None
                 zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
                 zeros = jax.lax.with_sharding_constraint(zeros, rules.shardings(self.grad_spec_tree))
                 acc, losses = lax.scan(micro, zeros, (batch, rngs))
@@ -662,7 +718,10 @@ class DeepSpeedTPUEngine:
                 max_hysteresis=config.fp16.hysteresis,
                 consecutive_hysteresis=config.fp16.consecutive_hysteresis)
             new_state = TrainState(step=state.step + 1, params=new_params,
-                                   opt_state=new_opt, loss_scale=new_ls)
+                                   opt_state=new_opt, loss_scale=new_ls,
+                                   comm_feedback=(state.comm_feedback
+                                                  if new_fb is None
+                                                  else new_fb))
             metrics = {
                 "loss": jnp.mean(losses),
                 "grad_norm": grad_norm,
@@ -720,7 +779,10 @@ class DeepSpeedTPUEngine:
             step=NamedSharding(topo.mesh, P()),
             params=self._param_shardings,
             opt_state=self._opt_shardings,
-            loss_scale=jax.tree.map(lambda _: NamedSharding(topo.mesh, P()), self.state.loss_scale))
+            loss_scale=jax.tree.map(lambda _: NamedSharding(topo.mesh, P()), self.state.loss_scale),
+            comm_feedback=jax.tree.map(
+                lambda _: NamedSharding(topo.mesh, P(topo.dp_axes)),
+                self.state.comm_feedback))
 
         if self._host_adam is not None:
             grad_sh = jax.tree.map(lambda s: NamedSharding(topo.mesh, s),
@@ -747,7 +809,8 @@ class DeepSpeedTPUEngine:
         self._compile_finish(state_sh)
 
     def _compressed_grad_phase(self, params, batch, rngs, step_rng, scale,
-                               *, ltd_keep=None, moq_bits=None):
+                               *, feedback=None, ltd_keep=None,
+                               moq_bits=None):
         """GAS scan + quantized mean all-reduce, per-shard under shard_map.
 
         The exact path lets SPMD insert fp32 psums where replicated params
@@ -767,14 +830,22 @@ class DeepSpeedTPUEngine:
         SPMD path computes the global count-weighted mean — identical for
         the engine's fixed-shape microbatches, different when per-rank valid
         counts diverge (the same contract as ``compression/onebit.py``'s
-        per-shard reduction)."""
+        per-shard reduction).
+
+        ``feedback`` (the engine-owned ``TrainState.comm_feedback`` — per-
+        rank residuals stacked on a leading dp dim) rides the shard_map as
+        an extra sharded operand when a program with an ``int8_ef`` hop is
+        resolved; the per-shard slice feeds the reduction and the updated
+        residual comes back out. Returns ``(grads, losses, new_feedback)``
+        — ``new_feedback`` is ``None`` on the feedback-free paths."""
         from ..utils.shard_map_compat import shard_map_nocheck
 
         topo, gas = self.topo, self.gas
         dpaxes = topo.dp_axes
         sr_key = jax.random.fold_in(step_rng, 0x0151)
+        fb_in = feedback if feedback else None  # () and None both mean "off"
 
-        def per_shard(p, b_l, rngs_l, k):
+        def accumulate(p, b_l, rngs_l):
             def micro_l(acc, xs):
                 mb, mb_rng = xs
 
@@ -789,40 +860,75 @@ class DeepSpeedTPUEngine:
 
             zeros = jax.tree.map(lambda l: jnp.zeros(l.shape, jnp.float32), p)
             acc, losses = lax.scan(micro_l, zeros, (b_l, rngs_l))
-            acc = jax.tree.map(lambda g: g / (scale * gas), acc)
-            return (self._quantized_grad_reduce(acc, k),
-                    lax.pmean(losses, dpaxes))
+            return jax.tree.map(lambda g: g / (scale * gas), acc), losses
+
+        if fb_in is None:
+            def per_shard(p, b_l, rngs_l, k):
+                acc, losses = accumulate(p, b_l, rngs_l)
+                return (self._quantized_grad_reduce(acc, k)[0],
+                        lax.pmean(losses, dpaxes))
+
+            grads, losses = shard_map_nocheck(
+                per_shard, topo.mesh,
+                in_specs=(P(), P(None, dpaxes), P(), P()),
+                out_specs=(P(), P()))(params, batch, rngs, sr_key)
+            return grads, losses, None
+
+        fb_spec = jax.tree.map(lambda _: P(dpaxes), fb_in)
+
+        def per_shard_fb(p, b_l, rngs_l, k, fb_l):
+            acc, losses = accumulate(p, b_l, rngs_l)
+            fb0 = jax.tree.map(lambda t: t[0], fb_l)  # [1, n] -> [n]
+            red, nfb = self._quantized_grad_reduce(acc, k, feedback=fb0)
+            nfb = jax.tree.map(lambda t: t[None], nfb)
+            return red, lax.pmean(losses, dpaxes), nfb
 
         return shard_map_nocheck(
-            per_shard, topo.mesh,
-            in_specs=(P(), P(None, dpaxes), P(), P()),
-            out_specs=(P(), P()))(params, batch, rngs, sr_key)
+            per_shard_fb, topo.mesh,
+            in_specs=(P(), P(None, dpaxes), P(), P(), fb_spec),
+            out_specs=(P(), P(), fb_spec))(params, batch, rngs, sr_key, fb_in)
 
-    def _quantized_grad_reduce(self, grads, sr_key):
+    def _quantized_grad_reduce(self, grads, sr_key, feedback=None):
         """Flatten a per-shard fp32 grad tree into ONE vector (the
         flat-buffer transport — one collective per reduction, padding paid
-        once), mean-reduce it with the quantized (optionally hierarchical)
-        all-reduce, unflatten. Called INSIDE shard_map over the dp axes;
-        shared by the GAS-scan and imperative-backward() paths."""
-        from ..comm.compressed import (hierarchical_quantized_all_reduce,
-                                       quantized_all_reduce)
+        once), mean-reduce it with the resolved transport, unflatten.
+        Called INSIDE shard_map over the dp axes; shared by the GAS-scan
+        and imperative-backward() paths.
 
-        mode_, block_, hier_ = self._dp_grad_impl  # knob- or planner-resolved
-        sr = mode_ == "int8_sr"
+        Transports: flat ``quantized_all_reduce`` (int8/int8_sr), the
+        legacy hand-wired two-level knob (inner ``ep`` exact, outer
+        ``dp_outer`` quantized), or a planner-synthesized multi-phase
+        PROGRAM (``run_collective_program`` — exact ICI reduce-scatter,
+        int8+feedback DCN hop, ICI all-gather) when the decision carries
+        one. Returns ``(grad_tree, new_feedback)``; ``new_feedback`` is
+        ``None`` unless a program's ``int8_ef`` hop consumed ``feedback``."""
+        from ..comm.compressed import (hierarchical_quantized_all_reduce,
+                                       quantized_all_reduce,
+                                       run_collective_program)
+
+        mode_, block_, extra_ = self._dp_grad_impl  # knob- or planner-resolved
         flat, tdef = jax.tree.flatten(grads)
         sizes = [int(np.prod(g.shape)) for g in flat]
         shapes = [g.shape for g in flat]
         vec = jnp.concatenate([jnp.ravel(g) for g in flat])
-        kw = dict(block=block_, stochastic=sr, key=sr_key if sr else None)
-        if hier_:
-            # inner (ICI-local) hop exact, only the outer hops quantize
-            red = hierarchical_quantized_all_reduce(vec, "ep", "dp_outer", **kw)
+        new_fb = None
+        if mode_ == "program":
+            red, new_fb = run_collective_program(vec, extra_,
+                                                 feedback=feedback,
+                                                 key=sr_key)
         else:
-            red = quantized_all_reduce(vec, self.topo.dp_axes, **kw)
+            sr = mode_ == "int8_sr"
+            kw = dict(block=block_, stochastic=sr, key=sr_key if sr else None)
+            if extra_:
+                # inner (ICI-local) hop exact, only the outer hops quantize
+                red = hierarchical_quantized_all_reduce(vec, "ep", "dp_outer",
+                                                        **kw)
+            else:
+                red = quantized_all_reduce(vec, self.topo.dp_axes, **kw)
         offs = np.cumsum([0] + sizes)
         return jax.tree.unflatten(tdef, [
             red[offs[i]:offs[i + 1]].reshape(shapes[i])
-            for i in range(len(sizes))])
+            for i in range(len(sizes))]), new_fb
 
     def _compile_finish(self, state_sh):
         self._train_step = self._train_steps[(None, None)]
@@ -976,7 +1082,8 @@ class DeepSpeedTPUEngine:
                                       emit_bf16=emit_bf16)
         new_params = jax.device_put(new_np, self._param_shardings)
         self.state = TrainState(step=state.step + 1, params=new_params,
-                                opt_state=(), loss_scale=state.loss_scale)
+                                opt_state=(), loss_scale=state.loss_scale,
+                                comm_feedback=state.comm_feedback)
 
     def _log_memory_breakdown(self, step_fn, batch, step_rng):
         """Step-1 memory report (reference ``see_memory_usage`` at the first
@@ -1061,7 +1168,12 @@ class DeepSpeedTPUEngine:
 
             g, loss = jax.grad(loss_fn, has_aux=True)(p)
             g = jax.tree.map(lambda t: t.astype(jnp.float32), g)
-            return (self._quantized_grad_reduce(g, jax.random.fold_in(r, 0x0151)),
+            # feedback=None: the compat micro path reduces per MICROBATCH —
+            # a residual per micro would be a different (noisier) carry than
+            # the fused step's one-per-step; a program's int8_ef hop runs as
+            # plain int8 here
+            return (self._quantized_grad_reduce(
+                        g, jax.random.fold_in(r, 0x0151))[0],
                     lax.pmean(loss, dpaxes))
 
         return shard_map_nocheck(
@@ -1176,7 +1288,8 @@ class DeepSpeedTPUEngine:
                                            min_scale=config.fp16.min_loss_scale,
                                            max_hysteresis=config.fp16.hysteresis)
                 return TrainState(step=state.step + 1, params=new_params,
-                                  opt_state=new_opt, loss_scale=new_ls)
+                                  opt_state=new_opt, loss_scale=new_ls,
+                                  comm_feedback=state.comm_feedback)
 
             # out_shardings keep the optimizer state's memory kind (pinned
             # host under the offload storage tier) across compat steps

@@ -30,7 +30,7 @@ Everything is gated behind the ``resilience:`` config block; with it off
 
 from .faults import FaultPlan, InjectedCrash
 from .heartbeat import (FileHeartbeatTransport, HealthTable, HeartbeatWriter,
-                        HostHealth)
+                        HostHealth, ObjectStoreHeartbeatTransport)
 from .preempt import PreemptionWatcher
 from .sentinel import Sentinel, SentinelEvent, SentinelHalt
 from .snapshot import SnapshotManager
@@ -41,4 +41,5 @@ __all__ = ["SnapshotManager", "Sentinel", "SentinelEvent", "SentinelHalt",
            "PreemptionWatcher", "FaultPlan", "InjectedCrash",
            "ResilienceManager", "resolve_restore", "StepWatchdog",
            "WATCHDOG_EXIT_CODE", "PREEMPT_EXIT_CODE", "HeartbeatWriter",
-           "HealthTable", "HostHealth", "FileHeartbeatTransport"]
+           "HealthTable", "HostHealth", "FileHeartbeatTransport",
+           "ObjectStoreHeartbeatTransport"]

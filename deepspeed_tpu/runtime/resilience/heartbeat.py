@@ -71,6 +71,93 @@ class FileHeartbeatTransport:
         return out
 
 
+class _LocalBucketStub:
+    """Minimal object-store client over a local directory, with BUCKET
+    semantics: whole-object PUT/GET only (a reader never observes a partial
+    write — PUT lands atomically), last-writer-wins per key, flat key
+    namespace under a prefix. Stands in for a GCS/S3 bucket in tests and on
+    dev boxes; a real deployment passes any client object with the same
+    three methods (``put_object``/``get_object``/``list_objects``) to
+    :class:`ObjectStoreHeartbeatTransport` instead."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        # keys are opaque bucket paths; map separators into the local tree
+        safe = key.strip("/").replace("/", os.sep)
+        return os.path.join(self.root, safe)
+
+    def put_object(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.put.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # the atomic whole-object PUT
+
+    def get_object(self, key: str) -> bytes:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except OSError:
+            raise KeyError(key)
+
+    def list_objects(self, prefix: str):
+        base = self._path(prefix)
+        try:
+            names = os.listdir(base)
+        except OSError:
+            return []
+        pfx = prefix.strip("/")
+        return [f"{pfx}/{n}" for n in sorted(names)
+                if not n.split(os.sep)[-1].startswith(".")
+                and ".put." not in n]
+
+
+class ObjectStoreHeartbeatTransport:
+    """The :class:`FileHeartbeatTransport` write/read_all protocol against a
+    shared-bucket key/value layout (``<prefix>/hb-<rank>.json`` objects), so
+    multi-slice fleets heartbeat through the object store they already have
+    instead of needing a shared POSIX filesystem (slices rarely cross-mount
+    one). Bucket contract: whole-object PUT/GET (no partial reads — a
+    beacon decodes completely or reads as absent) and last-writer-wins per
+    rank key (each rank owns its key; concurrent PUTs of the same key
+    resolve to the newest, which is exactly beacon semantics).
+
+    ``store`` is either a directory path (a :class:`_LocalBucketStub` is
+    built over it) or any client exposing ``put_object(key, bytes)``,
+    ``get_object(key) -> bytes`` and ``list_objects(prefix) -> [keys]``.
+    """
+
+    def __init__(self, store, prefix: str = "heartbeats"):
+        self.client = (_LocalBucketStub(store) if isinstance(store, str)
+                       else store)
+        self.prefix = prefix.strip("/")
+
+    def _key(self, rank: int) -> str:
+        return f"{self.prefix}/{_BEACON_PREFIX}{int(rank)}.json"
+
+    def write(self, rank: int, payload: dict) -> None:
+        self.client.put_object(self._key(rank),
+                               json.dumps(payload).encode("utf-8"))
+
+    def read_all(self) -> Dict[int, dict]:
+        out: Dict[int, dict] = {}
+        for key in self.client.list_objects(self.prefix):
+            name = key.rsplit("/", 1)[-1]
+            if not (name.startswith(_BEACON_PREFIX)
+                    and name.endswith(".json")):
+                continue
+            try:
+                rank = int(name[len(_BEACON_PREFIX):-len(".json")])
+                out[rank] = json.loads(self.client.get_object(key))
+            except (ValueError, KeyError, json.JSONDecodeError):
+                continue  # foreign object / deleted between list and get
+        return out
+
+
 class HeartbeatWriter:
     """Publishes this host's beacon. ``clock`` is injectable so tests can
     fabricate beacon ages deterministically."""

@@ -419,6 +419,17 @@ class ResilienceManager:
         self._saved_dp_impl = (engine._compressed_dp, engine._dp_grad_impl)
         engine._compressed_dp = False
         engine._dp_grad_impl = None
+        # the DCN-compressed program's error-feedback residual belongs to
+        # the abandoned compressed trajectory: zero it (structure kept — the
+        # retraced exact step just carries the zeros) so a later operator
+        # clear_degraded() cannot re-inject a stale correction; the keyed
+        # registry residuals of out-of-engine callers are dropped outright
+        engine.state = engine.state.replace(
+            comm_feedback=jax.tree.map(jax.numpy.zeros_like,
+                                       engine.state.comm_feedback))
+        from ...comm.compressed import clear_feedback
+
+        clear_feedback()
         engine._degraded_collectives = True
         self.degraded = True
         self._invalidate_compiled_steps()

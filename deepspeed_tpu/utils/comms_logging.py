@@ -52,6 +52,11 @@ class CommsLogger:
         # compressed collectives report int8 payload + scale lanes there.
         self.comms_dict: Dict[str, Dict[int, List[float]]] = defaultdict(
             lambda: defaultdict(lambda: [0, 0.0, 0, 0]))
+        # hop class ("ici" | "dcn" | "host") -> accumulated wire bytes:
+        # multi-phase collective programs tag each phase with the link class
+        # its traffic rides (comm/planner ir.PhaseStep.link), so the ledger
+        # can answer "how many bytes crossed the slice boundary" directly
+        self.hop_bytes: Dict[str, int] = defaultdict(int)
         # site signature -> planner decision info (comm/planner): per-mesh
         # facts, not per-step counters — reset() deliberately keeps them
         self.plan_records: Dict[str, Dict[str, Any]] = {}
@@ -74,10 +79,12 @@ class CommsLogger:
         return self.prof_all or op_name in self.prof_ops
 
     def append(self, op_name: str, size_bytes: int, latency_s: float = 0.0, traced: bool = False,
-               wire_bytes: Optional[int] = None):
+               wire_bytes: Optional[int] = None, hop_class: Optional[str] = None):
         """``wire_bytes`` defaults to ``size_bytes`` (exact collectives move
         what they carry); compressed collectives pass the smaller on-wire
-        total so the ledger can report the compression ratio."""
+        total so the ledger can report the compression ratio. ``hop_class``
+        additionally buckets the wire bytes by link class (ici/dcn/host) —
+        only hop-aware callers (program phases) pass it."""
         if not self._should_log(op_name):
             return
         rec = self.comms_dict[op_name][size_bytes]
@@ -85,6 +92,9 @@ class CommsLogger:
         rec[1] += latency_s
         rec[2] += 1 if traced else 0
         rec[3] += int(size_bytes if wire_bytes is None else wire_bytes)
+        if hop_class is not None:
+            self.hop_bytes[hop_class] += int(
+                size_bytes if wire_bytes is None else wire_bytes)
         if self.verbose:
             from .logging import logger
 
@@ -114,7 +124,8 @@ class CommsLogger:
                 f"{r.get('shape', '?'):<18}{r.get('axes', '?'):<16}"
                 f"{r.get('impl', '?'):<14}{str(r.get('block') or '-'):<8}"
                 f"{r.get('source', '?'):<12}"
-                f"{str(r.get('est_us') if r.get('est_us') is not None else '-'):<10}")
+                f"{str(r.get('est_us') if r.get('est_us') is not None else '-'):<10}"
+                + (f" {r['program']}" if r.get("program") else ""))
         return lines
 
     def monitor_events(self, step: int, prefix: str = "Train/Comms"):
@@ -174,8 +185,22 @@ class CommsLogger:
         print("\n".join(lines), flush=True)
         return self.totals()
 
+    def hop_totals(self) -> Dict[str, int]:
+        """Wire bytes per link class (``{"ici": .., "dcn": ..}``) — empty
+        unless hop-aware collectives (multi-phase programs) ran."""
+        return dict(self.hop_bytes)
+
+    def log_hop_bytes(self, link: str, nbytes: int) -> None:
+        """Attribute already-ledgered wire bytes to a link class — for
+        program phases whose underlying primitive (the ppermute chunk ring)
+        writes its own per-op ledger entry without hop awareness."""
+        if not self.enabled:
+            return
+        self.hop_bytes[link] += int(nbytes)
+
     def reset(self):
         self.comms_dict.clear()
+        self.hop_bytes.clear()
 
 
 class timed_op:

@@ -598,3 +598,300 @@ def test_model_embed_auto_defers_to_planner():
                                atol=2e-5)
     recs = dist.get_comms_logger().plan_records
     assert any(v["consumer"] == "embed" for v in recs.values())
+
+
+# ---------------------------------------------------------------------------
+# multi-phase program synthesis (ISSUE 8: DCN-aware hierarchical programs)
+# ---------------------------------------------------------------------------
+
+
+def _dcn_fp(ep=8, dcn=("dp_outer",)):
+    return MeshFingerprint(platform="tpu", device_kind="TPU v5e",
+                           n_devices=64, n_processes=8,
+                           axis_sizes=(("pp", 1), ("dp_outer", 8), ("ep", ep),
+                                       ("sp", 1), ("tp", 1)),
+                           dcn_axes=tuple(dcn))
+
+
+def _dp_site(n=1 << 22):
+    return make_site(op="all_reduce", shape=(n,), dtype="float32",
+                     axes=("dp_outer", "ep"), consumer="dp-grad")
+
+
+def test_synthesize_programs_shapes_and_gating():
+    from deepspeed_tpu.comm.planner import (PhaseStep, synthesize_programs)
+
+    cm = CostModel(_dcn_fp())
+    progs = synthesize_programs(_dp_site(), cm)
+    assert len(progs) == 3
+    for prog in progs:
+        assert all(isinstance(s, PhaseStep) for s in prog)
+        rs, ar, ag = prog
+        # the canonical hierarchy: ICI rs/ag exact, the DCN hop in the middle
+        assert rs.phase_op == "reduce_scatter" and rs.axes == ("ep",)
+        assert rs.wire_dtype == "exact" and rs.link == "ici"
+        assert ar.phase_op == "all_reduce" and ar.axes == ("dp_outer",)
+        assert ar.link == "dcn"
+        assert ag.phase_op == "all_gather" and ag.axes == ("ep",)
+    # gradient consumer => error feedback on the quantized outer hop
+    assert progs[0][1].wire_dtype == "int8_ef"
+    assert progs[1][1].wire_dtype == "exact"
+    assert progs[2][2].via == "bidir_ring"
+    # no inner level (ep=1): nothing to reduce-scatter over, no programs
+    assert synthesize_programs(_dp_site(), CostModel(_dcn_fp(ep=1))) == []
+    # activation consumer would get plain int8 (no dither, no feedback)
+    act = make_site(op="all_reduce", shape=(1 << 20,), dtype="float32",
+                    axes=("dp_outer", "ep"), consumer="ulysses")
+    assert synthesize_programs(act, cm)[0][1].wire_dtype == "int8"
+    # foreign-mesh and single-axis sites never synthesize
+    single = make_site(op="all_reduce", shape=(1 << 20,), dtype="float32",
+                       axes=("ep",), consumer="dp-grad")
+    assert synthesize_programs(single, cm) == []
+
+
+def test_program_cost_ordering_dcn_vs_all_ici():
+    """The acceptance ordering: with a DCN axis in the dp span the
+    hierarchical int8-outer program beats every flat impl (the DCN hop
+    carries 1/p_inner the bytes at 1/4 the width); on an all-ICI mesh the
+    extra full-width phases cost more than they save and flat wins."""
+    from deepspeed_tpu.comm.planner import synthesize_programs
+
+    site = _dp_site()
+    cm_dcn = CostModel(_dcn_fp())
+    progs = synthesize_programs(site, cm_dcn)
+    best_prog = min(cm_dcn.estimate_program(site, p) for p in progs)
+    assert best_prog < cm_dcn.estimate(site, "xla")
+    assert best_prog < cm_dcn.estimate(site, "int8")
+    assert best_prog < cm_dcn.estimate(site, "hierarchical")
+    # the winning program quantizes the DCN hop (exact-outer loses there)
+    ranked = sorted(progs, key=lambda p: cm_dcn.estimate_program(site, p))
+    assert ranked[0][1].wire_dtype == "int8_ef"
+
+    # all-ICI: the dp span crosses no DCN axis — synthesis declines (the
+    # extra full-width phases cannot pay on uniform links), and the legacy
+    # single-impl hierarchical estimate confirms the ordering: it loses to
+    # flat int8 there
+    cm_ici = CostModel(_dcn_fp(dcn=()))
+    assert synthesize_programs(site, cm_ici) == []
+    assert cm_ici.estimate(site, "hierarchical") > cm_ici.estimate(site,
+                                                                   "int8")
+
+
+def test_static_mode_resolves_program_on_dcn_mesh():
+    set_topology(Topology(TopologySpec(ep=2)))
+    p = CollectivePlanner("static", use_cache=False,
+                          dcn_axes=["dp_outer"])
+    assert "dp_outer" in p.fingerprint.dcn_axes  # forced into the print
+    d = p.resolve(_dp_site())
+    assert d.impl == "program" and d.source == "cost-model"
+    rs, ar, ag = d.program
+    assert (rs.phase_op, ar.wire_dtype, ag.phase_op) == \
+        ("reduce_scatter", "int8_ef", "all_gather")
+    # same mesh WITHOUT the override: single-process CPU mesh has no DCN
+    # axis, programs lose, the site resolves to a flat impl
+    q = CollectivePlanner("static", use_cache=False)
+    assert q.resolve(_dp_site()).impl != "program"
+    # forced fingerprints key a DIFFERENT plan-cache slot
+    assert p.fingerprint.digest() != q.fingerprint.digest()
+
+
+def test_program_decision_roundtrips_through_disk_cache(tmp_path):
+    """Program-IR JSON round-trip through the cache file, plus byte-compat:
+    a single-impl decision's serialized keys are exactly the pre-program
+    set (old planners can keep reading mixed caches)."""
+    set_topology(Topology(TopologySpec(ep=2)))
+    site = _dp_site()
+    a = CollectivePlanner("static", cache_dir=str(tmp_path),
+                          dcn_axes=["dp_outer"])
+    da = a.resolve(site)
+    assert da.impl == "program"
+    body = json.load(open(a.cache.path_for(a.fingerprint)))
+    entry = body["sites"][site.signature()]
+    assert isinstance(entry["program"], list) and len(entry["program"]) == 3
+    assert entry["program"][1]["wire_dtype"] == "int8_ef"
+    # fresh planner loads the SAME program from disk
+    b = CollectivePlanner("static", cache_dir=str(tmp_path),
+                          dcn_axes=["dp_outer"])
+    db = b.resolve(site)
+    assert db.source == "cache" and db.impl == "program"
+    assert db.program == da.program
+    # byte-compat: single-impl decisions serialize without a program key
+    flat = PlanDecision(impl="int8", block=512, source="measured",
+                        est_us=1.5)
+    assert set(flat.to_dict()) == {"impl", "block", "source", "est_us"}
+    assert PlanDecision.from_dict(flat.to_dict()) == flat
+
+
+def test_program_decision_rank0_broadcast_spmd(monkeypatch):
+    """Multi-host SPMD consistency: program decisions ride the same rank-0
+    broadcast as single-impl ones — the payload must survive a strict JSON
+    round-trip (what the wire does to it) with the program intact."""
+    import deepspeed_tpu.comm.planner.planner as planner_mod
+
+    set_topology(Topology(TopologySpec(ep=2)))
+    p = CollectivePlanner("static", use_cache=False, dcn_axes=["dp_outer"])
+    sent = {}
+
+    def fake_agree(decision):
+        wire = json.loads(json.dumps(decision.to_dict()))  # strict JSON
+        sent["payload"] = wire
+        return PlanDecision.from_dict(wire)
+
+    monkeypatch.setattr(p, "_agree", fake_agree)
+    d = p.resolve(_dp_site())
+    assert d.impl == "program" and len(d.program) == 3
+    assert d.program[1].wire_dtype == "int8_ef"
+    assert sent["payload"]["program"][0]["axes"] == ["ep"]
+
+
+def test_measure_mode_times_program_candidates():
+    """measure mode executes synthesized programs through the microbench
+    harness (probe caps keep it cheap); the winner is a real timing."""
+    set_topology(Topology(TopologySpec(ep=2)))
+    p = CollectivePlanner("measure", use_cache=False, measure_reps=2,
+                          measure_max_elems=1 << 12, margin=50.0,
+                          dcn_axes=["dp_outer"])
+    d = p.resolve(make_site(op="all_reduce", shape=(1 << 12,),
+                            dtype="float32", axes=("dp_outer", "ep"),
+                            consumer="dp-grad"))
+    assert d.source == "measured"
+    # on the CPU mesh any winner is legitimate; the contract is that the
+    # program candidates RAN (benchmark_site accepts them without error)
+    from deepspeed_tpu.comm.planner import benchmark_site, synthesize_programs
+
+    prog = synthesize_programs(_dp_site(1 << 12), p.cost)[0]
+    t = benchmark_site(_dp_site(1 << 12), "program", program=prog,
+                       reps=2, max_elems=1 << 12)
+    assert t > 0
+
+
+def _run_engine_dcn(extra_cfg, steps=4, seed=0):
+    """Engine run on a (dp_outer=4, ep=2) mesh — ep is the slice-local dp
+    axis (the zeropp split) — with a ~130k-param problem so the int8 DCN
+    hop pays for its quantization in the cost model."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel import Topology as Topo
+
+    rng = np.random.default_rng(seed)
+    params = {"w1": jnp.asarray(rng.normal(size=(256, 512)) * 0.05,
+                                jnp.float32),
+              "w2": jnp.asarray(rng.normal(size=(512, 32)) * 0.05,
+                                jnp.float32)}
+
+    def loss_fn(p, batch, rng=None):
+        x, y = batch
+        pred = jnp.tanh(x @ p["w1"]) @ p["w2"]
+        return jnp.mean((pred - y) ** 2)
+
+    def batch(i, n=16 * 8):
+        r = np.random.default_rng(1000 + i)
+        x = jnp.asarray(r.normal(size=(n, 256)), jnp.float32)
+        return (x, jnp.asarray(x[:, :32] * 0.5, jnp.float32))
+
+    cfg = {"train_micro_batch_size_per_gpu": 16,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": 0}, "steps_per_print": 10**9}
+    cfg.update(extra_cfg or {})
+    eng, *_ = ds.initialize(model=loss_fn,
+                            model_parameters=jax.tree.map(jnp.copy, params),
+                            config=cfg,
+                            topology=Topo(TopologySpec(ep=2)))
+    return eng, [float(eng.train_batch(batch(i))) for i in range(steps)]
+
+
+def test_engine_dp_grad_program_under_static_dcn():
+    """The ISSUE 8 acceptance path: comm_planner static on a mesh with a
+    DCN dp axis selects the multi-phase hierarchical program for the
+    engine DP-grad site (ICI hop exact, DCN hop int8+feedback), the engine
+    executes it, losses track the exact run within quantization tolerance,
+    and the error-feedback residual is engine-owned state that actually
+    carries across steps."""
+    _, ref = _run_engine_dcn(None)
+    eng, got = _run_engine_dcn({"comm_planner": {"mode": "static",
+                                                 "use_cache": False,
+                                                 "dcn_axes": ["dp_outer"]}})
+    assert eng._compressed_dp is True
+    mode_, _, prog = eng._dp_grad_impl
+    assert mode_ == "program"
+    assert [s.phase_op for s in prog] == ["reduce_scatter", "all_reduce",
+                                          "all_gather"]
+    assert prog[0].wire_dtype == "exact" and prog[1].wire_dtype == "int8_ef"
+    # residual is engine state: initialized zero, NONZERO after stepping
+    # (the reset-every-trace bug would leave it identically zero), and
+    # stacked per-rank on the dp leading dim
+    assert eng._dp_feedback is True
+    fb = eng.state.comm_feedback
+    assert fb.worker_error.shape[0] == 8  # dp world
+    assert float(jnp.abs(fb.worker_error).max()) > 0
+    # numerics: compressed DCN hop tracks the exact run (PR2 tolerance).
+    # The first loss predates any reduction effect but the step compiles
+    # as a different XLA program, so allow ulp-level fusion drift.
+    assert abs(got[0] - ref[0]) < 1e-5 * abs(ref[0])
+    for a, b in zip(ref, got):
+        assert abs(a - b) < 0.05 * abs(a) + 1e-3, (ref, got)
+    recs = dist.get_comms_logger().plan_records
+    dp = [v for v in recs.values() if v["consumer"] == "dp-grad"]
+    assert dp and dp[0]["impl"] == "program" and "program" in dp[0]
+
+
+def test_engine_program_residual_carries_and_differs_per_step():
+    """Regression for the satellite bugfix: two consecutive steps see a
+    CARRIED residual (step-2 input residual == step-1 output residual, by
+    construction of TrainState threading), not a fresh zero per trace."""
+    eng, _ = _run_engine_dcn({"comm_planner": {"mode": "static",
+                                               "use_cache": False,
+                                               "dcn_axes": ["dp_outer"]}},
+                             steps=1)
+    fb1 = np.asarray(eng.state.comm_feedback.worker_error)
+    assert np.abs(fb1).max() > 0  # step 1 left a residual behind
+
+    def batch(i, n=16 * 8):
+        r = np.random.default_rng(1000 + i)
+        x = jnp.asarray(r.normal(size=(n, 256)), jnp.float32)
+        return (x, jnp.asarray(x[:, :32] * 0.5, jnp.float32))
+
+    eng.train_batch(batch(1))
+    fb2 = np.asarray(eng.state.comm_feedback.worker_error)
+    assert np.abs(fb2).max() > 0
+    assert not np.array_equal(fb1, fb2)  # evolving carry, not a constant
+
+
+def test_program_residual_rides_snapshots_and_rollback_restores_it(tmp_path):
+    """Tentpole contract with the PR 4 resilience tier: the error-feedback
+    residual is TrainState, so snapshots carry it, and a rollback restores
+    the SNAPSHOT's residual — the one matching the restored params —
+    instead of replaying the abandoned trajectory's carry into them."""
+    eng, _ = _run_engine_dcn({"comm_planner": {"mode": "static",
+                                               "use_cache": False,
+                                               "dcn_axes": ["dp_outer"]},
+                              "resilience": str(tmp_path)}, steps=2)
+    assert eng.resilience is not None
+    fb_snap = np.asarray(eng.state.comm_feedback.worker_error)
+    assert np.abs(fb_snap).max() > 0
+    eng.resilience.take_snapshot()
+
+    def batch(i, n=16 * 8):
+        r = np.random.default_rng(1000 + i)
+        x = jnp.asarray(r.normal(size=(n, 256)), jnp.float32)
+        return (x, jnp.asarray(x[:, :32] * 0.5, jnp.float32))
+
+    eng.train_batch(batch(2))
+    eng.train_batch(batch(3))
+    fb_later = np.asarray(eng.state.comm_feedback.worker_error)
+    assert not np.array_equal(fb_snap, fb_later)  # the carry moved on
+
+    eng.resilience._rollback()
+    fb_restored = np.asarray(eng.state.comm_feedback.worker_error)
+    np.testing.assert_array_equal(fb_restored, fb_snap)
+
+
+def test_engine_program_off_paths_unchanged():
+    """Defaults-off bit-identity on the DCN-capable mesh: no planner, no
+    knob => exact psum path, no feedback state in TrainState (zero extra
+    pytree leaves), losses bitwise equal across runs."""
+    eng1, run1 = _run_engine_dcn(None)
+    eng2, run2 = _run_engine_dcn({"comm_planner": "off"})
+    assert run1 == run2
+    assert eng1._compressed_dp is False and eng1._dp_feedback is False
+    assert eng1.state.comm_feedback == ()
+    assert len(jax.tree.leaves(eng1.state.comm_feedback)) == 0

@@ -26,6 +26,7 @@ from deepspeed_tpu.runtime.resilience import (PREEMPT_EXIT_CODE,
                                               WATCHDOG_EXIT_CODE, FaultPlan,
                                               FileHeartbeatTransport,
                                               HealthTable, HeartbeatWriter,
+                                              ObjectStoreHeartbeatTransport,
                                               SnapshotManager, StepWatchdog)
 
 from .simple_model import make_simple_params, random_batches, simple_loss
@@ -199,6 +200,124 @@ def test_heartbeat_no_straggler_without_peers(tmp_path):
     tr = FileHeartbeatTransport(str(tmp_path))
     HeartbeatWriter(tr, rank=0).beat(1, 10.0)  # slow, but alone
     assert HealthTable(tr).verdicts()["stragglers"] == []
+
+
+# ---------------------------------------------------------------------------
+# object-store heartbeat transport (multi-slice fleets: shared bucket, not
+# a shared POSIX filesystem)
+# ---------------------------------------------------------------------------
+
+
+def test_object_store_transport_roundtrip_and_bucket_semantics(tmp_path):
+    tr = ObjectStoreHeartbeatTransport(str(tmp_path))
+    HeartbeatWriter(tr, rank=3).beat(step=9, step_time_s=0.2)
+    beacons = tr.read_all()
+    assert set(beacons) == {3} and beacons[3]["step"] == 9
+    # last-writer-wins per rank key: a newer PUT fully replaces the old
+    HeartbeatWriter(tr, rank=3).beat(step=10, step_time_s=0.3)
+    assert tr.read_all()[3]["step"] == 10
+    # no partial reads: a torn/foreign object decodes as absent, never as
+    # a half-beacon (bucket PUTs are whole-object)
+    tr.client.put_object("heartbeats/hb-4.json", b"{torn")
+    tr.client.put_object("heartbeats/notes.txt", b"hi")
+    assert set(tr.read_all()) == {3}
+
+
+def test_object_store_transport_custom_client(tmp_path):
+    """Any put/get/list client plugs in — the dict client here is the
+    shape a real GCS/S3 adapter takes."""
+
+    class DictBucket:
+        def __init__(self):
+            self.objects = {}
+
+        def put_object(self, key, data):
+            self.objects[key] = bytes(data)
+
+        def get_object(self, key):
+            return self.objects[key]
+
+        def list_objects(self, prefix):
+            return sorted(k for k in self.objects
+                          if k.startswith(prefix.strip("/") + "/"))
+
+    tr = ObjectStoreHeartbeatTransport(DictBucket(), prefix="fleet/hb")
+    for rank in range(3):
+        HeartbeatWriter(tr, rank=rank).beat(step=rank, step_time_s=0.1)
+    assert set(tr.read_all()) == {0, 1, 2}
+
+
+def test_object_store_transport_drives_health_table(tmp_path):
+    """The bucket transport swaps into HealthTable: dead-host and
+    straggler verdicts work identically to the file transport."""
+    tr = ObjectStoreHeartbeatTransport(str(tmp_path))
+    now = [500.0]
+    HeartbeatWriter(tr, rank=0, clock=lambda: now[0]).beat(4, 0.1)
+    HeartbeatWriter(tr, rank=1, clock=lambda: now[0]).beat(4, 0.11)
+    HeartbeatWriter(tr, rank=2, clock=lambda: now[0] - 300.0).beat(1, 0.1)
+    table = HealthTable(tr, dead_after_s=60.0, clock=lambda: now[0])
+    assert table.verdicts()["dead"] == [2]
+
+
+# ---------------------------------------------------------------------------
+# DCN-tier fault drills: straggler on a cross-slice axis, slice loss →
+# elastic shrink onto the survivors
+# ---------------------------------------------------------------------------
+
+
+def test_slow_rank_on_dcn_axis_trips_leave_one_out_straggler(tmp_path):
+    """A FaultPlan.slow_rank pinned to a rank on the DCN (cross-slice) axis:
+    the straggler gates every cross-slice collective, and the leave-one-out
+    heartbeat median must call out exactly that rank — the fleet-level
+    signal that one SLICE is dragging the DCN tier."""
+    plan = FaultPlan(slow_rank=5, slow_step_s=0.4)
+    # two slices x 4 ranks; rank 5 lives on slice 1 (rank // 4 == 1)
+    tr = ObjectStoreHeartbeatTransport(str(tmp_path))
+    now = [100.0]
+    base = 0.1
+    for rank in range(8):
+        st = base + plan.slow_now(step=3, rank=rank)
+        HeartbeatWriter(tr, rank=rank, clock=lambda: now[0]).beat(3, st)
+    assert ("slow" in {k for _, k in plan.fired})  # the drill actually fired
+    table = HealthTable(tr, straggler_factor=3.0, clock=lambda: now[0])
+    rows = {r.rank: r for r in table.read()}
+    assert table.verdicts()["stragglers"] == [5]
+    # leave-one-out reference: rank 5 vs the 7 healthy peers' median
+    assert rows[5].ratio == pytest.approx(0.5 / 0.1, rel=1e-6)
+    assert all(not rows[r].straggler for r in range(8) if r != 5)
+
+
+def test_slice_loss_drill_shrinks_onto_surviving_slices(tmp_path):
+    """Slice-loss drill: all ranks of one slice stop beaconing (preempted
+    slice / cut DCN link). The health table must declare exactly that
+    slice's ranks dead, and a relaunch must re-query the elastic schedule
+    onto the SURVIVING slice's world — decide_world picks the largest
+    valid world <= survivors, with a consistent batch triangle."""
+    from deepspeed_tpu.elasticity import decide_world
+
+    tr = ObjectStoreHeartbeatTransport(str(tmp_path))
+    now = [1000.0]
+    slice_a, slice_b = range(0, 4), range(4, 8)
+    for rank in slice_a:  # healthy slice keeps beaconing
+        HeartbeatWriter(tr, rank=rank, clock=lambda: now[0]).beat(20, 0.1)
+    for rank in slice_b:  # lost slice: beacons frozen in the past
+        HeartbeatWriter(tr, rank=rank,
+                        clock=lambda: now[0] - 500.0).beat(12, 0.1)
+    table = HealthTable(tr, dead_after_s=60.0, clock=lambda: now[0])
+    verdicts = table.verdicts()
+    assert verdicts["dead"] == list(slice_b)
+    survivors = [r.rank for r in table.read() if r.alive]
+    assert survivors == list(slice_a)
+
+    ds_config = {"elasticity": {"enabled": True, "max_train_batch_size": 16,
+                                "micro_batch_sizes": [2],
+                                "min_gpus": 1, "max_gpus": 8}}
+    decision = decide_world(ds_config, available=len(survivors))
+    assert decision.world_size == 4  # shrink onto the surviving slice
+    assert decision.final_batch % decision.world_size == 0
+    assert decision.gradient_accumulation >= 1
+    # before the loss, the same schedule ran the full 2-slice world
+    assert decide_world(ds_config, available=8).world_size == 8
 
 
 # ---------------------------------------------------------------------------
