@@ -1270,13 +1270,203 @@ def dcn_hierarchical_bench():
             "device": jax.devices()[0].platform}
 
 
+def telemetry_bench():
+    """Rung ob (telemetry spine, deepspeed_tpu/telemetry/): the spine's own
+    cost, since it rides every step when enabled — span record overhead
+    (ns/span, enabled AND the disabled no-op path), flight-recorder dump
+    latency on a full ring (bounds what a watchdog expiry adds before the
+    hangdump), and registry scrape time for a realistic series count (the
+    /metrics handler's per-request cost)."""
+    import shutil as _shutil
+    import tempfile
+
+    from deepspeed_tpu.telemetry import (FlightRecorder, MetricsRegistry,
+                                         SpanTracer)
+
+    tr = SpanTracer(enabled=True, max_spans=8192)
+    for _ in range(2000):  # warm the allocator/deque path
+        with tr.span("x"):
+            pass
+    n = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with tr.span("x"):
+            pass
+    span_ns = (time.perf_counter() - t0) / n * 1e9
+
+    off = SpanTracer(enabled=False)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with off.span("x"):
+            pass
+    off_ns = (time.perf_counter() - t0) / n * 1e9
+
+    # flight dump on a FULL ring: 32 steps x 8 phase spans + metrics
+    phases = ("data/draw", "data/shape", "compute/dispatch", "compute/drain",
+              "metrics/report", "resilience/post_step", "serve/admit",
+              "serve/decode")
+    d = tempfile.mkdtemp(prefix="dstpu_ob_")
+    try:
+        ftr = SpanTracer(enabled=True)  # fresh: the ring must hold 32 real
+        fl = FlightRecorder(ftr, d, steps=32)  # steps, not the bench's 50k spans
+        for step in range(32):
+            for ph in phases:
+                with ftr.span(ph):
+                    pass
+            fl.record_step(step, step_time_s=0.01,
+                           metrics={"loss": 1.0, "grad_norm": 0.5})
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            path = fl.dump("bench")
+            best = min(best, time.perf_counter() - t0)
+        dump_ms = best * 1e3
+        dump_kb = os.path.getsize(path) / 1024
+    finally:
+        _shutil.rmtree(d, ignore_errors=True)
+
+    # registry scrape: phase histograms + labeled counters + a collector,
+    # roughly what a training+serving process exposes
+    reg = MetricsRegistry()
+    hist = reg.histogram("dstpu_step_phase_seconds", "phases")
+    for ph in phases:
+        for i in range(100):
+            hist.observe(1e-4 * (i + 1), phase=ph)
+    ops = reg.counter("dstpu_comm_wire_bytes_total", "wire")
+    for op in ("all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+               "ring_embed_gather", "program_reduce_scatter"):
+        ops.inc(1 << 20, op=op)
+    reg.register_collector("x", lambda: [
+        ("dstpu_serving_ttft_p50_seconds", "gauge", "",
+         [("", {"replica": "0"}, 0.01)])])
+    best = float("inf")
+    for _ in range(20):
+        t0 = time.perf_counter()
+        text = reg.exposition()
+        best = min(best, time.perf_counter() - t0)
+    scrape_ms = best * 1e3
+    series = sum(1 for line in text.splitlines()
+                 if line and not line.startswith("#"))
+
+    return {"metric": "telemetry_span_overhead_ns",
+            "value": round(span_ns, 1), "unit": "ns/span",
+            "vs_baseline": None,
+            "span_disabled_ns": round(off_ns, 2),
+            "flight_dump_ms": round(dump_ms, 3),
+            "flight_dump_kb": round(dump_kb, 1),
+            "registry_scrape_ms": round(scrape_ms, 3),
+            "registry_series": series,
+            "device": jax.devices()[0].platform}
+
+
 RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "3b": rung3b_big_model,
          "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses,
          "cm": collective_matmul_bench, "qx": quantized_collectives_bench,
          "plan": planner_bench, "rz": resilience_bench,
          "wd": watchdog_bench, "fl": fused_hotpath_bench,
-         "sv": serving_bench, "ds": dcn_hierarchical_bench}
+         "sv": serving_bench, "ds": dcn_hierarchical_bench,
+         "ob": telemetry_bench}
+
+
+# ---------------------------------------------------------------------------
+# ladder self-gating: every rung row is compared against the recorded
+# LADDER.json baseline — vs_baseline stops being None, and `--gate` turns
+# the comparison into an exit code so BENCH-trajectory reading becomes CI.
+# ---------------------------------------------------------------------------
+
+LADDER_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "LADDER.json")
+
+# metric -> (direction, relative tolerance). Direction names which way
+# regression lies; tolerance absorbs shared-box timing noise (generous for
+# wall-clock metrics — a real regression is 2x, noise is tens of percent)
+# and is tight for deterministic byte accounting.
+GATE_DEFAULT = ("higher", 0.5)
+GATE_SPECS = {
+    "watchdog_arm_disarm_us": ("lower", 1.0),
+    "telemetry_span_overhead_ns": ("lower", 1.0),
+    "dcn_hierarchical": ("higher", 0.05),        # ledger bytes: deterministic
+    "llama_zero3_bf16_mfu": ("higher", 0.15),    # the TPU headline: tight
+}
+
+
+def load_ladder_baseline(path: str = None):
+    """``metric -> recorded rung row`` from LADDER.json; empty when the
+    baseline file is absent or unreadable (first run records, never gates)."""
+    try:
+        with open(path or LADDER_PATH) as f:
+            rows = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return {r["metric"]: r for r in rows
+            if isinstance(r, dict) and r.get("metric")}
+
+
+def fill_vs_baseline(rec: dict, baseline: dict) -> dict:
+    """Populate ``vs_baseline`` from the LADDER.json row for this metric
+    (current/recorded). Rungs that already computed a target-relative value
+    (the MFU rows' value/TARGET_MFU) keep it — the gate reads the raw
+    values either way."""
+    row = baseline.get(rec.get("metric"))
+    if (rec.get("vs_baseline") is None and row is not None
+            and isinstance(rec.get("value"), (int, float))
+            and isinstance(row.get("value"), (int, float)) and row["value"]):
+        rec["vs_baseline"] = round(rec["value"] / row["value"], 4)
+    return rec
+
+
+def gate_results(results, baseline, specs: dict = None):
+    """Compare rung rows against the recorded baseline; returns the list of
+    regression dicts (empty = ladder passes). A rung with no baseline row is
+    new and never gates; a rung that ERRORED where the baseline has a value
+    is itself a regression (a broken bench must fail CI, not skip it)."""
+    specs = GATE_SPECS if specs is None else specs
+    # a crashed rung subprocess yields {"metric": "rung<id>", "value": None}
+    # — no metric-name match, but the baseline rows carry their rung id, so
+    # the crash still gates against the row it failed to reproduce
+    by_rung = {row.get("rung"): row for row in baseline.values()
+               if row.get("rung") is not None}
+    failures = []
+    for rec in results:
+        metric = rec.get("metric")
+        row = baseline.get(metric)
+        if (row is None and rec.get("value") is None
+                and rec.get("rung") is not None):
+            # ERROR rows only: a successful rung whose metric name merely
+            # differs from the baseline's (rung 3's TPU-vs-CPU variants) is
+            # a different measurement, not a crash to gate by rung id
+            row = by_rung.get(rec.get("rung"))
+            if row is not None:
+                metric = row.get("metric")
+        if row is None or not isinstance(row.get("value"), (int, float)):
+            continue
+        direction, tol = specs.get(metric, GATE_DEFAULT)
+        bval, val = row["value"], rec.get("value")
+        if not isinstance(val, (int, float)):
+            failures.append({"metric": metric, "baseline": bval,
+                             "value": None,
+                             "why": rec.get("error", "no value")})
+            continue
+        bad = (val < bval * (1.0 - tol) if direction == "higher"
+               else val > bval * (1.0 + tol))
+        if bad:
+            failures.append({
+                "metric": metric, "baseline": bval, "value": val,
+                "direction": direction, "tolerance": tol,
+                "why": (f"{val:g} vs baseline {bval:g} "
+                        f"({'below' if direction == 'higher' else 'above'} "
+                        f"the {tol:.0%} gate)")})
+    return failures
+
+
+def gate_report(failures, n_checked: int) -> str:
+    if not failures:
+        return f"GATE PASS: {n_checked} rung(s) within tolerance of LADDER.json"
+    lines = [f"GATE FAIL: {len(failures)} regression(s) vs LADDER.json"]
+    for f in failures:
+        lines.append(f"  {f['metric']}: {f['why']}")
+    return "\n".join(lines)
 
 
 def _with_ledger(fn):
@@ -1299,14 +1489,17 @@ def _with_ledger(fn):
     return rec
 
 
-def run_ladder():
+def run_ladder(gate: bool = False):
     """Spawn one subprocess per rung (each needs its own XLA device config);
-    print each rung's JSON line and write LADDER.json."""
+    print each rung's JSON line and write LADDER.json. With ``gate`` the
+    recorded LADDER.json is the BASELINE: rows are compared instead of
+    rewritten and the return code is nonzero on any regression."""
     import subprocess
     import sys
 
     from deepspeed_tpu.utils.health import accelerator_healthy
 
+    baseline = load_ladder_baseline()
     healthy = accelerator_healthy()
     cpu8 = {"JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
@@ -1324,7 +1517,7 @@ def run_ladder():
             ("rz", chip), ("wd", cpu1), ("fl", chip), ("sv", chip),
             # ds simulates the DCN split (dcn_axes override) — the virtual
             # CPU mesh IS the measurement substrate, even beside a real chip
-            ("ds", cpu8)]
+            ("ds", cpu8), ("ob", cpu1)]
     results = []
     for rung, env_over in plan:
         env = dict(os.environ)
@@ -1346,11 +1539,16 @@ def run_ladder():
         # numeric ladder rungs keep their integer id; named rungs (cm/qx/
         # plan) keep the name — int("cm") used to throw and kill the ladder
         rec["rung"] = int(rung) if rung.isdigit() else rung
+        fill_vs_baseline(rec, baseline)
         print(json.dumps(rec))
         results.append(rec)
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "LADDER.json"), "w") as f:
+    if gate:
+        failures = gate_results(results, baseline)
+        print(gate_report(failures, len(results)))
+        return 1 if failures else 0
+    with open(LADDER_PATH, "w") as f:
         json.dump(results, f, indent=2)
+    return 0
 
 
 if __name__ == "__main__":
@@ -1361,9 +1559,28 @@ if __name__ == "__main__":
                     help="run all BASELINE.md ladder rungs")
     ap.add_argument("--rung", choices=sorted(RUNGS),
                     help="run one ladder rung in-process")
+    ap.add_argument("--gate", action="store_true",
+                    help="compare against the recorded LADDER.json baseline "
+                         "and exit nonzero on regression (with --ladder runs "
+                         "the rungs; with --results gates a recorded file)")
+    ap.add_argument("--results", default=None,
+                    help="with --gate: gate this previously-recorded results "
+                         "JSON instead of re-running the rungs")
+    ap.add_argument("--baseline", default=None,
+                    help="with --gate: baseline file (default LADDER.json)")
     args = ap.parse_args()
-    if args.ladder:
-        run_ladder()
+    if args.gate and args.results:
+        # CI fast path: gate recorded rows without touching any backend
+        with open(args.results) as f:
+            results = json.load(f)
+        baseline = load_ladder_baseline(args.baseline)
+        for rec in results:
+            fill_vs_baseline(rec, baseline)
+        failures = gate_results(results, baseline)
+        print(gate_report(failures, len(results)))
+        raise SystemExit(1 if failures else 0)
+    if args.ladder or args.gate:
+        raise SystemExit(run_ladder(gate=args.gate))
     elif args.rung:
         from deepspeed_tpu.utils.health import accelerator_healthy
 
@@ -1387,6 +1604,8 @@ if __name__ == "__main__":
         elif not accelerator_healthy():
             os.environ["JAX_PLATFORMS"] = "cpu"
             jax.config.update("jax_platforms", "cpu")
-        print(json.dumps(_with_ledger(RUNGS[args.rung])))
+        rec = _with_ledger(RUNGS[args.rung])
+        fill_vs_baseline(rec, load_ladder_baseline())
+        print(json.dumps(rec))
     else:
         main()

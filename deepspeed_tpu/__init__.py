@@ -9,6 +9,7 @@ profiler / monitor toolchain.
 """
 
 from . import comm
+from . import telemetry
 from .accelerator import get_accelerator
 from .runtime import activation_checkpointing as checkpointing
 from .runtime import zero

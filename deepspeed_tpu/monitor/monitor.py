@@ -9,6 +9,7 @@ Events are ``(name, value, step)`` tuples — the reference's
 """
 
 import os
+import threading
 from typing import Any, List, Optional, Sequence, Tuple
 
 Event = Tuple[str, Any, int]
@@ -40,6 +41,10 @@ class JSONLMonitor(Monitor):
     def __init__(self, config, filename: str = "events.jsonl"):
         super().__init__(config)
         self.path = None
+        # serving's engine thread and the training loop both write_events
+        # into one file; the lock plus one write() per batch keeps lines
+        # whole (interleaved per-event writes could split a JSON line)
+        self._lock = threading.Lock()
         if not (self.enabled and _is_rank_0()):
             self.enabled = False
             return
@@ -57,12 +62,14 @@ class JSONLMonitor(Monitor):
             return
         import json
 
-        with open(self.path, "a") as f:
-            for name, value, step in event_list:
-                if value is None:
-                    continue
-                f.write(json.dumps({"name": name, "value": float(value),
-                                    "step": int(step)}) + "\n")
+        lines = [json.dumps({"name": name, "value": float(value),
+                             "step": int(step)})
+                 for name, value, step in event_list if value is not None]
+        if not lines:
+            return
+        buf = "\n".join(lines) + "\n"
+        with self._lock, open(self.path, "a") as f:
+            f.write(buf)
 
 
 class TensorBoardMonitor(Monitor):

@@ -17,11 +17,27 @@ STEP_MICRO_TIMER = "step_microstep"
 STEP_GLOBAL_TIMER = "step"
 
 
+# one device sentinel, created on first use and reused: the previous
+# implementation issued a fresh jax.device_put H2D transfer on EVERY
+# stop(sync=True) — a per-step allocation + transfer on remote-attached
+# TPUs just to drain the dispatch queue. The chained +0 is what forces the
+# queue to retire; the operand can be the same buffer every time.
+_SYNC_SENTINEL = None
+
+
 def _sync():
+    global _SYNC_SENTINEL
     try:
         import jax
 
-        (jax.device_put(0) + 0).block_until_ready()
+        for _ in range(2):  # one retry with a fresh sentinel (backend reset)
+            if _SYNC_SENTINEL is None:
+                _SYNC_SENTINEL = jax.device_put(0)
+            try:
+                (_SYNC_SENTINEL + 0).block_until_ready()
+                return
+            except Exception:
+                _SYNC_SENTINEL = None
     except Exception:
         pass
 

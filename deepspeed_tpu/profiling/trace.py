@@ -65,3 +65,21 @@ def profile_steps(engine: Any, batches: Iterable, *, log_dir: str,
 def annotate(name: str):
     """Named region in the trace (``jax.profiler.TraceAnnotation``)."""
     return jax.profiler.TraceAnnotation(name)
+
+
+def export_spans(log_dir: str, filename: str = None) -> Optional[str]:
+    """Export the telemetry span tracer's host-phase timeline
+    (``telemetry/spans.py``) as Chrome-trace JSON into ``log_dir`` — the
+    same directory a :func:`capture` writes its device xplane to, so the
+    host step phases and the device op timeline open side by side in
+    Perfetto. Returns the path, or None when the tracer holds nothing."""
+    from ..telemetry.spans import export_chrome, get_tracer
+
+    tr = get_tracer()
+    spans = tr.snapshot()
+    open_spans = tr.open_spans()
+    if not spans and not open_spans:
+        return None
+    os.makedirs(log_dir, exist_ok=True)
+    name = filename or f"spans-{os.getpid()}.trace.json"
+    return export_chrome(os.path.join(log_dir, name), spans, open_spans)

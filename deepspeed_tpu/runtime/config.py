@@ -566,6 +566,30 @@ class ResilienceConfig(ConfigModel):
 
 @register_config
 @dataclass
+class TelemetryConfig(ConfigModel):
+    """Unified telemetry spine (``deepspeed_tpu/telemetry/``, see
+    ``docs/observability.md``): step-phase span tracing, the crash flight
+    recorder, and the pull-based metrics registry with Prometheus
+    exposition. Disabled by default — nothing is constructed and stepping
+    is bit-identical to a tree without the subsystem. Also accepted as a
+    bare bool (``"telemetry": true``) or a string flight-dump directory
+    (``"telemetry": "<dir>"``)."""
+    enabled: bool = False
+    spans: bool = True                # span tracer (engine/serving phases)
+    max_spans: int = 8192             # bounded closed-span buffer
+    # every N steps the engine drains the device INSIDE a compute/drain
+    # span, attributing device work to the timeline without a per-span
+    # sync; 0 = never (spans measure host/dispatch time only)
+    drain_interval_steps: int = 0
+    trace_dir: Optional[str] = None   # Chrome-trace export dir (on close())
+    flight_steps: int = 32            # flight-recorder ring size (0 = off)
+    flight_dir: Optional[str] = None  # default: resilience.snapshot_dir or .
+    prometheus_port: Optional[int] = None  # serve /metrics + /healthz
+    monitor_bridge: bool = False      # registry -> Monitor events each print
+
+
+@register_config
+@dataclass
 class ServingConfig(ConfigModel):
     """Serving tier (``deepspeed_tpu/serving/``): continuous-batching
     ``LLMServer`` over the ``inference/v2`` ragged engine.
@@ -755,6 +779,7 @@ class DeepSpeedTPUConfig(ConfigModel):
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)
     eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
     quantize_training: Optional[QuantizeTrainingConfig] = None
@@ -785,6 +810,13 @@ class DeepSpeedTPUConfig(ConfigModel):
         sv = d.get("serving")
         if isinstance(sv, str):
             d["serving"] = {"enabled": True, "policy": sv}
+        # bool/string shorthand: "telemetry": true enables the spine with
+        # defaults; "telemetry": "<dir>" additionally aims flight dumps there
+        tl = d.get("telemetry")
+        if isinstance(tl, bool):
+            d["telemetry"] = {"enabled": tl}
+        elif isinstance(tl, str):
+            d["telemetry"] = {"enabled": True, "flight_dir": tl}
         cl = d.pop("curriculum_learning", None)
         if cl:
             de = dict(d.get("data_efficiency") or {})

@@ -33,6 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import comm as dist
 from ..ops.optimizers import build_optimizer
+from ..telemetry.spans import span
 from ..parallel.topology import Topology, TopologySpec, get_topology, set_topology
 from ..utils.logging import log_dist, logger
 from .config import DeepSpeedTPUConfig, load_config
@@ -375,6 +376,18 @@ class DeepSpeedTPUEngine:
                 "drop needs model cooperation (as in the reference): build "
                 "the schedule with ProgressiveLayerDrop.from_config and gate "
                 "layers with progressive_layer_drop.pld_apply in the loss fn")
+        # telemetry spine (deepspeed_tpu/telemetry/): span tracer + flight
+        # recorder + metrics registry. Constructed BEFORE resilience so the
+        # restore-on-restart path is already on the timeline; attached after
+        # so flight dumps ride the watchdog/rollback/drain paths. Off by
+        # default: nothing constructed, stepping bit-identical.
+        self.telemetry = None
+        if config.telemetry.enabled:
+            from ..telemetry import TelemetryManager
+
+            self.telemetry = TelemetryManager(
+                config.telemetry, rank=jax.process_index(),
+                default_dir=config.resilience.snapshot_dir)
         # resilience (runtime/resilience/): snapshots + sentinel + preemption.
         # Constructed only when enabled, restore-on-restart runs before the
         # first step so a relaunch continues where the last snapshot left off.
@@ -385,6 +398,8 @@ class DeepSpeedTPUEngine:
             self.resilience = ResilienceManager(self, config.resilience)
             if config.resilience.restore_on_start:
                 self.resilience.maybe_restore()
+        if self.telemetry is not None:
+            self.telemetry.attach_engine(self)
         log_dist(f"engine initialized: {self.topo}, zero_stage={zc.stage}, "
                  f"gas={self.gas}, micro_bs={self.micro_batch_size}, "
                  f"dtype={jnp.dtype(self.compute_dtype).name}")
@@ -962,8 +977,13 @@ class DeepSpeedTPUEngine:
                 "step would drop them. Finish the window with backward()+"
                 "step() (the no_sync migration), or discard via "
                 "zero_grad() before switching to train_batch()")
+        if self.telemetry is not None:
+            # stamp BEFORE the draw so every span of this call — including
+            # data/draw — carries the step about to execute
+            self.telemetry.tracer.set_step(self.global_steps)
         if batch is None:
-            batch = _draw_from_iter(data_iter, self.gas)
+            with span("data/draw"):
+                batch = _draw_from_iter(data_iter, self.gas)
         if self.resilience is not None:
             # arm the step watchdog AFTER the batch draw (the routine
             # epoch-end StopIteration must not leave a deadline armed over
@@ -982,10 +1002,32 @@ class DeepSpeedTPUEngine:
         return self._train_batch_armed(batch)
 
     def _train_batch_armed(self, batch):
+        """Telemetry shell around the step body: opens the per-step ``step``
+        span and folds the window into the flight ring / phase histograms at
+        the end. With telemetry off this is a single attribute check."""
+        tm = self.telemetry
+        if tm is None:
+            return self._train_batch_inner(batch)
+        # the step EXECUTING is the pre-increment number: the same N the
+        # watchdog armed with, the spans are stamped with, and a hangdump
+        # reports — the flight ring must agree with all three
+        step = self.global_steps
+        with span("step"):
+            out = self._train_batch_inner(batch)
+        # _metrics_host is whatever already synced (lazy) — this hook must
+        # never force a device round trip of its own
+        tm.on_step_end(
+            step,
+            step_time_s=self._step_times[-1] if self._step_times else None,
+            metrics=self._metrics_host)
+        return out
+
+    def _train_batch_inner(self, batch):
         """The body of ``train_batch`` from batch shaping through the
         resilience post-step hook; runs with the step watchdog armed when
         resilience is enabled (``train_batch`` handles arm/abort)."""
-        batch = self._shape_batch(batch)
+        with span("data/shape"):
+            batch = self._shape_batch(batch)
         if self.curriculum_scheduler is not None:
             # seqlen curriculum: truncate [gas, micro, seq] leaves to the
             # current difficulty. Each distinct difficulty is one recompile;
@@ -1011,13 +1053,22 @@ class DeepSpeedTPUEngine:
                 and self._aot_step[1] == self._batch_fingerprint(batch)):
             step_fn = self._aot_step[0]  # AOT executable from compile()
         t0 = time.perf_counter()
-        if self._host_adam is not None:
-            metrics = self._host_offload_step(step_fn, batch, step_rng)
-        else:
-            self.state, metrics = step_fn(self.state, batch, step_rng)
+        with span("compute/dispatch"):
+            if self._host_adam is not None:
+                metrics = self._host_offload_step(step_fn, batch, step_rng)
+            else:
+                self.state, metrics = step_fn(self.state, batch, step_rng)
         if self.global_steps == 0 and self.config.memory_breakdown:
             self._log_memory_breakdown(step_fn, batch, step_rng)
         self.global_steps += 1
+        if self.telemetry is not None and \
+                self.telemetry.drain_due(self.global_steps):
+            # once-per-window device drain: the span timeline gets one
+            # interval that covers the step's actual device work (fwd/bwd,
+            # grad reduce, optimizer all live inside the compiled program)
+            # without paying a per-step pipeline stall
+            with span("compute/drain"):
+                jax.block_until_ready(metrics)
         # Metrics stay on device; ``_last_metrics`` converts lazily. A per-step
         # device->host sync here would serialize the async dispatch pipeline
         # (one full RTT per step on remote-attached TPUs). Overflow-skip
@@ -1027,12 +1078,14 @@ class DeepSpeedTPUEngine:
         if self.fp16:
             self._skipped_dev = self._skipped_dev + metrics["overflow"].astype(jnp.int32)
         self._step_times.append(time.perf_counter() - t0)
-        self._maybe_report()
+        with span("metrics/report"):
+            self._maybe_report()
         if self.resilience is not None:
             # fault injection -> preemption drain -> sentinel -> cadence
             # snapshot (runtime/resilience/supervisor.py). Not a hot-path
             # cost when disabled: the attribute is None and nothing runs.
-            self.resilience.post_step()
+            with span("resilience/post_step"):
+                self.resilience.post_step()
         at = self.config.autotuning
         if self.global_steps == at.end_profile_step:
             from ..autotuning.autotuner import AUTOTUNE_RESULT_ENV, report_autotune_result
@@ -1385,6 +1438,12 @@ class DeepSpeedTPUEngine:
             ledger = get_comms_logger()
             if ledger.enabled:
                 events += ledger.monitor_events(self.global_steps)
+            # registry -> monitor bridge: the telemetry spine's counters and
+            # phase histograms reach the existing JSONL/TB/W&B sinks too
+            if (self.telemetry is not None
+                    and self.telemetry.cfg.monitor_bridge):
+                events += self.telemetry.registry.monitor_events(
+                    self.global_steps)
             self.monitor.write_events(events)
         fp_cfg = self.config.flops_profiler
         if fp_cfg.enabled and self.global_steps == fp_cfg.profile_step:

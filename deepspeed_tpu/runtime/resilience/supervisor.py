@@ -29,6 +29,7 @@ from typing import Any, Optional, Tuple
 import jax
 import numpy as np
 
+from ...telemetry.spans import span
 from ...utils.logging import log_dist, logger
 from ..config_utils import ConfigError
 from .faults import FaultPlan
@@ -154,6 +155,10 @@ class ResilienceManager:
                                       dead_after_s=hc.dead_after_s,
                                       straggler_factor=hc.straggler_factor)
         self.degraded = False
+        # set by TelemetryManager.attach_resilience: flight dumps ride the
+        # watchdog expiry / rollback / drain paths, resilience events land
+        # in the metrics registry. None = telemetry off, zero overhead.
+        self._telemetry = None
         self._rollback_times: "deque[float]" = deque(maxlen=64)
         self._recent_step_times: "deque[float]" = deque(maxlen=16)
         self._step_t0: Optional[float] = None
@@ -288,8 +293,9 @@ class ResilienceManager:
                 grad_norm = self.faults.observe_grad_norm(pstep, grad_norm)
             action = self.sentinel.observe(pstep, loss, grad_norm)
             if action == "rollback":
-                self._rollback()
-                self._maybe_degrade()
+                with span("resilience/rollback"):
+                    self._rollback()
+                    self._maybe_degrade()
                 return
             # "warn" already logged inside the sentinel; "halt" raised
         streak_live = (self.sentinel is not None
@@ -312,6 +318,11 @@ class ResilienceManager:
         engine = self.engine
         reason = self.watcher.reason if self.watcher else "drain()"
         log_dist(f"resilience: draining for preemption ({reason})")
+        if self._telemetry is not None:
+            # the flight record of a run about to vanish: dump BEFORE the
+            # sync work below, while the timeline still shows why we drain
+            self._telemetry.flight_dump("preempt_drain", {"why": reason})
+            self._telemetry.count("preempt_drain")
         jax.block_until_ready(engine.state)
         pending = getattr(engine, "_ckpt_commit_thread", None)
         if pending is not None and pending.is_alive():
@@ -432,6 +443,8 @@ class ResilienceManager:
         clear_feedback()
         engine._degraded_collectives = True
         self.degraded = True
+        if self._telemetry is not None:
+            self._telemetry.count("degraded")
         self._invalidate_compiled_steps()
         self._emit([("Resilience/degraded_mode", 1.0, engine.global_steps)])
         logger.warning(
@@ -468,8 +481,14 @@ class ResilienceManager:
 
     # ------------------------------------------------------------------
     def take_snapshot(self, final: bool = False) -> str:
+        with span("resilience/snapshot"):
+            return self._take_snapshot(final)
+
+    def _take_snapshot(self, final: bool = False) -> str:
         engine = self.engine
         t0 = time.perf_counter()
+        if self._telemetry is not None:
+            self._telemetry.count("snapshot")
         data_state = None
         if self._dataloader is not None:
             try:
@@ -519,6 +538,11 @@ class ResilienceManager:
     def _rollback(self) -> None:
         engine = self.engine
         tripped_at = engine.global_steps
+        if self._telemetry is not None:
+            # the steps that LED INTO the divergence are exactly what the
+            # ring still holds — dump before the restore rewinds everything
+            self._telemetry.flight_dump("rollback", {"tripped_at": tripped_at})
+            self._telemetry.count("rollback")
         if self.watchdog is not None:
             # restore + retrace legitimately exceed a per-step deadline
             self.watchdog.disarm(record=False)

@@ -78,7 +78,11 @@ class StepWatchdog:
 
     ``on_expire(step)`` replaces the default kill action when set (tests,
     custom supervisors); the default writes the hangdump and exits the
-    process with ``exit_code``.
+    process with ``exit_code``. ``pre_dump`` (settable after construction)
+    runs FIRST on expiry regardless of ``on_expire`` — the telemetry tier's
+    flight recorder hooks it so the exit-83 post-mortem includes the last N
+    steps' span timeline (which phase hung), not just thread stacks; it is
+    exception-guarded so a failing dump can never mask the kill.
     """
 
     def __init__(self, dump_dir: str, *, factor: float = 8.0,
@@ -94,6 +98,7 @@ class StepWatchdog:
         self.cap_s = float(cap_s)
         self.rank = int(rank)
         self.on_expire = on_expire
+        self.pre_dump: Optional[Callable[[], None]] = None
         self.exit_code = int(exit_code)
         self.fired = False
         self.fired_step: Optional[int] = None
@@ -176,6 +181,11 @@ class StepWatchdog:
                 return  # unreachable after os._exit; keeps tests honest
 
     def _fire(self, step: Optional[int], deadline_s: Optional[float]) -> None:
+        if self.pre_dump is not None:
+            try:
+                self.pre_dump()  # flight record first: richest evidence
+            except Exception as e:
+                logger.error(f"watchdog: pre_dump failed ({e}); proceeding")
         try:
             path = write_hangdump(self.dump_dir, self.rank, step, deadline_s)
             logger.error(

@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..telemetry.spans import get_tracer, span
 from ..utils.logging import logger
 from .metrics import ServingMetrics
 from .request import (FINISH_CANCELLED, FINISH_EOS, FINISH_FAILED,
@@ -91,6 +92,33 @@ class LLMServer:
         self.heartbeat_interval_s = float(heartbeat_interval_s)
         self.suppress_heartbeat = False     # FaultPlan-style drill hook
         self.error: Optional[BaseException] = None
+        # telemetry spine: when a TelemetryManager is live in this process,
+        # this replica's ServingMetrics become dstpu_serving_* scrape
+        # samples (keyed by replica — a rebuilt server replaces its entry;
+        # stop paths unregister so a dead replica stops exporting)
+        self._telemetry_registered = False
+        try:
+            from ..telemetry import register_serving_metrics, telemetry_active
+            if telemetry_active():
+                register_serving_metrics(self.metrics, self.replica_id)
+                self._telemetry_registered = True
+        except Exception:  # telemetry must never block serving bring-up
+            pass
+
+    def _unregister_telemetry(self) -> None:
+        """Drop this replica's scrape collector (idempotent): a halted or
+        drained server must not keep exporting frozen dstpu_serving_*
+        series that look like a live replica."""
+        if not self._telemetry_registered:
+            return
+        self._telemetry_registered = False
+        try:
+            from ..telemetry import get_registry
+
+            get_registry().unregister_collector(
+                f"serving-{int(self.replica_id)}")
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
     @classmethod
@@ -253,6 +281,7 @@ class LLMServer:
             self._draining = True
         if self._thread is not None and self._thread.is_alive():
             self._thread.join(5.0)
+        self._unregister_telemetry()   # covers a never-started server too
 
     # -- fleet hooks --------------------------------------------------------
     def halt(self) -> None:
@@ -265,6 +294,7 @@ class LLMServer:
         self._beat_stop.set()
         if self._thread is not None and self._thread.is_alive():
             self._thread.join(5.0)
+        self._unregister_telemetry()
 
     def steal_unfinished(self) -> List[ServedResponse]:
         """Take every unfinished request off this (halted or draining-idle)
@@ -288,16 +318,31 @@ class LLMServer:
         try:
             while self._running:
                 now = self.clock()
-                self._drain_ingress()
-                self._process_cancellations(now)
-                self.scheduler.admit(now)
+                with span("serve/ingress"):
+                    self._drain_ingress()
+                    self._process_cancellations(now)
+                with span("serve/admit"):
+                    self.scheduler.admit(now)
                 progressed = False
                 if self.engine.has_work():
+                    # phase-named step span: a hang dump should say whether
+                    # the engine wedged packing prefill chunks or in steady
+                    # decode. The prefill scan only runs while tracing.
+                    if get_tracer().enabled:
+                        seqs = list(self.engine.state_manager.all())
+                        n_pre = sum(1 for s in seqs if s.in_prefill)
+                        name = ("serve/decode" if n_pre == 0
+                                else "serve/prefill" if n_pre == len(seqs)
+                                else "serve/mixed")
+                    else:
+                        name = "serve/step"
                     t0 = self.clock()
-                    out = self.engine.step()
+                    with span(name):
+                        out = self.engine.step()
                     self._last_step_time = self.clock() - t0
                     self._steps += 1
-                    self._deliver(out)
+                    with span("serve/deliver"):
+                        self._deliver(out)
                     progressed = (self.engine.last_num_scheduled > 0
                                   or bool(out))
                 self._sample_gauges()
@@ -331,6 +376,7 @@ class LLMServer:
         finally:
             self._running = False
             self._beat_stop.set()   # stopped serving = stop advertising
+            self._unregister_telemetry()
 
     def _drain_ingress(self) -> None:
         while True:

@@ -1,0 +1,34 @@
+"""Unified telemetry spine (see ``docs/observability.md``).
+
+Four pieces behind one default-off ``telemetry:`` config block:
+
+- :mod:`spans` — low-overhead step-phase span tracer with thread-local
+  nesting and Chrome-trace/Perfetto export;
+- :mod:`flight` — crash flight recorder: the last N steps' spans + metrics
+  ring-buffered and dumped to ``flightdump-<rank>.json`` from the watchdog
+  expiry path, sentinel rollback, and the preemption drain;
+- :mod:`registry` — pull-based counters/gauges/histograms with Prometheus
+  text exposition (``/metrics`` + ``/healthz``) and a monitor-event bridge
+  so the existing JSONL/TensorBoard sinks keep working;
+- :mod:`manager` — the engine/resilience/serving wiring.
+
+``spans``/``flight``/``registry`` are stdlib-only: the watchdog dumps from
+its monitor thread while jax is wedged, and drill scripts import them
+standalone.
+"""
+
+from .flight import FlightRecorder, flightdump_path
+from .manager import TelemetryManager, register_serving_metrics, telemetry_active
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       MetricsServer, get_registry, reset_registry)
+from .spans import (SpanTracer, chrome_trace, configure_tracer, export_chrome,
+                    get_tracer, span)
+
+__all__ = [
+    "span", "SpanTracer", "get_tracer", "configure_tracer",
+    "chrome_trace", "export_chrome",
+    "FlightRecorder", "flightdump_path",
+    "MetricsRegistry", "MetricsServer", "Counter", "Gauge", "Histogram",
+    "get_registry", "reset_registry",
+    "TelemetryManager", "telemetry_active", "register_serving_metrics",
+]

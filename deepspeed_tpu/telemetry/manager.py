@@ -1,0 +1,309 @@
+"""TelemetryManager: one object wiring the spine into a running engine.
+
+Owns the configured :class:`~.spans.SpanTracer`, the
+:class:`~.flight.FlightRecorder`, the fleet
+:class:`~.registry.MetricsRegistry` (+ optional
+:class:`~.registry.MetricsServer`), and the bridges between them and the
+pre-existing observability islands:
+
+- comms ledger totals -> ``dstpu_comm_*`` pull-time samples;
+- ServingMetrics -> ``dstpu_serving_*`` samples (registered by every
+  ``LLMServer`` built while telemetry is active);
+- resilience events -> ``dstpu_resilience_events_total{event=...}``;
+- drained step spans -> ``dstpu_step_phase_seconds{phase=...}`` histograms
+  and the flight ring.
+
+Constructed ONLY when ``config.telemetry.enabled`` — the default-off tree
+never imports this module, and nothing here touches the traced program
+(spans and counters read, they never compute), so stepping stays
+bit-identical either way.
+"""
+
+import os
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .flight import FlightRecorder
+from .registry import MetricsRegistry, MetricsServer, Sample, get_registry
+from .spans import configure_tracer, export_chrome, get_tracer
+
+_ACTIVE = False
+# the manager currently owning the process-global tracer/_ACTIVE flag: a
+# newer manager takes ownership, and only the owner's close() tears the
+# globals down (closing a superseded manager must not mute its successor)
+_OWNER = None
+
+
+def telemetry_active() -> bool:
+    """Whether a TelemetryManager is live in this process — the cheap check
+    late joiners (LLMServer) use to decide whether to register bridges."""
+    return _ACTIVE
+
+
+class TelemetryManager:
+    def __init__(self, cfg, *, rank: int = 0,
+                 default_dir: Optional[str] = None):
+        global _ACTIVE
+        self.cfg = cfg
+        self.rank = int(rank)
+        self.tracer = configure_tracer(enabled=cfg.spans,
+                                       max_spans=cfg.max_spans)
+        self.registry: MetricsRegistry = get_registry()
+        flight_dir = cfg.flight_dir or default_dir or "."
+        self.flight: Optional[FlightRecorder] = None
+        if cfg.flight_steps > 0:
+            self.flight = FlightRecorder(self.tracer, flight_dir,
+                                         steps=cfg.flight_steps,
+                                         rank=self.rank)
+        self.server: Optional[MetricsServer] = None
+        self._health_fn = None
+        self.phase_hist = self.registry.histogram(
+            "dstpu_step_phase_seconds",
+            "host-side duration of each step phase span")
+        self.step_counter = self.registry.counter(
+            "dstpu_steps_total", "engine steps completed")
+        self.res_counter = self.registry.counter(
+            "dstpu_resilience_events_total",
+            "resilience events (snapshot/rollback/degraded/preempt_drain)")
+        self._trace_dir = cfg.trace_dir
+        # with no flight ring, drained step spans would be lost to the
+        # trace_dir export — keep them in a bounded side buffer instead
+        self._trace_spans: Optional[deque] = (
+            deque(maxlen=cfg.max_spans)
+            if cfg.trace_dir and self.flight is None else None)
+        self._closed = False
+        _ACTIVE = True
+        global _OWNER
+        _OWNER = self
+        if cfg.prometheus_port is not None:
+            self.start_server(cfg.prometheus_port)
+        # the engine has no shutdown hook, so the trace_dir export and the
+        # server teardown ride process exit; close() is idempotent, so an
+        # explicit engine.telemetry.close() beforehand is also fine
+        import atexit
+
+        atexit.register(self.close)
+
+    # -- engine hooks ----------------------------------------------------
+    def drain_due(self, step: int) -> bool:
+        """Whether this step should drain the device inside its
+        ``compute/drain`` span (the once-per-window device attribution that
+        replaces a per-span sync)."""
+        n = self.cfg.drain_interval_steps
+        return bool(n and n > 0 and step % n == 0)
+
+    def on_step_end(self, step: int, *, step_time_s: Optional[float] = None,
+                    metrics: Optional[Dict[str, Any]] = None) -> None:
+        """Fold the step's spans into the phase histograms and the flight
+        ring. Only host-resident values are recorded — this hook never
+        forces a device sync."""
+        self.step_counter.inc()
+        if self.flight is not None:
+            # record_step drains the tracer; feed the histogram from the
+            # recorded window so both views see the same spans
+            window = self.flight.record_step(step, step_time_s=step_time_s,
+                                             metrics=metrics)["spans"]
+        else:
+            window = self.tracer.drain()
+            if self._trace_spans is not None:
+                self._trace_spans.extend(window)
+        for s in window:
+            self.phase_hist.observe(s["dur_ns"] / 1e9, phase=s["name"])
+
+    def count(self, event: str, amount: float = 1.0) -> None:
+        self.res_counter.inc(amount, event=event)
+
+    # -- wiring ----------------------------------------------------------
+    def attach_engine(self, engine) -> None:
+        """Post-construction wiring: the comms-ledger bridge, the resilience
+        tier (flight dumps on watchdog expiry / rollback / drain), and the
+        health surface for /healthz."""
+        from ..comm import get_comms_logger
+
+        ledger = get_comms_logger()
+        self.registry.register_collector(
+            "comms_ledger", lambda: comms_ledger_samples(ledger))
+        rz = getattr(engine, "resilience", None)
+        if rz is not None:
+            self.attach_resilience(rz)
+
+    def attach_resilience(self, manager) -> None:
+        manager._telemetry = self
+        if self.flight is not None and manager.watchdog is not None:
+            flight = self.flight
+            manager.watchdog.pre_dump = (
+                lambda: flight.dump("watchdog",
+                                    {"fired_step": manager.watchdog.fired_step}))
+        if manager.health is not None:
+            # stash the health source so a server started LATER (manual
+            # start_server after init) still serves real /healthz verdicts
+            self._health_fn = manager.health.verdicts
+            if self.server is not None:
+                self.server.health_fn = self._health_fn
+
+    def flight_dump(self, reason: str,
+                    extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+        """Exception-guarded: a failed dump (full disk, tracer churn) must
+        never abort the recovery action — rollback, drain — it documents;
+        the watchdog path has the same guard around ``pre_dump``."""
+        if self.flight is None:
+            return None
+        try:
+            return self.flight.dump(reason, extra)
+        except Exception as e:
+            from ..utils.logging import logger
+
+            logger.error(f"telemetry: flight dump ({reason}) failed: {e!r}")
+            return None
+
+    def start_server(self, port: int, host: str = "127.0.0.1") -> int:
+        """Serve /metrics (+/healthz) — the Prometheus surface beside the
+        heartbeat files the fleet already publishes. Bind failures are
+        logged, not raised: a fixed port shared across ranks (or held by a
+        stale process) must not take down engine bring-up — telemetry never
+        breaks the main path. Returns the bound port, or -1 on failure."""
+        if self.server is not None:
+            return self.server.port
+        try:
+            server = MetricsServer(self.registry, port=port, host=host,
+                                   health_fn=self._health_fn)
+            bound = server.start()
+        except OSError as e:
+            from ..utils.logging import logger
+
+            logger.warning(f"telemetry: metrics server failed to bind "
+                           f"{host}:{port} ({e}); /metrics disabled on "
+                           f"rank {self.rank}")
+            return -1
+        self.server = server
+        return bound
+
+    # -- export / teardown ----------------------------------------------
+    def export_trace(self, path: Optional[str] = None) -> Optional[str]:
+        """Chrome-trace JSON of everything still held: the flight ring's
+        per-step spans, the current unfolded window, and open spans. Slots
+        beside ``profiling/trace.py`` device captures in Perfetto."""
+        if path is None:
+            if not self._trace_dir:
+                return None
+            path = os.path.join(self._trace_dir,
+                                f"spans-{self.rank}.trace.json")
+        spans: List[dict] = []
+        if self.flight is not None:
+            for entry in self.flight.steps():
+                spans.extend(entry["spans"])
+        elif self._trace_spans is not None:
+            spans.extend(self._trace_spans)
+        spans.extend(self.tracer.snapshot())
+        return export_chrome(path, spans, self.tracer.open_spans())
+
+    def close(self) -> None:
+        global _ACTIVE
+        if self._closed:
+            return
+        self._closed = True
+        import atexit
+
+        try:  # drop the atexit pin so a closed manager can be collected
+            atexit.unregister(self.close)
+        except Exception:
+            pass
+        if self._trace_dir:
+            try:
+                self.export_trace()
+            except Exception:
+                pass
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+        # off means off again: a later telemetry-free engine in the same
+        # process must not keep filling the fleet tracer's buffer — but only
+        # the OWNING manager may flip the globals (closing a superseded
+        # manager while its successor is live must not mute the successor)
+        global _OWNER
+        if _OWNER is self:
+            configure_tracer(enabled=False)
+            _ACTIVE = False
+            _OWNER = None
+
+
+# ---------------------------------------------------------------------------
+# bridges: existing stateful sources -> pull-time registry samples
+# ---------------------------------------------------------------------------
+
+
+def comms_ledger_samples(ledger) -> List[Sample]:
+    """CommsLogger totals as ``dstpu_comm_*`` counter families (scrape-time
+    read of the ledger the collectives already maintain)."""
+    rows_b, rows_w, rows_c, rows_l = [], [], [], []
+    for op, t in sorted(ledger.totals().items()):
+        lab = {"op": op}
+        rows_b.append(("", lab, float(t["bytes"])))
+        rows_w.append(("", lab, float(t["wire_bytes"])))
+        rows_c.append(("", lab, float(t["count"])))
+        rows_l.append(("", lab, t["total_latency_ms"] / 1e3))
+    hop_rows = [("", {"link": link}, float(nbytes))
+                for link, nbytes in sorted(ledger.hop_totals().items())]
+    return [
+        ("dstpu_comm_logical_bytes_total", "counter",
+         "logical payload bytes per collective op", rows_b),
+        ("dstpu_comm_wire_bytes_total", "counter",
+         "on-wire bytes per collective op (compression-aware)", rows_w),
+        ("dstpu_comm_ops_total", "counter",
+         "collective invocations per op", rows_c),
+        ("dstpu_comm_latency_seconds_total", "counter",
+         "accumulated eager-collective latency per op", rows_l),
+        ("dstpu_comm_hop_bytes_total", "counter",
+         "wire bytes per link class (ici/dcn/host)", hop_rows),
+    ]
+
+
+def serving_metrics_samples(metrics, labels: Dict[str, str]) -> List[Sample]:
+    """ServingMetrics as ``dstpu_serving_*`` families: counters straight off
+    the tallies, latency percentiles as gauges (the serving tier keeps
+    exact percentiles — re-bucketing them would lose the tail)."""
+    lab = dict(labels)
+    counters = [
+        ("dstpu_serving_requests_total", "submitted"),
+        ("dstpu_serving_completed_total", "completed"),
+        ("dstpu_serving_cancelled_total", "cancelled"),
+        ("dstpu_serving_failed_total", "failed"),
+        ("dstpu_serving_rejected_total", "rejected"),
+        ("dstpu_serving_preemptions_total", "preemptions"),
+        ("dstpu_serving_requeues_total", "requeues"),
+        ("dstpu_serving_sla_violations_total", "sla_violations"),
+        ("dstpu_serving_tokens_out_total", "tokens_out"),
+    ]
+    out: List[Sample] = [
+        (name, "counter", f"serving {attr}",
+         [("", lab, float(getattr(metrics, attr)))])
+        for name, attr in counters]
+    gauge_rows: List[Sample] = []
+    for hname, h in (("ttft", metrics.ttft), ("tpot", metrics.tpot),
+                     ("e2e", metrics.e2e), ("queue_wait", metrics.queue_wait)):
+        for p in (50, 99):
+            v = h.percentile(p)
+            if v is not None:
+                gauge_rows.append(
+                    (f"dstpu_serving_{hname}_p{p}_seconds", "gauge",
+                     f"exact p{p} of {hname}", [("", lab, float(v))]))
+    occ = metrics.kv_occupancy()
+    if occ is not None:
+        gauge_rows.append(("dstpu_serving_kv_occupancy", "gauge",
+                           "KV pool occupancy fraction", [("", lab, occ)]))
+    gauge_rows.append(("dstpu_serving_queue_depth", "gauge",
+                       "requests queued (ingress + scheduler)",
+                       [("", lab, float(metrics.queue_depth))]))
+    gauge_rows.append(("dstpu_serving_inflight", "gauge",
+                       "sequences in the engine",
+                       [("", lab, float(metrics.inflight))]))
+    return out + gauge_rows
+
+
+def register_serving_metrics(metrics, replica_id: int = 0) -> None:
+    """Register one server's ServingMetrics into the fleet registry (keyed
+    by replica — a rebuilt server replaces its predecessor's collector)."""
+    lab = {"replica": str(int(replica_id))}
+    get_registry().register_collector(
+        f"serving-{int(replica_id)}",
+        lambda: serving_metrics_samples(metrics, lab))

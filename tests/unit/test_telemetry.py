@@ -1,0 +1,719 @@
+"""Telemetry spine tests (``deepspeed_tpu/telemetry/``).
+
+Coverage: span nesting / buffer bounds / Chrome-trace export, flight-ring
+semantics and the post-mortem dump (including a REAL watchdog exit-83 drill
+in a subprocess and the sentinel-rollback path), registry exposition format
+and the /metrics HTTP surface, default-off bitwise step identity, the
+ladder gate (synthetic regression flagged, unchanged ladder passes), and
+the satellite regressions (thread-safe JSONL monitor, cached timer sync
+sentinel).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.telemetry import (FlightRecorder, MetricsRegistry,
+                                     MetricsServer, SpanTracer, chrome_trace,
+                                     configure_tracer, get_tracer)
+from deepspeed_tpu.telemetry.spans import _NULL_SPAN, span
+
+from .simple_model import make_simple_params, random_batches, simple_loss
+
+HIDDEN = 48
+
+
+@pytest.fixture(autouse=True)
+def _reset_fleet_telemetry():
+    """Every test leaves the fleet tracer off and the process-global
+    registry fresh (TelemetryManager flips both)."""
+    yield
+    configure_tracer(enabled=False)
+    get_tracer().clear()
+    from deepspeed_tpu.telemetry import reset_registry
+    from deepspeed_tpu.telemetry import manager as _mgr
+
+    reset_registry()
+    _mgr._ACTIVE = False
+    _mgr._OWNER = None
+
+
+def _engine(cfg_extra, seed=42):
+    cfg = {"train_micro_batch_size_per_gpu": 8,
+           "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 1000, "seed": seed}
+    cfg.update(cfg_extra)
+    engine, *_ = ds.initialize(model=simple_loss,
+                               model_parameters=make_simple_params(HIDDEN),
+                               config=cfg)
+    return engine
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_depth_step_and_bounds():
+    tr = SpanTracer(enabled=True, max_spans=4)
+    tr.set_step(7)
+    with tr.span("step"):
+        with tr.span("inner", k="v"):
+            pass
+    recs = tr.drain()
+    assert [r["name"] for r in recs] == ["inner", "step"]  # close order
+    inner, outer = recs
+    assert inner["depth"] == 1 and outer["depth"] == 0
+    assert inner["step"] == 7 and inner["attrs"] == {"k": "v"}
+    assert inner["dur_ns"] >= 0 and outer["dur_ns"] >= inner["dur_ns"]
+    # the buffer is bounded: only the newest max_spans survive
+    for i in range(10):
+        with tr.span(f"s{i}"):
+            pass
+    assert [r["name"] for r in tr.drain()] == ["s6", "s7", "s8", "s9"]
+
+
+def test_span_disabled_is_shared_noop():
+    tr = SpanTracer(enabled=False)
+    assert tr.span("x") is tr.span("y") is _NULL_SPAN
+    with tr.span("x"):
+        pass
+    assert tr.drain() == [] and tr.open_spans() == []
+    # the module-level fleet entry point too
+    assert span("anything") is _NULL_SPAN
+
+
+def test_open_spans_visible_from_other_thread():
+    tr = SpanTracer(enabled=True)
+    entered, release = threading.Event(), threading.Event()
+
+    def worker():
+        with tr.span("outer"):
+            with tr.span("hung/phase"):
+                entered.set()
+                release.wait(5)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    assert entered.wait(5)
+    open_spans = tr.open_spans()
+    assert [s["name"] for s in open_spans] == ["outer", "hung/phase"]
+    assert open_spans[1]["dur_ns"] is None and open_spans[1]["age_ns"] >= 0
+    release.set()
+    t.join()
+    assert tr.open_spans() == []
+
+
+def test_chrome_trace_export(tmp_path):
+    tr = SpanTracer(enabled=True)
+    with tr.span("step", step=3):
+        with tr.span("compute/dispatch"):
+            pass
+    from deepspeed_tpu.telemetry import export_chrome
+
+    path = export_chrome(str(tmp_path / "t.json"), tr.drain(),
+                         tr.open_spans())
+    doc = json.load(open(path))
+    evs = doc["traceEvents"]
+    assert {e["name"] for e in evs} == {"step", "compute/dispatch"}
+    for e in evs:
+        assert e["ph"] == "X" and e["dur"] >= 0 and "ts" in e
+    # open spans export with their age and an open marker
+    doc2 = chrome_trace([], [{"name": "hung", "t0_ns": 0, "age_ns": 5000,
+                              "dur_ns": None, "depth": 0, "tid": 1,
+                              "step": None}])
+    (ev,) = doc2["traceEvents"]
+    assert ev["dur"] == 5.0 and ev["args"]["open"] is True
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_bounded_and_dump_schema(tmp_path):
+    tr = SpanTracer(enabled=True)
+    fl = FlightRecorder(tr, str(tmp_path), steps=3, rank=5)
+    for step in range(6):
+        with tr.span("compute/dispatch"):
+            pass
+        fl.record_step(step, step_time_s=0.01,
+                       metrics={"loss": 1.5, "skip": "nonnumeric"})
+    assert [e["step"] for e in fl.steps()] == [3, 4, 5]  # ring of 3
+    assert fl.steps()[-1]["metrics"] == {"loss": 1.5}  # numeric only
+    path = fl.dump("unit", {"extra_key": 1})
+    assert path.endswith("flightdump-5.json")
+    doc = json.load(open(path))
+    assert doc["reason"] == "unit" and doc["rank"] == 5
+    assert doc["extra_key"] == 1 and len(doc["steps"]) == 3
+    assert doc["last_phase"] == "compute/dispatch"
+    assert doc["open_spans"] == []
+
+
+def test_flight_last_phase_names_the_open_span(tmp_path):
+    tr = SpanTracer(enabled=True)
+    fl = FlightRecorder(tr, str(tmp_path), steps=4)
+    with tr.span("step"):
+        with tr.span("grad/reduce"):
+            doc = json.load(open(fl.dump("hang")))
+    assert doc["last_phase"] == "grad/reduce"  # innermost OPEN span wins
+    assert [s["name"] for s in doc["open_spans"]] == ["step", "grad/reduce"]
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counter_gauge_histogram_exposition():
+    reg = MetricsRegistry()
+    c = reg.counter("dstpu_test_total", "a counter")
+    c.inc(2, op="all_reduce")
+    c.inc(op="all_reduce")
+    g = reg.gauge("dstpu_test_gauge")
+    g.set(1.5)
+    h = reg.histogram("dstpu_test_seconds", "a hist", buckets=(0.1, 1.0))
+    h.observe(0.05, phase="fwd")
+    h.observe(5.0, phase="fwd")
+    text = reg.exposition()
+    assert "# TYPE dstpu_test_total counter" in text
+    assert 'dstpu_test_total{op="all_reduce"} 3' in text
+    assert "dstpu_test_gauge 1.5" in text
+    assert '# TYPE dstpu_test_seconds histogram' in text
+    assert 'dstpu_test_seconds_bucket{le="0.1",phase="fwd"} 1' in text
+    assert 'dstpu_test_seconds_bucket{le="+Inf",phase="fwd"} 2' in text
+    assert 'dstpu_test_seconds_count{phase="fwd"} 2' in text
+    # re-registration returns the same family; type clash fails loudly
+    assert reg.counter("dstpu_test_total") is c
+    with pytest.raises(ValueError):
+        reg.gauge("dstpu_test_total")
+
+
+def test_registry_collector_and_monitor_events():
+    reg = MetricsRegistry()
+    reg.counter("dstpu_x_total").inc(4)
+    reg.register_collector("src", lambda: [
+        ("dstpu_pull_gauge", "gauge", "", [("", {"k": "v"}, 9.0)])])
+    text = reg.exposition()
+    assert 'dstpu_pull_gauge{k="v"} 9' in text
+    events = reg.monitor_events(step=12)
+    names = {n for n, _v, _s in events}
+    assert "Telemetry/dstpu_x_total" in names
+    assert "Telemetry/dstpu_pull_gauge/k=v" in names
+    assert all(s == 12 for _n, _v, s in events)
+    # a replaced collector (same key) does not duplicate
+    reg.register_collector("src", lambda: [])
+    assert "dstpu_pull_gauge" not in reg.exposition()
+
+
+def test_exposition_merges_same_family_across_collectors():
+    """Two replicas' collectors emit the same family name; the text format
+    requires ONE # TYPE block holding all samples (promtool rejects
+    repeated family blocks)."""
+    reg = MetricsRegistry()
+    for rep in ("0", "1"):
+        reg.register_collector(f"serving-{rep}", lambda rep=rep: [
+            ("dstpu_serving_requests_total", "counter", "serving submitted",
+             [("", {"replica": rep}, float(rep) + 1)])])
+    text = reg.exposition()
+    assert text.count("# TYPE dstpu_serving_requests_total counter") == 1
+    assert 'dstpu_serving_requests_total{replica="0"} 1' in text
+    assert 'dstpu_serving_requests_total{replica="1"} 2' in text
+
+
+def test_comms_ledger_bridge_samples():
+    from deepspeed_tpu.telemetry.manager import comms_ledger_samples
+    from deepspeed_tpu.utils.comms_logging import CommsLogger
+
+    ledger = CommsLogger(enabled=True)
+    ledger.append("all_reduce", 1024, wire_bytes=256, hop_class="dcn")
+    fams = {name: rows for name, _t, _h, rows in comms_ledger_samples(ledger)}
+    assert fams["dstpu_comm_wire_bytes_total"][0] == ("", {"op": "all_reduce"},
+                                                     256.0)
+    assert fams["dstpu_comm_hop_bytes_total"][0] == ("", {"link": "dcn"},
+                                                    256.0)
+
+
+def test_metrics_server_scrape_and_healthz():
+    reg = MetricsRegistry()
+    reg.counter("dstpu_up_total").inc()
+    verdicts = {"dead": [], "stragglers": []}
+    srv = MetricsServer(reg, port=0, health_fn=lambda: verdicts)
+    port = srv.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "dstpu_up_total 1" in body
+        health = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5).read())
+        assert health["status"] == "ok" and health["dead"] == []
+        verdicts["dead"] = [3]          # a dead host flips the status code
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                                   timeout=5)
+        assert e.value.code == 503
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+
+
+def test_engine_records_step_phases_and_flight_ring(tmp_path):
+    e = _engine({"telemetry": {"enabled": True, "flight_steps": 8,
+                               "flight_dir": str(tmp_path),
+                               "drain_interval_steps": 2}})
+    for b in random_batches(4, 8, HIDDEN):
+        e.train_batch(b)
+    tm = e.telemetry
+    assert len(tm.flight.steps()) == 4
+    # ring entry step numbers agree with the spans' stamps (and with what
+    # the watchdog would report for the same step) — no off-by-one
+    for entry in tm.flight.steps():
+        stamped = {s["step"] for s in entry["spans"]}
+        assert stamped == {entry["step"]}
+    assert [entry["step"] for entry in tm.flight.steps()] == [0, 1, 2, 3]
+    phases = {s["name"] for entry in tm.flight.steps()
+              for s in entry["spans"]}
+    assert {"step", "data/shape", "compute/dispatch",
+            "metrics/report"} <= phases
+    assert "compute/drain" in phases        # the once-per-window device drain
+    assert tm.phase_hist.count(phase="step") == 4
+    assert tm.step_counter.value() == 4
+    text = tm.registry.exposition()
+    assert 'dstpu_step_phase_seconds_count{phase="compute/dispatch"} 4' in text
+    tm.close()
+
+
+def test_telemetry_shorthand_and_default_off():
+    from deepspeed_tpu.runtime.config import load_config
+
+    cfg = load_config({"telemetry": True})
+    assert cfg.telemetry.enabled and cfg.telemetry.flight_steps == 32
+    cfg = load_config({"telemetry": "/tmp/fl"})
+    assert cfg.telemetry.enabled and cfg.telemetry.flight_dir == "/tmp/fl"
+    assert not load_config(None).telemetry.enabled
+
+
+def test_telemetry_off_is_bitwise_identical():
+    batches = random_batches(3, 8, HIDDEN)
+    e_plain = _engine({})
+    e_off = _engine({"telemetry": {"enabled": False}})
+    e_on = _engine({"telemetry": {"enabled": True, "flight_steps": 4,
+                                  "flight_dir": "/tmp"}})
+    assert e_plain.telemetry is None and e_off.telemetry is None
+    for b in batches:
+        l0 = float(np.asarray(e_plain.train_batch(b)))
+        l1 = float(np.asarray(e_off.train_batch(b)))
+        l2 = float(np.asarray(e_on.train_batch(b)))
+        assert l0 == l1 == l2  # bitwise, not allclose
+    for p0, p2 in zip(np.asarray(e_plain.state.params["head"]["w"]).ravel(),
+                      np.asarray(e_on.state.params["head"]["w"]).ravel()):
+        assert p0 == p2
+    e_on.telemetry.close()
+
+
+def test_monitor_bridge_emits_registry_events(tmp_path):
+    import types
+
+    e = _engine({"steps_per_print": 1,
+                 "telemetry": {"enabled": True, "flight_steps": 4,
+                               "flight_dir": str(tmp_path),
+                               "monitor_bridge": True}})
+    events = []
+    e.monitor = types.SimpleNamespace(
+        write_events=lambda evs: events.extend(evs))
+    for b in random_batches(2, 8, HIDDEN):
+        e.train_batch(b)
+    assert any(n.startswith("Telemetry/dstpu_steps_total")
+               for n, _v, _s in events)
+    e.telemetry.close()
+
+
+def test_closing_superseded_manager_keeps_successor_live():
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    from deepspeed_tpu.telemetry import TelemetryManager, telemetry_active
+
+    a = TelemetryManager(TelemetryConfig(enabled=True, flight_steps=0))
+    b = TelemetryManager(TelemetryConfig(enabled=True, flight_steps=0))
+    a.close()                       # superseded: must not mute b
+    assert telemetry_active() and get_tracer().enabled
+    b.close()                       # the owner: tears the globals down
+    assert not telemetry_active() and not get_tracer().enabled
+
+
+def test_trace_export_without_flight_ring_keeps_spans(tmp_path):
+    """flight_steps=0 + trace_dir: drained step spans must survive into the
+    Chrome-trace export via the side buffer, not vanish each step."""
+    e = _engine({"telemetry": {"enabled": True, "flight_steps": 0,
+                               "trace_dir": str(tmp_path)}})
+    assert e.telemetry.flight is None
+    for b in random_batches(3, 8, HIDDEN):
+        e.train_batch(b)
+    path = e.telemetry.export_trace()
+    names = {ev["name"] for ev in json.load(open(path))["traceEvents"]}
+    assert {"step", "compute/dispatch"} <= names
+    assert sum(1 for ev in json.load(open(path))["traceEvents"]
+               if ev["name"] == "step") == 3       # all three steps, not one
+    e.telemetry.close()
+
+
+def test_metrics_server_bind_failure_does_not_kill_engine():
+    """One fixed prometheus_port across ranks: the second bind fails with
+    EADDRINUSE — telemetry logs and disables /metrics instead of taking
+    down engine bring-up."""
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    try:
+        e = _engine({"telemetry": {"enabled": True, "flight_steps": 4,
+                                   "flight_dir": "/tmp",
+                                   "prometheus_port": port}})
+        assert e.telemetry.server is None          # bind failed, engine lives
+        float(np.asarray(e.train_batch(random_batches(1, 8, HIDDEN)[0])))
+        e.telemetry.close()
+    finally:
+        sock.close()
+
+
+# ---------------------------------------------------------------------------
+# flight dumps on the three post-mortem paths
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_rollback_dumps_flight_record(tmp_path):
+    e = _engine({
+        "telemetry": {"enabled": True, "flight_steps": 8},
+        "resilience": {
+            "enabled": True, "snapshot_dir": str(tmp_path),
+            "snapshot_interval": 1,
+            "sentinel": {"enabled": True, "nan_streak": 1, "policy": "rollback"},
+            "faults": {"enabled": True, "nan_loss_at_steps": [2]}}})
+    assert e.resilience._telemetry is e.telemetry
+    for b in random_batches(5, 8, HIDDEN):
+        e.train_batch(b)
+    assert e.resilience.rollbacks == 1
+    # default flight_dir falls back to the snapshot dir
+    path = tmp_path / "flightdump-0.json"
+    assert path.exists()
+    doc = json.loads(path.read_text())
+    assert doc["reason"] == "rollback" and doc["tripped_at"] >= 2
+    assert doc["steps"] and doc["steps"][-1]["spans"]
+    assert e.telemetry.res_counter.value(event="rollback") == 1
+    assert e.telemetry.res_counter.value(event="snapshot") >= 1
+    e.resilience.close()
+    e.telemetry.close()
+
+
+def test_preempt_drain_dumps_flight_record(tmp_path):
+    e = _engine({
+        "telemetry": {"enabled": True, "flight_steps": 8},
+        "resilience": {
+            "enabled": True, "snapshot_dir": str(tmp_path),
+            "snapshot_interval": 0,
+            "preemption": {"enabled": True, "install_signal_handler": False},
+            "faults": {"enabled": True, "preempt_at_step": 2}}})
+    for b in random_batches(3, 8, HIDDEN):
+        e.train_batch(b)
+        if e.should_stop():
+            break
+    assert e.resilience.drained
+    doc = json.loads((tmp_path / "flightdump-0.json").read_text())
+    assert doc["reason"] == "preempt_drain"
+    e.resilience.close()
+    e.telemetry.close()
+
+
+def test_watchdog_expiry_dumps_flight_record_inprocess(tmp_path):
+    """hang_at_step drill with an overridden on_expire: pre_dump (the flight
+    recorder) must run FIRST and the dump's open spans must name the phase
+    the step wedged in."""
+    e = _engine({
+        "telemetry": {"enabled": True, "flight_steps": 8},
+        "resilience": {
+            "enabled": True, "snapshot_dir": str(tmp_path),
+            "snapshot_interval": 0,
+            "watchdog": {"enabled": True, "floor_s": 0.15, "cap_s": 2.0,
+                         "factor": 2.0},
+            "faults": {"enabled": True, "hang_at_step": 2}}})
+    rz = e.resilience
+    assert rz.watchdog.pre_dump is not None   # telemetry attached it
+    rz.watchdog.on_expire = lambda step: rz.release_hang()
+    for b in random_batches(3, 8, HIDDEN):
+        e.train_batch(b)
+    assert rz.watchdog.fired
+    doc = json.loads((tmp_path / "flightdump-0.json").read_text())
+    assert doc["reason"] == "watchdog"
+    open_names = [s["name"] for s in doc["open_spans"]]
+    assert open_names[0] == "step"
+    assert doc["last_phase"] == "resilience/post_step"  # where the hang lives
+    rz.close()
+    e.telemetry.close()
+
+
+def test_watchdog_exit83_drill_writes_flightdump(tmp_path):
+    """The REAL drill: a subprocess engine wedges (hang_at_step), the
+    watchdog kills it with exit code 83, and the flightdump left behind
+    names the hung phase — the acceptance path end to end."""
+    from deepspeed_tpu.runtime.resilience import WATCHDOG_EXIT_CODE
+
+    body = f"""\
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import sys
+        sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))!r})
+        import deepspeed_tpu as ds
+        from tests.unit.simple_model import (make_simple_params,
+                                             random_batches, simple_loss)
+        engine, *_ = ds.initialize(
+            model=simple_loss, model_parameters=make_simple_params({HIDDEN}),
+            config={{
+                "train_micro_batch_size_per_gpu": 8,
+                "optimizer": {{"type": "adam", "params": {{"lr": 1e-2}}}},
+                "steps_per_print": 1000,
+                "telemetry": {{"enabled": True, "flight_steps": 8}},
+                "resilience": {{
+                    "enabled": True, "snapshot_dir": {str(tmp_path)!r},
+                    "snapshot_interval": 0,
+                    "watchdog": {{"enabled": True, "floor_s": 0.15,
+                                  "cap_s": 2.0, "factor": 2.0}},
+                    "faults": {{"enabled": True, "hang_at_step": 2}}}}}})
+        for b in random_batches(4, 8, {HIDDEN}):
+            engine.train_batch(b)
+        raise SystemExit(99)  # unreachable: the watchdog must kill us first
+        """
+    script = tmp_path / "drill.py"
+    script.write_text(textwrap.dedent(body))
+    r = subprocess.run([sys.executable, str(script)], timeout=180,
+                       capture_output=True, text=True)
+    assert r.returncode == WATCHDOG_EXIT_CODE, r.stderr[-2000:]
+    dump = tmp_path / "flightdump-0.json"
+    assert dump.exists()
+    doc = json.loads(dump.read_text())
+    assert doc["reason"] == "watchdog"
+    assert doc["last_phase"] == "resilience/post_step"
+    assert any(s["name"] == "step" for s in doc["open_spans"])
+    # the ring held every step COMPLETED before the hang (the hung step's
+    # spans are in open_spans/inflight, not yet folded)
+    assert len(doc["steps"]) >= 1
+    assert doc["steps"][-1]["spans"]
+    # the PR 5 hangdump rides beside it unchanged
+    assert (tmp_path / "hangdump-0.txt").exists()
+
+
+# ---------------------------------------------------------------------------
+# serving integration
+# ---------------------------------------------------------------------------
+
+
+def test_serving_spans_and_registry_bridge():
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM)
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    from deepspeed_tpu.serving import LLMServer
+    from deepspeed_tpu.telemetry import TelemetryManager, get_registry
+
+    tm = TelemetryManager(TelemetryConfig(enabled=True, flight_steps=0))
+    try:
+        cfg = TransformerConfig(vocab_size=97, hidden_size=48,
+                                intermediate_size=96, num_layers=2,
+                                num_heads=4, num_kv_heads=2, max_seq_len=128,
+                                dtype=jnp.float32, norm="rmsnorm",
+                                activation="swiglu")
+        model = TransformerLM(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        engine = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            token_budget=16, max_ragged_sequence_count=4, max_chunk_size=8,
+            num_kv_blocks=64, kv_block_size=8, max_blocks_per_seq=8,
+            dtype="float32"))
+        server = LLMServer(engine, replica_id=3)
+        out = server.generate([np.arange(1, 9, dtype=np.int32)],
+                              max_new_tokens=4)
+        assert len(out) == 1 and len(out[0]) >= 1
+        text = get_registry().exposition()
+        assert 'dstpu_serving_completed_total{replica="3"} 1' in text
+        assert "dstpu_serving_ttft_p50_seconds" in text
+        server.drain(timeout=30)
+        names = {s["name"] for s in tm.tracer.snapshot()}
+        assert "serve/admit" in names
+        assert names & {"serve/prefill", "serve/decode", "serve/mixed"}
+        # a stopped replica stops exporting: frozen series would read as a
+        # live replica to every later scrape
+        assert "dstpu_serving_completed_total" not in get_registry().exposition()
+    finally:
+        tm.close()
+
+
+# ---------------------------------------------------------------------------
+# ladder gate
+# ---------------------------------------------------------------------------
+
+
+def _bench():
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    spec = importlib.util.spec_from_file_location(
+        "dstpu_bench_gate", os.path.join(root, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def bench_mod():
+    return _bench()
+
+
+def test_gate_passes_unchanged_and_flags_regression(bench_mod):
+    baseline = {"tok_per_s": {"metric": "tok_per_s", "value": 100.0},
+                "arm_us": {"metric": "arm_us", "value": 10.0}}
+    specs = {"arm_us": ("lower", 1.0)}
+    ok = [{"metric": "tok_per_s", "value": 97.0},
+          {"metric": "arm_us", "value": 12.0},
+          {"metric": "brand_new", "value": 1.0}]      # no baseline: never gates
+    assert bench_mod.gate_results(ok, baseline, specs) == []
+    bad_lower = [{"metric": "tok_per_s", "value": 40.0}]   # < 100*(1-0.5)
+    (f,) = bench_mod.gate_results(bad_lower, baseline, specs)
+    assert f["metric"] == "tok_per_s" and "below" in f["why"]
+    bad_higher = [{"metric": "arm_us", "value": 25.0}]     # > 10*(1+1.0)
+    (f,) = bench_mod.gate_results(bad_higher, baseline, specs)
+    assert f["metric"] == "arm_us" and "above" in f["why"]
+    broken = [{"metric": "tok_per_s", "value": None, "error": "boom"}]
+    (f,) = bench_mod.gate_results(broken, baseline, specs)
+    assert f["value"] is None and f["why"] == "boom"
+    # a CRASHED rung subprocess loses its metric name entirely — the error
+    # row still gates via the baseline row's rung id
+    rung_base = {"m": {"metric": "m", "value": 5.0, "rung": "ds"}}
+    crashed = [{"metric": "rungds", "value": None, "rung": "ds",
+                "error": "rc=-11"}]
+    (f,) = bench_mod.gate_results(crashed, rung_base, specs)
+    assert f["metric"] == "m" and f["value"] is None
+    # but a SUCCESSFUL rung whose metric name differs (rung 3's TPU-vs-CPU
+    # variants) is a different measurement — never gated by rung id
+    variant = [{"metric": "m_cpu_smoke", "value": 0.1, "rung": "ds"}]
+    assert bench_mod.gate_results(variant, rung_base, specs) == []
+
+
+def test_vs_baseline_filled_from_ladder_row(bench_mod):
+    baseline = {"m": {"metric": "m", "value": 50.0}}
+    rec = bench_mod.fill_vs_baseline({"metric": "m", "value": 60.0,
+                                      "vs_baseline": None}, baseline)
+    assert rec["vs_baseline"] == 1.2
+    # rows that computed a target-relative value keep it
+    rec = bench_mod.fill_vs_baseline({"metric": "m", "value": 60.0,
+                                      "vs_baseline": 0.9}, baseline)
+    assert rec["vs_baseline"] == 0.9
+    # the shipped LADDER.json parses and indexes by metric
+    rows = bench_mod.load_ladder_baseline()
+    assert "telemetry_span_overhead_ns" in rows
+
+
+def test_gate_cli_exit_codes(tmp_path, bench_mod):
+    """`bench.py --gate --results <file>` is the CI entry point: exit 0 on
+    the unchanged ladder, nonzero once a rung degrades past tolerance."""
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    bench_py = os.path.join(root, "bench.py")
+    rows = json.load(open(os.path.join(root, "LADDER.json")))
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(rows))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, bench_py, "--gate",
+                        "--results", str(ok)], env=env, timeout=180,
+                       capture_output=True, text=True)
+    assert r.returncode == 0 and "GATE PASS" in r.stdout
+    for row in rows:
+        if row["metric"] == "dcn_hierarchical":
+            row["value"] = row["value"] * 0.5   # past the 5% byte gate
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(rows))
+    r = subprocess.run([sys.executable, bench_py, "--gate",
+                        "--results", str(bad)], env=env, timeout=180,
+                       capture_output=True, text=True)
+    assert r.returncode == 1 and "dcn_hierarchical" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# satellites: thread-safe JSONL monitor, cached timer sync sentinel
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_monitor_concurrent_writers(tmp_path):
+    from deepspeed_tpu.monitor.monitor import JSONLMonitor
+
+    cfg = SimpleNamespace(enabled=True, output_path=str(tmp_path),
+                          job_name="job")
+    mon = JSONLMonitor(cfg)
+    n_threads, n_batches, batch = 8, 40, 5
+
+    def writer(t):
+        for i in range(n_batches):
+            mon.write_events([(f"T{t}/m{j}", float(i), i)
+                              for j in range(batch)])
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = open(mon.path).read().splitlines()
+    assert len(lines) == n_threads * n_batches * batch
+    for line in lines:          # every line is a whole JSON event
+        doc = json.loads(line)
+        assert set(doc) == {"name", "value", "step"}
+
+
+def test_timer_sync_reuses_one_device_sentinel(monkeypatch):
+    import jax
+
+    from deepspeed_tpu.profiling import timer
+
+    monkeypatch.setattr(timer, "_SYNC_SENTINEL", None)
+    calls = {"n": 0}
+    real_put = jax.device_put
+
+    def counting_put(x, *a, **kw):
+        calls["n"] += 1
+        return real_put(x, *a, **kw)
+
+    monkeypatch.setattr(jax, "device_put", counting_put)
+    for _ in range(5):
+        timer._sync()
+    assert calls["n"] == 1      # one transfer total, not one per stop()
+    assert timer._SYNC_SENTINEL is not None
+
+
+def test_timer_sync_rebuilds_after_invalid_sentinel(monkeypatch):
+    from deepspeed_tpu.profiling import timer
+
+    class Broken:
+        def __add__(self, other):
+            raise RuntimeError("deleted buffer")
+
+    monkeypatch.setattr(timer, "_SYNC_SENTINEL", Broken())
+    timer._sync()               # must not raise; rebuilds the sentinel
+    assert not isinstance(timer._SYNC_SENTINEL, Broken)
+    (timer._SYNC_SENTINEL + 0).block_until_ready()
