@@ -1359,6 +1359,76 @@ def telemetry_bench():
             "device": jax.devices()[0].platform}
 
 
+def memory_telemetry_bench():
+    """Rung mem (device-memory telemetry + collective flight recorder,
+    PR 10): the recording costs that ride every step when enabled —
+    collective-ring record overhead (ns/launch, enabled AND the disabled
+    no-op path the default tree pays), ``device.memory_stats()`` read
+    latency (the per-step HBM gauge cost; stays host-side — no device
+    sync), and one compile-time ``memory_analysis()`` extraction with its
+    reported breakdown. Gate direction: lower-is-better on the headline
+    overhead (a recorder that starts allocating per launch must fail CI)."""
+    from deepspeed_tpu.telemetry.collective import CollectiveRecorder
+
+    rec = CollectiveRecorder(enabled=True, max_records=512)
+    for _ in range(2000):  # warm the deque/dict path
+        rec.record("all_reduce", shape=(1024, 1024), dtype="float32",
+                   axes=("dp",))
+    n = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        rec.record("all_reduce", shape=(1024, 1024), dtype="float32",
+                   axes=("dp",))
+    record_ns = (time.perf_counter() - t0) / n * 1e9
+
+    off = CollectiveRecorder(enabled=False)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        off.record("all_reduce", shape=(1024, 1024), dtype="float32",
+                   axes=("dp",))
+    off_ns = (time.perf_counter() - t0) / n * 1e9
+
+    # memory_stats read latency: the per-step gauge cost. On CPU the call
+    # returns None — the latency of the (call, None) path is still the
+    # honest number for what a CPU smoke run pays before self-disabling.
+    dev = jax.local_devices()[0]
+    jnp.ones((8,)).block_until_ready()  # backend up before timing
+    m = 2000
+    t0 = time.perf_counter()
+    stats = None
+    for _ in range(m):
+        stats = dev.memory_stats()
+    stats_us = (time.perf_counter() - t0) / m * 1e6
+
+    # compile-time memory_analysis on a small-but-real jitted step
+    def step(p, b):
+        h = jnp.tanh(b @ p["w1"])
+        return p, jnp.mean((h @ p["w2"]) ** 2)
+
+    params = {"w1": jnp.ones((256, 512), jnp.float32),
+              "w2": jnp.ones((512, 64), jnp.float32)}
+    batch = jnp.ones((32, 256), jnp.float32)
+    exe = jax.jit(step).lower(params, batch).compile()
+    t0 = time.perf_counter()
+    ma = exe.memory_analysis()
+    analysis_us = (time.perf_counter() - t0) * 1e6
+    breakdown = {k: int(getattr(ma, k, 0)) for k in
+                 ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "generated_code_size_in_bytes")} \
+        if ma is not None else {}
+
+    return {"metric": "collective_ring_overhead_ns",
+            "value": round(record_ns, 1), "unit": "ns/launch",
+            "vs_baseline": None,
+            "record_disabled_ns": round(off_ns, 2),
+            "memory_stats_us": round(stats_us, 3),
+            "memory_stats_available": stats is not None,
+            "memory_analysis_us": round(analysis_us, 1),
+            "exec_memory": breakdown,
+            "ring_records": len(rec.snapshot()),
+            "device": jax.devices()[0].platform}
+
+
 RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "3b": rung3b_big_model,
          "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses,
@@ -1366,7 +1436,7 @@ RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "plan": planner_bench, "rz": resilience_bench,
          "wd": watchdog_bench, "fl": fused_hotpath_bench,
          "sv": serving_bench, "ds": dcn_hierarchical_bench,
-         "ob": telemetry_bench}
+         "ob": telemetry_bench, "mem": memory_telemetry_bench}
 
 
 # ---------------------------------------------------------------------------
@@ -1386,6 +1456,7 @@ GATE_DEFAULT = ("higher", 0.5)
 GATE_SPECS = {
     "watchdog_arm_disarm_us": ("lower", 1.0),
     "telemetry_span_overhead_ns": ("lower", 1.0),
+    "collective_ring_overhead_ns": ("lower", 1.0),
     "dcn_hierarchical": ("higher", 0.05),        # ledger bytes: deterministic
     "llama_zero3_bf16_mfu": ("higher", 0.15),    # the TPU headline: tight
 }
@@ -1517,7 +1588,10 @@ def run_ladder(gate: bool = False):
             ("rz", chip), ("wd", cpu1), ("fl", chip), ("sv", chip),
             # ds simulates the DCN split (dcn_axes override) — the virtual
             # CPU mesh IS the measurement substrate, even beside a real chip
-            ("ds", cpu8), ("ob", cpu1)]
+            ("ds", cpu8), ("ob", cpu1),
+            # mem measures the recorder/gauge costs; real HBM numbers ride
+            # when the chip is healthy, the CPU path measures the host side
+            ("mem", chip)]
     results = []
     for rung, env_over in plan:
         env = dict(os.environ)

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..telemetry.collective import record_launch
 from ..utils.comms_logging import CommsLogger, timed_op
 from ..utils.logging import logger
 
@@ -113,6 +114,9 @@ def get_local_rank() -> int:
 
 
 def barrier(name: str = "barrier"):
+    # eager host collective: recorded with its NAME — two ranks both "at a
+    # barrier" may be at different barriers, which is exactly a desync
+    record_launch("barrier", eager=True, detail=name)
     with timed_op(_COMMS_LOGGER, "barrier", 0):
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
@@ -145,20 +149,29 @@ def _axis_tuple(axis: Axis) -> Tuple[str, ...]:
     return (axis,) if isinstance(axis, str) else tuple(axis)
 
 
-def _log_traced(op: str, x) -> None:
+def _log_traced(op: str, x, axes: Optional[Sequence[str]] = None) -> None:
     _COMMS_LOGGER.append(op, _nbytes(x), traced=True)
+    # collective flight recorder (telemetry/collective.py): one launch
+    # record at trace time — shape/dtype are exact under XLA, and the
+    # doctor aligns the per-rank streams by the seq this stamps
+    record_launch(op, shape=getattr(x, "shape", ()),
+                  dtype=getattr(x, "dtype", None), axes=axes)
 
 
-def log_chunked(op: str, nbytes: int, wire_bytes: Optional[int] = None) -> None:
+def log_chunked(op: str, nbytes: int, wire_bytes: Optional[int] = None,
+                axes: Optional[Sequence[str]] = None) -> None:
     """Trace-time ledger entry for ring-chunked collectives
     (``ops/collective_matmul.py``): the chunk hops of one ring pass are
     recorded as a single entry covering the full ``(p-1)/p`` wire traffic,
     so ledger totals match what a fused collective would have reported."""
     _COMMS_LOGGER.append(op, int(nbytes), traced=True, wire_bytes=wire_bytes)
+    record_launch(op, shape=(int(nbytes),), axes=axes, impl="ring")
 
 
 def log_compressed(op: str, logical_bytes: int, wire_bytes: int,
-                   link: Optional[str] = None) -> None:
+                   link: Optional[str] = None,
+                   axes: Optional[Sequence[str]] = None,
+                   impl: Optional[str] = None) -> None:
     """Trace-time ledger entry for a compressed collective
     (``comm/compressed.py``): ``logical_bytes`` is what the exact collective
     would have moved, ``wire_bytes`` what the int8 payload + scale lanes
@@ -167,12 +180,14 @@ def log_compressed(op: str, logical_bytes: int, wire_bytes: int,
     multi-phase program phases (``CommsLogger.hop_totals``)."""
     _COMMS_LOGGER.append(op, int(logical_bytes), traced=True,
                          wire_bytes=int(wire_bytes), hop_class=link)
+    record_launch(op, shape=(int(logical_bytes),), axes=axes,
+                  impl=impl, link=link)
 
 
 def all_reduce(x, axis: Axis, op: str = "sum"):
     """SUM/MAX/MIN/MEAN allreduce over a mesh axis (reference ``comm.py:497``)."""
-    _log_traced("all_reduce", x)
     names = _axis_tuple(axis)
+    _log_traced("all_reduce", x, names)
     if op == "sum":
         return lax.psum(x, names)
     if op == "mean":
@@ -187,14 +202,15 @@ def all_reduce(x, axis: Axis, op: str = "sum"):
 def all_gather(x, axis: Axis, *, tiled: bool = True, gather_dim: int = 0):
     """Allgather shards over a mesh axis (reference ``all_gather_into_tensor``).
     ``tiled=True`` concatenates along ``gather_dim`` (NCCL semantics)."""
-    _log_traced("all_gather", x)
-    return lax.all_gather(x, _axis_tuple(axis), axis=gather_dim, tiled=tiled)
+    names = _axis_tuple(axis)
+    _log_traced("all_gather", x, names)
+    return lax.all_gather(x, names, axis=gather_dim, tiled=tiled)
 
 
 def reduce_scatter(x, axis: Axis, *, scatter_dim: int = 0, op: str = "sum"):
     """Reduce+scatter over a mesh axis (reference ``reduce_scatter_tensor``)."""
-    _log_traced("reduce_scatter", x)
     names = _axis_tuple(axis)
+    _log_traced("reduce_scatter", x, names)
     if op == "mean":
         return lax.psum_scatter(x, names, scatter_dimension=scatter_dim, tiled=True) / get_axis_size(names)
     return lax.psum_scatter(x, names, scatter_dimension=scatter_dim, tiled=True)
@@ -203,14 +219,14 @@ def reduce_scatter(x, axis: Axis, *, scatter_dim: int = 0, op: str = "sum"):
 def all_to_all(x, axis: str, *, split_dim: int, concat_dim: int, tiled: bool = True):
     """All-to-all over one mesh axis (reference ``all_to_all_single``). The
     Ulysses/MoE workhorse — a native ICI collective on TPU."""
-    _log_traced("all_to_all", x)
+    _log_traced("all_to_all", x, (axis,))
     return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=tiled)
 
 
 def broadcast(x, axis: Axis, src: int = 0):
     """Broadcast the value from rank ``src`` of the axis to all ranks."""
-    _log_traced("broadcast", x)
     names = _axis_tuple(axis)
+    _log_traced("broadcast", x, names)
     idx = lax.axis_index(names)
     sel = jnp.where(idx == src, x, jnp.zeros_like(x))
     return lax.psum(sel, names)
@@ -219,7 +235,7 @@ def broadcast(x, axis: Axis, src: int = 0):
 def ppermute(x, axis: str, perm: Sequence[Tuple[int, int]]):
     """Point-to-point permutation (reference p2p ``send``/``recv``,
     ``runtime/pipe/p2p.py``): pipeline activations ride this."""
-    _log_traced("ppermute", x)
+    _log_traced("ppermute", x, (axis,))
     return lax.ppermute(x, axis, perm=list(perm))
 
 
@@ -312,8 +328,8 @@ def group_all_reduce(x, axis: Axis, op: str = "sum",
     select (``axis_index_groups`` is pmap-era and unsupported under
     shard_map): same semantics, one full-axis collective. Contributions
     from non-members are the op's neutral element."""
-    _log_traced("all_reduce", x)
     names = _axis_tuple(axis)
+    _log_traced("all_reduce", x, names)
     fn = {"sum": lax.psum, "mean": lax.pmean, "max": lax.pmax,
           "min": lax.pmin}.get(op)
     if fn is None:
@@ -347,8 +363,8 @@ def group_all_reduce(x, axis: Axis, op: str = "sum",
 
 def reduce(x, axis: Axis, dst: int = 0, op: str = "sum"):
     """Reduce to rank ``dst`` of the axis; other ranks get zeros."""
-    _log_traced("reduce", x)  # one ledger entry: lax directly, not all_reduce
     names = _axis_tuple(axis)
+    _log_traced("reduce", x, names)  # one ledger entry: lax, not all_reduce
     fn = {"sum": lax.psum, "mean": lax.pmean, "max": lax.pmax,
           "min": lax.pmin}.get(op)
     if fn is None:
@@ -359,8 +375,8 @@ def reduce(x, axis: Axis, dst: int = 0, op: str = "sum"):
 
 def gather(x, axis: Axis, dst: int = 0, gather_dim: int = 0):
     """Gather all shards onto rank ``dst``; other ranks get zeros."""
-    _log_traced("gather", x)
     names = _axis_tuple(axis)
+    _log_traced("gather", x, names)
     full = lax.all_gather(x, names, axis=gather_dim, tiled=True)
     return jnp.where(lax.axis_index(names) == dst, full, jnp.zeros_like(full))
 
@@ -368,8 +384,8 @@ def gather(x, axis: Axis, dst: int = 0, gather_dim: int = 0):
 def scatter(x, axis: Axis, src: int = 0, scatter_dim: int = 0):
     """Each rank receives its ``scatter_dim`` slice of rank ``src``'s tensor
     (reference ``dist.scatter`` with a stacked input list)."""
-    _log_traced("scatter", x)  # one ledger entry: inline the src-select psum
     names = _axis_tuple(axis)
+    _log_traced("scatter", x, names)  # one entry: inline the src-select psum
     n = get_axis_size(names)
     if x.shape[scatter_dim] % n:
         raise ValueError(f"scatter dim {scatter_dim} of {x.shape} not "
@@ -389,9 +405,9 @@ def scatter(x, axis: Axis, src: int = 0, scatter_dim: int = 0):
 
 
 def all_reduce_coalesced(xs, axis: Axis, op: str = "sum"):
-    for leaf in jax.tree.leaves(xs):
-        _log_traced("all_reduce", leaf)
     names = _axis_tuple(axis)
+    for leaf in jax.tree.leaves(xs):
+        _log_traced("all_reduce", leaf, names)
     fn = {"sum": lax.psum, "mean": lax.pmean, "max": lax.pmax,
           "min": lax.pmin}.get(op)
     if fn is None:
@@ -401,11 +417,11 @@ def all_reduce_coalesced(xs, axis: Axis, op: str = "sum"):
 
 def all_gather_coalesced(xs, axis: Axis, *, tiled: bool = True,
                          gather_dim: int = 0):
+    names = _axis_tuple(axis)
     for leaf in jax.tree.leaves(xs):
-        _log_traced("all_gather", leaf)
+        _log_traced("all_gather", leaf, names)
     return jax.tree.map(
-        lambda t: lax.all_gather(t, _axis_tuple(axis), axis=gather_dim,
-                                 tiled=tiled), xs)
+        lambda t: lax.all_gather(t, names, axis=gather_dim, tiled=tiled), xs)
 
 
 # ---------------------------------------------------------------------------

@@ -128,10 +128,13 @@ def _nbytes(x) -> int:
 
 
 def _log(op: str, logical: int, wire: int,
-         link: Optional[str] = None) -> None:
-    from .comm import log_compressed
+         link: Optional[str] = None, axes=None,
+         impl: Optional[str] = None) -> None:
+    from .comm import _axis_tuple, log_compressed
 
-    log_compressed(op, logical, wire, link=link)
+    log_compressed(op, logical, wire, link=link,
+                   axes=_axis_tuple(axes) if axes is not None else None,
+                   impl=impl)
 
 
 def _quantize_parts(parts, block, stochastic, key):
@@ -246,7 +249,9 @@ def quantized_all_reduce(x, axis: Axis, *, block: Optional[int] = None,
     nb1 = world * (shard_p // b1)
     nb2 = shard_p // b1
     wire = (world * shard_p + 4 * nb1) + (shard_p + 4 * nb2)
-    _log("quantized_all_reduce", _nbytes(x), wire, link)
+    _log("quantized_all_reduce", _nbytes(x), wire, link, axes=axis,
+         impl=("int8_ef" if feedback is not None
+               else "int8_sr" if stochastic else "int8"))
     if feedback is not None:
         return out, type(feedback)(worker_error=new_worker,
                                    server_error=new_server)
@@ -364,7 +369,8 @@ def run_collective_program(x, program, *, feedback=None, key=None):
                 cur = lax.psum_scatter(padded, names, scatter_dimension=0,
                                        tiled=True) / p
                 moved = 4 * n_p * (p - 1) // p
-                _log("program_reduce_scatter", moved, moved, st.link)
+                _log("program_reduce_scatter", moved, moved, st.link,
+                     axes=names, impl="exact")
             else:
                 cur = quantized_reduce_scatter(padded, names, block=st.block,
                                                stochastic=sr, key=key,
@@ -373,7 +379,8 @@ def run_collective_program(x, program, *, feedback=None, key=None):
             if st.wire_dtype == "exact":
                 cur = lax.pmean(cur, names)
                 moved = 2 * 4 * n * (p - 1) // p
-                _log("program_all_reduce", moved, moved, st.link)
+                _log("program_all_reduce", moved, moved, st.link,
+                     axes=names, impl="exact")
             else:
                 fb = feedback if st.wire_dtype == "int8_ef" else None
                 out = quantized_all_reduce(cur, names, block=st.block,
@@ -401,7 +408,8 @@ def run_collective_program(x, program, *, feedback=None, key=None):
             elif st.wire_dtype == "exact":
                 cur = lax.all_gather(cur, names, axis=0, tiled=True)
                 moved = 4 * n * (p - 1)
-                _log("program_all_gather", moved, moved, st.link)
+                _log("program_all_gather", moved, moved, st.link,
+                     axes=names, impl="exact")
             else:
                 cur = quantized_all_gather(cur, names, block=st.block,
                                            link=st.link).reshape(-1)
@@ -487,7 +495,8 @@ def _qa2a_impl(x, axis: str, split_dim: int, concat_dim: int, block: int,
         [jnp.moveaxis(blocks[w], 0, split_dim) for w in range(world)],
         axis=concat_dim).astype(x.dtype)
     nb = world * (part_p // b)
-    _log("quantized_all_to_all", _nbytes(x), world * part_p + 4 * nb)
+    _log("quantized_all_to_all", _nbytes(x), world * part_p + 4 * nb,
+         axes=axis, impl="int8_sr" if stochastic else "int8")
     return out
 
 
@@ -539,7 +548,8 @@ def quantized_all_gather(x, axis: Axis, block: Optional[int] = None, *,
     block = compression_block() if block is None else block
     n = int(np.prod(x.shape)) if x.shape else 1
     nb = -(-n // block)
-    _log("quantized_all_gather", _nbytes(x), nb * block + 4 * nb, link)
+    _log("quantized_all_gather", _nbytes(x), nb * block + 4 * nb, link,
+         axes=axis, impl="int8_sr" if stochastic else "int8")
     from ..ops.pallas.quant import quantized_all_gather as _qag
 
     return _qag(x, axis, block, stochastic=stochastic, key=key)
@@ -557,7 +567,8 @@ def quantized_reduce_scatter(x, axis: Axis, block: Optional[int] = None, *,
     n = int(np.prod(x.shape)) if x.shape else 1
     _, shard_p, b = _shard_layout(n, world, block)
     nb = world * (shard_p // b)
-    _log("quantized_reduce_scatter", _nbytes(x), world * shard_p + 4 * nb, link)
+    _log("quantized_reduce_scatter", _nbytes(x), world * shard_p + 4 * nb,
+         link, axes=axis, impl="int8_sr" if stochastic else "int8")
     from ..ops.pallas.quant import quantized_reduce_scatter as _qrs
 
     return _qrs(x, axis, block, stochastic=stochastic, key=key)

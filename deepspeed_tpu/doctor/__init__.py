@@ -1,0 +1,597 @@
+"""Fleet post-mortem doctor: one report from N ranks' crash artifacts.
+
+The telemetry tier leaves per-rank evidence behind when a job dies —
+``flightdump-<rank>.json`` (span timeline + collective launch ring + plan
+table), ``hangdump-<rank>.txt`` (all-thread stacks), ``hb-<rank>.json``
+heartbeat beacons — but a human diagnosing a 256-host exit-83 is not going
+to read 768 files side by side. The doctor does the join:
+
+- which ranks are **missing** (no artifacts at all: host died before
+  dumping, or never came up);
+- the first sequence number where the per-rank **collective streams
+  diverge** — the desync smoking gun: the rank(s) that issued a different
+  (or extra) collective, named with op/shape/axes at that seq;
+- the innermost **open phase** per rank (what each rank was inside when it
+  stopped);
+- **dead / straggler** verdicts re-derived from the beacon set
+  (post-mortem aging: the newest beacon is "now");
+- **plan-table consistency** (planner decisions are rank-0-broadcast; a
+  rank running a different plan is itself a desync cause);
+- a suggested **classification**: ``desync`` vs ``dead_host`` vs
+  ``straggler`` vs ``hang`` vs ``crash`` vs ``preempt`` vs ``clean``.
+
+Usage — one command over a directory of artifacts::
+
+    python -m deepspeed_tpu.doctor <dump_dir> [--world N] [--out report.json]
+
+The launcher's supervisor (``launcher/launch.py::_supervise``) runs this
+automatically on a watchdog-hang exit and writes ``doctor-report.json``
+next to the dumps before relaunching. The CLI exits ``2`` on a desync
+verdict so drills can assert it in CI.
+
+Stdlib-only (json/os/re): the doctor must run on a crashed host, a dev
+box, or in CI without an accelerator stack.
+"""
+
+import json
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+try:
+    from ..utils.logging import logger
+except ImportError:  # loaded standalone (file-path import)
+    import logging
+
+    logger = logging.getLogger("deepspeed_tpu.doctor")
+
+REPORT_NAME = "doctor-report.json"
+# exit codes: the desync verdict must be assertable from CI
+EXIT_CLEAN = 0
+EXIT_DESYNC = 2
+
+_FLIGHT_RE = re.compile(r"^flightdump-(\d+)\.json$")
+_HANG_RE = re.compile(r"^hangdump-(\d+)\.txt$")
+_BEACON_RE = re.compile(r"^hb-(\d+)\.json$")
+_TRACE_RE = re.compile(r"^spans-(\d+)\.trace\.json$")
+_HANG_HEADER_RE = re.compile(
+    r"^==== watchdog hangdump rank=(\d+) pid=(\d+) step=(\S+) "
+    r"deadline_s=(\S+) wall=([\d.]+) ====")
+
+
+# ---------------------------------------------------------------------------
+# ingestion
+# ---------------------------------------------------------------------------
+
+
+def scan_artifacts(directory: str) -> Dict[str, Dict[int, str]]:
+    """Map each artifact class to ``{rank: path}``. Beacons are also looked
+    for in the ``heartbeats/`` subdirectory (the supervisor's default)."""
+    out: Dict[str, Dict[int, str]] = {
+        "flightdumps": {}, "hangdumps": {}, "heartbeats": {}, "traces": {}}
+    dirs = [directory]
+    hb_dir = os.path.join(directory, "heartbeats")
+    if os.path.isdir(hb_dir):
+        dirs.append(hb_dir)
+    for d in dirs:
+        try:
+            names = os.listdir(d)
+        except OSError:
+            continue
+        for name in names:
+            for key, rx in (("flightdumps", _FLIGHT_RE),
+                            ("hangdumps", _HANG_RE),
+                            ("heartbeats", _BEACON_RE),
+                            ("traces", _TRACE_RE)):
+                m = rx.match(name)
+                if m:
+                    out[key][int(m.group(1))] = os.path.join(d, name)
+    return out
+
+
+def load_flightdumps(paths: Dict[int, str]) -> Dict[int, dict]:
+    out: Dict[int, dict] = {}
+    for rank, path in sorted(paths.items()):
+        try:
+            with open(path) as f:
+                out[rank] = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            logger.warning(f"doctor: unreadable flightdump {path}: {e}")
+    return out
+
+
+def load_heartbeats(paths: Dict[int, str]) -> Dict[int, dict]:
+    out: Dict[int, dict] = {}
+    for rank, path in sorted(paths.items()):
+        try:
+            with open(path) as f:
+                out[rank] = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+    return out
+
+
+def load_hangdump_meta(paths: Dict[int, str]) -> Dict[int, dict]:
+    """Per-rank hangdump summary from the append-mode headers: how many
+    times the watchdog fired and the LAST firing's step/deadline/wall."""
+    out: Dict[int, dict] = {}
+    for rank, path in sorted(paths.items()):
+        meta = {"dumps": 0}
+        try:
+            with open(path, errors="replace") as f:
+                for line in f:
+                    m = _HANG_HEADER_RE.match(line)
+                    if m:
+                        meta["dumps"] += 1
+                        step = m.group(3)
+                        meta["last_step"] = (int(step) if step.isdigit()
+                                             else None)
+                        try:
+                            meta["deadline_s"] = float(m.group(4))
+                        except ValueError:
+                            meta["deadline_s"] = None
+                        meta["wall_time"] = float(m.group(5))
+        except OSError:
+            continue
+        if meta["dumps"]:
+            out[rank] = meta
+    return out
+
+
+# ---------------------------------------------------------------------------
+# collective-stream divergence
+# ---------------------------------------------------------------------------
+
+
+def _sig(rec: dict) -> Tuple:
+    """The identity of one collective launch — everything two SPMD ranks
+    must agree on. Timing, step stamps, and issuing phase are rank-local
+    and excluded."""
+    return (rec.get("op"),
+            rec.get("detail"),
+            tuple(rec.get("axes") or ()),
+            tuple(rec.get("shape") or ()),
+            rec.get("dtype"),
+            rec.get("impl"),
+            rec.get("link"))
+
+
+def _sig_str(sig: Tuple) -> str:
+    op, detail, axes, shape, dtype, impl, link = sig
+    s = op or "?"
+    if detail:
+        s += f"[{detail}]"
+    if shape:
+        s += f" {list(shape)}"
+    if dtype:
+        s += f" {dtype}"
+    if axes:
+        s += f" over {list(axes)}"
+    if impl:
+        s += f" impl={impl}"
+    if link:
+        s += f" link={link}"
+    return s
+
+
+def analyze_collective_streams(streams: Dict[int, List[dict]],
+                               tail_is_evidence: bool = True
+                               ) -> Optional[dict]:
+    """Find the first seq where the per-rank launch streams diverge.
+
+    Two divergence kinds:
+
+    - ``mismatch`` — at some seq covered by ≥2 ranks' rings, the recorded
+      launches differ (op/shape/axes/dtype/impl): the definitive desync.
+    - ``extra`` — streams agree wherever they overlap, but some rank(s)
+      kept issuing collectives past the seq where the others stopped.
+      Meaningful when every rank is *stopped* (watchdog/crash dumps, which
+      is when the doctor runs) — ``tail_is_evidence=False`` suppresses it
+      for dump sets taken at skewed times (rollback/drain snapshots).
+
+    Seq numbers are process-monotonic and rings are contiguous, so a seq
+    inside a rank's ``[min, max]`` window is always present; seqs below a
+    rank's window were evicted (bounded ring) and are not compared.
+    """
+    ranks = sorted(r for r, recs in streams.items() if recs)
+    if len(ranks) < 2:
+        return None
+    by_rank = {r: {rec["seq"]: rec for rec in streams[r]} for r in ranks}
+    lo = {r: min(by_rank[r]) for r in ranks}
+    hi = {r: max(by_rank[r]) for r in ranks}
+    counts = {r: len(by_rank[r]) for r in ranks}
+    # iterate the union of RECORDED seqs (bounded by ranks x ring size),
+    # not range(min, max): a stale dump from a long-lived rank beside a
+    # fresh one can put the windows millions of seqs apart, and per-rank
+    # contiguity makes the union walk equivalent
+    seqs = sorted(set().union(*(d.keys() for d in by_rank.values())))
+    for seq in seqs:
+        # .get, not [..]: two recording threads can interleave seq
+        # assignment and ring append, so eviction may leave a hole inside
+        # a rank's [lo, hi] window — a hole is absent evidence, not a
+        # KeyError that kills the whole diagnosis
+        present = {r: rec for r in ranks
+                   if lo[r] <= seq <= hi[r]
+                   and (rec := by_rank[r].get(seq)) is not None}
+        if len(present) < 2:
+            continue
+        sigs = {r: _sig(rec) for r, rec in present.items()}
+        distinct = set(sigs.values())
+        if len(distinct) > 1:
+            freq: Dict[Tuple, int] = {}
+            for s in sigs.values():
+                freq[s] = freq.get(s, 0) + 1
+            majority = max(freq, key=lambda s: (freq[s],))
+            has_majority = freq[majority] > len(sigs) - freq[majority]
+            divergent = sorted(r for r, s in sigs.items() if s != majority) \
+                if has_majority else sorted(sigs)
+            return {
+                "kind": "mismatch",
+                "first_divergent_seq": seq,
+                "majority": _sig_str(majority) if has_majority else None,
+                "divergent_ranks": divergent,
+                "per_rank": {str(r): {
+                    "signature": _sig_str(sigs[r]),
+                    "record": present[r]} for r in sorted(present)},
+                "stream_counts": {str(r): counts[r] for r in ranks},
+            }
+    if not tail_is_evidence:
+        return None
+    min_end, max_end = min(hi.values()), max(hi.values())
+    if max_end > min_end:
+        extra_ranks = sorted(r for r in ranks if hi[r] > min_end)
+        first_extra = min_end + 1
+        per_rank = {}
+        for r in extra_ranks:
+            rec = by_rank[r].get(first_extra)
+            if rec is not None:
+                per_rank[str(r)] = {"signature": _sig_str(_sig(rec)),
+                                    "record": rec}
+        return {
+            "kind": "extra",
+            "first_divergent_seq": first_extra,
+            "majority": None,
+            "divergent_ranks": extra_ranks,
+            "per_rank": per_rank,
+            "stream_counts": {str(r): counts[r] for r in ranks},
+        }
+    return None
+
+
+# ---------------------------------------------------------------------------
+# heartbeat verdicts: the PR 5 HealthTable, post-mortem-aged
+# ---------------------------------------------------------------------------
+
+
+class _LoadedBeacons:
+    """FileHeartbeatTransport protocol over already-parsed beacons, so the
+    doctor reuses the live HealthTable verdict math instead of a copy that
+    could drift."""
+
+    def __init__(self, beacons: Dict[int, dict]):
+        self._beacons = beacons
+
+    def read_all(self) -> Dict[int, dict]:
+        return self._beacons
+
+
+def health_verdicts(beacons: Dict[int, dict], *, dead_after_s: float = 60.0,
+                    straggler_factor: float = 3.0,
+                    now: Optional[float] = None) -> dict:
+    """Dead / straggler verdicts from the beacon set, derived by the SAME
+    :class:`~deepspeed_tpu.runtime.resilience.heartbeat.HealthTable` the
+    live fleet runs (leave-one-out straggler median and all). Post-mortem
+    aging: ``now`` defaults to the NEWEST beacon's wall time — the job is
+    over, so wall-clock now would declare everyone dead; what matters is
+    who stopped beating *relative to the last rank still alive*."""
+    if not beacons:
+        return {"dead": [], "stragglers": [], "rows": {}}
+    from ..runtime.resilience.heartbeat import HealthTable
+
+    newest = max(float(b.get("wall_time", 0.0)) for b in beacons.values())
+    ref_now = newest if now is None else float(now)
+    table = HealthTable(_LoadedBeacons(beacons), dead_after_s=dead_after_s,
+                        straggler_factor=straggler_factor,
+                        clock=lambda: ref_now)
+    rows = {str(h.rank): {"step": h.step, "step_time_s": h.step_time_s,
+                          "age_s": round(h.age_s, 3), "alive": h.alive,
+                          "straggler": h.straggler,
+                          "ratio": round(h.ratio, 3)}
+            for h in table.read()}
+    return {"dead": [int(r) for r, row in rows.items() if not row["alive"]],
+            "stragglers": [int(r) for r, row in rows.items()
+                           if row["straggler"]],
+            "rows": rows}
+
+
+# ---------------------------------------------------------------------------
+# diagnosis
+# ---------------------------------------------------------------------------
+
+
+# plan-record fields two SPMD ranks must agree on; est_us is a rank-local
+# microbenchmark timing and source is per-host cache warmth — comparing
+# them would flag healthy fake-fleet runs (where the rank-0 broadcast is a
+# single-process no-op) as desynced
+_PLAN_IDENTITY_EXCLUDE = ("est_us", "source")
+
+
+def _plan_identity(plan: dict) -> str:
+    return json.dumps(
+        {sig: {k: v for k, v in (info or {}).items()
+               if k not in _PLAN_IDENTITY_EXCLUDE}
+         for sig, info in (plan or {}).items()}, sort_keys=True)
+
+
+def _rank_summary(doc: dict) -> dict:
+    steps = doc.get("steps") or []
+    out = {
+        "reason": doc.get("reason"),
+        "last_phase": doc.get("last_phase"),
+        "last_step": max((s.get("step", -1) for s in steps), default=None),
+        "dump_wall_time": doc.get("wall_time"),
+        "open_spans": [s.get("name") for s in doc.get("open_spans") or []],
+        "collectives": len(doc.get("collectives") or []),
+    }
+    if doc.get("exception"):
+        out["exception"] = doc["exception"]
+        out["message"] = doc.get("message")
+    if doc.get("fired_step") is not None:
+        out["fired_step"] = doc["fired_step"]
+    if doc.get("mem"):
+        out["mem"] = doc["mem"]
+    return out
+
+
+def diagnose(directory: str, *, world: Optional[int] = None,
+             dead_after_s: float = 60.0,
+             straggler_factor: float = 3.0) -> dict:
+    """Ingest one directory of per-rank artifacts and produce the fleet
+    post-mortem report dict (see :func:`render_report` for the human
+    form; the schema is documented in ``docs/observability.md``)."""
+    artifacts = scan_artifacts(directory)
+    dumps = load_flightdumps(artifacts["flightdumps"])
+    beacons = load_heartbeats(artifacts["heartbeats"])
+    hangs = load_hangdump_meta(artifacts["hangdumps"])
+
+    seen = (set(dumps) | set(beacons) | set(hangs)
+            | set(artifacts["traces"]))
+    expected = int(world) if world else (max(seen) + 1 if seen else 0)
+    missing = sorted(set(range(expected)) - seen)
+
+    ranks = {str(r): _rank_summary(doc) for r, doc in sorted(dumps.items())}
+    for r, meta in sorted(hangs.items()):
+        ranks.setdefault(str(r), {})["hangdump"] = meta
+
+    # every rank stopped at dump time in the watchdog/crash cases — a
+    # trailing extra collective is then real evidence, not dump-time skew
+    reasons = {doc.get("reason") for doc in dumps.values()}
+    stopped = reasons and reasons <= {"watchdog", "crash"}
+    streams = {r: doc.get("collectives") or [] for r, doc in dumps.items()}
+    desync = analyze_collective_streams(streams,
+                                        tail_is_evidence=bool(stopped))
+
+    plans = {r: doc.get("plan") for r, doc in dumps.items()
+             if doc.get("plan")}
+    plan_mismatch = None
+    if len(plans) >= 2:
+        canonical: Dict[str, List[int]] = {}
+        for r, p in plans.items():
+            canonical.setdefault(_plan_identity(p), []).append(r)
+        if len(canonical) > 1:
+            groups = sorted(canonical.values(), key=len, reverse=True)
+            plan_mismatch = {"ranks": sorted(
+                r for grp in groups[1:] for r in grp)}
+
+    health = health_verdicts(beacons, dead_after_s=dead_after_s,
+                             straggler_factor=straggler_factor)
+
+    phases: Dict[str, List[int]] = {}
+    for r, doc in dumps.items():
+        ph = doc.get("last_phase") or "<none>"
+        phases.setdefault(ph, []).append(r)
+    phases = {ph: sorted(rs) for ph, rs in sorted(phases.items())}
+
+    verdict, evidence = _classify(dumps, missing, desync, plan_mismatch,
+                                  health, phases, expected, hangs)
+    return {
+        "version": 1,
+        "dir": os.path.abspath(directory),
+        "generated_wall_time": time.time(),
+        "world": expected,
+        "artifacts": {k: sorted(v) for k, v in artifacts.items()},
+        "ranks": ranks,
+        "missing_ranks": missing,
+        "desync": desync,
+        "plan_mismatch": plan_mismatch,
+        "health": health,
+        "phases": phases,
+        "verdict": verdict,
+        "evidence": evidence,
+    }
+
+
+def _classify(dumps, missing, desync, plan_mismatch, health, phases,
+              expected, hangs=None) -> Tuple[str, List[str]]:
+    """The decision tree (docs/observability.md reproduces it): desync
+    beats dead-host beats straggler beats genuine-hang beats crash."""
+    evidence: List[str] = []
+    reasons = {doc.get("reason") for doc in dumps.values()}
+    if desync is not None:
+        d = desync
+        at = d["first_divergent_seq"]
+        who = ", ".join(f"rank {r}" for r in d["divergent_ranks"])
+        if d["kind"] == "mismatch":
+            issued = "; ".join(
+                f"rank {r} issued {d['per_rank'][str(r)]['signature']}"
+                for r in d["divergent_ranks"]
+                if str(r) in d["per_rank"])
+            evidence.append(
+                f"collective streams diverge at seq {at} — {issued}"
+                + (f" while the majority issued {d['majority']}"
+                   if d["majority"] else ""))
+        else:
+            evidence.append(
+                f"{who} issued extra collective(s) from seq {at} while the "
+                "other ranks' streams had stopped")
+        if plan_mismatch:
+            evidence.append(
+                "plan tables also differ across ranks "
+                f"(ranks {plan_mismatch['ranks']}) — the desync may start "
+                "at planner resolution, not model code")
+        return "desync", evidence
+    if plan_mismatch:
+        evidence.append(
+            f"ranks {plan_mismatch['ranks']} resolved a DIFFERENT collective "
+            "plan than their peers (plans are rank-0-broadcast: this alone "
+            "desynchronizes the fleet)")
+        return "desync", evidence
+    dead = set(health["dead"]) | set(missing)
+    if dead:
+        if missing:
+            evidence.append(
+                f"rank(s) {missing} left no artifacts at all (host gone "
+                "before dumping, or never joined)")
+        if health["dead"]:
+            evidence.append(
+                f"rank(s) {sorted(health['dead'])} stopped heartbeating "
+                "while peers beat on")
+        return "dead_host", evidence
+    if health["stragglers"]:
+        rows = health["rows"]
+        for r in health["stragglers"]:
+            row = rows[str(r)]
+            evidence.append(
+                f"rank {r} stepped {row['ratio']}x slower than the "
+                "leave-one-out median of its live peers")
+        return "straggler", evidence
+    if "watchdog" in reasons or hangs:
+        hung = {ph: rs for ph, rs in phases.items()
+                if ph != "<none>"}
+        for ph, rs in hung.items():
+            evidence.append(f"rank(s) {rs} hung inside {ph}")
+        if hangs and not dumps:
+            # watchdog fired but telemetry was off: the hangdumps are the
+            # only evidence (stacks, fired step) — still a hang, not clean
+            for r, meta in sorted(hangs.items()):
+                evidence.append(
+                    f"rank {r} hangdump: watchdog fired "
+                    f"{meta.get('dumps')}x, last at step "
+                    f"{meta.get('last_step')} (deadline "
+                    f"{meta.get('deadline_s')}s); enable telemetry for "
+                    "phase/collective evidence")
+        if dumps:
+            evidence.append(
+                "collective streams are CONSISTENT across ranks — a "
+                "genuine hang (network, host wedge), not a desync")
+        return "hang", evidence
+    if "crash" in reasons:
+        for r, doc in sorted(dumps.items()):
+            if doc.get("reason") == "crash":
+                evidence.append(
+                    f"rank {r} crashed: {doc.get('exception')}: "
+                    f"{str(doc.get('message'))[:200]}")
+        return "crash", evidence
+    if "preempt_drain" in reasons:
+        evidence.append("run drained for preemption; nothing is wrong")
+        return "preempt", evidence
+    if not dumps and expected == 0:
+        evidence.append("no artifacts found")
+        return "inconclusive", evidence
+    evidence.append("all artifacts consistent; no failure signature found")
+    return "clean", evidence
+
+
+# ---------------------------------------------------------------------------
+# outputs
+# ---------------------------------------------------------------------------
+
+
+def write_report(report: dict, path: str) -> str:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def render_report(report: dict) -> str:
+    """The human form — what the CLI prints."""
+    lines = [f"== deepspeed_tpu doctor: {report['dir']} ==",
+             f"verdict: {report['verdict'].upper()}"]
+    for ev in report["evidence"]:
+        lines.append(f"  - {ev}")
+    lines.append(f"world: {report['world']} rank(s); "
+                 f"flightdumps from {report['artifacts']['flightdumps']}, "
+                 f"hangdumps from {report['artifacts']['hangdumps']}, "
+                 f"beacons from {report['artifacts']['heartbeats']}")
+    if report["missing_ranks"]:
+        lines.append(f"missing ranks: {report['missing_ranks']}")
+    d = report.get("desync")
+    if d:
+        lines.append(f"first divergent collective: seq "
+                     f"{d['first_divergent_seq']} ({d['kind']}); "
+                     f"divergent rank(s): {d['divergent_ranks']}")
+        for r, v in sorted(d.get("per_rank", {}).items()):
+            lines.append(f"  rank {r}: {v['signature']}")
+    if report["phases"]:
+        lines.append("last phase per rank:")
+        for ph, rs in report["phases"].items():
+            lines.append(f"  {ph}: ranks {rs}")
+    h = report["health"]
+    if h["rows"]:
+        lines.append(f"heartbeats: dead={h['dead']} "
+                     f"stragglers={h['stragglers']}")
+    for r, info in sorted(report["ranks"].items(), key=lambda kv: int(kv[0])):
+        bits = [f"reason={info.get('reason')}",
+                f"last_step={info.get('last_step')}",
+                f"phase={info.get('last_phase')}"]
+        if info.get("exception"):
+            bits.append(f"exception={info['exception']}")
+        if info.get("hangdump"):
+            bits.append(f"hangdumps={info['hangdump'].get('dumps')}")
+        lines.append(f"rank {r}: " + " ".join(bits))
+    return "\n".join(lines)
+
+
+def merge_traces(directory: str, out: Optional[str] = None) -> Optional[str]:
+    """Concatenate the per-rank Chrome-trace exports
+    (``spans-<rank>.trace.json``, already stamped ``pid=rank`` with
+    ``process_name`` metadata) into one file Perfetto opens as a single
+    multi-rank timeline. Returns the merged path, or None when there is
+    nothing to merge."""
+    traces = scan_artifacts(directory)["traces"]
+    if not traces:
+        return None
+    events: List[dict] = []
+    for rank, path in sorted(traces.items()):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            logger.warning(f"doctor: unreadable trace {path}: {e}")
+            continue
+        events.extend(doc.get("traceEvents") or [])
+    if not events:
+        return None
+    out = out or os.path.join(directory, "merged.trace.json")
+    tmp = f"{out}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    os.replace(tmp, out)
+    return out
+
+
+def run_post_mortem(directory: str, *, world: Optional[int] = None,
+                    out: Optional[str] = None) -> Optional[dict]:
+    """The supervisor entry point: diagnose + write the report next to the
+    dumps, never raising (a broken post-mortem must not block the
+    relaunch). Returns the report dict, or None on failure."""
+    try:
+        report = diagnose(directory, world=world)
+        write_report(report, out or os.path.join(directory, REPORT_NAME))
+        return report
+    except Exception as e:
+        logger.warning(f"doctor: post-mortem of {directory} failed: {e!r}")
+        return None

@@ -1,0 +1,73 @@
+"""``python -m deepspeed_tpu.doctor`` — one-command fleet hang diagnosis.
+
+Point it at the directory the fleet dumped into (the resilience
+``snapshot_dir`` / telemetry ``flight_dir``); it joins every rank's
+artifacts into one post-mortem, prints the verdict, and writes
+``doctor-report.json`` beside the dumps. Exit code ``2`` means a
+collective desync was identified — CI drills assert on it.
+"""
+
+import argparse
+import os
+import sys
+
+from . import (EXIT_CLEAN, EXIT_DESYNC, REPORT_NAME, diagnose, merge_traces,
+               render_report, write_report)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.doctor",
+        description="Fleet post-mortem: join per-rank flightdumps, "
+                    "hangdumps, and heartbeat beacons into one diagnosis.")
+    ap.add_argument("directory", help="dump directory (snapshot_dir / "
+                                      "flight_dir) holding the per-rank "
+                                      "artifacts")
+    ap.add_argument("--world", type=int, default=None,
+                    help="expected rank count (default: inferred from the "
+                         "highest rank seen — an all-ranks-missing tail "
+                         "cannot be inferred, so pass it when you know it)")
+    ap.add_argument("--out", default=None,
+                    help=f"report path (default: <dir>/{REPORT_NAME})")
+    ap.add_argument("--dead-after-s", type=float, default=60.0,
+                    help="beacon age (vs the newest beacon) past which a "
+                         "rank is dead")
+    ap.add_argument("--straggler-factor", type=float, default=3.0,
+                    help="step-time multiple of the leave-one-out peer "
+                         "median past which a rank is a straggler")
+    ap.add_argument("--merge-trace", nargs="?", const="", default=None,
+                    metavar="OUT",
+                    help="also merge the per-rank Chrome-trace exports "
+                         "(spans-<rank>.trace.json) into one Perfetto "
+                         "timeline (default OUT: <dir>/merged.trace.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report JSON instead of the rendering")
+    ap.add_argument("--no-report", action="store_true",
+                    help="do not write the report file (print only)")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.directory):
+        print(f"doctor: not a directory: {args.directory}", file=sys.stderr)
+        return 1
+    report = diagnose(args.directory, world=args.world,
+                      dead_after_s=args.dead_after_s,
+                      straggler_factor=args.straggler_factor)
+    if not args.no_report:
+        path = args.out or os.path.join(args.directory, REPORT_NAME)
+        write_report(report, path)
+        print(f"doctor: report written to {path}", file=sys.stderr)
+    if args.merge_trace is not None:
+        merged = merge_traces(args.directory, args.merge_trace or None)
+        print(f"doctor: merged trace: {merged or 'nothing to merge'}",
+              file=sys.stderr)
+    if args.json:
+        import json
+
+        print(json.dumps(report, indent=1))
+    else:
+        print(render_report(report))
+    return EXIT_DESYNC if report["verdict"] == "desync" else EXIT_CLEAN
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -171,7 +171,8 @@ def launch_local(args) -> int:
         return _supervise(cmd, env, max_restarts=args.max_restarts,
                           restart_policy=getattr(args, "restart_policy",
                                                  "default"),
-                          rescale_fn=rescale_fn)
+                          rescale_fn=rescale_fn,
+                          dump_dir=getattr(args, "dump_dir", None))
     return _run_once(cmd, env)
 
 
@@ -181,13 +182,50 @@ def _run_once(cmd: List[str], env) -> int:
     return proc.wait()
 
 
+def _run_doctor(dump_dir: Optional[str], env) -> None:
+    """Exit-83 post-mortem: join the per-rank dumps into
+    ``doctor-report.json`` BEFORE the relaunch overwrites the evidence
+    (flightdump filenames are newest-wins). ``dump_dir`` falls back to the
+    ``DSTPU_DUMP_DIR`` env (the child env inherits the supervisor's, so an
+    exported var reaches both). Never raises — a broken post-mortem must
+    not block the restart."""
+    d = dump_dir or (env or {}).get("DSTPU_DUMP_DIR") \
+        or os.environ.get("DSTPU_DUMP_DIR")
+    if not d or not os.path.isdir(d):
+        if not d:
+            logger.info(
+                "no dump_dir/DSTPU_DUMP_DIR configured; skipping the "
+                "exit-83 doctor post-mortem (run `python -m "
+                "deepspeed_tpu.doctor <dir>` by hand)")
+        return
+    try:
+        from ..doctor import REPORT_NAME, render_report, run_post_mortem
+    except ImportError:  # launch.py loaded standalone (file-path import)
+        logger.info("doctor unavailable in standalone launcher mode; run "
+                    f"`python -m deepspeed_tpu.doctor {d}` by hand")
+        return
+    # the supervisor KNOWS the world size (node_rank bootstrap env) — pass
+    # it so a dead highest-rank host, which left no artifact to infer
+    # from, still reads as missing instead of shrinking the world
+    try:
+        world = int((env or {}).get("DSTPU_NUM_PROCESSES", "0") or 0)
+    except ValueError:
+        world = 0
+    report = run_post_mortem(d, world=world if world > 1 else None)
+    if report is not None:
+        logger.warning(
+            f"doctor: verdict {report['verdict'].upper()} — report at "
+            f"{os.path.join(d, REPORT_NAME)}\n" + render_report(report))
+
+
 def _supervise(cmd: List[str], env, max_restarts: int = 100,
                min_uptime_s: float = 10.0, backoff_s: float = 3.0,
                restart_policy: str = "default",
                policy: Optional[RestartPolicy] = None,
                rescale_fn: Optional[Callable[[int], Optional[Dict[str, str]]]] = None,
                sleep: Callable[[float], None] = time.sleep,
-               rng: Optional[random.Random] = None) -> int:
+               rng: Optional[random.Random] = None,
+               dump_dir: Optional[str] = None) -> int:
     """Restart-on-failure supervision (elastic agent).
 
     ``restart_policy="default"`` classifies child exits
@@ -236,6 +274,12 @@ def _supervise(cmd: List[str], env, max_restarts: int = 100,
         cls = classify_exit(rc)
         if cls == "clean":
             return 0
+        if cls == "hang":
+            # post-mortem on EVERY hang exit — including the terminal one
+            # (budget exhausted, stop requested): that last hang is the one
+            # the operator investigates, and a relaunch would overwrite the
+            # newest-wins dumps
+            _run_doctor(dump_dir, env)
         if stop_requested:
             logger.info(f"worker stopped by signal {stop_requested[0]}; "
                         "not restarting")

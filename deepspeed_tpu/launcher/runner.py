@@ -75,6 +75,14 @@ def parse_args(args=None):
                              "each supervised relaunch re-probes capacity "
                              "and re-queries decide_world so the restart "
                              "targets the largest valid world")
+    parser.add_argument("--dump_dir", type=str, default=None,
+                        help="where the workers write their post-mortem "
+                             "artifacts (resilience snapshot_dir / telemetry "
+                             "flight_dir): on a watchdog-hang exit the "
+                             "supervisor runs `python -m deepspeed_tpu."
+                             "doctor` over it and writes doctor-report.json "
+                             "before relaunching (DSTPU_DUMP_DIR env works "
+                             "too)")
     parser.add_argument("--python_exec", type=str, default=sys.executable)
     parser.add_argument("--export", action="append", default=[],
                         help="KEY=VALUE env to forward to workers (repeatable)")

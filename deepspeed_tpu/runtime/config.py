@@ -584,7 +584,22 @@ class TelemetryConfig(ConfigModel):
     trace_dir: Optional[str] = None   # Chrome-trace export dir (on close())
     flight_steps: int = 32            # flight-recorder ring size (0 = off)
     flight_dir: Optional[str] = None  # default: resilience.snapshot_dir or .
-    prometheus_port: Optional[int] = None  # serve /metrics + /healthz
+    # collective flight recorder: bounded ring of every collective launch
+    # (seq/op/axes/shape/dtype/impl/phase), recorded host-side at trace/
+    # dispatch time in the comm wrappers and dumped with the flightdump —
+    # the stream `python -m deepspeed_tpu.doctor` aligns across ranks to
+    # name a desync. 0 = off.
+    collective_ring: int = 256
+    # per-step device-memory gauges from device.memory_stats() (bytes in
+    # use / peak / limit), folded into the flight ring and exported as
+    # dstpu_mem_* — auto-disables where the backend reports nothing (CPU)
+    memory: bool = True
+    # AOT-compile each train-step variant once to record its compile-time
+    # memory_analysis() (arg/output/temp/generated bytes) in the plan table
+    # and registry; the measured executable then serves the steps, so the
+    # compile is paid once, not twice. Program + numerics are identical.
+    memory_analysis: bool = False
+    prometheus_port: Optional[int] = None  # serve /metrics + /healthz (0 = ephemeral)
     monitor_bridge: bool = False      # registry -> Monitor events each print
 
 

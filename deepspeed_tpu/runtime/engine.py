@@ -49,6 +49,21 @@ except ImportError:  # pragma: no cover
     struct = None
 
 
+def artifact_rank() -> int:
+    """The rank stamped on per-rank post-mortem artifacts (flightdumps,
+    hangdumps, heartbeat beacons, doctor reports). ``jax.process_index()``
+    when the control plane is genuinely multi-process; otherwise the
+    launcher's ``DSTPU_PROCESS_ID`` env — fake-fleet drills run N
+    *independent* single-process jax instances against one dump dir, and
+    they must not all claim rank 0 — defaulting to 0."""
+    if jax.process_count() > 1:
+        return jax.process_index()
+    try:
+        return int(os.environ.get("DSTPU_PROCESS_ID", "0") or 0)
+    except ValueError:
+        return 0
+
+
 @struct.dataclass
 class TrainState:
     """Engine state pytree. ``params`` are fp32 master weights (reference
@@ -382,11 +397,12 @@ class DeepSpeedTPUEngine:
         # so flight dumps ride the watchdog/rollback/drain paths. Off by
         # default: nothing constructed, stepping bit-identical.
         self.telemetry = None
+        self.artifact_rank = artifact_rank()
         if config.telemetry.enabled:
             from ..telemetry import TelemetryManager
 
             self.telemetry = TelemetryManager(
-                config.telemetry, rank=jax.process_index(),
+                config.telemetry, rank=self.artifact_rank,
                 default_dir=config.resilience.snapshot_dir)
         # resilience (runtime/resilience/): snapshots + sentinel + preemption.
         # Constructed only when enabled, restore-on-restart runs before the
@@ -948,8 +964,50 @@ class DeepSpeedTPUEngine:
     def _compile_finish(self, state_sh):
         self._train_step = self._train_steps[(None, None)]
         self._aot_step = None  # (executable, batch fingerprint) from compile()
+        # (key, batch fingerprint) -> measured AOT executable, filled when
+        # telemetry.memory_analysis records each variant's compile-time
+        # memory breakdown (a curriculum reshape is a new fingerprint)
+        self._mem_execs = {}
         self._state_shardings = state_sh
         self._rng = jax.random.PRNGKey(self.config.seed)
+
+    def _measured_exec(self, step_fn, key, batch, step_rng):
+        """AOT-compile one train-step variant, record its
+        ``memory_analysis()`` breakdown, and return the executable (which
+        then serves matching steps — same program, same numerics)."""
+        fp = (key, self._batch_fingerprint(batch))
+        exe = self._mem_execs.get(fp)
+        if exe is None:
+            exe = step_fn.lower(self.state, batch, step_rng).compile()
+            self._mem_execs[fp] = exe
+            label = ("train_step" if key == (None, None)
+                     else f"train_step{key}")
+            self._record_memory_analysis(exe, label)
+        return exe
+
+    def _record_memory_analysis(self, exe, label: str) -> None:
+        """Fold one compiled executable's ``memory_analysis()`` into the
+        comms ledger's plan table and (when telemetry is live) the
+        ``dstpu_mem_exec_bytes`` registry gauges. Best-effort: a backend
+        without the surface records nothing."""
+        try:
+            ma = exe.memory_analysis()
+        except Exception:
+            return
+        if ma is None:
+            return
+        info = {}
+        for kind in ("argument_size_in_bytes", "output_size_in_bytes",
+                     "temp_size_in_bytes", "generated_code_size_in_bytes",
+                     "alias_size_in_bytes"):
+            v = getattr(ma, kind, None)
+            if v is not None:
+                info[kind] = int(v)
+        if not info:
+            return
+        dist.get_comms_logger().record_memory(label, info)
+        if self.telemetry is not None:
+            self.telemetry.record_memory_analysis(label, info)
 
     # ------------------------------------------------------------------
     # primary API
@@ -996,10 +1054,27 @@ class DeepSpeedTPUEngine:
             self.resilience.pre_step()
             try:
                 return self._train_batch_armed(batch)
-            except BaseException:
+            except BaseException as e:
                 self.resilience.abort_step()
+                self._crash_flight_dump(e)
                 raise
-        return self._train_batch_armed(batch)
+        try:
+            return self._train_batch_armed(batch)
+        except BaseException as e:
+            self._crash_flight_dump(e)
+            raise
+
+    def _crash_flight_dump(self, exc: BaseException) -> None:
+        """Crash hook: an unhandled train-loop exception would otherwise
+        lose the flight ring (the watchdog/rollback/drain dumps only cover
+        *their* paths) — dump it with ``reason="crash"`` and the exception
+        summary before the raise propagates. StopIteration is the routine
+        epoch-end signal, not a crash; everything else (including injected
+        faults and XLA errors) leaves a post-mortem."""
+        if (self.telemetry is not None
+                and isinstance(exc, Exception)
+                and not isinstance(exc, StopIteration)):
+            self.telemetry.crash_dump(exc)
 
     def _train_batch_armed(self, batch):
         """Telemetry shell around the step body: opens the per-step ``step``
@@ -1052,6 +1127,14 @@ class DeepSpeedTPUEngine:
         if (key == (None, None) and self._aot_step is not None
                 and self._aot_step[1] == self._batch_fingerprint(batch)):
             step_fn = self._aot_step[0]  # AOT executable from compile()
+        elif (self.telemetry is not None
+              and self.telemetry.cfg.memory_analysis
+              and self._host_adam is None):
+            # telemetry.memory_analysis: AOT-compile this variant once so
+            # its compile-time memory breakdown is recorded, then step
+            # through the measured executable (the compile is paid once —
+            # lower().compile() does not share the jit dispatch cache)
+            step_fn = self._measured_exec(step_fn, key, batch, step_rng)
         t0 = time.perf_counter()
         with span("compute/dispatch"):
             if self._host_adam is not None:
@@ -1386,6 +1469,9 @@ class DeepSpeedTPUEngine:
         else:
             exe = self._train_step.lower(self.state, batch, rng).compile()
         self._aot_step = (exe, self._batch_fingerprint(batch))
+        # the AOT path holds a real executable: its compile-time memory
+        # breakdown is free — record it in the plan table + registry
+        self._record_memory_analysis(exe, "train_step")
         return self
 
     @staticmethod

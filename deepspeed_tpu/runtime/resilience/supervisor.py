@@ -133,7 +133,12 @@ class ResilienceManager:
         self._pending_metrics = None
 
         # -- fleet-robustness tier (watchdog / heartbeat / degraded mode) --
-        self._rank = jax.process_index()
+        # the engine's artifact rank (DSTPU_PROCESS_ID-aware) keeps hangdump
+        # and beacon filenames consistent with the telemetry tier's
+        # flightdumps — the doctor joins all three by rank
+        self._rank = getattr(engine, "artifact_rank", None)
+        if self._rank is None:
+            self._rank = jax.process_index()
         wc = cfg.watchdog
         self.watchdog: Optional[StepWatchdog] = None
         if wc.enabled:

@@ -4,9 +4,14 @@ Four pieces behind one default-off ``telemetry:`` config block:
 
 - :mod:`spans` — low-overhead step-phase span tracer with thread-local
   nesting and Chrome-trace/Perfetto export;
+- :mod:`collective` — collective flight recorder: a bounded ring of every
+  collective launch (seq/op/axes/shape/dtype/impl/phase) recorded in the
+  comm wrappers at trace/dispatch time — the stream
+  ``python -m deepspeed_tpu.doctor`` aligns across ranks to name a desync;
 - :mod:`flight` — crash flight recorder: the last N steps' spans + metrics
-  ring-buffered and dumped to ``flightdump-<rank>.json`` from the watchdog
-  expiry path, sentinel rollback, and the preemption drain;
+  (+ the collective ring) ring-buffered and dumped to
+  ``flightdump-<rank>.json`` from the watchdog expiry path, sentinel
+  rollback, the preemption drain, and the engine's crash hook;
 - :mod:`registry` — pull-based counters/gauges/histograms with Prometheus
   text exposition (``/metrics`` + ``/healthz``) and a monitor-event bridge
   so the existing JSONL/TensorBoard sinks keep working;
@@ -17,6 +22,8 @@ its monitor thread while jax is wedged, and drill scripts import them
 standalone.
 """
 
+from .collective import (CollectiveRecorder, configure_collective_recorder,
+                         get_collective_recorder, record_launch)
 from .flight import FlightRecorder, flightdump_path
 from .manager import TelemetryManager, register_serving_metrics, telemetry_active
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
@@ -27,6 +34,8 @@ from .spans import (SpanTracer, chrome_trace, configure_tracer, export_chrome,
 __all__ = [
     "span", "SpanTracer", "get_tracer", "configure_tracer",
     "chrome_trace", "export_chrome",
+    "CollectiveRecorder", "get_collective_recorder",
+    "configure_collective_recorder", "record_launch",
     "FlightRecorder", "flightdump_path",
     "MetricsRegistry", "MetricsServer", "Counter", "Gauge", "Histogram",
     "get_registry", "reset_registry",

@@ -39,18 +39,23 @@ class FlightRecorder:
 
     def __init__(self, tracer: SpanTracer, directory: str, *,
                  steps: int = 32, rank: int = 0,
-                 clock=time.time):
+                 clock=time.time, collectives=None):
         self.tracer = tracer
         self.dir = directory
         self.rank = int(rank)
         self.clock = clock
+        # optional CollectiveRecorder (telemetry/collective.py): its launch
+        # ring rides every dump, and each step entry is stamped with the
+        # latest seq so the doctor can attribute seq ranges to steps
+        self.collectives = collectives
         self._ring: "deque" = deque(maxlen=max(1, int(steps)))
         self._lock = threading.Lock()
         self.dumps = 0
 
     # -- recording -------------------------------------------------------
     def record_step(self, step: int, *, step_time_s: Optional[float] = None,
-                    metrics: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+                    metrics: Optional[Dict[str, Any]] = None,
+                    mem: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """Fold the tracer's closed spans since the last call into one ring
         entry. Called at step end (engine) — off the device-sync path.
         Returns the appended entry so the hot path never has to copy the
@@ -62,6 +67,10 @@ class FlightRecorder:
         if metrics:
             entry["metrics"] = {k: v for k, v in metrics.items()
                                 if isinstance(v, (int, float, bool))}
+        if mem:  # device-memory gauges (bytes in use / peak / limit)
+            entry["mem"] = dict(mem)
+        if self.collectives is not None:
+            entry["collective_seq"] = self.collectives.last_seq()
         with self._lock:
             self._ring.append(entry)
         return entry
@@ -109,6 +118,10 @@ class FlightRecorder:
             "inflight_spans": inflight,
             "steps": self.steps(),
         }
+        if self.collectives is not None:
+            # the collective launch stream: what the doctor aligns across
+            # ranks to find the first divergent seq
+            doc["collectives"] = self.collectives.snapshot()
         if extra:
             doc.update(extra)
         os.makedirs(self.dir, exist_ok=True)

@@ -23,6 +23,7 @@ import os
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from .collective import configure_collective_recorder, get_collective_recorder
 from .flight import FlightRecorder
 from .registry import MetricsRegistry, MetricsServer, Sample, get_registry
 from .spans import configure_tracer, export_chrome, get_tracer
@@ -50,13 +51,24 @@ class TelemetryManager:
                                        max_spans=cfg.max_spans)
         self.registry: MetricsRegistry = get_registry()
         flight_dir = cfg.flight_dir or default_dir or "."
+        # collective flight recorder: launches recorded in the comm wrappers
+        # land here; the ring rides every flight dump
+        ring = int(getattr(cfg, "collective_ring", 0) or 0)
+        self.collectives = configure_collective_recorder(
+            enabled=ring > 0, max_records=ring or None)
         self.flight: Optional[FlightRecorder] = None
         if cfg.flight_steps > 0:
-            self.flight = FlightRecorder(self.tracer, flight_dir,
-                                         steps=cfg.flight_steps,
-                                         rank=self.rank)
+            self.flight = FlightRecorder(
+                self.tracer, flight_dir, steps=cfg.flight_steps,
+                rank=self.rank,
+                collectives=self.collectives if ring > 0 else None)
         self.server: Optional[MetricsServer] = None
         self._health_fn = None
+        # device-memory gauges: a sampler closure installed by attach_engine
+        # (the manager itself never imports jax); None = off or unavailable
+        self._mem_fn = None
+        self._mem_gauges = None
+        self._ledger = None
         self.phase_hist = self.registry.histogram(
             "dstpu_step_phase_seconds",
             "host-side duration of each step phase span")
@@ -96,13 +108,16 @@ class TelemetryManager:
                     metrics: Optional[Dict[str, Any]] = None) -> None:
         """Fold the step's spans into the phase histograms and the flight
         ring. Only host-resident values are recorded — this hook never
-        forces a device sync."""
+        forces a device sync (``memory_stats`` reads the allocator's
+        host-side counters)."""
         self.step_counter.inc()
+        mem = self.sample_memory()
         if self.flight is not None:
             # record_step drains the tracer; feed the histogram from the
             # recorded window so both views see the same spans
             window = self.flight.record_step(step, step_time_s=step_time_s,
-                                             metrics=metrics)["spans"]
+                                             metrics=metrics,
+                                             mem=mem)["spans"]
         else:
             window = self.tracer.drain()
             if self._trace_spans is not None:
@@ -110,19 +125,78 @@ class TelemetryManager:
         for s in window:
             self.phase_hist.observe(s["dur_ns"] / 1e9, phase=s["name"])
 
+    def sample_memory(self) -> Optional[Dict[str, Any]]:
+        """One host-side read of the device allocator gauges: the flight
+        ring gets the fleet-aggregate summary, the registry gets per-device
+        ``dstpu_mem_*`` series. Returns None when unavailable (CPU) — the
+        sampler self-disables after the first empty read."""
+        if self._mem_fn is None:
+            return None
+        try:
+            stats = self._mem_fn()
+        except Exception:
+            return None  # transient read failure: skip this step, keep
+        if not stats:     # sampling (a multi-day job must not lose its HBM
+            # history to one flaky read); only a backend that SUCCESSFULLY
+            # reports nothing (CPU) disables the sampler for good
+            self._mem_fn = None
+            return None
+        if self._mem_gauges is None:
+            self._mem_gauges = {
+                "in_use": self.registry.gauge(
+                    "dstpu_mem_bytes_in_use", "device HBM bytes in use"),
+                "peak": self.registry.gauge(
+                    "dstpu_mem_peak_bytes_in_use",
+                    "peak device HBM bytes in use"),
+                "limit": self.registry.gauge(
+                    "dstpu_mem_bytes_limit", "device HBM byte limit"),
+            }
+        in_use = peak = 0
+        limit = None
+        for idx, s in stats:
+            bi = int(s.get("bytes_in_use", 0))
+            pk = int(s.get("peak_bytes_in_use", bi))
+            lm = s.get("bytes_limit")
+            self._mem_gauges["in_use"].set(bi, device=str(idx))
+            self._mem_gauges["peak"].set(pk, device=str(idx))
+            if lm is not None:
+                self._mem_gauges["limit"].set(int(lm), device=str(idx))
+                limit = int(lm) if limit is None else min(limit, int(lm))
+            in_use = max(in_use, bi)
+            peak = max(peak, pk)
+        mem = {"bytes_in_use": in_use, "peak_bytes_in_use": peak}
+        if limit is not None:
+            mem["bytes_limit"] = limit
+        return mem
+
+    def record_memory_analysis(self, label: str,
+                               info: Dict[str, Any]) -> None:
+        """Surface one executable's compile-time memory breakdown (engine
+        ``memory_analysis()``) as ``dstpu_mem_exec_bytes{exec=,kind=}``
+        gauges; the comms ledger's plan table carries the same row."""
+        g = self.registry.gauge(
+            "dstpu_mem_exec_bytes",
+            "compile-time executable memory breakdown (memory_analysis)")
+        for kind in ("argument", "output", "temp", "generated_code"):
+            v = info.get(f"{kind}_size_in_bytes")
+            if v is not None:
+                g.set(float(v), exec=label, kind=kind)
+
     def count(self, event: str, amount: float = 1.0) -> None:
         self.res_counter.inc(amount, event=event)
 
     # -- wiring ----------------------------------------------------------
     def attach_engine(self, engine) -> None:
-        """Post-construction wiring: the comms-ledger bridge, the resilience
-        tier (flight dumps on watchdog expiry / rollback / drain), and the
-        health surface for /healthz."""
+        """Post-construction wiring: the comms-ledger bridge, the device
+        memory sampler, the resilience tier (flight dumps on watchdog
+        expiry / rollback / drain), and the health surface for /healthz."""
         from ..comm import get_comms_logger
 
-        ledger = get_comms_logger()
+        ledger = self._ledger = get_comms_logger()
         self.registry.register_collector(
             "comms_ledger", lambda: comms_ledger_samples(ledger))
+        if getattr(self.cfg, "memory", False):
+            self._mem_fn = device_memory_sampler()
         rz = getattr(engine, "resilience", None)
         if rz is not None:
             self.attach_resilience(rz)
@@ -130,10 +204,16 @@ class TelemetryManager:
     def attach_resilience(self, manager) -> None:
         manager._telemetry = self
         if self.flight is not None and manager.watchdog is not None:
-            flight = self.flight
+            # route through flight_dump (not flight.dump) so the plan table
+            # rides the watchdog post-mortem too — but with sample_mem off:
+            # the watchdog fires while the runtime is WEDGED, and a
+            # device.memory_stats() call from the monitor thread could
+            # block on the same stuck client and stall the exit-83 kill
             manager.watchdog.pre_dump = (
-                lambda: flight.dump("watchdog",
-                                    {"fired_step": manager.watchdog.fired_step}))
+                lambda: self.flight_dump(
+                    "watchdog",
+                    {"fired_step": manager.watchdog.fired_step},
+                    sample_mem=False))
         if manager.health is not None:
             # stash the health source so a server started LATER (manual
             # start_server after init) still serves real /healthz verdicts
@@ -142,13 +222,31 @@ class TelemetryManager:
                 self.server.health_fn = self._health_fn
 
     def flight_dump(self, reason: str,
-                    extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+                    extra: Optional[Dict[str, Any]] = None, *,
+                    sample_mem: bool = True) -> Optional[str]:
         """Exception-guarded: a failed dump (full disk, tracer churn) must
         never abort the recovery action — rollback, drain — it documents;
-        the watchdog path has the same guard around ``pre_dump``."""
+        the watchdog path has the same guard around ``pre_dump``.
+        ``sample_mem=False`` skips the live device-memory read — the
+        watchdog dump runs while the runtime is wedged and must stay on
+        the stdlib-only path (the ring's per-step ``mem`` history is
+        already in the dump)."""
         if self.flight is None:
             return None
         try:
+            extra = dict(extra or {})
+            # per-mesh facts ride every post-mortem: the resolved plan
+            # table (planner decisions + executable memory) lets the doctor
+            # check SPMD plan consistency across ranks
+            if self._ledger is not None and self._ledger.plan_records:
+                extra.setdefault("plan", dict(self._ledger.plan_records))
+            if (self._ledger is not None
+                    and getattr(self._ledger, "memory_records", None)):
+                extra.setdefault("exec_memory",
+                                 dict(self._ledger.memory_records))
+            mem = self.sample_memory() if sample_mem else None
+            if mem:
+                extra.setdefault("mem", mem)
             return self.flight.dump(reason, extra)
         except Exception as e:
             from ..utils.logging import logger
@@ -156,12 +254,38 @@ class TelemetryManager:
             logger.error(f"telemetry: flight dump ({reason}) failed: {e!r}")
             return None
 
+    def crash_dump(self, exc: BaseException) -> Optional[str]:
+        """The crash hook: an unhandled train-loop exception loses the
+        flight ring unless someone dumps it — the engine calls this before
+        re-raising. The dump meta carries the exception type and a bounded
+        traceback summary so the doctor can class the failure without the
+        stderr log."""
+        import traceback
+
+        tb = traceback.format_exception(type(exc), exc, exc.__traceback__)
+        return self.flight_dump("crash", {
+            "exception": type(exc).__name__,
+            "message": str(exc)[:500],
+            "traceback": "".join(tb)[-4000:],
+        })
+
+    @property
+    def prometheus_port(self) -> Optional[int]:
+        """The ACTUAL bound /metrics port (differs from the configured one
+        under ``prometheus_port: 0`` — ephemeral bind), or None."""
+        return self.server.port if self.server is not None else None
+
     def start_server(self, port: int, host: str = "127.0.0.1") -> int:
         """Serve /metrics (+/healthz) — the Prometheus surface beside the
-        heartbeat files the fleet already publishes. Bind failures are
-        logged, not raised: a fixed port shared across ranks (or held by a
-        stale process) must not take down engine bring-up — telemetry never
-        breaks the main path. Returns the bound port, or -1 on failure."""
+        heartbeat files the fleet already publishes. ``port=0`` binds an
+        ephemeral port (two engines on one host stop colliding); the bound
+        port is logged and readable via :attr:`prometheus_port`. Bind
+        failures are logged, not raised: a fixed port shared across ranks
+        (or held by a stale process) must not take down engine bring-up —
+        telemetry never breaks the main path. Returns the bound port, or
+        -1 on failure."""
+        from ..utils.logging import logger
+
         if self.server is not None:
             return self.server.port
         try:
@@ -169,13 +293,14 @@ class TelemetryManager:
                                    health_fn=self._health_fn)
             bound = server.start()
         except OSError as e:
-            from ..utils.logging import logger
-
             logger.warning(f"telemetry: metrics server failed to bind "
                            f"{host}:{port} ({e}); /metrics disabled on "
                            f"rank {self.rank}")
             return -1
         self.server = server
+        logger.info(f"telemetry: rank {self.rank} serving /metrics on "
+                    f"http://{host}:{bound}/metrics"
+                    + (" (ephemeral)" if int(port) == 0 else ""))
         return bound
 
     # -- export / teardown ----------------------------------------------
@@ -195,7 +320,8 @@ class TelemetryManager:
         elif self._trace_spans is not None:
             spans.extend(self._trace_spans)
         spans.extend(self.tracer.snapshot())
-        return export_chrome(path, spans, self.tracer.open_spans())
+        return export_chrome(path, spans, self.tracer.open_spans(),
+                             rank=self.rank)
 
     def close(self) -> None:
         global _ACTIVE
@@ -223,6 +349,7 @@ class TelemetryManager:
         global _OWNER
         if _OWNER is self:
             configure_tracer(enabled=False)
+            configure_collective_recorder(enabled=False)
             _ACTIVE = False
             _OWNER = None
 
@@ -230,6 +357,30 @@ class TelemetryManager:
 # ---------------------------------------------------------------------------
 # bridges: existing stateful sources -> pull-time registry samples
 # ---------------------------------------------------------------------------
+
+
+def device_memory_sampler():
+    """A closure reading every local device's allocator gauges
+    (``device.memory_stats()`` — host-side counters, no device sync).
+    Built by ``attach_engine`` (the only jax-touching path in this
+    module); returns ``[(device_index, stats_dict), ...]``, empty where
+    the backend reports nothing (CPU)."""
+    import jax
+
+    devs = jax.local_devices()
+
+    def sample():
+        out = []
+        for i, d in enumerate(devs):
+            try:
+                s = d.memory_stats()
+            except Exception:
+                s = None
+            if s:
+                out.append((i, s))
+        return out
+
+    return sample
 
 
 def comms_ledger_samples(ledger) -> List[Sample]:

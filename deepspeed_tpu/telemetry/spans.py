@@ -185,12 +185,24 @@ class SpanTracer:
 
 
 def chrome_trace(spans: List[Dict[str, Any]],
-                 open_spans: Optional[List[Dict[str, Any]]] = None) -> dict:
+                 open_spans: Optional[List[Dict[str, Any]]] = None,
+                 rank: Optional[int] = None) -> dict:
     """Span dicts -> a Chrome trace-event JSON object (``ph: "X"`` complete
     events, microsecond units). Open spans export with their live age as the
-    duration and an ``open: true`` arg."""
-    pid = os.getpid()
+    duration and an ``open: true`` arg.
+
+    With ``rank`` given, events are stamped ``pid=rank`` and
+    ``process_name``/``process_sort_index`` metadata events are emitted, so
+    per-rank exports concatenate into ONE Perfetto timeline (one process
+    lane per rank, in rank order) — ``python -m deepspeed_tpu.doctor
+    --merge-trace`` does exactly that."""
+    pid = os.getpid() if rank is None else int(rank)
     events = []
+    if rank is not None:
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": f"rank {int(rank)}"}})
+        events.append({"name": "process_sort_index", "ph": "M", "pid": pid,
+                       "args": {"sort_index": int(rank)}})
     for s in spans:
         args = dict(s.get("attrs") or {})
         if s.get("step") is not None:
@@ -211,13 +223,14 @@ def chrome_trace(spans: List[Dict[str, Any]],
 
 
 def export_chrome(path: str, spans: List[Dict[str, Any]],
-                  open_spans: Optional[List[Dict[str, Any]]] = None) -> str:
+                  open_spans: Optional[List[Dict[str, Any]]] = None,
+                  rank: Optional[int] = None) -> str:
     """Write a Chrome-trace JSON file; returns the path."""
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "w") as f:
-            json.dump(chrome_trace(spans, open_spans), f)
+            json.dump(chrome_trace(spans, open_spans, rank=rank), f)
         os.replace(tmp, path)
     except BaseException:
         try:

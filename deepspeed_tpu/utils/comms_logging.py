@@ -60,6 +60,10 @@ class CommsLogger:
         # site signature -> planner decision info (comm/planner): per-mesh
         # facts, not per-step counters — reset() deliberately keeps them
         self.plan_records: Dict[str, Dict[str, Any]] = {}
+        # executable label -> compile-time memory_analysis breakdown
+        # (runtime/engine records these when a step compiles); per-program
+        # facts like plan_records, so reset() keeps them too
+        self.memory_records: Dict[str, Dict[str, Any]] = {}
 
     def configure(self, enabled=None, verbose=None, prof_all=None, prof_ops=None, debug=None):
         if enabled is not None:
@@ -108,11 +112,39 @@ class CommsLogger:
         ``reset()`` (the plan is per-topology, not per-step)."""
         self.plan_records[signature] = dict(info)
 
-    def plan_table_lines(self) -> List[str]:
-        """The resolved-plan table (one row per site), empty when no
-        planner decision has been recorded."""
-        if not self.plan_records:
+    def record_memory(self, label: str, info: Dict[str, Any]) -> None:
+        """Record one compiled executable's ``memory_analysis()`` breakdown
+        (arg/output/temp/generated bytes) under a stable label — surfaced
+        in the plan table and carried into flight dumps, so a post-mortem
+        knows what the program *needed*, not just what the allocator held."""
+        self.memory_records[label] = dict(info)
+
+    def memory_table_lines(self) -> List[str]:
+        """The executable-memory table (one row per compiled step), empty
+        when nothing has been recorded."""
+        if not self.memory_records:
             return []
+        header = (f"{'Executable':<24}{'Args(MB)':<11}{'Out(MB)':<10}"
+                  f"{'Temp(MB)':<11}{'Code(KB)':<10}")
+        lines = ["Executable memory (memory_analysis):", header,
+                 "-" * len(header)]
+        mb = 1024 * 1024
+        for label in sorted(self.memory_records):
+            r = self.memory_records[label]
+            lines.append(
+                f"{label:<24}"
+                f"{r.get('argument_size_in_bytes', 0) / mb:<11.1f}"
+                f"{r.get('output_size_in_bytes', 0) / mb:<10.1f}"
+                f"{r.get('temp_size_in_bytes', 0) / mb:<11.1f}"
+                f"{r.get('generated_code_size_in_bytes', 0) / 1024:<10.1f}")
+        return lines
+
+    def plan_table_lines(self) -> List[str]:
+        """The resolved-plan table (one row per site, plus the executable
+        memory rows when a compiled step recorded its breakdown), empty
+        when no planner decision has been recorded."""
+        if not self.plan_records:
+            return self.memory_table_lines()
         header = (f"{'Consumer':<12}{'Op':<16}{'Shape':<18}"
                   f"{'Axes':<16}{'Impl':<14}{'Block':<8}{'Source':<12}"
                   f"{'Est(us)':<10}")
@@ -126,6 +158,9 @@ class CommsLogger:
                 f"{r.get('source', '?'):<12}"
                 f"{str(r.get('est_us') if r.get('est_us') is not None else '-'):<10}"
                 + (f" {r['program']}" if r.get("program") else ""))
+        mem = self.memory_table_lines()
+        if mem:
+            lines += [""] + mem
         return lines
 
     def monitor_events(self, step: int, prefix: str = "Train/Comms"):

@@ -40,9 +40,13 @@ def _reset_fleet_telemetry():
     yield
     configure_tracer(enabled=False)
     get_tracer().clear()
-    from deepspeed_tpu.telemetry import reset_registry
+    from deepspeed_tpu.telemetry import (configure_collective_recorder,
+                                         get_collective_recorder,
+                                         reset_registry)
     from deepspeed_tpu.telemetry import manager as _mgr
 
+    configure_collective_recorder(enabled=False)
+    get_collective_recorder().clear()
     reset_registry()
     _mgr._ACTIVE = False
     _mgr._OWNER = None
@@ -513,6 +517,211 @@ def test_watchdog_exit83_drill_writes_flightdump(tmp_path):
     assert doc["steps"][-1]["spans"]
     # the PR 5 hangdump rides beside it unchanged
     assert (tmp_path / "hangdump-0.txt").exists()
+
+
+def test_crash_hook_dumps_flight_record(tmp_path):
+    """Satellite: an unhandled train-loop exception leaves a
+    reason="crash" flightdump (exception type + traceback summary) before
+    re-raising — with or without the resilience tier armed. (Rides the
+    same engine: on CPU memory_stats() is None, so no dstpu_mem_* series
+    and no mem in ring entries — and no crash.)"""
+    e = _engine({"telemetry": {"enabled": True, "flight_steps": 8,
+                               "flight_dir": str(tmp_path)}})
+    good = random_batches(1, 8, HIDDEN)[0]
+    e.train_batch(good)
+    assert all("mem" not in s for s in e.telemetry.flight.steps())
+    assert "dstpu_mem_bytes_in_use" not in e.telemetry.registry.exposition()
+    # feature dim off by one: the loss matmul fails at trace time — an
+    # unhandled exception inside the step body
+    bad = {"x": np.zeros((8, HIDDEN + 1), np.float32),
+           "y": np.zeros((8, 1), np.float32)}
+    with pytest.raises(Exception) as excinfo:
+        e.train_batch(bad)
+    doc = json.loads((tmp_path / "flightdump-0.json").read_text())
+    assert doc["reason"] == "crash"
+    assert doc["exception"] == type(excinfo.value).__name__
+    assert doc["message"]
+    assert "Traceback" in doc["traceback"]
+    assert doc["steps"]                 # the completed step survived
+    # the routine epoch-end StopIteration is NOT a crash: no fresh dump
+    os.unlink(tmp_path / "flightdump-0.json")
+    with pytest.raises(StopIteration):
+        e.train_batch(data_iter=iter([]))
+    assert not (tmp_path / "flightdump-0.json").exists()
+    e.telemetry.close()
+
+
+def test_chrome_trace_rank_pid_and_process_metadata():
+    """Satellite: rank-stamped exports carry pid=rank plus process_name /
+    process_sort_index metadata so multi-rank traces merge into one
+    Perfetto timeline."""
+    doc = chrome_trace([{"name": "step", "t0_ns": 0, "dur_ns": 1000,
+                         "depth": 0, "tid": 1, "step": 0}], rank=3)
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {m["name"] for m in metas} == {"process_name",
+                                          "process_sort_index"}
+    assert all(m["pid"] == 3 for m in metas)
+    assert metas[0]["args"]["name"] == "rank 3"
+    (span_ev,) = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert span_ev["pid"] == 3
+    # rank-less exports keep the old behavior: os pid, no metadata
+    doc2 = chrome_trace([{"name": "x", "t0_ns": 0, "dur_ns": 1, "depth": 0,
+                          "tid": 1, "step": None}])
+    assert all(e["ph"] != "M" for e in doc2["traceEvents"])
+    assert doc2["traceEvents"][0]["pid"] == os.getpid()
+
+
+def test_prometheus_port_zero_is_ephemeral_per_engine():
+    """Satellite: prometheus_port: 0 binds an ephemeral port per manager —
+    two engines on one host stop colliding — and the bound port is exposed
+    via the prometheus_port attribute."""
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    from deepspeed_tpu.telemetry import TelemetryManager
+
+    a = TelemetryManager(TelemetryConfig(enabled=True, flight_steps=0,
+                                         prometheus_port=0))
+    b = TelemetryManager(TelemetryConfig(enabled=True, flight_steps=0,
+                                         prometheus_port=0))
+    try:
+        assert a.server is not None and b.server is not None
+        assert a.prometheus_port > 0 and b.prometheus_port > 0
+        assert a.prometheus_port != b.prometheus_port
+        for tm in (a, b):
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{tm.prometheus_port}/metrics",
+                timeout=5).read().decode()
+            assert "dstpu_steps_total" in body
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# device-memory telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_memory_sampler_folds_into_ring_and_gauges(tmp_path):
+    """A fake memory_stats source: per-device gauges land in the registry,
+    the fleet aggregate rides each flight-ring entry, and the sampler
+    self-disables once the backend reports nothing."""
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    from deepspeed_tpu.telemetry import TelemetryManager
+
+    tm = TelemetryManager(TelemetryConfig(enabled=True, flight_steps=4,
+                                          flight_dir=str(tmp_path)))
+    try:
+        tm._mem_fn = lambda: [
+            (0, {"bytes_in_use": 100, "peak_bytes_in_use": 150,
+                 "bytes_limit": 1000}),
+            (1, {"bytes_in_use": 200, "peak_bytes_in_use": 250,
+                 "bytes_limit": 1000})]
+        tm.on_step_end(0, step_time_s=0.01)
+        entry = tm.flight.steps()[-1]
+        assert entry["mem"] == {"bytes_in_use": 200,
+                                "peak_bytes_in_use": 250,
+                                "bytes_limit": 1000}
+        text = tm.registry.exposition()
+        assert 'dstpu_mem_bytes_in_use{device="0"} 100' in text
+        assert 'dstpu_mem_bytes_in_use{device="1"} 200' in text
+        assert 'dstpu_mem_peak_bytes_in_use{device="1"} 250' in text
+        assert 'dstpu_mem_bytes_limit{device="0"} 1000' in text
+        # the dump carries a fresh sample in its meta
+        doc = json.load(open(tm.flight_dump("unit")))
+        assert doc["mem"]["bytes_in_use"] == 200
+        # a TRANSIENT read failure skips the step but keeps the sampler —
+        # one flaky read must not end a multi-day job's HBM history
+        def boom():
+            raise RuntimeError("transient PJRT read failure")
+
+        tm._mem_fn = boom
+        tm.on_step_end(1)
+        assert tm._mem_fn is boom
+        assert "mem" not in tm.flight.steps()[-1]
+        # backend SUCCESSFULLY reports nothing -> sampler disables itself
+        tm._mem_fn = lambda: []
+        tm.on_step_end(2)
+        assert tm._mem_fn is None
+        assert "mem" not in tm.flight.steps()[-1]
+    finally:
+        tm.close()
+
+
+def test_watchdog_pre_dump_never_samples_device_memory(tmp_path):
+    """The watchdog fires while the runtime is WEDGED: its flight dump
+    must not read device.memory_stats() (a blocked client would stall the
+    exit-83 kill). The ring's per-step mem history still rides the dump."""
+    from types import SimpleNamespace
+
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    from deepspeed_tpu.telemetry import TelemetryManager
+
+    tm = TelemetryManager(TelemetryConfig(enabled=True, flight_steps=4,
+                                          flight_dir=str(tmp_path)))
+    try:
+        calls = {"n": 0}
+
+        def sampler():
+            calls["n"] += 1
+            return [(0, {"bytes_in_use": 7, "peak_bytes_in_use": 9})]
+
+        tm._mem_fn = sampler
+        tm.on_step_end(0)                      # per-step sampling works
+        assert calls["n"] == 1
+        rz = SimpleNamespace(watchdog=SimpleNamespace(pre_dump=None,
+                                                      fired_step=0),
+                             health=None)
+        tm.attach_resilience(rz)
+        path = rz.watchdog.pre_dump()          # the wedged-path dump
+        assert calls["n"] == 1                 # NOT sampled live
+        doc = json.loads(open(path).read())
+        assert "mem" not in doc                # no live sample in the meta
+        assert doc["steps"][-1]["mem"]["bytes_in_use"] == 7  # history rides
+        # the other dump reasons still take a live sample
+        doc2 = json.loads(open(tm.flight_dump("rollback")).read())
+        assert calls["n"] == 2 and doc2["mem"]["bytes_in_use"] == 7
+    finally:
+        tm.close()
+
+
+def test_memory_analysis_recorded_and_bitwise_identical(tmp_path):
+    """telemetry.memory_analysis AOT-measures each step variant: the
+    breakdown lands in the comms ledger's plan table + registry, and the
+    measured executable steps BITWISE identically to the plain jit path.
+    (engine.compile() records the same breakdown with NO telemetry —
+    checked on the plain engine.)"""
+    from deepspeed_tpu.comm import get_comms_logger
+
+    get_comms_logger().memory_records.clear()
+    batches = random_batches(3, 8, HIDDEN)
+    e_plain = _engine({})
+    e_plain.compile(batches[0])  # AOT path: plan-table fact, telemetry-free
+    assert "train_step" in get_comms_logger().memory_records
+    get_comms_logger().memory_records.clear()
+    e_mem = _engine({"telemetry": {"enabled": True, "flight_steps": 4,
+                                   "flight_dir": str(tmp_path),
+                                   "memory_analysis": True}})
+    for b in batches:
+        l0 = float(np.asarray(e_plain.train_batch(b)))
+        l1 = float(np.asarray(e_mem.train_batch(b)))
+        assert l0 == l1                     # bitwise, not allclose
+    recs = get_comms_logger().memory_records
+    assert "train_step" in recs
+    info = recs["train_step"]
+    assert info["argument_size_in_bytes"] > 0
+    assert "temp_size_in_bytes" in info
+    # one executable, measured once, reused across the steps
+    assert len(e_mem._mem_execs) == 1
+    text = e_mem.telemetry.registry.exposition()
+    assert 'dstpu_mem_exec_bytes{exec="train_step",kind="argument"}' in text
+    # the plan table surfaces the executable-memory rows
+    lines = get_comms_logger().plan_table_lines()
+    assert any("Executable memory" in ln for ln in lines)
+    assert any("train_step" in ln for ln in lines)
+    # and flight dumps carry the breakdown for the doctor
+    doc = json.load(open(e_mem.telemetry.flight_dump("unit")))
+    assert doc["exec_memory"]["train_step"] == info
+    e_mem.telemetry.close()
 
 
 # ---------------------------------------------------------------------------
