@@ -1429,6 +1429,116 @@ def memory_telemetry_bench():
             "device": jax.devices()[0].platform}
 
 
+def static_audit_bench():
+    """Rung sa (static graph auditor, deepspeed_tpu/analysis/): the
+    auditor's own wall-time, since the compile-time hook rides every
+    ``engine.compile()`` when enabled — (1) a full four-check audit of the
+    engine's compiled train step (trace reuse + HLO walk + reconciliation
+    against the ledger), and (2) of the fused serving decode step
+    (``inference/v2 decode_loop``, the scanned whole-model program — the
+    deepest jaxpr the repo stages). Programs are staged/compiled ONCE
+    outside the timed region; each rep pays what the hook pays: lower +
+    jaxpr checks + HLO parse + reconciliation. Gate direction:
+    lower-is-better on the train-step audit (an auditor that starts
+    re-compiling or quadratic-walking must fail CI). Findings counts ride
+    along — the clean train step must stay at zero errors."""
+    import deepspeed_tpu as ds
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.analysis import AuditOptions, audit_step
+
+    dim, batch = 256, 64
+    rng = np.random.default_rng(0)
+    params = {"w1": jnp.asarray(rng.normal(0, 0.05, (dim, 4 * dim)),
+                                jnp.float32),
+              "w2": jnp.asarray(rng.normal(0, 0.05, (4 * dim, dim)),
+                                jnp.float32),
+              "w3": jnp.asarray(rng.normal(0, 0.05, (dim, 10)), jnp.float32)}
+
+    def loss_fn(p, b, rng=None):
+        h = jnp.tanh(jnp.tanh(b["x"] @ p["w1"]) @ p["w2"])
+        logits = h @ p["w3"]
+        return jnp.mean(jax.nn.logsumexp(logits, -1)
+                        - jnp.take_along_axis(logits, b["y"][:, None],
+                                              1)[:, 0])
+
+    engine, *_ = ds.initialize(
+        model=loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": batch,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "steps_per_print": 10**9})
+    b = engine._shape_batch(
+        {"x": jnp.asarray(rng.normal(size=(batch, dim)), jnp.float32),
+         "y": jnp.asarray(rng.integers(0, 10, batch), jnp.int32)})
+    step_rng = jax.random.PRNGKey(0)
+    traced = engine._train_step.trace(engine.state, b, step_rng)
+    exe = traced.lower().compile()  # staged once; the hook reuses it too
+    ledger = dist.get_comms_logger()
+    axis_sizes = {str(k): int(v)
+                  for k, v in dict(engine.topo.mesh.shape).items()}
+
+    def one_train_audit():
+        return audit_step(traced, compiled=exe, label="train_step",
+                          options=AuditOptions(), axis_sizes=axis_sizes,
+                          plan_records=ledger.plan_records, ledger=ledger)
+
+    rep = one_train_audit()
+    best_train = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        one_train_audit()
+        best_train = min(best_train, time.perf_counter() - t0)
+
+    # the serving decode step: the scanned fused decode program
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.model import decode_loop
+    from deepspeed_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=128, hidden_size=64,
+                            intermediate_size=128, num_layers=2, num_heads=4,
+                            num_kv_heads=2, max_seq_len=128,
+                            dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    mp = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    v2 = InferenceEngineV2(model, mp, RaggedInferenceEngineConfig(
+        token_budget=16, max_ragged_sequence_count=4, max_chunk_size=8,
+        num_kv_blocks=32, kv_block_size=8, max_blocks_per_seq=8,
+        dtype="float32"))
+    kv_k, kv_v = v2.kv.pool_args()
+    S, B = 4, 8
+    dec_args = (v2.params, v2.cfg, kv_k, kv_v,
+                jnp.zeros((S,), jnp.int32), jnp.ones((S,), jnp.int32),
+                jnp.zeros((S, B), jnp.int32), jnp.ones((S,), bool),
+                jax.random.PRNGKey(1), jnp.float32(1.0))
+    dec_kw = dict(n_steps=8, attn_impl="einsum", greedy=True)
+    dec_traced = decode_loop.trace(*dec_args, **dec_kw)
+    dec_exe = dec_traced.lower().compile()
+
+    def one_decode_audit():
+        return audit_step(dec_traced, compiled=dec_exe, label="decode_step",
+                          options=AuditOptions())
+
+    dec_rep = one_decode_audit()
+    best_dec = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        one_decode_audit()
+        best_dec = min(best_dec, time.perf_counter() - t0)
+
+    return {"metric": "static_audit_train_ms",
+            "value": round(best_train * 1e3, 2), "unit": "ms/audit",
+            "vs_baseline": None,
+            "audit_decode_ms": round(best_dec * 1e3, 2),
+            "train_findings": rep.counts(),
+            "train_hlo_collectives": rep.context.get("hlo_collectives"),
+            "train_unplanned": rep.context.get("unplanned_collectives"),
+            "decode_findings": dec_rep.counts(),
+            "decode_hlo_collectives": dec_rep.context.get("hlo_collectives"),
+            "decode_unplanned": dec_rep.context.get("unplanned_collectives"),
+            "device": jax.devices()[0].platform}
+
+
 RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "3b": rung3b_big_model,
          "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses,
@@ -1436,7 +1546,8 @@ RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "plan": planner_bench, "rz": resilience_bench,
          "wd": watchdog_bench, "fl": fused_hotpath_bench,
          "sv": serving_bench, "ds": dcn_hierarchical_bench,
-         "ob": telemetry_bench, "mem": memory_telemetry_bench}
+         "ob": telemetry_bench, "mem": memory_telemetry_bench,
+         "sa": static_audit_bench}
 
 
 # ---------------------------------------------------------------------------
@@ -1457,6 +1568,7 @@ GATE_SPECS = {
     "watchdog_arm_disarm_us": ("lower", 1.0),
     "telemetry_span_overhead_ns": ("lower", 1.0),
     "collective_ring_overhead_ns": ("lower", 1.0),
+    "static_audit_train_ms": ("lower", 1.0),     # host walk: wall-clock noise
     "dcn_hierarchical": ("higher", 0.05),        # ledger bytes: deterministic
     "llama_zero3_bf16_mfu": ("higher", 0.15),    # the TPU headline: tight
 }
@@ -1591,7 +1703,10 @@ def run_ladder(gate: bool = False):
             ("ds", cpu8), ("ob", cpu1),
             # mem measures the recorder/gauge costs; real HBM numbers ride
             # when the chip is healthy, the CPU path measures the host side
-            ("mem", chip)]
+            ("mem", chip),
+            # sa times the static auditor itself (host-side HLO/jaxpr
+            # walks — device-independent, one CPU process is the substrate)
+            ("sa", cpu1)]
     results = []
     for rung, env_over in plan:
         env = dict(os.environ)

@@ -1,0 +1,42 @@
+"""Static analysis of the compiled step — audit before you run.
+
+``deepspeed_tpu.analysis`` walks the *staged* train/serve step (jaxpr +
+post-SPMD HLO; trace/lower/compile on the host, never a device step) and
+names the defects that otherwise surface as mystery DCN bytes, fp32-speed
+bf16 runs, or doubled peak memory:
+
+- :func:`audit_step` — the four-check auditor (collective reconciliation
+  against the planner/ledger/jaxpr, precision-leak detection, donation
+  audit, host-sync hazards); returns an :class:`AuditReport`.
+- :mod:`~deepspeed_tpu.analysis.jaxpr_walk` — the one shared jaxpr
+  visitor (sub-jaxpr enumeration, trip-count multipliers, scope
+  tracking); ``module_inject/auto_tp.py`` and
+  ``profiling/flops_profiler.py`` walk through it too.
+- :mod:`~deepspeed_tpu.analysis.lint` — the repo-invariant AST linter
+  tier-1 runs (``tests/unit/test_lint.py``).
+
+CLI: ``python -m deepspeed_tpu.audit`` (exit 2 on findings at/above the
+threshold — the doctor's convention).  Engine hook: the ``analysis:``
+config block runs the audit at ``engine.compile()`` time.  Docs:
+``docs/static_analysis.md``.
+"""
+
+from .auditor import (AuditOptions, ExpectedSite, audit_compiled_text,
+                      audit_step, jaxpr_collectives, ledger_expected_sites,
+                      plan_expected_sites)
+from .hlo import HloCollective, parse_collectives
+from .jaxpr_walk import (HANDLED, SubJaxpr, WalkContext, is_var, iter_eqns,
+                         subjaxprs, walk)
+from .lint import LintFinding, lint_paths, lint_source
+from .report import (CHECKS, EXIT_CLEAN, EXIT_FINDINGS, REPORT_NAME,
+                     SEVERITIES, AuditReport, Finding)
+
+__all__ = [
+    "AuditOptions", "AuditReport", "CHECKS", "EXIT_CLEAN", "EXIT_FINDINGS",
+    "ExpectedSite", "Finding", "HANDLED", "HloCollective", "LintFinding",
+    "REPORT_NAME", "SEVERITIES", "SubJaxpr", "WalkContext",
+    "audit_compiled_text", "audit_step", "is_var", "iter_eqns",
+    "jaxpr_collectives", "ledger_expected_sites", "lint_paths",
+    "lint_source", "parse_collectives", "plan_expected_sites", "subjaxprs",
+    "walk",
+]

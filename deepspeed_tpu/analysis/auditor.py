@@ -1,0 +1,631 @@
+"""The graph auditor: static pre-flight analysis of a compiled step.
+
+Four checks, none of which executes a device step:
+
+1. **Collective reconciliation** — every collective in the compiled HLO
+   (``analysis/hlo.py``) is matched against what the program *asked for*:
+   the jaxpr's explicit collective equations (``psum``/``all_gather``/
+   ``ppermute``/...), the planner's plan table (``comm/planner``), and the
+   comms ledger's recorded sites.  Author-annotated reshards
+   (``with_sharding_constraint``) match too.  What's left is what GSPMD
+   inserted on its own.  Gather-class leftovers (all-gather /
+   collective-permute / all-to-all) are the *implicit resharding*
+   signature — a PartitionSpec that doesn't line up with how an op
+   consumes its operand — and escalate with payload size.  Reduction-class
+   leftovers also arise from legitimate semantics (a mean over a sharded
+   batch axis needs an all-reduce), so they stay ``info`` unless
+   ``strict``.
+2. **Precision leaks** — ``convert_element_type`` upcasts (bf16/f16/int8 →
+   f32) whose value flows into FLOP-heavy ops (``dot_general``/conv) or
+   escapes to a large f32 output.  Upcasts that stay inside the blessed
+   accumulation shapes (reduce in f32, elementwise then cast back down —
+   the master-weight update) are allowed.
+3. **Donation audit** — large non-donated inputs whose (shape, dtype) also
+   appears among the outputs: XLA could have aliased the buffer but the
+   caller didn't let it, so peak memory carries both copies.
+4. **Host-sync / retrace hazards** — host callbacks compiled into the step
+   (every step pays a host round-trip), host-memory transfers, and
+   weak-typed scalar arguments (each distinct Python value compiles a new
+   program).
+
+Everything is trace/compile-time only: ``jax.jit(...).trace()`` +
+``lower()`` + ``compile()`` on the host.  See ``docs/static_analysis.md``
+for the finding taxonomy and the reconciliation contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .hlo import (GATHER_CLASS, HloCollective, compiled_text, guess_axes,
+                  parse_collectives)
+from .jaxpr_walk import (collect_consumers, is_var, join_scope, shape_of,
+                         source_frames, source_location, subjaxprs, walk)
+from .report import AuditReport
+
+# jaxpr collective primitive -> canonical HLO-side kind
+JAXPR_COLLECTIVES = {
+    "psum": "all_reduce", "pmax": "all_reduce", "pmin": "all_reduce",
+    "all_gather": "all_gather", "all_gather_invariant": "all_gather",
+    "reduce_scatter": "reduce_scatter", "psum_scatter": "reduce_scatter",
+    "all_to_all": "all_to_all",
+    "ppermute": "collective_permute", "pshuffle": "collective_permute",
+    "pbroadcast": "collective_broadcast",
+}
+
+# plan-table op -> HLO kinds that implementation family may legitimately
+# emit (a ring all_gather lowers to collective-permute hops; a program
+# decision's phases are expanded separately)
+PLAN_OP_KINDS = {
+    "all_reduce": ("all_reduce", "reduce_scatter", "all_gather"),
+    "all_gather": ("all_gather", "collective_permute"),
+    "reduce_scatter": ("reduce_scatter", "collective_permute"),
+    "all_to_all": ("all_to_all",),
+    "gather_matmul": ("all_gather", "collective_permute", "reduce_scatter"),
+    "embed_gather": ("all_gather", "collective_permute"),
+}
+
+_HEAVY_CONSUMERS = ("dot_general", "conv_general_dilated")
+_REDUCING_CONSUMERS = ("reduce_sum", "reduce_prod", "reduce_max",
+                       "reduce_min", "reduce_and", "reduce_or", "argmax",
+                       "argmin", "cumsum", "cumlogsumexp", "cummax",
+                       "cummin")
+_NARROW_FLOATS = ("bfloat16", "float16", "float8_e4m3fn", "float8_e5m2")
+_UPCAST_SOURCES = _NARROW_FLOATS + ("int8", "uint8")
+
+
+@dataclasses.dataclass
+class AuditOptions:
+    """Thresholds and allow-lists (the ``analysis:`` config block maps
+    onto this; docs/static_analysis.md documents each knob)."""
+    #: gather-class unplanned collective below this: info
+    small_bytes: int = 64 << 10
+    #: gather-class unplanned collective at/above this: error
+    big_bytes: int = 1 << 20
+    #: upcasts of fewer elements are scalar accumulators, never reported
+    precision_min_elems: int = 4096
+    #: upcasts at/above this element count escalate warning -> error
+    precision_big_elems: int = 1 << 20
+    #: non-donated aliasable inputs below this are not worth a finding
+    donation_min_bytes: int = 1 << 20
+    #: regexes matched against an HLO collective's metadata op_name/source;
+    #: a hit marks it planned (the annotation escape hatch)
+    collective_allowlist: Tuple[str, ...] = ()
+    #: regexes matched against the named-scope path of an upcast site
+    precision_allowlist: Tuple[str, ...] = ()
+    #: strict mode: unmatched reduction-class collectives become warnings
+    #: (default info — partitioner-inserted DP-mean psums are legitimate)
+    strict: bool = False
+
+
+# ---------------------------------------------------------------------------
+# jaxpr-side facts
+# ---------------------------------------------------------------------------
+
+
+def _eqn_axes(eqn) -> Tuple[str, ...]:
+    ax = eqn.params.get("axes")
+    if ax is None:
+        ax = eqn.params.get("axis_name")
+    if ax is None:
+        return ()
+    if not isinstance(ax, (tuple, list)):
+        ax = (ax,)
+    return tuple(str(a) for a in ax if isinstance(a, str))
+
+
+def _axes_span(axes: Sequence[str],
+               axis_sizes: Optional[Dict[str, int]]) -> Optional[int]:
+    if not axes or not axis_sizes:
+        return None
+    span = 1
+    for a in axes:
+        if a not in axis_sizes:
+            return None
+        span *= int(axis_sizes[a])
+    return span
+
+
+@dataclasses.dataclass
+class ExpectedSite:
+    """One collective the program asked for (jaxpr / plan / ledger)."""
+    kind: str
+    span: Optional[int]        # replica-group span; None = any
+    origin: str                # 'jaxpr' | 'plan' | 'ledger'
+    detail: str = ""
+
+    def matches(self, c: HloCollective) -> bool:
+        if c.kind != self.kind:
+            return False
+        if self.span is None or c.group_size is None:
+            return True
+        return c.group_size == self.span
+
+
+def jaxpr_collectives(jaxpr, axis_sizes=None) -> List[ExpectedSite]:
+    """Explicit collective equations anywhere in the nested program."""
+    sites: List[ExpectedSite] = []
+
+    def visit(eqn, ctx):
+        kind = JAXPR_COLLECTIVES.get(eqn.primitive.name)
+        if kind is not None:
+            axes = _eqn_axes(eqn)
+            sites.append(ExpectedSite(
+                kind=kind, span=_axes_span(axes, axis_sizes),
+                origin="jaxpr",
+                detail=f"{eqn.primitive.name}@{','.join(axes) or '?'}"))
+
+    walk(jaxpr, visit)
+    return sites
+
+
+def plan_expected_sites(plan_records: Dict[str, Dict[str, Any]],
+                        axis_sizes=None) -> List[ExpectedSite]:
+    """Expected sites from the planner's plan table
+    (``CommsLogger.plan_records`` rows, see ``comm/planner``)."""
+    sites: List[ExpectedSite] = []
+    for sig, rec in (plan_records or {}).items():
+        op = rec.get("op")
+        axes = tuple(a for a in str(rec.get("axes", "")).split(",") if a)
+        span = _axes_span(axes, axis_sizes)
+        for kind in PLAN_OP_KINDS.get(op, ()):
+            sites.append(ExpectedSite(kind=kind, span=span, origin="plan",
+                                      detail=sig))
+        prog = rec.get("program")
+        if prog:
+            # program summaries look like rs(ep)>ar.int8_ef(dp_outer)>ag(ep)
+            for phase in str(prog).split(">"):
+                m = re.match(r"(rs|ar|ag)[^(]*\(([^)]*)\)", phase)
+                if not m:
+                    continue
+                kind = {"rs": "reduce_scatter", "ar": "all_reduce",
+                        "ag": "all_gather"}[m.group(1)]
+                ph_axes = tuple(a for a in m.group(2).split(",") if a)
+                for k in PLAN_OP_KINDS[kind]:
+                    sites.append(ExpectedSite(
+                        kind=k, span=_axes_span(ph_axes, axis_sizes),
+                        origin="plan", detail=f"{sig}:{phase}"))
+    return sites
+
+
+_LEDGER_KINDS = (
+    ("all_to_all", ("all_to_all",)),
+    ("all_gather", ("all_gather", "collective_permute")),
+    ("reduce_scatter", ("reduce_scatter", "collective_permute")),
+    # a plain all-reduce row expects ONLY all-reduces: ledger sites match
+    # any span (the row records no axes), so widening the family here
+    # would let e.g. the DP grad reduce mask a genuine resharding
+    # all-gather.  The two-level lowerings that really do emit rs/ag name
+    # themselves (hierarchical/program rows are handled below).
+    ("all_reduce", ("all_reduce",)),
+    ("ppermute", ("collective_permute",)),
+    ("embed", ("all_gather", "collective_permute")),
+    ("ring", ("collective_permute",)),
+)
+# op-name tokens whose implementation lowers an all-reduce into
+# reduce-scatter + all-gather phases (comm/compressed.py hierarchical and
+# program transports) — only these widen the expected family
+_TWO_LEVEL_TOKENS = ("hierarchical", "program", "chunked")
+
+
+def ledger_expected_sites(ledger) -> List[ExpectedSite]:
+    """Expected sites from the comms ledger's per-op traffic rows — the
+    wrappers record every facade collective at trace time, so the op-name
+    vocabulary names what should appear in the compiled program."""
+    sites: List[ExpectedSite] = []
+    ops = getattr(ledger, "comms_dict", None) or {}
+    for op_name in ops:
+        low = op_name.lower()
+        for token, kinds in _LEDGER_KINDS:
+            if token in low:
+                if (token == "all_reduce"
+                        and any(t in low for t in _TWO_LEVEL_TOKENS)):
+                    kinds = ("all_reduce", "reduce_scatter", "all_gather")
+                for k in kinds:
+                    sites.append(ExpectedSite(kind=k, span=None,
+                                              origin="ledger",
+                                              detail=op_name))
+                break
+    return sites
+
+
+# ---------------------------------------------------------------------------
+# check 1: collective reconciliation
+# ---------------------------------------------------------------------------
+
+
+def reconcile_collectives(report: AuditReport,
+                          hlo_cols: List[HloCollective],
+                          expected: List[ExpectedSite],
+                          axis_sizes: Optional[Dict[str, int]],
+                          opts: AuditOptions) -> None:
+    allow = [re.compile(p) for p in opts.collective_allowlist]
+    matched = 0
+    unplanned = reductions = 0
+    for c in hlo_cols:
+        meta = f"{c.op_name or ''} {c.source or ''}"
+        if "sharding_constraint" in meta:
+            matched += 1  # author-annotated reshard: explicitly requested
+            continue
+        if any(p.search(meta) for p in allow):
+            matched += 1
+            continue
+        hit = next((e for e in expected if e.matches(c)), None)
+        if hit is not None:
+            matched += 1
+            continue
+        axes = c.axes_guess(axis_sizes or {})
+        shape_s = ", ".join(
+            f"{dt}[{'x'.join(map(str, sh)) or 'scalar'}]"
+            for dt, sh in c.result_shapes) or "?"
+        where = c.op_name or c.source or c.hlo_op
+        if c.kind in GATHER_CLASS:
+            unplanned += 1
+            sev = ("error" if c.nbytes >= opts.big_bytes else
+                   "warning" if c.nbytes >= opts.small_bytes else "info")
+            report.add(
+                "collective", sev,
+                f"implicit resharding: XLA inserted {c.hlo_op} of "
+                f"{shape_s} over {axes or f'{c.group_size} ranks'} "
+                f"({c.nbytes} B) with no matching plan/jaxpr site — "
+                f"check the PartitionSpec feeding {where}",
+                kind=c.kind, shape=shape_s, axes=axes,
+                group_size=c.group_size, nbytes=c.nbytes,
+                op_name=c.op_name, source=c.source)
+        else:
+            reductions += 1
+            sev = "warning" if opts.strict else "info"
+            report.add(
+                "collective", sev,
+                f"unplanned {c.hlo_op} of {shape_s} over "
+                f"{axes or f'{c.group_size} ranks'} ({c.nbytes} B) — "
+                f"partitioner-inserted reduction (legitimate for DP "
+                f"means; verify it was priced)",
+                kind=c.kind, shape=shape_s, axes=axes,
+                group_size=c.group_size, nbytes=c.nbytes,
+                op_name=c.op_name, source=c.source)
+    report.context["hlo_collectives"] = len(hlo_cols)
+    report.context["matched_collectives"] = matched
+    # "unplanned" is the resharding signature: unmatched GATHER-class ops.
+    # Unmatched reductions are bucketed separately — a mean over a sharded
+    # batch axis legitimately needs its partitioner-inserted psum.
+    report.context["unplanned_collectives"] = unplanned
+    report.context["unmatched_reductions"] = reductions
+
+
+# ---------------------------------------------------------------------------
+# check 2: precision leaks
+# ---------------------------------------------------------------------------
+
+
+def _classify_upcast(out_var, consumers, outset, max_hops: int = 12):
+    """Follow an upcast value through elementwise consumers: does it reach
+    a FLOP-heavy op still in f32 ('heavy'), escape to a large f32 output
+    ('escape'), or stay contained (reduced / cast back down)?"""
+    frontier = [out_var]
+    seen = set()
+    verdict = None
+    hops = 0
+    while frontier and hops < max_hops:
+        hops += 1
+        next_frontier = []
+        for v in frontier:
+            if id(v) in seen:
+                continue
+            seen.add(id(v))
+            if v in outset:
+                verdict = verdict or "escape"
+            for eqn in consumers.get(v, ()):
+                prim = eqn.primitive.name
+                if prim in _HEAVY_CONSUMERS:
+                    return "heavy"
+                if prim in _REDUCING_CONSUMERS:
+                    continue  # f32 accumulation: the blessed pattern
+                if prim == "convert_element_type":
+                    new = eqn.params.get("new_dtype")
+                    if new is not None and np.dtype(new).itemsize <= 2:
+                        continue  # cast back down: contained
+                if subjaxprs(eqn):
+                    continue  # crossing a call boundary: stop (co-location
+                    # is the contract; a leak inside shows up there)
+                next_frontier.extend(o for o in eqn.outvars if is_var(o))
+        frontier = next_frontier
+    return verdict
+
+
+def precision_check(report: AuditReport, jaxpr, opts: AuditOptions) -> None:
+    # this one cannot ride jaxpr_walk.walk(): the upcast classifier needs
+    # each BODY's consumer map and outvar set (who reads the converted
+    # value, does it escape this body), which a flat eqn visitor doesn't
+    # see — so the recursion stays explicit, built on the shared
+    # subjaxprs/join_scope vocabulary
+    allow = [re.compile(p) for p in opts.precision_allowlist]
+
+    def descend(j, scope):
+        consumers = collect_consumers(j)
+        outset = {v for v in j.outvars if is_var(v)}
+        for eqn in j.eqns:
+            sc = join_scope(scope, source_frames(eqn))
+            if eqn.primitive.name == "convert_element_type":
+                src_aval = getattr(eqn.invars[0], "aval", None)
+                dst_aval = eqn.outvars[0].aval
+                if (src_aval is not None
+                        and str(src_aval.dtype) in _UPCAST_SOURCES
+                        and str(dst_aval.dtype) == "float32"):
+                    elems = int(np.prod(dst_aval.shape)) if dst_aval.shape \
+                        else 1
+                    if elems >= opts.precision_min_elems \
+                            and not any(p.search(sc) for p in allow):
+                        verdict = _classify_upcast(eqn.outvars[0],
+                                                   consumers, outset)
+                        if verdict is not None:
+                            sev = ("error"
+                                   if verdict == "heavy"
+                                   and elems >= opts.precision_big_elems
+                                   else "warning")
+                            what = ("feeds a matmul/conv at f32"
+                                    if verdict == "heavy"
+                                    else "escapes to an f32 output")
+                            report.add(
+                                "precision", sev,
+                                f"{src_aval.dtype} tensor "
+                                f"[{'x'.join(map(str, dst_aval.shape))}] "
+                                f"upcast to f32 {what} "
+                                f"(scope {sc or '<top>'})",
+                                src_dtype=str(src_aval.dtype),
+                                shape=list(dst_aval.shape), elems=elems,
+                                scope=sc, kind=verdict,
+                                source=source_location(eqn))
+            for sub in subjaxprs(eqn):
+                descend(sub.jaxpr,
+                        join_scope(sc, [sub.tag]) if sub.tag else sc)
+
+    descend(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr, "")
+
+
+# ---------------------------------------------------------------------------
+# check 3: donation audit
+# ---------------------------------------------------------------------------
+
+
+def _aval_nbytes(aval) -> int:
+    try:
+        n = int(np.prod(aval.shape)) if aval.shape else 1
+        return n * np.dtype(aval.dtype).itemsize
+    except Exception:
+        return 0
+
+
+def donation_check(report: AuditReport, jaxpr,
+                   donated: Optional[Sequence[bool]],
+                   arg_names: Optional[Sequence[str]],
+                   opts: AuditOptions,
+                   memory_info: Optional[Dict[str, int]] = None) -> None:
+    """Large non-donated inputs whose (shape, dtype) recurs among the
+    outputs: XLA could alias the buffer in place of a fresh allocation."""
+    inner = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    invars = list(inner.invars)
+    if donated is None:
+        donated = [False] * len(invars)
+    # multiset of output (shape, dtype) slots; donated inputs claim theirs
+    out_slots: Dict[Tuple, int] = {}
+    for v in inner.outvars:
+        aval = getattr(v, "aval", None)
+        if aval is not None and getattr(aval, "shape", None) is not None:
+            key = (tuple(aval.shape), str(aval.dtype))
+            out_slots[key] = out_slots.get(key, 0) + 1
+    for v, d in zip(invars, donated):
+        if not d:
+            continue
+        key = (shape_of(v), str(v.aval.dtype))
+        if out_slots.get(key):
+            out_slots[key] -= 1
+    wasted = 0
+    misses = []
+    for i, (v, d) in enumerate(zip(invars, donated)):
+        if d:
+            continue
+        aval = getattr(v, "aval", None)
+        if aval is None:
+            continue
+        nbytes = _aval_nbytes(aval)
+        if nbytes < opts.donation_min_bytes:
+            continue
+        key = (tuple(aval.shape), str(aval.dtype))
+        if not out_slots.get(key):
+            continue  # no same-shaped output: donation couldn't alias it
+        out_slots[key] -= 1
+        wasted += nbytes
+        name = (arg_names[i] if arg_names and i < len(arg_names)
+                else f"arg{i}")
+        misses.append((name, nbytes, key))
+    for name, nbytes, key in misses:
+        report.add(
+            "donation", "warning",
+            f"input {name} ({key[1]}[{'x'.join(map(str, key[0]))}], "
+            f"{nbytes} B) is not donated but a same-shaped output exists "
+            f"— peak memory holds both copies; add it to donate_argnums",
+            arg=name, nbytes=nbytes, shape=list(key[0]), dtype=key[1])
+    if misses:
+        ctx = {"wasted_bytes_estimate": wasted}
+        if memory_info:
+            # cross-check against the compiled memory_analysis() breakdown
+            # (PR 10): args+outputs are what donation would have deduped
+            ctx["memory_analysis"] = {
+                k: memory_info[k] for k in
+                ("argument_size_in_bytes", "output_size_in_bytes",
+                 "temp_size_in_bytes", "alias_size_in_bytes")
+                if k in memory_info}
+        report.context["donation"] = ctx
+
+
+# ---------------------------------------------------------------------------
+# check 4: host-sync / retrace hazards
+# ---------------------------------------------------------------------------
+
+
+def host_sync_check(report: AuditReport, jaxpr,
+                    opts: AuditOptions) -> None:
+    inner = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+
+    def visit(eqn, ctx):
+        prim = eqn.primitive.name
+        if "callback" in prim or prim in ("infeed", "outfeed"):
+            report.add(
+                "host_sync", "warning",
+                f"{prim} compiled into the step (scope "
+                f"{ctx.scope or '<top>'}) — every execution pays a host "
+                f"round-trip; move it out of the hot path or batch it",
+                primitive=prim, scope=ctx.scope,
+                source=source_location(eqn))
+        elif prim == "device_put":
+            kinds = [str(d) for d in (eqn.params.get("devices") or ())]
+            if any("host" in k for k in kinds):
+                report.add(
+                    "host_sync", "info",
+                    f"host-memory transfer inside the step (scope "
+                    f"{ctx.scope or '<top>'}) — intended for offload "
+                    f"tiers; verify it overlaps",
+                    primitive=prim, scope=ctx.scope,
+                    source=source_location(eqn))
+
+    walk(inner, visit)
+    weak = [i for i, v in enumerate(inner.invars)
+            if getattr(getattr(v, "aval", None), "weak_type", False)]
+    if weak:
+        report.add(
+            "host_sync", "info",
+            f"{len(weak)} weak-typed scalar argument(s) (positions "
+            f"{weak[:8]}) — every distinct Python value compiles a new "
+            f"program; pass jnp arrays to pin the dtype",
+            positions=weak[:32])
+
+
+# ---------------------------------------------------------------------------
+# the orchestrator
+# ---------------------------------------------------------------------------
+
+
+def _flatten_args_info(args_info):
+    """(donated flags, dotted leaf names) from ``Lowered.args_info``."""
+    try:
+        import jax
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(args_info)
+    except Exception:
+        return None, None
+    donated, names = [], []
+    for kp, leaf in flat:
+        donated.append(bool(getattr(leaf, "donated", False)))
+        keys = [str(getattr(e, "key", getattr(e, "name", getattr(e, "idx", e))))
+                for e in kp]
+        names.append("/".join(keys) or "arg")
+    return donated, names
+
+
+def audit_step(target, *args, label: str = "step",
+               options: Optional[AuditOptions] = None,
+               axis_sizes: Optional[Dict[str, int]] = None,
+               plan_records: Optional[Dict[str, Dict[str, Any]]] = None,
+               ledger=None, donate_argnums: Sequence[int] = (),
+               in_shardings=None, out_shardings=None,
+               compile: bool = True, lowered=None, compiled=None, **kwargs
+               ) -> AuditReport:
+    """Audit one step function (or an already-staged jax object).
+
+    ``target`` may be a plain callable (jit-staged here with the given
+    shardings/donation), an already-``jax.jit``-wrapped function, a
+    ``jax.stages.Traced``, or a ``jax.stages.Lowered``.  ``args``/
+    ``kwargs`` shape the trace for the callable forms.  ``lowered`` /
+    ``compiled`` hand in already-staged objects (the engine's AOT path) so
+    the audit never pays a second lowering or compile.  Nothing executes:
+    trace + lower + (host) compile only.
+    """
+    import jax
+
+    opts = options or AuditOptions()
+    traced = None
+    if isinstance(target, jax.stages.Lowered):
+        lowered = target
+    elif isinstance(target, jax.stages.Traced):
+        traced = target
+    else:
+        fn = target
+        if not hasattr(fn, "trace"):  # plain callable -> stage it
+            jit_kw = {}
+            if in_shardings is not None:
+                jit_kw["in_shardings"] = in_shardings
+            if out_shardings is not None:
+                jit_kw["out_shardings"] = out_shardings
+            fn = jax.jit(fn, donate_argnums=tuple(donate_argnums), **jit_kw)
+        traced = fn.trace(*args, **kwargs)
+
+    report = AuditReport(label=label)
+    jaxpr = traced.jaxpr if traced is not None else None
+    if lowered is None and traced is not None:
+        lowered = traced.lower()
+
+    donated = names = None
+    if lowered is not None:
+        donated, names = _flatten_args_info(lowered.args_info)
+
+    if jaxpr is not None:
+        precision_check(report, jaxpr, opts)
+        donation_check(report, jaxpr, donated, names, opts)
+        host_sync_check(report, jaxpr, opts)
+        report.context["jaxpr_invars"] = len(jaxpr.jaxpr.invars)
+
+    if compiled is None and compile and lowered is not None:
+        try:
+            compiled = lowered.compile()
+        except Exception as e:
+            report.context["compile_error"] = f"{type(e).__name__}: {e}"
+    if compiled is not None:
+        text = compiled_text(compiled)
+        if text is not None:
+            expected: List[ExpectedSite] = []
+            if jaxpr is not None:
+                expected += jaxpr_collectives(jaxpr, axis_sizes)
+            if plan_records:
+                expected += plan_expected_sites(plan_records, axis_sizes)
+            if ledger is not None:
+                expected += ledger_expected_sites(ledger)
+            reconcile_collectives(report, parse_collectives(text),
+                                  expected, axis_sizes, opts)
+        mem = getattr(compiled, "memory_analysis", None)
+        if mem is not None:
+            try:
+                ma = mem()
+                if ma is not None:
+                    report.context["memory_analysis"] = {
+                        k: int(getattr(ma, k))
+                        for k in ("argument_size_in_bytes",
+                                  "output_size_in_bytes",
+                                  "temp_size_in_bytes",
+                                  "alias_size_in_bytes")
+                        if getattr(ma, k, None) is not None}
+            except Exception:
+                pass
+    if axis_sizes:
+        report.context["axis_sizes"] = dict(axis_sizes)
+    return report
+
+
+def audit_compiled_text(hlo_text: str, *,
+                        expected: Iterable[ExpectedSite] = (),
+                        axis_sizes: Optional[Dict[str, int]] = None,
+                        label: str = "step",
+                        options: Optional[AuditOptions] = None
+                        ) -> AuditReport:
+    """Reconciliation-only entry point for callers that already hold an
+    HLO dump (no jax objects needed) — what the bench rung and offline
+    tooling use."""
+    report = AuditReport(label=label)
+    reconcile_collectives(report, parse_collectives(hlo_text),
+                          list(expected), axis_sizes,
+                          options or AuditOptions())
+    return report

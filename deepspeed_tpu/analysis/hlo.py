@@ -1,0 +1,179 @@
+"""Post-SPMD HLO text analysis: find every collective XLA actually emitted.
+
+The jaxpr shows the collectives the PROGRAM asked for; the compiled module
+(``compiled.as_text()``, post GSPMD partitioning + optimization) shows the
+collectives the program GOT — including the resharding all-gathers the
+partitioner inserts silently when a ``PartitionSpec`` doesn't line up with
+how an op consumes its operand.  The gap between the two sets is exactly
+what the auditor reconciles (``analysis/auditor.py``).
+
+This is a text-level parser on purpose: the HLO dump format is the one
+stable, device-independent surface every jax release exposes
+(``lowered.compile().as_text()`` works on the CPU mesh CI runs on), and we
+only need the collective lines — op kind, result shapes, replica groups,
+and the ``metadata={op_name=...}`` pointer back to the producing jaxpr
+equation.  Unknown line shapes degrade to partial records, never raise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# HLO op -> canonical collective kind.  The async pairs (-start/-done) are
+# one logical collective: only the -start carries the operands; -done lines
+# are skipped below.
+HLO_COLLECTIVES = {
+    "all-gather": "all_gather",
+    "all-reduce": "all_reduce",
+    "reduce-scatter": "reduce_scatter",
+    "all-to-all": "all_to_all",
+    "collective-permute": "collective_permute",
+    "collective-broadcast": "collective_broadcast",
+}
+
+# gather-class kinds are the resharding signature: GSPMD inserts them when
+# an operand's sharding doesn't match what the consuming op needs.
+# Reduction-class kinds also arise from legitimate semantics (a mean over a
+# sharded batch axis NEEDS an all-reduce), so unmatched ones rank lower.
+GATHER_CLASS = ("all_gather", "collective_permute", "all_to_all",
+                "collective_broadcast")
+REDUCTION_CLASS = ("all_reduce", "reduce_scatter")
+
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "s32": 4, "u32": 4,
+    "s64": 8, "u64": 8, "f8e4m3fn": 1, "f8e5m2": 1, "bf16": 2, "f16": 2,
+    "f32": 4, "f64": 8, "c64": 8, "c128": 16,
+}
+# iota form: replica_groups=[G,S]<=[N] (G groups of S); explicit form:
+# replica_groups={{0,1},{2,3}}
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[([0-9,]+)\]<=\[")
+_GROUPS_LIST_RE = re.compile(r"replica_groups=\{\{([0-9,]+)\}")
+_CHANNEL_RE = re.compile(r"channel_id=(\d+)")
+_OPNAME_RE = re.compile(r'metadata=\{[^}]*op_name="([^"]*)"')
+_SOURCE_RE = re.compile(
+    r'source_file="([^"]*)"(?:[^}]*source_line=(\d+))?')
+
+
+def _parse_shapes(text: str) -> List[Tuple[str, Tuple[int, ...]]]:
+    """Every ``dtype[dims]`` occurrence in a shape spec (tuples included)."""
+    out = []
+    for dt, dims in _SHAPE_RE.findall(text):
+        if dt not in _DTYPE_BYTES:
+            continue  # layout annotations like {1,0} never match dtypes
+        shape = tuple(int(d) for d in dims.split(",") if d) if dims else ()
+        out.append((dt, shape))
+    return out
+
+
+def _shapes_nbytes(shapes: Sequence[Tuple[str, Tuple[int, ...]]]) -> int:
+    total = 0
+    for dt, shape in shapes:
+        n = int(np.prod(shape)) if shape else 1
+        total += n * _DTYPE_BYTES.get(dt, 4)
+    return total
+
+
+@dataclasses.dataclass
+class HloCollective:
+    """One collective op in the compiled module."""
+    kind: str                       # canonical (all_gather, all_reduce, ...)
+    hlo_op: str                     # the raw HLO opcode
+    result_shapes: List[Tuple[str, Tuple[int, ...]]]
+    nbytes: int                     # result payload bytes (per participant)
+    group_size: Optional[int]       # participants per replica group
+    num_groups: Optional[int]
+    channel_id: Optional[int]
+    op_name: Optional[str]          # metadata: the producing jaxpr op path
+    source: Optional[str]           # metadata: model file:line
+    line: str                       # the (truncated) HLO line, for reports
+
+    def axes_guess(self, axis_sizes: Dict[str, int]) -> Optional[str]:
+        """Best-effort mesh-axis attribution from the replica-group span:
+        a single axis whose size equals the group span wins; else a
+        contiguous product of axes (declaration order); else None."""
+        return guess_axes(self.group_size, axis_sizes)
+
+
+def guess_axes(group_size: Optional[int],
+               axis_sizes: Dict[str, int]) -> Optional[str]:
+    if not group_size or group_size <= 1 or not axis_sizes:
+        return None
+    for name, size in axis_sizes.items():
+        if size == group_size:
+            return name
+    names = [n for n, s in axis_sizes.items() if s > 1]
+    for i in range(len(names)):
+        prod = 1
+        for j in range(i, len(names)):
+            prod *= axis_sizes[names[j]]
+            if prod == group_size:
+                return ",".join(names[i:j + 1])
+            if prod > group_size:
+                break
+    return None
+
+
+def parse_collectives(hlo_text: str) -> List[HloCollective]:
+    """Every collective op line in one HLO module dump."""
+    out: List[HloCollective] = []
+    for raw in hlo_text.splitlines():
+        line = raw.strip()
+        # "%name = shapes opcode(...)" — find the opcode token
+        m = re.search(
+            r"=\s*(\(?[a-z0-9\[\],{}\s]*\)?)\s*"
+            r"(all-gather|all-reduce|reduce-scatter|all-to-all|"
+            r"collective-permute|collective-broadcast)"
+            r"(-start|-done)?\(", line)
+        if m is None:
+            continue
+        if m.group(3) == "-done":
+            continue  # the -start half already carried the payload
+        hlo_op = m.group(2)
+        shapes = _parse_shapes(m.group(1))
+        gi = _GROUPS_IOTA_RE.search(line)
+        gl = _GROUPS_LIST_RE.search(line)
+        group_size = num_groups = None
+        if gi:
+            dims = [int(d) for d in gi.group(1).split(",") if d]
+            if len(dims) >= 2:
+                num_groups, group_size = dims[0], int(np.prod(dims[1:]))
+            elif dims:
+                num_groups, group_size = 1, dims[0]
+        elif gl:
+            group_size = len([d for d in gl.group(1).split(",") if d])
+            num_groups = line.count("{") - 1 if "{" in line else None
+        ch = _CHANNEL_RE.search(line)
+        opn = _OPNAME_RE.search(line)
+        src = _SOURCE_RE.search(line)
+        source = None
+        if src:
+            source = src.group(1)
+            if src.group(2):
+                source += f":{src.group(2)}"
+        out.append(HloCollective(
+            kind=HLO_COLLECTIVES[hlo_op],
+            hlo_op=hlo_op + (m.group(3) or ""),
+            result_shapes=shapes,
+            nbytes=_shapes_nbytes(shapes),
+            group_size=group_size,
+            num_groups=num_groups,
+            channel_id=int(ch.group(1)) if ch else None,
+            op_name=opn.group(1) if opn else None,
+            source=source,
+            line=line[:240]))
+    return out
+
+
+def compiled_text(compiled) -> Optional[str]:
+    """The post-optimization module text of a ``jax.stages.Compiled`` —
+    None when the backend doesn't expose one (the audit then runs its
+    jaxpr-level checks only)."""
+    try:
+        return compiled.as_text()
+    except Exception:
+        return None

@@ -1,0 +1,214 @@
+"""The one jaxpr walker.
+
+Three subsystems walk jaxprs: AutoTP's dataflow classifier
+(``module_inject/auto_tp.py``), the FLOPs profiler
+(``profiling/flops_profiler.py``), and the static auditor
+(``analysis/auditor.py``).  Each needs the same awkward knowledge — which
+equation params hide a sub-jaxpr (``pjit``/``remat``/``custom_vjp`` spell it
+three ways), how ``scan`` trip counts multiply inner work, how outer vars
+line up with inner invars — and before this module each had its own copy
+with its own gaps.  This module is that knowledge, written once:
+
+- :func:`subjaxprs` enumerates every closed sub-jaxpr of one equation, with
+  the outer<->inner var correspondence when one exists and the trip-count
+  multiplier when the body repeats (``scan``).
+- :func:`walk` is the pre-order driver: named-scope tracking from each
+  equation's ``source_info.name_stack``, multiplier threading, and a
+  visitor protocol with an explicit opt-out (return :data:`HANDLED`) for
+  visitors that must own a construct's recursion themselves (the FLOPs
+  profiler counts only ``cond``'s most expensive branch).
+- :func:`is_var` / :func:`collect_consumers` are the small var-vocabulary
+  helpers: jaxpr ``Literal`` invars are unhashable (the case noted at the
+  old ``auto_tp.py:165``) and every walker must treat them as tag-free.
+
+Stdlib + jax only; nothing here touches a device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+# Sentinel a visitor returns to claim an equation ENTIRELY: the driver will
+# not descend into its sub-jaxprs (the visitor already did, or chose not to).
+HANDLED = object()
+
+
+def is_var(v) -> bool:
+    """True for jaxpr Vars (hashable, carry dataflow); False for Literals
+    (inline constants — unhashable, no identity, no tags)."""
+    return not hasattr(v, "val")
+
+
+def literal_value(v) -> Any:
+    """The Python value of a jaxpr Literal invar (None for Vars)."""
+    return getattr(v, "val", None)
+
+
+def aval_of(v):
+    return getattr(v, "aval", None)
+
+
+def shape_of(v) -> Tuple[int, ...]:
+    return tuple(getattr(aval_of(v), "shape", ()) or ())
+
+
+@dataclasses.dataclass(frozen=True)
+class SubJaxpr:
+    """One closed sub-jaxpr of an equation.
+
+    ``invars``/``outvars`` are the OUTER vars positionally aligned with the
+    inner jaxpr's invars/outvars — present only when the correspondence is
+    1:1 and shape-preserving (``pjit``/``remat``/``closed_call``/
+    ``custom_jvp``/``custom_vjp`` call bodies).  ``scan``/``while``/``cond``
+    reorder or reshape their operands (consts/carries/slices), so there the
+    fields are None and a dataflow walker must not map tags across.
+    ``mult`` is the trip-count multiplier for work inside the body
+    (``scan`` length; 1 elsewhere — ``while`` trip counts are dynamic and
+    counted once, the documented profiler caveat).  ``tag`` names the
+    construct for scope paths: the pjit's ``name`` param, or
+    ``scan``/``while``/``cond`` (None when there is nothing to add).
+    """
+    jaxpr: Any
+    invars: Optional[Tuple[Any, ...]]
+    outvars: Optional[Tuple[Any, ...]]
+    mult: int = 1
+    tag: Optional[str] = None
+
+
+def _inner(j):
+    """ClosedJaxpr -> Jaxpr (idempotent)."""
+    return j.jaxpr if hasattr(j, "jaxpr") else j
+
+
+def _looks_like_jaxpr(v) -> bool:
+    return hasattr(v, "eqns") or (hasattr(v, "jaxpr")
+                                  and hasattr(_inner(v), "eqns"))
+
+
+def subjaxprs(eqn) -> List[SubJaxpr]:
+    """Every closed sub-jaxpr of ``eqn`` (empty for leaf primitives).
+
+    Handles the named spellings (``jaxpr``, ``call_jaxpr``, ``fun_jaxpr``,
+    ``body_jaxpr``/``cond_jaxpr``, ``branches``) and falls back to scanning
+    the params for jaxpr-shaped values, so new primitives with bodies are
+    walked instead of silently skipped.
+    """
+    prim = eqn.primitive.name
+    params = eqn.params
+    out: List[SubJaxpr] = []
+
+    if prim == "scan":
+        length = int(params.get("length", 1) or 1)
+        out.append(SubJaxpr(_inner(params["jaxpr"]), None, None,
+                            mult=length, tag="scan"))
+        return out
+    if prim == "while":
+        out.append(SubJaxpr(_inner(params["body_jaxpr"]), None, None,
+                            tag="while"))
+        cond = params.get("cond_jaxpr")
+        if cond is not None:
+            out.append(SubJaxpr(_inner(cond), None, None, tag="while"))
+        return out
+    if prim == "cond":
+        for b in params.get("branches", ()):
+            out.append(SubJaxpr(_inner(b), None, None, tag="cond"))
+        return out
+
+    sub = params.get("jaxpr") or params.get("call_jaxpr") \
+        or params.get("fun_jaxpr")
+    if sub is not None and _looks_like_jaxpr(sub):
+        inner = _inner(sub)
+        name = params.get("name", "")
+        tag = name if name and name != "<lambda>" else None
+        # aligned only when arities agree: custom_vjp/jvp call bodies carry
+        # extra symbolic-zero/tangent positions in some jax versions
+        n_in = len(inner.invars)
+        n_out = len(inner.outvars)
+        invars = tuple(eqn.invars[-n_in:]) if len(eqn.invars) >= n_in else None
+        outvars = (tuple(eqn.outvars[:n_out])
+                   if len(eqn.outvars) >= n_out else None)
+        out.append(SubJaxpr(inner, invars, outvars, tag=tag))
+        return out
+
+    # fallback: any other param that is (a list of) jaxprs — unaligned
+    for key, val in params.items():
+        if key in ("jaxpr", "call_jaxpr", "fun_jaxpr", "body_jaxpr",
+                   "cond_jaxpr", "branches"):
+            continue
+        vals = val if isinstance(val, (list, tuple)) else (val,)
+        for v in vals:
+            if _looks_like_jaxpr(v):
+                out.append(SubJaxpr(_inner(v), None, None, tag=prim))
+    return out
+
+
+def source_frames(eqn) -> List[str]:
+    """``jax.named_scope`` frames attached to one equation (may be [])."""
+    try:
+        return [f for f in str(eqn.source_info.name_stack).split("/") if f]
+    except Exception:
+        return []
+
+
+def source_location(eqn) -> Optional[str]:
+    """``file:line`` of the user frame that produced this equation, when
+    jax kept one (the auditor's pointer back into model code)."""
+    try:
+        frame = eqn.source_info.traceback.frames[0]
+        return f"{frame.file_name}:{frame.line_no}"
+    except Exception:
+        return None
+
+
+def join_scope(scope: str, frames: Sequence[str]) -> str:
+    parts = [s for s in scope.split("/") if s] + [f for f in frames if f]
+    return "/".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class WalkContext:
+    """What the driver knows at one equation: the accumulated named-scope
+    path and the product of enclosing trip counts."""
+    scope: str
+    mult: int
+    depth: int
+
+
+def walk(jaxpr, visit: Callable[[Any, WalkContext], Any], *,
+         scope: str = "", mult: int = 1, depth: int = 0) -> None:
+    """Pre-order walk of ``jaxpr`` (Closed or open), calling
+    ``visit(eqn, ctx)`` on every equation and recursing into sub-jaxprs
+    with scope/multiplier threading.  A visitor that returns
+    :data:`HANDLED` owns that equation's recursion (the driver skips it).
+    """
+    for eqn in _inner(jaxpr).eqns:
+        ctx = WalkContext(join_scope(scope, source_frames(eqn)), mult, depth)
+        if visit(eqn, ctx) is HANDLED:
+            continue
+        for sub in subjaxprs(eqn):
+            sub_scope = (join_scope(ctx.scope, [sub.tag]) if sub.tag
+                         else ctx.scope)
+            walk(sub.jaxpr, visit, scope=sub_scope, mult=mult * sub.mult,
+                 depth=depth + 1)
+
+
+def collect_consumers(jaxpr) -> Dict[Any, List[Any]]:
+    """var -> [consuming eqns] within ONE jaxpr body (no sub-jaxpr
+    crossing): the precision-leak check asks "who reads this upcast?",
+    and consumers co-locate with the convert in the same body."""
+    consumers: Dict[Any, List[Any]] = {}
+    for eqn in _inner(jaxpr).eqns:
+        for v in eqn.invars:
+            if is_var(v):
+                consumers.setdefault(v, []).append(eqn)
+    return consumers
+
+
+def iter_eqns(jaxpr, *, mult: int = 1):
+    """Flat (eqn, ctx) iterator over the whole nested program — the
+    convenience form of :func:`walk` for passes that only need to see every
+    equation once with its multiplier/scope."""
+    acc: List[Tuple[Any, WalkContext]] = []
+    walk(jaxpr, lambda e, c: acc.append((e, c)), mult=mult)
+    return acc

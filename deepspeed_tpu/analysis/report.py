@@ -1,0 +1,168 @@
+"""Audit findings: the taxonomy, the report object, the exit-code contract.
+
+A :class:`Finding` is one defect the static auditor can name before the
+first step runs; an :class:`AuditReport` is the ordered set of them plus
+enough context (what was audited, which checks ran) for CI and the doctor
+to consume.  Severity is a 3-level ladder — ``info`` (worth knowing,
+never gates), ``warning`` (probably costing you; gates when asked),
+``error`` (the planner/ledger contract is broken: unpriced collectives,
+hot-path upcasts, donation misses at parameter scale).
+
+Exit-code convention matches ``deepspeed_tpu.doctor``: ``0`` clean,
+``2`` when findings at/above the chosen threshold exist — CI-assertable.
+The schema is documented in ``docs/static_analysis.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+SEVERITIES = ("info", "warning", "error")
+# the four checks the auditor runs (docs/static_analysis.md taxonomy)
+CHECKS = ("collective", "precision", "donation", "host_sync")
+
+# CLI / engine exit contract (the doctor's convention)
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 2
+REPORT_NAME = "audit-report.json"
+
+
+def severity_rank(severity: str) -> int:
+    try:
+        return SEVERITIES.index(severity)
+    except ValueError:
+        raise ValueError(f"unknown severity {severity!r}; "
+                         f"ladder: {SEVERITIES}") from None
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One defect: which check fired, how bad, a one-line summary, and the
+    structured evidence (shapes, axes, bytes, source locations) a tool can
+    act on without re-parsing the prose."""
+    check: str
+    severity: str
+    summary: str
+    detail: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.check not in CHECKS:
+            raise ValueError(f"unknown check {self.check!r}; "
+                             f"known: {CHECKS}")
+        severity_rank(self.severity)  # validates
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"check": self.check, "severity": self.severity,
+                "summary": self.summary, "detail": dict(self.detail)}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Finding":
+        return cls(check=d["check"], severity=d["severity"],
+                   summary=d["summary"], detail=dict(d.get("detail", {})))
+
+
+class AuditReport:
+    """Ordered findings + audit context; serializes to
+    ``audit-report.json`` (the file the doctor cross-reads)."""
+
+    def __init__(self, label: str = "step",
+                 findings: Optional[List[Finding]] = None,
+                 context: Optional[Dict[str, Any]] = None):
+        self.label = label
+        self.findings: List[Finding] = list(findings or [])
+        #: what was audited: eqn counts, collective counts, mesh axes, ...
+        self.context: Dict[str, Any] = dict(context or {})
+
+    def add(self, check: str, severity: str, summary: str,
+            **detail: Any) -> Finding:
+        f = Finding(check=check, severity=severity, summary=summary,
+                    detail=detail)
+        self.findings.append(f)
+        return f
+
+    def extend(self, findings) -> None:
+        self.findings.extend(findings)
+
+    def by_check(self, check: str) -> List[Finding]:
+        return [f for f in self.findings if f.check == check]
+
+    def counts(self) -> Dict[str, int]:
+        out = {s: 0 for s in SEVERITIES}
+        for f in self.findings:
+            out[f.severity] += 1
+        return out
+
+    def max_severity(self) -> Optional[str]:
+        if not self.findings:
+            return None
+        return max((f.severity for f in self.findings), key=severity_rank)
+
+    def at_or_above(self, threshold: str) -> List[Finding]:
+        floor = severity_rank(threshold)
+        return [f for f in self.findings
+                if severity_rank(f.severity) >= floor]
+
+    def exit_code(self, threshold: str = "error") -> int:
+        """``EXIT_FINDINGS`` (2) when findings at/above ``threshold``
+        exist; the CI-assertable surface."""
+        return EXIT_FINDINGS if self.at_or_above(threshold) else EXIT_CLEAN
+
+    # -- serialization --------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        ordered = sorted(self.findings,
+                         key=lambda f: (-severity_rank(f.severity), f.check))
+        return {"version": 1, "label": self.label,
+                "counts": self.counts(),
+                "max_severity": self.max_severity(),
+                "context": dict(self.context),
+                "findings": [f.to_dict() for f in ordered]}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AuditReport":
+        return cls(label=d.get("label", "step"),
+                   findings=[Finding.from_dict(f)
+                             for f in d.get("findings", [])],
+                   context=dict(d.get("context", {})))
+
+    def write(self, path: str) -> str:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f, indent=1)
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "AuditReport":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    # -- rendering ------------------------------------------------------
+    def render(self) -> str:
+        """The human form the CLI prints."""
+        c = self.counts()
+        head = (f"== audit: {self.label} == "
+                f"{c['error']} error / {c['warning']} warning / "
+                f"{c['info']} info")
+        lines = [head]
+        ctx = self.context
+        if ctx.get("hlo_collectives") is not None:
+            lines.append(
+                f"compiled program: {ctx.get('hlo_collectives')} "
+                f"collective(s), {ctx.get('matched_collectives', 0)} "
+                f"matched to plan/jaxpr, "
+                f"{ctx.get('unplanned_collectives', 0)} unplanned "
+                f"(resharding), "
+                f"{ctx.get('unmatched_reductions', 0)} partitioner "
+                f"reduction(s)")
+        for f in sorted(self.findings,
+                        key=lambda f: (-severity_rank(f.severity), f.check)):
+            lines.append(f"[{f.severity.upper():<7}] {f.check}: {f.summary}")
+            loc = f.detail.get("source")
+            if loc:
+                lines.append(f"          at {loc}")
+        if not self.findings:
+            lines.append("clean: no findings")
+        return "\n".join(lines)

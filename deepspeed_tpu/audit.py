@@ -1,0 +1,167 @@
+"""``python -m deepspeed_tpu.audit`` — static pre-flight audit of a step.
+
+Point it at a step via ``--entry module:callable`` (the callable returns
+what to audit, see below), or run the built-in ``--demo`` pair that proves
+the collective-reconciliation contract end to end: ``--demo misaligned``
+shards a weight on the wrong dim and the auditor names the all-gather XLA
+silently inserted to fix it up; ``--demo clean`` is the aligned twin and
+reports zero unplanned collectives.  Exit code ``2`` when findings at or
+above ``--fail-on`` exist (the doctor's convention — CI-assertable),
+``0`` clean, ``1`` usage error.
+
+An ``--entry`` callable returns either a ``jax.stages.Traced`` /
+``Lowered``, or a dict with keys ``fn`` (callable), ``args`` (tuple), and
+optionally ``kwargs`` / ``in_shardings`` / ``out_shardings`` /
+``donate_argnums`` / ``axis_sizes`` / ``label``.
+
+Nothing executes on a device: trace + lower + host compile only.
+See ``docs/static_analysis.md``.
+"""
+
+import argparse
+import importlib
+import json
+import os
+import sys
+
+
+def _parse_args(argv):
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.audit",
+        description="Static pre-flight audit: unplanned collectives, "
+                    "precision leaks, donation misses, host-sync hazards "
+                    "— before the first step runs.")
+    ap.add_argument("--entry", default=None, metavar="MODULE:CALLABLE",
+                    help="import MODULE and call CALLABLE() to get the "
+                         "step to audit")
+    ap.add_argument("--demo", choices=("clean", "misaligned"), default=None,
+                    help="built-in 2x4-mesh demo: 'misaligned' shards a "
+                         "weight on the wrong dim (the auditor names the "
+                         "implicit all-gather, exit 2); 'clean' is the "
+                         "aligned twin (exit 0)")
+    ap.add_argument("--fail-on", default="error",
+                    choices=("info", "warning", "error"),
+                    help="exit 2 when findings at/above this severity "
+                         "exist (default: error)")
+    ap.add_argument("--strict", action="store_true",
+                    help="unmatched reduction collectives become warnings "
+                         "instead of info")
+    ap.add_argument("--allow", action="append", default=[],
+                    metavar="REGEX",
+                    help="collective allow-list regex (vs HLO metadata "
+                         "op_name/source); repeatable")
+    ap.add_argument("--out", default=None,
+                    help="write audit-report.json here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report JSON instead of the rendering")
+    return ap.parse_args(argv)
+
+
+def _build_demo(which: str):
+    """The acceptance-criterion pair: one matmul chain, sharded right and
+    sharded wrong.  Needs >= 8 devices (main() forces the virtual CPU mesh
+    before jax loads when real devices are absent)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        raise SystemExit(f"audit --demo needs 8 devices, have {len(devs)}")
+    mesh = Mesh(np.array(devs[:8]).reshape(2, 4), ("dp", "tp"))
+    axis_sizes = {"dp": 2, "tp": 4}
+    x = jnp.ones((32, 1024), jnp.bfloat16)
+    w1 = jnp.ones((1024, 4096), jnp.bfloat16)  # 8 MiB: error-grade payload
+    w2 = jnp.ones((4096, 1024), jnp.bfloat16)
+
+    def step(x, w1, w2):
+        h = jnp.tanh(x @ w1)
+        y = h @ w2
+        return jnp.mean(y.astype(jnp.float32) ** 2)
+
+    def sh(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    if which == "clean":
+        # Megatron pairing: col-parallel w1, row-parallel w2 — the only
+        # collective is the row psum + the dp mean, both reductions
+        in_sh = (sh("dp", None), sh(None, "tp"), sh("tp", None))
+    else:
+        # w1 sharded on dim 0 (the CONTRACTION dim of x @ w1) instead of
+        # dim 1: GSPMD must all-gather the full weight on every rank —
+        # the classic AutoTP-rule-gone-wrong shape
+        in_sh = (sh("dp", None), sh("tp", None), sh("tp", None))
+    return {"fn": step, "args": (x, w1, w2), "in_shardings": in_sh,
+            "out_shardings": sh(), "axis_sizes": axis_sizes,
+            "label": f"demo-{which}"}
+
+
+def _load_entry(spec: str):
+    mod_name, _, attr = spec.partition(":")
+    if not attr:
+        raise SystemExit(f"--entry wants MODULE:CALLABLE, got {spec!r}")
+    sys.path.insert(0, os.getcwd())
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, attr)()
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+    if (args.entry is None) == (args.demo is None):
+        print("audit: pass exactly one of --entry or --demo",
+              file=sys.stderr)
+        return 1
+
+    import jax
+
+    if (args.demo and len(jax.devices()) < 8
+            and jax.default_backend() == "cpu"
+            and not os.environ.get("_DSTPU_AUDIT_REEXEC")):
+        # the demo needs a mesh, and the XLA flag must be set before jax
+        # initializes — which already happened when the package imported.
+        # Re-exec once with 8 virtual CPU devices (host platform only;
+        # never shrinks a real accelerator).
+        env = dict(os.environ,
+                   _DSTPU_AUDIT_REEXEC="1",
+                   XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                              + " --xla_force_host_platform_device_count=8"))
+        os.execve(sys.executable,
+                  [sys.executable, "-m", "deepspeed_tpu.audit"]
+                  + (argv if argv is not None else sys.argv[1:]), env)
+
+    from .analysis import AuditOptions, AuditReport, audit_step
+
+    opts = AuditOptions(strict=args.strict,
+                        collective_allowlist=tuple(args.allow))
+    if args.demo:
+        spec = _build_demo(args.demo)
+    else:
+        spec = _load_entry(args.entry)
+
+    if isinstance(spec, dict):
+        report = audit_step(
+            spec["fn"], *spec.get("args", ()),
+            label=spec.get("label", "step"), options=opts,
+            axis_sizes=spec.get("axis_sizes"),
+            in_shardings=spec.get("in_shardings"),
+            out_shardings=spec.get("out_shardings"),
+            donate_argnums=spec.get("donate_argnums", ()),
+            **spec.get("kwargs", {}))
+    elif isinstance(spec, AuditReport):
+        report = spec  # an entry may audit itself and hand back the report
+    else:
+        report = audit_step(spec, label="step", options=opts)
+
+    if args.out:
+        report.write(args.out)
+        print(f"audit: report written to {args.out}", file=sys.stderr)
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=1))
+    else:
+        print(report.render())
+    return report.exit_code(args.fail_on)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -393,8 +393,10 @@ def diagnose(directory: str, *, world: Optional[int] = None,
         phases.setdefault(ph, []).append(r)
     phases = {ph: sorted(rs) for ph, rs in sorted(phases.items())}
 
+    audit = load_audit_report(directory)
     verdict, evidence = _classify(dumps, missing, desync, plan_mismatch,
-                                  health, phases, expected, hangs)
+                                  health, phases, expected, hangs,
+                                  audit=audit)
     return {
         "version": 1,
         "dir": os.path.abspath(directory),
@@ -407,13 +409,39 @@ def diagnose(directory: str, *, world: Optional[int] = None,
         "plan_mismatch": plan_mismatch,
         "health": health,
         "phases": phases,
+        "audit": audit,
         "verdict": verdict,
         "evidence": evidence,
     }
 
 
+def load_audit_report(directory: str) -> Optional[dict]:
+    """The compile-time static audit summary, when the engine dropped an
+    ``audit-report.json`` beside the dumps (``analysis.report_dir`` /
+    resilience ``snapshot_dir`` — see ``deepspeed_tpu/analysis``).
+    Returns ``{counts, unplanned: [{kind, axes, shape}...]}`` or None."""
+    path = os.path.join(directory, "audit-report.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    unplanned = [
+        {"kind": fi.get("detail", {}).get("kind"),
+         "axes": fi.get("detail", {}).get("axes"),
+         "shape": fi.get("detail", {}).get("shape"),
+         "severity": fi.get("severity")}
+        for fi in doc.get("findings", [])
+        if fi.get("check") == "collective"
+        and fi.get("detail", {}).get("kind") in
+        ("all_gather", "collective_permute", "all_to_all",
+         "collective_broadcast")]
+    return {"label": doc.get("label"), "counts": doc.get("counts"),
+            "unplanned": unplanned}
+
+
 def _classify(dumps, missing, desync, plan_mismatch, health, phases,
-              expected, hangs=None) -> Tuple[str, List[str]]:
+              expected, hangs=None, audit=None) -> Tuple[str, List[str]]:
     """The decision tree (docs/observability.md reproduces it): desync
     beats dead-host beats straggler beats genuine-hang beats crash."""
     evidence: List[str] = []
@@ -440,6 +468,17 @@ def _classify(dumps, missing, desync, plan_mismatch, health, phases,
                 "plan tables also differ across ranks "
                 f"(ranks {plan_mismatch['ranks']}) — the desync may start "
                 "at planner resolution, not model code")
+        if audit and audit.get("unplanned"):
+            # compile-time audit cross-link: this program carried
+            # collectives the planner never priced — a desync around one
+            # of them is a sharding bug, not a model-code bug
+            u = audit["unplanned"][0]
+            evidence.append(
+                f"the static audit flagged {len(audit['unplanned'])} "
+                f"UNPLANNED collective(s) in this program (e.g. "
+                f"{u.get('kind')} over {u.get('axes') or '?'}) — the hang "
+                "may sit inside an implicit reshard; fix the "
+                "PartitionSpec it names (python -m deepspeed_tpu.audit)")
         return "desync", evidence
     if plan_mismatch:
         evidence.append(
@@ -535,6 +574,13 @@ def render_report(report: dict) -> str:
                      f"divergent rank(s): {d['divergent_ranks']}")
         for r, v in sorted(d.get("per_rank", {}).items()):
             lines.append(f"  rank {r}: {v['signature']}")
+    a = report.get("audit")
+    if a:
+        c = a.get("counts") or {}
+        lines.append(
+            f"static audit ({a.get('label')}): {c.get('error', 0)} error / "
+            f"{c.get('warning', 0)} warning; "
+            f"{len(a.get('unplanned') or [])} unplanned collective(s)")
     if report["phases"]:
         lines.append("last phase per rank:")
         for ph, rs in report["phases"].items():

@@ -39,6 +39,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..analysis.jaxpr_walk import is_var as _shared_is_var
+from ..analysis.jaxpr_walk import subjaxprs
+
 # Reference name vocabulary (``auto_tp.py:303-351`` tp_parser): layers whose
 # *output* is summed into the residual stream → row-parallel. Everything else
 # that is a matmul weight defaults to column-parallel, as the reference's
@@ -162,8 +165,9 @@ class _JaxprWalk:
 
     @staticmethod
     def _is_var(v) -> bool:
-        # jaxpr Literals (inline constants) are unhashable and carry no tags
-        return not hasattr(v, "val")
+        # jaxpr Literals (inline constants) are unhashable and carry no
+        # tags (analysis/jaxpr_walk owns the definition)
+        return _shared_is_var(v)
 
     def _get_tags(self, v) -> Dict[int, Tuple[str, int]]:
         if not self._is_var(v):
@@ -175,9 +179,15 @@ class _JaxprWalk:
             self.eqn(eqn)
 
     # -- recursion into sub-jaxprs (pjit, custom_vjp, remat, ...) ----------
-    def _sub(self, sub_jaxpr, invars, outvars) -> None:
-        inner = sub_jaxpr.jaxpr if hasattr(sub_jaxpr, "jaxpr") else sub_jaxpr
-        for outer, inner_v in zip(invars, inner.invars):
+    # enumeration + var alignment comes from analysis/jaxpr_walk.subjaxprs
+    # (the shared walker); this only copies dataflow tags across the
+    # aligned boundary. scan/while/cond reorder their operands (consts/
+    # carries/slices), so subjaxprs marks them unaligned and tags stop at
+    # the boundary — dropping a tag is always safe (the leaf degrades to
+    # the name heuristic).
+    def _sub(self, sub) -> None:
+        inner = sub.jaxpr
+        for outer, inner_v in zip(sub.invars, inner.invars):
             if not self._is_var(outer):
                 continue
             if outer in self.tags:
@@ -185,7 +195,7 @@ class _JaxprWalk:
             if outer in self.alias:
                 self.alias[inner_v] = self.alias[outer]
         self.run(inner)
-        for outer, inner_v in zip(outvars, inner.outvars):
+        for outer, inner_v in zip(sub.outvars, inner.outvars):
             if not self._is_var(inner_v):
                 continue
             if inner_v in self.tags:
@@ -197,9 +207,11 @@ class _JaxprWalk:
         prim = eqn.primitive.name
         params = eqn.params
 
-        sub = params.get("jaxpr") or params.get("call_jaxpr")
-        if sub is not None and prim not in ("scan", "while", "cond"):
-            self._sub(sub, eqn.invars, eqn.outvars)
+        subs = subjaxprs(eqn)
+        if subs:
+            for sub in subs:
+                if sub.invars is not None and sub.outvars is not None:
+                    self._sub(sub)
             return
 
         if prim == "dot_general":

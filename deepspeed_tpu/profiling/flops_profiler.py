@@ -20,6 +20,8 @@ import jax
 import numpy as np
 from jax import core as jcore
 
+from ..analysis import jaxpr_walk as jw
+
 
 def _size(aval) -> int:
     try:
@@ -69,67 +71,73 @@ _FREE = {"broadcast_in_dim", "reshape", "transpose", "squeeze", "slice",
          "eq", "ne", "lt", "le", "gt", "ge", "is_finite", "sharding_constraint"}
 
 
-def _eqn_flops(eqn, scope_acc, scope: str, mult: int) -> int:
-    """FLOPs for one eqn; recurses into sub-jaxprs with trip multipliers."""
+# call-like primitives that sometimes carry no discoverable jaxpr in their
+# params (custom_lin holds a bare callable): they dispatch work counted
+# elsewhere, so they must cost 0, not fall through to the size estimate
+_CALL_LIKE = {"pjit", "closed_call", "core_call", "custom_jvp_call",
+              "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
+              "checkpoint", "custom_lin", "c_jit"}
+
+
+def _leaf_flops(eqn) -> int:
+    """Analytic FLOPs for one leaf primitive (no sub-jaxpr)."""
     prim = eqn.primitive.name
-    if prim in ("pjit", "closed_call", "core_call", "custom_jvp_call",
-                "custom_vjp_call", "custom_vjp_call_jaxpr", "remat", "checkpoint",
-                "custom_lin", "c_jit"):
-        inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
-        if inner is None:
-            return 0
-        name = eqn.params.get("name", "")
-        sub_scope = f"{scope}/{name}" if name and name != "<lambda>" else scope
-        return _jaxpr_flops(getattr(inner, "jaxpr", inner), scope_acc, sub_scope, mult)
-    if prim == "scan":
-        inner = eqn.params["jaxpr"]
-        length = eqn.params.get("length", 1)
-        return _jaxpr_flops(inner.jaxpr, scope_acc, f"{scope}/scan", mult * length)
-    if prim == "while":
-        inner = eqn.params["body_jaxpr"]
-        # trip count is dynamic; count one iteration (documented caveat)
-        return _jaxpr_flops(inner.jaxpr, scope_acc, f"{scope}/while", mult)
-    if prim == "cond":
-        # count only the most expensive branch, in total AND per-scope
-        best_total, best_acc = 0, {}
-        for b in eqn.params["branches"]:
-            acc = defaultdict(int)
-            t = _jaxpr_flops(b.jaxpr, acc, f"{scope}/cond", mult)
-            if t >= best_total:
-                best_total, best_acc = t, acc
-        for k, v in best_acc.items():
-            scope_acc[k] += v
-        return best_total
+    if prim in _CALL_LIKE:
+        return 0
     if prim == "dot_general":
-        f = _dot_general_flops(eqn)
-    elif prim == "conv_general_dilated":
-        f = _conv_flops(eqn)
-    elif prim in _ELEMENTWISE:
-        f = _size(eqn.outvars[0].aval)
-    elif prim in _REDUCTION:
-        f = _size(eqn.invars[0].aval)
-    elif prim in ("psum", "all_gather", "reduce_scatter", "all_to_all", "ppermute"):
-        f = 0  # communication, not FLOPs — the comms logger ledgers these
-    elif prim in _FREE:
-        f = 0
-    else:
-        f = _size(eqn.outvars[0].aval) if eqn.outvars else 0
-    f *= mult
-    scope_acc[scope or "<top>"] += f
-    return f
+        return _dot_general_flops(eqn)
+    if prim == "conv_general_dilated":
+        return _conv_flops(eqn)
+    if prim in _ELEMENTWISE:
+        return _size(eqn.outvars[0].aval)
+    if prim in _REDUCTION:
+        return _size(eqn.invars[0].aval)
+    if prim in ("psum", "all_gather", "reduce_scatter", "all_to_all", "ppermute"):
+        return 0  # communication, not FLOPs — the comms logger ledgers these
+    if prim in _FREE:
+        return 0
+    return _size(eqn.outvars[0].aval) if eqn.outvars else 0
 
 
 def _jaxpr_flops(jaxpr, scope_acc, scope: str, mult: int) -> int:
-    total = 0
-    for eqn in jaxpr.eqns:
-        frames = []
-        try:
-            frames = [f for f in str(eqn.source_info.name_stack).split("/") if f]
-        except Exception:
-            pass
-        eqn_scope = "/".join([s for s in scope.split("/") if s] + frames)
-        total += _eqn_flops(eqn, scope_acc, eqn_scope, mult)
-    return total
+    """Walk the program on the shared driver (``analysis/jaxpr_walk``):
+    named-scope frames, pjit-name scope nesting, and ``scan`` trip-count
+    multipliers all come from :func:`jw.walk`/:func:`jw.subjaxprs`.  The
+    two FLOP-specific recursion rules stay here via the HANDLED protocol:
+    ``while`` counts ONE body iteration (trip count is dynamic —
+    documented caveat; the loop predicate is never counted), and ``cond``
+    counts only its most expensive branch, in total AND per-scope."""
+    total = [0]
+
+    def visit(eqn, ctx):
+        prim = eqn.primitive.name
+        if prim == "while":
+            inner = eqn.params["body_jaxpr"]
+            total[0] += _jaxpr_flops(inner.jaxpr, scope_acc,
+                                     f"{ctx.scope}/while", ctx.mult)
+            return jw.HANDLED
+        if prim == "cond":
+            best_total, best_acc = 0, {}
+            for b in eqn.params["branches"]:
+                acc = defaultdict(int)
+                t = _jaxpr_flops(b.jaxpr, acc, f"{ctx.scope}/cond", ctx.mult)
+                if t >= best_total:
+                    best_total, best_acc = t, acc
+            for k, v in best_acc.items():
+                scope_acc[k] += v
+            total[0] += best_total
+            return jw.HANDLED
+        if jw.subjaxprs(eqn):
+            # call-like (pjit/remat/custom_vjp/scan): the eqn itself costs
+            # nothing; the driver recurses with scope + trip multipliers
+            return None
+        f = _leaf_flops(eqn) * ctx.mult
+        scope_acc[ctx.scope or "<top>"] += f
+        total[0] += f
+        return None
+
+    jw.walk(jaxpr, visit, scope=scope, mult=mult)
+    return total[0]
 
 
 def count_flops(fn: Callable, *args, **kwargs) -> Tuple[int, Dict[str, int]]:

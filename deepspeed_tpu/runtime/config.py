@@ -566,6 +566,39 @@ class ResilienceConfig(ConfigModel):
 
 @register_config
 @dataclass
+class AnalysisConfig(ConfigModel):
+    """Static graph auditor (``deepspeed_tpu/analysis/``, see
+    ``docs/static_analysis.md``): at ``engine.compile()`` time the staged
+    train step is audited — unplanned collectives reconciled against the
+    planner's plan table / comms ledger / jaxpr, precision leaks, donation
+    misses, host-sync hazards — with findings logged as plan-table rows
+    and ``Analysis/*`` monitor events.  Disabled by default: nothing runs
+    and the compiled program is bit-identical (the audit never edits the
+    program either way — it only reads it).  Also accepted as a bare bool
+    (``"analysis": true``) or a severity string (``"analysis": "error"``
+    == enabled + ``fail_on: error``)."""
+    enabled: bool = False
+    # raise at compile() when findings at/above this severity exist
+    # (None = report only); same ladder as the CLI --fail-on
+    fail_on: Optional[str] = None     # None | info | warning | error
+    strict: bool = False              # unmatched reductions become warnings
+    small_bytes: int = 64 << 10       # gather-class unplanned: info below
+    big_bytes: int = 1 << 20          # gather-class unplanned: error at/above
+    precision_min_elems: int = 4096   # smaller upcasts never reported
+    precision_big_elems: int = 1 << 20  # upcast warning -> error at/above
+    donation_min_bytes: int = 1 << 20   # smaller non-donated inputs ignored
+    # regexes vs HLO metadata op_name/source: a hit marks the collective
+    # planned (the annotation escape hatch for intentional reshards)
+    collective_allowlist: List[str] = field(default_factory=list)
+    # regexes vs named-scope paths: allowed f32 accumulation scopes
+    precision_allowlist: List[str] = field(default_factory=list)
+    # where audit-report.json lands (the doctor cross-reads it from the
+    # dump dir); default: resilience.snapshot_dir when set, else unwritten
+    report_dir: Optional[str] = None
+
+
+@register_config
+@dataclass
 class TelemetryConfig(ConfigModel):
     """Unified telemetry spine (``deepspeed_tpu/telemetry/``, see
     ``docs/observability.md``): step-phase span tracing, the crash flight
@@ -795,6 +828,7 @@ class DeepSpeedTPUConfig(ConfigModel):
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    analysis: AnalysisConfig = field(default_factory=AnalysisConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)
     eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
     quantize_training: Optional[QuantizeTrainingConfig] = None
@@ -832,6 +866,14 @@ class DeepSpeedTPUConfig(ConfigModel):
             d["telemetry"] = {"enabled": tl}
         elif isinstance(tl, str):
             d["telemetry"] = {"enabled": True, "flight_dir": tl}
+        # bool/string shorthand: "analysis": true runs the compile-time
+        # audit report-only; "analysis": "error" additionally fails
+        # compile() on findings at/above that severity
+        an = d.get("analysis")
+        if isinstance(an, bool):
+            d["analysis"] = {"enabled": an}
+        elif isinstance(an, str):
+            d["analysis"] = {"enabled": True, "fail_on": an}
         cl = d.pop("curriculum_learning", None)
         if cl:
             de = dict(d.get("data_efficiency") or {})

@@ -466,7 +466,7 @@ class DeepSpeedTPUEngine:
 
             op = dict(self.config.optimizer.params)
             self._host_adam = DeepSpeedCPUAdam(
-                jax.device_get(params),
+                jax.device_get(params),  # sync-ok: one-time offload init
                 lr=op.get("lr", 1e-3), betas=tuple(op.get("betas", (0.9, 0.999))),
                 eps=op.get("eps", 1e-8),
                 weight_decay=op.get("weight_decay", 0.0),
@@ -1151,7 +1151,7 @@ class DeepSpeedTPUEngine:
             # grad reduce, optimizer all live inside the compiled program)
             # without paying a per-step pipeline stall
             with span("compute/drain"):
-                jax.block_until_ready(metrics)
+                jax.block_until_ready(metrics)  # sync-ok: opt-in windowed drain
         # Metrics stay on device; ``_last_metrics`` converts lazily. A per-step
         # device->host sync here would serialize the async dispatch pipeline
         # (one full RTT per step on remote-attached TPUs). Overflow-skip
@@ -1214,6 +1214,7 @@ class DeepSpeedTPUEngine:
                 grads = jax.tree.map(lambda g: g * coef, grads)
         lr_t = float(np.asarray(self.lr_schedule(self.global_steps + 1)))
         emit_bf16 = jnp.dtype(self.compute_dtype) == jnp.dtype(jnp.bfloat16)
+        # sync-ok: ZeRO-Offload host optimizer step (opt-in offload path)
         new_np = self._host_adam.step(jax.device_get(grads), lr=lr_t,
                                       emit_bf16=emit_bf16)
         new_params = jax.device_put(new_np, self._param_shardings)
@@ -1462,17 +1463,95 @@ class DeepSpeedTPUEngine:
         rng = jax.random.PRNGKey(0)
         # keep the executable and route matching train_batch calls through
         # it — lower().compile() does NOT warm the jit dispatch cache, so
-        # discarding it would pay the 20-40s JIT twice
+        # discarding it would pay the 20-40s JIT twice. trace() is the
+        # same staging pipeline lower() runs internally; keeping the
+        # Traced around gives the static auditor the jaxpr for free.
         if self._host_adam is not None:
-            exe = self._train_step.lower(self.state.params, batch, rng,
-                                         self.state.step).compile()
+            traced = self._train_step.trace(self.state.params, batch, rng,
+                                            self.state.step)
         else:
-            exe = self._train_step.lower(self.state, batch, rng).compile()
+            traced = self._train_step.trace(self.state, batch, rng)
+        lowered = traced.lower()
+        exe = lowered.compile()
         self._aot_step = (exe, self._batch_fingerprint(batch))
         # the AOT path holds a real executable: its compile-time memory
         # breakdown is free — record it in the plan table + registry
         self._record_memory_analysis(exe, "train_step")
+        self._run_static_audit(traced, exe, "train_step", lowered=lowered)
         return self
+
+    def _run_static_audit(self, traced, compiled, label: str, lowered=None):
+        """Compile-time static audit (``deepspeed_tpu/analysis``, gated on
+        the ``analysis:`` config block): reconcile the compiled program's
+        collectives against the plan table / comms ledger / jaxpr, check
+        precision, donation, and host-sync hazards — all on the already-
+        staged objects, so the audit costs an HLO walk, not a recompile.
+        Findings land in the ledger's plan table, ``Analysis/*`` monitor
+        events, the telemetry registry, and (when a report dir is known)
+        ``audit-report.json`` beside the resilience dumps so the doctor
+        can cross-reference a hang against an unplanned collective."""
+        acfg = self.config.analysis
+        if not acfg.enabled:
+            return None
+        from ..analysis import AuditOptions, audit_step
+        from ..analysis.report import REPORT_NAME, SEVERITIES
+
+        if acfg.fail_on not in (None, "none") and acfg.fail_on not in SEVERITIES:
+            # a typo'd threshold must not silently disable the gate the
+            # user thinks is armed
+            raise ConfigError(
+                f"analysis.fail_on={acfg.fail_on!r}: use one of "
+                f"{SEVERITIES} (or null for report-only)")
+
+        opts = AuditOptions(
+            small_bytes=acfg.small_bytes, big_bytes=acfg.big_bytes,
+            precision_min_elems=acfg.precision_min_elems,
+            precision_big_elems=acfg.precision_big_elems,
+            donation_min_bytes=acfg.donation_min_bytes,
+            collective_allowlist=tuple(acfg.collective_allowlist),
+            precision_allowlist=tuple(acfg.precision_allowlist),
+            strict=acfg.strict)
+        ledger = dist.get_comms_logger()
+        report = audit_step(traced, label=label, options=opts,
+                            axis_sizes={str(k): int(v) for k, v in
+                                        dict(self.topo.mesh.shape).items()},
+                            plan_records=ledger.plan_records,
+                            ledger=ledger, lowered=lowered,
+                            compiled=compiled)
+        counts = report.counts()
+        summary = dict(counts)
+        for key in ("hlo_collectives", "matched_collectives",
+                    "unplanned_collectives", "unmatched_reductions"):
+            if key in report.context:
+                summary[key] = report.context[key]
+        ledger.record_analysis(label, summary)
+        if self.monitor is not None:
+            step = self.global_steps
+            events = [(f"Analysis/{label}/{sev}", counts[sev], step)
+                      for sev in counts]
+            events.append((f"Analysis/{label}/unplanned_collectives",
+                           report.context.get("unplanned_collectives", 0),
+                           step))
+            self.monitor.write_events(events)
+        if self.telemetry is not None:
+            self.telemetry.count("analysis_findings", len(report.findings))
+        report_dir = acfg.report_dir
+        if report_dir is None and self.config.resilience.enabled:
+            report_dir = self.config.resilience.snapshot_dir
+        if report_dir:
+            try:
+                os.makedirs(report_dir, exist_ok=True)
+                report.write(os.path.join(report_dir, REPORT_NAME))
+            except OSError as e:
+                log_dist(f"analysis: could not write {REPORT_NAME}: {e}")
+        for line in report.render().splitlines():
+            log_dist(f"analysis: {line}")
+        if acfg.fail_on in SEVERITIES and report.at_or_above(acfg.fail_on):
+            raise RuntimeError(
+                f"static audit failed ({acfg.fail_on}+ findings present "
+                f"and analysis.fail_on={acfg.fail_on!r}):\n"
+                + report.render())
+        return report
 
     @staticmethod
     def _batch_fingerprint(batch):
@@ -1767,6 +1846,7 @@ def _to_host_memory(tree, shardings, fallback: str = "keep"):
             out_leaves.append(jax.device_put(x, host_sh))
             out_shs.append(host_sh)
         except Exception:
+            # sync-ok: offload fallback when pinned-host memory is absent
             out_leaves.append(x if fallback == "keep" else jax.device_get(x))
             out_shs.append(sh)
     return (jax.tree.unflatten(treedef, out_leaves),
@@ -1841,6 +1921,7 @@ def initialize(args=None,
                                 topology=topology, param_specs=param_specs,
                                 batch_spec=batch_spec, optimizer=optimizer,
                                 lr_scheduler=lr_scheduler,
+                                donate_state=kwargs.get("donate_state", True),
                                 autotp_example_batch=kwargs.get(
                                     "autotp_example_batch"),
                                 frozen_params=kwargs.get("frozen_params"))

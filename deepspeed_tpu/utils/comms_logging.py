@@ -64,6 +64,10 @@ class CommsLogger:
         # (runtime/engine records these when a step compiles); per-program
         # facts like plan_records, so reset() keeps them too
         self.memory_records: Dict[str, Dict[str, Any]] = {}
+        # executable label -> static-audit summary (deepspeed_tpu/analysis,
+        # recorded by the engine's compile-time hook); per-program facts —
+        # reset() keeps them
+        self.analysis_records: Dict[str, Dict[str, Any]] = {}
 
     def configure(self, enabled=None, verbose=None, prof_all=None, prof_ops=None, debug=None):
         if enabled is not None:
@@ -119,6 +123,30 @@ class CommsLogger:
         knows what the program *needed*, not just what the allocator held."""
         self.memory_records[label] = dict(info)
 
+    def record_analysis(self, label: str, info: Dict[str, Any]) -> None:
+        """Record one compiled step's static-audit summary (error/warning/
+        info counts, unplanned-collective count) — surfaced in the plan
+        table so ``log_summary`` shows the audit verdict next to the plan
+        it was reconciled against."""
+        self.analysis_records[label] = dict(info)
+
+    def analysis_table_lines(self) -> List[str]:
+        """The audit-verdict table (one row per audited step), empty when
+        no audit has been recorded."""
+        if not self.analysis_records:
+            return []
+        header = (f"{'Audited step':<24}{'Errors':<8}{'Warnings':<10}"
+                  f"{'Info':<7}{'Unplanned':<11}{'Collectives':<12}")
+        lines = ["Static audit (analysis):", header, "-" * len(header)]
+        for label in sorted(self.analysis_records):
+            r = self.analysis_records[label]
+            lines.append(
+                f"{label:<24}{r.get('error', 0):<8}{r.get('warning', 0):<10}"
+                f"{r.get('info', 0):<7}"
+                f"{r.get('unplanned_collectives', 0):<11}"
+                f"{r.get('hlo_collectives', 0):<12}")
+        return lines
+
     def memory_table_lines(self) -> List[str]:
         """The executable-memory table (one row per compiled step), empty
         when nothing has been recorded."""
@@ -141,10 +169,14 @@ class CommsLogger:
 
     def plan_table_lines(self) -> List[str]:
         """The resolved-plan table (one row per site, plus the executable
-        memory rows when a compiled step recorded its breakdown), empty
-        when no planner decision has been recorded."""
+        memory and static-audit rows when a compiled step recorded them),
+        empty when nothing has been recorded."""
         if not self.plan_records:
-            return self.memory_table_lines()
+            lines = self.memory_table_lines()
+            audit = self.analysis_table_lines()
+            if audit:
+                lines += ([""] if lines else []) + audit
+            return lines
         header = (f"{'Consumer':<12}{'Op':<16}{'Shape':<18}"
                   f"{'Axes':<16}{'Impl':<14}{'Block':<8}{'Source':<12}"
                   f"{'Est(us)':<10}")
@@ -161,6 +193,9 @@ class CommsLogger:
         mem = self.memory_table_lines()
         if mem:
             lines += [""] + mem
+        audit = self.analysis_table_lines()
+        if audit:
+            lines += [""] + audit
         return lines
 
     def monitor_events(self, step: int, prefix: str = "Train/Comms"):

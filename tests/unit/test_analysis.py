@@ -1,0 +1,478 @@
+"""The graph auditor (deepspeed_tpu/analysis): the four checks, the HLO
+parser, the reconciliation contract, the engine compile-time hook, the
+doctor cross-link, and the CLI exit-code contract — all on the virtual
+8-device CPU mesh, no device step ever executed."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu as ds
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.analysis import (AuditOptions, AuditReport, ExpectedSite,
+                                    Finding, audit_compiled_text, audit_step,
+                                    jaxpr_collectives, parse_collectives,
+                                    plan_expected_sites)
+
+from ..conftest import require_devices
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+AXES = {"dp": 2, "tp": 4}
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "tp"))
+
+
+def _mlp_spec(mesh, which):
+    x = jnp.ones((32, 1024), jnp.bfloat16)
+    w1 = jnp.ones((1024, 4096), jnp.bfloat16)
+    w2 = jnp.ones((4096, 1024), jnp.bfloat16)
+
+    def step(x, w1, w2):
+        h = jnp.tanh(x @ w1)
+        return jnp.mean((h @ w2).astype(jnp.float32) ** 2)
+
+    def sh(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    if which == "clean":
+        in_sh = (sh("dp", None), sh(None, "tp"), sh("tp", None))
+    else:
+        in_sh = (sh("dp", None), sh("tp", None), sh("tp", None))
+    return step, (x, w1, w2), in_sh, sh()
+
+
+# ---------------------------------------------------------------------------
+# collective reconciliation: the acceptance-criterion pair
+# ---------------------------------------------------------------------------
+
+
+@require_devices(8)
+def test_misaligned_partition_spec_names_the_reshard():
+    step, args, in_sh, out_sh = _mlp_spec(_mesh(), "misaligned")
+    rep = audit_step(step, *args, in_shardings=in_sh, out_shardings=out_sh,
+                     axis_sizes=AXES, label="bad")
+    errs = [f for f in rep.by_check("collective") if f.severity == "error"]
+    assert errs, rep.render()
+    f = errs[0]
+    # the finding names the op kind, payload shape, axes, and the
+    # producing equation — before any step ran
+    assert f.detail["kind"] in ("all_gather", "all_to_all",
+                                "collective_permute")
+    assert f.detail["axes"] == "tp"
+    assert f.detail["nbytes"] >= 1 << 20
+    assert "dot_general" in (f.detail.get("op_name") or "")
+    assert rep.exit_code("error") == 2
+    assert rep.context["unplanned_collectives"] >= 1
+
+
+@require_devices(8)
+def test_clean_partition_spec_zero_unplanned():
+    step, args, in_sh, out_sh = _mlp_spec(_mesh(), "clean")
+    rep = audit_step(step, *args, in_shardings=in_sh, out_shardings=out_sh,
+                     axis_sizes=AXES, label="clean")
+    assert rep.context["unplanned_collectives"] == 0
+    assert rep.exit_code("error") == 0
+    # the row-parallel psum + dp mean are reductions, bucketed separately
+    assert rep.context["unmatched_reductions"] >= 1
+    # and zero fp32 upcasts on the bf16 path (the .astype feeds a
+    # reduction — the blessed accumulation shape)
+    assert rep.by_check("precision") == []
+
+
+@require_devices(8)
+def test_explicit_shard_map_psum_is_matched():
+    from deepspeed_tpu.utils.shard_map_compat import shard_map_nocheck
+
+    mesh = _mesh()
+
+    def f(x):
+        def body(xs):
+            return jax.lax.psum(xs.sum(), "tp")
+        return shard_map_nocheck(body, mesh, in_specs=P(None, "tp"),
+                                 out_specs=P())(x)
+
+    x = jnp.ones((8, 64), jnp.float32)
+    rep = audit_step(f, x, axis_sizes=AXES, label="explicit")
+    # the jaxpr psum covers the HLO all-reduce: nothing is unplanned and
+    # the reduction is MATCHED, not bucketed as partitioner-inserted
+    assert rep.context["unplanned_collectives"] == 0
+    assert rep.context["matched_collectives"] >= 1
+
+
+def test_jaxpr_collectives_extracts_axes_and_span():
+    from deepspeed_tpu.utils.shard_map_compat import shard_map_nocheck
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = _mesh()
+
+    def f(x):
+        def body(xs):
+            return jax.lax.psum(xs, "dp")
+        return shard_map_nocheck(body, mesh, in_specs=P("dp"),
+                                 out_specs=P("dp"))(x)
+
+    closed = jax.make_jaxpr(f)(jnp.ones((8,)))
+    sites = jaxpr_collectives(closed, AXES)
+    assert any(s.kind == "all_reduce" and s.span == 2 for s in sites)
+
+
+def test_plan_expected_sites_expand_programs():
+    plan = {"dp-grad:all_reduce:128:float32@dp": {
+        "op": "all_reduce", "axes": "dp",
+        "program": "rs(ep)>ar.int8_ef(dp_outer)>ag(ep)"}}
+    sites = plan_expected_sites(plan, {"dp": 8, "ep": 2, "dp_outer": 4})
+    kinds = {(s.kind, s.span) for s in sites}
+    # the program phases contribute their own (kind, span) pairs
+    assert ("reduce_scatter", 2) in kinds
+    assert ("all_reduce", 4) in kinds
+    assert ("all_gather", 2) in kinds
+
+
+def test_reconcile_against_raw_hlo_text():
+    hlo = ('%ag = f32[4,1024]{1,0} all-gather(f32[4,256]{1,0} %p), '
+           'channel_id=1, replica_groups=[2,4]<=[8], dimensions={1}, '
+           'metadata={op_name="jit(step)/dot_general" '
+           'source_file="model.py" source_line=10}')
+    rep = audit_compiled_text(hlo, expected=(), axis_sizes=AXES)
+    assert rep.context["unplanned_collectives"] == 1
+    f = rep.findings[0]
+    assert f.detail["kind"] == "all_gather" and f.detail["axes"] == "tp"
+    # an expected site with matching kind+span silences it
+    rep2 = audit_compiled_text(
+        hlo, expected=[ExpectedSite("all_gather", 4, "plan")],
+        axis_sizes=AXES)
+    assert rep2.context["unplanned_collectives"] == 0
+    # the allow-list regex silences it too
+    rep3 = audit_compiled_text(
+        hlo, axis_sizes=AXES,
+        options=AuditOptions(collective_allowlist=(r"jit\(step\)",)))
+    assert rep3.context["unplanned_collectives"] == 0
+
+
+def test_ledger_all_reduce_does_not_mask_resharding_gathers():
+    # a plain all-reduce row must expect ONLY all-reduces — otherwise any
+    # ledgered DP grad reduce would silence every implicit all-gather and
+    # the flagship check would go dark whenever comms logging is on
+    from deepspeed_tpu.analysis import ledger_expected_sites
+
+    class FakeLedger:
+        comms_dict = {"quantized_all_reduce": {}}
+
+    kinds = {s.kind for s in ledger_expected_sites(FakeLedger())}
+    assert kinds == {"all_reduce"}
+    hlo = ('%ag = f32[4,1024]{1,0} all-gather(f32[4,256]{1,0} %p), '
+           'channel_id=1, replica_groups=[2,4]<=[8], dimensions={1}')
+    rep = audit_compiled_text(hlo,
+                              expected=ledger_expected_sites(FakeLedger()),
+                              axis_sizes=AXES)
+    assert rep.context["unplanned_collectives"] == 1
+
+    class Hier:  # two-level lowerings legitimately emit rs/ag phases
+        comms_dict = {"hierarchical_quantized_all_reduce": {}}
+
+    kinds = {s.kind for s in ledger_expected_sites(Hier())}
+    assert {"all_reduce", "reduce_scatter", "all_gather"} <= kinds
+
+
+def test_parse_collectives_formats():
+    text = "\n".join([
+        '%ar = f32[128]{0} all-reduce(f32[128]{0} %a), channel_id=2, '
+        'replica_groups={{0,1},{2,3}}, to_apply=%add',
+        '%cp-start = f32[8,8]{1,0} collective-permute-start(f32[8,8]{1,0} '
+        '%b), channel_id=3, source_target_pairs={{0,1},{1,0}}',
+        '%cp-done = f32[8,8]{1,0} collective-permute-done(f32[8,8]{1,0} '
+        '%cp-start)',
+        '%unrelated = f32[4]{0} add(f32[4]{0} %x, f32[4]{0} %y)'])
+    cols = parse_collectives(text)
+    assert [c.kind for c in cols] == ["all_reduce", "collective_permute"]
+    assert cols[0].group_size == 2        # explicit replica group list
+    assert cols[0].nbytes == 128 * 4
+    assert cols[1].hlo_op == "collective-permute-start"
+
+
+# ---------------------------------------------------------------------------
+# precision leaks
+# ---------------------------------------------------------------------------
+
+
+def test_precision_upcast_feeding_matmul_flagged():
+    w = jnp.ones((512, 512), jnp.bfloat16)
+
+    def f(x):
+        h = x.astype(jnp.float32)       # big upcast...
+        return (h @ w.astype(jnp.float32)).sum()  # ...runs the matmul at f32
+
+    rep = audit_step(f, jnp.ones((512, 512), jnp.bfloat16), compile=False)
+    leaks = rep.by_check("precision")
+    assert leaks and leaks[0].detail["kind"] == "heavy"
+
+
+def test_precision_accumulation_allowed():
+    def f(x):
+        return x.astype(jnp.float32).sum()  # f32 accumulation: blessed
+
+    rep = audit_step(f, jnp.ones((512, 512), jnp.bfloat16), compile=False)
+    assert rep.by_check("precision") == []
+
+
+def test_precision_master_update_pattern_allowed():
+    # upcast -> add -> cast back down: the mixed-precision master update
+    def f(p, u):
+        return (p.astype(jnp.float32) + u.astype(jnp.float32)).astype(
+            jnp.bfloat16)
+
+    rep = audit_step(f, jnp.ones((512, 512), jnp.bfloat16),
+                     jnp.ones((512, 512), jnp.bfloat16), compile=False)
+    assert rep.by_check("precision") == []
+
+
+def test_precision_scope_allowlist():
+    w = jnp.ones((512, 512), jnp.bfloat16)
+
+    def f(x):
+        with jax.named_scope("blessed_path"):
+            return (x.astype(jnp.float32) @ w.astype(jnp.float32)).sum()
+
+    rep = audit_step(f, jnp.ones((512, 512), jnp.bfloat16), compile=False,
+                     options=AuditOptions(
+                         precision_allowlist=(r"blessed_path",)))
+    assert rep.by_check("precision") == []
+
+
+def test_precision_small_upcasts_ignored():
+    def f(x):
+        return (x.astype(jnp.float32) @ jnp.ones((8, 8))).sum()
+
+    rep = audit_step(f, jnp.ones((8, 8), jnp.bfloat16), compile=False)
+    assert rep.by_check("precision") == []
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+
+
+def _update_step(state, b):
+    g = jax.grad(lambda p: jnp.mean((b @ p) ** 2))(state["p"])
+    return {"p": state["p"] - 0.1 * g}, jnp.mean(b)
+
+
+def test_donation_miss_flagged_with_bytes():
+    state = {"p": jnp.ones((512, 1024), jnp.float32)}  # 2 MiB
+    b = jnp.ones((8, 512), jnp.float32)
+    rep = audit_step(_update_step, state, b)
+    misses = rep.by_check("donation")
+    assert misses
+    assert misses[0].detail["nbytes"] == 512 * 1024 * 4
+    assert "p" in misses[0].detail["arg"]
+    assert rep.context["donation"]["wasted_bytes_estimate"] >= 1 << 21
+
+
+def test_donated_state_is_clean():
+    state = {"p": jnp.ones((512, 1024), jnp.float32)}
+    b = jnp.ones((8, 512), jnp.float32)
+    rep = audit_step(_update_step, state, b, donate_argnums=(0,))
+    assert rep.by_check("donation") == []
+
+
+# ---------------------------------------------------------------------------
+# host-sync / retrace hazards
+# ---------------------------------------------------------------------------
+
+
+def test_callback_in_step_flagged():
+    def f(x):
+        jax.debug.callback(lambda v: None, x.sum())
+        return x * 2
+
+    rep = audit_step(f, jnp.ones((4,)), compile=False)
+    hs = rep.by_check("host_sync")
+    assert any("callback" in h.detail.get("primitive", "") for h in hs)
+
+
+def test_weak_typed_scalar_argument_flagged():
+    def f(x, lr):
+        return x * lr
+
+    rep = audit_step(f, jnp.ones((4,)), 0.1, compile=False)
+    hs = rep.by_check("host_sync")
+    assert any("weak-typed" in h.summary for h in hs)
+
+
+def test_clean_step_has_no_host_sync_findings():
+    rep = audit_step(lambda x: x * 2.0, jnp.ones((4,)), compile=False)
+    assert rep.by_check("host_sync") == []
+
+
+# ---------------------------------------------------------------------------
+# report model
+# ---------------------------------------------------------------------------
+
+
+def test_report_roundtrip_and_exit_codes(tmp_path):
+    rep = AuditReport("t")
+    rep.add("collective", "error", "boom", kind="all_gather")
+    rep.add("precision", "warning", "warm")
+    rep.add("host_sync", "info", "fyi")
+    assert rep.max_severity() == "error"
+    assert rep.counts() == {"info": 1, "warning": 1, "error": 1}
+    assert rep.exit_code("error") == 2
+    assert rep.exit_code("warning") == 2
+    assert AuditReport("empty").exit_code("info") == 0
+    path = rep.write(str(tmp_path / "audit-report.json"))
+    back = AuditReport.load(path)
+    assert back.counts() == rep.counts()
+    assert back.findings[0].check == "collective"
+    with pytest.raises(ValueError):
+        Finding("nope", "error", "x")
+    with pytest.raises(ValueError):
+        Finding("collective", "fatal", "x")
+
+
+# ---------------------------------------------------------------------------
+# engine compile-time hook
+# ---------------------------------------------------------------------------
+
+
+def _tiny_engine(tmp_path, analysis_cfg, donate=True):
+    params = {"w1": jnp.ones((512, 1024), jnp.float32),
+              "w2": jnp.ones((1024, 8), jnp.float32)}
+
+    def loss_fn(p, batch, rng=None):
+        x, y = batch
+        return jnp.mean((jnp.tanh(x @ p["w1"]) @ p["w2"] - y) ** 2)
+
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 0}, "steps_per_print": 10**9,
+           "analysis": analysis_cfg}
+    eng, *_ = ds.initialize(model=loss_fn, model_parameters=params,
+                            config=cfg, donate_state=donate)
+    batch = (jnp.ones((16, 512)), jnp.ones((16, 8)))
+    return eng, batch
+
+
+def test_engine_compile_hook_records_plan_table_rows(tmp_path):
+    eng, batch = _tiny_engine(tmp_path, {"enabled": True,
+                                         "report_dir": str(tmp_path)})
+    eng.compile(batch)
+    rec = dist.get_comms_logger().analysis_records.get("train_step")
+    assert rec is not None and rec["error"] == 0
+    # the report file landed where the doctor will look
+    doc = json.load(open(tmp_path / "audit-report.json"))
+    assert doc["label"] == "train_step"
+    # and the plan table renders the audit row
+    lines = dist.get_comms_logger().plan_table_lines()
+    assert any("Static audit" in ln for ln in lines)
+
+
+def test_engine_hook_flags_disabled_donation(tmp_path):
+    eng, batch = _tiny_engine(tmp_path, True, donate=False)
+    eng.compile(batch)
+    rec = dist.get_comms_logger().analysis_records.get("train_step")
+    assert rec["warning"] >= 1  # the non-donated param/opt-state buffers
+
+
+def test_engine_fail_on_raises_at_compile(tmp_path):
+    eng, batch = _tiny_engine(tmp_path, "warning", donate=False)
+    with pytest.raises(RuntimeError, match="static audit failed"):
+        eng.compile(batch)
+
+
+def test_engine_invalid_fail_on_raises(tmp_path):
+    # a typo'd threshold must not silently disarm the gate
+    from deepspeed_tpu.runtime.config_utils import ConfigError
+
+    eng, batch = _tiny_engine(tmp_path, {"enabled": True, "fail_on": "warn"})
+    with pytest.raises(ConfigError, match="fail_on"):
+        eng.compile(batch)
+
+
+def test_engine_analysis_off_by_default(tmp_path):
+    eng, batch = _tiny_engine(tmp_path, {"enabled": False})
+    dist.get_comms_logger().analysis_records.clear()
+    eng.compile(batch)
+    assert dist.get_comms_logger().analysis_records == {}
+
+
+# ---------------------------------------------------------------------------
+# doctor cross-link
+# ---------------------------------------------------------------------------
+
+
+def test_doctor_reads_audit_report(tmp_path):
+    from deepspeed_tpu.doctor import load_audit_report
+
+    rep = AuditReport("train_step")
+    rep.add("collective", "error", "implicit reshard",
+            kind="all_gather", axes="tp", shape="bf16[1024x4096]")
+    rep.add("precision", "warning", "upcast")  # non-collective: filtered
+    rep.write(str(tmp_path / "audit-report.json"))
+    a = load_audit_report(str(tmp_path))
+    assert a["counts"]["error"] == 1
+    assert a["unplanned"] == [{"kind": "all_gather", "axes": "tp",
+                               "shape": "bf16[1024x4096]",
+                               "severity": "error"}]
+    assert load_audit_report(str(tmp_path / "missing")) is None
+
+
+def test_doctor_desync_verdict_cites_unplanned_collective(tmp_path):
+    from deepspeed_tpu.doctor import _classify
+
+    desync = {"first_divergent_seq": 7, "kind": "mismatch",
+              "divergent_ranks": [1], "majority": "all_reduce [128]",
+              "per_rank": {"1": {"signature": "all_gather [256]"}}}
+    audit = {"counts": {"error": 1},
+             "unplanned": [{"kind": "all_gather", "axes": "tp"}]}
+    dumps = {0: {"reason": "watchdog"}, 1: {"reason": "watchdog"}}
+    verdict, evidence = _classify(dumps, [], desync, None,
+                                  {"dead": [], "stragglers": [], "rows": {}},
+                                  {}, 2, audit=audit)
+    assert verdict == "desync"
+    assert any("UNPLANNED" in e for e in evidence)
+
+
+# ---------------------------------------------------------------------------
+# CLI (subprocess: the exit-code contract end to end)
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    return subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.audit", *args],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+
+
+def test_cli_misaligned_demo_exits_2():
+    r = _run_cli("--demo", "misaligned")
+    assert r.returncode == 2, r.stderr[-2000:]
+    assert "implicit resharding" in r.stdout
+    assert "tp" in r.stdout
+
+
+def test_cli_clean_demo_exits_0(tmp_path):
+    out = str(tmp_path / "audit-report.json")
+    r = _run_cli("--demo", "clean", "--json", "--out", out)
+    assert r.returncode == 0, r.stderr[-2000:]
+    doc = json.loads(r.stdout)
+    assert doc["context"]["unplanned_collectives"] == 0
+    assert json.load(open(out))["label"] == "demo-clean"
+
+
+def test_cli_usage_error_exits_1():
+    r = _run_cli()
+    assert r.returncode == 1
