@@ -1539,6 +1539,74 @@ def static_audit_bench():
             "device": jax.devices()[0].platform}
 
 
+def control_bench():
+    """Rung at (control plane, deepspeed_tpu/control/): (1) Autotuner v2
+    probe cost — wall-clock per candidate through the in-process
+    engine-warmup path (grid over gas x compression, cache off so every
+    probe is real), the number an operator budgets tuning time with; and
+    (2) the supervisor decision loop's per-step cost with control ARMED
+    but no signal firing (the steady-state tax every training step pays:
+    three rule evaluations through the flap guard) vs the disarmed path's
+    single attribute check. Gate direction: lower-is-better on the armed
+    decision loop — a supervisor that starts re-reading health tables or
+    allocating per step must fail CI."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.control import ControlAutotuner
+    from deepspeed_tpu.parallel.topology import reset_topology
+
+    reset_topology()
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.normal(size=(64, 64)) * 0.05,
+                               jnp.float32)}
+
+    def loss(p, b, rng=None):
+        return jnp.mean((b @ p["w"]) ** 2)
+
+    def batch_fn(gbs):
+        r = np.random.default_rng(0)
+        return jnp.asarray(r.normal(size=(max(int(gbs), 8), 64)), np.float32)
+
+    base = {"train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "steps_per_print": 10**9}
+    at = ControlAutotuner(base, dims=("gas", "compression"),
+                          warmup_steps=1, measure_steps=1,
+                          tuner_type="gridsearch", use_cache=False,
+                          probe_programs=False)
+    t0 = time.perf_counter()
+    at.tune(loss, params, batch_fn)
+    probe_ms = (time.perf_counter() - t0) / max(1, at.probes_run) * 1e3
+
+    # decision loop armed (no signal fires) vs the disarmed attribute check
+    eng, *_ = ds.initialize(model=loss, model_parameters=params,
+                            config={**base, "control": True})
+    sup = eng.control
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        sup.on_step()
+    armed_ns = (time.perf_counter() - t0) / n * 1e9
+    eng_off, *_ = ds.initialize(model=loss, model_parameters=params,
+                                config=dict(base))
+    t0 = time.perf_counter()
+    acc = 0
+    for _ in range(n):
+        if eng_off.control is not None:  # the entire disabled-path cost
+            acc += 1
+    off_ns = (time.perf_counter() - t0) / n * 1e9
+
+    return {"metric": "control_decide_ns",
+            "value": round(armed_ns, 1), "unit": "ns/step",
+            "vs_baseline": None,
+            "decide_off_ns": round(off_ns, 2),
+            "autotune_probe_ms": round(probe_ms, 1),
+            "autotune_probes": at.probes_run,
+            "autotune_grid": at.grid_size,
+            "autotune_winner": at.best["name"],
+            "ledger_entries": len(sup.ledger),
+            "device": jax.devices()[0].platform}
+
+
 RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "3b": rung3b_big_model,
          "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses,
@@ -1547,7 +1615,7 @@ RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "wd": watchdog_bench, "fl": fused_hotpath_bench,
          "sv": serving_bench, "ds": dcn_hierarchical_bench,
          "ob": telemetry_bench, "mem": memory_telemetry_bench,
-         "sa": static_audit_bench}
+         "sa": static_audit_bench, "at": control_bench}
 
 
 # ---------------------------------------------------------------------------
@@ -1569,6 +1637,7 @@ GATE_SPECS = {
     "telemetry_span_overhead_ns": ("lower", 1.0),
     "collective_ring_overhead_ns": ("lower", 1.0),
     "static_audit_train_ms": ("lower", 1.0),     # host walk: wall-clock noise
+    "control_decide_ns": ("lower", 1.0),         # supervisor loop: host cost
     "dcn_hierarchical": ("higher", 0.05),        # ledger bytes: deterministic
     "llama_zero3_bf16_mfu": ("higher", 0.15),    # the TPU headline: tight
 }
@@ -1706,7 +1775,11 @@ def run_ladder(gate: bool = False):
             ("mem", chip),
             # sa times the static auditor itself (host-side HLO/jaxpr
             # walks — device-independent, one CPU process is the substrate)
-            ("sa", cpu1)]
+            ("sa", cpu1),
+            # at times the control plane: autotune probes are real engine
+            # builds (8-dev mesh matches the test/drill substrate), the
+            # decision loop is pure host work
+            ("at", cpu8)]
     results = []
     for rung, env_over in plan:
         env = dict(os.environ)
@@ -1775,7 +1848,7 @@ if __name__ == "__main__":
 
         flags_preset = ("--xla_force_host_platform_device_count"
                         in os.environ.get("XLA_FLAGS", ""))
-        needs_cpu8 = args.rung in ("4", "5", "ds")
+        needs_cpu8 = args.rung in ("4", "5", "ds", "at")
         if args.rung in ("cm", "qx", "plan") and not flags_preset:
             # these run on the real mesh only when it's healthy AND >1 chip
             # (subprocess probes; this process must not init the backend yet)

@@ -173,6 +173,68 @@ class CollectivePlanner:
         self._record(site, decision)
         return decision
 
+    def replan_around(self, slow_axes: Sequence[str], *,
+                      penalty: float = 4.0,
+                      consumers: Sequence[str] = GRADIENT_CONSUMERS) -> bool:
+        """Control-plane re-plan: demote the named mesh axes to DCN-class
+        links (a straggler's link IS a slow cross-host link, whatever the
+        nominal topology says), penalize them by the observed slowdown,
+        and forget every decision for ``consumers`` so the next resolve
+        re-synthesizes against the demoted fingerprint — hierarchical
+        programs whose full-width phases EXCLUDE the slow axes become
+        eligible (and, with the penalty, win).
+
+        The fingerprint mutation re-keys the plan/cache identity exactly
+        like the ``comm_planner.dcn_axes`` override does, so a replanned
+        decision can never pollute this mesh's organic cache entry — and a
+        restart that performs the same demotion resolves the same cached
+        replanned plan. Returns False (no state touched) when none of the
+        axes name a multi-rank mesh axis or the planner is off.
+
+        ``consumers`` defaults to every gradient consumer (dp-grad AND
+        zeropp) so a ZeRO++ factory rebuilt after the demotion re-resolves
+        against the demoted links too — keeping only one consumer would
+        re-persist the other's stale fast-link decisions under the new
+        fingerprint."""
+        if self.mode == "off":
+            return False
+        known = {n for n, s in self.fingerprint.axis_sizes if s > 1}
+        slow = tuple(a for a in slow_axes if a in known)
+        if not slow:
+            return False
+        self.fingerprint = dataclasses.replace(
+            self.fingerprint,
+            dcn_axes=tuple(sorted(set(self.fingerprint.dcn_axes)
+                                  | set(slow))))
+        penalties = dict(self.cost.link_penalties)
+        for a in slow:
+            penalties[a] = max(penalties.get(a, 1.0), float(penalty))
+        # fleet costing: the demoted link is priced as the slow cross-host
+        # hop it behaves as; quant at accelerator rates, as with dcn_axes
+        self.cost = CostModel(self.fingerprint, block=self.block,
+                              assume_fleet=True, link_penalties=penalties)
+        drop = {sig for sig in self.plan.decisions
+                if sig.split(":", 1)[0] in set(consumers)}
+        self.plan = Plan(
+            fingerprint=self.fingerprint.digest(),
+            decisions={sig: d for sig, d in self.plan.decisions.items()
+                       if sig not in drop})
+        self._from_cache -= drop
+        self._agreed -= drop
+        self._recorded -= drop
+        if self.cache is not None:
+            # a PREVIOUS run already measured under this demoted identity:
+            # load its decisions (current in-memory ones win) so a restart
+            # that repeats the demotion reuses them instead of re-running
+            # microbenchmarks mid-training
+            cached = self.cache.load(self.fingerprint)
+            if cached is not None:
+                for sig, d in cached.decisions.items():
+                    if sig not in self.plan.decisions:
+                        self.plan.decisions[sig] = d
+                        self._from_cache.add(sig)
+        return True
+
     def _agree(self, decision: PlanDecision) -> PlanDecision:
         """Rank 0's decision, on every process (no-op single-process)."""
         import jax

@@ -127,21 +127,45 @@ class CostModel:
     """
 
     def __init__(self, fingerprint: MeshFingerprint,
-                 block: int = _DEFAULT_BLOCK, assume_fleet: bool = False):
+                 block: int = _DEFAULT_BLOCK, assume_fleet: bool = False,
+                 link_penalties: Optional[Dict[str, float]] = None):
         self.fp = fingerprint
         self.block = block
         platform = "tpu" if assume_fleet else fingerprint.platform
         self.quant_cost = QUANT_COST_PER_BYTE.get(platform, _QUANT_DEFAULT)
         self.quant_fixed = QUANT_FIXED
+        # per-axis cost multipliers (alpha AND beta): the control plane's
+        # straggler re-plan marks the slow host's link here so every
+        # candidate that touches it is priced at its OBSERVED slowness,
+        # not the link class's nominal figure
+        self.link_penalties: Dict[str, float] = dict(link_penalties or {})
+
+    def _penalized(self, lp: LinkParams,
+                   axes: Tuple[str, ...]) -> LinkParams:
+        f = 1.0
+        for a in axes:
+            f = max(f, float(self.link_penalties.get(a, 1.0)))
+        if f == 1.0:
+            return lp
+        return LinkParams(alpha=lp.alpha * f, beta=lp.beta * f)
 
     def link(self, axes: Tuple[str, ...]) -> LinkParams:
         if any(a in self.fp.dcn_axes for a in axes):
-            return LINK_TABLE["dcn"]
+            return self._penalized(LINK_TABLE["dcn"], axes)
         if self.fp.platform == "tpu" or self.fp.dcn_axes:
             # a mesh that DISTINGUISHES DCN axes makes every other axis
             # slice-local interconnect by definition
-            return LINK_TABLE["ici"]
-        return LINK_TABLE["host"]
+            return self._penalized(LINK_TABLE["ici"], axes)
+        return self._penalized(LINK_TABLE["host"], axes)
+
+    def link_params(self, link: Optional[str],
+                    axes: Tuple[str, ...]) -> LinkParams:
+        """A phase's link params: the stamped link class when the program
+        carries one (penalties still apply — a demoted slow axis stays
+        expensive whatever class synthesis stamped), else by axes."""
+        if link:
+            return self._penalized(LINK_TABLE[link], axes)
+        return self.link(axes)
 
     def dcn_split(self, site: CollectiveSite) -> Tuple[Tuple[str, ...],
                                                        Tuple[str, ...]]:
@@ -269,7 +293,7 @@ class CostModel:
             p = self.fp.axis_size(st.axes)
             if p <= 1:
                 continue
-            lp = LINK_TABLE[st.link] if st.link else self.link(st.axes)
+            lp = self.link_params(st.link, st.axes)
             hops = p - 1
             q = self._wire_ratio(site.dtype) if st.quantized else 1.0
             if st.via == "ring":

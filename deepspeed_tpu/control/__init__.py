@@ -1,0 +1,39 @@
+"""Self-driving fleet control plane: close the loop from telemetry to knobs.
+
+Every signal a production operator reads (planner microbench timings,
+HealthTable straggler/dead verdicts, ``dstpu_mem_*`` gauges, ServingMetrics
+SLA counters, sentinel rollbacks, doctor verdicts) and every knob they turn
+(planner impl/program selection, compression mode, fastpath, remat,
+micro-batch/GAS, replica drain/scale, degraded mode) existed before this
+subsystem — but a human sat between them. This package is the loop closure,
+in two halves sharing one decision ledger:
+
+- **Autotuner v2** (:mod:`.autotune`) — offline-ish: short measured probes
+  over the generalized knob space {GAS, remat policy, training_fastpath,
+  compressed_collectives, planner program variants}, winners cached per
+  mesh-fingerprint digest beside the comm-plan cache (:mod:`.winners`) so a
+  restart on the same mesh re-applies them with zero probes.
+- **Supervisor policy** (:mod:`.supervisor`, rule book in :mod:`.policy`) —
+  online: reacts to live signals through a hysteresis/cooldown/budget flap
+  guard (:mod:`.guard`); every automated decision is a ledger entry
+  (:mod:`.ledger`) that rides flight dumps, Prometheus
+  (``dstpu_control_actions_total``), ``Control/*`` monitor events, and the
+  doctor's post-mortem report.
+
+Gated behind the ``control:`` config block — disabled (the default)
+constructs nothing and engine stepping is bit-identical. See
+``docs/autotuning.md``.
+"""
+
+from .autotune import (ControlAutotuner, build_space, dim_candidates,
+                       probe_collective_programs)
+from .guard import FlapGuard
+from .ledger import ControlAction, ControlLedger, describe_action
+from .policy import POLICY_TABLE, RULE_NAMES
+from .supervisor import ControlSupervisor
+from .winners import WinnerCache, space_signature
+
+__all__ = ["ControlSupervisor", "ControlAutotuner", "ControlLedger",
+           "ControlAction", "FlapGuard", "WinnerCache", "space_signature",
+           "describe_action", "build_space", "dim_candidates",
+           "probe_collective_programs", "POLICY_TABLE", "RULE_NAMES"]

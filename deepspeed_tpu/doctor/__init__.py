@@ -46,6 +46,18 @@ except ImportError:  # loaded standalone (file-path import)
 
     logger = logging.getLogger("deepspeed_tpu.doctor")
 
+try:
+    from ..control.ledger import describe_action as _describe_action
+except ImportError:  # standalone load: a minimal local renderer
+    def _describe_action(entry):
+        bits = [f"step {entry.get('step')}: {entry.get('action')}"]
+        if entry.get("reason"):
+            bits.append(f"— {entry['reason']}")
+        outcome = entry.get("outcome")
+        if outcome and outcome != "ok":
+            bits.append(f"[{outcome}]")
+        return " ".join(bits)
+
 REPORT_NAME = "doctor-report.json"
 # exit codes: the desync verdict must be assertable from CI
 EXIT_CLEAN = 0
@@ -341,6 +353,8 @@ def _rank_summary(doc: dict) -> dict:
         out["fired_step"] = doc["fired_step"]
     if doc.get("mem"):
         out["mem"] = doc["mem"]
+    if doc.get("control"):
+        out["control_actions"] = len(doc["control"])
     return out
 
 
@@ -393,10 +407,29 @@ def diagnose(directory: str, *, world: Optional[int] = None,
         phases.setdefault(ph, []).append(r)
     phases = {ph: sorted(rs) for ph, rs in sorted(phases.items())}
 
+    # control ledger: every flight dump carries the supervisor's automated
+    # decisions — the post-mortem must explain a knob that moved by itself
+    supervisor_actions: List[dict] = []
+    for r, doc in sorted(dumps.items()):
+        for entry in doc.get("control") or []:
+            if isinstance(entry, dict):
+                supervisor_actions.append({"rank": r, **entry})
+    supervisor_actions.sort(key=lambda e: (e.get("wall_time") or 0.0,
+                                           e.get("rank", 0),
+                                           e.get("seq", 0)))
+
     audit = load_audit_report(directory)
     verdict, evidence = _classify(dumps, missing, desync, plan_mismatch,
                                   health, phases, expected, hangs,
                                   audit=audit)
+    acted = [a for a in supervisor_actions
+             if (a.get("outcome") or "ok") == "ok"]
+    if acted:
+        last = acted[-1]
+        evidence.append(
+            f"the supervisor acted {len(acted)}x before this state "
+            f"(last: rank {last.get('rank')} {_describe_action(last)}) — "
+            "see the supervisor-action lines")
     return {
         "version": 1,
         "dir": os.path.abspath(directory),
@@ -410,6 +443,7 @@ def diagnose(directory: str, *, world: Optional[int] = None,
         "health": health,
         "phases": phases,
         "audit": audit,
+        "supervisor_actions": supervisor_actions,
         "verdict": verdict,
         "evidence": evidence,
     }
@@ -581,6 +615,9 @@ def render_report(report: dict) -> str:
             f"static audit ({a.get('label')}): {c.get('error', 0)} error / "
             f"{c.get('warning', 0)} warning; "
             f"{len(a.get('unplanned') or [])} unplanned collective(s)")
+    for act in (report.get("supervisor_actions") or [])[-12:]:
+        lines.append(f"supervisor action: rank {act.get('rank')} "
+                     + _describe_action(act))
     if report["phases"]:
         lines.append("last phase per rank:")
         for ph, rs in report["phases"].items():

@@ -396,6 +396,12 @@ class ActivationCheckpointingConfig(ConfigModel):
     profile: bool = False
     # jax-native: remat policy name ('nothing_saveable','dots_saveable',...)
     policy: Optional[str] = None
+    # apply ``policy`` as one jax.checkpoint wrap around the WHOLE loss at
+    # the engine (the control plane's remat actuator / autotune 'remat'
+    # dim). Opt-in: models using the per-layer compat API
+    # (``deepspeed_tpu.checkpointing.checkpoint``) read the same ``policy``
+    # field, and wrapping the engine on top would double-rematerialize.
+    engine_wrap: bool = False
 
 
 @register_config
@@ -638,6 +644,87 @@ class TelemetryConfig(ConfigModel):
 
 @register_config
 @dataclass
+class ControlGuardConfig(ConfigModel):
+    """Flap guard for automated actions (``control/guard.py``): an action
+    fires only after ``trigger_streak`` consecutive asserted observations,
+    re-arms only after ``clear_streak`` consecutive clear ones, waits
+    ``cooldown_s`` between firings of the same rule, and the whole
+    supervisor stops acting once ``budget`` actions fired within
+    ``budget_window_s`` (observing and ledgering continue)."""
+    trigger_streak: int = 2
+    clear_streak: int = 2
+    cooldown_s: float = 120.0
+    budget: int = 8
+    budget_window_s: float = 3600.0
+
+
+@register_config
+@dataclass
+class ControlAutotuneConfig(ConfigModel):
+    """Autotuner v2 (``control/autotune.py``): the generalized knob search
+    {GAS, remat, training_fastpath, compressed_collectives, +stage/
+    micro_batch}, probed with the in-process engine-warmup path and cached
+    per mesh-fingerprint digest beside the comm-plan cache. Invoked
+    explicitly — never implicitly at ``initialize()``: this block
+    parameterizes ``ControlAutotuner.from_config(ds_config)`` (or pass the
+    knobs directly to ``ControlAutotuner(...)``)."""
+    enabled: bool = False
+    dims: List[str] = field(default_factory=lambda: [
+        "gas", "remat", "fastpath", "compression"])
+    metric: str = "throughput"
+    warmup_steps: int = 1
+    measure_steps: int = 2
+    tuner_type: str = "model"     # model | gridsearch | random
+    early_stop: int = 3           # model/random tuner early-stop patience
+    use_cache: bool = True        # per-mesh winner cache (DSTPU_PLAN_CACHE)
+    cache_dir: Optional[str] = None  # default: the comm-plan cache dir
+    probe_programs: bool = True   # microbench the dp-grad program variants
+
+
+@register_config
+@dataclass
+class ControlSupervisorConfig(ConfigModel):
+    """Online supervisor policy (``control/supervisor.py``): the rule book
+    reacting to live signals. Rule toggles gate each signal->action edge
+    independently; ``replan_axes`` overrides which mesh axes a straggler
+    re-plan treats as the slow link (default: fingerprint DCN axes, else
+    the outermost dp axis of a multi-axis span)."""
+    enabled: bool = True              # within an enabled control block
+    interval_steps: int = 1           # rule-evaluation cadence (steps)
+    straggler_replan: bool = True
+    straggler_penalty: float = 4.0    # slow-link cost multiplier floor
+    replan_axes: Optional[List[str]] = None
+    memory_guard: bool = True
+    mem_watermark: float = 0.92       # bytes_in_use / bytes_limit trigger
+    sla_guard: bool = True
+    sla_violation_rate: float = 0.5   # violations / tracked per tick
+    sla_min_tracked: int = 8          # finishes per tick before judging
+    rollback_degrade: bool = True
+    rollback_threshold: int = 2
+    rollback_window_s: float = 600.0
+
+
+@register_config
+@dataclass
+class ControlConfig(ConfigModel):
+    """Control-plane subsystem (``deepspeed_tpu/control/``, see
+    ``docs/autotuning.md``): Autotuner v2 + the online supervisor policy,
+    sharing one decision ledger that rides flight dumps, the Prometheus
+    registry (``dstpu_control_actions_total``), ``Control/*`` monitor
+    events, and the doctor's post-mortem. Disabled by default — nothing is
+    constructed and engine stepping is bit-identical. Also accepted as a
+    bare bool (``"control": true``)."""
+    enabled: bool = False
+    ledger_size: int = 256
+    autotune: ControlAutotuneConfig = field(
+        default_factory=ControlAutotuneConfig)
+    supervisor: ControlSupervisorConfig = field(
+        default_factory=ControlSupervisorConfig)
+    guard: ControlGuardConfig = field(default_factory=ControlGuardConfig)
+
+
+@register_config
+@dataclass
 class ServingConfig(ConfigModel):
     """Serving tier (``deepspeed_tpu/serving/``): continuous-batching
     ``LLMServer`` over the ``inference/v2`` ragged engine.
@@ -829,6 +916,7 @@ class DeepSpeedTPUConfig(ConfigModel):
     serving: ServingConfig = field(default_factory=ServingConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     analysis: AnalysisConfig = field(default_factory=AnalysisConfig)
+    control: ControlConfig = field(default_factory=ControlConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)
     eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
     quantize_training: Optional[QuantizeTrainingConfig] = None
@@ -874,6 +962,11 @@ class DeepSpeedTPUConfig(ConfigModel):
             d["analysis"] = {"enabled": an}
         elif isinstance(an, str):
             d["analysis"] = {"enabled": True, "fail_on": an}
+        # bool shorthand: "control": true arms the supervisor policy (and
+        # the autotuner API) with defaults
+        ct = d.get("control")
+        if isinstance(ct, bool):
+            d["control"] = {"enabled": ct}
         cl = d.pop("curriculum_learning", None)
         if cl:
             de = dict(d.get("data_efficiency") or {})

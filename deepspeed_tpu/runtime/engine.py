@@ -48,6 +48,13 @@ try:
 except ImportError:  # pragma: no cover
     struct = None
 
+# the control plane's remat escalation ladder (engine.raise_remat): no
+# remat -> keep only matmul outputs -> keep nothing (max memory headroom,
+# max recompute). Each entry names a jax.checkpoint_policies member
+# (None = unwrapped); a custom configured policy escalates straight to
+# the last rung.
+REMAT_LADDER = (None, "dots_saveable", "nothing_saveable")
+
 
 def artifact_rank() -> int:
     """The rank stamped on per-rank post-mortem artifacts (flightdumps,
@@ -201,6 +208,16 @@ class DeepSpeedTPUEngine:
         from ..ops.fastpath import configure_fastpath
         configure_fastpath(attn_impl=tf.attn_impl, loss_impl=tf.loss_impl,
                            embedding_overlap=tf.embedding_overlap)
+        # engine-level rematerialization: with activation_checkpointing
+        # .engine_wrap, ``policy`` names a jax.checkpoint_policies entry
+        # applied around the whole loss fn (None never wraps — bit-
+        # identical). engine_wrap is opt-in because the per-layer compat
+        # API (checkpointing.checkpoint) reads the SAME policy field —
+        # wrapping the engine on top would double-rematerialize those
+        # models. Read at trace time: the control plane's raise_remat()
+        # actuator climbs REMAT_LADDER and invalidates the compiled steps.
+        ac = config.activation_checkpointing
+        self._remat_policy = ac.policy if ac.engine_wrap else None
         if (optimizer is not None and callable(optimizer)
                 and not hasattr(optimizer, "update")):
             # reference DeepSpeedOptimizerCallable (deepspeed/__init__.py:112):
@@ -322,6 +339,13 @@ class DeepSpeedTPUEngine:
         # --- place state on the mesh ------------------------------------
         self._build_state(params)
         self._build_specs(batch_spec)
+        # kept for reconfigure_step(): a control-plane knob change (gas,
+        # micro-batch, a re-planned dp-grad transport) re-runs _compile
+        self._donate_state = donate_state
+        # the training dataloader, when initialize() built one — its batch
+        # shape is fixed outside the engine, so halve_micro_batch refuses
+        # while one is attached (set regardless of resilience)
+        self._train_dataloader = None
         self._compile(donate_state)
 
         # compat-path buffers (forward/backward/step API)
@@ -416,6 +440,16 @@ class DeepSpeedTPUEngine:
                 self.resilience.maybe_restore()
         if self.telemetry is not None:
             self.telemetry.attach_engine(self)
+        # control plane (deepspeed_tpu/control/): the supervisor policy
+        # closing telemetry -> knobs. Constructed AFTER resilience and
+        # telemetry so it can tap the health table, the memory gauges, and
+        # ride the flight dumps. Off by default: a None attribute the step
+        # path checks once — stepping stays bit-identical.
+        self.control = None
+        if config.control.enabled and config.control.supervisor.enabled:
+            from ..control import ControlSupervisor
+
+            self.control = ControlSupervisor.for_engine(self, config.control)
         log_dist(f"engine initialized: {self.topo}, zero_stage={zc.stage}, "
                  f"gas={self.gas}, micro_bs={self.micro_batch_size}, "
                  f"dtype={jnp.dtype(self.compute_dtype).name}")
@@ -535,9 +569,19 @@ class DeepSpeedTPUEngine:
         if ltd_keep is not None and self._loss_takes_ltd:
             kw["ltd_keep"] = ltd_keep
         if self._loss_takes_rng:
-            out = self.loss_fn_raw(p, batch, rng, **kw)
+            call = lambda p_, b_: self.loss_fn_raw(p_, b_, rng, **kw)  # noqa: E731
         else:
-            out = self.loss_fn_raw(p, batch, **kw)
+            call = lambda p_, b_: self.loss_fn_raw(p_, b_, **kw)  # noqa: E731
+        if self._remat_policy is not None:
+            # engine-level remat (activation_checkpointing.policy / the
+            # control plane's raise_remat): the backward pass recomputes
+            # this forward instead of keeping its intermediates — values
+            # identical, activation memory traded for recompute. Trace-time
+            # read; a policy change invalidates the compiled steps.
+            from .activation_checkpointing import checkpoint_wrapper
+
+            call = checkpoint_wrapper(call, self._remat_policy)
+        out = call(p, batch)
         if isinstance(out, tuple):
             return out[0].astype(jnp.float32), out[1]
         return out.astype(jnp.float32), None
@@ -595,6 +639,9 @@ class DeepSpeedTPUEngine:
                          and topo.sp_size == 1 and not config.moe.enabled
                          and topo.dp_size > 1 and self._host_adam is None
                          and not fp16)
+        # remembered for replan_dp_grad: the control plane must not claim
+        # a re-plan on an engine whose reductions are declarative
+        self._dp_grad_site_eligible = site_eligible
         dp_grad_impl = None  # (mode, block, hierarchical) when compressed
         if cc.mode != "none":  # raw knob explicitly set: it wins as before
             compressed_dp = cc.dp_gradients and site_eligible
@@ -1010,6 +1057,110 @@ class DeepSpeedTPUEngine:
             self.telemetry.record_memory_analysis(label, info)
 
     # ------------------------------------------------------------------
+    # control-plane actuators (deepspeed_tpu/control/) + retrace plumbing
+    # ------------------------------------------------------------------
+    def invalidate_compiled_steps(self) -> None:
+        """A trace-time constant changed (LR scale, remat policy, degraded
+        collectives): drop every compiled step — and the measured AOT
+        executables, which bake the same constants — so the next call
+        retraces. State, specs, and the resolved dp-grad plan are kept."""
+        self._train_steps = {(None, None): self._make_train_step(None)}
+        self._train_step = self._train_steps[(None, None)]
+        self._aot_step = None
+        self._apply_fn = None
+        self._micro_step_fn = None
+        self._eval_fn = None
+        self._mem_execs = {}
+
+    def reconfigure_step(self) -> None:
+        """A structural knob changed (gas/micro-batch split, a re-planned
+        dp-grad transport): re-run ``_compile`` — plan resolution, feedback
+        state, and step closures are all rebuilt against the CURRENT
+        attributes — preserving the training RNG stream (``_compile_finish``
+        reseeds it for fresh engines; a mid-run reconfigure must not replay
+        step 0's randomness)."""
+        rng = self._rng
+        self._compile(self._donate_state)
+        self._rng = rng
+        self._apply_fn = None
+        self._micro_step_fn = None
+        self._eval_fn = None
+
+    def raise_remat(self) -> Optional[str]:
+        """Climb one rung of :data:`REMAT_LADDER` (the control plane's
+        memory-pressure actuator). Returns the new policy name, or None
+        when already at the top (nothing left to trade)."""
+        cur = self._remat_policy
+        if cur in REMAT_LADDER:
+            idx = REMAT_LADDER.index(cur)
+            if idx + 1 >= len(REMAT_LADDER):
+                return None
+            nxt = REMAT_LADDER[idx + 1]
+        elif cur != REMAT_LADDER[-1]:
+            nxt = REMAT_LADDER[-1]  # custom policy: escalate to full remat
+        else:
+            return None
+        self._remat_policy = nxt
+        self.invalidate_compiled_steps()
+        log_dist(f"engine: remat policy raised to {nxt} (next step retraces)")
+        return nxt
+
+    def halve_micro_batch(self) -> bool:
+        """Halve the per-device micro-batch and double GAS — the global
+        batch, the optimizer schedule, and the training math are unchanged
+        (the GAS scan equal-weights fixed-size microbatches); per-microbatch
+        activation residency halves. The caller passes whole-step batches
+        (``[gas * micro_global, ...]`` leaves reshape against the new gas
+        automatically); a registered dataloader owns its own batch shape —
+        the control policy skips this actuator there. Returns False when
+        the micro-batch cannot halve (already 1 / odd) or a dataloader
+        owns the batch shape."""
+        if self._train_dataloader is not None:
+            return False
+        if self.micro_batch_size < 2 or self.micro_batch_size % 2:
+            return False
+        self.micro_batch_size //= 2
+        self.gas *= 2
+        cfg = self.config
+        cfg.train_micro_batch_size_per_gpu = self.micro_batch_size
+        cfg.gradient_accumulation_steps = self.gas
+        # keep the batch triangle consistent for any later finalize()
+        cfg._user_batch = (cfg.train_batch_size, self.micro_batch_size,
+                           self.gas)
+        self.reconfigure_step()
+        log_dist(f"engine: micro-batch halved to {self.micro_batch_size} "
+                 f"(gas {self.gas}); next step retraces")
+        return True
+
+    def replan_dp_grad(self, slow_axes, penalty: float = 4.0
+                       ) -> Optional[str]:
+        """Re-plan the DP-gradient collective around a slow link (the
+        control plane's straggler actuator): the planner demotes
+        ``slow_axes`` to penalized DCN-class links and re-synthesizes
+        (``CollectivePlanner.replan_around``), then the step recompiles so
+        the new transport — typically a hierarchical program whose
+        full-width phases exclude the slow axes — takes effect. Returns
+        the re-resolved plan summary, or None when the planner is off, no
+        axis matched, or this engine has no re-plannable DP-grad site
+        (ZeRO>0 / model-parallel / fp16 configurations keep their
+        declarative reductions — a 'successful' re-plan there would be a
+        lie the ledger then repeats)."""
+        from ..comm.planner import (get_planner, planner_active,
+                                    program_summary)
+
+        if not planner_active() or not getattr(
+                self, "_dp_grad_site_eligible", False):
+            return None
+        if not get_planner().replan_around(slow_axes, penalty=penalty):
+            return None
+        self.reconfigure_step()
+        impl = self._dp_grad_impl
+        if impl is None:
+            return "exact-xla"
+        return (program_summary(impl[2]) if impl[0] == "program"
+                else impl[0])
+
+    # ------------------------------------------------------------------
     # primary API
     # ------------------------------------------------------------------
     def train_batch(self, batch=None, data_iter: Optional[Iterable] = None):
@@ -1119,6 +1270,8 @@ class DeepSpeedTPUEngine:
         moq_bits = self.moq.update(self.global_steps) if self.moq else None
         if moq_bits is not None and moq_bits >= 16:
             moq_bits = None  # schedule_offset warmup: unquantized program
+        executing_step = self.global_steps  # pre-increment: the N every
+        # other post-mortem surface (spans, flight ring, watchdog) stamps
         key = (ltd_keep, moq_bits)
         step_fn = self._train_steps.get(key)
         if step_fn is None:
@@ -1169,6 +1322,13 @@ class DeepSpeedTPUEngine:
             # cost when disabled: the attribute is None and nothing runs.
             with span("resilience/post_step"):
                 self.resilience.post_step()
+        if self.control is not None:
+            # supervisor policy: live signals -> flap-guarded knob actions
+            # (deepspeed_tpu/control/). Runs AFTER the resilience hook so
+            # it observes this step's rollback/health outcomes; host-only
+            # work unless a fired rule actuates.
+            with span("control/decide"):
+                self.control.on_step(executing_step)
         at = self.config.autotuning
         if self.global_steps == at.end_profile_step:
             from ..autotuning.autotuner import AUTOTUNE_RESULT_ENV, report_autotune_result
@@ -1952,6 +2112,10 @@ def initialize(args=None,
         dataloader = DeepSpeedDataLoader(training_data,
                                          batch_size=cfg.train_micro_batch_size_per_gpu,
                                          sampler=sampler)
+    if dataloader is not None:
+        # the control plane's halve_micro_batch actuator must not change
+        # the engine's batch split while a fixed-shape loader feeds it
+        engine._train_dataloader = dataloader
     if dataloader is not None and engine.resilience is not None:
         # resumable data stream: the loader's position rides in snapshot
         # meta, and a restore (which already happened at engine init)

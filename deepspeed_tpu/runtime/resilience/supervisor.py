@@ -159,11 +159,18 @@ class ResilienceManager:
             self.health = HealthTable(transport,
                                       dead_after_s=hc.dead_after_s,
                                       straggler_factor=hc.straggler_factor)
+        # newest HealthTable rows (refreshed each heartbeat tick): the
+        # control plane reads THESE instead of issuing its own per-step
+        # transport read
+        self.last_health = None
         self.degraded = False
         # set by TelemetryManager.attach_resilience: flight dumps ride the
         # watchdog expiry / rollback / drain paths, resilience events land
         # in the metrics registry. None = telemetry off, zero overhead.
         self._telemetry = None
+        # set by ControlSupervisor.attach_engine: rollbacks feed the
+        # control plane's rollback-rate signal. None = control off.
+        self._control = None
         self._rollback_times: "deque[float]" = deque(maxlen=64)
         self._recent_step_times: "deque[float]" = deque(maxlen=16)
         self._step_t0: Optional[float] = None
@@ -368,7 +375,8 @@ class ResilienceManager:
             self.heartbeat.beat(step, step_time_s=st)
         if self.health is not None:
             events = []
-            for row in self.health.read():
+            self.last_health = rows = self.health.read()
+            for row in rows:
                 if not row.alive:
                     events.append(("Resilience/dead_host",
                                    float(row.rank), step))
@@ -568,6 +576,8 @@ class ResilienceManager:
             engine._lr_scale = getattr(engine, "_lr_scale", 1.0) * drop
             self._invalidate_compiled_steps()
         self.rollbacks += 1
+        if self._control is not None:
+            self._control.note_rollback(tripped_at)
         if self.sentinel is not None:
             self.sentinel.reset()
         self._emit([("Resilience/rollback", 1.0, tripped_at),
@@ -581,13 +591,10 @@ class ResilienceManager:
     def _invalidate_compiled_steps(self) -> None:
         """An LR-scale change is a trace-time constant: drop every compiled
         step so the next call retraces with the new scale. Rollbacks are
-        rare; a recompile is the honest cost of changing the schedule."""
-        engine = self.engine
-        engine._train_steps = {(None, None): engine._make_train_step(None)}
-        engine._train_step = engine._train_steps[(None, None)]
-        engine._aot_step = None
-        engine._apply_fn = None
-        engine._micro_step_fn = None
+        rare; a recompile is the honest cost of changing the schedule.
+        Delegates to the engine's own invalidation (shared with the
+        control-plane actuators)."""
+        self.engine.invalidate_compiled_steps()
 
     def _emit(self, events) -> None:
         if getattr(self.engine, "monitor", None) is not None:

@@ -210,6 +210,27 @@ class ReplicaRouter:
         return True
 
     # ------------------------------------------------------------------
+    def add_replica(self, server: LLMServer) -> None:
+        """Scale-out: register (and start) a new replica so the next
+        dispatch can land on it — the control plane's ``serving_scale``
+        actuator (``control/policy.py rule_sla``) calls this from its
+        ``scale_fn``. The new replica joins the heartbeat transport when
+        the router has one, so health verdicts cover it immediately."""
+        rid = int(server.replica_id)
+        with self._lock:
+            if rid in self.replicas:
+                raise ValueError(f"replica id {rid} already registered")
+            self.replicas[rid] = server
+            self._assigned[rid] = {}
+            self._dead.discard(rid)
+            self._draining.discard(rid)
+        if self.health is not None and server.heartbeat is None:
+            server.heartbeat = HeartbeatWriter(self.health.transport, rid,
+                                               clock=self.clock)
+        server.start()
+        logger.info(f"serving: replica {rid} added to the router "
+                    f"({len(self.replicas)} total)")
+
     def drain_replica(self, rid: int, timeout: Optional[float] = None) -> bool:
         """Graceful maintenance drain: stop dispatching to ``rid``, let its
         in-flight requests finish, then stop its engine thread."""

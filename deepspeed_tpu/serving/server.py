@@ -88,6 +88,16 @@ class LLMServer:
         self._steps = 0
         self._last_emit_step = 0
         self._last_step_time: Optional[float] = None
+        # control plane (deepspeed_tpu/control/): a ControlSupervisor
+        # attached via attach_server ticks every control_interval_steps
+        # serving steps; control_max_queue is its shedding actuator — a
+        # tightened admission watermark below the ingress queue's bound
+        # (None = full admission). Requeues bypass it: already-admitted
+        # work must land.
+        self.control = None
+        self.control_interval_steps = 25
+        self.control_max_queue: Optional[int] = None
+        self._last_control_step = 0
         self.heartbeat = heartbeat          # resilience.HeartbeatWriter
         self.heartbeat_interval_s = float(heartbeat_interval_s)
         self.suppress_heartbeat = False     # FaultPlan-style drill hook
@@ -195,6 +205,15 @@ class LLMServer:
         load, not stack it. ``_response`` re-enqueues an existing handle
         (router requeue path): the response keeps its arrival time/SLA clock
         but gets a fresh engine uid on this replica."""
+        if _response is None and self.control_max_queue is not None \
+                and self._ingress.qsize() >= self.control_max_queue:
+            # control-plane shedding: sustained SLA violations tightened
+            # admission below the ingress bound — reject at the door like
+            # an overload, so upstream backpressure works unchanged
+            self.metrics.on_reject()
+            raise ServerOverloaded(
+                f"control plane shed: admission tightened to "
+                f"{self.control_max_queue} queued request(s)")
         with self._flags:
             if not (self._accepting and not self._draining):
                 raise ServerClosed(f"server replica={self.replica_id} is not "
@@ -347,6 +366,7 @@ class LLMServer:
                                   or bool(out))
                 self._sample_gauges()
                 self._maybe_emit()
+                self._maybe_control_tick()
                 if self._draining and not self.scheduler.has_work():
                     # under the flags lock, with no submit between its
                     # admission check and its enqueue (_submitting == 0),
@@ -451,6 +471,20 @@ class LLMServer:
                 except Exception as e:  # a full disk must not kill serving
                     logger.warning(f"serving: heartbeat write failed: {e!r}")
             self._beat_stop.wait(self.heartbeat_interval_s)
+
+    def _maybe_control_tick(self) -> None:
+        """Hand the supervisor one look at this replica's metrics every
+        ``control_interval_steps`` engine steps (engine-thread context, so
+        the SLA rule's shed/unshed actuation races nothing)."""
+        if self.control is None or self.control_interval_steps <= 0:
+            return
+        if (self._steps and self._steps != self._last_control_step
+                and self._steps % self.control_interval_steps == 0):
+            self._last_control_step = self._steps
+            try:
+                self.control.on_serving_tick(self)
+            except Exception as e:  # control must never stall serving
+                logger.warning(f"serving: control tick failed: {e!r}")
 
     def _maybe_emit(self) -> None:
         if self.monitor is None or self.metrics_interval_steps <= 0:

@@ -69,6 +69,12 @@ class TelemetryManager:
         self._mem_fn = None
         self._mem_gauges = None
         self._ledger = None
+        # newest per-step memory sample (the control plane's mem-pressure
+        # signal reads this — one step stale by design, never a fresh sync)
+        self.last_mem: Optional[Dict[str, Any]] = None
+        # set by ControlSupervisor.attach_engine: the control ledger rides
+        # every flight dump so the doctor can explain automated decisions
+        self._control = None
         self.phase_hist = self.registry.histogram(
             "dstpu_step_phase_seconds",
             "host-side duration of each step phase span")
@@ -112,6 +118,7 @@ class TelemetryManager:
         host-side counters)."""
         self.step_counter.inc()
         mem = self.sample_memory()
+        self.last_mem = mem
         if self.flight is not None:
             # record_step drains the tracer; feed the histogram from the
             # recorded window so both views see the same spans
@@ -201,6 +208,11 @@ class TelemetryManager:
         if rz is not None:
             self.attach_resilience(rz)
 
+    def attach_control(self, supervisor) -> None:
+        """Control-plane wiring: the decision ledger rides every flight
+        dump (the doctor's ``supervisor action`` lines read it back)."""
+        self._control = supervisor
+
     def attach_resilience(self, manager) -> None:
         manager._telemetry = self
         if self.flight is not None and manager.watchdog is not None:
@@ -244,6 +256,10 @@ class TelemetryManager:
                     and getattr(self._ledger, "memory_records", None)):
                 extra.setdefault("exec_memory",
                                  dict(self._ledger.memory_records))
+            if self._control is not None and len(self._control.ledger):
+                # the control ledger: which knobs the supervisor moved and
+                # why — the doctor prints these beside its verdicts
+                extra.setdefault("control", self._control.ledger.snapshot())
             mem = self.sample_memory() if sample_mem else None
             if mem:
                 extra.setdefault("mem", mem)

@@ -1,0 +1,901 @@
+"""Control-plane subsystem (deepspeed_tpu/control/): flap-guard state
+machine, decision ledger, supervisor rules (straggler re-plan, memory
+escalation, SLA shed/scale, rollback degrade), Autotuner v2 with per-mesh
+winner caching, and the doctor's supervisor-action cross-link."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.control import (POLICY_TABLE, RULE_NAMES, ControlAutotuner,
+                                   ControlLedger, ControlSupervisor,
+                                   FlapGuard, WinnerCache, build_space,
+                                   describe_action, space_signature)
+from deepspeed_tpu.parallel import Topology, TopologySpec
+from deepspeed_tpu.runtime.config import DeepSpeedTPUConfig
+from deepspeed_tpu.runtime.resilience import (FileHeartbeatTransport,
+                                              HeartbeatWriter)
+
+from .simple_model import make_simple_params, random_batches, simple_loss
+
+HIDDEN = 64
+
+
+def _engine(extra_cfg=None, topology=None, params=None, loss=None):
+    cfg = {"train_micro_batch_size_per_gpu": 8,
+           "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 1000, "seed": 42}
+    if extra_cfg:
+        cfg.update(extra_cfg)
+    engine, *_ = ds.initialize(
+        model=loss or simple_loss,
+        model_parameters=params or make_simple_params(HIDDEN),
+        config=cfg, topology=topology)
+    return engine
+
+
+def _control_cfg(**over):
+    base = {"enabled": True,
+            "guard": {"trigger_streak": 1, "clear_streak": 1,
+                      "cooldown_s": 0.0, "budget": 100,
+                      "budget_window_s": 3600.0}}
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            base[k] = {**base[k], **v}
+        else:
+            base[k] = v
+    return base
+
+
+# ---------------------------------------------------------------------------
+# flap guard: hysteresis / cooldown / budget state machine
+# ---------------------------------------------------------------------------
+
+
+def test_guard_hysteresis_needs_trigger_streak():
+    now = [0.0]
+    g = FlapGuard(trigger_streak=3, clear_streak=1, cooldown_s=0,
+                  clock=lambda: now[0])
+    assert not g.should_fire("r", True)
+    assert not g.should_fire("r", False)   # streak broken
+    assert not g.should_fire("r", True)
+    assert not g.should_fire("r", True)
+    assert g.should_fire("r", True)        # third consecutive assert fires
+    assert g.fires("r") == 1
+
+
+def test_guard_latches_until_clear_streak():
+    now = [0.0]
+    g = FlapGuard(trigger_streak=1, clear_streak=2, cooldown_s=0,
+                  clock=lambda: now[0])
+    assert g.should_fire("r", True)
+    # signal stays asserted: latched, never re-fires
+    for _ in range(5):
+        assert not g.should_fire("r", True)
+    assert not g.should_fire("r", False)   # one clear is not enough
+    assert not g.should_fire("r", True)    # still latched
+    assert not g.should_fire("r", False)
+    assert not g.should_fire("r", False)   # clear_streak reached: re-armed
+    assert g.should_fire("r", True)
+    assert g.fires("r") == 2
+
+
+def test_guard_cooldown_blocks_rearmed_rule():
+    now = [0.0]
+    g = FlapGuard(trigger_streak=1, clear_streak=1, cooldown_s=100.0,
+                  clock=lambda: now[0])
+    assert g.should_fire("r", True)
+    assert not g.should_fire("r", False)   # re-armed...
+    now[0] = 50.0
+    assert not g.should_fire("r", True)    # ...but inside the cooldown
+    now[0] = 150.0
+    assert not g.should_fire("r", False)   # the failed assert re-latched? no:
+    assert g.should_fire("r", True)        # cooldown passed -> fires
+    assert g.fires("r") == 2
+
+
+def test_guard_global_budget_and_window_drain():
+    now = [0.0]
+    g = FlapGuard(trigger_streak=1, clear_streak=1, cooldown_s=0, budget=2,
+                  budget_window_s=100.0, clock=lambda: now[0])
+    assert g.should_fire("a", True)
+    assert g.should_fire("b", True)
+    assert not g.should_fire("c", True)    # budget exhausted (global)
+    assert g.budget_exhausted_observed
+    assert g.budget_left() == 0
+    now[0] = 200.0                         # window drains
+    assert g.should_fire("c", True)
+    assert g.total_fires() == 3
+
+
+def test_guard_alternating_signal_one_fire_under_cooldown():
+    """The flap scenario: an alternating asserted/clear signal with a long
+    cooldown produces exactly ONE firing, not one per edge."""
+    now = [0.0]
+    g = FlapGuard(trigger_streak=1, clear_streak=1, cooldown_s=1e9,
+                  clock=lambda: now[0])
+    fires = 0
+    for i in range(20):
+        now[0] += 1.0
+        fires += g.should_fire("r", i % 2 == 0)
+    assert fires == 1
+
+
+# ---------------------------------------------------------------------------
+# ledger
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_records_counter_and_monitor_events():
+    led = ControlLedger(max_entries=4, clock=lambda: 123.0)
+
+    class Counter:
+        def __init__(self):
+            self.by_action = {}
+
+        def inc(self, amount=1.0, **labels):
+            a = labels.get("action")
+            self.by_action[a] = self.by_action.get(a, 0) + 1
+
+    c = Counter()
+    events = []
+    led.bind_counter(c)
+    led.bind_monitor(events.extend)
+    e = led.record("raise_remat", step=7, rule="mem_pressure",
+                   signal="mem 0.95x", reason="raised remat to dots_saveable",
+                   params={"policy": "dots_saveable"})
+    led.record("serving_shed", step=9, outcome="skipped:budget")
+    assert c.by_action == {"raise_remat": 1, "serving_shed": 1}
+    assert ("Control/raise_remat", 1.0, 7) in events
+    assert led.total == 2 and len(led) == 2
+    snap = led.snapshot()
+    assert snap[0]["action"] == "raise_remat" and snap[0]["wall_time"] == 123.0
+    line = describe_action(e.to_dict())
+    assert "step 7: raise_remat" in line and "dots_saveable" in line
+    assert "[skipped:budget]" in describe_action(snap[1])
+    for i in range(10):                    # bounded ring
+        led.record("x", step=i)
+    assert len(led) == 4
+
+
+def test_policy_table_covers_fired_rules():
+    assert set(RULE_NAMES) == {"straggler_replan", "mem_pressure",
+                               "sla_pressure", "rollback_degrade"}
+    assert len(POLICY_TABLE) == 4
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+def test_control_config_defaults_off_and_shorthand():
+    cfg = DeepSpeedTPUConfig.from_dict({})
+    assert not cfg.control.enabled
+    cfg = DeepSpeedTPUConfig.from_dict({"control": True})
+    assert cfg.control.enabled and cfg.control.supervisor.enabled
+    assert cfg.control.guard.trigger_streak == 2
+    assert cfg.control.autotune.dims == ["gas", "remat", "fastpath",
+                                         "compression"]
+    cfg = DeepSpeedTPUConfig.from_dict(
+        {"control": {"enabled": True,
+                     "supervisor": {"mem_watermark": 0.8,
+                                    "replan_axes": ["dp_outer"]}}})
+    assert cfg.control.supervisor.mem_watermark == 0.8
+    assert cfg.control.supervisor.replan_axes == ["dp_outer"]
+
+
+# ---------------------------------------------------------------------------
+# supervisor rules on fakes (jax-free paths)
+# ---------------------------------------------------------------------------
+
+
+def _supervisor(clock=None, **cfg_over):
+    cfg = DeepSpeedTPUConfig.from_dict(
+        {"control": _control_cfg(**cfg_over)}).control
+    kw = {"clock": clock} if clock is not None else {}
+    return ControlSupervisor(cfg, **kw)
+
+
+def test_alternating_straggler_signal_replans_exactly_once():
+    """The fake-fleet flap drill: an alternating straggler/clear verdict
+    stream produces exactly ONE re-plan (hysteresis latch + cooldown), and
+    the single action is ledgered."""
+    sup = _supervisor(guard={"cooldown_s": 1e9})
+    replans = []
+    engine = types.SimpleNamespace(
+        global_steps=0,
+        topo=types.SimpleNamespace(dp_axes=("dp_outer", "ep")),
+        resilience=None,
+        replan_dp_grad=lambda axes, penalty: (
+            replans.append((tuple(axes), penalty)) or "rs(ep)>ar(dp_outer)"),
+    )
+    sup.engine = engine
+    sup.can_replan = lambda: True   # the fake engine IS re-plannable
+    rows = [[(0, 5.0)], []]  # alternating verdicts
+    sup.straggler_rows = lambda: rows[engine.global_steps % 2]
+    for i in range(12):
+        engine.global_steps = i
+        sup.on_step()
+    assert len(replans) == 1
+    assert replans[0][0] == ("dp_outer",)
+    acts = sup.ledger.actions("straggler_replan")
+    assert len(acts) == 1 and acts[0].outcome == "ok"
+    assert acts[0].params["plan"] == "rs(ep)>ar(dp_outer)"
+
+
+def test_straggler_single_axis_span_is_skipped_not_flapped():
+    sup = _supervisor()
+    engine = types.SimpleNamespace(
+        global_steps=1, topo=types.SimpleNamespace(dp_axes=("dp_outer",)),
+        resilience=None,
+        replan_dp_grad=lambda *a, **k: pytest.fail("must not actuate"))
+    sup.engine = engine
+    sup.straggler_rows = lambda: [(3, 4.0)]
+    sup.on_step()
+    acts = sup.ledger.actions("straggler_replan")
+    assert len(acts) == 1 and acts[0].outcome == "skipped:no-slow-axes"
+
+
+def test_sla_rule_sheds_then_recovers_and_scale_fn_wins():
+    sup = _supervisor(supervisor={"sla_violation_rate": 0.5,
+                                  "sla_min_tracked": 4})
+
+    class Ingress:
+        maxsize = 64
+
+        @staticmethod
+        def qsize():
+            return 0
+
+    m = types.SimpleNamespace(sla_violations=0, sla_tracked=0)
+    server = types.SimpleNamespace(replica_id=0, metrics=m, _steps=0,
+                                   control_max_queue=None, _ingress=Ingress)
+    # tick 1: 8/8 violations -> shed halves admission from the queue bound
+    m.sla_violations, m.sla_tracked = 8, 8
+    server._steps = 25
+    sup.on_serving_tick(server)
+    assert server.control_max_queue == 32
+    assert sup.ledger.actions("serving_shed")[0].params["max_queue"] == 32
+    # tick 2: recovered -> full admission restored
+    m.sla_violations, m.sla_tracked = 8, 16  # 0 new violations / 8 tracked
+    server._steps = 50
+    sup.on_serving_tick(server)
+    assert server.control_max_queue is None
+    assert sup.ledger.actions("serving_unshed")
+    # with a scale_fn registered, pressure scales out instead of shedding
+    added = []
+    sup.scale_fn = lambda s: added.append("replica-1") or "replica-1"
+    m.sla_violations, m.sla_tracked = 16, 24
+    server._steps = 75
+    sup.on_serving_tick(server)
+    assert added == ["replica-1"] and server.control_max_queue is None
+    assert sup.ledger.actions("serving_scale")[0].outcome == "ok"
+
+
+def test_unshed_is_restorative_and_ignores_exhausted_budget():
+    """An exhausted action budget must never pin a recovered replica at
+    tightened admission: un-shedding bypasses (and never charges) it."""
+    sup = _supervisor(guard={"budget": 1, "budget_window_s": 3600.0},
+                      supervisor={"sla_violation_rate": 0.5,
+                                  "sla_min_tracked": 4})
+
+    class Ingress:
+        maxsize = 64
+
+        @staticmethod
+        def qsize():
+            return 0
+
+    m = types.SimpleNamespace(sla_violations=0, sla_tracked=0)
+    server = types.SimpleNamespace(replica_id=0, metrics=m, _steps=25,
+                                   control_max_queue=None, _ingress=Ingress)
+    m.sla_violations, m.sla_tracked = 8, 8
+    sup.on_serving_tick(server)
+    assert server.control_max_queue == 32      # shed consumed the budget
+    assert sup.guard.budget_left() == 0
+    m.sla_violations, m.sla_tracked = 8, 16    # recovered
+    server._steps = 50
+    sup.on_serving_tick(server)
+    assert server.control_max_queue is None    # restored despite the budget
+    assert sup.guard.budget_left() == 0        # ...and did not charge it
+
+
+def test_infeasible_actions_never_charge_the_budget():
+    """A permanently impossible actuation (no re-plannable site) under a
+    persistent signal: one explanatory ledger note, zero guard firings,
+    budget untouched — the safety budget stays available for real rules."""
+    sup = _supervisor()
+    sup.engine = types.SimpleNamespace(
+        global_steps=0,
+        topo=types.SimpleNamespace(dp_axes=("dp_outer", "ep")),
+        resilience=None,
+        replan_dp_grad=lambda *a, **k: pytest.fail("must not actuate"))
+    sup.straggler_rows = lambda: [(1, 9.0)]
+    sup.can_replan = lambda: False
+    budget0 = sup.guard.budget_left()
+    for i in range(10):
+        sup.engine.global_steps = i
+        sup.on_step()
+    acts = sup.ledger.actions("straggler_replan")
+    assert len(acts) == 1
+    assert acts[0].outcome == "skipped:no-replannable-site"
+    assert sup.guard.total_fires() == 0
+    assert sup.guard.budget_left() == budget0
+
+
+def test_replan_cache_reused_across_planner_instances(tmp_path):
+    """A restart that repeats the demotion resolves the cached replanned
+    plan (stored under the demoted fingerprint digest) instead of
+    re-deciding from scratch; the organic cache entry stays untouched."""
+    from deepspeed_tpu.comm.planner import CollectivePlanner, make_site
+
+    topo = Topology(TopologySpec(ep=2))
+    site = make_site(op="all_reduce", shape=(1 << 20,), dtype="float32",
+                     axes=("dp_outer", "ep"), consumer="dp-grad")
+    p1 = CollectivePlanner("static", cache_dir=str(tmp_path), topology=topo)
+    organic_digest = p1.fingerprint.digest()
+    p1.resolve(site)
+    assert p1.replan_around(("dp_outer",), penalty=6.0)
+    d1 = p1.resolve(site)                  # stored under the demoted digest
+    assert d1.impl == "program"
+    demoted_digest = p1.fingerprint.digest()
+    assert {f"plan_{organic_digest}.json", f"plan_{demoted_digest}.json"} \
+        <= set(os.listdir(tmp_path)) - {f"plan_{organic_digest}.json.lock",
+                                        f"plan_{demoted_digest}.json.lock"}
+    # fresh planner (a restarted process), same demotion: the replanned
+    # decision comes back from the cache
+    p2 = CollectivePlanner("static", cache_dir=str(tmp_path), topology=topo)
+    assert p2.replan_around(("dp_outer",), penalty=6.0)
+    assert site.signature() in p2.plan.decisions
+    d2 = p2.resolve(site)
+    assert d2.impl == "program" and d2.source == "cache"
+
+
+def test_server_control_shed_rejects_at_the_door():
+    """LLMServer.submit honors the control-plane admission watermark
+    without the engine thread ever starting."""
+    from deepspeed_tpu.serving.request import Request
+    from deepspeed_tpu.serving.server import LLMServer, ServerOverloaded
+
+    eng = types.SimpleNamespace(
+        config=types.SimpleNamespace(max_ragged_sequence_count=4,
+                                     kv_block_size=4, max_blocks_per_seq=8),
+        cfg=types.SimpleNamespace(max_seq_len=128),
+        kv=types.SimpleNamespace(num_blocks=9),
+        state_manager=types.SimpleNamespace(get=lambda uid: None))
+    srv = LLMServer(eng, max_queue=8)
+    srv.control_max_queue = 0
+    with pytest.raises(ServerOverloaded, match="control plane shed"):
+        srv.submit(Request(np.array([1, 2], np.int32), max_new_tokens=2))
+    assert srv.metrics.rejected == 1
+
+
+def test_router_add_replica_registers_and_heartbeats(tmp_path):
+    from deepspeed_tpu.serving.replica import ReplicaRouter
+
+    class _Srv:
+        def __init__(self, rid):
+            self.replica_id = rid
+            self.heartbeat = None
+            self.error = None
+            self.outstanding = 0
+            self.started = False
+
+        def start(self):
+            self.started = True
+            return self
+
+    tr = FileHeartbeatTransport(str(tmp_path))
+    r = ReplicaRouter([_Srv(0)], transport=tr)
+    new = _Srv(1)
+    r.add_replica(new)
+    assert new.started and new.heartbeat is not None
+    assert set(r.replicas) == {0, 1}
+    with pytest.raises(ValueError, match="already registered"):
+        r.add_replica(_Srv(1))
+
+
+# ---------------------------------------------------------------------------
+# winner cache
+# ---------------------------------------------------------------------------
+
+
+def _fp(n_devices=8, dcn=()):
+    from deepspeed_tpu.comm.planner import MeshFingerprint
+
+    return MeshFingerprint(platform="cpu", device_kind="cpu",
+                           n_devices=n_devices, n_processes=1,
+                           axis_sizes=(("dp_outer", n_devices),),
+                           dcn_axes=tuple(dcn))
+
+
+def test_winner_cache_roundtrip_and_mesh_keying(tmp_path):
+    cache = WinnerCache(str(tmp_path))
+    sig = space_signature({"gas": ["gas1", "gas2"]}, "throughput")
+    assert cache.lookup(_fp(), sig) is None
+    cache.store(_fp(), sig, {"name": "gas2", "overrides": {"x": 1}})
+    hit = cache.lookup(_fp(), sig)
+    assert hit["name"] == "gas2" and hit["overrides"] == {"x": 1}
+    # a changed mesh NEVER replays this winner
+    assert cache.lookup(_fp(n_devices=4), sig) is None
+    assert cache.lookup(_fp(dcn=("dp_outer",)), sig) is None
+    # a changed search space records a sibling, not a clobber
+    sig2 = space_signature({"gas": ["gas1", "gas2"], "remat": ["a"]},
+                           "throughput")
+    assert cache.lookup(_fp(), sig2) is None
+    cache.store(_fp(), sig2, {"name": "other"})
+    assert cache.lookup(_fp(), sig)["name"] == "gas2"
+    # corrupt file reads as a miss
+    with open(cache.path_for(_fp()), "w") as f:
+        f.write("{broken")
+    assert cache.lookup(_fp(), sig) is None
+
+
+def test_build_space_is_cartesian_product():
+    base = {"train_micro_batch_size_per_gpu": 8,
+            "gradient_accumulation_steps": 1}
+    space = build_space(base, ("gas", "remat", "compression"))
+    assert len(space) == 2 * 3 * 2  # gas {1,2} x remat {off,dots,full} x cc
+    names = {e.name for e in space}
+    assert "gas1_remat-off_cc-none" in names
+    ov = next(e for e in space if e.name == "gas2_remat-full_cc-int8").overrides
+    assert ov["gradient_accumulation_steps"] == 2
+    assert ov["activation_checkpointing"]["policy"] == "nothing_saveable"
+    assert ov["compressed_collectives"]["mode"] == "int8"
+    # the train_batch_size pop-marker must SURVIVE candidate combination:
+    # a base carrying a resolved batch triangle (from_config's to_dict
+    # path) would otherwise fail finalize() on every gas candidate
+    assert "train_batch_size" in ov and ov["train_batch_size"] is None
+    from deepspeed_tpu.autotuning.autotuner import _merge
+
+    merged = _merge({"train_batch_size": 8,
+                     "train_micro_batch_size_per_gpu": 8,
+                     "gradient_accumulation_steps": 1}, ov)
+    assert "train_batch_size" not in merged  # popped at the final overlay
+
+
+# ---------------------------------------------------------------------------
+# doctor cross-link (synthetic dumps, no engine)
+# ---------------------------------------------------------------------------
+
+
+def test_doctor_prints_supervisor_action_lines(tmp_path):
+    from deepspeed_tpu import doctor
+
+    actions = [
+        {"seq": 1, "step": 12, "wall_time": 100.0, "action": "raise_remat",
+         "rule": "mem_pressure", "signal": "mem 0.95x",
+         "reason": "raised remat at step 12 after mem gauge hit "
+                   "0.95x bytes_limit",
+         "params": {"policy": "dots_saveable"}, "outcome": "ok"},
+        {"seq": 2, "step": 14, "wall_time": 101.0,
+         "action": "straggler_replan", "rule": "straggler_replan",
+         "signal": "straggler rank(s) [1]", "reason": "re-planned dp-grad",
+         "params": {"axes": ["dp_outer"]}, "outcome": "ok"},
+    ]
+    for rank in (0, 1):
+        with open(tmp_path / f"flightdump-{rank}.json", "w") as f:
+            json.dump({"reason": "crash", "rank": rank, "wall_time": 102.0,
+                       "last_phase": "compute/dispatch", "steps": [],
+                       "open_spans": [], "collectives": [],
+                       "control": actions if rank == 0 else []}, f)
+    rep = doctor.diagnose(str(tmp_path))
+    assert len(rep["supervisor_actions"]) == 2
+    assert rep["ranks"]["0"]["control_actions"] == 2
+    assert any("supervisor acted 2x" in ev for ev in rep["evidence"])
+    out = doctor.render_report(rep)
+    lines = [ln for ln in out.splitlines() if
+             ln.startswith("supervisor action:")]
+    assert len(lines) == 2
+    assert "rank 0 step 12: raise_remat" in lines[0]
+    assert "mem gauge hit 0.95x bytes_limit" in lines[0]
+    assert "straggler_replan" in lines[1]
+
+
+# ---------------------------------------------------------------------------
+# planner replan unit
+# ---------------------------------------------------------------------------
+
+
+def test_planner_replan_around_demotes_and_resynthesizes():
+    from deepspeed_tpu.comm.planner import CollectivePlanner, make_site
+
+    topo = Topology(TopologySpec(ep=2))
+    pl = CollectivePlanner("static", use_cache=False, topology=topo)
+    site = make_site(op="all_reduce", shape=(1 << 20,), dtype="float32",
+                     axes=("dp_outer", "ep"), consumer="dp-grad")
+    before = pl.resolve(site)
+    assert before.impl != "program"       # all-ICI span: no synthesis
+    digest0 = pl.fingerprint.digest()
+    assert pl.replan_around(("dp_outer",), penalty=6.0)
+    assert "dp_outer" in pl.fingerprint.dcn_axes
+    assert pl.fingerprint.digest() != digest0  # cache identity re-keyed
+    after = pl.resolve(site)
+    assert after.impl == "program"
+    for st in after.program:
+        if st.phase_op in ("reduce_scatter", "all_gather"):
+            assert "dp_outer" not in st.axes  # bulk phases avoid the link
+    # unknown axes / off mode are no-ops
+    assert not pl.replan_around(("nope",))
+    off = CollectivePlanner("off", use_cache=False, topology=topo)
+    assert not off.replan_around(("dp_outer",))
+
+
+# ---------------------------------------------------------------------------
+# engine-level: off-identity, remat, memory escalation
+# ---------------------------------------------------------------------------
+
+
+def test_control_enabled_is_bitwise_off_identity():
+    """control: on with no firing signal steps bitwise identically to a
+    tree that never heard of the subsystem."""
+    batches = random_batches(3, 8, HIDDEN)
+    e_off = _engine()
+    e_on = _engine({"control": True})
+    assert e_off.control is None and e_on.control is not None
+    for b in batches:
+        l0 = float(np.asarray(e_off.train_batch(b)))
+        l1 = float(np.asarray(e_on.train_batch(b)))
+        assert l0 == l1  # bitwise, not allclose
+    assert len(e_on.control.ledger) == 0
+
+
+def test_remat_policy_config_and_ladder_value_identity():
+    batches = random_batches(2, 8, HIDDEN)
+    e_plain = _engine()
+    # policy WITHOUT engine_wrap stays inert at the engine (the per-layer
+    # compat API owns that field — no silent double-remat on upgrade)
+    e_compat = _engine({"activation_checkpointing":
+                        {"policy": "nothing_saveable"}})
+    assert e_compat._remat_policy is None
+    e_remat = _engine({"activation_checkpointing":
+                       {"policy": "nothing_saveable",
+                        "engine_wrap": True}})
+    assert e_remat._remat_policy == "nothing_saveable"
+    for b in batches:
+        l0 = float(np.asarray(e_plain.train_batch(b)))
+        l1 = float(np.asarray(e_remat.train_batch(b)))
+        assert l0 == l1  # remat trades memory for recompute, never values
+    # the ladder climbs and tops out
+    assert e_plain.raise_remat() == "dots_saveable"
+    assert e_plain.raise_remat() == "nothing_saveable"
+    assert e_plain.raise_remat() is None
+
+
+def test_memory_guard_escalates_remat_then_halves_micro_batch():
+    """SUSTAINED pressure (the gauge never dropping below the watermark)
+    must climb the whole escalation ladder — per-stage guard rules, not
+    one latched-forever rule: remat dots -> remat full -> halve micro."""
+    e = _engine({"control": _control_cfg()})
+    sup = e.control
+    sup._mem_fn = lambda: {"bytes_in_use": 95, "bytes_limit": 100}
+    gas0, mbs0 = e.gas, e.micro_batch_size
+
+    sup.on_step()                           # stage 0: remat -> dots
+    assert e._remat_policy == "dots_saveable"
+    sup.on_step()                           # stage 1: remat -> full
+    assert e._remat_policy == "nothing_saveable"
+    sup.on_step()                           # stage 2: ladder done -> halve
+    assert (e.gas, e.micro_batch_size) == (gas0 * 2, mbs0 // 2)
+    acts = [a.action for a in sup.ledger.entries()]
+    assert acts == ["raise_remat", "raise_remat", "halve_micro_batch"]
+    assert "0.95x bytes_limit" in sup.ledger.entries()[-1].signal
+    assert len({a.rule for a in sup.ledger.entries()}) == 3  # per-stage rules
+    # training continues on the reconfigured step, same math
+    e_ref = _engine()
+    b = random_batches(1, 8, HIDDEN)[0]
+    l_ref = float(np.asarray(e_ref.train_batch(b)))
+    l_new = float(np.asarray(e.train_batch(b)))
+    assert np.isfinite(l_new) and abs(l_new - l_ref) < 1e-4
+
+
+def test_halve_micro_batch_refuses_with_attached_dataloader():
+    """A built dataloader owns the batch shape: the actuator must refuse
+    (and the policy records skipped:dataloader) even with resilience OFF."""
+    e = _engine({"control": _control_cfg()})
+    e._remat_policy = "nothing_saveable"    # ladder already exhausted
+    e._train_dataloader = object()          # what initialize() attaches
+    sup = e.control
+    sup._mem_fn = lambda: {"bytes_in_use": 99, "bytes_limit": 100}
+    gas0 = e.gas
+    assert not e.halve_micro_batch()
+    sup.on_step()
+    assert e.gas == gas0
+    act = sup.ledger.actions("halve_micro_batch")[0]
+    assert act.outcome == "skipped:dataloader"
+
+
+def test_replan_refuses_without_an_eligible_dp_grad_site():
+    """ZeRO>0 keeps declarative reductions: replan_dp_grad must return
+    None (and the rule record a skip), never claim success."""
+    e = _engine({"comm_planner": {"mode": "static", "use_cache": False},
+                 "zero_optimization": {"stage": 2},
+                 "control": _control_cfg()})
+    assert not e._dp_grad_site_eligible
+    assert e.replan_dp_grad(("dp_outer",)) is None
+    sup = e.control
+    sup.straggler_rows = lambda: [(1, 5.0)]
+    sup.slow_link_axes = lambda: ("dp_outer",)
+    sup.on_step()
+    act = sup.ledger.actions("straggler_replan")[0]
+    assert act.outcome == "skipped:no-replannable-site"
+
+
+def test_autotuner_from_config_plumbs_the_block():
+    cfg = DeepSpeedTPUConfig.from_dict({
+        "train_micro_batch_size_per_gpu": 8,
+        "control": {"enabled": True,
+                    "autotune": {"dims": ["gas", "stage"],
+                                 "tuner_type": "gridsearch",
+                                 "measure_steps": 5, "use_cache": False}}})
+    at = ControlAutotuner.from_config(cfg)
+    assert at.dims == ("gas", "stage")
+    assert at.tuner_type == "gridsearch" and at.measure_steps == 5
+    assert at.cache is None
+    assert at.base_config["train_micro_batch_size_per_gpu"] == 8
+    # a bare block needs an explicit base
+    with pytest.raises(ValueError, match="base_config"):
+        ControlAutotuner.from_config({"dims": ["gas"]})
+    at2 = ControlAutotuner.from_config({"dims": ["gas"]},
+                                       base_config={"x": 1},
+                                       measure_steps=9)
+    assert at2.dims == ("gas",) and at2.measure_steps == 9
+
+
+def test_actions_land_in_registry_counter_and_monitor_events(tmp_path):
+    """Satellite: every automated decision shows up as
+    dstpu_control_actions_total{action=} in the Prometheus registry and as
+    a Control/* event through the monitor bridge."""
+    e = _engine({"control": _control_cfg(),
+                 "telemetry": {"enabled": True, "flight_steps": 4,
+                               "flight_dir": str(tmp_path)}})
+    events = []
+    e.monitor = types.SimpleNamespace(
+        write_events=lambda evs: events.extend(evs))
+    sup = e.control
+    mem = {"bytes_in_use": 95, "bytes_limit": 100}
+    sup._mem_fn = lambda: mem
+    sup.on_step()
+    from deepspeed_tpu.telemetry import get_registry
+
+    c = get_registry().counter("dstpu_control_actions_total")
+    assert c.value(action="raise_remat") >= 1.0
+    assert "dstpu_control_actions_total" in get_registry().exposition()
+    assert any(name == "Control/raise_remat" for name, _, _ in events)
+    # the ledger rides the flight dump
+    path = e.telemetry.flight_dump("rollback", {})
+    doc = json.loads(open(path).read())
+    assert doc["control"][0]["action"] == "raise_remat"
+    e.telemetry.close()
+
+
+def test_rollback_signal_enters_degraded_mode():
+    now = [0.0]
+    sup = _supervisor(clock=lambda: now[0],
+                      supervisor={"rollback_threshold": 2,
+                                  "rollback_window_s": 600.0})
+    entered = []
+    rz = types.SimpleNamespace(
+        degraded=False,
+        enter_degraded=lambda reason: entered.append(reason))
+    sup.engine = types.SimpleNamespace(global_steps=5, resilience=rz)
+    sup.note_rollback(3)
+    sup.on_step()
+    assert not entered                      # below threshold
+    sup.note_rollback(5)
+    sup.on_step()
+    assert len(entered) == 1 and "2 sentinel rollback" in entered[0]
+    act = sup.ledger.actions("enter_degraded")[0]
+    assert act.outcome == "ok" and act.rule == "rollback_degrade"
+    # window drains (signal clears, latch re-arms), a new storm fires again
+    # — but the run is already degraded: a recorded no-op, not a crash
+    now[0] = 1000.0
+    rz.degraded = True
+    sup.on_step()                           # clear observation: re-arm
+    sup.note_rollback(7)
+    sup.note_rollback(8)
+    sup.on_step()
+    skipped = [a for a in sup.ledger.actions("enter_degraded")
+               if a.outcome == "skipped:already-degraded"]
+    assert len(entered) == 1 and skipped
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end drill: slow_rank -> straggler verdict -> re-plan -> doctor
+# ---------------------------------------------------------------------------
+
+
+def _dp2_setup():
+    rng = np.random.default_rng(0)
+    params = {"w1": jnp.asarray(rng.normal(size=(128, 256)) * 0.05,
+                                jnp.float32),
+              "w2": jnp.asarray(rng.normal(size=(256, 64)) * 0.05,
+                                jnp.float32)}
+
+    def loss_fn(p, batch, rng=None):
+        x, y = batch
+        return jnp.mean((jnp.tanh(x @ p["w1"]) @ p["w2"] - y) ** 2)
+
+    def batch(i, n=64):
+        r = np.random.default_rng(1000 + i)
+        x = jnp.asarray(r.normal(size=(n, 128)), jnp.float32)
+        return (x, jnp.asarray(x[:, :64] * 0.5, jnp.float32))
+
+    return params, loss_fn, batch
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs an 8-device mesh")
+def test_slow_rank_drill_replans_around_link_and_doctor_names_it(tmp_path):
+    """Acceptance drill: an injected FaultPlan.slow_rank straggler makes
+    the controller log a re-plan within K steps; the new plan's full-width
+    phases exclude the slow link; the doctor report names the action."""
+    params, loss_fn, batch = _dp2_setup()
+    hb = str(tmp_path / "hb")
+    cfg = {"train_micro_batch_size_per_gpu": 8,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+           "steps_per_print": 10**9,
+           "comm_planner": {"mode": "static", "use_cache": False},
+           "telemetry": {"enabled": True, "flight_dir": str(tmp_path)},
+           "control": _control_cfg(guard={"trigger_streak": 2,
+                                          "cooldown_s": 600.0,
+                                          "clear_streak": 2}),
+           "resilience": {"enabled": True, "snapshot_dir": str(tmp_path),
+                          "snapshot_interval": 0,
+                          "heartbeat": {"enabled": True, "interval_steps": 1,
+                                        "dir": hb, "straggler_factor": 3.0},
+                          "faults": {"enabled": True, "slow_rank": 0,
+                                     "slow_step_s": 0.05}}}
+    eng, *_ = ds.initialize(model=loss_fn, model_parameters=params,
+                            config=cfg,
+                            topology=Topology(TopologySpec(ep=2)))
+    assert eng._dp_grad_impl is None        # before: the exact psum
+    tr = FileHeartbeatTransport(hb)
+    K = 6
+    replanned_at = None
+    for i in range(K):
+        HeartbeatWriter(tr, rank=1).beat(step=i, step_time_s=0.001)
+        HeartbeatWriter(tr, rank=2).beat(step=i, step_time_s=0.001)
+        eng.train_batch(batch(i))
+        if eng._dp_grad_impl is not None:
+            replanned_at = eng.global_steps
+            break
+    assert replanned_at is not None and replanned_at <= K
+    acts = eng.control.ledger.actions("straggler_replan")
+    assert acts and acts[-1].outcome == "ok"
+    assert acts[-1].params["axes"] == ["dp_outer"]
+    assert 0 in acts[-1].params["ranks"]
+    # the new plan: a program whose full-width phases EXCLUDE the slow link
+    mode, _, prog = eng._dp_grad_impl
+    assert mode == "program"
+    for st in prog:
+        if st.phase_op in ("reduce_scatter", "all_gather"):
+            assert "dp_outer" not in st.axes
+    # training continues on the re-planned transport
+    l = float(np.asarray(eng.train_batch(batch(99))))
+    assert np.isfinite(l)
+    # the ledger rides the flight dump and the doctor names the action
+    from deepspeed_tpu import doctor
+
+    eng.telemetry.flight_dump("rollback", {"why": "drill dump"})
+    rep = doctor.diagnose(str(tmp_path))
+    assert any(a["action"] == "straggler_replan"
+               for a in rep["supervisor_actions"])
+    out = doctor.render_report(rep)
+    assert any("supervisor action" in ln and "straggler_replan" in ln
+               for ln in out.splitlines())
+    eng.resilience.close()
+    eng.telemetry.close()
+
+
+# ---------------------------------------------------------------------------
+# autotuner v2
+# ---------------------------------------------------------------------------
+
+
+AT_SCRIPT = textwrap.dedent("""
+    import json, sys
+    import numpy as np, jax.numpy as jnp
+    from deepspeed_tpu.control import ControlAutotuner
+
+    params = {"w": jnp.asarray(
+        np.random.default_rng(0).normal(size=(32, 32)) * 0.05, jnp.float32)}
+    loss = lambda p, b, rng=None: jnp.mean((b @ p["w"]) ** 2)
+    batch_fn = lambda gbs: jnp.asarray(
+        np.random.default_rng(0).normal(size=(max(gbs, 8), 32)), np.float32)
+    base = {"train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "steps_per_print": 10**9}
+    at = ControlAutotuner(base, dims=("gas", "remat", "compression"),
+                          warmup_steps=1, measure_steps=1,
+                          tuner_type="model", early_stop=2,
+                          probe_programs=False)
+    best = at.tune(loss, params, batch_fn)
+    print(json.dumps({"probes": at.probes_run, "grid": at.grid_size,
+                      "from_cache": at.from_cache,
+                      "winner": at.best["name"],
+                      "gas": best.get("gradient_accumulation_steps")}))
+""")
+
+
+def _run_at_subprocess(cache_dir):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               DSTPU_PLAN_CACHE=str(cache_dir))
+    out = subprocess.run([sys.executable, "-c", AT_SCRIPT], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_autotuner_v2_fewer_probes_than_grid_and_fresh_process_reuse(
+        tmp_path, monkeypatch):
+    """Acceptance: the model-based search finds a winner over 3 knob
+    dimensions in fewer probes than the exhaustive grid; the winner is
+    cached per mesh fingerprint and a FRESH PROCESS on the same mesh
+    reuses it with zero probes (asserted via the probe counter)."""
+    monkeypatch.setenv("DSTPU_PLAN_CACHE", str(tmp_path))
+    # the fingerprint keys the winner cache: capture it on the SAME default
+    # topology the fresh process will see (earlier tests may have left an
+    # ep-split fleet topology behind)
+    from deepspeed_tpu.parallel.topology import reset_topology
+
+    reset_topology()
+    params = {"w": jnp.asarray(
+        np.random.default_rng(0).normal(size=(32, 32)) * 0.05, jnp.float32)}
+    loss = lambda p, b, rng=None: jnp.mean((b @ p["w"]) ** 2)  # noqa: E731
+    batch_fn = lambda gbs: jnp.asarray(  # noqa: E731
+        np.random.default_rng(0).normal(size=(max(gbs, 8), 32)), np.float32)
+    base = {"train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "steps_per_print": 10**9}
+    at = ControlAutotuner(base, dims=("gas", "remat", "compression"),
+                          warmup_steps=1, measure_steps=1,
+                          tuner_type="model", early_stop=2,
+                          probe_programs=False)
+    best = at.tune(loss, params, batch_fn)
+    assert len(at.dims) == 3 and at.grid_size == 12
+    assert 0 < at.probes_run < at.grid_size     # fewer than exhaustive
+    assert not at.from_cache and at.best["name"]
+    assert isinstance(best, dict)
+    # a fresh PROCESS on the same mesh: zero probes, same winner
+    res = _run_at_subprocess(tmp_path)
+    assert res["from_cache"] is True
+    assert res["probes"] == 0
+    assert res["winner"] == at.best["name"]
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs an 8-device mesh")
+def test_program_probes_ride_the_microbench_executor():
+    """The planner-program dimension: synthesized multi-phase dp-grad
+    programs are timed through the planner's own microbench executor and
+    a winner is recorded."""
+    from deepspeed_tpu.comm.planner import configure_planner, reset_planner
+    from deepspeed_tpu.control import probe_collective_programs
+    from deepspeed_tpu.parallel.topology import reset_topology, set_topology
+
+    topo = Topology(TopologySpec(ep=2))
+    set_topology(topo)                      # the probes run on this mesh
+    configure_planner("static", use_cache=False, dcn_axes=["dp_outer"],
+                      topology=topo)
+    try:
+        res = probe_collective_programs(1 << 12, axes=("dp_outer", "ep"),
+                                        reps=2, repeats=1,
+                                        max_elems=1 << 12)
+    finally:
+        reset_planner()
+        reset_topology()
+    assert res is not None
+    assert any(k.startswith("program:") for k in res["timings_us"])
+    assert res["winner"] in res["timings_us"]
+    assert res["timings_us"][res["winner"]] == min(res["timings_us"].values())
