@@ -1135,10 +1135,22 @@ def serving_bench():
     model = TransformerLM(cfg)
     params = init_params(model, batch=1, seq=64)
     engine = InferenceEngineV2(model, params, eng_cfg)
-    # warm the compile caches OFF the clock (the packed-step program), then
-    # serve the seeded schedule
+    # warm the compile caches OFF the clock (the packed-step program AND the
+    # fused-decode programs at the table widths generation grows through),
+    # then serve the seeded schedule
     engine.generate([np.arange(1, 9, dtype=np.int32)], max_new_tokens=4)
-    server = LLMServer(engine, policy="deadline", max_queue=512).start()
+    fused_chunk = 8
+    warm_new = min(6 * fused_chunk,
+                   eng_cfg.max_blocks_per_seq * eng_cfg.kv_block_size - 16)
+    engine.put([10**9], [np.arange(1, 9, dtype=np.int32)],
+               max_new_tokens=warm_new)
+    while any(s.in_prefill for s in engine.state_manager.all()):
+        engine.step()
+    for _ in range(4):
+        engine.decode_batch(fused_chunk)
+    engine.flush(10**9)
+    server = LLMServer(engine, policy="deadline", max_queue=512,
+                       fused_decode_chunk=fused_chunk).start()
     t0 = time.perf_counter()
     resps, rejected = OpenLoopTraffic(traffic).run(
         lambda req: server.submit(req))
@@ -1163,6 +1175,104 @@ def serving_bench():
             "rate_rps": traffic.rate_rps, "num_requests": traffic.num_requests,
             "drained": drained, "wall_s": round(wall, 3),
             "policy": "deadline", "seed": traffic.seed,
+            # which attention paths served this row (engine_v2 resolution,
+            # stamped into ServingMetrics) + the fused-decode chunk width
+            "attn_impl": snap["attn_impl"],
+            "decode_attn_impl": snap["decode_attn_impl"],
+            "fused_decode_chunk": server.fused_decode_chunk,
+            "device": getattr(dev, "device_kind", dev.platform)}
+
+
+def paged_decode_bench():
+    """Rung pd (paged decode fastpath, ops/pallas/paged_attention.py
+    paged_flash_decode): fused multi-token decode step time, the
+    resident-pool pallas flash-decode kernel vs the gathered-page einsum
+    reference, on fp KV pools and on int8 (values, scales) pools (dequant
+    fused in-kernel vs dequant-on-gather), plus the per-step pool bytes
+    each arm touches from the comms ledger (``paged_pool_gather`` = the
+    einsum path's materialized copy, the tensor the kernel deletes;
+    ``paged_pool_read`` = the kernel's in-place page-read upper bound).
+    Value = per-token decode time of the impl the engine's auto resolution
+    would actually serve on this host, so the lower-is-better gate tracks
+    the serving decode hot path."""
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models.transformer import (TransformerLM, init_params,
+                                                  llama_config)
+    import deepspeed_tpu.comm as dist
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = llama_config("7b", num_layers=12, hidden_size=1536,
+                           intermediate_size=4096, num_heads=12,
+                           num_kv_heads=4, vocab_size=32000, max_seq_len=4096,
+                           dtype=jnp.bfloat16)
+        S, chunk, blocks, bs, bps = 16, 32, 400, 128, 8
+        compute = "bfloat16"
+    else:
+        cfg = llama_config("7b", num_layers=2, hidden_size=128,
+                           intermediate_size=256, num_heads=4, num_kv_heads=2,
+                           vocab_size=512, max_seq_len=256, dtype=jnp.float32)
+        S, chunk, blocks, bs, bps = 4, 8, 64, 8, 8
+        compute = "float32"
+    model = TransformerLM(cfg)
+    params = init_params(model, batch=1, seq=32)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, cfg.vocab_size, 16).astype(np.int32)
+               for _ in range(S)]
+    max_new = bps * bs - 24              # fits max_blocks_per_seq worst-case
+    logger = dist.get_comms_logger()
+    # the pool-byte columns ARE the measurement: enable the ledger here so
+    # a standalone `--rung pd` doesn't silently report zeros
+    logger.configure(enabled=True, prof_all=True)
+    pool_mb = None
+
+    def run(backend, kv_dtype):
+        nonlocal pool_mb
+        eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            token_budget=S * 32, max_ragged_sequence_count=S,
+            max_chunk_size=32, num_kv_blocks=blocks, kv_block_size=bs,
+            max_blocks_per_seq=bps, dtype=compute, kv_cache_dtype=kv_dtype,
+            decode_attn_backend=backend, decode_chunk=chunk))
+        pool_mb = round(eng.kv.pool_nbytes() / 2**20, 2)
+        eng.put(list(range(S)), prompts, max_new_tokens=max_new)
+        while any(s.in_prefill for s in eng.state_manager.all()):
+            eng.step()
+        logger.reset()               # decode-trace pool rows only
+        eng.decode_batch(chunk)      # compile + trace (ledger records here)
+        tot = logger.totals()
+        reps, best = 3, float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            got = eng.decode_batch(chunk)
+            n = max((len(t) for t in got.values()), default=chunk)
+            best = min(best, (time.perf_counter() - t0) / max(1, n))
+        row = lambda op: tot.get(op, {}).get("bytes", 0)
+        return (best * 1e3, row("paged_pool_gather"), row("paged_pool_read"),
+                eng.decode_attn_impl)
+
+    t_einsum, gather_b, _, _ = run("einsum", None)
+    t_pallas, _, read_b, _ = run("pallas", None)
+    t_einsum_q, gather_q, _, _ = run("einsum", "int8")
+    t_pallas_q, _, read_q, _ = run("pallas", "int8")
+    # the impl auto resolution serves on THIS host (heuristic: tpu->pallas)
+    auto = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        num_kv_blocks=16, kv_block_size=bs, max_blocks_per_seq=2,
+        dtype=compute)).decode_attn_impl
+    served = t_pallas if auto == "pallas" else t_einsum
+    return {"metric": "paged_decode_step_ms",
+            "value": round(served, 4), "unit": "ms/tok",
+            "vs_baseline": None, "served_impl": auto,
+            "t_einsum_ms": round(t_einsum, 4),
+            "t_pallas_ms": round(t_pallas, 4),
+            "t_einsum_int8_ms": round(t_einsum_q, 4),
+            "t_pallas_int8_ms": round(t_pallas_q, 4),
+            "einsum_pool_gather_bytes_per_step": gather_b,
+            "pallas_pool_read_bytes_per_step": read_b,
+            "einsum_int8_pool_gather_bytes_per_step": gather_q,
+            "pallas_int8_pool_read_bytes_per_step": read_q,
+            "pool_mb": pool_mb, "decode_chunk": chunk, "seqs": S,
             "device": getattr(dev, "device_kind", dev.platform)}
 
 
@@ -1613,7 +1723,8 @@ RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "cm": collective_matmul_bench, "qx": quantized_collectives_bench,
          "plan": planner_bench, "rz": resilience_bench,
          "wd": watchdog_bench, "fl": fused_hotpath_bench,
-         "sv": serving_bench, "ds": dcn_hierarchical_bench,
+         "sv": serving_bench, "pd": paged_decode_bench,
+         "ds": dcn_hierarchical_bench,
          "ob": telemetry_bench, "mem": memory_telemetry_bench,
          "sa": static_audit_bench, "at": control_bench}
 
@@ -1640,6 +1751,7 @@ GATE_SPECS = {
     "control_decide_ns": ("lower", 1.0),         # supervisor loop: host cost
     "dcn_hierarchical": ("higher", 0.05),        # ledger bytes: deterministic
     "llama_zero3_bf16_mfu": ("higher", 0.15),    # the TPU headline: tight
+    "paged_decode_step_ms": ("lower", 1.0),      # decode hot path: wall-clock
 }
 
 
@@ -1767,6 +1879,9 @@ def run_ladder(gate: bool = False):
             ("qx", {} if multichip else cpu8),
             ("plan", {} if multichip else cpu8),
             ("rz", chip), ("wd", cpu1), ("fl", chip), ("sv", chip),
+            # pd compares the paged decode kernel against the einsum
+            # reference (interpret-mode pallas on CPU; real kernel on TPU)
+            ("pd", chip),
             # ds simulates the DCN split (dcn_axes override) — the virtual
             # CPU mesh IS the measurement substrate, even beside a real chip
             ("ds", cpu8), ("ob", cpu1),

@@ -168,6 +168,16 @@ def log_chunked(op: str, nbytes: int, wire_bytes: Optional[int] = None,
     record_launch(op, shape=(int(nbytes),), axes=axes, impl="ring")
 
 
+def log_local(op: str, nbytes: int) -> None:
+    """Trace-time ledger entry for LOCAL (HBM-side) traffic an
+    implementation choice implies — e.g. the paged-decode pool bytes
+    (``inference/v2/model.py``: the einsum path's materialized gather copy
+    vs the Pallas kernel's in-place page reads). No collective launches, so
+    nothing is recorded in the collective flight ring: the doctor's
+    cross-rank seq alignment must only ever see real launches."""
+    _COMMS_LOGGER.append(op, int(nbytes), traced=True)
+
+
 def log_compressed(op: str, logical_bytes: int, wire_bytes: int,
                    link: Optional[str] = None,
                    axes: Optional[Sequence[str]] = None,

@@ -38,8 +38,13 @@ import numpy as np
 #                  run_collective_program — e.g. exact reduce-scatter over
 #                  the ICI axes, int8+error-feedback all-reduce over the
 #                  DCN axis, all-gather back over ICI
+#   einsum       — serving decode_attn only: the gathered-page dense
+#                  reference path (inference/v2/model.paged_attention)
+#   pallas       — serving decode_attn only: the resident-pool paged
+#                  flash-decode kernel (ops/pallas/paged_attention.
+#                  paged_flash_decode, int8 dequant fused in-kernel)
 IMPLEMENTATIONS = ("xla", "ring", "bidir_ring", "hierarchical", "int8",
-                   "int8_sr", "fused_matmul", "program")
+                   "int8_sr", "fused_matmul", "program", "einsum", "pallas")
 
 # the phase vocabulary a program decision is built from; each phase lowers
 # to one collective primitive over its own axes with its own wire dtype
@@ -67,10 +72,20 @@ OP_MENU: Dict[str, Tuple[str, ...]] = {
     # chunk hops behind the resident chunk's row lookups
     # (ops/collective_matmul.py ring_embedding_gather / ring_tied_lm_head)
     "embed_gather": ("xla", "ring", "bidir_ring"),
+    # serving fused-decode attention (inference/v2): not a collective at
+    # all but a kernel choice with a decode-shape cost regime — the site
+    # shape is the gathered pool view one decode step touches
+    # ([S, B*bs, Hk, D] in the storage dtype), axes are empty. einsum
+    # materializes a compute-dtype copy of it per step; pallas streams the
+    # live pages of the resident pool in place (topo._estimate_decode_attn)
+    "decode_attn": ("einsum", "pallas"),
 }
 
-# the wired consumers (PR 3's five + the PR 6 embedding site)
-CONSUMERS = ("tp-linear", "ulysses", "moe-a2a", "dp-grad", "zeropp", "embed")
+# the wired consumers (PR 3's five + the PR 6 embedding site + the
+# serving decode tier: decode_attn and the decode-TP projections'
+# gather_matmul both resolve under "decode")
+CONSUMERS = ("tp-linear", "ulysses", "moe-a2a", "dp-grad", "zeropp", "embed",
+             "decode")
 
 # consumers whose payload is a gradient: stochastic rounding is admissible
 # (unbiased compression matters there); activation exchanges keep nearest

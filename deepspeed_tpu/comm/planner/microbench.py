@@ -26,6 +26,61 @@ def _probe_elems(site: CollectiveSite, p: int, max_elems: int) -> int:
     return max(quantum, -(-n // quantum) * quantum)
 
 
+def _decode_attn_probe(site: CollectiveSite, impl: str, *, reps: int,
+                       max_elems: int):
+    """Single-device probe for the serving ``decode_attn`` site (a kernel
+    choice, not a collective — no mesh axis, no shard_map): one fused-decode
+    attention step at a capped version of the site's pool shape, the
+    gathered-page einsum reference vs the Pallas paged flash-decode kernel
+    (interpret mode off-TPU, so measure mode stays honest about what THIS
+    host would actually run). ``site.dtype == int8`` probes the quantized
+    (values, scales) pool form through both paths."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ...inference.v2.model import paged_attention as einsum_paged
+    from ...ops.pallas.paged_attention import paged_flash_decode
+    from ...ops.pallas.quant import quantize_rows
+
+    S, slots, Hk, D = (tuple(site.shape) + (4, 64, 2, 32))[:4]
+    S = max(1, min(int(S), 4))
+    bs = 8 if slots < 128 else 128
+    # cap the pool at max_elems total values
+    slots = max(bs, min(int(slots), max(bs, int(max_elems) // (Hk * D))))
+    B = -(-slots // bs)
+    N = S * B + 1
+    key = jax.random.PRNGKey(0)
+    kp = jax.random.normal(key, (1, N, Hk, bs, D), jnp.float32)
+    vp = jax.random.normal(jax.random.fold_in(key, 1),
+                           (1, N, Hk, bs, D), jnp.float32)
+    if site.dtype == "int8":
+        kp, vp = quantize_rows(kp), quantize_rows(vp)
+    bt = (1 + jnp.arange(S * B, dtype=jnp.int32)).reshape(S, B)
+    kvl = jnp.full((S,), B * bs - bs // 2, jnp.int32)  # partial last page
+    pos = kvl  # the decode query sits one past the pool
+    q = jax.random.normal(jax.random.fold_in(key, 2), (S, Hk, D), jnp.float32)
+
+    def one(qv):
+        if impl == "pallas":
+            return paged_flash_decode(qv, kp, vp, bt, pos, kvl)
+        out = einsum_paged(qv[:, None], _layer(kp), _layer(vp), bt,
+                           pos[:, None], jnp.ones((S, 1), bool), kvl)
+        return out[:, 0]
+
+    def _layer(pool):
+        return (pool[0][0], pool[1][0]) if isinstance(pool, tuple) else pool[0]
+
+    def loop(qv):
+        def body(c, _):
+            return one(c) * jnp.float32(0.5) + qv * jnp.float32(0.5), ()
+
+        c, _ = lax.scan(body, qv, None, length=reps)
+        return c.reshape(-1)[0]
+
+    return jax.jit(loop), q
+
+
 def build_probe(site: CollectiveSite, impl: str, *, mesh=None,
                 block: Optional[int] = None, reps: int = 4,
                 max_elems: int = 1 << 16, program=None):
@@ -48,6 +103,9 @@ def build_probe(site: CollectiveSite, impl: str, *, mesh=None,
 
     from ...parallel.topology import get_topology
     from ...utils.shard_map_compat import shard_map_nocheck
+
+    if site.op == "decode_attn":
+        return _decode_attn_probe(site, impl, reps=reps, max_elems=max_elems)
 
     topo = get_topology()
     mesh = mesh or topo.mesh

@@ -249,6 +249,12 @@ class CollectivePlanner:
     # ------------------------------------------------------------------
     def _knob_decision(self, site: CollectiveSite) -> Optional[PlanDecision]:
         """Explicitly-set raw knobs win over any planning."""
+        if site.op == "decode_attn":
+            # the serving decode kernel choice: no raw training knob maps
+            # to it (the engine's own attn_backend pins are applied BEFORE
+            # the planner is consulted), and the compression knob must not
+            # hijack it into an "xla" decision that isn't on its menu
+            return None
         if site.op == "gather_matmul":
             if self.knobs.get("overlap"):
                 return PlanDecision(impl="fused_matmul", source="knob")
@@ -276,6 +282,8 @@ class CollectivePlanner:
 
     def _default_decision(self, site: CollectiveSite) -> PlanDecision:
         """Planner off, no knob: what the tree does today."""
+        if site.op == "decode_attn":
+            return PlanDecision(impl="einsum", source="default")
         if site.consumer == "zeropp":
             # zeropp_train_step_factory's legacy default is quantized ON
             return PlanDecision(impl="int8", block=self.block,

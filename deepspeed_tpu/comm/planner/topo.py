@@ -56,6 +56,15 @@ OVERLAP_CREDIT = 0.55
 # fused XLA collective
 RING_HOP_PENALTY = 1.5
 
+# --- decode-shape regime (serving decode_attn) -----------------------------
+# HBM streaming rates, bytes/s: decode attention moves no link traffic —
+# the candidates differ only in pool bytes touched per step (order-of-
+# magnitude public figures; ratios are what rank the impls)
+HBM_BW = {"tpu": 8e11, "cpu": 2e10}
+# fraction of the (power-of-two-sliced) block table's pages that are live
+# mid-generation — what the pallas kernel's clamped index map actually DMAs
+DECODE_LIVE_FRACTION = 0.75
+
 
 @dataclass(frozen=True)
 class MeshFingerprint:
@@ -132,6 +141,7 @@ class CostModel:
         self.fp = fingerprint
         self.block = block
         platform = "tpu" if assume_fleet else fingerprint.platform
+        self.platform = platform
         self.quant_cost = QUANT_COST_PER_BYTE.get(platform, _QUANT_DEFAULT)
         self.quant_fixed = QUANT_FIXED
         # per-axis cost multipliers (alpha AND beta): the control plane's
@@ -198,9 +208,38 @@ class CostModel:
         item = max(1, int(np.dtype(dtype).itemsize))
         return (1.0 + 4.0 / self.block) / item
 
+    def _estimate_decode_attn(self, site: CollectiveSite, impl: str) -> float:
+        """Decode-shape regime: ``site.shape`` is the gathered pool view one
+        decode step touches ([S, B*bs, Hk, D] in the STORAGE dtype, one
+        pool); K and V double it. The einsum path materializes a
+        compute-dtype copy (read the pool, write the copy, read it back in
+        the attention einsum — plus the dequant stream for int8 storage);
+        the pallas kernel streams the live pages once, in place. No link
+        term: decode_attn is a kernel choice, not a collective."""
+        bw = HBM_BW.get(self.platform, HBM_BW["cpu"])
+        n = 2.0 * float(site.nbytes)          # K and V pools
+        item = max(1, int(np.dtype(site.dtype).itemsize))
+        if impl == "einsum":
+            # the gathered copy lands in the COMPUTE dtype: same width as
+            # fp/bf16 storage, widened for int8 pools (bf16 is the serving
+            # compute dtype on TPU, so assume 2 bytes there)
+            copy = n * (max(2.0, float(item)) / item)
+            t = n / bw + 2.0 * copy / bw
+            if site.dtype == "int8":
+                t += n * self.quant_cost
+            return t
+        if impl == "pallas":
+            if self.platform != "tpu":
+                # interpret mode off-TPU: a reference path, never a win
+                return float("inf")
+            return n * DECODE_LIVE_FRACTION / bw
+        return float("inf")
+
     # -- per-impl estimate -------------------------------------------------
     def estimate(self, site: CollectiveSite, impl: str) -> float:
         """Predicted seconds for one execution of ``site`` via ``impl``."""
+        if site.op == "decode_attn":
+            return self._estimate_decode_attn(site, impl)
         p = self.axis_size_of(site)
         if p <= 1:
             return 0.0

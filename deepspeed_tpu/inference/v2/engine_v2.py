@@ -48,6 +48,14 @@ class RaggedInferenceEngineConfig:
     seed: int = 0
     # "auto": Pallas paged kernel on TPU, einsum reference path on CPU.
     attn_backend: str = "auto"    # auto | pallas | einsum
+    # fused-decode attention path (model.decode_loop) SPECIFICALLY: "auto"
+    # resolves model field > this knob > attn_backend > planner (decode_attn
+    # op) > accelerator heuristic, mirroring resolve_loss_impl. The pallas
+    # decode kernel reads the resident pool in place (incl. int8 (values,
+    # scales) pools, dequant fused in-kernel); structural fallbacks
+    # (ALiBi / windows / fp8 storage / off-tile head dim on TPU) warn once
+    # and run the gathered-page einsum reference instead.
+    decode_attn_backend: str = "auto"   # auto | pallas | einsum
     # decode iterations fused into one compiled program by decode_batch()
     # (one host round-trip per chunk instead of per token)
     decode_chunk: int = 16
@@ -58,6 +66,18 @@ class RaggedInferenceEngineConfig:
     max_fused_window: int = 512
 
 
+_DECODE_WARNED = set()
+
+
+def _warn_decode_once(msg: str) -> None:
+    if msg in _DECODE_WARNED:
+        return
+    _DECODE_WARNED.add(msg)
+    from ...utils.logging import logger
+
+    logger.warning(msg)
+
+
 class InferenceEngineV2:
     def __init__(self, model: TransformerLM, params,
                  config: Optional[RaggedInferenceEngineConfig] = None):
@@ -65,23 +85,18 @@ class InferenceEngineV2:
         c = self.config
         self.model = model  # reference engine_v2 `model` property
         self.cfg: TransformerConfig = model.cfg
-        # families whose attention needs logit bias/masking beyond plain
-        # causal (ALiBi bloom/mpt, unscaled gpt-neo, windowed gpt-neo local
-        # layers): served on the gathered-page einsum path — the Pallas paged
-        # kernel computes plain scaled causal attention only
+        # families whose attention needs per-head logit bias/windowing
+        # beyond plain scaled causal (ALiBi bloom/mpt, windowed gpt-neo
+        # local layers): served on the gathered-page einsum path — both
+        # Pallas kernels take an explicit sm_scale, so attn_scale families
+        # (unscaled gpt-neo globals) no longer count as special
         self._special_attn = (self.cfg.position == "alibi"
-                              or self.cfg.attn_scale is not None
                               or self.cfg.layer_windows is not None)
         dtype = jnp.dtype(c.dtype)
         self.params = jax.tree.map(
             lambda x: jnp.asarray(x, dtype) if jnp.issubdtype(
                 jnp.asarray(x).dtype, jnp.floating) else jnp.asarray(x), params)
         kv_dtype = jnp.dtype(c.kv_cache_dtype) if c.kv_cache_dtype else dtype
-        if c.attn_backend == "pallas" and kv_dtype != dtype:
-            raise ValueError(
-                "attn_backend='pallas' needs the KV pool in the compute "
-                "dtype; kv_cache_dtype storage quantization runs on the "
-                "gather (einsum) path — use attn_backend='auto' or 'einsum'")
         self.kv = BlockedKVCache(self.cfg.num_layers, c.num_kv_blocks,
                                  c.kv_block_size, self.cfg.kv_heads,
                                  self.cfg.head_dim, dtype=kv_dtype)
@@ -91,29 +106,132 @@ class InferenceEngineV2:
                                           max_chunk=c.max_chunk_size,
                                           max_blocks_per_seq=c.max_blocks_per_seq)
         self._key = jax.random.PRNGKey(c.seed)
+        for knob in (c.attn_backend, c.decode_attn_backend,
+                     getattr(self.cfg, "decode_attn_impl", "auto")):
+            if knob not in ("auto", "pallas", "einsum"):
+                raise ValueError(f"attn backend must be auto|pallas|einsum, "
+                                 f"got {knob!r}")
         if c.attn_backend == "pallas" and self._special_attn:
             raise ValueError(
                 "attn_backend='pallas' computes plain scaled causal "
-                "attention; ALiBi / attn_scale / layer_windows families "
+                "attention; ALiBi / layer_windows families "
                 "run on the einsum path — use attn_backend='auto'")
+        # packed/prefill path: the legacy chunk kernel takes fp pools in the
+        # compute dtype (quantized and storage-cast pools dequantize on the
+        # einsum gather); the FUSED DECODE kernel below has no such limit
         if c.attn_backend == "auto":
             self.attn_impl = ("pallas" if jax.default_backend() == "tpu"
                               and kv_dtype == dtype
                               and not self._special_attn else "einsum")
-            # fused decode: the paged kernel's pool operand gets re-laid-out
-            # (copied) on every pallas_call inside the scan, so step time
-            # grows with POOL size; the gather-einsum path reads only the
-            # block-table pages and measures ~1.6x faster (v5e, 16-32 seqs,
-            # ctx 512-1.5k). Prefill chunks amortize one call per 256 tokens
-            # and keep the kernel.
-            self.decode_attn_impl = "einsum"
+        elif c.attn_backend == "pallas" and kv_dtype != dtype:
+            _warn_decode_once(
+                f"attn_backend='pallas' with kv_cache_dtype={c.kv_cache_dtype}: "
+                "the packed-step kernel takes compute-dtype pools, so prompt "
+                "chunks run the einsum gather; the fused decode path keeps "
+                "the pallas kernel (int8 dequant fused in-kernel)")
+            self.attn_impl = "einsum"
         else:
             self.attn_impl = c.attn_backend
-            self.decode_attn_impl = c.attn_backend
+        self.decode_attn_impl, self.decode_attn_source = \
+            self._resolve_decode_attn(kv_dtype, dtype)
+        self._record_decode_plan(kv_dtype)
         self.steps = 0
         self.last_num_scheduled = 0
         log_dist(f"inference v2: budget={c.token_budget} seqs={c.max_ragged_sequence_count} "
-                 f"chunk={c.max_chunk_size} blocks={c.num_kv_blocks}x{c.kv_block_size}")
+                 f"chunk={c.max_chunk_size} blocks={c.num_kv_blocks}x{c.kv_block_size} "
+                 f"attn={self.attn_impl} decode_attn={self.decode_attn_impl}"
+                 f"({self.decode_attn_source})")
+
+    # ------------------------------------------------------------------
+    # decode-attention resolution (model field > serving/engine config >
+    # planner > heuristic — the resolve_loss_impl order)
+    # ------------------------------------------------------------------
+    def _decode_attn_site(self, kv_dtype):
+        """The planner-IR site for this engine's fused-decode attention:
+        ``shape`` is the gathered pool view one decode step would
+        materialize on the einsum path ([S, B*bs, Hk, D], ONE pool) in the
+        STORAGE dtype — the cost model's decode-shape regime prices both
+        impls from it."""
+        from ...comm.planner.ir import make_site
+
+        c = self.config
+        return make_site(op="decode_attn",
+                         shape=(c.max_ragged_sequence_count,
+                                c.max_blocks_per_seq * c.kv_block_size,
+                                self.cfg.kv_heads, self.cfg.head_dim),
+                         dtype=kv_dtype, axes=(), consumer="decode")
+
+    def _decode_structural_bail(self, kv_dtype, dtype) -> Optional[str]:
+        """Why the fused decode kernel cannot serve this model/pool, or
+        None. The kernel computes plain scaled causal attention over
+        compute-dtype or int8 (values, scales) pools."""
+        if self.cfg.position == "alibi":
+            return "the ALiBi per-head bias rides the logits"
+        if self.cfg.layer_windows is not None:
+            return "per-layer attention windows mask the logits"
+        if kv_dtype != dtype and kv_dtype != jnp.dtype(jnp.int8):
+            return (f"kv_cache_dtype={self.config.kv_cache_dtype} "
+                    "storage-cast pools dequantize on the gather path")
+        if jax.default_backend() == "tpu" and self.cfg.head_dim % 128:
+            return (f"head_dim {self.cfg.head_dim} is not a 128-lane "
+                    "multiple on this TPU")
+        return None
+
+    def _resolve_decode_attn(self, kv_dtype, dtype):
+        """-> (impl, source). An explicit model field wins, then the
+        engine/serving config (decode_attn_backend, then the shared
+        attn_backend), then a planner decision (``decode_attn`` first-class
+        op), then the accelerator heuristic; a structural bail demotes a
+        pallas pick to einsum with a one-time warning instead of the old
+        silent hard-pin."""
+        c = self.config
+        want, source = "auto", "heuristic"
+        if getattr(self.cfg, "decode_attn_impl", "auto") != "auto":
+            want, source = self.cfg.decode_attn_impl, "model"
+        elif c.decode_attn_backend != "auto":
+            want, source = c.decode_attn_backend, "config"
+        elif c.attn_backend != "auto":
+            want, source = c.attn_backend, "config"
+        if want == "auto":
+            try:
+                from ...comm.planner import get_planner, planner_active
+
+                if planner_active():
+                    d = get_planner().resolve(self._decode_attn_site(kv_dtype))
+                    if d.impl in ("pallas", "einsum"):
+                        want, source = d.impl, "planner"
+            except Exception:  # planning must never block engine bring-up
+                pass
+        if want == "auto":
+            want = "pallas" if jax.default_backend() == "tpu" else "einsum"
+            source = "heuristic"
+        if want == "pallas":
+            reason = self._decode_structural_bail(kv_dtype, dtype)
+            if reason:
+                _warn_decode_once(
+                    f"decode_attn='pallas' ({source}) but {reason} — fused "
+                    "decode falls back to the gathered-page einsum "
+                    "reference (one-time notice)")
+                return "einsum", "fallback"
+        return want, source
+
+    def _record_decode_plan(self, kv_dtype) -> None:
+        """Plan-table row for the resolved decode path: planner-sourced
+        decisions were already recorded by ``resolve()``; every other
+        source records here, so ``comm.log_summary()``'s plan table (and
+        the static auditor's reconciliation) always names which decode
+        attention implementation serves this engine."""
+        if self.decode_attn_source == "planner":
+            return
+        from ...comm import get_comms_logger
+
+        site = self._decode_attn_site(kv_dtype)
+        get_comms_logger().record_plan(site.signature(), {
+            "consumer": "decode", "op": "decode_attn",
+            "shape": "x".join(str(d) for d in site.shape),
+            "axes": "", "impl": self.decode_attn_impl, "block": None,
+            "source": self.decode_attn_source, "est_us": None,
+            "mode": "engine"})
 
     # ------------------------------------------------------------------
     # admission (reference put/query/can_schedule, engine_v2.py:107,158,184)

@@ -30,7 +30,7 @@ from ...models.transformer import (TransformerConfig, alibi_slopes,
                                    apply_activation, apply_rope,
                                    merge_partial_attention as merge_attention,
                                    rope_table)
-from ...ops.pallas.paged_attention import NEG_INF
+from ...ops.pallas.paged_attention import NEG_INF, paged_flash_decode
 from ...ops.pallas.paged_attention import paged_attention as paged_attention_pallas
 from ...ops.pallas.quant import dequantize_rows, quantize_rows
 
@@ -47,6 +47,18 @@ from ...ops.pallas.quant import dequantize_rows, quantize_rows
 
 def _pool_values(pool):
     return pool[0] if isinstance(pool, tuple) else pool
+
+
+def _log_pool(op: str, nbytes: int) -> None:
+    """Trace-time ledger entry for pool bytes an attention path touches per
+    step: ``paged_pool_gather`` is the einsum path's materialized gathered
+    copy (the tensor the Pallas decode kernel deletes), ``paged_pool_read``
+    the kernel's in-place page-read upper bound (clamped trailing pages
+    elide their DMA, so the true figure is the live-page subset). The ``pd``
+    bench rung reads these rows."""
+    from ... import comm
+
+    comm.log_local(op, int(nbytes))
 
 
 def _kv_layer(pool, i):
@@ -72,8 +84,25 @@ def _gather_pages(pool, block_table, dtype):
     dtype (consumers cast at the einsum)."""
     if isinstance(pool, tuple):
         q, s = pool
-        return dequantize_rows(q[block_table], s[block_table], dtype)
-    return pool[block_table]
+        out = dequantize_rows(q[block_table], s[block_table], dtype)
+    else:
+        out = pool[block_table]
+    _log_pool("paged_pool_gather",
+              int(np.prod(out.shape)) * jnp.dtype(out.dtype).itemsize)
+    return out
+
+
+def _pool_read_bytes(pool, block_table) -> int:
+    """Per-step upper bound on the bytes the Pallas paged kernel can DMA for
+    one pool: every block-table page at storage width (+ the scale rows for
+    int8 pools) — never a materialized copy."""
+    vals = _pool_values(pool)
+    hk, bs, d = vals.shape[-3:]
+    pages = int(np.prod(block_table.shape))
+    n = pages * hk * bs * d * jnp.dtype(vals.dtype).itemsize
+    if isinstance(pool, tuple):
+        n += pages * hk * bs * 4  # fp32 per-row scales
+    return n
 
 
 def _rms_norm(x, scale, eps):
@@ -291,8 +320,17 @@ def _ragged_forward_impl(params, cfg: TransformerConfig, kv_k, kv_v, tokens,
         kv_k = _kv_write(kv_k, i, tgt_block, tgt_slot, kg.reshape(-1, hk, d))
         kv_v = _kv_write(kv_v, i, tgt_block, tgt_slot, vg.reshape(-1, hk, d))
         if attn_impl == "pallas":
+            if isinstance(kv_k, tuple):
+                raise ValueError(
+                    "the packed-step pallas kernel takes compute-dtype "
+                    "pools; quantized pools run the einsum gather here "
+                    "(the fused-dequant kernel serves decode_loop)")
+            _log_pool("paged_pool_read",
+                      _pool_read_bytes(kv_k, block_table)
+                      + _pool_read_bytes(kv_v, block_table))
             out = paged_attention_pallas(qg, kv_k[i], kv_v[i], block_table,
-                                         start_pos, chunk_len, kv_len)
+                                         start_pos, chunk_len, kv_len,
+                                         sm_scale=cfg.attn_scale)
         else:
             win = cfg.layer_windows[i] if cfg.layer_windows else None
             out = paged_attention(qg, _kv_layer(kv_k, i), _kv_layer(kv_v, i),
@@ -438,20 +476,27 @@ def decode_loop(params, cfg: TransformerConfig, kv_k, kv_v, tokens0, pos0,
                 wk, kt.astype(wk.dtype)[None, None], (i, t, 0, 0, 0))
             wv = jax.lax.dynamic_update_slice(
                 wv, vt.astype(wv.dtype)[None, None], (i, t, 0, 0, 0))
-            qg = qt[:, None]                                        # [S, 1, Hq, D]
             win = cfg.layer_windows[i] if cfg.layer_windows else None
             if attn_impl == "pallas":
-                o1, m1, l1 = paged_attention_pallas(
-                    qg, kv_k[i], kv_v[i], block_table, pos, ones, pool_len,
-                    return_stats=True)
+                # resident-pool flash decode: the kernel indexes (layer,
+                # page) through the block table, so neither a per-layer
+                # pool slice nor a gathered copy materializes — int8 pools
+                # ride as (values, scales) with the dequant fused in-kernel
+                _log_pool("paged_pool_read",
+                          _pool_read_bytes(kv_k, block_table)
+                          + _pool_read_bytes(kv_v, block_table))
+                o1, m1, l1 = paged_flash_decode(
+                    qt, kv_k, kv_v, block_table, pos, pool_len,
+                    layer=i, sm_scale=sm, return_stats=True)  # [S, Hq, *]
             else:
+                qg = qt[:, None]                                # [S, 1, Hq, D]
                 o1, m1, l1 = paged_attention(
                     qg, _kv_layer(kv_k, i), _kv_layer(kv_v, i), block_table,
                     pos[:, None],
                     active[:, None], pool_len, return_stats=True,
                     alibi=alibi, alibi_post_scale=cfg.alibi_post_scale,
                     scale=cfg.attn_scale, window=win)
-            o1, m1, l1 = o1[:, 0], m1[:, 0], l1[:, 0]               # [S,Hq,*]
+                o1, m1, l1 = o1[:, 0], m1[:, 0], l1[:, 0]       # [S,Hq,*]
 
             # dense attention over the in-window tokens (incl. this one);
             # in-window token w sits at absolute position pos0 + w, so the
@@ -521,3 +566,101 @@ def decode_loop(params, cfg: TransformerConfig, kv_k, kv_v, tokens0, pos0,
         kv_k = _kv_write(kv_k, i, blk, slot, wkt[i])
         kv_v = _kv_write(kv_v, i, blk, slot, wvt[i])
     return toks.T, kv_k, kv_v                                       # [S, n_steps]
+
+
+# ---------------------------------------------------------------------------
+# TP-sharded decode projections (call inside shard_map over the tp axis).
+#
+# Decode TP layout: the S decode rows are sharded over the axis ([S/p, H]
+# per rank) and the projection weights stay column-sharded ([H, n/p] — each
+# rank keeps its head/vocab shard resident, nothing gathers weights). The
+# per-step collective is then the tiny sequence-row gather, and
+# ``impl="fused_matmul"`` hides it behind the projection matmul
+# (``ops/collective_matmul.all_gather_matmul`` / ``matmul_reduce_scatter``)
+# instead of paying it serially before the matmul — the T3
+# compute/collective-fusion thesis applied to the decode hot loop. The KV
+# pool shards by kv head alongside the projections, so each rank's paged
+# attention covers every sequence over its own heads. ``resolve`` asks the
+# collective planner (op=``gather_matmul``, consumer=``"decode"``) when the
+# impl is left at ``"auto"``; the decision lands in the plan table, so the
+# static auditor reconciles the decode-TP collectives against the plan
+# instead of flagging them unplanned.
+# ---------------------------------------------------------------------------
+
+
+def resolve_decode_tp_impl(axis: str, shape, dtype) -> str:
+    """``"fused_matmul" | "xla"`` for the decode projections' row gather:
+    planner-resolved (knob > cache > cost model > microbench, recorded in
+    the plan table) when a planner is active, the unfused XLA gather
+    otherwise."""
+    from ...comm.planner import planner_active, resolve_site
+
+    if not planner_active():
+        return "xla"
+    try:
+        d = resolve_site(op="gather_matmul", shape=tuple(int(s) for s in shape),
+                         dtype=dtype, axes=(str(axis),), consumer="decode")
+        return "fused_matmul" if d.impl == "fused_matmul" else "xla"
+    except Exception:
+        return "xla"
+
+
+def tp_decode_matmul(x, w, axis: str, *, impl: str = "auto"):
+    """Column-parallel decode projection: ``[S/p, H]`` local decode rows ×
+    ``[H, n_local]`` resident weight shard → ``[S, n_local]`` (every
+    sequence, this rank's output columns). ``fused_matmul`` rides
+    :func:`~...ops.collective_matmul.all_gather_matmul` — the row-chunk ring
+    hides behind the partial matmuls; ``xla`` gathers the rows first. Call
+    inside ``shard_map``."""
+    from ...ops.collective_matmul import all_gather_matmul
+
+    if impl == "auto":
+        impl = resolve_decode_tp_impl(axis, x.shape, x.dtype)
+    if impl == "fused_matmul":
+        return all_gather_matmul(x, w, axis)
+    full = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+    return full @ w.astype(full.dtype)
+
+
+def tp_decode_out_proj(attn, wo, axis: str, *, impl: str = "auto"):
+    """Row-parallel decode output projection: ``[S, n_local]`` per-rank
+    attention columns × ``[n_local, H]`` shard, summed over ranks and row-
+    scattered back to ``[S/p, H]``. ``fused_matmul`` rides
+    :func:`~...ops.collective_matmul.matmul_reduce_scatter` (reduction ring
+    behind the chunked matmul; needs ``S % p == 0``). Call inside
+    ``shard_map``."""
+    from ...ops.collective_matmul import matmul_reduce_scatter
+
+    if impl == "auto":
+        impl = resolve_decode_tp_impl(axis, attn.shape, attn.dtype)
+    if impl == "fused_matmul":
+        return matmul_reduce_scatter(attn, wo, axis)
+    return jax.lax.psum_scatter(attn @ wo.astype(attn.dtype), axis,
+                                scatter_dimension=0, tiled=True)
+
+
+def tp_decode_logits(h, w_vocab, axis: str, *, impl: str = "auto"):
+    """Vocab-parallel LM head for decode: ``[S/p, H]`` local rows ×
+    ``[H, V/p]`` vocab shard → ``[S, V/p]`` local-vocab logits for ALL
+    sequences — the row gather (tiny) overlaps the head matmul under
+    ``fused_matmul`` instead of preceding it. Pair with
+    :func:`tp_greedy_token` to sample without ever gathering ``[S, V]``."""
+    return tp_decode_matmul(h, w_vocab, axis, impl=impl)
+
+
+def tp_greedy_token(local_logits, axis: str):
+    """Global greedy argmax from vocab-sharded logits: each rank contributes
+    its ``(best value, global token id)`` pair and only ``[S]``-sized
+    scalars ride the wire instead of the vocab row. Tie-breaking matches the
+    dense ``argmax`` (lowest global id wins: per-shard argmax picks the
+    lowest local id, the cross-shard argmax picks the first = lowest-offset
+    shard). Call inside ``shard_map``."""
+    vloc = local_logits.shape[-1]
+    off = jax.lax.axis_index(axis) * vloc
+    loc = local_logits.astype(jnp.float32)
+    best = jnp.max(loc, axis=-1)                                   # [S]
+    idx = (jnp.argmax(loc, axis=-1) + off).astype(jnp.int32)
+    bests = jax.lax.all_gather(best, axis, axis=0)                 # [p, S]
+    idxs = jax.lax.all_gather(idx, axis, axis=0)
+    win = jnp.argmax(bests, axis=0)                                # [S]
+    return jnp.take_along_axis(idxs, win[None], axis=0)[0]

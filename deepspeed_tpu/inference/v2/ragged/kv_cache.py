@@ -12,9 +12,18 @@ jitted step so updates are in-place); block reservation is host-side via
 library use case, ``csrc/quantization``): the pools hold int8 rows and a
 per-page scale tensor ``[L, num_blocks, Hk, block_size]`` rides alongside
 (one absmax scale per (page, slot, head) row, the ``ops/pallas/quant.py``
-``quantize_rows`` convention). Writers quantize on scatter, the gather
-attention path dequantizes on read — KV memory drops ~2x vs bf16 / ~4x vs
-fp32 at row-wise int8 fidelity."""
+``quantize_rows`` convention). Writers quantize on scatter; readers either
+dequantize on the einsum gather path or hand the (values, scales) pair
+straight to the Pallas paged flash-decode kernel, which fuses the dequant
+against the page tiles in VMEM — KV memory drops ~2x vs bf16 / ~4x vs fp32
+at row-wise int8 fidelity, with no full-precision copy on the decode path.
+
+Residency contract: the pools are DONATED through every jitted step
+(``ragged_step`` / ``decode_loop``), so :meth:`update` is an in-place
+device update and the decode kernel reads the committed pool where it
+lives — its index map resolves (layer, physical page) per grid step, so
+neither a per-layer slice nor a gathered copy of the pool is ever
+materialized per call."""
 
 from typing import Optional, Tuple
 
@@ -61,6 +70,16 @@ class BlockedKVCache:
         if self.quantized:
             return (self.k, self.k_scale), (self.v, self.v_scale)
         return self.k, self.v
+
+    def pool_nbytes(self) -> int:
+        """Total device bytes both pools (plus int8 scales) hold — what the
+        old carried-pool decode paid per scan iteration and the resident
+        kernel never touches beyond the live pages (the ``pd`` bench rung
+        reports this next to the per-step pool bytes from the ledger)."""
+        n = self.k.size * self.k.dtype.itemsize * 2
+        if self.quantized:
+            n += self.k_scale.size * self.k_scale.dtype.itemsize * 2
+        return int(n)
 
     @property
     def free_blocks(self) -> int:

@@ -92,6 +92,10 @@ class TransformerConfig:
     sequence_parallel: bool = False             # SP over the 'sp' axis
     sp_impl: str = "ulysses"                    # ulysses (all-to-all) | ring
     attn_impl: str = "auto"                     # auto | xla | flash (pallas)
+    # serving fused-decode attention (inference/v2): the model-level pin the
+    # engine's decode resolution honors first (model field > serving config
+    # > planner > heuristic — docs/inference.md decode path)
+    decode_attn_impl: str = "auto"              # auto | einsum | pallas
     # Pallas fused LM loss (ops/pallas/fused_loss.py): the lm-head matmul +
     # online-softmax + NLL run blockwise so [B, S, V] logits never
     # materialize; 'auto' defers to the training_fastpath fleet knob then

@@ -159,11 +159,23 @@ def quantize_rows(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return q, scale
 
 
+def dequant_rows_tile(q: jnp.ndarray, scale: jnp.ndarray,
+                      dtype=jnp.float32) -> jnp.ndarray:
+    """The :func:`quantize_rows` inverse for one tile: int8 values with one
+    scale per row, the scale broadcast over the last axis. This is the SINGLE
+    statement of the row-dequant convention — both the XLA gather path
+    (:func:`dequantize_rows`) and the Pallas paged flash-decode kernel
+    (``paged_attention.paged_flash_decode``, which fuses it against the page
+    tiles in VMEM) run exactly this arithmetic, so the two attention paths
+    see bit-identical dequantized rows."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def dequantize_rows(q: jnp.ndarray, scale: jnp.ndarray,
                     dtype=jnp.float32) -> jnp.ndarray:
     """Inverse of :func:`quantize_rows`: ``q * scale`` with the scale
     broadcast over the last axis (dequant-on-gather for the int8 KV pool)."""
-    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+    return dequant_rows_tile(q, scale, dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -740,6 +740,11 @@ class ServingConfig(ConfigModel):
     policy: str = "fcfs"                 # fcfs | priority | deadline
     preempt: bool = True                 # preempt prefills under block pressure
     max_queue: int = 256                 # bounded ingress (overload sheds)
+    # fused multi-token decode chunk (engine.decode_batch — the pallas
+    # paged flash-decode fast path): when > 1 and every live sequence is in
+    # steady decode, one server step runs a whole chunk in ONE compiled
+    # dispatch; tokens stream in chunk-sized bursts. 0 = off.
+    fused_decode_chunk: int = 0
     default_deadline_s: Optional[float] = None  # SLA stamped when unset
     idle_s: float = 0.001                # engine-thread sleep when idle
     metrics_interval_steps: int = 50     # Serving/* monitor event cadence

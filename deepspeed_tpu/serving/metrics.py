@@ -98,6 +98,20 @@ class ServingMetrics:
         self.inflight = 0
         self.kv_free_blocks = 0
         self.kv_total_blocks = 0
+        # implementation stamp: which attention kernels served this replica
+        # (engine_v2 resolution) — the sv/pd ladder rungs and post-hoc
+        # readers must know which decode path produced a latency row
+        self.attn_impl: Optional[str] = None
+        self.decode_attn_impl: Optional[str] = None
+
+    def stamp_impls(self, attn_impl: Optional[str] = None,
+                    decode_attn_impl: Optional[str] = None) -> None:
+        """Record the engine's resolved packed-step / fused-decode attention
+        implementations (``LLMServer`` stamps these at construction)."""
+        if attn_impl:
+            self.attn_impl = str(attn_impl)
+        if decode_attn_impl:
+            self.decode_attn_impl = str(decode_attn_impl)
 
     # ------------------------------------------------------------------
     def on_submit(self, resp: ServedResponse) -> None:
@@ -180,6 +194,8 @@ class ServingMetrics:
             "queue_depth": self.queue_depth, "inflight": self.inflight,
             "kv_occupancy": None if occ is None else round(occ, 4),
             "elapsed_s": round(self.elapsed_s, 3),
+            "attn_impl": self.attn_impl,
+            "decode_attn_impl": self.decode_attn_impl,
         }
 
     def monitor_events(self, step: int, prefix: str = "Serving") -> List[Event]:

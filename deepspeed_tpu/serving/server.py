@@ -55,13 +55,25 @@ class LLMServer:
                  replica_id: int = 0,
                  heartbeat=None, heartbeat_interval_s: float = 2.0,
                  default_deadline_s: Optional[float] = None,
+                 fused_decode_chunk: int = 0,
                  clock: Callable[[], float] = time.monotonic):
         self.engine = engine
         self.replica_id = int(replica_id)
         self.clock = clock
         self.idle_s = float(idle_s)
         self.default_deadline_s = default_deadline_s
+        # fused multi-token decode (engine.decode_batch — the pallas paged
+        # flash-decode fast path): when > 1 and every live sequence is in
+        # steady decode with nothing waiting to prefill, one engine step
+        # runs a whole chunk of decode iterations in ONE compiled dispatch
+        # instead of chunk packed single-token steps. Tokens then stream in
+        # chunk-sized bursts — the latency granularity the fused path
+        # trades for per-token dispatch overhead. 0 = off (every step is a
+        # packed SplitFuse step, the pre-chunk behavior).
+        self.fused_decode_chunk = int(fused_decode_chunk)
         self.metrics = metrics or ServingMetrics(clock=clock)
+        self.metrics.stamp_impls(getattr(engine, "attn_impl", None),
+                                 getattr(engine, "decode_attn_impl", None))
         self.monitor = monitor              # Monitor.write_events provider
         self.metrics_interval_steps = int(metrics_interval_steps)
         self.scheduler = ContinuousBatchScheduler(engine, policy,
@@ -176,7 +188,8 @@ class LLMServer:
                    metrics_interval_steps=sv.metrics_interval_steps,
                    replica_id=rid, heartbeat=heartbeat,
                    heartbeat_interval_s=sv.heartbeat_interval_s,
-                   default_deadline_s=sv.default_deadline_s)
+                   default_deadline_s=sv.default_deadline_s,
+                   fused_decode_chunk=getattr(sv, "fused_decode_chunk", 0))
 
     # ------------------------------------------------------------------
     # client side
@@ -355,15 +368,24 @@ class LLMServer:
                                 else "serve/mixed")
                     else:
                         name = "serve/step"
+                    fused = self._fusable_decode()
                     t0 = self.clock()
                     with span(name):
-                        out = self.engine.step()
+                        if fused:
+                            multi = self.engine.decode_batch(
+                                self.fused_decode_chunk)
+                        else:
+                            out = self.engine.step()
                     self._last_step_time = self.clock() - t0
                     self._steps += 1
                     with span("serve/deliver"):
-                        self._deliver(out)
-                    progressed = (self.engine.last_num_scheduled > 0
-                                  or bool(out))
+                        if fused:
+                            self._deliver_multi(multi)
+                        else:
+                            self._deliver(out)
+                    progressed = (bool(multi) if fused
+                                  else (self.engine.last_num_scheduled > 0
+                                        or bool(out)))
                 self._sample_gauges()
                 self._maybe_emit()
                 self._maybe_control_tick()
@@ -418,6 +440,48 @@ class LLMServer:
             resp._on_finish(FINISH_CANCELLED, now)
             self.metrics.on_finish(resp)
 
+    def _fusable_decode(self) -> bool:
+        """True when this step can run the fused multi-token decode
+        (``engine.decode_batch`` — the pallas paged-decode fast path)
+        instead of a packed single-token step: opt-in
+        (``fused_decode_chunk > 1``), every live sequence in steady decode
+        with a first sampled token, the batch fits one dispatch, and
+        nothing is waiting to prefill. The bare ``pending`` gate is a
+        deliberate admission-latency bias: a queued request isn't
+        admissible RIGHT NOW (admit just ran), but a completion mid-chunk
+        could free its capacity, and fusing would delay that admission by
+        up to chunk steps — so a saturated queue keeps packed per-token
+        steps (SplitFuse admission wins) and fusing serves the
+        steady-decode / dispatch-latency-dominated regime it targets."""
+        if self.fused_decode_chunk <= 1 or self.scheduler.pending:
+            return False
+        if not hasattr(self.engine, "decode_batch"):
+            return False
+        seqs = [s for s in self.engine.state_manager.all() if not s.done]
+        if not (bool(seqs)
+                and len(seqs) <= self.engine.config.max_ragged_sequence_count
+                and all((not s.in_prefill) and s.generated for s in seqs)):
+            return False
+        # only fuse FULL chunks: decode_batch clamps its scan length to the
+        # smallest remaining budget, and a drifting length would recompile
+        # the whole scanned decode program per distinct value — tail tokens
+        # (< chunk remaining) run as packed steps instead
+        return min(s.max_new_tokens - len(s.generated)
+                   for s in seqs) >= self.fused_decode_chunk
+
+    def _finish_if_done(self, uid: int, resp, now: float) -> None:
+        seq = self.engine.state_manager.get(uid)
+        if seq is not None and seq.done:
+            reason = (FINISH_EOS
+                      if (resp.request.eos_token_id is not None
+                          and resp.tokens
+                          and resp.tokens[-1] == resp.request.eos_token_id)
+                      else FINISH_LENGTH)
+            self.engine.flush(uid)
+            self.scheduler.complete(uid)
+            resp._on_finish(reason, now)
+            self.metrics.on_finish(resp)
+
     def _deliver(self, out: Dict[int, int]) -> None:
         now = self.clock()
         for uid, tok in out.items():
@@ -425,17 +489,21 @@ class LLMServer:
             if resp is None:
                 continue                   # flushed by a cancel this loop
             resp._on_token(tok, now)
-            seq = self.engine.state_manager.get(uid)
-            if seq is not None and seq.done:
-                reason = (FINISH_EOS
-                          if (resp.request.eos_token_id is not None
-                              and resp.tokens
-                              and resp.tokens[-1] == resp.request.eos_token_id)
-                          else FINISH_LENGTH)
-                self.engine.flush(uid)
-                self.scheduler.complete(uid)
-                resp._on_finish(reason, now)
-                self.metrics.on_finish(resp)
+            self._finish_if_done(uid, resp, now)
+
+    def _deliver_multi(self, out) -> None:
+        """Fused-chunk delivery: ``decode_batch`` hands back a token BURST
+        per uid (already EOS/length-trimmed host-side); the tokens stream
+        into the response in order, sharing one wall-clock stamp — the
+        latency granularity the fused path trades for dispatch overhead."""
+        now = self.clock()
+        for uid, toks in (out or {}).items():
+            resp = self.scheduler.inflight.get(uid)
+            if resp is None:
+                continue                   # flushed by a cancel this loop
+            for tok in toks:
+                resp._on_token(tok, now)
+            self._finish_if_done(uid, resp, now)
 
     def _sample_gauges(self) -> None:
         m = self.metrics

@@ -93,6 +93,75 @@ def _tpu_fp(dcn=("dp_outer",), ep=1):
                            dcn_axes=tuple(dcn))
 
 
+def test_decode_attn_site_and_cost_regime():
+    """The serving decode_attn op: a first-class plan-IR site with a
+    decode-shape cost regime — pallas (resident-pool kernel) wins on the
+    TPU fingerprint, the einsum reference wins off-TPU (interpret-mode
+    pallas is never a win), and int8 storage widens the pallas margin (the
+    einsum path pays the dequant + a 4x-wider materialized copy)."""
+    site = make_site(op="decode_attn", shape=(16, 1024, 4, 128),
+                     dtype="float32", axes=(), consumer="decode")
+    assert site.signature() == "decode:decode_attn:16x1024x4x128:float32@"
+    tpu = CostModel(_tpu_fp())
+    assert tpu.estimate(site, "pallas") < tpu.estimate(site, "einsum")
+    q = make_site(op="decode_attn", shape=(16, 1024, 4, 128), dtype="int8",
+                  axes=(), consumer="decode")
+    assert (tpu.estimate(q, "einsum") / tpu.estimate(q, "pallas")
+            > tpu.estimate(site, "einsum") / tpu.estimate(site, "pallas"))
+    assert tpu.decide(site).impl == "pallas"
+    cpu = CostModel(MeshFingerprint.capture())
+    assert cpu.estimate(site, "pallas") == float("inf")
+    assert cpu.decide(site).impl == "einsum"
+
+
+def test_decode_attn_static_resolution_ignores_compression_knob():
+    """Static mode resolves decode_attn on the cost model, records it in
+    the plan table, and the compressed_collectives knob (which maps every
+    OTHER site to an impl) must not hijack it onto an off-menu impl."""
+    configure_planner("static", use_cache=False,
+                      knobs={"compression": {"mode": "int8", "block": 2048,
+                                             "hierarchical": False,
+                                             "sites": {}}})
+    site = make_site(op="decode_attn", shape=(8, 512, 2, 64),
+                     dtype="float32", axes=(), consumer="decode")
+    d = get_planner().resolve(site)
+    assert d.impl == "einsum"            # CPU fingerprint: kernel loses
+    assert d.source == "cost-model"
+    assert site.signature() in dist.get_comms_logger().plan_records
+
+
+def test_decode_attn_microbench_probe_runs():
+    """measure-mode ground truth: the decode_attn probe builds and times
+    the einsum reference (single-device, no mesh axis) — and the pallas
+    probe runs the real kernel in interpret mode."""
+    from deepspeed_tpu.comm.planner.microbench import benchmark_site
+
+    site = make_site(op="decode_attn", shape=(2, 64, 2, 16), dtype="float32",
+                     axes=(), consumer="decode")
+    t = benchmark_site(site, "einsum", reps=2, repeats=1, max_elems=1 << 10)
+    assert np.isfinite(t) and t > 0
+    t_p = benchmark_site(site, "pallas", reps=2, repeats=1, max_elems=1 << 10)
+    assert np.isfinite(t_p) and t_p > 0
+
+
+def test_decode_tp_gather_matmul_resolution():
+    """The decode-TP projections resolve through the planner under the
+    'decode' consumer (op=gather_matmul) — a big row gather picks the
+    overlapped fused_matmul on the cost model and lands in the plan table,
+    so the static auditor reconciles decode collectives against the plan."""
+    from deepspeed_tpu.inference.v2.model import resolve_decode_tp_impl
+
+    set_topology(Topology(TopologySpec(tp=4)))
+    reset_planner()
+    assert resolve_decode_tp_impl("tp", (64, 4096), "float32") == "xla"
+    configure_planner("static", use_cache=False)
+    impl = resolve_decode_tp_impl("tp", (1 << 16, 128), "float32")
+    assert impl == "fused_matmul"
+    recs = dist.get_comms_logger().plan_records
+    sig = [s for s in recs if s.startswith("decode:gather_matmul")]
+    assert sig and recs[sig[0]]["impl"] == "fused_matmul"
+
+
 def test_cost_model_prefers_int8_on_dcn_and_exact_for_tiny():
     cm = CostModel(_tpu_fp())
     big = make_site(op="all_reduce", shape=(128 * 2**20,), dtype="float32",

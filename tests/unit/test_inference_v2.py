@@ -451,12 +451,112 @@ def test_int8_kv_cache_parity():
     assert agree >= 2, f"int8 KV diverged from bf16: {qbf} vs {bf}"
 
 
-def test_int8_kv_rejects_pallas_backend():
+def test_int8_kv_pallas_backend_fused_decode():
+    """attn_backend='pallas' + int8 KV no longer raises: the prompt chunks
+    fall back (warn-once) to the einsum gather — the legacy prefill kernel
+    takes fp pools — while the fused decode keeps the pallas kernel with
+    the (values, scales) pools fed directly (dequant fused in-kernel), and
+    the generated tokens track the all-einsum int8 engine."""
     model, params = _tiny_model("rope")
-    with pytest.raises(ValueError, match="compute"):
-        InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
-            num_kv_blocks=16, kv_block_size=8, dtype="float32",
-            kv_cache_dtype="int8", attn_backend="pallas"))
+    prompts = [np.array([5, 6, 7, 8, 9], np.int32),
+               np.array([40, 41, 42], np.int32)]
+
+    def run(backend):
+        eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            token_budget=16, max_ragged_sequence_count=4, max_chunk_size=8,
+            num_kv_blocks=32, kv_block_size=8, max_blocks_per_seq=8,
+            dtype="float32", kv_cache_dtype="int8", attn_backend=backend))
+        return eng, eng.generate(prompts, max_new_tokens=6)
+
+    eng_p, out_p = run("pallas")
+    assert eng_p.attn_impl == "einsum"          # prefill kernel: fp pools
+    assert eng_p.decode_attn_impl == "pallas"   # fused-dequant decode kernel
+    eng_e, out_e = run("einsum")
+    assert eng_e.decode_attn_impl == "einsum"
+    agree = sum(int(np.array_equal(a, b)) for a, b in zip(out_p, out_e))
+    assert agree >= 1, f"int8 fused decode diverged: {out_p} vs {out_e}"
+
+
+def test_decode_attn_resolution_order():
+    """model field > engine/serving config > heuristic, with a warned
+    structural fallback instead of the old silent einsum pin."""
+    from dataclasses import replace
+
+    from deepspeed_tpu.models.transformer import TransformerLM
+
+    model, params = _tiny_model("rope")
+
+    def build(model_, **kw):
+        return InferenceEngineV2(model_, params, RaggedInferenceEngineConfig(
+            token_budget=8, num_kv_blocks=16, kv_block_size=8,
+            max_blocks_per_seq=4, dtype="float32", **kw))
+
+    # heuristic on CPU: einsum
+    eng = build(model)
+    assert (eng.decode_attn_impl, eng.decode_attn_source) == ("einsum",
+                                                              "heuristic")
+    # engine config decode_attn_backend wins over the shared attn_backend
+    eng = build(model, attn_backend="einsum", decode_attn_backend="pallas")
+    assert (eng.decode_attn_impl, eng.decode_attn_source) == ("pallas",
+                                                              "config")
+    # the model field wins over everything
+    pinned = TransformerLM(replace(model.cfg, decode_attn_impl="einsum"))
+    eng = build(pinned, decode_attn_backend="pallas")
+    assert (eng.decode_attn_impl, eng.decode_attn_source) == ("einsum",
+                                                              "model")
+    # structural fallback: an alibi family demotes a pallas pick, loudly
+    alibi_model, alibi_params = _tiny_model("alibi")
+    eng = InferenceEngineV2(alibi_model, alibi_params,
+                            RaggedInferenceEngineConfig(
+                                token_budget=8, num_kv_blocks=16,
+                                kv_block_size=8, max_blocks_per_seq=4,
+                                dtype="float32",
+                                decode_attn_backend="pallas"))
+    assert (eng.decode_attn_impl, eng.decode_attn_source) == ("einsum",
+                                                              "fallback")
+    # invalid knob names are rejected, not silently einsum-pinned — at
+    # every precedence level, including the model field
+    with pytest.raises(ValueError, match="auto|pallas|einsum"):
+        build(model, decode_attn_backend="cuda")
+    with pytest.raises(ValueError, match="auto|pallas|einsum"):
+        build(TransformerLM(replace(model.cfg, decode_attn_impl="palas")))
+
+
+def test_decode_attn_plan_table_row():
+    """Every engine records its resolved decode_attn decision in the plan
+    table (CommsLogger.record_plan), whatever the resolution source — the
+    sv/pd ladder rows and the static auditor read it from there."""
+    from deepspeed_tpu.comm import get_comms_logger
+
+    model, params = _tiny_model("rope")
+    eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        token_budget=8, num_kv_blocks=16, kv_block_size=8,
+        max_blocks_per_seq=4, dtype="float32", kv_cache_dtype="int8"))
+    sig = eng._decode_attn_site(jnp.dtype(jnp.int8)).signature()
+    rec = get_comms_logger().plan_records.get(sig)
+    assert rec is not None
+    assert rec["op"] == "decode_attn" and rec["consumer"] == "decode"
+    assert rec["impl"] == eng.decode_attn_impl
+    assert rec["source"] == eng.decode_attn_source
+
+
+def test_einsum_backend_bitwise_default_contract():
+    """attn_backend='einsum' is the default-off contract on CPU: explicit
+    einsum and auto resolution produce bitwise-identical generations."""
+    model, params = _tiny_model("rope")
+    prompts = [np.array([5, 6, 7, 8, 9], np.int32),
+               np.array([40, 41, 42], np.int32)]
+
+    def run(**kw):
+        eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            token_budget=16, max_ragged_sequence_count=4, max_chunk_size=8,
+            num_kv_blocks=32, kv_block_size=8, max_blocks_per_seq=8,
+            dtype="float32", **kw))
+        return eng.generate(prompts, max_new_tokens=6)
+
+    for a, b in zip(run(), run(attn_backend="einsum",
+                               decode_attn_backend="einsum")):
+        np.testing.assert_array_equal(a, b)
 
 
 def test_flush_step_interleaving_block_consistency():
@@ -667,3 +767,57 @@ def test_reference_surface_properties():
                          DeepSpeedInferenceConfig(dtype="float32",
                                                   max_out_tokens=32))
     assert v1.module is v1.model
+
+
+# ---------------------------------------------------------------------------
+# TP-sharded decode projections (model.py tp_decode_*): the decode-TP
+# collective-matmul wiring — sequence rows sharded over tp, weights
+# column-sharded, the row gather hidden behind the projection matmul
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 devices")
+@pytest.mark.parametrize("impl", ["xla", "fused_matmul"])
+def test_tp_decode_projections_match_dense(impl):
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deepspeed_tpu.inference.v2.model import (tp_decode_logits,
+                                                  tp_decode_matmul,
+                                                  tp_decode_out_proj,
+                                                  tp_greedy_token)
+    from deepspeed_tpu.utils.shard_map_compat import shard_map_nocheck
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))
+    rng = np.random.default_rng(11)
+    S, H, NL, V = 8, 32, 16, 64   # 4*NL total out cols, V/4 vocab shards
+    x = jnp.asarray(rng.normal(size=(S, H)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(H, 4 * NL)), jnp.float32)
+    wo = jnp.asarray(rng.normal(size=(4 * NL, H)), jnp.float32)
+    wv = jnp.asarray(rng.normal(size=(H, V)), jnp.float32)
+    attn = jnp.asarray(rng.normal(size=(S, 4 * NL)), jnp.float32)
+
+    # column-parallel projection: [S/p, H] rows x [H, n/p] shard -> [S, n/p]
+    fn = jax.jit(shard_map_nocheck(
+        lambda xl, wl: tp_decode_matmul(xl, wl, "tp", impl=impl),
+        mesh, in_specs=(P("tp", None), P(None, "tp")),
+        out_specs=P(None, "tp")))
+    np.testing.assert_allclose(np.asarray(fn(x, w)), np.asarray(x @ w),
+                               rtol=1e-5, atol=1e-5)
+
+    # row-parallel output projection: psum + row scatter back to [S/p, H]
+    fn_o = jax.jit(shard_map_nocheck(
+        lambda al, wol: tp_decode_out_proj(al, wol, "tp", impl=impl),
+        mesh, in_specs=(P(None, "tp"), P("tp", None)),
+        out_specs=P("tp", None)))
+    np.testing.assert_allclose(np.asarray(fn_o(attn, wo)),
+                               np.asarray(attn @ wo), rtol=1e-4, atol=1e-4)
+
+    # vocab-parallel LM head + global greedy sample without [S, V] gathers:
+    # tokens must match the dense argmax exactly (tie-break included)
+    fn_l = jax.jit(shard_map_nocheck(
+        lambda hl, wvl: tp_greedy_token(
+            tp_decode_logits(hl, wvl, "tp", impl=impl), "tp"),
+        mesh, in_specs=(P("tp", None), P(None, "tp")), out_specs=P()))
+    np.testing.assert_array_equal(
+        np.asarray(fn_l(x, wv)),
+        np.asarray(jnp.argmax(x @ wv, axis=-1).astype(jnp.int32)))

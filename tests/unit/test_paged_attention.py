@@ -11,8 +11,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from deepspeed_tpu.inference.v2.model import _kv_layer
 from deepspeed_tpu.inference.v2.model import paged_attention as einsum_paged
-from deepspeed_tpu.ops.pallas.paged_attention import paged_attention as pallas_paged
+from deepspeed_tpu.ops.pallas.paged_attention import (paged_flash_decode,
+                                                      paged_attention as pallas_paged)
+from deepspeed_tpu.ops.pallas.quant import quantize_rows
 
 
 def _make_case(rng, S, Q, Hq, Hk, D, N, bs, B, kv_lens, chunk_lens):
@@ -163,6 +166,181 @@ def test_stats_parity_and_merge(rng):
         want = np.einsum("hgk,khd->hgd", p, v_all).reshape(Hq, D)
         np.testing.assert_allclose(np.asarray(merged)[s], want, rtol=2e-5,
                                    atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# paged_flash_decode: the decode-specialized resident-pool kernel
+# ---------------------------------------------------------------------------
+
+
+def _decode_case(rng, L, S, Hq, Hk, D, N, bs, B, kv_lens, kv_dtype=None):
+    """Multi-layer pools + a ragged block table; queries sit past the pool.
+    kv_dtype='int8' returns (values, scales) tuple pools (quantize_rows)."""
+    kp = rng.standard_normal((L, N, Hk, bs, D)).astype(np.float32)
+    vp = rng.standard_normal((L, N, Hk, bs, D)).astype(np.float32)
+    q = rng.standard_normal((S, Hq, D)).astype(np.float32)
+    bt = np.zeros((S, B), np.int32)
+    nxt = 1
+    for s in range(S):
+        for b in range(-(-max(int(kv_lens[s]), 1) // bs)):
+            bt[s, b] = nxt
+            nxt += 1
+    assert nxt <= N
+    kvl = np.asarray(kv_lens, np.int32)
+    pos = kvl + 3  # decode queries sit past the committed pool
+    kp, vp = jnp.asarray(kp), jnp.asarray(vp)
+    if kv_dtype == "int8":
+        kp, vp = quantize_rows(kp), quantize_rows(vp)
+    return (jnp.asarray(q), kp, vp, jnp.asarray(bt), jnp.asarray(pos),
+            jnp.asarray(kvl))
+
+
+def _decode_ref(q, k_pool, v_pool, bt, pos, kvl, layer):
+    out = einsum_paged(q[:, None], _kv_layer(k_pool, layer),
+                       _kv_layer(v_pool, layer), bt, pos[:, None],
+                       jnp.ones((q.shape[0], 1), bool), kvl)
+    return out[:, 0]
+
+
+@pytest.mark.parametrize("Hq,Hk", [(4, 4), (8, 2), (6, 1)])
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_flash_decode_parity_gqa_pools(rng, Hq, Hk, kv_dtype):
+    """Decode kernel vs the einsum reference over GQA ratios × fp32/int8
+    pools × ragged lengths (incl. a partially-filled last page and an empty
+    slot), per layer of a resident 2-layer pool."""
+    L, S, D, N, bs, B = 2, 4, 32, 24, 8, 4
+    case = _decode_case(rng, L, S, Hq, Hk, D, N, bs, B,
+                        kv_lens=[1, 7, 29, 0], kv_dtype=kv_dtype)
+    q, kp, vp, bt, pos, kvl = case
+    for layer in range(L):
+        out = paged_flash_decode(q, kp, vp, bt, pos, kvl, layer=layer,
+                                 interpret=True)
+        ref = _decode_ref(q, kp, vp, bt, pos, kvl, layer)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    # empty slot stays exactly zero
+    assert not np.asarray(out)[3].any()
+
+
+def test_flash_decode_parity_bf16_int8(rng):
+    """bf16 queries over an int8 pool (the serving config on TPU): the fused
+    in-kernel dequant matches the dequant-on-gather reference within bf16
+    tolerance."""
+    L, S, Hq, Hk, D, N, bs, B = 1, 2, 4, 2, 64, 16, 8, 4
+    q, kp, vp, bt, pos, kvl = _decode_case(rng, L, S, Hq, Hk, D, N, bs, B,
+                                           kv_lens=[12, 27], kv_dtype="int8")
+    qb = q.astype(jnp.bfloat16)
+    out = paged_flash_decode(qb, kp, vp, bt, pos, kvl, interpret=True)
+    ref = _decode_ref(qb, kp, vp, bt, pos, kvl, 0)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_flash_decode_sm_scale(rng):
+    """Explicit sm_scale (attn_scale families, e.g. gpt-neo's unscaled 1.0)
+    matches the einsum reference's `scale` knob."""
+    L, S, Hq, Hk, D, N, bs, B = 1, 2, 4, 2, 16, 16, 8, 4
+    q, kp, vp, bt, pos, kvl = _decode_case(rng, L, S, Hq, Hk, D, N, bs, B,
+                                           kv_lens=[9, 21])
+    out = paged_flash_decode(q, kp, vp, bt, pos, kvl, sm_scale=1.0,
+                             interpret=True)
+    ref = einsum_paged(q[:, None], kp[0], vp[0], bt, pos[:, None],
+                       jnp.ones((S, 1), bool), kvl, scale=1.0)[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_decode_stats_match_einsum(rng):
+    """return_stats (m, l) parity — the merge contract the fused decode
+    loop's in-window combine depends on."""
+    L, S, Hq, Hk, D, N, bs, B = 1, 3, 4, 2, 16, 16, 8, 4
+    q, kp, vp, bt, pos, kvl = _decode_case(rng, L, S, Hq, Hk, D, N, bs, B,
+                                           kv_lens=[13, 5, 0])
+    o_p, m_p, l_p = paged_flash_decode(q, kp, vp, bt, pos, kvl,
+                                       return_stats=True, interpret=True)
+    o_e, m_e, l_e = einsum_paged(q[:, None], kp[0], vp[0], bt, pos[:, None],
+                                 jnp.ones((S, 1), bool), kvl,
+                                 return_stats=True)
+    np.testing.assert_allclose(np.asarray(o_p), np.asarray(o_e)[:, 0],
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(m_p), np.asarray(m_e)[:, 0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(l_p), np.asarray(l_e)[:, 0],
+                               rtol=2e-5)
+
+
+def test_flash_decode_unwritten_slots_masked_and_scale_one_exact(rng):
+    """Two invariants of the int8 pool tail: (a) garbage in slots past
+    kv_len (payload AND scales) never leaks into the output — the causal/
+    length mask owns them; (b) the scale-1.0 init on never-written slots
+    dequantizes the zero payload to EXACT zero (no rounding residue)."""
+    from deepspeed_tpu.ops.pallas.quant import dequantize_rows
+
+    L, S, Hq, Hk, D, N, bs, B = 1, 2, 4, 2, 16, 16, 8, 4
+    q, kp, vp, bt, pos, kvl = _decode_case(rng, L, S, Hq, Hk, D, N, bs, B,
+                                           kv_lens=[11, 3], kv_dtype="int8")
+    out = paged_flash_decode(q, kp, vp, bt, pos, kvl, interpret=True)
+    # poison every slot past kv_len on the live pages with garbage
+    kq, ks = kp
+    vq, vs = vp
+    slot = np.arange(bs)
+    for s in range(S):
+        for b in range(B):
+            page = int(np.asarray(bt)[s, b])
+            if page == 0:
+                continue
+            dead = slot + b * bs >= int(np.asarray(kvl)[s])
+            kq = kq.at[0, page, :, dead].set(127)
+            ks = ks.at[0, page, :, dead].set(1e9)
+            vq = vq.at[0, page, :, dead].set(-127)
+            vs = vs.at[0, page, :, dead].set(1e9)
+    poisoned = paged_flash_decode(q, (kq, ks), (vq, vs), bt, pos, kvl,
+                                  interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(poisoned))
+    # scale-1.0 unwritten-slot exactness
+    z = dequantize_rows(jnp.zeros((4, 8), jnp.int8), jnp.ones((4,)))
+    assert (np.asarray(z) == 0.0).all()
+
+
+def test_pallas_decode_never_gathers_pages(monkeypatch):
+    """The acceptance contract: the pallas decode step has ZERO per-step
+    pool materialization. _gather_pages is monkeypatch-tripped; the pallas
+    fused decode must trace clean while the einsum path (fresh shapes, so
+    it re-traces) trips the mine — proving the trip is armed."""
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2 import model as v2_model
+    from deepspeed_tpu.models.transformer import (TransformerLM, init_params,
+                                                  llama_config)
+
+    cfg = llama_config("tiny", num_layers=2, hidden_size=32,
+                       intermediate_size=64, num_heads=4, num_kv_heads=2,
+                       vocab_size=61, max_seq_len=128, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    params = init_params(model, batch=1, seq=16)
+
+    def tripped(*a, **k):
+        raise AssertionError("_gather_pages on the pallas decode path")
+
+    def build(backend):
+        # distinctive shapes so decode_loop traces fresh under the mine
+        eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            token_budget=33, max_ragged_sequence_count=3, max_chunk_size=11,
+            num_kv_blocks=37, kv_block_size=8, max_blocks_per_seq=7,
+            dtype="float32", attn_backend="einsum",
+            decode_attn_backend=backend, decode_chunk=5))
+        eng.put([0], [np.array([7, 8, 9, 10], np.int32)], max_new_tokens=17)
+        while any(s.in_prefill for s in eng.state_manager.all()):
+            eng.step()
+        return eng
+
+    eng = build("pallas")
+    monkeypatch.setattr(v2_model, "_gather_pages", tripped)
+    out = eng.decode_batch(5)     # traces decode_loop with the mine armed
+    assert out and len(out[0]) == 5
+    with pytest.raises(Exception, match="_gather_pages"):
+        build("einsum").decode_batch(5)
 
 
 def test_decode_loop_pallas_matches_einsum():

@@ -698,3 +698,30 @@ def test_serving_metrics_sla_and_events():
     assert events["Serving/sla_violations"] == 1
     assert events["Serving/kv_occupancy"] == pytest.approx(0.75)
     assert events["Serving/tokens_per_sec"] == pytest.approx(3 / 2.0)
+
+
+def test_fused_decode_chunk_parity_and_impl_stamp(tiny_model):
+    """fused_decode_chunk: steady-decode steps run engine.decode_batch (the
+    paged-decode fast path) in chunk bursts — generated tokens, finish
+    reasons, and KV accounting must match the per-token reference exactly,
+    and ServingMetrics stamps which attention impls served the replica."""
+    engine = _engine(tiny_model)
+    free0 = engine.kv.free_blocks
+    prompts = [np.array([5, 6, 7, 8, 9], np.int32),
+               np.array([40, 41, 42], np.int32)]
+    server = LLMServer(engine, fused_decode_chunk=4).start()
+    resps = [server.submit(Request(p, max_new_tokens=9)) for p in prompts]
+    assert server.drain(timeout=300)
+    ref = _engine(tiny_model).generate(prompts, max_new_tokens=9)
+    for resp, want in zip(resps, ref):
+        assert resp.done and resp.finish_reason == FINISH_LENGTH
+        np.testing.assert_array_equal(resp.result(), want)
+    assert engine.kv.free_blocks == free0
+    assert engine._outstanding_blocks() == 0
+    snap = server.metrics.snapshot()
+    assert snap["decode_attn_impl"] == engine.decode_attn_impl
+    assert snap["attn_impl"] == engine.attn_impl
+    # the config block carries the knob through from_config
+    from deepspeed_tpu.runtime.config import ServingConfig
+    sv = ServingConfig.from_dict({"enabled": True, "fused_decode_chunk": 8})
+    assert sv.fused_decode_chunk == 8
