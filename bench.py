@@ -1380,6 +1380,157 @@ def dcn_hierarchical_bench():
             "device": jax.devices()[0].platform}
 
 
+def fused_phase_bench():
+    """Rung t3 (fused compute-collective phase programs, comm/planner +
+    ops/collective_matmul.py): fused vs sequenced dp-grad program on the
+    simulated 2-axis DCN mesh (dp_outer=4 forced DCN, ep=2 slice-local —
+    the ds rung's substrate). The fused arm is what comm_planner static now
+    synthesizes organically: ``rs~fused_matmul(ep) > ar.int8_ef(dp_outer) >
+    ag~fused_matmul(ep)`` — the ICI phases' ppermute hops ride between the
+    producing/consuming matmul tiles instead of running as exposed
+    transport. The sequenced arm replays the PR 8 program (same phase
+    algebra, via=xla) through a hand-written plan-cache entry, so both
+    arms move the SAME wire bytes and differ only in exposure. Metric: the
+    fused program's exposed-collective fraction from the ledger hop
+    exposure buckets (exposed wire bytes / total wire bytes per step) —
+    the sequenced arm's fraction is 1.0 by construction, and the
+    acceptance bar is strictly lower at equal wire bytes. A direct
+    executor probe also proves fused-exact is BITWISE-identical to
+    sequenced-exact (the ep=2 ring reduction is order-free)."""
+    import dataclasses as _dc
+    import shutil
+    import tempfile
+
+    import deepspeed_tpu as ds
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.comm.compressed import run_collective_program
+    from deepspeed_tpu.comm.planner import (Plan, PlanCache, PlanDecision,
+                                            get_planner, program_summary,
+                                            reset_planner)
+    from deepspeed_tpu.parallel import Topology, TopologySpec
+    from deepspeed_tpu.utils.shard_map_compat import shard_map_nocheck
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    if len(jax.devices()) < 8:
+        return {"metric": "fused_exposed_fraction", "value": None,
+                "unit": "ratio", "vs_baseline": None,
+                "error": "needs an 8-device mesh"}
+
+    rng = np.random.default_rng(0)
+    params = {"w1": jnp.asarray(rng.normal(size=(512, 1024)) * 0.05,
+                                jnp.float32),
+              "w2": jnp.asarray(rng.normal(size=(1024, 64)) * 0.05,
+                                jnp.float32)}  # ~0.59M params, ~2.4MB grads
+
+    def loss_fn(p, batch, rng=None):
+        x, y = batch
+        pred = jnp.tanh(x @ p["w1"]) @ p["w2"]
+        return jnp.mean((pred - y) ** 2)
+
+    def batch(i, n=8 * 8):
+        r = np.random.default_rng(1000 + i)
+        x = jnp.asarray(r.normal(size=(n, 512)), jnp.float32)
+        return (x, jnp.asarray(x[:, :64] * 0.5, jnp.float32))
+
+    base = {"train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0}, "steps_per_print": 10**9,
+            "comms_logger": {"enabled": True, "prof_all": True}}
+    logger = dist.get_comms_logger()
+    steps = 4
+
+    def run(planner_cfg):
+        cfg = dict(base)
+        cfg["comm_planner"] = planner_cfg
+        logger.reset()
+        reset_planner()
+        eng, *_ = ds.initialize(model=loss_fn,
+                                model_parameters=jax.tree.map(jnp.copy,
+                                                              params),
+                                config=cfg,
+                                topology=Topology(TopologySpec(ep=2)))
+        losses = [float(eng.train_batch(batch(i))) for i in range(steps)]
+        totals, expo = logger.totals(), logger.hop_exposure()
+        logger.reset()
+        return eng, totals, expo, losses
+
+    def exposure_fraction(expo):
+        wire = sum(v["wire"] for v in expo.values())
+        exposed = sum(v["exposed"] for v in expo.values())
+        return (exposed / wire if wire else None), wire
+
+    # fused arm: what static synthesis picks on the DCN mesh today
+    eng, f_tot, f_expo, losses = run({"mode": "static", "use_cache": False,
+                                      "dcn_axes": ["dp_outer"]})
+    impl = eng._dp_grad_impl
+    if not impl or impl[0] != "program":
+        return {"metric": "fused_exposed_fraction", "value": None,
+                "unit": "ratio", "vs_baseline": None,
+                "error": f"planner resolved {impl!r}, not a program"}
+    fused_prog = impl[2]
+    fused_n = sum(1 for s in fused_prog if s.via == "fused_matmul")
+    fp = get_planner().fingerprint
+    sig = next(s for s, r in logger.plan_records.items()
+               if r.get("consumer") == "dp-grad")
+    f_frac, f_wire = exposure_fraction(f_expo)
+
+    # sequenced arm: the PR 8 program (same phases, via=xla) replayed
+    # through a plan-cache entry under the SAME mesh fingerprint
+    seq_prog = tuple(_dc.replace(s, via="xla", compute=None)
+                     if s.via == "fused_matmul" else s for s in fused_prog)
+    cache_dir = tempfile.mkdtemp(prefix="dstpu_t3_cache_")
+    try:
+        plan = Plan(fingerprint=fp.digest())
+        plan.decisions[sig] = PlanDecision(
+            impl="program", block=impl[1], source="measured", est_us=1.0,
+            program=seq_prog)
+        PlanCache(cache_dir).store(fp, plan)
+        eng2, s_tot, s_expo, s_losses = run({"mode": "static",
+                                             "cache_dir": cache_dir,
+                                             "dcn_axes": ["dp_outer"]})
+        assert eng2._dp_grad_impl[0] == "program"
+        assert all(s.via != "fused_matmul" for s in eng2._dp_grad_impl[2])
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    s_frac, s_wire = exposure_fraction(s_expo)
+
+    # bitwise proof: fused-exact vs sequenced-exact through the executor
+    exact_fused = tuple(_dc.replace(s, wire_dtype="exact", block=None)
+                        for s in fused_prog)
+    exact_seq = tuple(_dc.replace(s, wire_dtype="exact", block=None)
+                      for s in seq_prog)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                ("dp_outer", "ep"))
+    probe = jnp.linspace(-1.0, 1.0, 1 << 16, dtype=jnp.float32)
+
+    def run_prog(prog):
+        def f(v):
+            return run_collective_program(v, prog)[0]
+
+        return np.asarray(jax.jit(shard_map_nocheck(
+            f, mesh, in_specs=P(), out_specs=P()))(probe))
+
+    bitwise = bool(np.array_equal(run_prog(exact_fused), run_prog(exact_seq)))
+    logger.reset()
+
+    return {"metric": "fused_exposed_fraction",
+            "value": round(f_frac, 4) if f_frac is not None else None,
+            "unit": "exposed-wire-fraction",
+            "vs_baseline": None,
+            "fused_program": program_summary(fused_prog),
+            "fused_phases": fused_n,
+            "sequenced_exposed_fraction": (round(s_frac, 4)
+                                           if s_frac is not None else None),
+            "fused_wire_bytes": f_wire, "sequenced_wire_bytes": s_wire,
+            "equal_wire_bytes": f_wire == s_wire,
+            "fused_exact_bitwise_eq_sequenced_exact": bitwise,
+            "hop_exposure": {k: dict(v) for k, v in f_expo.items()},
+            "final_loss": round(losses[-1], 6),
+            "final_loss_sequenced": round(s_losses[-1], 6),
+            "devices": len(jax.devices()),
+            "device": jax.devices()[0].platform}
+
+
 def telemetry_bench():
     """Rung ob (telemetry spine, deepspeed_tpu/telemetry/): the spine's own
     cost, since it rides every step when enabled — span record overhead
@@ -1724,7 +1875,7 @@ RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "plan": planner_bench, "rz": resilience_bench,
          "wd": watchdog_bench, "fl": fused_hotpath_bench,
          "sv": serving_bench, "pd": paged_decode_bench,
-         "ds": dcn_hierarchical_bench,
+         "ds": dcn_hierarchical_bench, "t3": fused_phase_bench,
          "ob": telemetry_bench, "mem": memory_telemetry_bench,
          "sa": static_audit_bench, "at": control_bench}
 
@@ -1750,6 +1901,7 @@ GATE_SPECS = {
     "static_audit_train_ms": ("lower", 1.0),     # host walk: wall-clock noise
     "control_decide_ns": ("lower", 1.0),         # supervisor loop: host cost
     "dcn_hierarchical": ("higher", 0.05),        # ledger bytes: deterministic
+    "fused_exposed_fraction": ("lower", 0.05),   # ledger bytes: deterministic
     "llama_zero3_bf16_mfu": ("higher", 0.15),    # the TPU headline: tight
     "paged_decode_step_ms": ("lower", 1.0),      # decode hot path: wall-clock
 }
@@ -1884,7 +2036,11 @@ def run_ladder(gate: bool = False):
             ("pd", chip),
             # ds simulates the DCN split (dcn_axes override) — the virtual
             # CPU mesh IS the measurement substrate, even beside a real chip
-            ("ds", cpu8), ("ob", cpu1),
+            ("ds", cpu8),
+            # t3 gates the fused-phase programs on the same simulated DCN
+            # split: exposed-collective fraction from the ledger exposure
+            # buckets, fused vs the sequenced PR 8 program at equal wire
+            ("t3", cpu8), ("ob", cpu1),
             # mem measures the recorder/gauge costs; real HBM numbers ride
             # when the chip is healthy, the CPU path measures the host side
             ("mem", chip),
@@ -1963,7 +2119,7 @@ if __name__ == "__main__":
 
         flags_preset = ("--xla_force_host_platform_device_count"
                         in os.environ.get("XLA_FLAGS", ""))
-        needs_cpu8 = args.rung in ("4", "5", "ds", "at")
+        needs_cpu8 = args.rung in ("4", "5", "ds", "t3", "at")
         if args.rung in ("cm", "qx", "plan") and not flags_preset:
             # these run on the real mesh only when it's healthy AND >1 chip
             # (subprocess probes; this process must not init the backend yet)

@@ -163,6 +163,75 @@ def jaxpr_collectives(jaxpr, axis_sizes=None) -> List[ExpectedSite]:
     return sites
 
 
+def _phase_hlo_kinds(phase_op: str, via: str, quantized: bool
+                     ) -> Tuple[str, ...]:
+    """The HLO collective kinds ONE program phase actually lowers to.
+
+    A ring/fused phase is p-1 ``collective-permute`` hops (a fused phase's
+    hops additionally interleave with its bound matmul's tiles — same HLO
+    vocabulary, different schedule); a quantized XLA-via phase lowers
+    through the int8 transports of ``comm/compressed.py`` (all-to-all
+    shard exchange + all-gather return); an exact XLA-via phase is the
+    fused native collective."""
+    if via in ("ring", "bidir_ring", "fused_matmul"):
+        return ("collective_permute",)
+    if quantized:
+        if phase_op == "all_reduce":
+            return ("all_to_all", "all_gather")
+        if phase_op == "reduce_scatter":
+            return ("all_to_all",)
+        return ("all_gather",)
+    return {"all_reduce": ("all_reduce",),
+            "reduce_scatter": ("reduce_scatter",),
+            "all_gather": ("all_gather",)}[phase_op]
+
+
+def _expand_program_phases(sig: str, phases, axis_sizes
+                           ) -> List[ExpectedSite]:
+    """Hop-granular expected sites from a program decision's STRUCTURED
+    phase dicts (``plan_records[sig]["program_phases"]``, stamped by
+    ``planner._record``): a ring/fused phase over a span-``p`` axis set
+    lowers to ``p-1`` collective-permute hops PER AXIS of the chained
+    ring — the expansion expects exactly that HLO vocabulary (permute
+    kind, single-axis span, hop count recorded in the detail) instead of
+    the phase's nominal fused collective, so the interleaved ppermutes a
+    fused ``PhaseStep`` emits reconcile instead of being flagged as
+    unplanned gather-class collectives. Matching itself stays
+    existence-based on (kind, span) — ``reconcile_collectives`` does not
+    consume sites, so ONE expected site per (phase, axis) carries the
+    full matching power; the hop count is report detail, not multiplicity.
+    """
+    sites: List[ExpectedSite] = []
+    for ph in phases:
+        op = ph.get("phase_op")
+        if op is None:
+            continue
+        via = ph.get("via", "xla")
+        quant = ph.get("wire_dtype", "exact") != "exact"
+        ph_axes = tuple(str(a) for a in ph.get("axes", ()))
+        per_hop = via in ("ring", "bidir_ring", "fused_matmul")
+        tag = f"{sig}:{op}~{via}" if via != "xla" else f"{sig}:{op}"
+        comp = ph.get("compute") or {}
+        if comp.get("site") or comp.get("role"):
+            tag += f"@{comp.get('site') or comp.get('role')}"
+        for kind in _phase_hlo_kinds(op, via, quant):
+            if per_hop and kind == "collective_permute":
+                # one site PER AXIS of the chained ring (the executor runs
+                # one ring per axis): permute spans are the single axis's,
+                # not the phase's product span
+                for ax in ph_axes:
+                    span = _axes_span((ax,), axis_sizes)
+                    hops = (span - 1) if span else None
+                    sites.append(ExpectedSite(
+                        kind=kind, span=span, origin="plan",
+                        detail=f"{tag}({ax})#hops={hops or '?'}"))
+            else:
+                sites.append(ExpectedSite(
+                    kind=kind, span=_axes_span(ph_axes, axis_sizes),
+                    origin="plan", detail=tag))
+    return sites
+
+
 def plan_expected_sites(plan_records: Dict[str, Dict[str, Any]],
                         axis_sizes=None) -> List[ExpectedSite]:
     """Expected sites from the planner's plan table
@@ -175,9 +244,17 @@ def plan_expected_sites(plan_records: Dict[str, Dict[str, Any]],
         for kind in PLAN_OP_KINDS.get(op, ()):
             sites.append(ExpectedSite(kind=kind, span=span, origin="plan",
                                       detail=sig))
+        phases = rec.get("program_phases")
+        if phases:
+            # structured per-phase dicts (PR 14+): expand per hop — the
+            # authoritative path; fused/ring phases reconcile against
+            # their individual ppermutes
+            sites += _expand_program_phases(sig, phases, axis_sizes)
+            continue
         prog = rec.get("program")
         if prog:
-            # program summaries look like rs(ep)>ar.int8_ef(dp_outer)>ag(ep)
+            # legacy fallback: parse the one-line summary —
+            # rs(ep)>ar.int8_ef(dp_outer)>ag~fused_matmul(ep)
             for phase in str(prog).split(">"):
                 m = re.match(r"(rs|ar|ag)[^(]*\(([^)]*)\)", phase)
                 if not m:

@@ -194,6 +194,22 @@ def log_compressed(op: str, logical_bytes: int, wire_bytes: int,
                   impl=impl, link=link)
 
 
+def log_fused(op: str, logical_bytes: int, wire_bytes: int,
+              link: Optional[str] = None) -> None:
+    """Trace-time ledger entry for a compute-bound FUSED ring phase
+    (``ops/collective_matmul.py`` fused primitives): like
+    :func:`log_compressed`, but the wire bytes additionally land in the
+    HIDDEN hop bucket — their hops ride between the bound matmul's tile
+    steps, so ``CommsLogger.hop_exposure()`` counts them as overlapped
+    rather than exposed transport (the t3 bench's exposed-collective
+    fraction). No flight-ring record here: the fused primitives record one
+    launch PER HOP themselves (the doctor needs hop-granular seq
+    alignment, not one record per phase)."""
+    _COMMS_LOGGER.append(op, int(logical_bytes), traced=True,
+                         wire_bytes=int(wire_bytes), hop_class=link,
+                         hop_hidden=True)
+
+
 def all_reduce(x, axis: Axis, op: str = "sum"):
     """SUM/MAX/MIN/MEAN allreduce over a mesh axis (reference ``comm.py:497``)."""
     names = _axis_tuple(axis)

@@ -54,8 +54,8 @@ __all__ = [
     "configure_compression", "compression_mode", "compression_block",
     "compression_hierarchical", "allreduce_feedback_init",
     "run_collective_program", "program_feedback_layout",
-    "program_feedback_init", "feedback_state", "store_feedback",
-    "clear_feedback",
+    "program_feedback_init", "bind_fused_tiles", "feedback_state",
+    "store_feedback", "clear_feedback",
 ]
 
 # ---------------------------------------------------------------------------
@@ -331,6 +331,40 @@ def program_feedback_init(n: int, program, axis_sizes):
                               server_error=jnp.zeros(s, jnp.float32))
 
 
+def bind_fused_tiles(program, n: int, axis_sizes):
+    """Stamp each fused phase's ``FusedCompute.tile`` with the ACTUAL
+    per-hop chunk element count for a flat ``n``-element payload — the
+    planner synthesizes fused phases with ``tile=0`` (the site's flat size
+    is known but the phase algebra's intermediate widths are this
+    function's job), and the engine binds them at compile time so the
+    flight ring's per-hop ``detail`` and the doctor's divergence report
+    name real chunk sizes. Walks the same ``_phase_sizes`` arithmetic as
+    :func:`run_collective_program`; non-fused phases pass through
+    untouched, so a fused-free program binds to itself."""
+    import dataclasses
+
+    out = []
+    cur = int(n)
+    for st in program:
+        p = 1
+        for a in st.axes:
+            p *= int(axis_sizes.get(a, 1) if hasattr(axis_sizes, "get")
+                     else axis_sizes(a))
+        if p <= 1:
+            out.append(st)
+            continue
+        n_p, out_len = _phase_sizes(cur, st.phase_op, p)
+        if getattr(st, "fused", False) and st.compute is not None:
+            # the circulating chunk: the output shard for a reduce-scatter
+            # ring, the input shard for an all-gather ring
+            tile = out_len if st.phase_op == "reduce_scatter" else cur
+            st = dataclasses.replace(
+                st, compute=dataclasses.replace(st.compute, tile=int(tile)))
+        out.append(st)
+        cur = out_len
+    return tuple(out)
+
+
 def run_collective_program(x, program, *, feedback=None, key=None):
     """Execute a planner-synthesized multi-phase MEAN all-reduce program on
     a per-shard tensor (called inside ``shard_map``, the ``comm.comm``
@@ -341,9 +375,15 @@ def run_collective_program(x, program, *, feedback=None, key=None):
     the ICI (slice-local) axes, int8(+error-feedback) all-reduce over the
     DCN axis on the 1/p_inner-sized shard, all-gather back over ICI — but
     any composition whose phase algebra nets out to a full mean reduction
-    runs. Each phase logs its own comms-ledger entry tagged with the
+    runs. Phases with ``via="fused_matmul"`` dispatch through the
+    compute-bound chunk rings (``ops/collective_matmul.py``
+    ``fused_ring_reduce_scatter`` / ``fused_ring_all_gather``): their
+    ppermute hops ride between the bound matmul's tile steps instead of
+    running as exposed transport, with an optional int8 wire dtype per
+    hop. Each phase logs its own comms-ledger entry tagged with the
     phase's ``link`` class, so ``hop_totals()`` reports ICI- vs DCN-class
-    wire bytes separately.
+    wire bytes separately (fused phases additionally land in the hidden
+    bucket — ``hop_exposure()``).
 
     ``feedback`` (an ``ErrorFeedbackState`` shaped by
     :func:`program_feedback_init`) feeds the ``int8_ef`` phase; pass
@@ -362,10 +402,29 @@ def run_collective_program(x, program, *, feedback=None, key=None):
             continue
         n = int(cur.shape[0])
         sr = st.wire_dtype == "int8_sr"
+        fused = getattr(st, "via", "xla") == "fused_matmul"
+        ftag = (st.compute.tag() if fused and st.compute is not None
+                else "fused")
+        fblk = st.block or compression_block()
         if st.phase_op == "reduce_scatter":
             n_p, _ = _phase_sizes(n, "reduce_scatter", p)
             padded = jnp.pad(cur, (0, n_p - n))
-            if st.wire_dtype == "exact":
+            if fused:
+                # compute-bound chunk ring (per-axis chain, same bytes as
+                # the fused scatter): the producing matmul's tiles hide
+                # the hops; exact wire is bit-identical to the sequenced
+                # ring reduction, int8 narrows each hop's payload
+                from ..ops.collective_matmul import fused_ring_reduce_scatter
+
+                shard = padded
+                for a in names:
+                    if _axis_size((a,)) <= 1:
+                        continue
+                    shard = fused_ring_reduce_scatter(
+                        shard, a, wire_dtype=st.wire_dtype, block=fblk,
+                        stochastic=sr, key=key, link=st.link, tag=ftag)
+                cur = shard / p
+            elif st.wire_dtype == "exact":
                 cur = lax.psum_scatter(padded, names, scatter_dimension=0,
                                        tiled=True) / p
                 moved = 4 * n_p * (p - 1) // p
@@ -391,7 +450,19 @@ def run_collective_program(x, program, *, feedback=None, key=None):
                 else:
                     cur = out
         elif st.phase_op == "all_gather":
-            if st.via in ("ring", "bidir_ring"):
+            if fused:
+                # compute-bound gather ring: the consuming matmul's tiles
+                # hide the hops (data movement only — exact wire is
+                # bitwise; int8 decodes rank-invariantly on arrival)
+                from ..ops.collective_matmul import fused_ring_all_gather
+
+                for a in names:
+                    if _axis_size((a,)) <= 1:
+                        continue
+                    cur = fused_ring_all_gather(
+                        cur, a, wire_dtype=st.wire_dtype, block=fblk,
+                        link=st.link, tag=ftag)
+            elif st.via in ("ring", "bidir_ring"):
                 from ..ops.collective_matmul import ring_all_gather
                 from .comm import get_comms_logger
 

@@ -8,9 +8,10 @@ menu into an automatic, measured, cached per-site decision (GC3, arxiv
 """
 
 from .cache import PlanCache, default_cache_dir
-from .ir import (CONSUMERS, IMPLEMENTATIONS, LINK_CLASSES, OP_MENU, PHASE_OPS,
-                 WIRE_DTYPES, CollectiveSite, PhaseStep, Plan, PlanDecision,
-                 make_phase, make_site, program_summary)
+from .ir import (CONSUMERS, FUSED_PHASE_OPS, FUSED_ROLES, IMPLEMENTATIONS,
+                 LINK_CLASSES, OP_MENU, PHASE_OPS, PHASE_VIAS, PLAN_FORMAT,
+                 WIRE_DTYPES, CollectiveSite, FusedCompute, PhaseStep, Plan,
+                 PlanDecision, make_phase, make_site, program_summary)
 from .microbench import benchmark_site
 from .planner import (MODES, CollectivePlanner, configure_from_config,
                       configure_planner, get_planner, planner_active,
@@ -19,8 +20,9 @@ from .topo import CostModel, LinkParams, MeshFingerprint
 
 __all__ = [
     "CONSUMERS", "IMPLEMENTATIONS", "OP_MENU", "MODES",
-    "PHASE_OPS", "WIRE_DTYPES", "LINK_CLASSES",
-    "CollectiveSite", "Plan", "PlanDecision", "PhaseStep",
+    "PHASE_OPS", "PHASE_VIAS", "WIRE_DTYPES", "LINK_CLASSES",
+    "FUSED_PHASE_OPS", "FUSED_ROLES", "PLAN_FORMAT",
+    "CollectiveSite", "Plan", "PlanDecision", "PhaseStep", "FusedCompute",
     "make_site", "make_phase", "program_summary", "synthesize_programs",
     "MeshFingerprint", "CostModel", "LinkParams",
     "PlanCache", "default_cache_dir", "benchmark_site",

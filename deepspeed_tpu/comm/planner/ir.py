@@ -55,16 +55,31 @@ PHASE_OPS = ("reduce_scatter", "all_reduce", "all_gather")
 # int8_ef   — block-quantized + ErrorFeedbackState residual carry (the DCN
 #             gradient hop: quantization error re-injected next step)
 WIRE_DTYPES = ("exact", "int8", "int8_sr", "int8_ef")
-# how a phase lowers: the fused XLA collective or a ppermute chunk ring
-PHASE_VIAS = ("xla", "ring", "bidir_ring")
+# how a phase lowers: the fused XLA collective, a ppermute chunk ring, or a
+# ppermute chunk ring BOUND to the matmul that produces/consumes the payload
+# (T3-style: the hops ride between the compute site's tile steps and hide
+# behind them — such phases must carry a FusedCompute descriptor)
+PHASE_VIAS = ("xla", "ring", "bidir_ring", "fused_matmul")
+# phase ops a fused_matmul via can realize: the all-gather side (consumer
+# matmul eats the arriving chunks) and the reduce-scatter side (producer
+# matmul feeds the departing chunks); a one-shot all_reduce has no tile
+# stream to interleave with
+FUSED_PHASE_OPS = ("all_gather", "reduce_scatter")
+# which side of the matmul the fused phase binds to
+FUSED_ROLES = ("producer", "consumer")
 # link classes a phase's traffic is accounted under in the comms ledger
 LINK_CLASSES = ("ici", "dcn", "host")
 
-# op kind -> implementations that can realize it
+# op kind -> implementations that can realize it.
+# all_gather/reduce_scatter "fused_matmul": the compute-bound quantized
+# chunk ring (ops/collective_matmul.py fused_ring_all_gather /
+# fused_ring_reduce_scatter) — int8 payload per hop AND the hops hidden
+# behind the consuming/producing matmul tiles (the ZeRO-3 qwZ gather
+# fusing into its projection, the qgZ scatter into the backward matmuls)
 OP_MENU: Dict[str, Tuple[str, ...]] = {
     "all_reduce": ("xla", "int8", "int8_sr", "hierarchical"),
-    "all_gather": ("xla", "ring", "bidir_ring", "int8"),
-    "reduce_scatter": ("xla", "ring", "int8", "int8_sr"),
+    "all_gather": ("xla", "ring", "bidir_ring", "int8", "fused_matmul"),
+    "reduce_scatter": ("xla", "ring", "int8", "int8_sr", "fused_matmul"),
     "all_to_all": ("xla", "int8"),
     "gather_matmul": ("xla", "fused_matmul"),
     # the vocab-sharded embedding table gather (shape = the per-rank table
@@ -147,17 +162,66 @@ def make_site(*, op: str, shape: Sequence[int], dtype: Any,
 
 
 @dataclass(frozen=True)
+class FusedCompute:
+    """The compute-site binding of a ``via="fused_matmul"`` phase.
+
+    ``role`` says which side of the matmul the hops interleave with:
+    ``"consumer"`` — the matmul consumes the gathered operand (the
+    all-gather side: each arriving chunk's partial product runs while the
+    next chunk's permute is in flight); ``"producer"`` — the matmul
+    produces the payload the reduction consumes (the reduce-scatter side:
+    each departing partial sum's hop overlaps the next tile's matmul).
+    ``site`` is a free-form tag naming the bound matmul site (shows up in
+    flight-ring ``detail`` and the doctor's divergence report); ``tile``
+    the per-hop chunk element count (0 = unbound: the executor's per-rank
+    shard — the engine re-binds it to the real chunk size at compile).
+    """
+    role: str
+    site: str = ""
+    tile: int = 0
+
+    def __post_init__(self):
+        if self.role not in FUSED_ROLES:
+            raise ValueError(f"unknown fused-compute role {self.role!r}; "
+                             f"menu: {FUSED_ROLES}")
+
+    def tag(self) -> str:
+        """The flight-ring/doctor label: ``site@role`` (or just the role)."""
+        return f"{self.site}@{self.role}" if self.site else self.role
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"role": self.role}
+        if self.site:
+            d["site"] = self.site
+        if self.tile:
+            d["tile"] = int(self.tile)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FusedCompute":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            # strict: a compute descriptor from a newer build must fail the
+            # load (cache miss), never silently shed fields
+            raise ValueError(f"unknown FusedCompute fields {sorted(unknown)}")
+        return cls(**d)
+
+
+@dataclass(frozen=True)
 class PhaseStep:
     """One phase of a multi-phase collective program.
 
     ``phase_op`` is the collective primitive, ``axes`` the mesh axes THIS
     phase runs over (each phase gets its own axes — the whole point:
     different hops ride different links), ``wire_dtype`` what rides those
-    links, ``via`` whether the phase lowers to the fused XLA collective or
-    a ppermute chunk ring, and ``link`` the ledger hop class the phase's
-    wire bytes are accounted under (``ici``/``dcn``/``host``; synthesis
-    stamps it from the mesh fingerprint so the ledger can report DCN-class
-    bytes without re-deriving topology at trace time).
+    links, ``via`` whether the phase lowers to the fused XLA collective, a
+    ppermute chunk ring, or a compute-bound fused ring
+    (``"fused_matmul"`` — requires ``compute``), and ``link`` the ledger
+    hop class the phase's wire bytes are accounted under
+    (``ici``/``dcn``/``host``; synthesis stamps it from the mesh
+    fingerprint so the ledger can report DCN-class bytes without
+    re-deriving topology at trace time).
     """
     phase_op: str
     axes: Tuple[str, ...]
@@ -165,6 +229,7 @@ class PhaseStep:
     block: Optional[int] = None
     via: str = "xla"
     link: Optional[str] = None
+    compute: Optional[FusedCompute] = None
 
     def __post_init__(self):
         if self.phase_op not in PHASE_OPS:
@@ -181,10 +246,30 @@ class PhaseStep:
                              f"menu: {LINK_CLASSES}")
         if not self.axes:
             raise ValueError("a PhaseStep needs at least one mesh axis")
+        if self.via == "fused_matmul":
+            if self.phase_op not in FUSED_PHASE_OPS:
+                raise ValueError(
+                    f"via='fused_matmul' only fuses {FUSED_PHASE_OPS} "
+                    f"(a one-shot {self.phase_op} has no tile stream to "
+                    f"interleave with)")
+            if self.compute is None:
+                raise ValueError("via='fused_matmul' needs a FusedCompute "
+                                 "binding (which matmul hides the hops)")
+            if self.wire_dtype == "int8_ef":
+                raise ValueError(
+                    "int8_ef rides the all_reduce phase (the residual is a "
+                    "full-tensor carry); fused hops take exact|int8|int8_sr")
+        elif self.compute is not None:
+            raise ValueError(f"via={self.via!r} must not carry a "
+                             "FusedCompute binding")
 
     @property
     def quantized(self) -> bool:
         return self.wire_dtype != "exact"
+
+    @property
+    def fused(self) -> bool:
+        return self.via == "fused_matmul"
 
     def to_dict(self) -> Dict[str, Any]:
         d = {"phase_op": self.phase_op, "axes": list(self.axes)}
@@ -196,25 +281,38 @@ class PhaseStep:
             d["via"] = self.via
         if self.link is not None:
             d["link"] = self.link
+        if self.compute is not None:
+            d["compute"] = self.compute.to_dict()
         return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "PhaseStep":
         known = {f.name for f in dataclasses.fields(cls)}
-        kw = {k: v for k, v in d.items() if k in known}
+        unknown = set(d) - known
+        if unknown:
+            # strict: a phase from a newer plan format must fail the load
+            # (the cache treats ValueError as a miss and re-tunes) — the
+            # old behavior of silently dropping unknown fields could strip
+            # the part of a phase that changes its semantics
+            raise ValueError(f"unknown PhaseStep fields {sorted(unknown)}")
+        kw = dict(d)
         kw["axes"] = tuple(str(a) for a in kw.get("axes", ()))
+        comp = kw.get("compute")
+        if comp is not None and not isinstance(comp, FusedCompute):
+            kw["compute"] = FusedCompute.from_dict(comp)
         return cls(**kw)
 
 
 def make_phase(phase_op: str, axes: Sequence[str], *,
                wire_dtype: str = "exact", block: Optional[int] = None,
-               via: str = "xla", link: Optional[str] = None) -> PhaseStep:
+               via: str = "xla", link: Optional[str] = None,
+               compute: Optional[FusedCompute] = None) -> PhaseStep:
     """Normalizing :class:`PhaseStep` constructor (the ``make_site`` twin)."""
     return PhaseStep(phase_op=str(phase_op),
                      axes=tuple(str(a) for a in axes),
                      wire_dtype=str(wire_dtype),
                      block=None if block is None else int(block),
-                     via=str(via), link=link)
+                     via=str(via), link=link, compute=compute)
 
 
 def program_summary(program: Sequence[PhaseStep]) -> str:
@@ -281,13 +379,31 @@ class PlanDecision:
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "PlanDecision":
         known = {f.name for f in dataclasses.fields(cls)}
-        kw = {k: v for k, v in d.items() if k in known}
+        unknown = set(d) - known
+        if unknown:
+            # strict (the PhaseStep.from_dict contract): version skew must
+            # surface as a failed load, never a silently-narrowed decision
+            raise ValueError(f"unknown PlanDecision fields {sorted(unknown)}")
+        kw = dict(d)
         prog = kw.get("program")
         if prog is not None:
             kw["program"] = tuple(
                 s if isinstance(s, PhaseStep) else PhaseStep.from_dict(s)
                 for s in prog)
         return cls(**kw)
+
+
+# On-disk plan format. 1 = the PR 8 shape (no version stamp, phase vias
+# xla|ring|bidir_ring); 2 adds the fused_matmul via + FusedCompute compute
+# bindings and stamps ``format`` into the serialized plan. Loading:
+#   - no stamp (a stale PR 8 ``plan_<digest>.json``): version-skew-migrated —
+#     every decision re-parses under the STRICT from_dict vocabulary, so a
+#     file whose content doesn't actually match the v1 vocabulary fails the
+#     load (cache miss -> re-tune) instead of resolving into an executor
+#     that doesn't understand it;
+#   - stamp > PLAN_FORMAT (a plan written by a newer build): rejected
+#     outright — its decisions may carry semantics this executor can't run.
+PLAN_FORMAT = 2
 
 
 class Plan:
@@ -313,12 +429,19 @@ class Plan:
                 and self.decisions == other.decisions)
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"fingerprint": self.fingerprint,
+        return {"format": PLAN_FORMAT,
+                "fingerprint": self.fingerprint,
                 "sites": {sig: d.to_dict()
                           for sig, d in sorted(self.decisions.items())}}
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "Plan":
+        fmt = int(d.get("format", 1))  # unstamped = the PR 8 v1 shape
+        if fmt > PLAN_FORMAT:
+            raise ValueError(
+                f"plan format {fmt} is newer than this build's "
+                f"{PLAN_FORMAT}; refusing to load (its decisions may name "
+                f"implementations this executor doesn't understand)")
         return cls(fingerprint=d.get("fingerprint", ""),
                    decisions={sig: PlanDecision.from_dict(dd)
                               for sig, dd in d.get("sites", {}).items()})

@@ -166,6 +166,15 @@ def build_probe(site: CollectiveSite, impl: str, *, mesh=None,
                 for a in names:
                     full = ring_all_gather(full, a,
                                            bidirectional=impl == "bidir_ring")
+            elif impl == "fused_matmul":
+                # the compute-bound int8 chunk ring — the SAME primitive
+                # the zeropp wiring runs when this impl wins
+                from ...ops.collective_matmul import fused_ring_all_gather
+
+                full = v
+                for a in names:
+                    full = fused_ring_all_gather(full, a, wire_dtype="int8",
+                                                 block=blk, tag="probe")
             elif impl == "int8":
                 from ..compressed import quantized_all_gather
 
@@ -183,6 +192,14 @@ def build_probe(site: CollectiveSite, impl: str, *, mesh=None,
                 shard = v  # per-axis chain: same bytes as the fused scatter
                 for a in names:
                     shard = ring_reduce_scatter(shard, a)
+            elif impl == "fused_matmul":
+                from ...ops.collective_matmul import fused_ring_reduce_scatter
+
+                shard = v
+                for a in names:
+                    shard = fused_ring_reduce_scatter(shard, a,
+                                                      wire_dtype="int8",
+                                                      block=blk, tag="probe")
             elif impl in ("int8", "int8_sr"):
                 from ..compressed import quantized_reduce_scatter
 

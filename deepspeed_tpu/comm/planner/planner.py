@@ -23,8 +23,8 @@ import dataclasses
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .cache import PlanCache
-from .ir import (GRADIENT_CONSUMERS, CollectiveSite, PhaseStep, Plan,
-                 PlanDecision, make_phase, make_site, program_summary)
+from .ir import (GRADIENT_CONSUMERS, CollectiveSite, FusedCompute, PhaseStep,
+                 Plan, PlanDecision, make_phase, make_site, program_summary)
 from .microbench import benchmark_site
 from .topo import CostModel, MeshFingerprint
 
@@ -44,8 +44,16 @@ def synthesize_programs(site: CollectiveSite, cost: CostModel,
       over ICI shrinks the per-rank payload by the inner span, the DCN hop
       carries int8 (+error feedback on gradient consumers), the all-gather
       restores full width over ICI;
-    - the same shape with an exact outer hop (hierarchical-exact); and
-    - a bidirectional-ring all-gather variant (both ICI directions busy).
+    - the same shape with an exact outer hop (hierarchical-exact);
+    - a bidirectional-ring all-gather variant (both ICI directions busy);
+    - FUSED-hierarchical variants (the T3 move): the ICI reduce-scatter
+      and all-gather phases carry ``via="fused_matmul"`` bound to the
+      site's producing/consuming matmuls — their ppermute hops ride
+      between the matmul tile steps (``ops/collective_matmul.py`` fused
+      rings) instead of running as exposed transport, with the same exact
+      wire (bitwise vs the sequenced ring) or an int8 wire per hop. The
+      cost model prices them with the overlap credit, so they compete
+      with everything else on one scale.
 
     Flat single-impl candidates stay in the normal menu — synthesis only
     ADDS programs; an all-ICI mesh still gets them as candidates and the
@@ -68,10 +76,24 @@ def synthesize_programs(site: CollectiveSite, cost: CostModel,
     ar_exact = make_phase("all_reduce", outer, link=out_link)
     ar_int8 = make_phase("all_reduce", outer, wire_dtype=wire, block=block,
                          link=out_link)
+    # fused twins: exact wire on the ICI hops (bitwise vs the sequenced
+    # ring), bound producer-side on the reduce-scatter (the backward
+    # matmuls feed it) and consumer-side on the gather (the update math
+    # eats it); tile=0 — the engine binds real chunk sizes at compile
+    # (comm.compressed.bind_fused_tiles)
+    rs_f = make_phase("reduce_scatter", inner, via="fused_matmul",
+                      link=in_link,
+                      compute=FusedCompute(role="producer",
+                                           site=f"{site.consumer}/bwd"))
+    ag_f = make_phase("all_gather", inner, via="fused_matmul", link=in_link,
+                      compute=FusedCompute(role="consumer",
+                                           site=f"{site.consumer}/apply"))
     return [
-        (rs, ar_int8, ag),        # hierarchical-int8-outer (the DCN shape)
-        (rs, ar_exact, ag),       # hierarchical-exact
-        (rs, ar_int8, ag_bidir),  # bidir-ring gather variant
+        (rs, ar_int8, ag),          # hierarchical-int8-outer (the DCN shape)
+        (rs, ar_exact, ag),         # hierarchical-exact
+        (rs, ar_int8, ag_bidir),    # bidir-ring gather variant
+        (rs_f, ar_int8, ag_f),      # fused-hierarchical (the t3 shape)
+        (rs_f, ar_exact, ag_f),     # fused-hierarchical, exact outer
     ]
 
 
@@ -101,17 +123,25 @@ class CollectivePlanner:
             # operator-forced DCN axes (``comm_planner.dcn_axes``): rehearse
             # a multi-slice plan on a single-slice (or CPU) dev box. The
             # override is part of the fingerprint, so forced plans never
-            # collide with this mesh's organic plan cache entry.
+            # collide with this mesh's organic plan cache entry. Axes that
+            # name no fleet mesh axis are KEPT (they mark foreign-mesh
+            # sites — the zeropp factory's own ``dp`` axis resolves with an
+            # explicit ``axis_size`` and its link class comes from exactly
+            # this membership test) but called out, since a typo here
+            # switches costing to fleet (accelerator) rates
             known = {n for n, s in self.fingerprint.axis_sizes if s > 1}
-            forced = tuple(a for a in dcn_axes if a in known)
-            dropped = [a for a in dcn_axes if a not in known]
-            if dropped:
+            forced = tuple(dict.fromkeys(str(a) for a in dcn_axes))
+            foreign = [a for a in forced if a not in known]
+            if foreign:
                 from ...utils.logging import logger
 
                 logger.warning(
-                    f"comm_planner.dcn_axes: {dropped} match no multi-rank "
-                    f"mesh axis (known: {sorted(known)}) — ignored; no "
-                    f"cross-slice program will be synthesized for them")
+                    f"comm_planner.dcn_axes: {foreign} match no multi-rank "
+                    f"fleet mesh axis (known: {sorted(known)}) — kept as "
+                    f"foreign-mesh DCN axes (zeropp-style sites with their "
+                    f"own mesh); no cross-slice PROGRAM will be "
+                    f"synthesized for them, and a typo here prices plans "
+                    f"at fleet rates")
             if forced:
                 self.fingerprint = dataclasses.replace(
                     self.fingerprint,
@@ -309,14 +339,14 @@ class CollectivePlanner:
     def _static_decision(self, site: CollectiveSite) -> PlanDecision:
         """Static-mode decision: argmin over single impls AND programs."""
         impl, est, prog = self._candidates(site)[0]
-        return self._finish(impl, est_s=est, source="cost-model",
+        return self._finish(site, impl, est_s=est, source="cost-model",
                             program=prog)
 
     def _measure(self, site: CollectiveSite) -> PlanDecision:
         survivors = self._candidates(site)
         if len(survivors) == 1:
             impl, est, prog = survivors[0]
-            return self._finish(impl, est_s=est, source="cost-model",
+            return self._finish(site, impl, est_s=est, source="cost-model",
                                 program=prog)
         timed, errs = [], []
         for impl, _, prog in survivors:
@@ -339,15 +369,21 @@ class CollectivePlanner:
                 f"{site.signature()} — falling back to the cost model "
                 f"({'; '.join(errs)[:300]})")
             impl, est, prog = survivors[0]
-            return self._finish(impl, est_s=est, source="cost-model",
+            return self._finish(site, impl, est_s=est, source="cost-model",
                                 program=prog)
         impl, t, prog = min(timed, key=lambda kv: kv[1])
-        return self._finish(impl, est_s=t, source="measured", program=prog)
+        return self._finish(site, impl, est_s=t, source="measured",
+                            program=prog)
 
-    def _finish(self, impl: str, *, est_s: float, source: str,
-                program=None) -> PlanDecision:
+    def _finish(self, site: CollectiveSite, impl: str, *, est_s: float,
+                source: str, program=None) -> PlanDecision:
         block = self.block if impl in ("int8", "int8_sr", "hierarchical",
                                        "program") else None
+        if impl == "fused_matmul" and site.op in ("all_gather",
+                                                  "reduce_scatter"):
+            # the fused gather/scatter rings carry an int8 wire (the TP
+            # gather_matmul fused impl stays exact and blockless)
+            block = self.block
         return PlanDecision(impl=impl, block=block, source=source,
                             est_us=round(est_s * 1e6, 3),
                             program=program)
@@ -368,6 +404,13 @@ class CollectivePlanner:
         }
         if decision.program is not None:
             info["program"] = program_summary(decision.program)
+            # the structured per-phase dicts ride beside the summary so
+            # the graph auditor expands a program decision per hop (a
+            # fused/ring phase emits p-1 collective-permutes, not the
+            # phase's nominal fused collective) without re-parsing the
+            # summary string
+            info["program_phases"] = [s.to_dict()
+                                      for s in decision.program]
         get_comms_logger().record_plan(sig, info)
 
 

@@ -286,6 +286,15 @@ class CostModel:
             if impl == "int8":
                 return (hops * lp.alpha + hops * n * q * lp.beta
                         + n * self.quant_cost * p + self.quant_fixed)
+            if impl == "fused_matmul":
+                # the compute-bound quantized chunk ring (fused_ring_all_
+                # gather): int8 wire AND the overlap credit at once — wins
+                # the big-message regime where both terms matter, loses
+                # tiny alpha-dominated sites to exact xla (ring penalty +
+                # quant_fixed)
+                return (hops * lp.alpha * RING_HOP_PENALTY
+                        + hops * n * q * lp.beta * (1 - OVERLAP_CREDIT)
+                        + n * self.quant_cost * p + self.quant_fixed)
         elif site.op == "reduce_scatter":
             # site.shape is the full local input; (p-1)/p*n bytes per rank
             frac = n * hops / p
@@ -298,6 +307,13 @@ class CostModel:
                 t = hops * lp.alpha + frac * q * lp.beta \
                     + n * self.quant_cost + self.quant_fixed
                 return t * (1.02 if impl == "int8_sr" else 1.0)
+            if impl == "fused_matmul":
+                # quantized ring reduction bound to the producing matmul:
+                # one re-quantization round per hop (the shard-sized
+                # accumulator), hops hidden behind the tiles
+                return (hops * lp.alpha * RING_HOP_PENALTY
+                        + frac * q * lp.beta * (1 - OVERLAP_CREDIT)
+                        + n * self.quant_cost + hops * self.quant_fixed)
         elif site.op == "all_to_all":
             frac = n * hops / p
             if impl == "xla":
@@ -323,7 +339,11 @@ class CostModel:
         'exact on ICI, int8 on DCN' beat both flat variants the moment a
         slice boundary enters the span) and the per-rank payload tracks
         the phase algebra: a reduce-scatter shrinks it by the axis span, an
-        all-gather grows it back."""
+        all-gather grows it back. Fused phases (``via="fused_matmul"``)
+        take the ring alpha penalty but earn :data:`OVERLAP_CREDIT` on the
+        bandwidth term — their hops ride behind the bound matmul's tiles,
+        the term that lets a fused-hierarchical program beat its sequenced
+        twin on the same cost scale."""
         if site.axis_size is not None:
             return float("inf")  # foreign-mesh sites are one flat axis
         n = float(site.nbytes)
@@ -335,14 +355,17 @@ class CostModel:
             lp = self.link_params(st.link, st.axes)
             hops = p - 1
             q = self._wire_ratio(site.dtype) if st.quantized else 1.0
-            if st.via == "ring":
+            overlap = 1.0
+            if st.via in ("ring", "fused_matmul"):
                 alpha_t = hops * RING_HOP_PENALTY * lp.alpha
+                if st.via == "fused_matmul":
+                    overlap = 1 - OVERLAP_CREDIT
             elif st.via == "bidir_ring":
                 alpha_t = -(-hops // 2) * RING_HOP_PENALTY * lp.alpha
             else:
                 alpha_t = hops * lp.alpha
             if st.phase_op == "reduce_scatter":
-                t += alpha_t + n * hops / p * q * lp.beta
+                t += alpha_t + n * hops / p * q * lp.beta * overlap
                 if st.quantized:
                     t += n * self.quant_cost + self.quant_fixed
                 n = n / p
@@ -351,7 +374,7 @@ class CostModel:
                 if st.quantized:
                     t += 2 * n * self.quant_cost + 2 * self.quant_fixed
             elif st.phase_op == "all_gather":
-                t += alpha_t + hops * n * q * lp.beta
+                t += alpha_t + hops * n * q * lp.beta * overlap
                 if st.quantized:
                     t += n * p * self.quant_cost + self.quant_fixed
                 n = n * p
@@ -400,7 +423,12 @@ class CostModel:
                margin: float = 3.0) -> PlanDecision:
         """Static-mode decision: the cost model's argmin."""
         impl, est = self.prune(site, margin=margin)[0]
-        block = self.block if impl in ("int8", "int8_sr",
-                                       "hierarchical") else None
-        return PlanDecision(impl=impl, block=block, source="cost-model",
+        quantized = impl in ("int8", "int8_sr", "hierarchical") or (
+            # the fused gather/scatter rings carry an int8 wire; the TP
+            # gather_matmul fused impl is exact and needs no block
+            impl == "fused_matmul"
+            and site.op in ("all_gather", "reduce_scatter"))
+        return PlanDecision(impl=impl,
+                            block=self.block if quantized else None,
+                            source="cost-model",
                             est_us=round(est * 1e6, 3))

@@ -32,6 +32,13 @@ axis) are handled one level up — the consumer wiring
 matmul-free ring halves — ZeRO-3/ZeRO++ wires them into the unquantized
 qwZ/qgZ param gather and gradient scatter (``runtime/zero/zeropp.py``) so
 XLA can interleave one parameter's chunked transfer with another's compute.
+:func:`fused_ring_all_gather` / :func:`fused_ring_reduce_scatter` are the
+plan-IR fused-phase executors (``comm/planner`` ``via="fused_matmul"``):
+the same chunk rings with an optional int8 wire dtype per hop, stamped
+into the ledger as HIDDEN hop-classed traffic and into the collective
+flight ring one record per hop (``impl="fused_matmul"``). Both fused
+matmul primitives also take ``wire_dtype="int8"`` directly — the
+generalized fused computation-collective form (arxiv 2305.06942).
 
 All ring traffic is recorded in the comms ledger at trace time
 (``comm.log_chunked``) so ``_COMMS_LOGGER`` totals stay truthful.
@@ -47,6 +54,7 @@ from jax import lax
 __all__ = [
     "all_gather_matmul", "matmul_reduce_scatter",
     "ring_all_gather", "ring_reduce_scatter",
+    "fused_ring_all_gather", "fused_ring_reduce_scatter",
     "ring_embedding_gather", "ring_tied_lm_head",
     "embedding_overlap_ready",
     "overlap_ready", "overlap_enabled", "set_overlap_enabled",
@@ -102,6 +110,83 @@ def _mm(x, w):
     return jnp.einsum("...k,kn->...n", x, w)
 
 
+def _ag_ring_fill(out, x, axis: str, p: int, idx, put):
+    """The unidirectional gather ring: place the local chunk, then ``p-1``
+    forward permutes, each arrival placed at its owner's slot. The ONE
+    statement of this loop — ``ring_all_gather`` and the fused-phase
+    executor share it, so fused-exact is structurally identical to the
+    sequenced ring rather than a hand-kept copy."""
+    buf = x
+    out = put(out, buf, idx)
+    for s in range(1, p):
+        buf = lax.ppermute(buf, axis, _fwd_perm(p))
+        out = put(out, buf, (idx - s) % p)
+    return out
+
+
+def _rs_ring_sum(chunk, axis: str, p: int):
+    """The reduce-scatter ring: start from chunk 0's contribution, then
+    ``p-1`` rounds of permute-accumulate-add. The ONE statement of this
+    reduction tree — ``ring_reduce_scatter``, ``_mmrs_impl`` and the
+    fused-phase executor all run exactly this addition order."""
+    acc = chunk(0)
+    for s in range(1, p):
+        acc = lax.ppermute(acc, axis, _fwd_perm(p)) + chunk(s)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# quantized wire helpers: int8 payload + one-lane scales per ring hop
+# ---------------------------------------------------------------------------
+
+_WIRE_BLOCK = 2048  # default quant block, matches ops/pallas/quant.BLOCK
+
+
+def _wire_quant(flat, block, stochastic=False, key=None):
+    """Flat fp32 -> (int8 [nb, block], fp32 scales [nb, 1]) — the pair that
+    rides a quantized ring hop (one scale lane on the wire, the
+    ``comm/compressed.py`` convention)."""
+    from .pallas.quant import quantize_int8
+
+    q, s, _ = quantize_int8(flat, block, stochastic=stochastic, key=key)
+    return q, s[:, :1]
+
+
+def _wire_dequant(q, s1, n):
+    """Inverse of :func:`_wire_quant`: -> flat fp32 [n]."""
+    from .pallas.quant import dequantize_int8
+
+    return dequantize_int8(q, s1, (int(n),))
+
+
+def _wire_nbytes(n: int, block: int) -> int:
+    """On-wire bytes of one quantized hop of an ``n``-element chunk: int8
+    payload padded to whole blocks + one fp32 scale lane per block."""
+    nb = -(-int(n) // int(block))
+    return nb * int(block) + 4 * nb
+
+
+def _log_fused_phase(op: str, logical: int, wire: int, link, axis: str,
+                     hops: int, chunk_shape, wire_dtype: str,
+                     tag: str) -> None:
+    """Fused-phase accounting: ONE hop-classed ledger entry whose wire
+    bytes also land in the HIDDEN bucket (the hops ride behind the bound
+    matmul's tiles — ``hop_exposure()`` reports them as overlapped, which
+    is what the t3 bench's exposed-collective fraction measures), plus one
+    flight-ring launch record PER HOP with ``impl="fused_matmul"`` and a
+    per-hop ``detail`` — so the doctor's cross-rank seq alignment sees
+    every hop and names the divergent rank when one side runs the
+    sequenced fallback instead."""
+    from ..comm.comm import log_fused
+    from ..telemetry.collective import record_launch
+
+    log_fused(op, int(logical), int(wire), link=link)
+    for h in range(int(hops)):
+        record_launch(op, shape=chunk_shape, axes=(axis,),
+                      impl="fused_matmul", link=link,
+                      detail=f"{tag}:{wire_dtype}:hop{h + 1}/{hops}")
+
+
 # ---------------------------------------------------------------------------
 # all_gather_matmul
 # ---------------------------------------------------------------------------
@@ -140,6 +225,40 @@ def _agmm_impl(x, w, axis: str, bidirectional: bool):
     return out
 
 
+def _agmm_impl_quant(x, w, axis: str, block: int):
+    """Quantized-wire :func:`_agmm_impl`: this rank's chunk quantizes ONCE
+    and the (int8 payload, scale-lane) pair circulates the ring; each
+    arrival dequantizes into the resident chunk's partial matmul while the
+    next hop is in flight. Every rank (this one included) consumes the
+    DECODED chunk, so the gathered operand — and therefore the product —
+    is rank-invariant (the qwZ convention)."""
+    p = _axis_size(axis)
+    idx = lax.axis_index(axis)
+    m = x.shape[-2]
+    n_el = int(np.prod(x.shape))
+    from ..comm.comm import log_chunked
+
+    log_chunked("all_gather_matmul_int8", (p - 1) * _nbytes(x),
+                wire_bytes=(p - 1) * _wire_nbytes(n_el, block))
+    out = jnp.zeros(x.shape[:-2] + (p * m, w.shape[-1]),
+                    jnp.result_type(jnp.float32, w))
+
+    def put(o, val, j):
+        return lax.dynamic_update_slice_in_dim(o, val, j * m, axis=-2)
+
+    q, s1 = _wire_quant(x.astype(jnp.float32).reshape(-1), block)
+
+    def decoded():
+        return _wire_dequant(q, s1, n_el).reshape(x.shape)
+
+    out = put(out, _mm(decoded(), w), idx)
+    for s in range(1, p):
+        q = lax.ppermute(q, axis, _fwd_perm(p))
+        s1 = lax.ppermute(s1, axis, _fwd_perm(p))
+        out = put(out, _mm(decoded(), w), (idx - s) % p)
+    return out
+
+
 def _ring_weight_grad(rot, full, axis: str):
     """``sum_j rot_j^T @ full[chunk j]`` with ``rot`` circulating the ring —
     the weight-cotangent form shared by both primitives' backwards (the
@@ -160,7 +279,8 @@ def _ring_weight_grad(rot, full, axis: str):
     return acc
 
 
-def all_gather_matmul(x, w, axis: str, *, bidirectional: bool = False):
+def all_gather_matmul(x, w, axis: str, *, bidirectional: bool = False,
+                      wire_dtype: str = "exact", block: int = _WIRE_BLOCK):
     """``all_gather(x, axis) @ w`` with the gather hidden behind the matmul.
 
     Call inside ``shard_map``. ``x: [..., m, k]`` (this rank's row chunk of
@@ -170,21 +290,34 @@ def all_gather_matmul(x, w, axis: str, *, bidirectional: bool = False):
     column-parallel linears consume this with sequence-sharded activations
     (Megatron-SP / T3 all-gather side).
 
+    ``wire_dtype="int8"`` additionally narrows each hop to an int8 payload
+    + one-lane scales (``block`` elements per scale): the latency hides
+    behind the MXU AND the wire carries ~1/4 the bytes — the generalized
+    fused computation-collective form the plan IR's ``fused_matmul``
+    phases price. Quantization is transport-only (the matmul runs on the
+    decoded fp32 chunks); ``bidirectional`` applies to the exact wire only.
+
     Differentiable: ``dx`` returns through :func:`matmul_reduce_scatter`
-    (the transpose dual), ``dw`` through a chunked ring accumulation.
-    Falls back to the unfused ``all_gather`` + einsum when the axis size
-    is 1.
+    (the transpose dual), ``dw`` through a chunked ring accumulation —
+    both EXACT whatever the wire dtype (straight-through: int8 rounding
+    has no useful gradient). Falls back to the unfused ``all_gather`` +
+    einsum when the axis size is 1.
     """
     p = _axis_size(axis)
     if p == 1:
         return _mm(lax.all_gather(x, axis, axis=0, tiled=True), w)
+    quant = wire_dtype in ("int8", "int8_sr")
+
+    def impl(x, w):
+        return (_agmm_impl_quant(x, w, axis, block) if quant
+                else _agmm_impl(x, w, axis, bidirectional))
 
     @jax.custom_vjp
     def agmm(x, w):
-        return _agmm_impl(x, w, axis, bidirectional)
+        return impl(x, w)
 
     def fwd(x, w):
-        return _agmm_impl(x, w, axis, bidirectional), (x, w)
+        return impl(x, w), (x, w)
 
     def bwd(res, dy):
         x, w = res
@@ -234,14 +367,51 @@ def _mmrs_impl(x, w, axis: str):
         xs = lax.dynamic_slice_in_dim(x, j * m, m, axis=-2)
         return _mm(xs, w)
 
+    acc_elems = int(np.prod(x.shape[:-2] + (m, w.shape[-1])))
+    acc_bytes = acc_elems * jnp.dtype(jnp.result_type(x, w)).itemsize
+    _log_ring("matmul_reduce_scatter", (p - 1) * acc_bytes)
+    return _rs_ring_sum(part, axis, p)
+
+
+def _mmrs_impl_quant(x, w, axis: str, block: int, stochastic, key):
+    """Quantized-wire :func:`_mmrs_impl`: each hop's partial accumulator
+    re-quantizes to int8 for the permute and dequant-adds into the next
+    tile's product on arrival (one quantization round per hop — the
+    quantized ring-reduction error model; ``stochastic`` dithers each
+    round so the compression stays unbiased per element on gradients)."""
+    p = _axis_size(axis)
+    idx = lax.axis_index(axis)
+    m = x.shape[-2] // p
+
+    def part(s):
+        j = (idx - s - 1) % p
+        xs = lax.dynamic_slice_in_dim(x, j * m, m, axis=-2)
+        return _mm(xs, w).astype(jnp.float32)
+
     acc = part(0)
-    _log_ring("matmul_reduce_scatter", (p - 1) * _nbytes(acc))
+    n_el = int(np.prod(acc.shape))
+    from ..comm.comm import log_chunked
+
+    log_chunked("matmul_reduce_scatter_int8", (p - 1) * _nbytes(acc),
+                wire_bytes=(p - 1) * _wire_nbytes(n_el, block))
+    vk = key
+    if stochastic:
+        if key is None:
+            raise ValueError("stochastic matmul_reduce_scatter needs a key")
+        vk = jax.random.fold_in(key, idx)
     for s in range(1, p):
-        acc = lax.ppermute(acc, axis, _fwd_perm(p)) + part(s)
+        hk = jax.random.fold_in(vk, s) if stochastic else None
+        q, s1 = _wire_quant(acc.reshape(-1), block, stochastic=stochastic,
+                            key=hk)
+        q = lax.ppermute(q, axis, _fwd_perm(p))
+        s1 = lax.ppermute(s1, axis, _fwd_perm(p))
+        acc = _wire_dequant(q, s1, n_el).reshape(acc.shape) + part(s)
     return acc
 
 
-def matmul_reduce_scatter(x, w, axis: str):
+def matmul_reduce_scatter(x, w, axis: str, *, wire_dtype: str = "exact",
+                          block: int = _WIRE_BLOCK, stochastic: bool = False,
+                          key=None):
     """``psum_scatter(x @ w, axis)`` (scatter over the row dim) with the
     reduction ring hidden behind the chunked matmul.
 
@@ -252,9 +422,15 @@ def matmul_reduce_scatter(x, w, axis: str):
     layer (Megatron-SP / T3 reduce-scatter side). Requires ``M % p == 0``
     (wiring checks :func:`overlap_ready` and falls back otherwise).
 
+    ``wire_dtype="int8"`` narrows each hop's partial sum to int8 + scale
+    lanes on the wire (``stochastic`` + ``key`` dither the per-hop
+    rounding) — the producing matmul's tiles hide the hops AND the wire
+    carries ~1/4 the bytes, at one quantization round of error per hop.
+
     Differentiable: ``dx`` returns through :func:`all_gather_matmul` (the
-    transpose dual). Falls back to einsum + ``psum_scatter`` composition
-    semantics when the axis size is 1 (a no-op scatter).
+    transpose dual) — exact whatever the wire dtype (straight-through).
+    Falls back to einsum + ``psum_scatter`` composition semantics when the
+    axis size is 1 (a no-op scatter).
     """
     p = _axis_size(axis)
     if p == 1:
@@ -263,13 +439,19 @@ def matmul_reduce_scatter(x, w, axis: str):
         raise ValueError(
             f"matmul_reduce_scatter: rows {x.shape[-2]} don't chunk over "
             f"axis {axis!r} of size {p}; use overlap_ready() and fall back")
+    quant = wire_dtype in ("int8", "int8_sr")
+    sr = stochastic or wire_dtype == "int8_sr"
+
+    def impl(x, w):
+        return (_mmrs_impl_quant(x, w, axis, block, sr, key) if quant
+                else _mmrs_impl(x, w, axis))
 
     @jax.custom_vjp
     def mmrs(x, w):
-        return _mmrs_impl(x, w, axis)
+        return impl(x, w)
 
     def fwd(x, w):
-        return _mmrs_impl(x, w, axis), (x, w)
+        return impl(x, w), (x, w)
 
     def bwd(res, dy):
         x, w = res
@@ -303,14 +485,13 @@ def ring_all_gather(x, axis, *, bidirectional: bool = False):
     m = x.shape[0]
     _log_ring("ring_all_gather", (p - 1) * _nbytes(x))
     out = jnp.zeros((p * m,) + x.shape[1:], x.dtype)
-    out = lax.dynamic_update_slice_in_dim(out, x, idx * m, axis=0)
+
+    def put(o, val, j):
+        return lax.dynamic_update_slice_in_dim(o, val, j * m, axis=0)
+
     if not bidirectional:
-        buf = x
-        for s in range(1, p):
-            buf = lax.ppermute(buf, axis, _fwd_perm(p))
-            out = lax.dynamic_update_slice_in_dim(out, buf, ((idx - s) % p) * m,
-                                                  axis=0)
-        return out
+        return _ag_ring_fill(out, x, axis, p, idx, put)
+    out = put(out, x, idx)
     n_f, n_b = (p - 1 + 1) // 2, (p - 1) // 2
     buf_f = buf_b = x
     for s in range(1, n_f + 1):
@@ -521,8 +702,153 @@ def ring_reduce_scatter(x, axis):
         j = (idx - s - 1) % p
         return lax.dynamic_slice_in_dim(x, j * m, m, axis=0)
 
-    acc = chunk(0)
-    _log_ring("ring_reduce_scatter", (p - 1) * _nbytes(acc))
-    for s in range(1, p):
-        acc = lax.ppermute(acc, axis, _fwd_perm(p)) + chunk(s)
-    return acc
+    chunk_bytes = int(np.prod((m,) + x.shape[1:])) * jnp.dtype(x.dtype).itemsize
+    _log_ring("ring_reduce_scatter", (p - 1) * chunk_bytes)
+    return _rs_ring_sum(chunk, axis, p)
+
+
+# ---------------------------------------------------------------------------
+# Fused-phase ring collectives (plan-IR ``via="fused_matmul"`` execution)
+# ---------------------------------------------------------------------------
+#
+# The T3 move generalized past TP: a phase whose payload is produced or
+# consumed by a matmul lowers to a ppermute chunk ring whose hops ride
+# BETWEEN the compute site's tile steps (XLA's async collective-permute
+# overlaps each hop with the resident chunk's compute), and each hop's
+# payload can additionally quantize to int8 + one-lane scales — the wire
+# narrows AND the remainder hides. These are the executors behind
+# ``run_collective_program``'s fused phases (the engine DP-grad program)
+# and the planner-resolved ``fused_matmul`` decisions at the ZeRO-3
+# qwZ/qgZ sites (the gather fusing into its consuming projection, the
+# scatter into the producing backward matmuls). Flat 1-D calling
+# convention (the flat-buffer transport both consumers already use).
+
+
+def fused_ring_all_gather(x, axis: str, *, wire_dtype: str = "exact",
+                          block: int = _WIRE_BLOCK, link=None,
+                          tag: str = "fused"):
+    """Compute-bound tiled all-gather: ``[m] -> [p*m]`` fp32 along a ring
+    of ``p-1`` chunk hops, each hop's payload in ``wire_dtype``
+    (``exact`` | ``int8``). The int8 wire quantizes this rank's chunk ONCE
+    (the qwZ convention — every rank, this one included, consumes the
+    decoded value, so the result is rank-invariant) and circulates the
+    (payload, scale-lane) pair, dequantizing on arrival while the next
+    hop is already in flight.
+
+    Differentiable by straight-through estimation: backward is the exact
+    chunked sum reduce-scatter (the gather transpose) whatever the wire
+    dtype — int8 rounding has no useful gradient (the ``zeropp`` STE
+    contract). Ledger: one hop-classed HIDDEN entry; flight ring: one
+    ``impl="fused_matmul"`` record per hop (see ``_log_fused_phase``)."""
+    p = _axis_size(axis)
+    if p == 1:
+        return x.astype(jnp.float32).reshape(-1)
+    m = int(x.shape[0])
+    quant = wire_dtype in ("int8", "int8_sr")
+    wire = (p - 1) * (_wire_nbytes(m, block) if quant else 4 * m)
+    _log_fused_phase("fused_ring_all_gather", (p - 1) * 4 * m, wire, link,
+                     axis, p - 1, (m,), wire_dtype, tag)
+
+    def impl(v):
+        idx = lax.axis_index(axis)
+        out = jnp.zeros((p * m,), jnp.float32)
+
+        def put(o, val, j):
+            return lax.dynamic_update_slice_in_dim(o, val, j * m, axis=0)
+
+        if not quant:
+            # the shared gather-ring loop: structurally identical to the
+            # sequenced ring_all_gather, by construction
+            return _ag_ring_fill(out, v.astype(jnp.float32), axis, p, idx,
+                                 put)
+        q, s1 = _wire_quant(v.astype(jnp.float32).reshape(-1), block)
+        out = put(out, _wire_dequant(q, s1, m), idx)
+        for s in range(1, p):
+            q = lax.ppermute(q, axis, _fwd_perm(p))
+            s1 = lax.ppermute(s1, axis, _fwd_perm(p))
+            out = put(out, _wire_dequant(q, s1, m), (idx - s) % p)
+        return out
+
+    @jax.custom_vjp
+    def gather(v):
+        return impl(v)
+
+    def fwd(v):
+        return impl(v), None
+
+    def bwd(_, ct):
+        return (ring_reduce_scatter(ct, axis),)
+
+    gather.defvjp(fwd, bwd)
+    return gather(x)
+
+
+def fused_ring_reduce_scatter(x, axis: str, *, wire_dtype: str = "exact",
+                              block: int = _WIRE_BLOCK, stochastic=False,
+                              key=None, link=None, tag: str = "fused"):
+    """Compute-bound tiled SUM reduce-scatter: ``[p*m] -> [m]`` fp32 along
+    the ring, each hop's partial accumulator re-quantized for the wire
+    when ``wire_dtype`` is int8 (one extra quantization round per hop —
+    the standard quantized ring-reduction error model; gradient callers
+    pass ``stochastic=True`` + ``key`` to keep each round unbiased per
+    element). ``exact`` wire runs the bit-faithful ring — the same
+    reduction tree as :func:`ring_reduce_scatter`, so a fused-exact phase
+    is bitwise-identical to its sequenced ring twin.
+
+    Differentiable straight-through: backward is the exact chunked
+    all-gather (the reduce-scatter transpose). Same ledger/flight-ring
+    stamping contract as :func:`fused_ring_all_gather`."""
+    p = _axis_size(axis)
+    if p == 1:
+        return x.astype(jnp.float32).reshape(-1)
+    if x.shape[0] % p:
+        raise ValueError(
+            f"fused_ring_reduce_scatter: {x.shape[0]} elements don't chunk "
+            f"over axis {axis!r} of size {p}")
+    m = int(x.shape[0]) // p
+    quant = wire_dtype in ("int8", "int8_sr")
+    sr = stochastic or wire_dtype == "int8_sr"
+    if quant and sr and key is None:
+        raise ValueError("stochastic fused_ring_reduce_scatter needs a key")
+    wire = (p - 1) * (_wire_nbytes(m, block) if quant else 4 * m)
+    _log_fused_phase("fused_ring_reduce_scatter", (p - 1) * 4 * m, wire,
+                     link, axis, p - 1, (m,), wire_dtype, tag)
+
+    def impl(v):
+        idx = lax.axis_index(axis)
+        vk = key
+        if quant and sr:
+            # decorrelate the dither per rank (the quantized_all_reduce
+            # convention: shared thresholds would add errors coherently)
+            vk = jax.random.fold_in(key, lax.axis_index(axis))
+
+        def chunk(s):
+            j = (idx - s - 1) % p
+            return lax.dynamic_slice_in_dim(v.astype(jnp.float32), j * m, m,
+                                            axis=0)
+
+        if not quant:
+            # the shared reduction-ring loop: same addition order as the
+            # sequenced ring_reduce_scatter, by construction
+            return _rs_ring_sum(chunk, axis, p)
+        acc = chunk(0)
+        for s in range(1, p):
+            hk = jax.random.fold_in(vk, s) if sr else None
+            q, s1 = _wire_quant(acc, block, stochastic=sr, key=hk)
+            q = lax.ppermute(q, axis, _fwd_perm(p))
+            s1 = lax.ppermute(s1, axis, _fwd_perm(p))
+            acc = _wire_dequant(q, s1, m) + chunk(s)
+        return acc
+
+    @jax.custom_vjp
+    def scatter(v):
+        return impl(v)
+
+    def fwd(v):
+        return impl(v), None
+
+    def bwd(_, ct):
+        return (ring_all_gather(ct, axis),)
+
+    scatter.defvjp(fwd, bwd)
+    return scatter(x)

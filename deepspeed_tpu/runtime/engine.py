@@ -671,9 +671,17 @@ class DeepSpeedTPUEngine:
                     # shape: exact reduce-scatter over ICI, int8+error-
                     # feedback all-reduce over the cross-slice axis,
                     # all-gather back) — executed per step by
-                    # comm.compressed.run_collective_program
+                    # comm.compressed.run_collective_program. Fused phases
+                    # (via="fused_matmul": the ICI hops riding between the
+                    # backward matmuls' tile steps) get their compute
+                    # descriptors bound to the REAL chunk sizes here, so
+                    # the flight ring's per-hop detail and the doctor's
+                    # divergence report name what actually moves
+                    from ..comm.compressed import bind_fused_tiles
+                    program = bind_fused_tiles(d.program, n_elems,
+                                               dict(topo.mesh.shape))
                     dp_grad_impl = ("program", d.block or cc.block,
-                                    d.program)
+                                    program)
                     compressed_dp = True
                 elif d.impl in ("int8", "int8_sr", "hierarchical"):
                     hier = (d.impl == "hierarchical" and topo.ep_size > 1
@@ -685,8 +693,13 @@ class DeepSpeedTPUEngine:
             mode_, block_, hier_ = dp_grad_impl
             if mode_ == "program":
                 from ..comm.planner import program_summary
+                fused_n = sum(1 for s in hier_
+                              if getattr(s, "via", "xla") == "fused_matmul")
                 log_dist(f"DP gradients ride a planner program: "
-                         f"{program_summary(hier_)}")
+                         f"{program_summary(hier_)}"
+                         + (f" ({fused_n} phase(s) fused into the "
+                            f"producing/consuming matmul tiles)"
+                            if fused_n else ""))
             else:
                 log_dist(f"DP gradients ride the {mode_} all-reduce "
                          f"(block={block_}{', hierarchical' if hier_ else ''})")

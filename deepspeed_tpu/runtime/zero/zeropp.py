@@ -158,10 +158,16 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
     dp = mesh.shape[dp_axis]
     state_box = {"shapes": None, "treedef": None}
     # the live knob state closures read: filled from the explicit/legacy
-    # resolution above, overwritten by the planner in init() when pending
+    # resolution above, overwritten by the planner in init() when pending.
+    # fused_g/fused_s: the planner resolved the site to "fused_matmul" —
+    # the compute-bound int8 chunk ring (ops/collective_matmul.py
+    # fused_ring_*): the qwZ gather's hops hide behind the consuming
+    # projection's tiles, the qgZ scatter's behind the producing backward
+    # matmuls, and each hop's payload is int8 + one-lane scales
     kn = {"qw": quantized_weights, "qg": quantized_gradients,
           "sr": stochastic_rounding, "ring_g": overlap_collective_matmul,
           "ring_s": overlap_collective_matmul, "bidir": False,
+          "fused_g": False, "fused_s": False, "fblock": quant_block,
           "pending": plan_pending}
 
     def shard_spec_tree(tree):
@@ -187,6 +193,9 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
             kn["qw"] = dg.impl == "int8"
             kn["ring_g"] = dg.impl in ("ring", "bidir_ring")
             kn["bidir"] = dg.impl == "bidir_ring"
+            kn["fused_g"] = dg.impl == "fused_matmul"
+            if dg.impl == "fused_matmul" and dg.block:
+                kn["fblock"] = dg.block
             if remat is None:  # remat modes have no qgZ reduction at all
                 ds_ = resolve_site(op="reduce_scatter", shape=(total,),
                                    dtype="float32", axes=(dp_axis,),
@@ -194,6 +203,7 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
                 kn["qg"] = ds_.impl in ("int8", "int8_sr")
                 kn["sr"] = ds_.impl == "int8_sr"
                 kn["ring_s"] = ds_.impl == "ring"
+                kn["fused_s"] = ds_.impl == "fused_matmul"
         shards = jax.device_put(
             shards, jax.tree.map(lambda s: NamedSharding(mesh, P(dp_axis)), shards))
         opt_state = tx.init(shards)
@@ -203,7 +213,17 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
     def _gather(local_1d, shape):
         """shard [m] -> full param [shape] at compute dtype (qwZ)."""
         n = int(np.prod(shape)) if shape else 1
-        if kn["qw"]:
+        if kn["fused_g"]:
+            # the fused form of qwZ: int8 chunk hops that ride between the
+            # consuming projection's tile steps — quantized wire AND the
+            # gather latency hidden behind the matmuls it feeds
+            from ...ops.collective_matmul import fused_ring_all_gather
+
+            full = fused_ring_all_gather(local_1d, dp_axis,
+                                         wire_dtype="int8",
+                                         block=kn["fblock"],
+                                         tag="zeropp/qwZ")
+        elif kn["qw"]:
             full = quantized_all_gather(local_1d, dp_axis, block=quant_block)
         elif kn["ring_g"]:
             # ring-chunked exact gather: p-1 ppermute hops the scheduler can
@@ -229,6 +249,17 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
 
     def _reduce(grad_full, m, sr_key=None):
         """full grad -> this rank's mean shard [m] fp32 (qgZ)."""
+        if kn["fused_s"]:
+            # the fused form of qgZ: the reduction's int8 chunk hops ride
+            # between the producing backward matmuls' tile steps
+            from ...ops.collective_matmul import fused_ring_reduce_scatter
+
+            flat = jnp.ravel(grad_full).astype(jnp.float32)
+            flat = jnp.pad(flat, (0, dp * m - flat.shape[0]))
+            return fused_ring_reduce_scatter(
+                flat, dp_axis, wire_dtype="int8", block=kn["fblock"],
+                stochastic=sr_key is not None, key=sr_key,
+                tag="zeropp/qgZ") / dp
         if kn["qg"]:
             flat = jnp.ravel(grad_full).astype(jnp.float32)
             flat = jnp.pad(flat, (0, dp * m - flat.shape[0]))
@@ -258,8 +289,12 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
     def step(state: ZeroPPState, batch):
         flat_shapes = state_box["shapes"]
         # read at trace time (first call, after init resolved any pending
-        # plan); remat needs no term: remat + explicit qgZ already raised
-        use_sr = kn["sr"] and kn["qg"]
+        # plan); remat needs no term: remat + explicit qgZ already raised.
+        # The fused scatter ALWAYS dithers: it re-quantizes the gradient
+        # accumulator once per hop, so nearest rounding would compound a
+        # deterministic bias per hop per step — exactly what int8_sr
+        # exists to prevent on gradient paths
+        use_sr = (kn["sr"] and kn["qg"]) or kn["fused_s"]
 
         def body(shards, opt_state, mb, step_ctr):
             local = jax.tree.map(lambda s: s[0], shards)   # [1, m] -> [m]
@@ -302,7 +337,8 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
                     for l, shp in zip(leaves_local, flat_shapes):
                         # _gather's exact branch is lax.all_gather — its AD
                         # transpose is exactly _scatter_sum; the quantized
-                        # branch needs the explicit STE vjp
+                        # branch needs the explicit STE vjp (the fused ring
+                        # carries its OWN exact-transpose STE vjp)
                         f = (_ste_gather(l.shape[0], shp)(l)
                              if kn["qw"] else _gather(l, shp))
                         full.append(checkpoint_name(f, HPZ_NAME))

@@ -57,6 +57,12 @@ class CommsLogger:
         # its traffic rides (comm/planner ir.PhaseStep.link), so the ledger
         # can answer "how many bytes crossed the slice boundary" directly
         self.hop_bytes: Dict[str, int] = defaultdict(int)
+        # the HIDDEN subset of hop_bytes: wire bytes whose transfer rides
+        # behind compute (via="fused_matmul" phases — the ppermute hops
+        # interleave with the bound matmul's tiles). hop_exposure() reports
+        # exposed = total - hidden per link class; the t3 bench gates on
+        # the exposed fraction dropping when programs fuse
+        self.hop_hidden_bytes: Dict[str, int] = defaultdict(int)
         # site signature -> planner decision info (comm/planner): per-mesh
         # facts, not per-step counters — reset() deliberately keeps them
         self.plan_records: Dict[str, Dict[str, Any]] = {}
@@ -87,12 +93,16 @@ class CommsLogger:
         return self.prof_all or op_name in self.prof_ops
 
     def append(self, op_name: str, size_bytes: int, latency_s: float = 0.0, traced: bool = False,
-               wire_bytes: Optional[int] = None, hop_class: Optional[str] = None):
+               wire_bytes: Optional[int] = None, hop_class: Optional[str] = None,
+               hop_hidden: bool = False):
         """``wire_bytes`` defaults to ``size_bytes`` (exact collectives move
         what they carry); compressed collectives pass the smaller on-wire
         total so the ledger can report the compression ratio. ``hop_class``
         additionally buckets the wire bytes by link class (ici/dcn/host) —
-        only hop-aware callers (program phases) pass it."""
+        only hop-aware callers (program phases) pass it. ``hop_hidden``
+        marks the hop-classed bytes as compute-overlapped (fused phases):
+        they still count in ``hop_totals`` but ``hop_exposure`` subtracts
+        them from the exposed side."""
         if not self._should_log(op_name):
             return
         rec = self.comms_dict[op_name][size_bytes]
@@ -101,8 +111,10 @@ class CommsLogger:
         rec[2] += 1 if traced else 0
         rec[3] += int(size_bytes if wire_bytes is None else wire_bytes)
         if hop_class is not None:
-            self.hop_bytes[hop_class] += int(
-                size_bytes if wire_bytes is None else wire_bytes)
+            w = int(size_bytes if wire_bytes is None else wire_bytes)
+            self.hop_bytes[hop_class] += w
+            if hop_hidden:
+                self.hop_hidden_bytes[hop_class] += w
         if self.verbose:
             from .logging import logger
 
@@ -260,17 +272,34 @@ class CommsLogger:
         unless hop-aware collectives (multi-phase programs) ran."""
         return dict(self.hop_bytes)
 
-    def log_hop_bytes(self, link: str, nbytes: int) -> None:
+    def hop_exposure(self) -> Dict[str, Dict[str, int]]:
+        """Per link class: ``{"wire": total, "hidden": overlapped,
+        "exposed": total - overlapped}`` — hidden bytes are the fused-phase
+        hops that ride behind their bound matmul's tiles. The t3 bench's
+        exposed-collective fraction is ``sum(exposed) / sum(wire)``."""
+        out: Dict[str, Dict[str, int]] = {}
+        for link, wire in self.hop_bytes.items():
+            hidden = self.hop_hidden_bytes.get(link, 0)
+            out[link] = {"wire": int(wire), "hidden": int(hidden),
+                         "exposed": int(wire - hidden)}
+        return out
+
+    def log_hop_bytes(self, link: str, nbytes: int,
+                      hidden: bool = False) -> None:
         """Attribute already-ledgered wire bytes to a link class — for
         program phases whose underlying primitive (the ppermute chunk ring)
-        writes its own per-op ledger entry without hop awareness."""
+        writes its own per-op ledger entry without hop awareness.
+        ``hidden`` marks them compute-overlapped (see ``hop_exposure``)."""
         if not self.enabled:
             return
         self.hop_bytes[link] += int(nbytes)
+        if hidden:
+            self.hop_hidden_bytes[link] += int(nbytes)
 
     def reset(self):
         self.comms_dict.clear()
         self.hop_bytes.clear()
+        self.hop_hidden_bytes.clear()
 
 
 class timed_op:

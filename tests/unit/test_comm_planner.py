@@ -692,7 +692,7 @@ def test_synthesize_programs_shapes_and_gating():
 
     cm = CostModel(_dcn_fp())
     progs = synthesize_programs(_dp_site(), cm)
-    assert len(progs) == 3
+    assert len(progs) == 5
     for prog in progs:
         assert all(isinstance(s, PhaseStep) for s in prog)
         rs, ar, ag = prog
@@ -706,6 +706,15 @@ def test_synthesize_programs_shapes_and_gating():
     assert progs[0][1].wire_dtype == "int8_ef"
     assert progs[1][1].wire_dtype == "exact"
     assert progs[2][2].via == "bidir_ring"
+    # the fused-hierarchical twins: ICI phases ride between the producing/
+    # consuming matmul tiles, with role-correct compute bindings
+    for prog in progs[3:]:
+        rs, ar, ag = prog
+        assert rs.via == "fused_matmul" and rs.compute.role == "producer"
+        assert ag.via == "fused_matmul" and ag.compute.role == "consumer"
+        assert rs.wire_dtype == "exact" and ag.wire_dtype == "exact"
+    assert progs[3][1].wire_dtype == "int8_ef"
+    assert progs[4][1].wire_dtype == "exact"
     # no inner level (ep=1): nothing to reduce-scatter over, no programs
     assert synthesize_programs(_dp_site(), CostModel(_dcn_fp(ep=1))) == []
     # activation consumer would get plain int8 (no dither, no feedback)
@@ -885,6 +894,10 @@ def test_engine_dp_grad_program_under_static_dcn():
     assert [s.phase_op for s in prog] == ["reduce_scatter", "all_reduce",
                                           "all_gather"]
     assert prog[0].wire_dtype == "exact" and prog[1].wire_dtype == "int8_ef"
+    # PR 14: static synthesis now fuses the ICI phases into the producing/
+    # consuming matmul tiles, with the engine-bound real chunk size
+    assert prog[0].via == "fused_matmul" and prog[2].via == "fused_matmul"
+    assert prog[0].compute.role == "producer" and prog[0].compute.tile > 0
     # residual is engine state: initialized zero, NONZERO after stepping
     # (the reset-every-trace bug would leave it identically zero), and
     # stacked per-rank on the dp leading dim

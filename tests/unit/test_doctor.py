@@ -205,6 +205,33 @@ def test_divergence_tolerates_seq_hole_in_window():
     assert d["kind"] == "mismatch" and d["first_divergent_seq"] == 3
 
 
+def test_divergence_fused_vs_sequenced_fallback_names_rank():
+    """PR 14 fused phases stamp ONE launch per hop (impl="fused_matmul",
+    per-hop detail); a rank that degraded to the sequenced program records
+    a single program_reduce_scatter launch instead. The seq streams
+    diverge at the FIRST fused hop and the doctor names the sequenced
+    rank against the fused majority."""
+    def fused_stream():
+        hops = [_C(h, "fused_ring_reduce_scatter", shape=(2560,),
+                   axes=("ep",), impl="fused_matmul",
+                   detail=f"dp-grad/bwd@producer:exact:hop{h + 1}/3")
+                for h in range(3)]
+        return hops + [_C(3, "quantized_all_reduce", shape=(10240,),
+                          axes=("dp_outer",), impl="int8_ef")]
+
+    sequenced = [_C(0, "program_reduce_scatter", shape=(10240,),
+                    axes=("ep",), impl="exact"),
+                 _C(1, "quantized_all_reduce", shape=(10240,),
+                    axes=("dp_outer",), impl="int8_ef")]
+    d = doctor.analyze_collective_streams(
+        {0: fused_stream(), 1: fused_stream(), 2: fused_stream(),
+         3: sequenced})
+    assert d["kind"] == "mismatch" and d["first_divergent_seq"] == 0
+    assert d["divergent_ranks"] == [3]
+    assert "fused_matmul" in d["majority"] and "hop1/3" in d["majority"]
+    assert "program_reduce_scatter" in d["per_rank"]["3"]["signature"]
+
+
 def test_divergence_respects_ring_truncation():
     """A rank whose bounded ring evicted old seqs is only compared where
     its window overlaps — eviction is not divergence."""
