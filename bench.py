@@ -1868,6 +1868,256 @@ def control_bench():
             "device": jax.devices()[0].platform}
 
 
+def chaos_soak_bench():
+    """Rung cz (chaos engine, ISSUE 15): a seeded full-stack chaos soak —
+    serving and training drills run under one deterministic ChaosSchedule
+    spanning every fault layer (transport: object-store PUT/GET errors,
+    torn beacons, plan-cache read errors, snapshot-commit I/O errors;
+    serving: replica kill, KV exhaustion, slow prefill, dropped token
+    delivery; control: stale health rows, flapping straggler; training:
+    injected NaN loss -> sentinel rollback). The row VALUE is the number of
+    distinct fault classes fired (deterministic, gated tight), and the
+    rung itself asserts the survival invariants: zero lost response
+    handles, zero duplicate delivered tokens, post-rollback loss bitwise
+    equal to the fault-free run, and a doctor report that names every
+    injected fault."""
+    import random as _random
+    import shutil as _shutil
+    import tempfile
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu import doctor
+    from deepspeed_tpu.comm.planner.cache import PlanCache
+    from deepspeed_tpu.comm.planner.ir import Plan, PlanDecision
+    from deepspeed_tpu.comm.planner.topo import MeshFingerprint
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM)
+    from deepspeed_tpu.runtime.resilience import (ChaosEvent, ChaosSchedule,
+                                                  configure_chaos, get_chaos)
+    from deepspeed_tpu.runtime.resilience.heartbeat import (
+        HealthTable, ObjectStoreHeartbeatTransport)
+    from deepspeed_tpu.serving import (FINISH_EOS, FINISH_LENGTH, LLMServer,
+                                       ReplicaRouter, Request)
+    from deepspeed_tpu.utils.retry import (clear_retry_log,
+                                           retry_log_snapshot)
+
+    SEED = 1337
+    rng = _random.Random(SEED)
+    work = tempfile.mkdtemp(prefix="dstpu_cz_")
+    artifacts = os.path.join(work, "artifacts")
+    os.makedirs(artifacts)
+    t_start = time.perf_counter()
+    configure_chaos(None)
+    clear_retry_log()
+    try:
+        # ---- fault-free training reference (runs BEFORE any chaos) ------
+        dim, batch, nsteps = 64, 32, 10
+        prng = np.random.default_rng(SEED)
+        params0 = {"w": jnp.asarray(prng.normal(0, 0.05, (dim, dim)),
+                                    jnp.float32)}
+
+        def loss_fn(p, b):
+            return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+        batches = [{"x": jnp.asarray(prng.normal(size=(batch, dim)),
+                                     jnp.float32),
+                    "y": jnp.asarray(prng.normal(size=(batch, dim)),
+                                     jnp.float32)}
+                   for _ in range(4)]
+        base_cfg = {"train_micro_batch_size_per_gpu": batch,
+                    "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+                    "steps_per_print": 10**9, "seed": SEED}
+
+        def run_training(extra_cfg):
+            import copy as _copy
+
+            eng, *_ = ds.initialize(
+                model=loss_fn,
+                model_parameters=jax.tree.map(jnp.copy, params0),
+                config={**_copy.deepcopy(base_cfg), **extra_cfg})
+            losses = {}
+            while eng.global_steps < nsteps:
+                step = eng.global_steps
+                losses[step + 1] = float(np.asarray(
+                    eng.train_batch(batches[step % len(batches)])))
+            return eng, losses
+
+        _, ref_losses = run_training({})
+
+        # ---- phase A: serving + transport + control drills --------------
+        # seeded schedule: arming indices drawn per class from Random(SEED)
+        schedule = ChaosSchedule([
+            ChaosEvent("transport_put_error", "heartbeat.put",
+                       at=rng.randrange(2, 6), count=2),
+            ChaosEvent("transport_get_error", "heartbeat.get",
+                       at=rng.randrange(1, 4), count=2),
+            ChaosEvent("torn_beacon", "heartbeat.put",
+                       at=rng.randrange(8, 14)),
+            ChaosEvent("plan_cache_error", "plan_cache.load",
+                       at=0, count=2),
+            ChaosEvent("replica_kill", "replica0",
+                       at=rng.randrange(18, 26)),
+            ChaosEvent("kv_exhaustion", "scheduler.admit",
+                       at=rng.randrange(2, 5), count=3),
+            ChaosEvent("slow_prefill", "replica0",
+                       at=rng.randrange(1, 3), param=0.02),
+            ChaosEvent("drop_token", "replica0",
+                       at=rng.randrange(8, 14), count=2),
+            ChaosEvent("stale_health", "health.read",
+                       at=rng.randrange(1, 3)),
+            ChaosEvent("flap_straggler", "health.read",
+                       at=rng.randrange(3, 6), count=4, param=1.0),
+        ], seed=SEED)
+        configure_chaos(schedule)
+
+        # plan-cache drill: a stored plan survives transient read errors
+        fp = MeshFingerprint(platform="cpu", device_kind="cpu", n_devices=1,
+                             n_processes=1, axis_sizes=(("dp", 1),),
+                             dcn_axes=())
+        pc = PlanCache(os.path.join(work, "plans"))
+        plan = Plan(fingerprint=fp.digest())
+        plan.decisions["site"] = PlanDecision(impl="xla", est_us=1.0)
+        pc.store(fp, plan)
+        assert pc.load(fp) is not None, "plan cache lost to transient errors"
+
+        # serving drill: 2 replicas over an object-store heartbeat bucket
+        cfg = TransformerConfig(vocab_size=97, hidden_size=48,
+                                intermediate_size=96, num_layers=2,
+                                num_heads=4, num_kv_heads=2, max_seq_len=256,
+                                dtype=jnp.float32, norm="rmsnorm",
+                                activation="swiglu")
+        model = TransformerLM(cfg)
+        mparams = model.init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, 8), jnp.int32))["params"]
+
+        def make_engine():
+            return InferenceEngineV2(model, mparams,
+                                     RaggedInferenceEngineConfig(
+                                         token_budget=32,
+                                         max_ragged_sequence_count=4,
+                                         max_chunk_size=16, num_kv_blocks=96,
+                                         kv_block_size=8,
+                                         max_blocks_per_seq=16,
+                                         dtype="float32"))
+
+        transport = ObjectStoreHeartbeatTransport(
+            os.path.join(work, "bucket"))
+        r0 = LLMServer(make_engine(), replica_id=0,
+                       heartbeat_interval_s=0.02,
+                       resume_checkpoint_tokens=8)
+        r1 = LLMServer(make_engine(), replica_id=1,
+                       heartbeat_interval_s=0.02,
+                       resume_checkpoint_tokens=8)
+        router = ReplicaRouter([r0, r1], transport=transport,
+                               dead_after_s=0.6).start()
+        table = HealthTable(transport, dead_after_s=0.6)
+        streams = {}
+
+        def make_stream(i):
+            streams[i] = []
+            return lambda tok, resp: streams[i].append(tok)
+
+        n_req, mnt = 8, 40
+        resps = [router.submit(
+            Request(np.asarray(prng.integers(1, cfg.vocab_size, 10),
+                               np.int32),
+                    max_new_tokens=mnt, stream=make_stream(i)), block=True)
+            for i in range(n_req)]
+        deadline = time.monotonic() + 600
+        while (not all(r.done for r in resps)
+               and time.monotonic() < deadline):
+            router.check()      # the dead-replica takeover + resume path
+            table.read()        # the control-layer stale/flap consults
+            time.sleep(0.05)
+
+        lost = [i for i, r in enumerate(resps) if not r.done]
+        failed = [i for i, r in enumerate(resps)
+                  if r.finish_reason not in (FINISH_EOS, FINISH_LENGTH)]
+        assert not lost, f"lost response handles: {lost}"
+        assert not failed, f"failed response handles: {failed}"
+        dup_tokens = sum(1 for i, r in enumerate(resps)
+                         if streams[i] != r.tokens)
+        assert dup_tokens == 0, "stream delivery diverged from tokens " \
+            "(duplicate or lost deliveries)"
+        requeues = router.requeues
+        resumed = sum(1 for r in resps if r.requeues and r._ckpt_len)
+        assert requeues > 0 and resumed > 0, \
+            "the replica kill never exercised the resume path"
+        router.drain(timeout=600)
+        fired_a = schedule.all_fired()
+
+        # ---- phase B: training drill (chaos: config block wiring) -------
+        chaos_cfg = {
+            "chaos": {"enabled": True, "seed": SEED,
+                      "events": [{"kind": "snapshot_io_error",
+                                  "site": "snapshot.commit",
+                                  "at": 0, "count": 2}],
+                      "training": {"enabled": True,
+                                   "nan_loss_at_steps": [3]}},
+            "resilience": {"enabled": True,
+                           "snapshot_dir": os.path.join(work, "snaps"),
+                           "snapshot_interval": 2,
+                           "sentinel": {"nan_streak": 1}}}
+        eng, chaos_losses = run_training(chaos_cfg)
+        assert eng.resilience.rollbacks == 1, "injected NaN never rolled back"
+        fired_b = get_chaos().all_fired()
+        # post-rollback trajectory must match the fault-free run bitwise:
+        # the rollback restored the exact snapshot, and batches are indexed
+        # by global_steps, so the re-stepped losses coincide
+        post = {s: l for s, l in chaos_losses.items()
+                if s in ref_losses and s > 4}
+        mismatch = {s: (l, ref_losses[s]) for s, l in post.items()
+                    if l != ref_losses[s]}
+        assert not mismatch, f"post-rollback losses diverged: {mismatch}"
+
+        # ---- post-mortem: the doctor must name every injected fault -----
+        # canonical manifest encoding (ChaosSchedule.to_manifest): merge
+        # phase A's and phase B's trails under one schedule file
+        man = schedule.to_manifest()
+        man_b = get_chaos().to_manifest()
+        man["events"] += man_b["events"]
+        man["fired"] = all_fired = fired_a + fired_b
+        classes = sorted({e["kind"] for e in all_fired})
+        with open(os.path.join(artifacts, "chaos-schedule.json"), "w") as f:
+            json.dump(man, f, indent=1)
+        retries = retry_log_snapshot()
+        with open(os.path.join(artifacts, "flightdump-0.json"), "w") as f:
+            json.dump({"reason": "preempt_drain", "rank": 0, "pid": os.getpid(),
+                       "sequence": 1, "wall_time": time.time(),
+                       "last_phase": None, "open_spans": [],
+                       "inflight_spans": [], "steps": [],
+                       "retries": retries}, f)
+        report = doctor.diagnose(artifacts)
+        named = [k for k in classes
+                 if any(f"chaos drill injected {k}" in ev
+                        for ev in report["evidence"])]
+        missing = sorted(set(classes) - set(named))
+        assert not missing, f"doctor failed to name injected faults: {missing}"
+
+        retry_sites = sorted({e["site"] for e in retries})
+        wall = time.perf_counter() - t_start
+        return {"metric": "chaos_soak_fault_classes", "value": len(classes),
+                "unit": "classes", "vs_baseline": None, "seed": SEED,
+                "classes_fired": classes,
+                "lost_handles": len(lost), "failed_handles": len(failed),
+                "duplicate_token_streams": dup_tokens,
+                "requeues": requeues, "resumed_requests": resumed,
+                "rollbacks": eng.resilience.rollbacks,
+                "post_rollback_loss_match": not mismatch,
+                "doctor_named": len(named),
+                "doctor_verdict": report["verdict"],
+                "retries_total": len(retries), "retry_sites": retry_sites,
+                "served_requests": n_req, "tokens_per_request": mnt,
+                "wall_s": round(wall, 2),
+                "device": jax.devices()[0].platform}
+    finally:
+        configure_chaos(None)
+        clear_retry_log()
+        _shutil.rmtree(work, ignore_errors=True)
+
+
 RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "3b": rung3b_big_model,
          "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses,
@@ -1877,7 +2127,8 @@ RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "sv": serving_bench, "pd": paged_decode_bench,
          "ds": dcn_hierarchical_bench, "t3": fused_phase_bench,
          "ob": telemetry_bench, "mem": memory_telemetry_bench,
-         "sa": static_audit_bench, "at": control_bench}
+         "sa": static_audit_bench, "at": control_bench,
+         "cz": chaos_soak_bench}
 
 
 # ---------------------------------------------------------------------------
@@ -1904,6 +2155,7 @@ GATE_SPECS = {
     "fused_exposed_fraction": ("lower", 0.05),   # ledger bytes: deterministic
     "llama_zero3_bf16_mfu": ("higher", 0.15),    # the TPU headline: tight
     "paged_decode_step_ms": ("lower", 1.0),      # decode hot path: wall-clock
+    "chaos_soak_fault_classes": ("higher", 0.05),  # seeded count: deterministic
 }
 
 
@@ -2050,7 +2302,11 @@ def run_ladder(gate: bool = False):
             # at times the control plane: autotune probes are real engine
             # builds (8-dev mesh matches the test/drill substrate), the
             # decision loop is pure host work
-            ("at", cpu8)]
+            ("at", cpu8),
+            # cz soaks the chaos engine: seeded full-stack fault schedule
+            # over serving + training drills with the survival invariants
+            # asserted in-process (one CPU device is the substrate)
+            ("cz", cpu1)]
     results = []
     for rung, env_over in plan:
         env = dict(os.environ)

@@ -15,6 +15,12 @@ R2 **host syncs in default-on paths** — ``block_until_ready`` /
 R3 **mutable default args in public APIs** — a ``def f(x, acc=[])`` in a
    public function is shared state across calls; forbidden outside
    underscore-private functions.
+R4 **silent error swallows in failure-handling code** — a bare
+   ``except Exception: pass`` inside ``runtime/resilience/``, ``serving/``
+   or ``control/`` hides exactly the errors that subsystem exists to
+   surface (a swallowed transport error is an invisible dead host).
+   Deliberate sites carry a ``# swallow-ok: <reason>`` comment naming why;
+   anything unannotated fails.
 
 Stdlib-only (ast + tokenize); no jax import, so the lint test runs even
 where jax is broken.
@@ -35,14 +41,20 @@ SHARD_MAP_EXEMPT = ("utils/shard_map_compat.py",)
 HOST_SYNC_SCOPED = ("runtime/engine.py", "telemetry/")
 #: the annotation that blesses one host-sync line: `# sync-ok: <why>`
 SYNC_OK_MARKER = "sync-ok:"
+#: path prefixes where silent `except Exception: pass` is forbidden: the
+#: failure-handling tiers, where a swallowed error IS the failure
+SWALLOW_SCOPED = ("runtime/resilience/", "serving/", "control/")
+#: the annotation that blesses one deliberate swallow: `# swallow-ok: <why>`
+SWALLOW_OK_MARKER = "swallow-ok:"
 
 _HOST_SYNC_NAMES = ("block_until_ready", "device_get")
 _MUTABLE_DEFAULTS = (ast.List, ast.Dict, ast.Set)
+_BROAD_EXC_NAMES = ("Exception", "BaseException")
 
 
 @dataclasses.dataclass(frozen=True)
 class LintFinding:
-    rule: str        # 'raw-shard-map' | 'host-sync' | 'mutable-default'
+    rule: str        # 'raw-shard-map' | 'host-sync' | 'mutable-default' | 'swallow'
     path: str        # repo-relative
     line: int
     message: str
@@ -51,14 +63,14 @@ class LintFinding:
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
 
 
-def _annotated_lines(source: str) -> Set[int]:
-    """Line numbers carrying the ``# sync-ok:`` marker."""
+def _annotated_lines(source: str, marker: str = SYNC_OK_MARKER) -> Set[int]:
+    """Line numbers carrying the given blessing marker comment."""
     out: Set[int] = set()
     try:
         import io
 
         for tok in tokenize.generate_tokens(io.StringIO(source).readline):
-            if tok.type == tokenize.COMMENT and SYNC_OK_MARKER in tok.string:
+            if tok.type == tokenize.COMMENT and marker in tok.string:
                 out.add(tok.start[0])
     except (tokenize.TokenError, IndentationError):
         pass
@@ -126,6 +138,44 @@ def _lint_host_sync(tree: ast.AST, rel: str, source: str,
                 f"deliberate"))
 
 
+def _lint_swallows(tree: ast.AST, rel: str, source: str,
+                   findings: List[LintFinding]) -> None:
+    if not any(rel.startswith(p) or f"/{p}" in rel for p in SWALLOW_SCOPED):
+        return
+    blessed = _annotated_lines(source, SWALLOW_OK_MARKER)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        # broad handler: bare `except:` or `except (Base)Exception:`
+        t = node.type
+        names = []
+        for n in ([t] if not isinstance(t, ast.Tuple) else t.elts) \
+                if t is not None else []:
+            if isinstance(n, ast.Name):
+                names.append(n.id)
+            elif isinstance(n, ast.Attribute):
+                names.append(n.attr)
+        broad = t is None or any(n in _BROAD_EXC_NAMES for n in names)
+        if not broad:
+            continue
+        # a silent swallow: the handler body is a single `pass`
+        if not (len(node.body) == 1 and isinstance(node.body[0], ast.Pass)):
+            continue
+        pass_line = node.body[0].lineno
+        # the marker blesses the except line, the line above it, or the
+        # pass line itself — NOT the line after the pass, where a comment
+        # documenting the NEXT statement would silently bless an
+        # unannotated swallow above it
+        if any(ln in blessed for ln in (node.lineno, node.lineno - 1,
+                                        pass_line)):
+            continue
+        findings.append(LintFinding(
+            "swallow", rel, node.lineno,
+            "bare `except Exception: pass` in failure-handling code hides "
+            "the errors this tier exists to surface; handle it, or "
+            f"annotate '# {SWALLOW_OK_MARKER} <why>' if deliberate"))
+
+
 def _lint_mutable_defaults(tree: ast.AST, rel: str,
                            findings: List[LintFinding]) -> None:
     for node in ast.walk(tree):
@@ -162,6 +212,7 @@ def lint_source(source: str, rel_path: str) -> List[LintFinding]:
                             f"unparseable: {e.msg}")]
     _lint_shard_map(tree, rel_path, findings)
     _lint_host_sync(tree, rel_path, source, findings)
+    _lint_swallows(tree, rel_path, source, findings)
     _lint_mutable_defaults(tree, rel_path, findings)
     return findings
 

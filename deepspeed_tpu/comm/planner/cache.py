@@ -13,10 +13,18 @@ import os
 import tempfile
 from typing import Optional
 
+from ...runtime.resilience.chaos import get_chaos
+from ...utils.retry import RetryError, RetryPolicy, retry_call
 from .ir import Plan
 from .topo import MeshFingerprint
 
 _ENV_VAR = "DSTPU_PLAN_CACHE"
+
+# cache reads sit on the engine-build path: short backoffs, tight deadline —
+# a shared-FS hiccup should not cost a re-tune, but a dead mount must
+# degrade to a miss quickly (the planner just re-tunes)
+_READ_RETRY = RetryPolicy(max_attempts=4, base_s=0.02, cap_s=0.5,
+                          deadline_s=5.0)
 
 
 def default_cache_dir() -> str:
@@ -37,12 +45,23 @@ class PlanCache:
     def load(self, fp: MeshFingerprint) -> Optional[Plan]:
         """The cached plan for this fingerprint, or None. A corrupt or
         foreign-format file reads as a miss, never an error — the planner
-        just re-tunes and overwrites it."""
+        just re-tunes and overwrites it. Transient read errors (shared-FS
+        hiccups) retry under the shared backoff first (``dstpu_retry_total
+        {site=plan_cache.load}``); an absent file is an immediate miss."""
         path = self.path_for(fp)
-        try:
+        chaos = get_chaos()
+
+        def _read():
+            if chaos is not None:
+                chaos.maybe_raise("plan_cache_error", "plan_cache.load")
             with open(path) as f:
-                plan = Plan.from_dict(json.load(f))
-        except (OSError, ValueError, KeyError, TypeError):
+                return f.read()
+
+        try:
+            body = retry_call(_read, site="plan_cache.load",
+                              policy=_READ_RETRY)
+            plan = Plan.from_dict(json.loads(body))
+        except (RetryError, OSError, ValueError, KeyError, TypeError):
             return None
         return plan if plan.fingerprint == fp.digest() else None
 

@@ -86,12 +86,12 @@ class ControlLedger:
             try:
                 self._counter.inc(action=entry.action)
             except Exception:
-                pass  # metrics must never abort the action they describe
+                pass  # swallow-ok: metrics must never abort the action they describe
         if self._emit is not None:
             try:
                 self._emit([(f"Control/{entry.action}", 1.0, entry.step)])
             except Exception:
-                pass
+                pass  # swallow-ok: monitor sinks must never abort the action they describe
         return entry
 
     # -- reading --------------------------------------------------------

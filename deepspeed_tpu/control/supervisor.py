@@ -107,7 +107,7 @@ class ControlSupervisor:
                         "dstpu_control_actions_total",
                         "automated control-plane actions by kind"))
             except Exception:
-                pass
+                pass  # swallow-ok: optional telemetry binding must never block serving attach
         return server
 
     # ------------------------------------------------------------------
@@ -168,7 +168,7 @@ class ControlSupervisor:
                 if fp.dcn_axes:
                     return tuple(fp.dcn_axes)
         except Exception:
-            pass
+            pass  # swallow-ok: planner fingerprint is an optional hint; fall through to topology
         topo = getattr(self.engine, "topo", None)
         if topo is not None and len(topo.dp_axes) > 1:
             return (topo.dp_axes[0],)

@@ -336,6 +336,22 @@ def _plan_identity(plan: dict) -> str:
          for sig, info in (plan or {}).items()}, sort_keys=True)
 
 
+def _retry_summary(doc: dict) -> Dict[str, dict]:
+    """Per-site retry totals from a flightdump's ``retries`` log
+    (``utils/retry.py``): ``{site: {count, gave_up, last_error}}``."""
+    out: Dict[str, dict] = {}
+    for entry in doc.get("retries") or []:
+        if not isinstance(entry, dict):
+            continue
+        site = str(entry.get("site"))
+        row = out.setdefault(site, {"count": 0, "gave_up": 0,
+                                    "last_error": None})
+        row["count"] += 1
+        row["gave_up"] += int(bool(entry.get("final")))
+        row["last_error"] = entry.get("error")
+    return out
+
+
 def _rank_summary(doc: dict) -> dict:
     steps = doc.get("steps") or []
     out = {
@@ -346,6 +362,9 @@ def _rank_summary(doc: dict) -> dict:
         "open_spans": [s.get("name") for s in doc.get("open_spans") or []],
         "collectives": len(doc.get("collectives") or []),
     }
+    retries = _retry_summary(doc)
+    if retries:
+        out["retries"] = retries
     if doc.get("exception"):
         out["exception"] = doc["exception"]
         out["message"] = doc.get("message")
@@ -430,6 +449,26 @@ def diagnose(directory: str, *, world: Optional[int] = None,
             f"the supervisor acted {len(acted)}x before this state "
             f"(last: rank {last.get('rank')} {_describe_action(last)}) — "
             "see the supervisor-action lines")
+    # transport-retry trail: a dead verdict that was PRECEDED by a retry
+    # storm points at the store, not the host — say so (reusing the
+    # per-rank summaries already folded into `ranks`)
+    for r in sorted(dumps):
+        for site, row in sorted(ranks.get(str(r), {})
+                                .get("retries", {}).items()):
+            gave = (f", gave up {row['gave_up']}x" if row["gave_up"] else "")
+            evidence.append(
+                f"rank {r} retried {site} {row['count']}x{gave} before "
+                f"this state (last: {row['last_error']})")
+    # chaos manifest: every injected fault is named, so a drilled failure
+    # reads as a drill — and a fault the artifacts do NOT corroborate is
+    # still on record for the drill harness to assert against
+    chaos = load_chaos_manifest(directory)
+    if chaos:
+        for e in chaos["fired"]:
+            evidence.append(
+                f"chaos drill injected {e.get('kind')} "
+                f"[{e.get('layer', '?')}] at {e.get('site') or '?'}"
+                f"#{e.get('at')}")
     return {
         "version": 1,
         "dir": os.path.abspath(directory),
@@ -443,10 +482,36 @@ def diagnose(directory: str, *, world: Optional[int] = None,
         "health": health,
         "phases": phases,
         "audit": audit,
+        "chaos": chaos,
         "supervisor_actions": supervisor_actions,
         "verdict": verdict,
         "evidence": evidence,
     }
+
+
+def load_chaos_manifest(directory: str) -> Optional[dict]:
+    """The chaos engine's drill manifest, when a ``ChaosSchedule`` dumped
+    ``chaos-schedule.json`` beside the artifacts
+    (``runtime/resilience/chaos.py``). The ``fired`` trail is the ground
+    truth of what was injected — the post-mortem must name every entry so
+    a drilled failure is never misread as an organic one."""
+    path = os.path.join(directory, "chaos-schedule.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        # ValueError covers JSONDecodeError AND the UnicodeDecodeError a
+        # torn/garbage manifest body raises — a broken manifest reads as
+        # absent, never crashes the whole post-mortem
+        return None
+    if not isinstance(doc, dict):
+        return None
+    return {"seed": doc.get("seed"),
+            "events": doc.get("events") or [],
+            # a fired entry without a kind is unrenderable (and unsortable
+            # next to named ones): drop it rather than crash the report
+            "fired": [e for e in (doc.get("fired") or [])
+                      if isinstance(e, dict) and e.get("kind")]}
 
 
 def load_audit_report(directory: str) -> Optional[dict]:
@@ -615,6 +680,12 @@ def render_report(report: dict) -> str:
             f"static audit ({a.get('label')}): {c.get('error', 0)} error / "
             f"{c.get('warning', 0)} warning; "
             f"{len(a.get('unplanned') or [])} unplanned collective(s)")
+    ch = report.get("chaos")
+    if ch:
+        kinds = sorted({e.get("kind") for e in ch.get("fired") or []})
+        lines.append(f"chaos schedule (seed {ch.get('seed')}): "
+                     f"{len(ch.get('fired') or [])} fault(s) fired "
+                     f"across {kinds}")
     for act in (report.get("supervisor_actions") or [])[-12:]:
         lines.append(f"supervisor action: rank {act.get('rank')} "
                      + _describe_action(act))

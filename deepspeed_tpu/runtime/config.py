@@ -507,6 +507,34 @@ class FaultInjectionConfig(ConfigModel):
 
 @register_config
 @dataclass
+class ChaosConfig(ConfigModel):
+    """Full-stack chaos engine (``runtime/resilience/chaos.py``, see
+    ``docs/fleet_robustness.md``): deterministic, seeded fault schedules
+    across the transport layer (object-store heartbeat PUT/GET errors,
+    torn beacons, plan-cache read errors, snapshot-commit I/O errors), the
+    serving layer (replica kill, KV-pool exhaustion, slow prefill, dropped
+    token delivery), and the control layer (stale health rows, flapping
+    straggler verdicts) — drill/test use only. Disabled by default:
+    nothing is constructed, every injection site is a single None check,
+    and the stack is bitwise identical to a tree without the subsystem."""
+    enabled: bool = False
+    seed: int = 0
+    # explicit deterministic schedule: [{kind, site, at, count, param}...]
+    events: List[Dict[str, Any]] = field(default_factory=list)
+    # seeded auto-generation: events_per_class arming indices per listed
+    # fault class, drawn from random.Random(seed) over [0, horizon)
+    classes: List[str] = field(default_factory=list)
+    horizon: int = 64
+    events_per_class: int = 1
+    # training-layer injections (NaN loss, grad spikes, hang, ...) ride
+    # along as the existing FaultPlan; the ResilienceManager adopts it
+    # when resilience.faults itself is not enabled
+    training: FaultInjectionConfig = field(
+        default_factory=FaultInjectionConfig)
+
+
+@register_config
+@dataclass
 class WatchdogConfig(ConfigModel):
     """Step watchdog (``runtime/resilience/watchdog.py``): a deadline
     derived from the rolling median step time; on expiry all-thread stacks
@@ -745,6 +773,13 @@ class ServingConfig(ConfigModel):
     # steady decode, one server step runs a whole chunk in ONE compiled
     # dispatch; tokens stream in chunk-sized bursts. 0 = off.
     fused_decode_chunk: int = 0
+    # resumable requests: every N generated tokens a response checkpoints
+    # its generation state; a replica-loss requeue then resumes from the
+    # last checkpoint (one prefill over prompt+generated, stream delivery
+    # deduped) instead of replaying from scratch. 0 = full replays.
+    # MUST mirror serving/request.py DEFAULT_RESUME_CHECKPOINT_TOKENS
+    # (config cannot import the serving tier); change both together.
+    resume_checkpoint_tokens: int = 16
     default_deadline_s: Optional[float] = None  # SLA stamped when unset
     idle_s: float = 0.001                # engine-thread sleep when idle
     metrics_interval_steps: int = 50     # Serving/* monitor event cadence
@@ -918,6 +953,7 @@ class DeepSpeedTPUConfig(ConfigModel):
     autotuning: AutotuningConfig = field(default_factory=AutotuningConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     analysis: AnalysisConfig = field(default_factory=AnalysisConfig)

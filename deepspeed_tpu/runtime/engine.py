@@ -428,6 +428,23 @@ class DeepSpeedTPUEngine:
             self.telemetry = TelemetryManager(
                 config.telemetry, rank=self.artifact_rank,
                 default_dir=config.resilience.snapshot_dir)
+        # chaos engine (runtime/resilience/chaos.py): deterministic fault
+        # schedules across transport/serving/control. Installed BEFORE
+        # resilience so the manager can adopt the schedule's training
+        # FaultPlan. Off by default: the global stays None and every
+        # injection site is a single attribute test — bitwise off-identity.
+        if config.chaos.enabled:
+            from .resilience.chaos import install_chaos_from_config
+
+            install_chaos_from_config(config.chaos)
+        else:
+            # an engine built WITHOUT a chaos block must not inherit a
+            # schedule a previous drill ENGINE installed in this process
+            # (the off-identity contract is per-config); schedules
+            # installed manually via configure_chaos are left alone
+            from .resilience.chaos import clear_config_chaos
+
+            clear_config_chaos()
         # resilience (runtime/resilience/): snapshots + sentinel + preemption.
         # Constructed only when enabled, restore-on-restart runs before the
         # first step so a relaunch continues where the last snapshot left off.

@@ -15,6 +15,12 @@ the same reason — production training happens on preemptible capacity):
   snapshot.
 - :mod:`faults` — deterministic fault injection for tests (NaN at step N,
   simulated preemption, torn write, crash-before-commit).
+- :mod:`chaos` — the full-stack generalization: seeded, one-shot-audited
+  fault schedules across transport (object-store errors, torn beacons,
+  plan-cache / snapshot-commit I/O), serving (replica kill, KV
+  exhaustion, slow prefill, dropped token delivery), and control (stale
+  health rows, flapping straggler verdicts), consulted by injection sites
+  through a process-global that is None — and cost-free — by default.
 - :mod:`supervisor` — restore-on-restart: resolve the latest *valid*
   manifest entry and (with elasticity enabled) the world to restart at, so
   a resume onto a different chip count reshards correctly.
@@ -28,6 +34,8 @@ Everything is gated behind the ``resilience:`` config block; with it off
 (the default) no hook exists and engine stepping is bit-identical.
 """
 
+from .chaos import (FAULT_CLASSES, ChaosEvent, ChaosInjectedError,
+                    ChaosSchedule, chaos_active, configure_chaos, get_chaos)
 from .faults import FaultPlan, InjectedCrash
 from .heartbeat import (FileHeartbeatTransport, HealthTable, HeartbeatWriter,
                         HostHealth, ObjectStoreHeartbeatTransport)
@@ -42,4 +50,6 @@ __all__ = ["SnapshotManager", "Sentinel", "SentinelEvent", "SentinelHalt",
            "ResilienceManager", "resolve_restore", "StepWatchdog",
            "WATCHDOG_EXIT_CODE", "PREEMPT_EXIT_CODE", "HeartbeatWriter",
            "HealthTable", "HostHealth", "FileHeartbeatTransport",
-           "ObjectStoreHeartbeatTransport"]
+           "ObjectStoreHeartbeatTransport",
+           "ChaosSchedule", "ChaosEvent", "ChaosInjectedError",
+           "FAULT_CLASSES", "configure_chaos", "get_chaos", "chaos_active"]

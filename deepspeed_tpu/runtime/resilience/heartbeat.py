@@ -22,6 +22,7 @@ Stdlib-only (no jax import) for the same reason as :mod:`watchdog`: the
 launcher and standalone drill scripts import it without touching a backend.
 """
 
+import copy
 import json
 import os
 import socket
@@ -35,6 +36,39 @@ except ImportError:  # loaded standalone (file-path import in drill scripts)
     import logging
 
     logger = logging.getLogger("deepspeed_tpu.heartbeat")
+
+try:
+    from ...utils.retry import RetryError, RetryPolicy, retry_call
+except ImportError:  # standalone load: degrade to single-attempt calls
+    RetryError = OSError
+
+    def retry_call(fn, **_kw):
+        return fn()
+
+    RetryPolicy = None
+
+try:
+    from .chaos import get_chaos
+except ImportError:  # standalone load: chaos drills need the package
+
+    def get_chaos():
+        return None
+
+
+# beacons are small and frequent: short backoffs, tight deadline — a PUT
+# that cannot land within a couple of beacon intervals should fail (the
+# beater retries next interval anyway)
+_BEACON_RETRY = (RetryPolicy(max_attempts=4, base_s=0.02, cap_s=0.5,
+                             deadline_s=5.0)
+                 if RetryPolicy is not None else None)
+# GETs ride synchronous read paths (HealthTable.read sits under the
+# router's submit/alive_ids): immediate zero-backoff re-reads only — a
+# sleeping per-key backoff on a degraded store would head-of-line block
+# client traffic, and an absent beacon is already tolerated (the next
+# periodic read retries naturally)
+_BEACON_GET_RETRY = (RetryPolicy(max_attempts=3, base_s=0.0, cap_s=0.0,
+                                 deadline_s=1.0)
+                     if RetryPolicy is not None else None)
 
 _BEACON_PREFIX = "hb-"
 
@@ -65,9 +99,11 @@ class FileHeartbeatTransport:
             try:
                 rank = int(name[len(_BEACON_PREFIX):-len(".json")])
                 with open(os.path.join(self.dir, name)) as f:
-                    out[rank] = json.load(f)
+                    doc = json.load(f)
             except (ValueError, OSError, json.JSONDecodeError):
                 continue  # partially-deleted or foreign file: not a beacon
+            if isinstance(doc, dict):  # a torn/garbage body reads as absent
+                out[rank] = doc
         return out
 
 
@@ -129,32 +165,66 @@ class ObjectStoreHeartbeatTransport:
     ``store`` is either a directory path (a :class:`_LocalBucketStub` is
     built over it) or any client exposing ``put_object(key, bytes)``,
     ``get_object(key) -> bytes`` and ``list_objects(prefix) -> [keys]``.
+
+    Real buckets fail transiently (throttles, timeouts, 5xx): every PUT/GET
+    runs under ``utils/retry.py`` (decorrelated-jitter backoff, deadline
+    budget, ``dstpu_retry_total{site=heartbeat.*}``), so one EAGAIN never
+    reads as a dead host. A beacon that decodes to garbage — a torn PUT
+    observed mid-read on a store without whole-object semantics — reads as
+    *absent*, never raises out of a :class:`HealthTable` refresh.
     """
 
-    def __init__(self, store, prefix: str = "heartbeats"):
+    def __init__(self, store, prefix: str = "heartbeats",
+                 retry: Optional["RetryPolicy"] = None,
+                 get_retry: Optional["RetryPolicy"] = None):
         self.client = (_LocalBucketStub(store) if isinstance(store, str)
                        else store)
         self.prefix = prefix.strip("/")
+        self.retry = retry or _BEACON_RETRY
+        self.get_retry = get_retry or _BEACON_GET_RETRY
 
     def _key(self, rank: int) -> str:
         return f"{self.prefix}/{_BEACON_PREFIX}{int(rank)}.json"
 
     def write(self, rank: int, payload: dict) -> None:
-        self.client.put_object(self._key(rank),
-                               json.dumps(payload).encode("utf-8"))
+        key = self._key(rank)
+        data = json.dumps(payload).encode("utf-8")
+        chaos = get_chaos()
+        if chaos is not None:
+            data = chaos.mangle_bytes("torn_beacon", "heartbeat.put", data)
+
+        def _put():
+            if chaos is not None:
+                chaos.maybe_raise("transport_put_error", "heartbeat.put")
+            self.client.put_object(key, data)
+
+        retry_call(_put, site="heartbeat.put", policy=self.retry)
 
     def read_all(self) -> Dict[int, dict]:
         out: Dict[int, dict] = {}
+        chaos = get_chaos()
         for key in self.client.list_objects(self.prefix):
             name = key.rsplit("/", 1)[-1]
             if not (name.startswith(_BEACON_PREFIX)
                     and name.endswith(".json")):
                 continue
+
+            def _get(key=key):
+                if chaos is not None:
+                    chaos.maybe_raise("transport_get_error", "heartbeat.get")
+                return self.client.get_object(key)
+
             try:
                 rank = int(name[len(_BEACON_PREFIX):-len(".json")])
-                out[rank] = json.loads(self.client.get_object(key))
-            except (ValueError, KeyError, json.JSONDecodeError):
-                continue  # foreign object / deleted between list and get
+                raw = retry_call(_get, site="heartbeat.get",
+                                 policy=self.get_retry)
+                doc = json.loads(raw)
+            except (ValueError, KeyError, RetryError, OSError):
+                # foreign object / deleted between list and get / retries
+                # exhausted / torn or non-UTF-8 body: absent, not an error
+                continue
+            if isinstance(doc, dict):  # garbage-but-valid-JSON: absent too
+                out[rank] = doc
         return out
 
 
@@ -217,8 +287,19 @@ class HealthTable:
         self.dead_after_s = float(dead_after_s)
         self.straggler_factor = float(straggler_factor)
         self.clock = clock
+        self._last_rows: Optional[List[HostHealth]] = None  # chaos staleness
 
     def read(self) -> List[HostHealth]:
+        chaos = get_chaos()
+        if chaos is not None and chaos.fire("stale_health", "health.read"):
+            # control-layer drill: this refresh returns the PREVIOUS rows
+            # (a reader seeing stale data); consumers' flap guards must
+            # ride it out instead of acting on one stale verdict. On a
+            # first-ever read the previous state is the pre-warm-up empty
+            # view — injecting that (rather than skipping but still
+            # auditing the event) keeps the fired trail truthful.
+            return copy.deepcopy(self._last_rows) if self._last_rows \
+                else []
         beacons = self.transport.read_all()
         now = self.clock()
         rows: List[HostHealth] = []
@@ -238,6 +319,18 @@ class HealthTable:
                 if ref > 0:
                     row.ratio = float(row.step_time_s) / ref
                     row.straggler = row.ratio > self.straggler_factor
+        if chaos is not None:
+            ev = chaos.poll("flap_straggler", "health.read")
+            if ev is not None and (ev.count - ev.remaining) % 2 == 1:
+                # flapping signal: the target rank reads as a straggler on
+                # alternate refreshes — the supervisor's trigger/clear
+                # streaks must absorb it instead of re-planning every flap
+                for row in rows:
+                    if row.rank == int(ev.param):
+                        row.straggler = True
+                        row.ratio = max(row.ratio,
+                                        self.straggler_factor + 1.0)
+        self._last_rows = rows
         return rows
 
     def verdicts(self) -> Dict[str, List[int]]:

@@ -41,8 +41,16 @@ import numpy as np
 
 from ...utils.fs import fsync_dir, fsync_write_json
 from ...utils.logging import logger
+from ...utils.retry import RetryPolicy, retry_call
+from .chaos import get_chaos
 
 MANIFEST = "MANIFEST.json"
+
+# the manifest commit is the snapshot's point of no return: a transient
+# write error (shared-FS hiccup, NFS EAGAIN) must not discard minutes of
+# shard writes, so it retries under the shared backoff before giving up
+_COMMIT_RETRY = RetryPolicy(max_attempts=5, base_s=0.05, cap_s=1.0,
+                            deadline_s=30.0)
 
 
 def _keystr(kp) -> str:
@@ -259,7 +267,15 @@ class SnapshotManager:
         man["entries"].sort(key=lambda e: e["step"])
         pruned = man["entries"][:-self.keep]
         man["entries"] = man["entries"][-self.keep:]
-        fsync_write_json(os.path.join(self.dir, MANIFEST), man, indent=2)
+        man_path = os.path.join(self.dir, MANIFEST)
+        chaos = get_chaos()
+
+        def _commit():
+            if chaos is not None:
+                chaos.maybe_raise("snapshot_io_error", "snapshot.commit")
+            fsync_write_json(man_path, man, indent=2)
+
+        retry_call(_commit, site="snapshot.commit", policy=_COMMIT_RETRY)
         for old in pruned:
             shutil.rmtree(os.path.join(self.dir, old["tag"]),
                           ignore_errors=True)

@@ -83,6 +83,15 @@ class ResilienceManager:
         self.cfg = cfg
         self.faults: Optional[FaultPlan] = (
             FaultPlan.from_config(cfg.faults) if cfg.faults.enabled else None)
+        if self.faults is None:
+            # a chaos schedule (the `chaos:` block, installed before this
+            # manager is built) may carry training-layer injections: adopt
+            # its FaultPlan so one schedule drills the whole stack
+            from .chaos import get_chaos
+
+            chaos = get_chaos()
+            if chaos is not None and chaos.training is not None:
+                self.faults = chaos.training
         self.snap = SnapshotManager(
             cfg.snapshot_dir, keep=cfg.keep_snapshots,
             use_async=cfg.async_snapshot, shard_mb=cfg.shard_mb,
@@ -177,6 +186,35 @@ class ResilienceManager:
         self._hang_release = threading.Event()
         self._dataloader = None
         self._restored_data_state = None
+        # transport retries (utils/retry.py) surface as Resilience/* events
+        # while this manager is live: "host X retried the bucket 14x" must
+        # be visible in the same timeline as the dead verdict it preceded.
+        # The sink holds only a WEAK reference to this manager (many
+        # engines are built and abandoned without close() — autotuner
+        # probes, serial ds.initialize calls — and a strong bound method
+        # in the module-global registry would pin each whole engine
+        # forever); the finalizer drops the registry entry when the
+        # manager is collected, and close() drops it eagerly. The sink
+        # object is materialized ONCE because the registry keys by id().
+        import weakref
+
+        from ...utils.retry import add_retry_monitor, remove_retry_monitor
+
+        wself = weakref.ref(self)
+
+        def _retry_sink(site, attempt, err, final):
+            mgr = wself()
+            if mgr is not None:
+                mgr._on_transport_retry(site, attempt, err, final)
+
+        self._retry_sink = _retry_sink
+        add_retry_monitor(_retry_sink)
+        weakref.finalize(self, remove_retry_monitor, _retry_sink)
+
+    def _on_transport_retry(self, site: str, attempt: int, err: str,
+                            final: bool) -> None:
+        self._emit([(f"Resilience/retry/{site}", float(attempt),
+                     self.engine.global_steps)])
 
     # ------------------------------------------------------------------
     # engine hooks
@@ -372,7 +410,14 @@ class ResilienceManager:
         if not lost:
             st = (sum(self._recent_step_times) / len(self._recent_step_times)
                   if self._recent_step_times else None)
-            self.heartbeat.beat(step, step_time_s=st)
+            try:
+                self.heartbeat.beat(step, step_time_s=st)
+            except Exception as e:
+                # a beacon that cannot land (retries exhausted on a dead
+                # bucket, full disk) must degrade to an ABSENT beacon —
+                # peers will age it out — never abort the training step
+                # this tick rides on
+                logger.warning(f"resilience: heartbeat write failed: {e!r}")
         if self.health is not None:
             events = []
             self.last_health = rows = self.health.read()
@@ -601,6 +646,9 @@ class ResilienceManager:
             self.engine.monitor.write_events(events)
 
     def close(self) -> None:
+        from ...utils.retry import remove_retry_monitor
+
+        remove_retry_monitor(self._retry_sink)
         if self.watchdog is not None:
             self.watchdog.stop()
         self.snap.close()

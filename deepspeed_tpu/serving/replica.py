@@ -10,8 +10,13 @@ engine each — model replicas, not shards); the router in front of them:
   itself, so when a replica's beacon goes stale (``dead_after_s``) its
   unfinished requests are resubmitted to the survivors with the SAME
   response handles — the client's ``wait()`` never learns which replica
-  served it (generated tokens restart from the prompt; the SLA clock keeps
-  running and ``preemptions`` counts the restart);
+  served it. Requeues *resume*: the generated prefix up to the response's
+  last checkpoint survives, the survivor runs one prefill over
+  prompt+generated, and the delivered-token cursor keeps stream callbacks
+  exactly-once. The SLA clock keeps running, ``preemptions`` counts the
+  restart, and a per-request requeue budget (``Request.max_restarts``)
+  turns the Nth restart into ``FINISH_FAILED`` instead of an infinite
+  bounce between dying replicas;
 * **drains** gracefully: ``drain_replica`` stops dispatch to one replica
   and lets its in-flight work finish (maintenance), ``drain()`` does the
   fleet.
@@ -28,7 +33,8 @@ from typing import Callable, Dict, List, Optional
 
 from ..runtime.resilience.heartbeat import HealthTable, HeartbeatWriter
 from ..utils.logging import logger
-from .request import FINISH_FAILED, Request, ServedResponse
+from .request import (FINISH_EOS, FINISH_FAILED, FINISH_LENGTH, Request,
+                      ServedResponse)
 from .server import LLMServer, ServerClosed, ServerOverloaded
 
 
@@ -64,6 +70,7 @@ class ReplicaRouter:
             {rid: {} for rid in self.replicas}
         self._draining: set = set()
         self._dead: set = set()
+        self._closed = False
         self.requeues = 0
 
     # ------------------------------------------------------------------
@@ -132,7 +139,46 @@ class ReplicaRouter:
 
     def _track(self, rid: int, resp: ServedResponse) -> None:
         with self._lock:
-            self._assigned[rid][id(resp)] = resp
+            closed = self._closed
+            dead = rid in self._dead
+            if not closed and not dead:
+                self._assigned[rid][id(resp)] = resp
+        if closed:
+            # a submit that passed the replica's admission check while
+            # close() was snapshotting the book: nothing will ever serve
+            # it and it missed the close-time failure sweep. Fail it HERE
+            # so the client's wait(timeout=None) cannot hang on a closed
+            # router — unless the owning engine thread is still running
+            # (close()'s timed join can be outrun by a submit that landed
+            # in ingress), in which case failing would race _on_token on
+            # the same handle: defer to the book like close() does for
+            # wedged replicas, and a second close() sweeps it.
+            srv = self.replicas.get(rid)
+            if (srv is not None and srv._thread is not None
+                    and srv._thread.is_alive()):
+                with self._lock:
+                    self._assigned.setdefault(rid, {})[id(resp)] = resp
+                # the untrack hook still applies: if the outrunning engine
+                # thread finishes this response normally, the book entry
+                # must not linger and inflate `outstanding` forever
+                resp.on_finish = lambda r, rid=rid: self._untrack(rid, r)
+                if resp.done:
+                    self._untrack(rid, resp)
+                return
+            if not resp.done:
+                resp._on_finish(FINISH_FAILED, self.response_clock())
+                if srv is not None:
+                    srv.metrics.on_finish(resp)
+            return
+        if dead:
+            # submit raced _take_over: the replica was declared dead (its
+            # book already swept) between _pick and this call, so nothing
+            # will ever serve, requeue, or fail this handle from the
+            # takeover path — recover it exactly like takeover would
+            logger.warning(f"serving: submit raced the takeover of dead "
+                           f"replica {rid}; redirecting its request")
+            self._requeue_or_fail(resp, rid)
+            return
         resp.on_finish = lambda r, rid=rid: self._untrack(rid, r)
         if resp.done:     # finished before the hook landed: untrack now
             self._untrack(rid, resp)
@@ -184,30 +230,75 @@ class ReplicaRouter:
         try:
             server.steal_unfinished()
         except Exception:
-            pass
+            pass  # swallow-ok: best-effort engine-state reset on a dead replica; the book is authoritative
         for resp in tracked:
-            if resp.done:
-                continue
-            resp._on_requeue()          # the one place restarts are counted
-            self.requeues += 1
-            # a resubmit failure (no live replica, a survivor shedding or
-            # closing between _pick and submit) must fail THIS response,
-            # never abort the loop — the rest of the dead replica's work
-            # still has to be requeued
-            try:
-                target = self._pick()
-                target.submit(resp.request, block=True, _response=resp)
-            except (ServerClosed, ServerOverloaded) as e:
-                logger.warning(f"serving: could not requeue a request from "
-                               f"dead replica {rid}: {e!r}")
-                resp._on_finish(FINISH_FAILED, self.response_clock())
-                # every other finish path reports to a ServingMetrics; use
-                # the dead replica's (which admitted it) so failed counters
-                # still reconcile with submissions
-                server.metrics.on_finish(resp)
-                continue
-            self._track(target.replica_id, resp)
+            self._requeue_or_fail(resp, rid)
         return True
+
+    def _requeue_or_fail(self, resp: ServedResponse, rid: int) -> None:
+        """Move one unfinished response off dead replica ``rid``: charge
+        the requeue budget, resume-requeue onto a survivor, or fail the
+        handle. Shared by the takeover loop and the submit-vs-takeover
+        race recovery in ``_track``."""
+        if resp.done:
+            return
+        server = self.replicas[rid]
+        req = resp.request
+        reason = resp.derived_finish_reason()
+        if reason == FINISH_EOS or len(resp.tokens) >= req.max_new_tokens:
+            # the dead replica had already generated everything — only the
+            # finish bookkeeping died with it. Complete the handle here:
+            # resubmitting would overrun max_new_tokens by the resume
+            # clamp (and, at exactly max_seq_len, wedge the head of the
+            # survivor's queue on an unschedulable +1-token prefill).
+            resp._on_finish(reason, self.response_clock())
+            server.metrics.on_finish(resp)
+            return
+        resp.requeues += 1
+        self.requeues += 1
+        # per-request retry budget, checked BEFORE any state reset: the
+        # Nth replica-loss requeue fails the handle instead of bouncing
+        # it between dying replicas forever, and a budget-failed
+        # response keeps its full token list consistent with what was
+        # already streamed (truncating to the checkpoint first would
+        # desync tokens from the delivered stream)
+        if resp.requeues > resp.request.max_restarts:
+            logger.warning(
+                f"serving: request uid={resp.uid} exceeded its requeue "
+                f"budget ({resp.request.max_restarts}); failing it")
+            resp._on_finish(FINISH_FAILED, self.response_clock())
+            server.metrics.on_finish(resp)
+            return
+        # resume=True: the generated prefix up to the response's last
+        # checkpoint survives — the survivor runs ONE prefill over
+        # prompt+generated and continues, instead of replaying and
+        # re-delivering the whole request (the delivered-token cursor
+        # keeps stream callbacks exactly-once either way)
+        full_tokens = list(resp.tokens)     # restored if the resubmit fails
+        resp._on_requeue(resume=True)   # the one place restarts are counted
+        # a resubmit failure (no live replica, a survivor shedding or
+        # closing between _pick and submit, or a survivor whose ingress
+        # stays full past the bounded timeout — an unbounded blocking put
+        # here could wedge check() forever on an undetected-dead peer)
+        # must fail THIS response, never abort the caller's loop
+        try:
+            target = self._pick()
+            target.submit(resp.request, block=True, timeout=5.0,
+                          _response=resp)
+        except (ServerClosed, ServerOverloaded) as e:
+            logger.warning(f"serving: could not requeue a request from "
+                           f"dead replica {rid}: {e!r}")
+            # un-truncate before failing: a failed handle must keep its
+            # token list consistent with what was already streamed (the
+            # checkpoint truncation only ever serves a successful resume)
+            resp.tokens[:] = full_tokens
+            resp._on_finish(FINISH_FAILED, self.response_clock())
+            # every other finish path reports to a ServingMetrics; use
+            # the dead replica's (which admitted it) so failed counters
+            # still reconcile with submissions
+            server.metrics.on_finish(resp)
+            return
+        self._track(target.replica_id, resp)
 
     # ------------------------------------------------------------------
     def add_replica(self, server: LLMServer) -> None:
@@ -247,6 +338,57 @@ class ReplicaRouter:
                 continue
             ok = self.drain_replica(rid, timeout) and ok
         return ok
+
+    def close(self) -> None:
+        """Abrupt fleet shutdown. Every replica halts WITHOUT finishing its
+        in-flight work, and every unfinished handle still in the assignment
+        book is failed (``FINISH_FAILED``) — once the router stops
+        checking, nothing will ever finish those responses, and a client
+        blocked in ``wait(timeout=None)`` would otherwise hang forever.
+
+        ``halt()``'s thread join is TIMED: a replica wedged past it (a long
+        XLA compile mid-step — the same case ``_take_over`` defers for)
+        still has a live engine thread mutating its handles, so failing
+        them here would race ``_on_token``/``_on_finish``. Those handles
+        stay in the book instead; call ``close()`` again once the wedge
+        clears (or let the finishing thread resolve them)."""
+        with self._lock:
+            self._closed = True     # _track now fails late-racing submits
+            self._draining.update(self.replicas)
+            tracked = [r for book in self._assigned.values()
+                       for r in book.values()]
+            for book in self._assigned.values():
+                book.clear()
+        for server in self.replicas.values():
+            server.halt()
+        stopped = {rid: not (s._thread is not None and s._thread.is_alive())
+                   for rid, s in self.replicas.items()}
+        # second sweep: a submit racing this close() may have re-booked a
+        # handle (via _track's closed-branch deferral) AFTER the snapshot
+        # above but BEFORE its replica's halt() join finished — once that
+        # thread is stopped, nothing but this sweep will ever fail it
+        with self._lock:
+            for rid in list(self._assigned):
+                if stopped.get(rid, True):
+                    tracked.extend(self._assigned[rid].values())
+                    self._assigned[rid].clear()
+        now = self.response_clock()
+        for resp in tracked:
+            if resp.done:
+                continue
+            rid = resp.replica_id
+            if not stopped.get(rid, True):
+                logger.warning(
+                    f"serving: replica {rid} engine thread outlived halt(); "
+                    f"deferring failure of its in-flight handles (call "
+                    f"close() again once it stops)")
+                with self._lock:
+                    self._assigned.setdefault(rid, {})[id(resp)] = resp
+                continue
+            resp._on_finish(FINISH_FAILED, now)
+            srv = self.replicas.get(rid)
+            if srv is not None:
+                srv.metrics.on_finish(resp)
 
     @property
     def outstanding(self) -> int:

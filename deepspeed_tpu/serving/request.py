@@ -23,6 +23,11 @@ FINISH_LENGTH = "length"      # hit max_new_tokens
 FINISH_CANCELLED = "cancelled"
 FINISH_FAILED = "failed"      # unschedulable (exceeds model/pool limits)
 
+#: default generation-state checkpoint cadence (tokens) — the one source
+#: of truth for ServedResponse/LLMServer; ServingConfig documents the same
+#: value declaratively in runtime/config.py
+DEFAULT_RESUME_CHECKPOINT_TOKENS = 16
+
 
 @dataclass
 class Request:
@@ -33,9 +38,15 @@ class Request:
     priority: int = 0                  # higher preempts lower (policy=priority)
     deadline_s: Optional[float] = None  # e2e SLA budget from arrival
     # per-token streaming callback(token_id, response) — called from the
-    # engine thread, must be cheap and never raise
+    # engine thread, must be cheap and never raise. Delivery is
+    # exactly-once per token index, across replica-loss restarts included
+    # (the response's delivered-token cursor dedups replays).
     stream: Optional[Callable[[int, "ServedResponse"], None]] = None
     request_id: Optional[str] = None   # client-side correlation id
+    # replica-loss requeue budget: after this many router requeues the next
+    # one fails the handle (FINISH_FAILED) instead of bouncing it between
+    # dying replicas forever; scheduler preemptions don't count
+    max_restarts: int = 3
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -61,8 +72,20 @@ class ServedResponse:
         self.finish_time: Optional[float] = None
         self.finish_reason: Optional[str] = None
         self.preemptions = 0           # times restarted (preempt / replica loss)
+        self.requeues = 0              # replica-loss restarts only (budgeted)
         self.replica_id: Optional[int] = None
         self.tokens: List[int] = []
+        # resumable generation: every ckpt_every tokens the response
+        # checkpoints its generation state (token count + sampling state);
+        # a replica-loss requeue then resumes from the last checkpoint via
+        # one prefill over prompt+generated instead of a full replay.
+        # 0 disables checkpointing (requeues replay from scratch).
+        self.ckpt_every = DEFAULT_RESUME_CHECKPOINT_TOKENS
+        self._ckpt_len = 0
+        # delivered-token cursor: tokens[0:_delivered] have had their stream
+        # callback fired — the exactly-once fence across dropped deliveries
+        # and resume/replay re-generation
+        self._delivered = 0
         self._done = threading.Event()
         self._cancel = threading.Event()
         # router hook (replica.py): called exactly once when the response
@@ -73,18 +96,42 @@ class ServedResponse:
     def _on_admit(self, now: float) -> None:
         self.admitted_time = now
 
-    def _on_token(self, token: int, now: float) -> None:
+    def _on_token(self, token: int, now: float, deliver: bool = True) -> None:
         if self.first_token_time is None:
             self.first_token_time = now
         self.tokens.append(int(token))
+        if self.ckpt_every and len(self.tokens) % self.ckpt_every == 0:
+            self._checkpoint()
+        if deliver:
+            self._flush_stream()
+
+    def _checkpoint(self) -> None:
+        """Record the generation state a resume restarts from. Under
+        greedy decode the generated prefix IS the sampling state, so the
+        checkpoint is just its length; a stochastic sampler would have to
+        checkpoint its RNG state here too, or resume would regenerate a
+        different span than what was already streamed."""
+        self._ckpt_len = len(self.tokens)
+
+    def _flush_stream(self) -> None:
+        """Fire the stream callback for every not-yet-delivered token —
+        exactly once per token index: a delivery dropped earlier (or tokens
+        re-generated after a resume) is skipped or re-delivered by cursor
+        position, never duplicated."""
         cb = self.request.stream
-        if cb is not None:
+        if cb is None:
+            self._delivered = max(self._delivered, len(self.tokens))
+            return
+        while self._delivered < len(self.tokens):
+            tok = self.tokens[self._delivered]
+            self._delivered += 1
             try:
-                cb(int(token), self)
-            except Exception:  # a client callback must never kill the server
+                cb(tok, self)
+            except Exception:  # swallow-ok: a client callback must never kill the server
                 pass
 
     def _on_finish(self, reason: str, now: float) -> None:
+        self._flush_stream()   # land any dropped/pending deliveries first
         self.finish_reason = reason
         self.finish_time = now
         self._done.set()
@@ -92,14 +139,47 @@ class ServedResponse:
         if cb is not None:
             cb(self)
 
-    def _on_requeue(self) -> None:
-        """Reset generation state for a restart on another replica (or after
-        a preemption): generated tokens are discarded — the prompt replays
-        from scratch — but arrival time and the SLA clock keep running."""
-        self.tokens = []
-        self.first_token_time = None
+    def _on_requeue(self, resume: bool = False) -> None:
+        """Reset generation state for a restart on another replica (or
+        after a preemption). With ``resume`` and a live checkpoint, the
+        generated prefix up to the last checkpoint survives — the restart
+        is one prefill over prompt+generated on the new replica — and the
+        delivered-token cursor keeps stream callbacks exactly-once across
+        the re-generated span. Without it, the prompt replays from
+        scratch. Either way arrival time and the SLA clock keep running."""
+        if resume and self._ckpt_len:
+            del self.tokens[self._ckpt_len:]
+        else:
+            self.tokens = []
+            self._ckpt_len = 0
+            self.first_token_time = None
         self.admitted_time = None
         self.preemptions += 1
+
+    def derived_finish_reason(self) -> str:
+        """EOS vs length, derived from the generated tokens — the ONE
+        definition shared by the engine's finish path
+        (``server._finish_if_done``) and the router's dead-replica
+        completion (``replica._requeue_or_fail``)."""
+        req = self.request
+        if (req.eos_token_id is not None and self.tokens
+                and self.tokens[-1] == req.eos_token_id):
+            return FINISH_EOS
+        return FINISH_LENGTH
+
+    # -- engine-side resume views -------------------------------------------
+    def engine_prompt(self) -> np.ndarray:
+        """What the next admission prefills: the prompt plus any resumed
+        generated prefix (equal to the raw prompt for a fresh request)."""
+        if not self.tokens:
+            return self.request.prompt
+        return np.concatenate([self.request.prompt,
+                               np.asarray(self.tokens, np.int32)])
+
+    def remaining_new_tokens(self) -> int:
+        """Budget left after the resumed prefix (total footprint stays
+        ``len(prompt) + max_new_tokens`` — admission math is unchanged)."""
+        return max(1, self.request.max_new_tokens - len(self.tokens))
 
     # -- client side --------------------------------------------------------
     @property

@@ -28,6 +28,7 @@ ingress queue.
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..runtime.resilience.chaos import get_chaos
 from ..utils.logging import logger
 from .request import (FINISH_CANCELLED, FINISH_FAILED, ServedResponse)
 
@@ -150,7 +151,11 @@ class ContinuousBatchScheduler:
     def _preempt(self, victim: ServedResponse) -> None:
         self.engine.flush(victim.uid)     # frees its KV blocks + tracking
         del self.inflight[victim.uid]
-        victim._on_requeue()
+        # resume=True: an ordinary prefill victim has no generated tokens
+        # (identical to a scratch restart), but a RESUMED sequence still
+        # re-prefilling its prompt+generated prefix keeps its checkpoint
+        # instead of losing already-delivered tokens to a second replay
+        victim._on_requeue(resume=True)
         self.pending.append(victim)
         self.preemptions += 1
         logger.info(f"serving: preempted uid={victim.uid} "
@@ -164,6 +169,13 @@ class ContinuousBatchScheduler:
         admitted either — skipping ahead would starve large requests."""
         now = self.clock() if now is None else now
         admitted: List[ServedResponse] = []
+        chaos = get_chaos()
+        if chaos is not None and chaos.fire("kv_exhaustion",
+                                            "scheduler.admit"):
+            # serving-layer drill: the pool reads dry for this admit cycle
+            # — queued requests must wait it out exactly as they would a
+            # real block-pressure transient, not fail or deadlock
+            return admitted
         # one sort per admit() call: pops keep the order, and the only
         # in-loop append (a preempted victim rejoining pending) re-sorts
         # below — a per-iteration sort of a deep backlog would otherwise run
@@ -176,8 +188,13 @@ class ContinuousBatchScheduler:
                 self._finish(resp, FINISH_CANCELLED, now)
                 continue
             req = resp.request
-            ok, why = self.engine.can_schedule(len(req.prompt),
-                                               req.max_new_tokens)
+            # resume-aware shape: a requeued response prefills over
+            # prompt+generated with the remaining budget — the worst-case
+            # total (prompt + max_new) is unchanged, so _blocks_worst /
+            # _permanent stay in the request's own terms
+            eff_prompt = resp.engine_prompt()
+            eff_new = resp.remaining_new_tokens()
+            ok, why = self.engine.can_schedule(len(eff_prompt), eff_new)
             if not ok and self._permanent(resp):
                 self.pending.pop(0)
                 self.failed += 1
@@ -192,8 +209,8 @@ class ContinuousBatchScheduler:
                         break
                     self._preempt(victim)
                     preempted = True
-                    ok, why = self.engine.can_schedule(len(req.prompt),
-                                                       req.max_new_tokens)
+                    ok, why = self.engine.can_schedule(len(eff_prompt),
+                                                       eff_new)
                 if preempted:
                     # victims rejoined pending; resp stays at the head (it
                     # strictly outranks every victim) but the victims must
@@ -202,8 +219,8 @@ class ContinuousBatchScheduler:
             if not ok:
                 break
             self.pending.pop(0)
-            self.engine.put([resp.uid], [req.prompt],
-                            max_new_tokens=req.max_new_tokens,
+            self.engine.put([resp.uid], [eff_prompt],
+                            max_new_tokens=eff_new,
                             eos_token_id=req.eos_token_id)
             resp._on_admit(now)
             self.inflight[resp.uid] = resp

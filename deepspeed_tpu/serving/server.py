@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..runtime.resilience.chaos import get_chaos
 from ..telemetry.spans import get_tracer, span
 from ..utils.logging import logger
 from .metrics import ServingMetrics
@@ -56,12 +57,25 @@ class LLMServer:
                  heartbeat=None, heartbeat_interval_s: float = 2.0,
                  default_deadline_s: Optional[float] = None,
                  fused_decode_chunk: int = 0,
+                 resume_checkpoint_tokens: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.engine = engine
         self.replica_id = int(replica_id)
         self.clock = clock
         self.idle_s = float(idle_s)
         self.default_deadline_s = default_deadline_s
+        # resumable requests: every N generated tokens a response
+        # checkpoints its generation state, so a replica-loss requeue
+        # resumes from the last checkpoint (one prefill over
+        # prompt+generated) instead of replaying the whole request.
+        # 0 = requeues replay from scratch (the pre-resume behavior);
+        # None = the request-tier default.
+        from .request import DEFAULT_RESUME_CHECKPOINT_TOKENS
+
+        self.resume_checkpoint_tokens = int(
+            DEFAULT_RESUME_CHECKPOINT_TOKENS
+            if resume_checkpoint_tokens is None
+            else resume_checkpoint_tokens)
         # fused multi-token decode (engine.decode_batch — the pallas paged
         # flash-decode fast path): when > 1 and every live sequence is in
         # steady decode with nothing waiting to prefill, one engine step
@@ -124,8 +138,8 @@ class LLMServer:
             if telemetry_active():
                 register_serving_metrics(self.metrics, self.replica_id)
                 self._telemetry_registered = True
-        except Exception:  # telemetry must never block serving bring-up
-            pass
+        except Exception:
+            pass  # swallow-ok: telemetry must never block serving bring-up
 
     def _unregister_telemetry(self) -> None:
         """Drop this replica's scrape collector (idempotent): a halted or
@@ -140,7 +154,7 @@ class LLMServer:
             get_registry().unregister_collector(
                 f"serving-{int(self.replica_id)}")
         except Exception:
-            pass
+            pass  # swallow-ok: scrape-surface teardown is best-effort on a dying replica
 
     # ------------------------------------------------------------------
     @classmethod
@@ -189,7 +203,9 @@ class LLMServer:
                    replica_id=rid, heartbeat=heartbeat,
                    heartbeat_interval_s=sv.heartbeat_interval_s,
                    default_deadline_s=sv.default_deadline_s,
-                   fused_decode_chunk=getattr(sv, "fused_decode_chunk", 0))
+                   fused_decode_chunk=getattr(sv, "fused_decode_chunk", 0),
+                   resume_checkpoint_tokens=getattr(
+                       sv, "resume_checkpoint_tokens", None))
 
     # ------------------------------------------------------------------
     # client side
@@ -197,15 +213,22 @@ class LLMServer:
     def start(self) -> "LLMServer":
         # under _flags: start() is called from every submit(), and two
         # first-submits racing the None check would each spawn a _loop
-        # thread — two threads stepping one single-threaded engine
+        # thread — two threads stepping one single-threaded engine.
+        # A halted server (accepting off, NOT draining) stays down: a
+        # submit that raced past the admission check before halt() landed
+        # must not revive the engine thread the router just stopped —
+        # its stranded request is the router's (close()/_track) to fail.
         with self._flags:
-            if self._thread is None or not self._thread.is_alive():
+            revivable = self._accepting or self._draining
+            if revivable and (self._thread is None
+                              or not self._thread.is_alive()):
                 self._running = True
                 self._thread = threading.Thread(
                     target=self._loop, name=f"llm-server-{self.replica_id}",
                     daemon=True)
                 self._thread.start()
-            self._start_beater()
+            if revivable:
+                self._start_beater()
         return self
 
     def submit(self, request: Request, *, block: bool = False,
@@ -236,6 +259,7 @@ class LLMServer:
             uid = next(self._uid)
             if _response is None:
                 resp = ServedResponse(request, uid, self.clock())
+                resp.ckpt_every = self.resume_checkpoint_tokens
             else:
                 resp = _response
                 resp.uid = uid
@@ -357,6 +381,10 @@ class LLMServer:
                     self.scheduler.admit(now)
                 progressed = False
                 if self.engine.has_work():
+                    chaos = get_chaos()
+                    if chaos is not None and self._chaos_step(chaos):
+                        return      # injected replica kill: simulated
+                                    # process loss (finally stops the beat)
                     # phase-named step span: a hang dump should say whether
                     # the engine wedged packing prefill chunks or in steady
                     # decode. The prefill scan only runs while tracing.
@@ -420,6 +448,31 @@ class LLMServer:
             self._beat_stop.set()   # stopped serving = stop advertising
             self._unregister_telemetry()
 
+    def _chaos_step(self, chaos) -> bool:
+        """Serving-layer chaos consult, once per engine step (the ``at``
+        index of serving events counts steps on this replica). Returns True
+        when the replica was just killed: the loop must return — a
+        simulated process loss leaves the scheduler/engine state in place
+        (nothing finishes, nothing is failed), the beat stops via the
+        loop's finally, and the router's dead-replica takeover is the only
+        thing that can recover the in-flight work, exactly as with a real
+        process death."""
+        site = f"replica{self.replica_id}"
+        if chaos.fire("replica_kill", site):
+            logger.warning(f"chaos: killing replica {self.replica_id} at "
+                           f"serving step {self._steps}")
+            with self._flags:
+                self._accepting = False
+                self._running = False
+            return True
+        stall = chaos.value("slow_prefill", site)
+        if stall:
+            # slow/stalled prefill: the step sits still while queued work
+            # ages — deadline scheduling and the router's health view must
+            # absorb it, not misread it as death
+            time.sleep(float(stall))
+        return False
+
     def _drain_ingress(self) -> None:
         while True:
             try:
@@ -472,11 +525,7 @@ class LLMServer:
     def _finish_if_done(self, uid: int, resp, now: float) -> None:
         seq = self.engine.state_manager.get(uid)
         if seq is not None and seq.done:
-            reason = (FINISH_EOS
-                      if (resp.request.eos_token_id is not None
-                          and resp.tokens
-                          and resp.tokens[-1] == resp.request.eos_token_id)
-                      else FINISH_LENGTH)
+            reason = resp.derived_finish_reason()
             self.engine.flush(uid)
             self.scheduler.complete(uid)
             resp._on_finish(reason, now)
@@ -484,11 +533,19 @@ class LLMServer:
 
     def _deliver(self, out: Dict[int, int]) -> None:
         now = self.clock()
+        chaos = get_chaos()
         for uid, tok in out.items():
             resp = self.scheduler.inflight.get(uid)
             if resp is None:
                 continue                   # flushed by a cancel this loop
-            resp._on_token(tok, now)
+            # drop_token drill: the token lands in the response (generation
+            # state is engine truth) but its stream delivery is lost — the
+            # delivered-token cursor must re-deliver it exactly once with
+            # the next delivery (or at finish), never duplicate it
+            drop = (chaos is not None
+                    and chaos.fire("drop_token",
+                                   f"replica{self.replica_id}"))
+            resp._on_token(tok, now, deliver=not drop)
             self._finish_if_done(uid, resp, now)
 
     def _deliver_multi(self, out) -> None:
@@ -497,12 +554,16 @@ class LLMServer:
         into the response in order, sharing one wall-clock stamp — the
         latency granularity the fused path trades for dispatch overhead."""
         now = self.clock()
+        chaos = get_chaos()
         for uid, toks in (out or {}).items():
             resp = self.scheduler.inflight.get(uid)
             if resp is None:
                 continue                   # flushed by a cancel this loop
             for tok in toks:
-                resp._on_token(tok, now)
+                drop = (chaos is not None
+                        and chaos.fire("drop_token",
+                                       f"replica{self.replica_id}"))
+                resp._on_token(tok, now, deliver=not drop)
             self._finish_if_done(uid, resp, now)
 
     def _sample_gauges(self) -> None:

@@ -122,6 +122,21 @@ class FlightRecorder:
             # the collective launch stream: what the doctor aligns across
             # ranks to find the first divergent seq
             doc["collectives"] = self.collectives.snapshot()
+        try:
+            # transport-retry log (utils/retry.py): the doctor shows "this
+            # host retried the bucket 14x before the dead verdict". Lazy +
+            # ImportError-only guard: standalone file-path loads have no
+            # package context (dump proceeds without the retry trail), but
+            # any OTHER failure must surface — a silently-dropped retries
+            # key is exactly the invisible evidence loss lint rule R4
+            # exists to prevent
+            from ..utils.retry import retry_log_snapshot
+        except ImportError:
+            pass
+        else:
+            retries = retry_log_snapshot()
+            if retries:
+                doc["retries"] = retries
         if extra:
             doc.update(extra)
         os.makedirs(self.dir, exist_ok=True)

@@ -1,0 +1,681 @@
+"""Chaos engine (runtime/resilience/chaos.py) + shared retry
+(utils/retry.py) + resumable serving requests.
+
+Coverage: deterministic seeded schedules with one-shot audit; backoff /
+deadline / classification semantics of the shared retry loop and its
+observability (dstpu_retry_total, the flight-ring retry log); chaos-driven
+transport drills (object-store heartbeat PUT/GET errors, torn beacons, the
+plan-cache read, the snapshot-manifest commit); the torn-beacon
+reads-as-absent regression (satellite); control-layer health mangles
+(stale rows, flapping straggler); delivered-token dedup and
+checkpoint-resume on ServedResponse; the full replica-kill resume drill on
+real engines (prefill over prompt+generated, exactly-once streaming, the
+per-request requeue budget); and the router close() that fails — instead
+of hangs — every handle still in the assignment book.
+"""
+
+import json
+import os
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.resilience.chaos import (
+    FAULT_CLASSES, ChaosEvent, ChaosInjectedError, ChaosSchedule,
+    configure_chaos, get_chaos)
+from deepspeed_tpu.runtime.resilience.heartbeat import (
+    HealthTable, HeartbeatWriter, ObjectStoreHeartbeatTransport)
+from deepspeed_tpu.utils.retry import (RetryError, RetryPolicy, clear_retry_log,
+                                       retry_call, retry_log_snapshot)
+
+FAST = RetryPolicy(max_attempts=5, base_s=0.0, cap_s=0.0, deadline_s=None)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    clear_retry_log()
+    yield
+    configure_chaos(None)
+    clear_retry_log()
+
+
+# ---------------------------------------------------------------------------
+# retry loop
+# ---------------------------------------------------------------------------
+
+
+def test_retry_recovers_after_transients():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_call(flaky, site="t", policy=FAST,
+                      sleep=lambda s: None) == "ok"
+    assert calls["n"] == 3
+    log = retry_log_snapshot()
+    assert [e["final"] for e in log if e["site"] == "t"] == [False, False]
+
+
+def test_retry_gives_up_with_retry_error():
+    def always():
+        raise ConnectionError("down")
+
+    with pytest.raises(RetryError) as ei:
+        retry_call(always, site="t2", policy=FAST, sleep=lambda s: None)
+    assert isinstance(ei.value, OSError)       # degrades like plain I/O
+    assert ei.value.attempts == FAST.max_attempts
+    assert isinstance(ei.value.last, ConnectionError)
+    assert retry_log_snapshot()[-1]["final"] is True
+
+
+def test_retry_non_retryable_passes_through():
+    def absent():
+        raise FileNotFoundError("no such key")
+
+    with pytest.raises(FileNotFoundError):
+        retry_call(absent, site="t3", policy=FAST, sleep=lambda s: None)
+    assert retry_log_snapshot() == []          # not even one retry recorded
+
+    def typo():
+        raise TypeError("bug")
+
+    with pytest.raises(TypeError):             # not classified retryable
+        retry_call(typo, site="t3", policy=FAST, sleep=lambda s: None)
+
+
+def test_retry_deadline_budget_cuts_attempts_short():
+    t = {"now": 0.0}
+
+    def clock():
+        return t["now"]
+
+    def sleep(s):
+        t["now"] += 1.0                        # each backoff burns 1s
+
+    policy = RetryPolicy(max_attempts=50, base_s=0.5, cap_s=0.5,
+                         deadline_s=2.5)
+    tries = {"n": 0}
+
+    def always():
+        tries["n"] += 1
+        raise OSError("x")
+
+    with pytest.raises(RetryError):
+        retry_call(always, site="t4", policy=policy, sleep=sleep, clock=clock)
+    assert tries["n"] < 50                     # deadline, not attempts, won
+
+
+def test_retry_backoff_is_decorrelated_jitter_and_deterministic():
+    slept = []
+    policy = RetryPolicy(max_attempts=4, base_s=0.1, cap_s=10.0,
+                         deadline_s=None)
+
+    def always():
+        raise OSError("x")
+
+    with pytest.raises(RetryError):
+        retry_call(always, site="t5", policy=policy,
+                   sleep=slept.append, rng=random.Random(7))
+    slept2 = []
+    with pytest.raises(RetryError):
+        retry_call(always, site="t5", policy=policy,
+                   sleep=slept2.append, rng=random.Random(7))
+    assert slept == slept2 and len(slept) == 3   # same rng -> same schedule
+    prev = policy.base_s
+    for s in slept:                              # uniform(base, 3*prev), capped
+        assert policy.base_s <= s <= min(policy.cap_s, 3 * prev)
+        prev = s
+
+
+def test_retry_counter_lands_in_registry():
+    from deepspeed_tpu.telemetry.registry import get_registry
+
+    before = get_registry().counter("dstpu_retry_total").value(site="t6")
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return 1
+
+    retry_call(flaky, site="t6", policy=FAST, sleep=lambda s: None)
+    after = get_registry().counter("dstpu_retry_total").value(site="t6")
+    assert after - before == 2
+
+
+# ---------------------------------------------------------------------------
+# chaos schedule semantics
+# ---------------------------------------------------------------------------
+
+
+def test_schedule_seeded_generation_is_deterministic():
+    classes = sorted(FAULT_CLASSES)
+    a = ChaosSchedule.generate(11, classes, horizon=32)
+    b = ChaosSchedule.generate(11, classes, horizon=32)
+    c = ChaosSchedule.generate(12, classes, horizon=32)
+    assert [e.to_dict() for e in a.events] == [e.to_dict() for e in b.events]
+    assert [e.to_dict() for e in a.events] != [e.to_dict() for e in c.events]
+
+
+def test_schedule_poll_arms_once_and_fires_count_times():
+    s = ChaosSchedule([ChaosEvent(kind="transport_put_error",
+                                  site="heartbeat.put", at=2, count=2)])
+    hits = [s.fire("transport_put_error", "heartbeat.put")
+            for _ in range(6)]
+    assert hits == [False, False, True, True, False, False]
+    assert len(s.fired) == 1                   # audited ONCE, not per firing
+    assert s.fired[0]["kind"] == "transport_put_error"
+    assert s.fired[0]["layer"] == "transport"
+    assert s.classes_fired() == ["transport_put_error"]
+
+
+def test_schedule_overlapping_same_kind_events_both_arm():
+    """An event whose `at` index lands inside an earlier event's firing
+    window must still arm (the call counter never revisits an index):
+    at=1 count=2 fires calls 1-2, and the at=2 event extends the streak
+    instead of silently never arming."""
+    s = ChaosSchedule([
+        ChaosEvent(kind="transport_put_error", site="s", at=1, count=2),
+        ChaosEvent(kind="transport_put_error", site="s", at=2, count=2)])
+    hits = [s.fire("transport_put_error", "s") for _ in range(6)]
+    assert hits == [False, True, True, True, True, False]
+    assert len(s.fired) == 2                   # BOTH events audited
+
+
+def test_schedule_site_matching_and_unknown_class():
+    s = ChaosSchedule([ChaosEvent(kind="replica_kill", site="replica1", at=0)])
+    assert not s.fire("replica_kill", "replica0")  # wrong site never matches
+    assert s.fire("replica_kill", "replica1")
+    with pytest.raises(ValueError, match="unknown chaos fault class"):
+        ChaosSchedule([ChaosEvent(kind="nope", at=0)])
+    with pytest.raises(ValueError, match="unknown chaos fault class"):
+        ChaosSchedule.generate(0, ["nope"])
+
+
+def test_schedule_manifest_dump_and_maybe_raise(tmp_path):
+    s = ChaosSchedule([ChaosEvent(kind="plan_cache_error",
+                                  site="plan_cache.load", at=0)], seed=5)
+    with pytest.raises(ChaosInjectedError):
+        s.maybe_raise("plan_cache_error", "plan_cache.load")
+    path = s.dump(str(tmp_path))
+    doc = json.load(open(path))
+    assert doc["seed"] == 5
+    assert doc["events"][0]["kind"] == "plan_cache_error"
+    assert doc["fired"][0]["kind"] == "plan_cache_error"
+
+
+def test_config_install_idempotent_and_manual_preserved():
+    """Engine-init semantics: rebuilding engines from the SAME drill
+    config (autotuner probes) keeps the live schedule — counters and the
+    one-shot fired trail intact — and chaos-free engine builds clear only
+    config-installed schedules, never manually-configured ones."""
+    from deepspeed_tpu.runtime.config import DeepSpeedTPUConfig
+    from deepspeed_tpu.runtime.resilience.chaos import (
+        clear_config_chaos, install_chaos_from_config)
+
+    cfg = DeepSpeedTPUConfig.from_dict(
+        {"chaos": {"enabled": True, "seed": 3,
+                   "events": [{"kind": "drop_token", "site": "replica0",
+                               "at": 0}]}}).chaos
+    s1 = install_chaos_from_config(cfg)
+    assert s1.fire("drop_token", "replica0")
+    s2 = install_chaos_from_config(cfg)    # same config: NOT rebuilt
+    assert s2 is s1 and s1.fired           # audit trail survives
+    other = DeepSpeedTPUConfig.from_dict(
+        {"chaos": {"enabled": True, "seed": 4,
+                   "events": [{"kind": "drop_token", "site": "replica0",
+                               "at": 0}]}}).chaos
+    assert install_chaos_from_config(other) is not s1   # new drill: replace
+    clear_config_chaos()
+    assert get_chaos() is None             # config-installed: cleared
+    manual = configure_chaos(ChaosSchedule([ChaosEvent(kind="drop_token",
+                                                       at=0)]))
+    clear_config_chaos()
+    assert get_chaos() is manual           # manual: the caller owns it
+
+
+def test_chaos_off_is_inert():
+    assert get_chaos() is None                 # default: no schedule
+    from deepspeed_tpu.runtime.config import DeepSpeedTPUConfig
+
+    assert DeepSpeedTPUConfig.from_dict({}).chaos.enabled is False
+
+
+# ---------------------------------------------------------------------------
+# transport drills: object-store heartbeats
+# ---------------------------------------------------------------------------
+
+
+def _fast_transport(tmp_path):
+    return ObjectStoreHeartbeatTransport(
+        str(tmp_path), retry=RetryPolicy(max_attempts=5, base_s=0.0,
+                                         cap_s=0.0, deadline_s=None))
+
+
+def test_object_store_put_get_recover_through_retry(tmp_path):
+    configure_chaos(ChaosSchedule([
+        ChaosEvent(kind="transport_put_error", site="heartbeat.put",
+                   at=0, count=2),
+        ChaosEvent(kind="transport_get_error", site="heartbeat.get",
+                   at=0, count=2)]))
+    t = _fast_transport(tmp_path)
+    HeartbeatWriter(t, rank=0).beat(step=3, step_time_s=0.1)  # survives chaos
+    out = t.read_all()
+    assert out[0]["step"] == 3
+    sites = {e["site"] for e in retry_log_snapshot()}
+    assert {"heartbeat.put", "heartbeat.get"} <= sites
+    assert {e["kind"] for e in get_chaos().fired} == {
+        "transport_put_error", "transport_get_error"}
+
+
+def test_object_store_put_retries_exhausted_raises_oserror(tmp_path):
+    configure_chaos(ChaosSchedule([
+        ChaosEvent(kind="transport_put_error", site="heartbeat.put",
+                   at=0, count=99)]))
+    t = _fast_transport(tmp_path)
+    with pytest.raises(OSError):               # RetryError IS an OSError
+        t.write(0, {"rank": 0})
+
+
+def test_torn_beacon_reads_as_absent_not_raise(tmp_path):
+    """Satellite regression: a partially-written/garbage beacon body must
+    read as ABSENT — never raise out of a HealthTable refresh."""
+    t = _fast_transport(tmp_path)
+    HeartbeatWriter(t, rank=0).beat(step=3, step_time_s=0.1)
+    # a torn PUT observed mid-read: truncated JSON object in the bucket
+    t.client.put_object("heartbeats/hb-1.json", b'{"rank": 1, "wall_')
+    # valid JSON that is not a beacon object at all
+    t.client.put_object("heartbeats/hb-2.json", b"42")
+    # non-UTF-8 garbage
+    t.client.put_object("heartbeats/hb-3.json", b"\xff\xfe\x00garbage")
+    out = t.read_all()
+    assert set(out) == {0}
+    rows = HealthTable(t, dead_after_s=60.0).read()   # must not raise
+    assert [r.rank for r in rows] == [0]
+
+
+def test_chaos_torn_beacon_injection_reads_as_absent(tmp_path):
+    configure_chaos(ChaosSchedule([
+        ChaosEvent(kind="torn_beacon", site="heartbeat.put", at=1)]))
+    t = _fast_transport(tmp_path)
+    w = HeartbeatWriter(t, rank=0)
+    w.beat(step=1)                 # call 0: intact
+    w.beat(step=2)                 # call 1: torn mid-body (overwrites key)
+    assert 0 not in t.read_all()   # the torn body reads as ABSENT, no raise
+    w.beat(step=3)                 # the next intact beat recovers the rank
+    assert t.read_all()[0]["step"] == 3
+    assert get_chaos().classes_fired() == ["torn_beacon"]
+
+
+def test_file_transport_garbage_beacon_reads_as_absent(tmp_path):
+    from deepspeed_tpu.runtime.resilience.heartbeat import (
+        FileHeartbeatTransport)
+
+    t = FileHeartbeatTransport(str(tmp_path))
+    t.write(0, {"rank": 0, "wall_time": 1.0})
+    with open(os.path.join(str(tmp_path), "hb-1.json"), "w") as f:
+        f.write("7")                           # valid JSON, not a beacon
+    assert set(t.read_all()) == {0}
+
+
+# ---------------------------------------------------------------------------
+# control drills: stale health rows, flapping straggler
+# ---------------------------------------------------------------------------
+
+
+def _beacon_fleet(tmp_path, now):
+    t = ObjectStoreHeartbeatTransport(str(tmp_path))
+    for r, st in ((0, 0.1), (1, 0.1), (2, 0.1)):
+        HeartbeatWriter(t, r, clock=lambda: now).beat(step=5, step_time_s=st)
+    return t
+
+
+def test_stale_health_returns_previous_rows(tmp_path):
+    now = 1000.0
+    t = _beacon_fleet(tmp_path, now)
+    table = HealthTable(t, dead_after_s=60.0, clock=lambda: now)
+    configure_chaos(ChaosSchedule([
+        ChaosEvent(kind="stale_health", site="health.read", at=1)]))
+    first = table.read()
+    assert all(r.alive for r in first)
+    # rank 2 stops beating; the NEXT read is chaos-stale and must still
+    # show the old (alive) view; the one after sees the truth
+    now2 = now + 120.0
+    table.clock = lambda: now2
+    stale = table.read()
+    assert all(r.alive for r in stale)         # the injected stale view
+    fresh = table.read()
+    assert not any(r.alive for r in fresh if r.age_s > 60.0) or True
+    assert [r.alive for r in fresh] == [False, False, False]
+    assert get_chaos().classes_fired() == ["stale_health"]
+
+
+def test_flap_straggler_flips_on_alternate_reads(tmp_path):
+    now = 1000.0
+    t = _beacon_fleet(tmp_path, now)
+    table = HealthTable(t, dead_after_s=60.0, straggler_factor=3.0,
+                        clock=lambda: now)
+    configure_chaos(ChaosSchedule([
+        ChaosEvent(kind="flap_straggler", site="health.read", at=0,
+                   count=4, param=1.0)]))
+    verdicts = [any(r.straggler and r.rank == 1 for r in table.read())
+                for _ in range(5)]
+    assert verdicts == [True, False, True, False, False]  # flap, then quiet
+
+
+# ---------------------------------------------------------------------------
+# transport drills: plan cache + snapshot commit
+# ---------------------------------------------------------------------------
+
+
+def _fp(dp):
+    from deepspeed_tpu.comm.planner.topo import MeshFingerprint
+
+    return MeshFingerprint(platform="cpu", device_kind="cpu", n_devices=dp,
+                           n_processes=1, axis_sizes=(("dp", dp),),
+                           dcn_axes=())
+
+
+def test_plan_cache_read_retries_through_chaos(tmp_path, monkeypatch):
+    from deepspeed_tpu.comm.planner import cache as cache_mod
+    from deepspeed_tpu.comm.planner.cache import PlanCache
+    from deepspeed_tpu.comm.planner.ir import Plan, PlanDecision
+    from deepspeed_tpu.comm.planner.topo import MeshFingerprint
+
+    monkeypatch.setattr(
+        cache_mod, "_READ_RETRY",
+        RetryPolicy(max_attempts=4, base_s=0.0, cap_s=0.0, deadline_s=None))
+    fp = _fp(8)
+    pc = PlanCache(str(tmp_path))
+    plan = Plan(fingerprint=fp.digest())
+    plan.decisions["site"] = PlanDecision(impl="xla", est_us=1.0)
+    pc.store(fp, plan)
+    configure_chaos(ChaosSchedule([
+        ChaosEvent(kind="plan_cache_error", site="plan_cache.load",
+                   at=0, count=2)]))
+    loaded = pc.load(fp)                       # retries absorb the chaos
+    assert loaded is not None and "site" in loaded.decisions
+    assert any(e["site"] == "plan_cache.load" for e in retry_log_snapshot())
+    # a MISSING file is an immediate miss — no retry storm on the hot path
+    clear_retry_log()
+    assert pc.load(_fp(4)) is None
+    assert retry_log_snapshot() == []
+
+
+def test_plan_cache_read_exhausted_degrades_to_miss(tmp_path, monkeypatch):
+    from deepspeed_tpu.comm.planner import cache as cache_mod
+    from deepspeed_tpu.comm.planner.cache import PlanCache
+    from deepspeed_tpu.comm.planner.ir import Plan
+    from deepspeed_tpu.comm.planner.topo import MeshFingerprint
+
+    monkeypatch.setattr(
+        cache_mod, "_READ_RETRY",
+        RetryPolicy(max_attempts=2, base_s=0.0, cap_s=0.0, deadline_s=None))
+    fp = _fp(8)
+    pc = PlanCache(str(tmp_path))
+    pc.store(fp, Plan(fingerprint=fp.digest()))
+    configure_chaos(ChaosSchedule([
+        ChaosEvent(kind="plan_cache_error", site="plan_cache.load",
+                   at=0, count=99)]))
+    assert pc.load(fp) is None                 # a miss, never an exception
+
+
+def test_snapshot_commit_retries_through_chaos(tmp_path, monkeypatch):
+    from deepspeed_tpu.runtime.resilience import snapshot as snap_mod
+    from deepspeed_tpu.runtime.resilience.snapshot import SnapshotManager
+
+    monkeypatch.setattr(
+        snap_mod, "_COMMIT_RETRY",
+        RetryPolicy(max_attempts=4, base_s=0.0, cap_s=0.0, deadline_s=None))
+    configure_chaos(ChaosSchedule([
+        ChaosEvent(kind="snapshot_io_error", site="snapshot.commit",
+                   at=0, count=2)]))
+    sm = SnapshotManager(str(tmp_path), use_async=False)
+    tree = {"a": jnp.arange(8, dtype=jnp.float32)}
+    tag = sm.snapshot(tree, step=1)
+    assert tag == "step_1"
+    entry = sm.latest_valid()
+    assert entry is not None and entry["tag"] == "step_1"
+    assert any(e["site"] == "snapshot.commit" for e in retry_log_snapshot())
+
+
+# ---------------------------------------------------------------------------
+# resumable responses: checkpoints + delivered-token dedup (unit level)
+# ---------------------------------------------------------------------------
+
+
+def _resp(uid=0, plen=4, mnt=16, ckpt=4, stream=None, max_restarts=3):
+    from deepspeed_tpu.serving import Request, ServedResponse
+
+    req = Request(np.arange(1, plen + 1, dtype=np.int32),
+                  max_new_tokens=mnt, stream=stream,
+                  max_restarts=max_restarts)
+    r = ServedResponse(req, uid, 0.0)
+    r.ckpt_every = ckpt
+    return r
+
+
+def test_response_checkpoint_and_resume_views():
+    r = _resp(plen=3, mnt=10, ckpt=4)
+    for i, tok in enumerate(range(100, 106)):   # 6 tokens; ckpt at 4
+        r._on_token(tok, float(i))
+    assert r._ckpt_len == 4
+    r._on_requeue(resume=True)
+    assert r.tokens == [100, 101, 102, 103]     # truncated to checkpoint
+    assert r.first_token_time is not None       # the client saw tokens
+    np.testing.assert_array_equal(r.engine_prompt(),
+                                  [1, 2, 3, 100, 101, 102, 103])
+    assert r.remaining_new_tokens() == 6
+    # without a checkpoint the replay is from scratch (legacy behavior)
+    r2 = _resp(ckpt=0)
+    r2._on_token(5, 0.0)
+    r2._on_requeue(resume=True)
+    assert r2.tokens == [] and r2.first_token_time is None
+    np.testing.assert_array_equal(r2.engine_prompt(), r2.request.prompt)
+
+
+def test_dropped_delivery_redelivers_exactly_once():
+    got = []
+    r = _resp(stream=lambda tok, resp: got.append(tok))
+    r._on_token(7, 0.0, deliver=False)          # chaos drop
+    assert got == []
+    r._on_token(8, 1.0)                         # next delivery flushes both
+    assert got == [7, 8]
+    r._on_token(9, 2.0, deliver=False)
+    r._on_finish("length", 3.0)                 # finish lands the tail
+    assert got == [7, 8, 9]
+
+
+def test_resume_never_duplicates_stream_delivery():
+    got = []
+    r = _resp(plen=2, mnt=12, ckpt=4, stream=lambda tok, resp: got.append(tok))
+    for i in range(6):                          # ckpt at 4, delivered 6
+        r._on_token(50 + i, float(i))
+    assert got == [50, 51, 52, 53, 54, 55]
+    r._on_requeue(resume=True)                  # back to 4 tokens
+    # deterministic re-generation re-appends the same two tokens, then new
+    for tok in (54, 55, 56):
+        r._on_token(tok, 9.0)
+    assert got == [50, 51, 52, 53, 54, 55, 56]  # 54/55 NOT re-delivered
+
+
+# ---------------------------------------------------------------------------
+# the real drill: replica killed mid-generation resumes on a survivor
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM)
+
+    cfg = TransformerConfig(vocab_size=97, hidden_size=48,
+                            intermediate_size=96, num_layers=2, num_heads=4,
+                            num_kv_heads=2, max_seq_len=256,
+                            dtype=jnp.float32, norm="rmsnorm",
+                            activation="swiglu")
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _engine(tiny_model, **over):
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+
+    model, params = tiny_model
+    kw = dict(token_budget=32, max_ragged_sequence_count=4, max_chunk_size=16,
+              num_kv_blocks=96, kv_block_size=8, max_blocks_per_seq=16,
+              dtype="float32")
+    kw.update(over)
+    return InferenceEngineV2(model, params, RaggedInferenceEngineConfig(**kw))
+
+
+def test_replica_kill_resumes_from_checkpoint(tiny_model, tmp_path):
+    """The acceptance drill: chaos kills replica 0 mid-generation; the
+    router requeues onto replica 1, which resumes from the last
+    checkpointed token via ONE prefill over prompt+generated. The final
+    tokens match a fault-free generation bitwise (greedy decode), and the
+    stream callbacks stay exactly-once."""
+    from deepspeed_tpu.runtime.resilience.heartbeat import (
+        FileHeartbeatTransport)
+    from deepspeed_tpu.serving import (FINISH_LENGTH, LLMServer,
+                                       ReplicaRouter, Request)
+
+    prompt = np.arange(1, 11, dtype=np.int32)
+    mnt = 48
+    # fault-free reference: greedy decode is deterministic, so the resumed
+    # generation must reproduce it exactly
+    ref = _engine(tiny_model).generate([prompt], max_new_tokens=mnt)[0]
+
+    configure_chaos(ChaosSchedule([
+        ChaosEvent(kind="replica_kill", site="replica0", at=25)]))
+    e0, e1 = _engine(tiny_model), _engine(tiny_model)
+    r0 = LLMServer(e0, replica_id=0, heartbeat_interval_s=0.02,
+                   resume_checkpoint_tokens=8)
+    r1 = LLMServer(e1, replica_id=1, heartbeat_interval_s=0.02,
+                   resume_checkpoint_tokens=8)
+    transport = FileHeartbeatTransport(str(tmp_path))
+    router = ReplicaRouter([r0, r1], transport=transport,
+                           dead_after_s=0.4).start()
+    streams = {}
+
+    def make_stream(key):
+        streams[key] = []
+        return lambda tok, resp: streams[key].append(tok)
+
+    resps = [router.submit(Request(prompt, max_new_tokens=mnt,
+                                   stream=make_stream(i)), block=True)
+             for i in range(4)]
+    victims_exist = time.monotonic() + 60
+    while not get_chaos().fired and time.monotonic() < victims_exist:
+        time.sleep(0.02)                       # wait for the kill to land
+    assert get_chaos().classes_fired() == ["replica_kill"]
+    victims = [r for r in resps if r.replica_id == 0 and not r.done]
+    assert victims, "replica 0 finished everything before the kill"
+    deadline = time.monotonic() + 60
+    while router.check() == [] and time.monotonic() < deadline:
+        time.sleep(0.05)                       # beacon must go stale first
+    for i, r in enumerate(resps):
+        assert r.wait(300), f"request {i} lost after the chaos kill"
+        assert r.finish_reason == FINISH_LENGTH
+        np.testing.assert_array_equal(r.result(), ref)   # bitwise resume
+        assert streams[i] == list(ref)         # exactly-once, in order
+    for v in victims:
+        assert v.requeues == 1 and v.replica_id == 1
+        assert v._ckpt_len > 0                 # it resumed, not replayed
+    assert router.drain(timeout=300)
+
+
+def test_requeue_budget_turns_nth_requeue_into_failed(tiny_model, tmp_path):
+    """A request whose budget is exhausted must FAIL on the next replica
+    loss instead of bouncing forever."""
+    from deepspeed_tpu.runtime.resilience.heartbeat import (
+        FileHeartbeatTransport)
+    from deepspeed_tpu.serving import (FINISH_FAILED, LLMServer,
+                                       ReplicaRouter, Request)
+
+    configure_chaos(ChaosSchedule([
+        ChaosEvent(kind="replica_kill", site="replica0", at=3)]))
+    r0 = LLMServer(_engine(tiny_model), replica_id=0,
+                   heartbeat_interval_s=0.02)
+    r1 = LLMServer(_engine(tiny_model), replica_id=1,
+                   heartbeat_interval_s=0.02)
+    router = ReplicaRouter(
+        [r0, r1], transport=FileHeartbeatTransport(str(tmp_path)),
+        dead_after_s=0.4).start()
+    # budget 0: the FIRST replica-loss requeue already exceeds it
+    resps = [router.submit(Request(np.arange(1, 9, dtype=np.int32),
+                                   max_new_tokens=64, max_restarts=0),
+                           block=True)
+             for _ in range(4)]
+    deadline = time.monotonic() + 60
+    while router.check() == [] and time.monotonic() < deadline:
+        time.sleep(0.05)
+    victims = [r for r in resps if r.done and r.finish_reason == FINISH_FAILED]
+    assert victims, "no request hit the requeue budget"
+    for v in victims:
+        assert v.requeues == 1                 # counted, then failed
+        with pytest.raises(RuntimeError):
+            v.result(0)
+    for r in resps:
+        assert r.wait(300)                     # nothing hangs either way
+    assert router.drain(timeout=300)
+
+
+def test_router_close_fails_book_instead_of_hanging(tiny_model):
+    """Satellite: wait(timeout=None) must not hang forever when the router
+    shuts down with the assignment book non-empty — close() fails every
+    unfinished tracked handle."""
+    from deepspeed_tpu.serving import (FINISH_FAILED, LLMServer,
+                                       ReplicaRouter, Request)
+
+    r0 = LLMServer(_engine(tiny_model), replica_id=0)
+    router = ReplicaRouter([r0]).start()
+    resps = [router.submit(Request(np.arange(1, 9, dtype=np.int32),
+                                   max_new_tokens=2048), block=True)
+             for _ in range(3)]
+    assert router.outstanding > 0              # the book is non-empty
+    router.close()
+    for r in resps:
+        assert r.wait(30), "handle still hanging after router.close()"
+        assert r.done
+    assert any(r.finish_reason == FINISH_FAILED for r in resps)
+    assert router.outstanding == 0
+
+
+# ---------------------------------------------------------------------------
+# serving-layer chaos: kv exhaustion + slow prefill + dropped delivery
+# ---------------------------------------------------------------------------
+
+
+def test_kv_exhaustion_and_drop_token_drills(tiny_model):
+    from deepspeed_tpu.serving import FINISH_LENGTH, LLMServer, Request
+
+    configure_chaos(ChaosSchedule([
+        ChaosEvent(kind="kv_exhaustion", site="scheduler.admit",
+                   at=0, count=3),
+        ChaosEvent(kind="slow_prefill", site="replica0", at=1, param=0.02),
+        ChaosEvent(kind="drop_token", site="replica0", at=5, count=2)]))
+    got = []
+    server = LLMServer(_engine(tiny_model), replica_id=0).start()
+    resp = server.submit(Request(np.arange(1, 9, dtype=np.int32),
+                                 max_new_tokens=24,
+                                 stream=lambda tok, r: got.append(tok)),
+                         block=True)
+    assert resp.wait(300) and resp.finish_reason == FINISH_LENGTH
+    assert len(resp.tokens) == 24
+    assert got == resp.tokens                  # dedup: exactly-once, in order
+    fired = get_chaos().classes_fired()
+    assert "kv_exhaustion" in fired and "drop_token" in fired
+    assert server.drain(timeout=300)

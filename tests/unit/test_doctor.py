@@ -619,3 +619,114 @@ def test_engine_flightdump_carries_stream_and_rank_override(tmp_path,
     assert all(c["detail"] == "step-end" and c.get("eager")
                for c in barriers)
     e.telemetry.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos drills (ISSUE 15 satellite): the doctor must name every injected
+# fault from chaos-generated dump sets — verdict AND evidence line per class
+# ---------------------------------------------------------------------------
+
+
+def _chaos_dump_set(d, kind):
+    """Build the artifact set a real drill of ``kind`` leaves behind, plus
+    the chaos manifest, and return the expected (verdict, evidence
+    substring) the doctor must produce."""
+    from deepspeed_tpu.runtime.resilience.chaos import (ChaosEvent,
+                                                        ChaosSchedule)
+
+    sites = {"transport_put_error": "heartbeat.put",
+             "transport_get_error": "heartbeat.get",
+             "torn_beacon": "heartbeat.put",
+             "plan_cache_error": "plan_cache.load",
+             "snapshot_io_error": "snapshot.commit",
+             "replica_kill": "replica0",
+             "kv_exhaustion": "scheduler.admit",
+             "slow_prefill": "replica0",
+             "drop_token": "replica0",
+             "stale_health": "health.read",
+             "flap_straggler": "health.read"}
+    site = sites[kind]
+    schedule = ChaosSchedule([ChaosEvent(kind=kind, site=site, at=1)])
+    assert schedule.fire(kind, site) is False and schedule.fire(kind, site)
+    schedule.dump(d)
+    # corroborating artifacts per layer: a dead replica 0 for the kill, a
+    # flapping straggler for the control classes, retry logs for transport
+    if kind == "replica_kill":
+        for r in range(2):
+            _write_dump(d, r, list(_BASE), reason="preempt_drain", phase=None)
+        _write_beacon(d, 0, 800.0)            # killed replica: stale beacon
+        _write_beacon(d, 1, 1000.0)
+        return "dead_host", f"chaos drill injected {kind}"
+    if kind == "flap_straggler":
+        for r in range(3):
+            _write_dump(d, r, list(_BASE), reason="preempt_drain", phase=None)
+            _write_beacon(d, r, 1000.0, step_time=1.0 if r == 0 else 0.1)
+        return "straggler", f"chaos drill injected {kind}"
+    if kind in ("transport_put_error", "transport_get_error",
+                "plan_cache_error", "snapshot_io_error"):
+        retries = [{"site": site, "attempt": a, "error": "OSError('x')",
+                    "final": False, "wall_time": 999.0 + a}
+                   for a in (1, 2)]
+        _write_dump(d, 0, list(_BASE), reason="preempt_drain", phase=None,
+                    extra={"retries": retries})
+        _write_dump(d, 1, list(_BASE), reason="preempt_drain", phase=None)
+        return "preempt", f"rank 0 retried {site} 2x"
+    for r in range(2):
+        _write_dump(d, r, list(_BASE), reason="preempt_drain", phase=None)
+        _write_beacon(d, r, 1000.0)
+    return "preempt", f"chaos drill injected {kind}"
+
+
+from deepspeed_tpu.runtime.resilience.chaos import FAULT_CLASSES
+
+
+@pytest.mark.parametrize("kind", sorted(FAULT_CLASSES))
+def test_doctor_names_every_injected_fault_class(tmp_path, kind):
+    d = str(tmp_path)
+    verdict, needle = _chaos_dump_set(d, kind)
+    rep = doctor.diagnose(d)
+    assert rep["verdict"] == verdict
+    assert rep["chaos"] is not None
+    assert [e["kind"] for e in rep["chaos"]["fired"]] == [kind]
+    assert any(needle in ev for ev in rep["evidence"]), rep["evidence"]
+    # every fired fault class is named somewhere in the evidence
+    assert any(f"chaos drill injected {kind}" in ev
+               for ev in rep["evidence"])
+    text = doctor.render_report(rep)
+    assert "chaos schedule" in text and kind in text
+
+
+def test_doctor_cli_renders_chaos_and_retries(tmp_path, capsys):
+    """The CLI form of the drill: `python -m deepspeed_tpu.doctor` over a
+    chaos dump set prints the chaos summary and the retry trail."""
+    from deepspeed_tpu.doctor.__main__ import main as doctor_main
+
+    d = str(tmp_path)
+    _chaos_dump_set(d, "transport_put_error")
+    rc = doctor_main([d, "--no-report"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "chaos schedule" in out and "transport_put_error" in out
+    assert "retried heartbeat.put" in out
+
+
+def test_doctor_retry_storm_evidence_rides_dead_verdict(tmp_path):
+    """'host X retried the bucket 14x before the dead verdict' — the retry
+    trail must surface WITH the dead-host classification, pointing the
+    post-mortem at the store rather than the host."""
+    d = str(tmp_path)
+    retries = [{"site": "heartbeat.put", "attempt": a,
+                "error": "ChaosInjectedError('chaos[transport_put_error]')",
+                "final": a == 14, "wall_time": 900.0 + a}
+               for a in range(1, 15)]
+    _write_dump(d, 0, list(_BASE), reason="preempt_drain", phase=None,
+                extra={"retries": retries})
+    _write_dump(d, 1, list(_BASE), reason="preempt_drain", phase=None)
+    _write_beacon(d, 0, 800.0)                 # rank 0 then went dead
+    _write_beacon(d, 1, 1000.0)
+    rep = doctor.diagnose(d)
+    assert rep["verdict"] == "dead_host"
+    assert rep["ranks"]["0"]["retries"]["heartbeat.put"]["count"] == 14
+    assert rep["ranks"]["0"]["retries"]["heartbeat.put"]["gave_up"] == 1
+    assert any("rank 0 retried heartbeat.put 14x" in e
+               for e in rep["evidence"])

@@ -95,6 +95,75 @@ def test_none_default_clean():
     assert lint_source(src, "utils/thing.py") == []
 
 
+def test_swallow_in_scoped_dir_flagged():
+    src = ("def f():\n"
+           "    try:\n"
+           "        g()\n"
+           "    except Exception:\n"
+           "        pass\n")
+    for rel in ("serving/replica.py", "runtime/resilience/heartbeat.py",
+                "control/policy.py"):
+        fs = lint_source(src, rel)
+        assert any(f.rule == "swallow" for f in fs), rel
+
+
+def test_swallow_bare_except_flagged():
+    src = "def f():\n    try:\n        g()\n    except:\n        pass\n"
+    fs = lint_source(src, "serving/server.py")
+    assert any(f.rule == "swallow" for f in fs)
+
+
+def test_swallow_annotation_blesses():
+    src = ("def f():\n"
+           "    try:\n"
+           "        g()\n"
+           "    except Exception:\n"
+           "        pass  # swallow-ok: test fixture\n")
+    assert lint_source(src, "serving/server.py") == []
+
+
+def test_swallow_comment_after_pass_does_not_bless():
+    # a marker comment documenting the NEXT statement must not bless the
+    # unannotated swallow above it
+    src = ("def f():\n"
+           "    try:\n"
+           "        g()\n"
+           "    except Exception:\n"
+           "        pass\n"
+           "    # swallow-ok: this documents h(), not the swallow above\n"
+           "    h()\n")
+    fs = lint_source(src, "serving/server.py")
+    assert any(f.rule == "swallow" for f in fs)
+
+
+def test_swallow_outside_scope_not_flagged():
+    src = ("def f():\n"
+           "    try:\n"
+           "        g()\n"
+           "    except Exception:\n"
+           "        pass\n")
+    assert lint_source(src, "checkpoint/engine.py") == []
+
+
+def test_swallow_handled_exception_not_flagged():
+    # a handler that DOES something (log, re-raise, fallback) is fine
+    src = ("def f():\n"
+           "    try:\n"
+           "        g()\n"
+           "    except Exception as e:\n"
+           "        print(e)\n")
+    assert lint_source(src, "serving/server.py") == []
+
+
+def test_swallow_narrow_exception_not_flagged():
+    src = ("def f():\n"
+           "    try:\n"
+           "        g()\n"
+           "    except KeyError:\n"
+           "        pass\n")
+    assert lint_source(src, "serving/server.py") == []
+
+
 def test_finding_renders_path_and_rule():
     f = LintFinding("host-sync", "runtime/engine.py", 12, "msg")
     assert "runtime/engine.py:12" in str(f) and "host-sync" in str(f)
