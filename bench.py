@@ -1183,6 +1183,156 @@ def serving_bench():
             "device": getattr(dev, "device_kind", dev.platform)}
 
 
+def serving_prefix_reuse_bench():
+    """Rung sv2 (prefix KV reuse + speculative decoding, ISSUE 16): the
+    SAME seeded prefix-heavy open-loop trace (Zipf-reused system prompts +
+    unique suffixes) served twice — a baseline arm with the prefix cache
+    and spec decode off, and a reuse arm with ``enable_prefix_cache=True``
+    + n-gram spec decode — and the value is the tokens/s-per-chip speedup
+    of the reuse arm over the baseline. Both arms must produce BITWISE
+    identical greedy tokens per request_id (the tentpole's correctness
+    invariant: content-addressed reuse and draft-verify change only the
+    schedule, never the math), and the rung asserts it before reporting.
+    A third pass re-serves the trace with the reuse arm under a seeded
+    chaos schedule (kv_exhaustion at admission, slow_prefill + drop_token
+    on the replica) and must complete every request with the same bitwise
+    output — zero lost requests, per the PR 15 soak convention."""
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models.transformer import (TransformerLM, init_params,
+                                                  llama_config)
+    from deepspeed_tpu.runtime.resilience import (ChaosEvent, ChaosSchedule,
+                                                  configure_chaos)
+    from deepspeed_tpu.serving import (LengthDist, LLMServer, OpenLoopTraffic,
+                                       TrafficConfig)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = llama_config("7b", num_layers=12, hidden_size=1536,
+                           intermediate_size=4096, num_heads=12,
+                           num_kv_heads=4, vocab_size=32000, max_seq_len=4096,
+                           dtype=jnp.bfloat16)
+        eng_over = dict(token_budget=512, max_ragged_sequence_count=16,
+                        max_chunk_size=256, num_kv_blocks=640,
+                        kv_block_size=128, max_blocks_per_seq=16,
+                        dtype="bfloat16")
+        traffic = TrafficConfig(rate_rps=64.0, num_requests=48, seed=11,
+                                vocab_size=cfg.vocab_size,
+                                prompt_len=LengthDist("uniform", 16, 48),
+                                output_len=LengthDist("uniform", 16, 32),
+                                system_prompt_pool=4, system_prompt_len=1024)
+    else:
+        cfg = llama_config("7b", num_layers=2, hidden_size=128,
+                           intermediate_size=256, num_heads=4, num_kv_heads=2,
+                           vocab_size=1024, max_seq_len=512,
+                           dtype=jnp.float32)
+        eng_over = dict(token_budget=64, max_ragged_sequence_count=8,
+                        max_chunk_size=16, num_kv_blocks=512, kv_block_size=8,
+                        max_blocks_per_seq=48, dtype="float32")
+        # saturating rate: every request queues immediately, so the wall
+        # clock measures service time (prefill work the cache deletes),
+        # not open-loop idle gaps
+        traffic = TrafficConfig(rate_rps=500.0, num_requests=24, seed=11,
+                                vocab_size=cfg.vocab_size,
+                                prompt_len=LengthDist("uniform", 4, 12),
+                                output_len=LengthDist("uniform", 4, 8),
+                                system_prompt_pool=3, system_prompt_len=320)
+
+    model = TransformerLM(cfg)
+    params = init_params(model, batch=1, seq=64)
+    fused_chunk = 8
+    n_chips = len(jax.devices())
+
+    def run_arm(reuse_on: bool):
+        eng_cfg = RaggedInferenceEngineConfig(
+            **eng_over, enable_prefix_cache=reuse_on,
+            spec_decode_k=4 if reuse_on else 0)
+        engine = InferenceEngineV2(model, params, eng_cfg)
+        # warm the compile caches OFF the clock: packed step, fused-decode
+        # chunk, and (reuse arm) the spec verify widths a repetitive prompt
+        # actually drafts through — compiles must not bias either arm
+        warm = np.tile(np.arange(1, 9, dtype=np.int32), 3)
+        engine.generate([warm[:8]], max_new_tokens=4)
+        engine.put([10**9], [warm], max_new_tokens=24)
+        while any(s.in_prefill for s in engine.state_manager.all()):
+            engine.step()
+        for _ in range(6):
+            if reuse_on:
+                engine.spec_decode_batch()
+            else:
+                engine.decode_batch(fused_chunk)
+        engine.flush(10**9)
+        server = LLMServer(engine, policy="fcfs", max_queue=512,
+                           fused_decode_chunk=fused_chunk).start()
+        t0 = time.perf_counter()
+        resps, rejected = OpenLoopTraffic(traffic).run(
+            lambda req: server.submit(req))
+        drained = server.drain(timeout=1800)
+        wall = time.perf_counter() - t0
+        snap = server.metrics.snapshot()
+        outs = {r.request.request_id: np.asarray(r.result(timeout=5))
+                for r in resps}
+        assert not rejected and drained, \
+            f"sv2 arm reuse={reuse_on}: rejected={len(rejected)} " \
+            f"drained={drained}"
+        tps = server.metrics.tokens_out / wall / n_chips
+        return tps, snap, outs, wall
+
+    tps_off, snap_off, outs_off, wall_off = run_arm(False)
+    tps_on, snap_on, outs_on, wall_on = run_arm(True)
+    # the tentpole invariant: reuse + draft-verify are schedule-only
+    for rid, toks in outs_off.items():
+        assert np.array_equal(toks, outs_on[rid]), \
+            f"sv2: greedy divergence on {rid}"
+
+    # chaos-soaked pass (PR 15 convention): same trace, reuse arm, seeded
+    # serving faults — every request must still complete bitwise identical
+    import random as _random
+    rng = _random.Random(17)
+    configure_chaos(None)
+    try:
+        configure_chaos(ChaosSchedule([
+            ChaosEvent("kv_exhaustion", "scheduler.admit",
+                       at=rng.randrange(2, 5), count=3),
+            ChaosEvent("slow_prefill", "replica0",
+                       at=rng.randrange(1, 4), param=0.01),
+            ChaosEvent("drop_token", "replica0",
+                       at=rng.randrange(8, 14), count=2),
+        ], seed=17))
+        _, snap_cz, outs_cz, _ = run_arm(True)
+        lost = [rid for rid in outs_off if rid not in outs_cz
+                or not np.array_equal(outs_off[rid], outs_cz[rid])]
+        assert not lost, f"sv2 chaos pass lost/diverged: {lost}"
+    finally:
+        configure_chaos(None)
+
+    return {"metric": "serving_prefix_reuse_speedup",
+            "value": round(tps_on / tps_off, 3), "unit": "x",
+            "vs_baseline": None,
+            "tokens_per_sec_per_chip_reuse": round(tps_on, 1),
+            "tokens_per_sec_per_chip_baseline": round(tps_off, 1),
+            "ttft_p99_ms_reuse": snap_on["ttft"]["p99_ms"],
+            "ttft_p99_ms_baseline": snap_off["ttft"]["p99_ms"],
+            "e2e_p99_ms_reuse": snap_on["e2e"]["p99_ms"],
+            "e2e_p99_ms_baseline": snap_off["e2e"]["p99_ms"],
+            "prefix_hit_rate": snap_on["prefix_hit_rate"],
+            "prefix_tokens_reused": snap_on["prefix_tokens_reused"],
+            "prefix_blocks_shared": snap_on["prefix_blocks_shared"],
+            "cow_forks": snap_on["cow_forks"],
+            "spec_acceptance_rate": snap_on["spec_acceptance_rate"],
+            "spec_steps": snap_on["spec_steps"],
+            "greedy_parity": True,
+            "chaos_completed": snap_cz["completed"],
+            "chaos_lost": 0,
+            "wall_s_reuse": round(wall_on, 3),
+            "wall_s_baseline": round(wall_off, 3),
+            "num_requests": traffic.num_requests, "seed": traffic.seed,
+            "system_prompt_pool": traffic.system_prompt_pool,
+            "system_prompt_len": traffic.system_prompt_len,
+            "device": getattr(dev, "device_kind", dev.platform)}
+
+
 def paged_decode_bench():
     """Rung pd (paged decode fastpath, ops/pallas/paged_attention.py
     paged_flash_decode): fused multi-token decode step time, the
@@ -2124,7 +2274,8 @@ RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "cm": collective_matmul_bench, "qx": quantized_collectives_bench,
          "plan": planner_bench, "rz": resilience_bench,
          "wd": watchdog_bench, "fl": fused_hotpath_bench,
-         "sv": serving_bench, "pd": paged_decode_bench,
+         "sv": serving_bench, "sv2": serving_prefix_reuse_bench,
+         "pd": paged_decode_bench,
          "ds": dcn_hierarchical_bench, "t3": fused_phase_bench,
          "ob": telemetry_bench, "mem": memory_telemetry_bench,
          "sa": static_audit_bench, "at": control_bench,
@@ -2155,6 +2306,9 @@ GATE_SPECS = {
     "fused_exposed_fraction": ("lower", 0.05),   # ledger bytes: deterministic
     "llama_zero3_bf16_mfu": ("higher", 0.15),    # the TPU headline: tight
     "paged_decode_step_ms": ("lower", 1.0),      # decode hot path: wall-clock
+    # reuse-arm/baseline-arm ratio: both arms share the box so load noise
+    # largely cancels, but the arms are wall-clock — keep the default slack
+    "serving_prefix_reuse_speedup": ("higher", 0.5),
     "chaos_soak_fault_classes": ("higher", 0.05),  # seeded count: deterministic
 }
 
@@ -2283,6 +2437,9 @@ def run_ladder(gate: bool = False):
             ("qx", {} if multichip else cpu8),
             ("plan", {} if multichip else cpu8),
             ("rz", chip), ("wd", cpu1), ("fl", chip), ("sv", chip),
+            # sv2 serves the same prefix-heavy trace with the prefix cache
+            # + spec decode off then on; the row is the speedup ratio
+            ("sv2", chip),
             # pd compares the paged decode kernel against the einsum
             # reference (interpret-mode pallas on CPU; real kernel on TPU)
             ("pd", chip),

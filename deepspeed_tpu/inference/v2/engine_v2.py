@@ -18,8 +18,9 @@ import numpy as np
 
 from ...models.transformer import TransformerConfig, TransformerLM
 from ...utils.logging import log_dist
-from .model import decode_loop, ragged_step
+from .model import decode_loop, ragged_step, verify_step
 from .ragged.kv_cache import BlockedKVCache
+from .ragged.prefix_index import ROOT_HASH, chain_hashes, hash_block
 from .ragged.ragged_manager import DSStateManager
 from .ragged.ragged_wrapper import RaggedBatch, RaggedBatchWrapper
 
@@ -64,6 +65,34 @@ class RaggedInferenceEngineConfig:
     # attention each step, so an unbounded n would grow HBM and O(n^2) work;
     # longer runs are chunked into windows of this size
     max_fused_window: int = 512
+    # content-addressed prefix KV reuse (ragged/prefix_index.py): admission
+    # matches the longest chain of registered full blocks over the prompt,
+    # maps those pages shared (refcounted, COW on the one partial-tail
+    # write), and prefills only the uncached tail. Off = bit-identical to
+    # the pre-cache engine (no hashing, no refcount divergence).
+    enable_prefix_cache: bool = False
+    # n-gram speculative decoding (spec_decode_batch): draft up to k tokens
+    # per live sequence from the most recent prior occurrence of the last
+    # spec_ngram tokens in prompt+generated, verify all drafts in ONE
+    # packed dispatch, commit the accepted prefix + the model's correction.
+    # Greedy-only (the acceptance rule compares argmax streams, so the
+    # committed tokens are bitwise the sequential greedy output). 0 = off.
+    spec_decode_k: int = 0
+    spec_ngram: int = 2
+
+
+@dataclass
+class ReuseStats:
+    """Cumulative prefix-cache / speculative-decode counters (the serving
+    tier samples these into ServingMetrics gauges)."""
+    prefix_lookups: int = 0          # put() admissions that consulted the index
+    prefix_hits: int = 0             # admissions that mapped >= 1 cached block
+    prefix_tokens_reused: int = 0    # prompt tokens never re-prefilled
+    prefix_blocks_shared: int = 0    # pages mapped shared (blocks saved)
+    cow_forks: int = 0               # shared blocks copy-on-write-forked
+    spec_steps: int = 0              # verify dispatches
+    spec_drafted: int = 0            # draft tokens proposed
+    spec_accepted: int = 0           # draft tokens accepted
 
 
 _DECODE_WARNED = set()
@@ -99,8 +128,17 @@ class InferenceEngineV2:
         kv_dtype = jnp.dtype(c.kv_cache_dtype) if c.kv_cache_dtype else dtype
         self.kv = BlockedKVCache(self.cfg.num_layers, c.num_kv_blocks,
                                  c.kv_block_size, self.cfg.kv_heads,
-                                 self.cfg.head_dim, dtype=kv_dtype)
+                                 self.cfg.head_dim, dtype=kv_dtype,
+                                 enable_prefix_index=c.enable_prefix_cache)
         self.state_manager = DSStateManager(self.kv)
+        self.reuse = ReuseStats()
+        if c.spec_decode_k < 0 or c.spec_ngram < 1:
+            raise ValueError(f"spec_decode_k={c.spec_decode_k} must be >= 0 "
+                             f"and spec_ngram={c.spec_ngram} >= 1")
+        if c.spec_decode_k > 0 and not c.greedy:
+            raise ValueError(
+                "spec_decode_k > 0 requires greedy=True: the acceptance rule "
+                "compares argmax streams, which has no sampled analogue here")
         self.wrapper = RaggedBatchWrapper(token_budget=c.token_budget,
                                           max_seqs=c.max_ragged_sequence_count,
                                           max_chunk=c.max_chunk_size,
@@ -265,8 +303,82 @@ class InferenceEngineV2:
             ok, why = self.can_schedule(len(toks), max_new_tokens)
             if not ok:
                 raise RuntimeError(f"cannot schedule uid={uid}: {why}")
-            self.state_manager.create(uid, toks, max_new_tokens=max_new_tokens,
-                                      eos_token_id=eos_token_id)
+            seq = self.state_manager.create(uid, toks,
+                                            max_new_tokens=max_new_tokens,
+                                            eos_token_id=eos_token_id)
+            self._map_cached_prefix(seq)
+
+    def _map_cached_prefix(self, seq) -> None:
+        """Prefix-cache admission: match the longest chain of registered
+        full blocks over the prompt, map those pages into the sequence's
+        block table SHARED (refcounted), and advance ``seen_tokens`` so only
+        the uncached tail is prefilled. When the whole prompt is covered the
+        final prompt token must still run through the forward to produce
+        next-token logits, and its KV write would land in the last matched
+        (shared) page — that page is copy-on-write-forked first and the
+        cursor rewound one token, so the write hits the private copy.
+
+        Runs AFTER ``can_schedule`` accepted the worst case, and only ever
+        reduces this sequence's outstanding commitment (mapped pages need no
+        fresh allocation), so the PR 7 no-deadlock invariant is untouched.
+        """
+        idx = self.kv.index
+        if idx is None:
+            return
+        bs = self.config.kv_block_size
+        self.reuse.prefix_lookups += 1
+        hashes = chain_hashes(seq.prompt_tokens, bs)
+        pages = idx.lookup(hashes)
+        if not pages:
+            return
+        m = len(pages)
+        plen = len(seq.prompt_tokens)
+        self.kv.share(pages)
+        seq.blocks = list(pages)
+        seq.hash_chain = hashes[:m]
+        seq.seen_tokens = m * bs
+        shared = m
+        if seq.seen_tokens >= plen:
+            seq.seen_tokens = plen - 1
+            src = seq.blocks[-1]
+            fork = self.kv.cow_fork(src)
+            seq.blocks[-1] = fork
+            self.kv.release(src)
+            shared -= 1
+            self.reuse.cow_forks += 1
+        seq.prefix_reused_tokens = seq.seen_tokens
+        self.reuse.prefix_hits += 1
+        self.reuse.prefix_tokens_reused += seq.seen_tokens
+        self.reuse.prefix_blocks_shared += shared
+
+    def _register_full_blocks(self, seq) -> None:
+        """Publish this sequence's newly-FILLED full blocks into the prefix
+        index (first writer wins; pages another sequence already advertises
+        are skipped by ``register``). Generated tokens count too — a resumed
+        request re-admitted with prompt+generated re-matches its own decode
+        progress and pays only the tail (PR 15 resumable-serving bugfix).
+        Only tokens whose KV is committed are hashable: ``seen_tokens``
+        bounds written rows, prompt+generated bounds known content (in
+        steady decode ``seen`` trails ``committed`` by the one sampled-but-
+        unwritten token)."""
+        idx = self.kv.index
+        if idx is None:
+            return
+        bs = self.config.kv_block_size
+        committed = len(seq.prompt_tokens) + len(seq.generated)
+        n_full = min(min(seq.seen_tokens, committed) // bs, len(seq.blocks))
+        chain = seq.hash_chain
+        if n_full <= len(chain):
+            return
+        tokens = np.concatenate(
+            [seq.prompt_tokens, np.asarray(seq.generated, np.int32)]) \
+            if seq.generated else seq.prompt_tokens
+        while len(chain) < n_full:
+            i = len(chain)
+            digest = hash_block(chain[-1] if chain else ROOT_HASH,
+                                tokens[i * bs:(i + 1) * bs])
+            chain.append(digest)
+            idx.register(digest, seq.blocks[i])
 
     def _outstanding_blocks(self) -> int:
         """Worst-case blocks already promised to admitted sequences but not
@@ -390,6 +502,8 @@ class InferenceEngineV2:
             if ((seq.eos_token_id is not None and tok == seq.eos_token_id)
                     or len(seq.generated) >= seq.max_new_tokens):
                 seq.done = True
+        for seq, _ in scheduled:
+            self._register_full_blocks(seq)
         self.steps += 1
         return out
 
@@ -446,7 +560,115 @@ class InferenceEngineV2:
                     break
             seq.generated.extend(accepted)
             seq.seen_tokens += n                    # n tokens entered the KV cache
+            self._register_full_blocks(seq)
             out[seq.uid] = accepted
+        self.steps += 1
+        return out
+
+    def _ngram_propose(self, seq, k: int) -> List[int]:
+        """Draft up to ``k`` tokens by n-gram lookup: find the most recent
+        PRIOR occurrence of the sequence's final ``spec_ngram`` tokens in
+        prompt+generated and propose the tokens that followed it. Pure host
+        work over int32 context — no draft model, no extra forward."""
+        n = self.config.spec_ngram
+        ctx = (np.concatenate([seq.prompt_tokens,
+                               np.asarray(seq.generated, np.int32)])
+               if seq.generated else seq.prompt_tokens)
+        L = len(ctx)
+        if k < 1 or L <= n:
+            return []
+        key = ctx[L - n:]
+        for start in range(L - n - 1, -1, -1):
+            if np.array_equal(ctx[start:start + n], key):
+                return [int(t) for t in ctx[start + n:start + n + k]]
+        return []
+
+    def spec_decode_batch(self, k: Optional[int] = None) -> Dict[int, List[int]]:
+        """N-gram speculative decode: per live sequence, pack the chunk
+        ``[last sampled] + drafts`` and verify EVERY position in one packed
+        dispatch (``model.verify_step`` returns the greedy argmax after each
+        input token). The accepted run of drafts plus the model's own next
+        token at the first mismatch are committed; ``seen_tokens`` rewinds
+        past the rejected suffix (their KV rows are overwritten when those
+        positions are legitimately reached — reads are masked by ``kv_len``
+        so stale rows are never visible). Greedy-only: every committed token
+        IS an argmax the sequential path would have produced, so the output
+        stream is bitwise identical to ``step()``/``decode_batch``.
+
+        Preconditions mirror ``decode_batch`` (all live sequences past
+        prefill with a first sampled token). A sequence with no n-gram match
+        rides along as a plain 1-token chunk — same dispatch, no divergent
+        code path. Returns {uid: committed tokens}."""
+        c = self.config
+        if not c.greedy:
+            raise RuntimeError("spec_decode_batch requires greedy=True (the "
+                               "acceptance rule compares argmax streams)")
+        k = c.spec_decode_k if k is None else int(k)
+        seqs = [s for s in self.state_manager.all() if not s.done]
+        if not seqs:
+            return {}
+        if any(s.in_prefill or not s.generated for s in seqs):
+            raise RuntimeError("spec_decode_batch requires every active "
+                               "sequence past prefill with a first sampled "
+                               "token")
+        if len(seqs) > c.max_ragged_sequence_count:
+            raise RuntimeError(f"{len(seqs)} active sequences > "
+                               f"max_ragged_sequence_count "
+                               f"{c.max_ragged_sequence_count}")
+        bs = c.kv_block_size
+        share = max(1, c.token_budget // len(seqs))
+        scheduled: List[Tuple] = []
+        drafted: List[List[int]] = []
+        for seq in seqs:
+            # chunk = 1 + k_i must fit the prompt-chunk cap and the budget
+            # share; committing up to k_i + 1 tokens must not overrun
+            # max_new_tokens; KV rows for all chunk inputs must fit the
+            # block table
+            cap = min(k, c.max_chunk_size - 1, share - 1,
+                      seq.max_new_tokens - len(seq.generated) - 1,
+                      c.max_blocks_per_seq * bs - seq.seen_tokens - 1)
+            drafts = self._ngram_propose(seq, cap) if cap > 0 else []
+            toks = np.asarray([seq.generated[-1]] + drafts, np.int32)
+            self.kv.reserve(seq, len(toks))
+            scheduled.append((seq, toks))
+            drafted.append(drafts)
+        batch = self.wrapper.pack(scheduled, bs)
+        kv_k, kv_v = self.kv.pool_args()
+        nexts, new_k, new_v = verify_step(
+            self.params, self.cfg, kv_k, kv_v,
+            jnp.asarray(batch.tokens), jnp.asarray(batch.positions),
+            jnp.asarray(batch.gather_idx), jnp.asarray(batch.block_table),
+            jnp.asarray(batch.kv_len), jnp.asarray(batch.start_pos),
+            jnp.asarray(batch.chunk_len), attn_impl=self.attn_impl)
+        self.kv.update(new_k, new_v)
+        nexts = np.asarray(nexts)       # [T] int32 — the only D2H transfer
+        out: Dict[int, List[int]] = {}
+        cursor = 0
+        for (seq, toks), drafts in zip(scheduled, drafted):
+            preds = nexts[cursor:cursor + len(toks)]
+            cursor += len(toks)
+            j = 0
+            while j < len(drafts) and int(preds[j]) == drafts[j]:
+                j += 1
+            committed = drafts[:j] + [int(preds[j])]
+            self.reuse.spec_drafted += len(drafts)
+            self.reuse.spec_accepted += j
+            accepted: List[int] = []
+            for t in committed:
+                accepted.append(int(t))
+                if ((seq.eos_token_id is not None
+                        and int(t) == seq.eos_token_id)
+                        or len(seq.generated) + len(accepted)
+                        >= seq.max_new_tokens):
+                    seq.done = True
+                    break
+            seq.generated.extend(accepted)
+            # chunk inputs [last] + drafts[:j] are committed content whose
+            # KV is now written; rewind past the rejected draft suffix
+            seq.seen_tokens += 1 + j
+            self._register_full_blocks(seq)
+            out[seq.uid] = accepted
+        self.reuse.spec_steps += 1
         self.steps += 1
         return out
 
@@ -547,6 +769,7 @@ class InferenceEngineV2:
                     break
             seq.generated.extend(accepted)
             seq.seen_tokens += n        # every scanned token entered the KV
+            self._register_full_blocks(seq)
             out[seq.uid] = accepted
         return out
 

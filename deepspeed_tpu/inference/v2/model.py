@@ -267,14 +267,17 @@ def paged_attention(qg, k_pool, v_pool, block_table, positions_g, q_valid,
     return out
 
 
-def _ragged_forward_impl(params, cfg: TransformerConfig, kv_k, kv_v, tokens,
-                         positions, gather_idx, block_table, kv_len,
-                         logits_idx, start_pos, chunk_len, attn_impl: str
-                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One engine step over a packed ragged batch.
+def _ragged_hidden(params, cfg: TransformerConfig, kv_k, kv_v, tokens,
+                   positions, gather_idx, block_table, kv_len,
+                   start_pos, chunk_len, attn_impl: str
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The packed ragged forward up to the final norm: returns the
+    final-norm hidden states for EVERY packed token (``x [T, H]``) plus the
+    updated pools. ``_ragged_forward_impl`` selects per-sequence sample
+    positions on top; ``verify_step`` reads all T rows (speculative-decode
+    verification needs logits at every draft position).
 
-    kv pools: [L, N, Hk, bs, D] (donated — updated in place). Returns
-    (logits [S, V] fp32 at each sequence's logits_idx token, new kv_k, kv_v).
+    kv pools: [L, N, Hk, bs, D] (donated — updated in place).
     ``attn_impl``: "einsum" (dense gathered-page reference path) or "pallas"
     (paged online-softmax kernel, ops/pallas/paged_attention.py).
     """
@@ -354,6 +357,18 @@ def _ragged_forward_impl(params, cfg: TransformerConfig, kv_k, kv_v, tokens,
             x = x + _ffn(cfg, lp, _norm(cfg, lp["mlp_norm"], x))
 
     x = _norm(cfg, params["final_norm"], x)
+    return x, kv_k, kv_v
+
+
+def _ragged_forward_impl(params, cfg: TransformerConfig, kv_k, kv_v, tokens,
+                         positions, gather_idx, block_table, kv_len,
+                         logits_idx, start_pos, chunk_len, attn_impl: str
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One engine step over a packed ragged batch. Returns (logits [S, V]
+    fp32 at each sequence's logits_idx token, new kv_k, kv_v)."""
+    x, kv_k, kv_v = _ragged_hidden(params, cfg, kv_k, kv_v, tokens, positions,
+                                   gather_idx, block_table, kv_len,
+                                   start_pos, chunk_len, attn_impl)
     # logits only at the sample positions (reference logits_gather kernel);
     # logits_idx == T selects the zero pad row for non-sampling slots
     h_sel = jnp.concatenate([x, jnp.zeros_like(x[:1])])[logits_idx]  # [S, H]
@@ -403,6 +418,32 @@ def ragged_step(params, cfg: TransformerConfig, kv_k, kv_v, tokens, positions,
         toks = jax.random.categorical(
             key, logits / jnp.maximum(temperature, 1e-6), axis=-1)
     return toks.astype(jnp.int32), kv_k, kv_v
+
+
+@partial(jax.jit, static_argnames=("cfg", "attn_impl"),
+         donate_argnames=("kv_k", "kv_v"))
+def verify_step(params, cfg: TransformerConfig, kv_k, kv_v, tokens, positions,
+                gather_idx, block_table, kv_len, start_pos, chunk_len,
+                attn_impl: str = "einsum"
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Speculative-decode verification: the packed ragged forward with a
+    greedy argmax at EVERY packed token position, not just logits_idx.
+
+    Each sequence's chunk is ``[last committed token, draft_1..draft_k]``;
+    row ``t`` of the returned ``[T] int32`` is the model's next-token
+    prediction AFTER input token ``t`` — the host accepts the longest draft
+    prefix where ``draft_{i+1} == next[i]`` and commits ``next[j]`` at the
+    first mismatch, which is by construction exactly the sequential greedy
+    stream. KV rows for all k+1 inputs are scattered as usual; the engine
+    rewinds ``seen_tokens`` past the rejected suffix and those rows are
+    rewritten when their positions are next reached (reads never see them:
+    attention masks by kv_len/pool_len = committed length).
+    """
+    x, kv_k, kv_v = _ragged_hidden(params, cfg, kv_k, kv_v, tokens, positions,
+                                   gather_idx, block_table, kv_len,
+                                   start_pos, chunk_len, attn_impl)
+    logits = _lm_logits(cfg, params, x)                           # [T, V]
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv_k, kv_v
 
 
 def _dense_multi_in(p, x):

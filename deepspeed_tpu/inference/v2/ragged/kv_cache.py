@@ -23,25 +23,45 @@ Residency contract: the pools are DONATED through every jitted step
 device update and the decode kernel reads the committed pool where it
 lives — its index map resolves (layer, physical page) per grid step, so
 neither a per-layer slice nor a gathered copy of the pool is ever
-materialized per call."""
+materialized per call.
 
-from typing import Optional, Tuple
+Sharing contract (``enable_prefix_index=True``): pages carry a host-side
+refcount (``refs``) so several sequences can map one physical page
+(content-addressed prefix reuse, :mod:`prefix_index`). :meth:`free` only
+returns a page to the allocator when its LAST owner releases it; a page
+the :class:`~.prefix_index.PrefixIndex` still advertises survives at
+``refs == 0`` as *reclaimable* cache — it counts toward
+:attr:`free_blocks` (admission math is unchanged) and is evicted LRU-first
+the moment a reservation actually needs the capacity. Shared pages are
+read-only by construction: only token-aligned FULL blocks are ever
+registered/mapped, appends land in fresh pages past them, and the one
+write that could touch a shared page (re-running the final prompt token of
+a fully-cached prompt) goes through :meth:`cow_fork` first."""
+
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .blocked_allocator import BlockedAllocator
+from .prefix_index import PrefixIndex
 
 
 class BlockedKVCache:
     def __init__(self, num_layers: int, num_blocks: int, block_size: int,
                  kv_heads: int, head_dim: int, dtype=jnp.bfloat16,
-                 shardings=None):
+                 shardings=None, enable_prefix_index: bool = False):
         self.num_layers = num_layers
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.allocator = BlockedAllocator(num_blocks)
+        #: page id -> number of live sequence owners (1 for private pages;
+        #: maintained whether or not the index is on, so free() is one path)
+        self.refs: Dict[int, int] = {}
+        self.index: Optional[PrefixIndex] = (PrefixIndex()
+                                             if enable_prefix_index else None)
+        self.cow_forks = 0
         shape = (num_layers, num_blocks, kv_heads, block_size, head_dim)
         self.k = jnp.zeros(shape, dtype)
         self.v = jnp.zeros(shape, dtype)
@@ -83,18 +103,104 @@ class BlockedKVCache:
 
     @property
     def free_blocks(self) -> int:
-        return self.allocator.free_blocks
+        """Pages a reservation can obtain: the allocator free list PLUS
+        reclaimable index pages (registered, zero live owners) — cached
+        content is capacity, not occupancy, so the admission invariant
+        (`can_schedule` worst-case commitment) is unchanged by caching."""
+        n = self.allocator.free_blocks
+        if self.index is not None:
+            n += len(self.index.reclaimable_pages(self.refs))
+        return n
+
+    def _allocate(self, n: int) -> List[int]:
+        """Allocate ``n`` fresh private pages, evicting reclaimable index
+        entries (LRU) when the raw free list runs short."""
+        if self.index is not None and n > self.allocator.free_blocks:
+            evicted = self.index.evict(n - self.allocator.free_blocks,
+                                       self.refs)
+            if evicted:
+                self.allocator.free(evicted)
+        pages = self.allocator.allocate(n).tolist()
+        for p in pages:
+            self.refs[p] = 1
+        return pages
 
     def reserve(self, seq, n_new_tokens: int) -> None:
         """Ensure ``seq`` has blocks for ``n_new_tokens`` more tokens."""
         need = seq.blocks_needed(n_new_tokens, self.block_size)
         if need:
-            seq.blocks.extend(self.allocator.allocate(need).tolist())
+            seq.blocks.extend(self._allocate(need))
+
+    def share(self, pages) -> None:
+        """Map already-written pages into one more sequence's block table
+        (prefix-cache hit). Resurrecting a reclaimable index page is the
+        same operation: refs 0 -> 1 pins it again."""
+        for p in pages:
+            self.refs[p] = self.refs.get(p, 0) + 1
+
+    def release(self, page: int) -> None:
+        """Drop one owner. The page returns to the allocator only when it
+        is truly dead: zero owners AND not advertised by the prefix index
+        (registered pages linger as reclaimable cache)."""
+        r = self.refs.get(page, 0) - 1
+        if r > 0:
+            self.refs[page] = r
+            return
+        self.refs.pop(page, None)
+        if self.index is not None and self.index.holds_page(page):
+            self.index.touch_page(page)   # reclaimable from now; LRU-stamp
+            return
+        self.allocator.free([page])
 
     def free(self, seq) -> None:
-        if seq.blocks:
-            self.allocator.free(seq.blocks)
-            seq.blocks = []
+        for p in seq.blocks:
+            self.release(p)
+        seq.blocks = []
+
+    def cow_fork(self, page: int) -> int:
+        """Copy-on-write fork: allocate a private page and copy ``page``'s
+        payload (all layers, and int8 scales when quantized) so the caller
+        can write into its copy without corrupting the shared original.
+        The caller still owns its reference on ``page`` and must
+        :meth:`release` it after swapping the fork into the block table."""
+        (new,) = self._allocate(1)
+        self.k = self.k.at[:, new].set(self.k[:, page])
+        self.v = self.v.at[:, new].set(self.v[:, page])
+        if self.quantized:
+            self.k_scale = self.k_scale.at[:, new].set(self.k_scale[:, page])
+            self.v_scale = self.v_scale.at[:, new].set(self.v_scale[:, page])
+        self.cow_forks += 1
+        return new
+
+    def assert_conservation(self, live_block_lists) -> None:
+        """Pool-conservation invariant for tests: every non-trash page is
+        accounted exactly once across {allocator free list} ∪ {pages with
+        live owners} ∪ {reclaimable index pages}, live refcounts equal the
+        number of sequences mapping each page, and nothing is both free
+        and referenced. ``live_block_lists``: the block tables of every
+        tracked sequence."""
+        owners: Dict[int, int] = {}
+        for blocks in live_block_lists:
+            for p in blocks:
+                owners[p] = owners.get(p, 0) + 1
+        if owners != {p: r for p, r in self.refs.items() if r > 0}:
+            raise AssertionError(
+                f"refcount drift: sequences map {owners} but refs say "
+                f"{self.refs}")
+        free = set(self.allocator._free)
+        held = set(self.refs)
+        cached = (set(self.index.reclaimable_pages(self.refs))
+                  if self.index is not None else set())
+        if free & held or free & cached or held & cached:
+            raise AssertionError(
+                f"page in two states: free∩held={free & held} "
+                f"free∩cached={free & cached} held∩cached={held & cached}")
+        every = free | held | cached
+        expect = set(range(1, self.num_blocks))
+        if every != expect:
+            raise AssertionError(
+                f"pool leak/double-free: missing={expect - every} "
+                f"extra={every - expect}")
 
     def update(self, k, v) -> None:
         """Install the new pools returned by the jitted step (donation makes

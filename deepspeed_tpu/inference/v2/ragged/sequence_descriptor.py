@@ -20,6 +20,13 @@ class DSSequenceDescriptor:
     max_new_tokens: int = 256
     eos_token_id: Optional[int] = None
     done: bool = False
+    # prefix-cache state: the hash chain of this sequence's committed FULL
+    # blocks (prefix_index.chain_hashes prefix) — seeded with the matched
+    # chain on a cache hit, extended as decode/prefill fills blocks — and
+    # how many prompt tokens admission mapped from the index (prefilled-for
+    # -free; the serving tier's blocks-saved/hit-rate accounting)
+    hash_chain: List[str] = field(default_factory=list)
+    prefix_reused_tokens: int = 0
 
     @property
     def prompt_remaining(self) -> int:

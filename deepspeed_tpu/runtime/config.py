@@ -762,8 +762,14 @@ class ServingConfig(ConfigModel):
     prefills when the KV pool runs dry), or ``deadline`` (earliest SLA
     deadline first). ``engine`` holds ``RaggedInferenceEngineConfig``
     overrides (token_budget, num_kv_blocks, kv_block_size,
-    kv_cache_dtype, ...). ``heartbeat_dir`` enables the PR 5 beacon
-    transport for replica health (``ReplicaRouter``)."""
+    kv_cache_dtype, ...) — notably ``enable_prefix_cache`` (content-
+    addressed prefix KV reuse: repeated system prompts map already-written
+    pages instead of re-prefilling; resumed/migrated requests pay only the
+    uncached tail) and ``spec_decode_k``/``spec_ngram`` (n-gram
+    speculative decoding, greedy-only; the server runs the verify path
+    automatically whenever every live sequence is in steady decode). See
+    docs/serving.md. ``heartbeat_dir`` enables the PR 5 beacon transport
+    for replica health (``ReplicaRouter``)."""
     enabled: bool = False
     policy: str = "fcfs"                 # fcfs | priority | deadline
     preempt: bool = True                 # preempt prefills under block pressure

@@ -98,6 +98,17 @@ class ServingMetrics:
         self.inflight = 0
         self.kv_free_blocks = 0
         self.kv_total_blocks = 0
+        # prefix-cache / speculative-decode counters, mirrored from the
+        # engine's cumulative ReuseStats each loop (sample_reuse) — the
+        # engine is the source of truth, these are its last-seen values
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefix_tokens_reused = 0
+        self.prefix_blocks_shared = 0
+        self.cow_forks = 0
+        self.spec_steps = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         # implementation stamp: which attention kernels served this replica
         # (engine_v2 resolution) — the sv/pd ladder rungs and post-hoc
         # readers must know which decode path produced a latency row
@@ -151,6 +162,29 @@ class ServingMetrics:
         self.kv_free_blocks = int(kv_free_blocks)
         self.kv_total_blocks = int(kv_total_blocks)
 
+    def sample_reuse(self, reuse) -> None:
+        """Mirror the engine's cumulative prefix-cache / speculative-decode
+        counters (``engine_v2.ReuseStats`` or any object with the same
+        attribute names)."""
+        for name in ("prefix_lookups", "prefix_hits", "prefix_tokens_reused",
+                     "prefix_blocks_shared", "cow_forks", "spec_steps",
+                     "spec_drafted", "spec_accepted"):
+            setattr(self, name, int(getattr(reuse, name, 0)))
+
+    def prefix_hit_rate(self) -> Optional[float]:
+        """Fraction of admissions that mapped at least one cached block
+        (None until the first lookup, i.e. prefix cache off or no traffic)."""
+        if not self.prefix_lookups:
+            return None
+        return self.prefix_hits / self.prefix_lookups
+
+    def spec_acceptance_rate(self) -> Optional[float]:
+        """Fraction of drafted tokens the verify pass accepted (None until
+        the first draft)."""
+        if not self.spec_drafted:
+            return None
+        return self.spec_accepted / self.spec_drafted
+
     # ------------------------------------------------------------------
     @property
     def elapsed_s(self) -> float:
@@ -196,6 +230,19 @@ class ServingMetrics:
             "elapsed_s": round(self.elapsed_s, 3),
             "attn_impl": self.attn_impl,
             "decode_attn_impl": self.decode_attn_impl,
+            "prefix_lookups": self.prefix_lookups,
+            "prefix_hits": self.prefix_hits,
+            "prefix_hit_rate": (None if (hr := self.prefix_hit_rate()) is None
+                                else round(hr, 4)),
+            "prefix_tokens_reused": self.prefix_tokens_reused,
+            "prefix_blocks_shared": self.prefix_blocks_shared,
+            "cow_forks": self.cow_forks,
+            "spec_steps": self.spec_steps,
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
+            "spec_acceptance_rate": (None
+                                     if (ar := self.spec_acceptance_rate())
+                                     is None else round(ar, 4)),
         }
 
     def monitor_events(self, step: int, prefix: str = "Serving") -> List[Event]:
@@ -220,4 +267,9 @@ class ServingMetrics:
         put("requeues", self.requeues)
         put("rejected", self.rejected)
         put("sla_violations", self.sla_violations)
+        put("prefix_hit_rate", self.prefix_hit_rate())
+        put("prefix_tokens_reused", self.prefix_tokens_reused)
+        put("prefix_blocks_shared", self.prefix_blocks_shared)
+        put("cow_forks", self.cow_forks)
+        put("spec_acceptance_rate", self.spec_acceptance_rate())
         return events

@@ -135,17 +135,34 @@ class ContinuousBatchScheduler:
         victims = self._eligible_victims(cand)
         return max(victims, key=self._key) if victims else None
 
+    def _victim_gain(self, resp: ServedResponse) -> int:
+        """Uncommitted blocks a flush of ``resp`` actually returns. The
+        un-commitment part (worst-case promise minus already-held pages) is
+        always reclaimed; of the held pages, only those this sequence is
+        the LAST owner of go back to the pool — a page shared with another
+        live sequence (prefix-cache hit) survives the flush, so counting it
+        would overstate the gain and trigger pointless evictions."""
+        refs = getattr(self.engine.kv, "refs", None)
+        seq = self.engine.state_manager.get(resp.uid)
+        if refs is None or seq is None:
+            return self._blocks_worst(resp)
+        held = list(seq.blocks)
+        return (self._blocks_worst(resp) - len(held)
+                + sum(1 for p in held if refs.get(p, 0) <= 1))
+
     def _preemption_covers(self, cand: ServedResponse) -> bool:
         """Only start evicting when the evictable prefills can actually free
-        enough: a victim's flush returns its whole worst-case commitment to
-        the uncommitted pool, so the sum over eligible victims bounds the
-        gain. Without this check a too-large candidate would throw away
-        every outranked prefill's progress and still not be admitted."""
+        enough: a victim's flush returns its un-committed worst-case promise
+        plus the held pages it solely owns (``_victim_gain`` — shared
+        prefix-cache pages don't free), so the sum over eligible victims
+        bounds the gain. Without this check a too-large candidate would
+        throw away every outranked prefill's progress and still not be
+        admitted."""
         deficit = (self._blocks_worst(cand)
                    - self.engine.uncommitted_free_blocks)
         if deficit <= 0:
             return True       # schedulable modulo races; can_schedule decides
-        return sum(self._blocks_worst(v)
+        return sum(self._victim_gain(v)
                    for v in self._eligible_victims(cand)) >= deficit
 
     def _preempt(self, victim: ServedResponse) -> None:

@@ -396,10 +396,14 @@ class LLMServer:
                                 else "serve/mixed")
                     else:
                         name = "serve/step"
-                    fused = self._fusable_decode()
+                    mode = ("spec" if self._spec_decode_ready()
+                            else "fused" if self._fusable_decode()
+                            else "step")
                     t0 = self.clock()
                     with span(name):
-                        if fused:
+                        if mode == "spec":
+                            multi = self.engine.spec_decode_batch()
+                        elif mode == "fused":
                             multi = self.engine.decode_batch(
                                 self.fused_decode_chunk)
                         else:
@@ -407,11 +411,11 @@ class LLMServer:
                     self._last_step_time = self.clock() - t0
                     self._steps += 1
                     with span("serve/deliver"):
-                        if fused:
-                            self._deliver_multi(multi)
-                        else:
+                        if mode == "step":
                             self._deliver(out)
-                    progressed = (bool(multi) if fused
+                        else:
+                            self._deliver_multi(multi)
+                    progressed = (bool(multi) if mode != "step"
                                   else (self.engine.last_num_scheduled > 0
                                         or bool(out)))
                 self._sample_gauges()
@@ -522,6 +526,28 @@ class LLMServer:
         return min(s.max_new_tokens - len(s.generated)
                    for s in seqs) >= self.fused_decode_chunk
 
+    def _spec_decode_ready(self) -> bool:
+        """True when this step should run n-gram speculative decode
+        (``engine.spec_decode_batch``): opt-in via the engine's
+        ``spec_decode_k`` knob (greedy-only by construction), every live
+        sequence in steady decode with a first sampled token, the batch
+        fits one dispatch, and nothing is queued — the same bare
+        ``pending`` admission-latency bias as the fused path (see
+        :meth:`_fusable_decode`). Unlike fusing there is no full-chunk
+        gate: the verify dispatch has static packed shapes, so variable
+        accept counts never recompile. When both are eligible speculation
+        wins — accepted drafts make it strictly denser per dispatch."""
+        cfg = getattr(self.engine, "config", None)
+        if (cfg is None or getattr(cfg, "spec_decode_k", 0) < 1
+                or not getattr(cfg, "greedy", False)
+                or not hasattr(self.engine, "spec_decode_batch")
+                or self.scheduler.pending):
+            return False
+        seqs = [s for s in self.engine.state_manager.all() if not s.done]
+        return (bool(seqs)
+                and len(seqs) <= cfg.max_ragged_sequence_count
+                and all((not s.in_prefill) and s.generated for s in seqs))
+
     def _finish_if_done(self, uid: int, resp, now: float) -> None:
         seq = self.engine.state_manager.get(uid)
         if seq is not None and seq.done:
@@ -573,6 +599,9 @@ class LLMServer:
                  inflight=self.inflight_count,
                  kv_free_blocks=self.engine.kv.free_blocks,
                  kv_total_blocks=self.engine.kv.num_blocks)
+        reuse = getattr(self.engine, "reuse", None)
+        if reuse is not None:
+            m.sample_reuse(reuse)
 
     def _start_beater(self) -> None:
         if self.heartbeat is None:

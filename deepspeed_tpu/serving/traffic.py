@@ -51,6 +51,18 @@ class TrafficConfig:
     # optional SLA fields stamped on every request
     deadline_s: Optional[float] = None
     priorities: Tuple[int, ...] = (0,)  # drawn uniformly per request
+    # prefix-heavy workload shape (system-prompt reuse, the regime the
+    # prefix KV cache targets): when ``system_prompt_pool > 0`` every
+    # request's prompt is ``pool[z] + unique suffix`` where the pool holds
+    # that many fixed system prompts of ``system_prompt_len`` tokens (drawn
+    # once from the same seeded rng) and ``z`` is a Zipf(``zipf_a``) draw —
+    # a few system prompts dominate, the tail is cold, matching production
+    # template reuse. The unique suffix keeps ``prompt_len`` semantics (it
+    # IS the suffix length), so total prompt = system_prompt_len +
+    # prompt_len.sample().
+    system_prompt_pool: int = 0
+    system_prompt_len: int = 0
+    zipf_a: float = 1.5
 
 
 class OpenLoopTraffic:
@@ -62,6 +74,13 @@ class OpenLoopTraffic:
         Request), ...]`` sorted by offset (exponential inter-arrival gaps)."""
         c = self.config
         rng = np.random.default_rng(c.seed)
+        pool: List[np.ndarray] = []
+        if c.system_prompt_pool > 0 and c.system_prompt_len > 0:
+            # the pool is drawn BEFORE any per-request randomness so the
+            # shared prefixes are identical across runs of the same seed
+            # regardless of num_requests
+            pool = [rng.integers(0, c.vocab_size, size=c.system_prompt_len)
+                    .astype(np.int32) for _ in range(c.system_prompt_pool)]
         out: List[Tuple[float, Request]] = []
         t = 0.0
         for i in range(c.num_requests):
@@ -69,6 +88,9 @@ class OpenLoopTraffic:
             plen = c.prompt_len.sample(rng)
             olen = c.output_len.sample(rng)
             prompt = rng.integers(0, c.vocab_size, size=plen).astype(np.int32)
+            if pool:
+                z = (int(rng.zipf(c.zipf_a)) - 1) % len(pool)
+                prompt = np.concatenate([pool[z], prompt])
             prio = int(rng.choice(c.priorities))
             out.append((t, Request(prompt, max_new_tokens=olen,
                                    priority=prio, deadline_s=c.deadline_s,

@@ -440,6 +440,15 @@ def serving_metrics_samples(metrics, labels: Dict[str, str]) -> List[Sample]:
         ("dstpu_serving_requeues_total", "requeues"),
         ("dstpu_serving_sla_violations_total", "sla_violations"),
         ("dstpu_serving_tokens_out_total", "tokens_out"),
+        # prefix KV cache / speculative decoding (mirrored off the
+        # engine's ReuseStats by the server loop)
+        ("dstpu_serving_prefix_lookups_total", "prefix_lookups"),
+        ("dstpu_serving_prefix_hits_total", "prefix_hits"),
+        ("dstpu_serving_prefix_tokens_reused_total", "prefix_tokens_reused"),
+        ("dstpu_serving_prefix_blocks_shared_total", "prefix_blocks_shared"),
+        ("dstpu_serving_cow_forks_total", "cow_forks"),
+        ("dstpu_serving_spec_drafted_total", "spec_drafted"),
+        ("dstpu_serving_spec_accepted_total", "spec_accepted"),
     ]
     out: List[Sample] = [
         (name, "counter", f"serving {attr}",
@@ -464,6 +473,18 @@ def serving_metrics_samples(metrics, labels: Dict[str, str]) -> List[Sample]:
     gauge_rows.append(("dstpu_serving_inflight", "gauge",
                        "sequences in the engine",
                        [("", lab, float(metrics.inflight))]))
+    hr = metrics.prefix_hit_rate() if hasattr(metrics,
+                                              "prefix_hit_rate") else None
+    if hr is not None:
+        gauge_rows.append(("dstpu_serving_prefix_hit_rate", "gauge",
+                           "fraction of admissions matching cached prefix",
+                           [("", lab, float(hr))]))
+    ar = (metrics.spec_acceptance_rate()
+          if hasattr(metrics, "spec_acceptance_rate") else None)
+    if ar is not None:
+        gauge_rows.append(("dstpu_serving_spec_acceptance_rate", "gauge",
+                           "fraction of drafted tokens accepted by verify",
+                           [("", lab, float(ar))]))
     return out + gauge_rows
 
 

@@ -821,3 +821,175 @@ def test_tp_decode_projections_match_dense(impl):
     np.testing.assert_array_equal(
         np.asarray(fn_l(x, wv)),
         np.asarray(jnp.argmax(x @ wv, axis=-1).astype(jnp.int32)))
+
+
+# ---------------------------------------------------------------------------
+# content-addressed prefix KV reuse + n-gram speculative decode
+# ---------------------------------------------------------------------------
+
+
+def _cache_engine(**over):
+    model, params = _tiny_model()
+    kw = dict(token_budget=16, max_ragged_sequence_count=4, max_chunk_size=8,
+              num_kv_blocks=32, kv_block_size=8, max_blocks_per_seq=8,
+              dtype="float32")
+    kw.update(over)
+    return InferenceEngineV2(model, params, RaggedInferenceEngineConfig(**kw))
+
+
+def test_prefix_index_chain_lookup_and_eviction():
+    from deepspeed_tpu.inference.v2.ragged import (ROOT_HASH, PrefixIndex,
+                                                   chain_hashes, hash_block)
+
+    toks = np.arange(20, dtype=np.int32)
+    hashes = chain_hashes(toks, 8)
+    assert len(hashes) == 2                      # full blocks only (20 // 8)
+    # deterministic and chained: same tokens -> same digests, first digest
+    # keyed off the sentinel root, second off the first
+    assert hashes == chain_hashes(toks, 8)
+    assert hashes[0] == hash_block(ROOT_HASH, toks[:8])
+    assert hashes[1] == hash_block(hashes[0], toks[8:16])
+    # a different PARENT changes the digest even for identical block tokens
+    assert hash_block("other", toks[:8]) != hashes[0]
+
+    idx = PrefixIndex()
+    assert idx.register(hashes[0], 3)
+    assert not idx.register(hashes[0], 4)        # first writer wins
+    assert idx.register(hashes[1], 5)
+    assert idx.lookup(hashes) == [3, 5]
+    # a chain whose FIRST block misses matches nothing, even if a later
+    # digest were somehow known (prefix means prefix)
+    assert idx.lookup([hash_block(ROOT_HASH, toks[1:9])] + hashes[1:]) == []
+    # eviction respects refcounts (page 3 pinned) and LRU among the rest
+    assert idx.evict(2, refs={3: 1}) == [5]
+    assert idx.lookup(hashes) == [3]
+
+
+def test_v2_prefix_cache_warm_put_parity_and_cow():
+    """Warm-cache admission must (a) reproduce cold greedy output bitwise,
+    (b) skip the cached prefill, (c) COW-fork exactly once when the prompt
+    is fully block-aligned-covered, and (d) conserve the pool."""
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 97, 24).astype(np.int32)   # 24 % 8 == 0
+    ref = _cache_engine().generate([prompt], max_new_tokens=12)[0]
+
+    eng = _cache_engine(enable_prefix_cache=True)
+    cold = eng.generate([prompt], max_new_tokens=12)[0]
+    warm = eng.generate([prompt], max_new_tokens=12)[0]
+    np.testing.assert_array_equal(cold, ref)
+    np.testing.assert_array_equal(warm, ref)
+    r = eng.reuse
+    assert r.prefix_lookups == 2 and r.prefix_hits == 1
+    assert r.prefix_tokens_reused == 23          # plen - 1: COW rewind
+    assert r.cow_forks == 1
+    eng.kv.assert_conservation(
+        [s.blocks for s in eng.state_manager.all()])
+    # all flushed: every page is free or reclaimable cache, none leaked
+    assert eng.kv.free_blocks == eng.config.num_kv_blocks - 1
+
+    # unaligned prompt (no COW case): tail prefill starts in a fresh page
+    p2 = rng.integers(0, 97, 21).astype(np.int32)
+    ref2 = _cache_engine().generate([p2], max_new_tokens=6)[0]
+    eng2 = _cache_engine(enable_prefix_cache=True)
+    eng2.generate([p2], max_new_tokens=6)
+    warm2 = eng2.generate([p2], max_new_tokens=6)[0]
+    np.testing.assert_array_equal(warm2, ref2)
+    assert eng2.reuse.cow_forks == 0
+    assert eng2.reuse.prefix_tokens_reused == 16  # 2 full blocks of 21
+
+
+def test_v2_prefix_cache_shared_pages_and_partial_reuse():
+    """Two live sequences with a common 2-block prefix share pages
+    (refcount 2), and a LONGER prompt re-admitted over a cached shorter
+    one reuses exactly the common full blocks."""
+    rng = np.random.default_rng(1)
+    head = rng.integers(0, 97, 16).astype(np.int32)
+    a = np.concatenate([head, rng.integers(0, 97, 5).astype(np.int32)])
+    b = np.concatenate([head, rng.integers(0, 97, 7).astype(np.int32)])
+    ref_a = _cache_engine().generate([a], max_new_tokens=6)[0]
+    ref_b = _cache_engine().generate([b], max_new_tokens=6)[0]
+
+    eng = _cache_engine(enable_prefix_cache=True)
+    eng.put([1], [a], max_new_tokens=6)
+    while any(s.in_prefill for s in eng.state_manager.all()):
+        eng.step()
+    eng.put([2], [b], max_new_tokens=6)
+    seq_a, seq_b = eng.state_manager.get(1), eng.state_manager.get(2)
+    assert seq_b.prefix_reused_tokens == 16      # the two shared head blocks
+    assert seq_b.blocks[:2] == seq_a.blocks[:2]
+    assert all(eng.kv.refs[p] == 2 for p in seq_b.blocks[:2])
+    eng.kv.assert_conservation([seq_a.blocks, seq_b.blocks])
+    while eng.has_work():
+        if not eng.step() and eng.last_num_scheduled == 0:
+            break
+    np.testing.assert_array_equal(eng.query(1)[1], ref_a)
+    np.testing.assert_array_equal(eng.query(2)[1], ref_b)
+    eng.flush(1)
+    # flushing ONE owner must not free the shared pages under the other
+    assert all(eng.kv.refs[p] == 1 for p in seq_b.blocks[:2])
+    eng.kv.assert_conservation([seq_b.blocks])
+    eng.flush(2)
+    eng.kv.assert_conservation([])
+    assert eng.kv.free_blocks == eng.config.num_kv_blocks - 1
+
+
+def test_v2_prefix_cache_eviction_under_pressure():
+    """Filling the pool with distinct prompts must evict reclaimable cache
+    LRU-first instead of failing allocation, and conservation holds
+    throughout."""
+    rng = np.random.default_rng(2)
+    eng = _cache_engine(enable_prefix_cache=True, num_kv_blocks=16)
+    for i in range(12):
+        p = rng.integers(0, 97, 16).astype(np.int32)
+        out = eng.generate([p], max_new_tokens=4)[0]
+        assert len(out) == 4
+        eng.kv.assert_conservation(
+            [s.blocks for s in eng.state_manager.all()])
+    assert eng.kv.index.evictions > 0            # pressure actually evicted
+    assert eng.kv.free_blocks == eng.config.num_kv_blocks - 1
+
+
+def test_v2_spec_decode_greedy_parity_and_acceptance():
+    """The correctness contract: greedy output with speculation on is
+    bitwise identical to the plain path, and a repetitive prompt yields
+    nonzero draft acceptance (the speedup exists)."""
+    p = np.array([5, 6, 7, 8] * 6, np.int32)
+    ref = _cache_engine().generate([p], max_new_tokens=16)[0]
+
+    eng = _cache_engine(spec_decode_k=4, spec_ngram=2)
+    eng.put([1], [p], max_new_tokens=16)
+    while any(s.in_prefill for s in eng.state_manager.all()):
+        eng.step()
+    got = list(eng.query(1)[1])
+    steps = 0
+    while not eng.query(1)[0]:
+        r = eng.spec_decode_batch()
+        if not r:
+            break
+        got.extend(r[1])
+        steps += 1
+    np.testing.assert_array_equal(np.asarray(got, np.int32), ref)
+    assert eng.reuse.spec_accepted > 0
+    assert steps < 15              # accepted drafts beat 1 token/step
+    eng.flush(1)
+
+    # eos mid-draft: committed tokens still truncate exactly at eos
+    eos = int(ref[5])
+    ref_eos = _cache_engine().generate([p], max_new_tokens=16,
+                                       eos_token_id=eos)[0]
+    eng2 = _cache_engine(spec_decode_k=4, spec_ngram=2)
+    eng2.put([1], [p], max_new_tokens=16, eos_token_id=eos)
+    while any(s.in_prefill for s in eng2.state_manager.all()):
+        eng2.step()
+    got2 = list(eng2.query(1)[1])
+    while not eng2.query(1)[0]:
+        r = eng2.spec_decode_batch()
+        if not r:
+            break
+        got2.extend(r[1])
+    np.testing.assert_array_equal(np.asarray(got2, np.int32), ref_eos)
+
+
+def test_v2_spec_decode_requires_greedy():
+    with pytest.raises(ValueError, match="greedy"):
+        _cache_engine(spec_decode_k=4, greedy=False)

@@ -502,23 +502,35 @@ def test_traffic_schedule_deterministic():
 
 
 def test_open_loop_block_reservation_invariant_and_drain(tiny_model):
-    """The acceptance drill: a seeded open-loop run where every admission is
-    checked against the pool invariant (free - outstanding >= 0 after every
-    put), and drain() completes every admitted request."""
-    engine = _engine(tiny_model)
+    """The acceptance drill: a seeded PREFIX-HEAVY open-loop run with the
+    prefix cache on, where every admission is checked against the pool
+    invariant (free - outstanding >= 0 after every put) AND the COW/refcount
+    pool-conservation invariant holds at every put() and flush(); drain()
+    completes every admitted request."""
+    engine = _engine(tiny_model, enable_prefix_cache=True)
     violations = []
-    orig_put = engine.put
+    orig_put, orig_flush = engine.put, engine.flush
+
+    def conserve():
+        engine.kv.assert_conservation(
+            [s.blocks for s in engine.state_manager.all()])
 
     def checked_put(uids, toks, **kw):              # runs on the engine thread
         orig_put(uids, toks, **kw)
         slack = engine.kv.free_blocks - engine._outstanding_blocks()
         if slack < 0:
             violations.append((list(uids), slack))
+        conserve()
 
-    engine.put = checked_put
+    def checked_flush(uid):
+        orig_flush(uid)
+        conserve()
+
+    engine.put, engine.flush = checked_put, checked_flush
     server = LLMServer(engine, policy="deadline", max_queue=64).start()
     traffic = TrafficConfig(rate_rps=200.0, num_requests=16, seed=11,
                             vocab_size=97,
+                            system_prompt_pool=3, system_prompt_len=16,
                             prompt_len=LengthDist("uniform", 4, 12),
                             output_len=LengthDist("uniform", 4, 8),
                             deadline_s=120.0)
@@ -532,7 +544,10 @@ def test_open_loop_block_reservation_invariant_and_drain(tiny_model):
         assert len(r.tokens) == r.request.max_new_tokens
     m = server.metrics
     assert m.completed == 16 and m.sla_tracked == 16 and m.sla_violations == 0
+    assert m.prefix_hits > 0                        # the pool actually shared
     assert engine._outstanding_blocks() == 0
+    # after drain: every page free or reclaimable cache, nothing leaked
+    assert engine.kv.free_blocks == engine.config.num_kv_blocks - 1
 
 
 @pytest.mark.slow
@@ -725,3 +740,168 @@ def test_fused_decode_chunk_parity_and_impl_stamp(tiny_model):
     from deepspeed_tpu.runtime.config import ServingConfig
     sv = ServingConfig.from_dict({"enabled": True, "fused_decode_chunk": 8})
     assert sv.fused_decode_chunk == 8
+
+
+# ---------------------------------------------------------------------------
+# prefix KV reuse + speculative decode through the serving tier
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_traffic_pool_sharing_and_determinism():
+    cfg = TrafficConfig(rate_rps=50.0, num_requests=64, seed=9,
+                        system_prompt_pool=4, system_prompt_len=16,
+                        prompt_len=LengthDist("uniform", 4, 8))
+    a, b = OpenLoopTraffic(cfg).schedule(), OpenLoopTraffic(cfg).schedule()
+    heads = set()
+    for (_, ra), (_, rb) in zip(a, b):
+        np.testing.assert_array_equal(ra.prompt, rb.prompt)  # deterministic
+        assert 16 + 4 <= len(ra.prompt) <= 16 + 8
+        heads.add(tuple(ra.prompt[:16].tolist()))
+    assert len(heads) <= 4                       # every head from the pool
+    # Zipf reuse: the hottest system prompt dominates (prefix-cache regime)
+    counts = {}
+    for _, r in a:
+        counts[tuple(r.prompt[:16].tolist())] = \
+            counts.get(tuple(r.prompt[:16].tolist()), 0) + 1
+    assert max(counts.values()) > len(a) // 4
+
+
+def test_scheduler_preempt_requeue_holding_shared_blocks(tiny_model):
+    """COW/refcount stress (satellite): preempting a prefill that MAPS
+    shared prefix pages must not free them under the surviving sharer, the
+    gain accounting must know shared pages don't free, and pool
+    conservation holds through admit/preempt/flush."""
+    engine = _engine(tiny_model, enable_prefix_cache=True, num_kv_blocks=9)
+    s = ContinuousBatchScheduler(engine, "priority", clock=lambda: 0.0)
+    rng = np.random.default_rng(4)
+    head = rng.integers(0, 97, 16).astype(np.int32)
+    pa = np.concatenate([head, rng.integers(0, 97, 8).astype(np.int32)])
+    pb = np.concatenate([head, rng.integers(0, 97, 8).astype(np.int32)])
+    a = ServedResponse(Request(pa, max_new_tokens=8, priority=0), 1, 0.0)
+    b = ServedResponse(Request(pb, max_new_tokens=8, priority=0), 2, 0.0)
+    s.add(a)
+    assert s.admit() == [a]
+    engine.step()                    # two chunks: A's head blocks fill and
+    engine.step()                    # register mid-prefill
+    seq_a = engine.state_manager.get(1)
+    assert seq_a.in_prefill and len(seq_a.hash_chain) == 2
+    s.add(b)
+    assert s.admit() == [b]
+    seq_b = engine.state_manager.get(2)
+    assert seq_b.blocks[:2] == seq_a.blocks[:2]  # mapped, not re-prefilled
+    assert all(engine.kv.refs[p] == 2 for p in seq_a.blocks[:2])
+    engine.kv.assert_conservation([seq_a.blocks, seq_b.blocks])
+    # a high-priority request that needs preemption: both prefills evicted,
+    # and the gain math counted their SHARED pages only once (worst-case
+    # commitment minus held plus solely-owned)
+    c = ServedResponse(Request(rng.integers(0, 97, 24).astype(np.int32),
+                               max_new_tokens=8, priority=5), 3, 0.0)
+    s.add(c)
+    assert s.admit() == [c]
+    # ONE eviction covered the deficit: the gain math knew each victim
+    # frees its un-commitment plus solely-owned pages only
+    assert s.preemptions == 1
+    victim = a if a in s.pending else b
+    assert victim in s.pending and victim.preemptions == 1
+    # the preempted prefill's flush did NOT free the pages it shared with
+    # the survivor — refcount dropped to the survivor's single reference
+    shared = seq_a.blocks[:2]
+    assert all(engine.kv.refs[p] == 1 for p in shared)
+    assert all(engine.kv.index.holds_page(p) for p in shared)
+    engine.kv.assert_conservation(
+        [q.blocks for q in engine.state_manager.all()])
+    # the requeued victim re-admitted once capacity returns re-matches its
+    # head blocks from the index (a preempt-resume pays only the tail)
+    engine.flush(3)
+    s.complete(3)
+    assert s.admit() == [victim]
+    assert engine.state_manager.get(victim.uid).prefix_reused_tokens == 16
+    assert all(engine.kv.refs[p] == 2 for p in shared)
+    engine.kv.assert_conservation(
+        [q.blocks for q in engine.state_manager.all()])
+
+
+def test_server_prefix_spec_parity_and_reuse_metrics(tiny_model):
+    """End-to-end correctness contract: the SAME prefix-heavy open-loop
+    trace served with prefix cache + speculation ON yields bitwise the
+    greedy token streams of the plain server, with reuse counters visible
+    in the snapshot and the telemetry bridge."""
+    traffic = TrafficConfig(rate_rps=300.0, num_requests=20, seed=13,
+                            vocab_size=97,
+                            system_prompt_pool=2, system_prompt_len=16,
+                            prompt_len=LengthDist("uniform", 4, 10),
+                            output_len=LengthDist("uniform", 6, 10))
+
+    def serve(**over):
+        engine = _engine(tiny_model, **over)
+        server = LLMServer(engine, max_queue=64).start()
+        resps, rejected = OpenLoopTraffic(traffic).run(server.submit)
+        assert server.drain(timeout=600) and not rejected
+        return server, resps
+
+    _, base = serve()
+    fast_server, fast = serve(enable_prefix_cache=True, spec_decode_k=4)
+    for rb, rf in zip(base, fast):
+        assert rb.request.request_id == rf.request.request_id
+        assert rf.finish_reason == FINISH_LENGTH
+        np.testing.assert_array_equal(rf.result(), rb.result())
+    snap = fast_server.metrics.snapshot()
+    assert snap["prefix_hits"] > 0 and snap["prefix_hit_rate"] > 0
+    assert snap["prefix_tokens_reused"] > 0
+    assert snap["spec_steps"] > 0                # the verify path actually ran
+    fams = {name for name, *_ in __import__(
+        "deepspeed_tpu.telemetry.manager", fromlist=["x"]
+    ).serving_metrics_samples(fast_server.metrics, {})}
+    assert {"dstpu_serving_prefix_hits_total",
+            "dstpu_serving_prefix_tokens_reused_total",
+            "dstpu_serving_cow_forks_total",
+            "dstpu_serving_spec_accepted_total"} <= fams
+
+
+def test_chaos_replica_kill_with_prefix_cache(tiny_model, tmp_path):
+    """Chaos drill (satellite): replica 0 dies mid-serving with the prefix
+    cache on and identical block-aligned prompts in flight (the COW-fork
+    regime). The router requeues onto the survivor, which re-matches the
+    cached prefix (resume pays only the tail); every request completes
+    bitwise equal to a fault-free run and the survivor's pool conserves."""
+    from deepspeed_tpu.runtime.resilience.chaos import (ChaosEvent,
+                                                        ChaosSchedule,
+                                                        configure_chaos,
+                                                        get_chaos)
+    from deepspeed_tpu.runtime.resilience.heartbeat import (
+        FileHeartbeatTransport)
+
+    prompt = np.arange(1, 25, dtype=np.int32)    # 24 = 3 full blocks of 8
+    mnt = 32
+    ref = _engine(tiny_model).generate([prompt], max_new_tokens=mnt)[0]
+    configure_chaos(ChaosSchedule([
+        ChaosEvent(kind="replica_kill", site="replica0", at=10)]))
+    try:
+        e0 = _engine(tiny_model, enable_prefix_cache=True)
+        e1 = _engine(tiny_model, enable_prefix_cache=True)
+        r0 = LLMServer(e0, replica_id=0, heartbeat_interval_s=0.02)
+        r1 = LLMServer(e1, replica_id=1, heartbeat_interval_s=0.02)
+        router = ReplicaRouter(
+            [r0, r1], transport=FileHeartbeatTransport(str(tmp_path)),
+            dead_after_s=0.4).start()
+        resps = [router.submit(Request(prompt, max_new_tokens=mnt),
+                               block=True) for _ in range(4)]
+        deadline = time.monotonic() + 60
+        while not get_chaos().fired and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert get_chaos().classes_fired() == ["replica_kill"]
+        deadline = time.monotonic() + 60
+        while router.check() == [] and time.monotonic() < deadline:
+            time.sleep(0.05)
+        for i, r in enumerate(resps):
+            assert r.wait(300), f"request {i} lost after the chaos kill"
+            assert r.finish_reason == FINISH_LENGTH
+            np.testing.assert_array_equal(r.result(), ref)
+        assert router.drain(timeout=300)
+        # the survivor served duplicates of one prompt: its cache shared
+        assert e1.reuse.prefix_hits >= 1
+        assert e1._outstanding_blocks() == 0
+        e1.kv.assert_conservation(
+            [s.blocks for s in e1.state_manager.all()])
+    finally:
+        configure_chaos(None)
