@@ -1681,6 +1681,118 @@ def fused_phase_bench():
             "device": jax.devices()[0].platform}
 
 
+def program_compiler_bench():
+    """Rung cp (collective-program compiler, comm/planner/compiler.py):
+    searched program vs the best FIXED-MENU program on a 3-axis
+    ici x ici x dcn mesh the five-candidate menu was never written for
+    (dp_outer=8 forced DCN, ep=2, tp=2 slice-local — 32 virtual devices).
+    The menu's strongest arm keeps an O(p) int8_ef ring on the 8-wide DCN
+    core; the compiler's beam finds the O(log p) tree core the grammar
+    exposes. Metric: exposed DCN wire time per step from the shared cost
+    model — the sum of the per-phase alpha/beta estimates over the phases
+    that touch ``fp.dcn_axes``, menu-best over searched-best (higher =
+    searched wins; deterministic model arithmetic, no wall clock). The
+    acceptance bar is >= 1.3x on DCN exposure and >= 1.15x modeled
+    end-to-end; an executor probe on the real 32-device mesh proves the
+    searched program computes the same mean all-reduce (allclose vs flat
+    XLA — the tree core reassociates, so bitwise is not the contract)."""
+    from deepspeed_tpu.comm.compressed import run_collective_program
+    from deepspeed_tpu.comm.planner import (CollectivePlanner,
+                                            compile_programs,
+                                            legacy_menu_programs, make_site,
+                                            program_summary, reset_planner)
+    from deepspeed_tpu.parallel import Topology, TopologySpec
+    from deepspeed_tpu.utils.shard_map_compat import shard_map_nocheck
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    if len(jax.devices()) < 32:
+        return {"metric": "program_search_dcn_speedup", "value": None,
+                "unit": "ratio", "vs_baseline": None,
+                "error": "needs a 32-device mesh"}
+
+    reset_planner()
+    topo = Topology(TopologySpec(ep=2, tp=2))  # dp_outer=8, ep=2, tp=2
+    pl = CollectivePlanner("static", topology=topo, use_cache=False,
+                           dcn_axes=["dp_outer"])
+    fp = pl.fingerprint
+    site = make_site(op="all_reduce", shape=(1 << 16,), dtype="float32",
+                     axes=("dp_outer", "ep", "tp"), consumer="dp-grad")
+
+    def dcn_exposure(prog):
+        # the same payload walk as estimate_program, summing only the
+        # phases whose span touches a forced-DCN axis
+        n, t = float(site.nbytes), 0.0
+        for st in prog:
+            dt, n = pl.cost.estimate_phase(site, st, n)
+            if any(a in fp.dcn_axes for a in st.axes):
+                t += dt
+        return t
+
+    menu = [(p, pl.cost.estimate_program(site, p))
+            for p in legacy_menu_programs(site, pl.cost, block=pl.block)]
+    menu = [(p, e) for p, e in menu if np.isfinite(e)]
+    menu.sort(key=lambda pe: pe[1])
+    beam = compile_programs(site, pl.cost, block=pl.block,
+                            beam_width=pl.beam_width)
+    if not menu or not beam:
+        return {"metric": "program_search_dcn_speedup", "value": None,
+                "unit": "ratio", "vs_baseline": None,
+                "error": f"menu={len(menu)} beam={len(beam)} candidates"}
+    menu_prog, menu_est = menu[0]
+    searched_prog, searched_est = beam[0]
+    menu_dcn, searched_dcn = dcn_exposure(menu_prog), dcn_exposure(searched_prog)
+
+    # executor probe: the searched winner computes the same MEAN all-reduce
+    # (the dp-grad program convention) on the REAL 32-device mesh (exact
+    # wire; the tree core reassociates the sum, so the contract is
+    # allclose, not bitwise)
+    import dataclasses as _dc
+
+    exact = tuple(_dc.replace(s, wire_dtype="exact", block=None)
+                  for s in searched_prog)
+    mesh = Mesh(np.array(jax.devices()[:32]).reshape(8, 2, 2),
+                ("dp_outer", "ep", "tp"))
+    probe = jnp.linspace(-1.0, 1.0, 1 << 16, dtype=jnp.float32)
+
+    def _ranked(v):
+        # per-rank distinct payload: a replicated probe would make the mean
+        # an identity and prove nothing
+        r = (jax.lax.axis_index("dp_outer") * 4.0
+             + jax.lax.axis_index("ep") * 2.0 + jax.lax.axis_index("tp"))
+        return v * (1.0 + 0.01 * r)
+
+    def prog_fn(v):
+        return run_collective_program(_ranked(v), exact)[0]
+
+    def flat_fn(v):
+        return jax.lax.pmean(_ranked(v), ("dp_outer", "ep", "tp"))
+
+    got = np.asarray(jax.jit(shard_map_nocheck(
+        prog_fn, mesh, in_specs=P(), out_specs=P()))(probe))
+    want = np.asarray(jax.jit(shard_map_nocheck(
+        flat_fn, mesh, in_specs=P(), out_specs=P()))(probe))
+    ok = bool(np.allclose(got, want, rtol=1e-5, atol=1e-5))
+
+    dcn_ratio = menu_dcn / searched_dcn if searched_dcn else None
+    return {"metric": "program_search_dcn_speedup",
+            "value": round(dcn_ratio, 4) if dcn_ratio else None,
+            "unit": "menu-over-searched-dcn-exposure",
+            "vs_baseline": None,
+            "modeled_speedup": round(menu_est / searched_est, 4),
+            "menu_program": program_summary(menu_prog),
+            "searched_program": program_summary(searched_prog),
+            "menu_est_us": round(menu_est * 1e6, 1),
+            "searched_est_us": round(searched_est * 1e6, 1),
+            "menu_dcn_us": round(menu_dcn * 1e6, 1),
+            "searched_dcn_us": round(searched_dcn * 1e6, 1),
+            "searched_uses_tree": any(s.via == "tree"
+                                      for s in searched_prog),
+            "beam_width": len(beam),
+            "executor_allclose_flat_xla": ok,
+            "devices": len(jax.devices()),
+            "device": jax.devices()[0].platform}
+
+
 def telemetry_bench():
     """Rung ob (telemetry spine, deepspeed_tpu/telemetry/): the spine's own
     cost, since it rides every step when enabled — span record overhead
@@ -2277,6 +2389,7 @@ RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "sv": serving_bench, "sv2": serving_prefix_reuse_bench,
          "pd": paged_decode_bench,
          "ds": dcn_hierarchical_bench, "t3": fused_phase_bench,
+         "cp": program_compiler_bench,
          "ob": telemetry_bench, "mem": memory_telemetry_bench,
          "sa": static_audit_bench, "at": control_bench,
          "cz": chaos_soak_bench}
@@ -2304,6 +2417,9 @@ GATE_SPECS = {
     "control_decide_ns": ("lower", 1.0),         # supervisor loop: host cost
     "dcn_hierarchical": ("higher", 0.05),        # ledger bytes: deterministic
     "fused_exposed_fraction": ("lower", 0.05),   # ledger bytes: deterministic
+    # menu/searched DCN-exposure ratio: pure cost-model arithmetic over the
+    # two programs' phase structure — deterministic, tight gate
+    "program_search_dcn_speedup": ("higher", 0.05),
     "llama_zero3_bf16_mfu": ("higher", 0.15),    # the TPU headline: tight
     "paged_decode_step_ms": ("lower", 1.0),      # decode hot path: wall-clock
     # reuse-arm/baseline-arm ratio: both arms share the box so load noise
@@ -2425,6 +2541,8 @@ def run_ladder(gate: bool = False):
     healthy = accelerator_healthy()
     cpu8 = {"JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    cpu32 = {"JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=32"}
     cpu1 = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""}
     chip = {} if healthy else cpu1
     # device count via subprocess probe: touching the backend HERE would hold
@@ -2449,7 +2567,11 @@ def run_ladder(gate: bool = False):
             # t3 gates the fused-phase programs on the same simulated DCN
             # split: exposed-collective fraction from the ledger exposure
             # buckets, fused vs the sequenced PR 8 program at equal wire
-            ("t3", cpu8), ("ob", cpu1),
+            ("t3", cpu8),
+            # cp searches the 3-axis ici x ici x dcn program space the fixed
+            # menu was never written for (32 virtual devices: dp_outer=8
+            # forced DCN, ep=2, tp=2) — menu-vs-searched DCN exposure
+            ("cp", cpu32), ("ob", cpu1),
             # mem measures the recorder/gauge costs; real HBM numbers ride
             # when the chip is healthy, the CPU path measures the host side
             ("mem", chip),
@@ -2533,6 +2655,12 @@ if __name__ == "__main__":
         flags_preset = ("--xla_force_host_platform_device_count"
                         in os.environ.get("XLA_FLAGS", ""))
         needs_cpu8 = args.rung in ("4", "5", "ds", "t3", "at")
+        if args.rung == "cp" and not flags_preset:
+            # cp needs the 32-device virtual mesh (3-axis search substrate)
+            os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                       + " --xla_force_host_platform_device_count=32")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            jax.config.update("jax_platforms", "cpu")
         if args.rung in ("cm", "qx", "plan") and not flags_preset:
             # these run on the real mesh only when it's healthy AND >1 chip
             # (subprocess probes; this process must not init the backend yet)

@@ -169,12 +169,20 @@ def _phase_hlo_kinds(phase_op: str, via: str, quantized: bool
 
     A ring/fused phase is p-1 ``collective-permute`` hops (a fused phase's
     hops additionally interleave with its bound matmul's tiles — same HLO
-    vocabulary, different schedule); a quantized XLA-via phase lowers
-    through the int8 transports of ``comm/compressed.py`` (all-to-all
-    shard exchange + all-gather return); an exact XLA-via phase is the
-    fused native collective."""
-    if via in ("ring", "bidir_ring", "fused_matmul"):
+    vocabulary, different schedule); a tree phase is log2(p) butterfly
+    ``collective-permute`` rounds (exact or int8 wire alike — the
+    recursive halving/doubling of ``run_collective_program``); a quantized
+    XLA-via phase lowers through the int8 transports of
+    ``comm/compressed.py`` (all-to-all shard exchange + all-gather
+    return); an exact XLA-via phase is the fused native collective. A
+    chunked phase (``chunks > 1``) emits the same kinds K times — matching
+    is existence-based on (kind, span), so multiplicity needs no entry.
+    ``all_to_all`` phases exchange shards in place either way (the int8
+    wire all-to-alls values and scales — same kind)."""
+    if via in ("ring", "bidir_ring", "fused_matmul", "tree"):
         return ("collective_permute",)
+    if phase_op == "all_to_all":
+        return ("all_to_all",)
     if quantized:
         if phase_op == "all_reduce":
             return ("all_to_all", "all_gather")
@@ -209,8 +217,10 @@ def _expand_program_phases(sig: str, phases, axis_sizes
         via = ph.get("via", "xla")
         quant = ph.get("wire_dtype", "exact") != "exact"
         ph_axes = tuple(str(a) for a in ph.get("axes", ()))
-        per_hop = via in ("ring", "bidir_ring", "fused_matmul")
+        per_hop = via in ("ring", "bidir_ring", "fused_matmul", "tree")
         tag = f"{sig}:{op}~{via}" if via != "xla" else f"{sig}:{op}"
+        if int(ph.get("chunks", 1) or 1) > 1:
+            tag += f"x{ph.get('chunks')}"
         comp = ph.get("compute") or {}
         if comp.get("site") or comp.get("role"):
             tag += f"@{comp.get('site') or comp.get('role')}"
@@ -221,7 +231,12 @@ def _expand_program_phases(sig: str, phases, axis_sizes
                 # not the phase's product span
                 for ax in ph_axes:
                     span = _axes_span((ax,), axis_sizes)
-                    hops = (span - 1) if span else None
+                    if span and via == "tree":
+                        # butterfly rounds, not ring hops: log2(span)
+                        # permutes per axis of the chained tree
+                        hops = max(1, int(span).bit_length() - 1)
+                    else:
+                        hops = (span - 1) if span else None
                     sites.append(ExpectedSite(
                         kind=kind, span=span, origin="plan",
                         detail=f"{tag}({ax})#hops={hops or '?'}"))

@@ -293,7 +293,165 @@ def _phase_sizes(n: int, phase_op: str, p: int) -> tuple:
         return n_p, n_p // p
     if phase_op == "all_gather":
         return n, n * p
-    return n, n  # all_reduce keeps the payload width
+    return n, n  # all_reduce / all_to_all keep the payload width
+
+
+def _is_pow2(p: int) -> bool:
+    return p > 0 and (p & (p - 1)) == 0
+
+
+def _quantize_wire(v, block, stochastic, key):
+    """flat fp32 -> (int8 [nb, block], one-lane scales [nb, 1], wire bytes)
+    — the per-round tree-exchange wire format (int8 payload + one fp32
+    scale lane per block, the :func:`_quantize_parts` convention)."""
+    q, s, _ = quantize_int8(v, block, stochastic=stochastic, key=key)
+    nb = int(q.shape[0])
+    return q, s[:, :1], nb * block + 4 * nb
+
+
+def _butterfly_perm(p: int, bit: int):
+    return [(i, i ^ bit) for i in range(p)]
+
+
+def _tree_key(key, axis_name, r):
+    """Per-(rank, round) dither stream for stochastic tree rounds — the
+    :func:`quantized_all_reduce` decorrelation rule, folded per round so
+    re-quantizations don't reuse thresholds."""
+    if key is None:
+        return None
+    return jax.random.fold_in(jax.random.fold_in(key, r),
+                              lax.axis_index((axis_name,)))
+
+
+def _tree_all_reduce_axis(v, axis_name: str, *, wire_dtype: str, block: int,
+                          key):
+    """Recursive-doubling all-SUM over one power-of-two axis: log2(p)
+    full-vector pairwise-exchange rounds (partner = rank XOR 2^r) instead
+    of the ring's 2(p-1) hops — the alpha-dominated DCN shape. The exact
+    wire is a butterfly summation tree: deterministic, but a different
+    association than the fused XLA collective, so parity vs ``lax.psum``
+    is allclose-not-bitwise. Quantized wires re-quantize the running sum
+    each round (log2(p) quantization stages). Returns ``(sum, wire_bytes)``
+    — the caller owns the mean division."""
+    p = _axis_size((axis_name,))
+    if p <= 1:
+        return v, 0
+    if not _is_pow2(p):
+        raise ValueError(f"via='tree' needs a power-of-two span on "
+                         f"{axis_name!r}, got {p}")
+    n = int(v.shape[0])
+    quant = wire_dtype in ("int8", "int8_sr")
+    sr = wire_dtype == "int8_sr" and key is not None
+    wire = 0
+    bit, r = 1, 0
+    while bit < p:
+        perm = _butterfly_perm(p, bit)
+        if quant:
+            q, s1, w = _quantize_wire(v, block, sr,
+                                      _tree_key(key, axis_name, r) if sr
+                                      else None)
+            qt = lax.ppermute(q, axis_name, perm)
+            st = lax.ppermute(s1, axis_name, perm)
+            v = v + dequantize_int8(qt, st, (n,))
+            wire += w
+        else:
+            v = v + lax.ppermute(v, axis_name, perm)
+            wire += 4 * n
+        bit <<= 1
+        r += 1
+    return v, wire
+
+
+def _tree_reduce_scatter_axis(v, axis_name: str, *, wire_dtype: str,
+                              block: int, key):
+    """Recursive-halving reduce-SUM-scatter over one power-of-two axis:
+    each of the log2(p) rounds keeps the half of the running segment this
+    rank's index bit owns and exchanges the other half with the partner
+    (total bytes = the ring's n(p-1)/p, in log2(p) alphas). Rank placement
+    matches ``lax.psum_scatter(tiled=True)`` — segment i lands on rank i —
+    with a butterfly association (allclose parity). ``len(v)`` must be
+    divisible by p (the caller's ``_phase_sizes`` padding guarantees it).
+    Returns ``(sum_shard, wire_bytes)``."""
+    p = _axis_size((axis_name,))
+    if p <= 1:
+        return v, 0
+    if not _is_pow2(p):
+        raise ValueError(f"via='tree' needs a power-of-two span on "
+                         f"{axis_name!r}, got {p}")
+    idx = lax.axis_index((axis_name,))
+    quant = wire_dtype in ("int8", "int8_sr")
+    sr = wire_dtype == "int8_sr" and key is not None
+    wire = 0
+    half, r = p, 0
+    while half > 1:
+        half //= 2
+        seg = v.reshape(2, -1)
+        m = int(seg.shape[1])
+        bit = (idx // half) % 2
+        mine = jnp.take(seg, bit, axis=0)
+        send = jnp.take(seg, 1 - bit, axis=0)
+        perm = _butterfly_perm(p, half)
+        if quant:
+            q, s1, w = _quantize_wire(send, block, sr,
+                                      _tree_key(key, axis_name, r) if sr
+                                      else None)
+            qt = lax.ppermute(q, axis_name, perm)
+            st = lax.ppermute(s1, axis_name, perm)
+            v = mine + dequantize_int8(qt, st, (m,))
+            wire += w
+        else:
+            v = mine + lax.ppermute(send, axis_name, perm)
+            wire += 4 * m
+        r += 1
+    return v, wire
+
+
+def _tree_all_gather_axis(v, axis_name: str, *, wire_dtype: str, block: int,
+                          key):
+    """Recursive-doubling all-gather over one power-of-two axis: the shard
+    doubles each round (log2(p) alphas, ring-equivalent n(p-1) bytes).
+    Movement-only, so the exact wire is BITWISE-identical to
+    ``lax.all_gather(tiled=True)``. Quantized wires re-quantize the grown
+    piece each round. Returns ``(gathered, wire_bytes)``."""
+    p = _axis_size((axis_name,))
+    if p <= 1:
+        return v, 0
+    if not _is_pow2(p):
+        raise ValueError(f"via='tree' needs a power-of-two span on "
+                         f"{axis_name!r}, got {p}")
+    idx = lax.axis_index((axis_name,))
+    quant = wire_dtype in ("int8", "int8_sr")
+    sr = wire_dtype == "int8_sr" and key is not None
+    wire = 0
+    bit, r = 1, 0
+    while bit < p:
+        perm = _butterfly_perm(p, bit)
+        n = int(v.shape[0])
+        if quant:
+            q, s1, w = _quantize_wire(v, block, sr,
+                                      _tree_key(key, axis_name, r) if sr
+                                      else None)
+            qt = lax.ppermute(q, axis_name, perm)
+            st = lax.ppermute(s1, axis_name, perm)
+            other = dequantize_int8(qt, st, (n,))
+            wire += w
+        else:
+            other = lax.ppermute(v, axis_name, perm)
+            wire += 4 * n
+        own_bit = (idx // bit) % 2
+        v = jnp.where(own_bit == 0,
+                      jnp.concatenate([v, other]),
+                      jnp.concatenate([other, v]))
+        bit <<= 1
+        r += 1
+    return v, wire
+
+
+def _chunk_bounds(m: int, k: int):
+    """K roughly-equal contiguous [lo, hi) pieces of an m-element span."""
+    k = max(1, min(int(k), m)) if m else 1
+    step = -(-m // k)
+    return [(lo, min(lo + step, m)) for lo in range(0, m, step)]
 
 
 def program_feedback_layout(n: int, program, axis_sizes) -> Optional[tuple]:
@@ -395,6 +553,12 @@ def run_collective_program(x, program, *, feedback=None, key=None):
     n0 = int(np.prod(shape)) if shape else 1
     cur = x.astype(jnp.float32).reshape(-1)
     new_fb = None
+    logical = n0  # the phase-algebra output length (rs shrinks, ag grows)
+    # net scatter/gather balance, tracked exactly: a balanced program (an
+    # all-reduce site's shell mirror) must restore the caller's width even
+    # when a ragged payload ceil-pads through the scatter levels (1111 ->
+    # rs(2) 556 -> ag(2) 1112 would otherwise misread as a gather site)
+    net_num = net_den = 1
     for st in program:
         names = tuple(st.axes)
         p = _axis_size(names)
@@ -403,11 +567,15 @@ def run_collective_program(x, program, *, feedback=None, key=None):
         n = int(cur.shape[0])
         sr = st.wire_dtype == "int8_sr"
         fused = getattr(st, "via", "xla") == "fused_matmul"
+        tree = getattr(st, "via", "xla") == "tree"
+        chunks = int(getattr(st, "chunks", 1) or 1)
         ftag = (st.compute.tag() if fused and st.compute is not None
                 else "fused")
         fblk = st.block or compression_block()
         if st.phase_op == "reduce_scatter":
-            n_p, _ = _phase_sizes(n, "reduce_scatter", p)
+            logical = -(-logical // p)
+            net_den *= p
+            n_p, out_len = _phase_sizes(n, "reduce_scatter", p)
             padded = jnp.pad(cur, (0, n_p - n))
             if fused:
                 # compute-bound chunk ring (per-axis chain, same bytes as
@@ -424,49 +592,120 @@ def run_collective_program(x, program, *, feedback=None, key=None):
                         shard, a, wire_dtype=st.wire_dtype, block=fblk,
                         stochastic=sr, key=key, link=st.link, tag=ftag)
                 cur = shard / p
+            elif tree:
+                # recursive halving, per-axis chain (first-to-last nests
+                # segment placement identically to the flat tuple scatter)
+                shard, wire = padded, 0
+                for a in names:
+                    shard, w = _tree_reduce_scatter_axis(
+                        shard, a, wire_dtype=st.wire_dtype, block=fblk,
+                        key=key)
+                    wire += w
+                cur = shard / p
+                moved = 4 * n_p * (p - 1) // p
+                _log("program_reduce_scatter", moved, wire, st.link,
+                     axes=names, impl=f"tree:{st.wire_dtype}")
             elif st.wire_dtype == "exact":
-                cur = lax.psum_scatter(padded, names, scatter_dimension=0,
-                                       tiled=True) / p
+                if chunks > 1:
+                    # column pipelining: [p, cols] view, scatter each
+                    # column piece — rank placement (and bits) identical
+                    # to the flat scatter, but phase N+1 can start on
+                    # piece 1 while piece 2 streams
+                    cols = padded.reshape(p, n_p // p)
+                    outs = [lax.psum_scatter(
+                        cols[:, lo:hi].reshape(-1), names,
+                        scatter_dimension=0, tiled=True)
+                        for lo, hi in _chunk_bounds(n_p // p, chunks)]
+                    cur = jnp.concatenate(outs) / p
+                else:
+                    cur = lax.psum_scatter(padded, names,
+                                           scatter_dimension=0,
+                                           tiled=True) / p
                 moved = 4 * n_p * (p - 1) // p
                 _log("program_reduce_scatter", moved, moved, st.link,
                      axes=names, impl="exact")
+            elif chunks > 1:
+                cols = padded.reshape(p, n_p // p)
+                outs = [quantized_reduce_scatter(
+                    cols[:, lo:hi].reshape(-1), names, block=st.block,
+                    stochastic=sr, key=key, link=st.link)
+                    for lo, hi in _chunk_bounds(n_p // p, chunks)]
+                cur = jnp.concatenate(outs)
             else:
                 cur = quantized_reduce_scatter(padded, names, block=st.block,
                                                stochastic=sr, key=key,
                                                link=st.link)
         elif st.phase_op == "all_reduce":
-            if st.wire_dtype == "exact":
-                cur = lax.pmean(cur, names)
+            if tree:
+                total, wire = cur, 0
+                for a in names:
+                    total, w = _tree_all_reduce_axis(
+                        total, a, wire_dtype=st.wire_dtype, block=fblk,
+                        key=key)
+                    wire += w
+                cur = total / p
+                moved = 2 * 4 * n * (p - 1) // p
+                _log("program_all_reduce", moved, wire, st.link,
+                     axes=names, impl=f"tree:{st.wire_dtype}")
+            elif st.wire_dtype == "exact":
+                if chunks > 1:
+                    outs = [lax.pmean(cur[lo:hi], names)
+                            for lo, hi in _chunk_bounds(n, chunks)]
+                    cur = jnp.concatenate(outs)
+                else:
+                    cur = lax.pmean(cur, names)
                 moved = 2 * 4 * n * (p - 1) // p
                 _log("program_all_reduce", moved, moved, st.link,
                      axes=names, impl="exact")
             else:
                 fb = feedback if st.wire_dtype == "int8_ef" else None
-                out = quantized_all_reduce(cur, names, block=st.block,
-                                           stochastic=sr, key=key,
-                                           feedback=fb, link=st.link)
-                if fb is not None:
-                    cur, new_fb = out
+                if chunks > 1:  # int8_ef never chunks (IR validation)
+                    outs = [quantized_all_reduce(cur[lo:hi], names,
+                                                 block=st.block,
+                                                 stochastic=sr, key=key,
+                                                 link=st.link)
+                            for lo, hi in _chunk_bounds(n, chunks)]
+                    cur = jnp.concatenate(outs)
                 else:
-                    cur = out
+                    out = quantized_all_reduce(cur, names, block=st.block,
+                                               stochastic=sr, key=key,
+                                               feedback=fb, link=st.link)
+                    if fb is not None:
+                        cur, new_fb = out
+                    else:
+                        cur = out
         elif st.phase_op == "all_gather":
+            logical = logical * p
+            net_num *= p
             if fused:
                 # compute-bound gather ring: the consuming matmul's tiles
                 # hide the hops (data movement only — exact wire is
-                # bitwise; int8 decodes rank-invariantly on arrival)
+                # bitwise; int8 decodes rank-invariantly on arrival).
+                # Last-axis-first chain: the tuple collective's tiled
+                # placement (and the inverse of the rs chain's nesting)
                 from ..ops.collective_matmul import fused_ring_all_gather
 
-                for a in names:
+                for a in reversed(names):
                     if _axis_size((a,)) <= 1:
                         continue
                     cur = fused_ring_all_gather(
                         cur, a, wire_dtype=st.wire_dtype, block=fblk,
                         link=st.link, tag=ftag)
+            elif tree:
+                wire = 0
+                for a in reversed(names):
+                    cur, w = _tree_all_gather_axis(
+                        cur, a, wire_dtype=st.wire_dtype, block=fblk,
+                        key=key)
+                    wire += w
+                moved = 4 * n * (p - 1)
+                _log("program_all_gather", moved, wire, st.link,
+                     axes=names, impl=f"tree:{st.wire_dtype}")
             elif st.via in ("ring", "bidir_ring"):
                 from ..ops.collective_matmul import ring_all_gather
                 from .comm import get_comms_logger
 
-                for a in names:  # per-axis chain: same bytes as the fused op
+                for a in reversed(names):  # per-axis chain, tuple placement
                     if st.link is not None:
                         # the ring logs its own chunked per-op ledger entry
                         # without hop awareness; bucket its wire bytes here
@@ -477,14 +716,51 @@ def run_collective_program(x, program, *, feedback=None, key=None):
                     cur = ring_all_gather(cur, a,
                                           bidirectional=st.via == "bidir_ring")
             elif st.wire_dtype == "exact":
-                cur = lax.all_gather(cur, names, axis=0, tiled=True)
+                if chunks > 1:
+                    outs = [lax.all_gather(cur[lo:hi], names, axis=0,
+                                           tiled=True).reshape(p, -1)
+                            for lo, hi in _chunk_bounds(n, chunks)]
+                    cur = jnp.concatenate(outs, axis=1).reshape(-1)
+                else:
+                    cur = lax.all_gather(cur, names, axis=0, tiled=True)
                 moved = 4 * n * (p - 1)
                 _log("program_all_gather", moved, moved, st.link,
                      axes=names, impl="exact")
+            elif chunks > 1:
+                outs = [quantized_all_gather(cur[lo:hi], names,
+                                             block=st.block,
+                                             link=st.link).reshape(p, -1)
+                        for lo, hi in _chunk_bounds(n, chunks)]
+                cur = jnp.concatenate(outs, axis=1).reshape(-1)
             else:
                 cur = quantized_all_gather(cur, names, block=st.block,
                                            link=st.link).reshape(-1)
-    return cur[:n0].reshape(shape), new_fb
+        elif st.phase_op == "all_to_all":
+            if n % p:
+                raise ValueError(
+                    f"all_to_all phase needs a payload divisible by its "
+                    f"span ({n} % {p}); the compiler gates on this")
+            rows = cur.reshape(p, n // p)
+            if st.wire_dtype == "exact":
+                outs = [lax.all_to_all(rows[:, lo:hi].reshape(-1), names,
+                                       split_axis=0, concat_axis=0,
+                                       tiled=True).reshape(p, -1)
+                        for lo, hi in _chunk_bounds(n // p, chunks)]
+                cur = jnp.concatenate(outs, axis=1).reshape(-1)
+                moved = 4 * n * (p - 1) // p
+                _log("program_all_to_all", moved, moved, st.link,
+                     axes=names, impl="exact")
+            else:
+                outs = [quantized_all_to_all(
+                    rows[:, lo:hi], names, split_dim=0, concat_dim=0,
+                    block=st.block, stochastic=sr, key=key)
+                    for lo, hi in _chunk_bounds(n // p, chunks)]
+                cur = jnp.concatenate(outs, axis=1).reshape(-1)
+    if net_num == net_den:
+        return cur[:n0].reshape(shape), new_fb
+    # a gather/scatter/exchange-site program: the flat phase-algebra result
+    # (callers at such sites pass flat payloads — the probe convention)
+    return cur[:logical], new_fb
 
 
 # ---------------------------------------------------------------------------

@@ -8,11 +8,14 @@ menu into an automatic, measured, cached per-site decision (GC3, arxiv
 """
 
 from .cache import PlanCache, default_cache_dir
+from .compiler import (DEFAULT_BEAM_WIDTH, PROGRAM_CAPABLE, SEARCH_SPACE,
+                       compile_programs, legacy_menu_programs,
+                       program_capable)
 from .ir import (CONSUMERS, FUSED_PHASE_OPS, FUSED_ROLES, IMPLEMENTATIONS,
                  LINK_CLASSES, OP_MENU, PHASE_OPS, PHASE_VIAS, PLAN_FORMAT,
                  WIRE_DTYPES, CollectiveSite, FusedCompute, PhaseStep, Plan,
                  PlanDecision, make_phase, make_site, program_summary)
-from .microbench import benchmark_site
+from .microbench import benchmark_site, probe_stats, reset_probe_memo
 from .planner import (MODES, CollectivePlanner, configure_from_config,
                       configure_planner, get_planner, planner_active,
                       reset_planner, resolve_site, synthesize_programs)
@@ -24,8 +27,11 @@ __all__ = [
     "FUSED_PHASE_OPS", "FUSED_ROLES", "PLAN_FORMAT",
     "CollectiveSite", "Plan", "PlanDecision", "PhaseStep", "FusedCompute",
     "make_site", "make_phase", "program_summary", "synthesize_programs",
+    "SEARCH_SPACE", "DEFAULT_BEAM_WIDTH", "PROGRAM_CAPABLE",
+    "compile_programs", "legacy_menu_programs", "program_capable",
     "MeshFingerprint", "CostModel", "LinkParams",
-    "PlanCache", "default_cache_dir", "benchmark_site",
+    "PlanCache", "default_cache_dir", "benchmark_site", "probe_stats",
+    "reset_probe_memo",
     "CollectivePlanner", "configure_planner", "configure_from_config",
     "get_planner", "planner_active", "reset_planner", "resolve_site",
 ]

@@ -36,19 +36,42 @@ def default_cache_dir() -> str:
 
 
 class PlanCache:
-    def __init__(self, cache_dir: Optional[str] = None):
+    def __init__(self, cache_dir: Optional[str] = None,
+                 space_version: Optional[int] = None):
         self.cache_dir = cache_dir or default_cache_dir()
+        # search-space version (``compiler.SEARCH_SPACE``): part of the
+        # cache identity. A winner is only the argmin OVER THE SPACE IT WAS
+        # SEARCHED IN — widening the program grammar must read as a clean
+        # miss (re-tune), never replay a stale narrower-space winner. None
+        # keeps the legacy unversioned filename (pre-compiler callers).
+        self.space_version = (None if space_version is None
+                              else int(space_version))
 
     def path_for(self, fp: MeshFingerprint) -> str:
-        return os.path.join(self.cache_dir, f"plan_{fp.digest()}.json")
+        tag = ("" if self.space_version is None
+               else f"_s{self.space_version}")
+        return os.path.join(self.cache_dir, f"plan_{fp.digest()}{tag}.json")
 
     def load(self, fp: MeshFingerprint) -> Optional[Plan]:
         """The cached plan for this fingerprint, or None. A corrupt or
         foreign-format file reads as a miss, never an error — the planner
         just re-tunes and overwrites it. Transient read errors (shared-FS
         hiccups) retry under the shared backoff first (``dstpu_retry_total
-        {site=plan_cache.load}``); an absent file is an immediate miss."""
-        path = self.path_for(fp)
+        {site=plan_cache.load}``); an absent file is an immediate miss.
+
+        A version-carrying cache also falls back to the LEGACY unversioned
+        filename: a pre-compiler plan file has no search-space identity and
+        migrates on read (same precedent as the unstamped-format
+        migration), while a file stamped with a DIFFERENT version — the
+        case the versioning exists for — stays a miss."""
+        plan = self._load_path(self.path_for(fp), fp)
+        if plan is None and self.space_version is not None:
+            legacy = os.path.join(self.cache_dir,
+                                  f"plan_{fp.digest()}.json")
+            plan = self._load_path(legacy, fp)
+        return plan
+
+    def _load_path(self, path: str, fp: MeshFingerprint) -> Optional[Plan]:
         chaos = get_chaos()
 
         def _read():
@@ -63,6 +86,16 @@ class PlanCache:
             plan = Plan.from_dict(json.loads(body))
         except (RetryError, OSError, ValueError, KeyError, TypeError):
             return None
+        if self.space_version is not None:
+            # belt + braces beside the filename tag: a copied/renamed file
+            # from another search-space version still reads as a miss (an
+            # UNSTAMPED body is legacy and migrates)
+            try:
+                stamped = json.loads(body).get("search_space")
+            except ValueError:
+                return None
+            if stamped is not None and int(stamped) != self.space_version:
+                return None
         return plan if plan.fingerprint == fp.digest() else None
 
     def store(self, fp: MeshFingerprint, plan: Plan) -> str:
@@ -86,6 +119,8 @@ class PlanCache:
             merged.decisions.update(plan.decisions)
             body = {"fingerprint": fp.digest(), "mesh": fp.to_dict(),
                     **merged.to_dict()}
+            if self.space_version is not None:
+                body["search_space"] = self.space_version
             fd, tmp = tempfile.mkstemp(dir=self.cache_dir, suffix=".tmp")
             try:
                 with os.fdopen(fd, "w") as f:

@@ -48,18 +48,23 @@ IMPLEMENTATIONS = ("xla", "ring", "bidir_ring", "hierarchical", "int8",
 
 # the phase vocabulary a program decision is built from; each phase lowers
 # to one collective primitive over its own axes with its own wire dtype
-PHASE_OPS = ("reduce_scatter", "all_reduce", "all_gather")
+# (all_to_all phases exist for the compiler's single-phase a2a-site
+# programs — chunked/quantized variants of the flat exchange)
+PHASE_OPS = ("reduce_scatter", "all_reduce", "all_gather", "all_to_all")
 # exact     — native-dtype payload, bit-faithful transport
 # int8      — block-quantized payload + one-lane scales, nearest rounding
 # int8_sr   — block-quantized + stochastic rounding (unbiased per element)
 # int8_ef   — block-quantized + ErrorFeedbackState residual carry (the DCN
 #             gradient hop: quantization error re-injected next step)
 WIRE_DTYPES = ("exact", "int8", "int8_sr", "int8_ef")
-# how a phase lowers: the fused XLA collective, a ppermute chunk ring, or a
+# how a phase lowers: the fused XLA collective, a ppermute chunk ring, a
 # ppermute chunk ring BOUND to the matmul that produces/consumes the payload
 # (T3-style: the hops ride between the compute site's tile steps and hide
-# behind them — such phases must carry a FusedCompute descriptor)
-PHASE_VIAS = ("xla", "ring", "bidir_ring", "fused_matmul")
+# behind them — such phases must carry a FusedCompute descriptor), or a
+# recursive-doubling/halving butterfly ("tree": log2(p) ppermute rounds
+# instead of p-1 ring hops — the alpha-dominated regime's shape; the span
+# must be a power of two, enforced at synthesis where the span is known)
+PHASE_VIAS = ("xla", "ring", "bidir_ring", "fused_matmul", "tree")
 # phase ops a fused_matmul via can realize: the all-gather side (consumer
 # matmul eats the arriving chunks) and the reduce-scatter side (producer
 # matmul feeds the departing chunks); a one-shot all_reduce has no tile
@@ -222,6 +227,15 @@ class PhaseStep:
     (``ici``/``dcn``/``host``; synthesis stamps it from the mesh
     fingerprint so the ledger can report DCN-class bytes without
     re-deriving topology at trace time).
+
+    ``chunks`` > 1 column-splits the payload into K pipelined pieces so
+    the next phase can start on chunk 1 while this phase streams chunk 2
+    (priced alpha x K vs overlapped beta in ``topo.estimate_program``).
+    The column layout keeps reduce_scatter/all_gather rank-placement
+    identical to the flat collective, so a chunked exact phase stays
+    bitwise-equal to its unchunked twin. Only the ``xla`` via chunks (the
+    ring/tree/fused lowerings already stream per-hop pieces), and
+    ``int8_ef`` never chunks (the residual is one full-tensor carry).
     """
     phase_op: str
     axes: Tuple[str, ...]
@@ -230,6 +244,7 @@ class PhaseStep:
     via: str = "xla"
     link: Optional[str] = None
     compute: Optional[FusedCompute] = None
+    chunks: int = 1
 
     def __post_init__(self):
         if self.phase_op not in PHASE_OPS:
@@ -246,6 +261,23 @@ class PhaseStep:
                              f"menu: {LINK_CLASSES}")
         if not self.axes:
             raise ValueError("a PhaseStep needs at least one mesh axis")
+        if int(self.chunks) < 1:
+            raise ValueError(f"chunks must be >= 1, got {self.chunks}")
+        if self.chunks > 1 and self.via != "xla":
+            raise ValueError(
+                f"chunked pipelining only lowers via 'xla' (the "
+                f"{self.via!r} via already streams per-hop pieces)")
+        if self.wire_dtype == "int8_ef" and self.chunks > 1:
+            raise ValueError("int8_ef never chunks (the error-feedback "
+                             "residual is one full-tensor carry)")
+        if self.phase_op == "all_to_all" and self.via != "xla":
+            raise ValueError("all_to_all phases lower via 'xla' only")
+        if self.via in ("ring", "bidir_ring", "tree"):
+            if self.wire_dtype == "int8_ef":
+                raise ValueError(
+                    "int8_ef rides xla all_reduce phases (the two-stage "
+                    "server layout); hop-structured vias take "
+                    "exact|int8|int8_sr")
         if self.via == "fused_matmul":
             if self.phase_op not in FUSED_PHASE_OPS:
                 raise ValueError(
@@ -283,6 +315,8 @@ class PhaseStep:
             d["link"] = self.link
         if self.compute is not None:
             d["compute"] = self.compute.to_dict()
+        if self.chunks != 1:
+            d["chunks"] = int(self.chunks)
         return d
 
     @classmethod
@@ -306,19 +340,23 @@ class PhaseStep:
 def make_phase(phase_op: str, axes: Sequence[str], *,
                wire_dtype: str = "exact", block: Optional[int] = None,
                via: str = "xla", link: Optional[str] = None,
-               compute: Optional[FusedCompute] = None) -> PhaseStep:
+               compute: Optional[FusedCompute] = None,
+               chunks: int = 1) -> PhaseStep:
     """Normalizing :class:`PhaseStep` constructor (the ``make_site`` twin)."""
     return PhaseStep(phase_op=str(phase_op),
                      axes=tuple(str(a) for a in axes),
                      wire_dtype=str(wire_dtype),
                      block=None if block is None else int(block),
-                     via=str(via), link=link, compute=compute)
+                     via=str(via), link=link, compute=compute,
+                     chunks=int(chunks))
 
 
 def program_summary(program: Sequence[PhaseStep]) -> str:
     """Compact one-line program rendering for logs and the plan table:
-    ``rs(ep)>ar.int8_ef(dp_outer)>ag(ep)``."""
-    short = {"reduce_scatter": "rs", "all_reduce": "ar", "all_gather": "ag"}
+    ``rs(ep)>ar.int8_ef(dp_outer)>ag(ep)`` (chunked phases carry ``xK``:
+    ``ar.int8(dp_outer)x4``)."""
+    short = {"reduce_scatter": "rs", "all_reduce": "ar", "all_gather": "ag",
+             "all_to_all": "a2a"}
     parts = []
     for s in program:
         tag = short[s.phase_op]
@@ -326,7 +364,10 @@ def program_summary(program: Sequence[PhaseStep]) -> str:
             tag += f".{s.wire_dtype}"
         if s.via != "xla":
             tag += f"~{s.via}"
-        parts.append(f"{tag}({','.join(s.axes)})")
+        tag += f"({','.join(s.axes)})"
+        if s.chunks != 1:
+            tag += f"x{s.chunks}"
+        parts.append(tag)
     return ">".join(parts)
 
 
@@ -395,7 +436,9 @@ class PlanDecision:
 
 # On-disk plan format. 1 = the PR 8 shape (no version stamp, phase vias
 # xla|ring|bidir_ring); 2 adds the fused_matmul via + FusedCompute compute
-# bindings and stamps ``format`` into the serialized plan. Loading:
+# bindings and stamps ``format`` into the serialized plan; 3 adds the
+# compiler vocabulary — ``chunks`` pipelining, the ``tree`` via, and
+# ``all_to_all`` phases. Loading:
 #   - no stamp (a stale PR 8 ``plan_<digest>.json``): version-skew-migrated —
 #     every decision re-parses under the STRICT from_dict vocabulary, so a
 #     file whose content doesn't actually match the v1 vocabulary fails the
@@ -403,7 +446,7 @@ class PlanDecision:
 #     that doesn't understand it;
 #   - stamp > PLAN_FORMAT (a plan written by a newer build): rejected
 #     outright — its decisions may carry semantics this executor can't run.
-PLAN_FORMAT = 2
+PLAN_FORMAT = 3
 
 
 class Plan:

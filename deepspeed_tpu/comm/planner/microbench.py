@@ -138,7 +138,14 @@ def build_probe(site: CollectiveSite, impl: str, *, mesh=None,
             from ..compressed import run_collective_program
 
             out, _ = run_collective_program(v, program)
-            return out
+            # close the carry shape: gather/scatter/a2a programs change the
+            # payload width (all_reduce ones keep it) — fold back to n
+            out = out.reshape(-1)
+            if out.size == v.size:
+                return out
+            if out.size > v.size:
+                return out[:v.size]
+            return jnp.tile(out, -(-v.size // out.size))[:v.size]
         if site.op == "all_reduce":
             if impl == "xla":
                 return lax.pmean(v, axes)
@@ -272,12 +279,63 @@ def build_probe(site: CollectiveSite, impl: str, *, mesh=None,
     return fn, x
 
 
+# --------------------------------------------------------------------------
+# process-level probe memo: measure-mode tuning and the autotune sweeps
+# resolve overlapping candidate sets (several planner instances in one
+# process, the autotuner's program sweep, the bench rungs) — each distinct
+# probe SIGNATURE compiles and times exactly once per process. Keyed by
+# everything that changes the compiled probe or its timing; the live mesh
+# rides in as its axis-size map so a set_topology() switch is a different
+# signature, never a stale hit.
+# --------------------------------------------------------------------------
+
+_PROBE_MEMO: dict = {}
+_PROBE_STATS = {"calls": 0, "built": 0, "hits": 0}
+
+
+def _memo_key(site: CollectiveSite, impl: str, mesh, block, reps, repeats,
+              max_elems, program):
+    if mesh is None:
+        try:
+            from ...parallel.topology import get_topology
+
+            mesh = get_topology().mesh
+        except Exception:
+            mesh = None
+    mesh_key = (tuple(sorted(mesh.shape.items())) if mesh is not None
+                else ())
+    return (site.signature(), impl, mesh_key, block, int(reps), int(repeats),
+            int(max_elems), tuple(program) if program else None)
+
+
+def probe_stats() -> dict:
+    """Counters for the process-level probe memo: ``calls`` (benchmark_site
+    invocations), ``built`` (probes actually compiled+timed), ``hits``
+    (answered from the memo). ``built`` is the cost that must shrink."""
+    return dict(_PROBE_STATS)
+
+
+def reset_probe_memo() -> None:
+    _PROBE_MEMO.clear()
+    _PROBE_STATS.update(calls=0, built=0, hits=0)
+
+
 def benchmark_site(site: CollectiveSite, impl: str, *, mesh=None,
                    block: Optional[int] = None, reps: int = 4,
                    repeats: int = 3, max_elems: int = 1 << 16,
-                   program=None) -> float:
+                   program=None, memo: bool = True) -> float:
     """Min-of-``repeats`` wall-clock seconds per single execution of
-    ``impl`` at (a capped version of) ``site``. Compile excluded."""
+    ``impl`` at (a capped version of) ``site``. Compile excluded.
+
+    ``memo=False`` bypasses the process-level memo both ways (no read, no
+    write) — for callers that want a fresh wall-clock sample, e.g. drift
+    re-checks."""
+    _PROBE_STATS["calls"] += 1
+    key = _memo_key(site, impl, mesh, block, reps, repeats, max_elems,
+                    program) if memo else None
+    if key is not None and key in _PROBE_MEMO:
+        _PROBE_STATS["hits"] += 1
+        return _PROBE_MEMO[key]
     fn, x = build_probe(site, impl, mesh=mesh, block=block, reps=reps,
                         max_elems=max_elems, program=program)
     float(fn(x))  # compile + drain
@@ -286,4 +344,7 @@ def benchmark_site(site: CollectiveSite, impl: str, *, mesh=None,
         t0 = time.perf_counter()
         float(fn(x))
         best = min(best, (time.perf_counter() - t0) / reps)
+    _PROBE_STATS["built"] += 1
+    if key is not None:
+        _PROBE_MEMO[key] = best
     return best

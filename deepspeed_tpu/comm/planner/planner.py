@@ -23,6 +23,8 @@ import dataclasses
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .cache import PlanCache
+from .compiler import (DEFAULT_BEAM_WIDTH, SEARCH_SPACE, compile_programs,
+                       legacy_menu_programs, program_capable)
 from .ir import (GRADIENT_CONSUMERS, CollectiveSite, FusedCompute, PhaseStep,
                  Plan, PlanDecision, make_phase, make_site, program_summary)
 from .microbench import benchmark_site
@@ -33,68 +35,13 @@ MODES = ("off", "static", "measure")
 
 def synthesize_programs(site: CollectiveSite, cost: CostModel,
                         block: int = 2048) -> List[Tuple[PhaseStep, ...]]:
-    """Candidate multi-phase programs for a multi-axis site (the GC3 move:
-    the planner doesn't just pick among fixed impls, it COMPOSES phase
-    sequences and lets the cost model / microbench rank them).
-
-    For an all-reduce whose span splits into (inner slice-local, outer
-    cross-slice) axes, the candidates are the bandwidth-optimal hierarchy:
-
-    - ``rs(inner) > ar.int8[_ef](outer) > ag(inner)`` — exact reduce-scatter
-      over ICI shrinks the per-rank payload by the inner span, the DCN hop
-      carries int8 (+error feedback on gradient consumers), the all-gather
-      restores full width over ICI;
-    - the same shape with an exact outer hop (hierarchical-exact);
-    - a bidirectional-ring all-gather variant (both ICI directions busy);
-    - FUSED-hierarchical variants (the T3 move): the ICI reduce-scatter
-      and all-gather phases carry ``via="fused_matmul"`` bound to the
-      site's producing/consuming matmuls — their ppermute hops ride
-      between the matmul tile steps (``ops/collective_matmul.py`` fused
-      rings) instead of running as exposed transport, with the same exact
-      wire (bitwise vs the sequenced ring) or an int8 wire per hop. The
-      cost model prices them with the overlap credit, so they compete
-      with everything else on one scale.
-
-    Flat single-impl candidates stay in the normal menu — synthesis only
-    ADDS programs; an all-ICI mesh still gets them as candidates and the
-    cost model prices the extra phases honestly (they lose there).
-    """
-    if site.op != "all_reduce" or site.axis_size is not None:
-        return []
-    inner, outer = cost.dcn_split(site)
-    if not inner or not outer:
-        return []
-    fp = cost.fp
-    if fp.axis_size(inner) <= 1 or fp.axis_size(outer) <= 1:
-        return []
-    in_link = "ici" if (fp.platform == "tpu" or fp.dcn_axes) else "host"
-    out_link = ("dcn" if any(a in fp.dcn_axes for a in outer) else in_link)
-    wire = "int8_ef" if site.consumer in GRADIENT_CONSUMERS else "int8"
-    rs = make_phase("reduce_scatter", inner, link=in_link)
-    ag = make_phase("all_gather", inner, link=in_link)
-    ag_bidir = make_phase("all_gather", inner, via="bidir_ring", link=in_link)
-    ar_exact = make_phase("all_reduce", outer, link=out_link)
-    ar_int8 = make_phase("all_reduce", outer, wire_dtype=wire, block=block,
-                         link=out_link)
-    # fused twins: exact wire on the ICI hops (bitwise vs the sequenced
-    # ring), bound producer-side on the reduce-scatter (the backward
-    # matmuls feed it) and consumer-side on the gather (the update math
-    # eats it); tile=0 — the engine binds real chunk sizes at compile
-    # (comm.compressed.bind_fused_tiles)
-    rs_f = make_phase("reduce_scatter", inner, via="fused_matmul",
-                      link=in_link,
-                      compute=FusedCompute(role="producer",
-                                           site=f"{site.consumer}/bwd"))
-    ag_f = make_phase("all_gather", inner, via="fused_matmul", link=in_link,
-                      compute=FusedCompute(role="consumer",
-                                           site=f"{site.consumer}/apply"))
-    return [
-        (rs, ar_int8, ag),          # hierarchical-int8-outer (the DCN shape)
-        (rs, ar_exact, ag),         # hierarchical-exact
-        (rs, ar_int8, ag_bidir),    # bidir-ring gather variant
-        (rs_f, ar_int8, ag_f),      # fused-hierarchical (the t3 shape)
-        (rs_f, ar_exact, ag_f),     # fused-hierarchical, exact outer
-    ]
+    """Compat shim: PR 8's five hand-written hierarchical candidates,
+    exactly as before. Real synthesis moved to ``planner/compiler.py`` —
+    :func:`compile_programs` searches the full program space (axis
+    groupings x algorithm shapes x wire dtypes x chunking) and the
+    planner's ``_candidates`` uses that beam; this function remains for
+    callers and tests that want the legacy fixed menu."""
+    return legacy_menu_programs(site, cost, block=block)
 
 
 class CollectivePlanner:
@@ -107,6 +54,8 @@ class CollectivePlanner:
                  measure_max_elems: int = 1 << 16,
                  block: int = 2048,
                  dcn_axes: Optional[Sequence[str]] = None,
+                 beam_width: Optional[int] = None,
+                 overlap_credit: Optional[float] = None,
                  topology=None):
         if mode not in MODES:
             raise ValueError(f"comm_planner mode must be one of {MODES}, "
@@ -117,6 +66,9 @@ class CollectivePlanner:
         self.measure_reps = int(measure_reps)
         self.measure_max_elems = int(measure_max_elems)
         self.block = int(block)
+        self.beam_width = int(beam_width) if beam_width else DEFAULT_BEAM_WIDTH
+        self.overlap_credit = (None if overlap_credit is None
+                               else float(overlap_credit))
         self.fingerprint = MeshFingerprint.capture(topology)
         forced = ()
         if dcn_axes:
@@ -149,9 +101,16 @@ class CollectivePlanner:
                                           | set(forced))))
         # fleet costing only when an override actually took: a typo'd
         # dcn_axes must not silently switch quantization to TPU rates
+        self._assume_fleet = bool(forced)
         self.cost = CostModel(self.fingerprint, block=self.block,
-                              assume_fleet=bool(forced))
-        self.cache = PlanCache(cache_dir) if use_cache else None
+                              assume_fleet=self._assume_fleet,
+                              overlap_credit=self.overlap_credit)
+        # the winner cache is keyed by (fingerprint, SEARCH_SPACE): widening
+        # the compiler's grammar in a later version is a clean cache miss —
+        # a winner searched over a narrower space must not be replayed
+        self.cache = (PlanCache(cache_dir, space_version=SEARCH_SPACE)
+                      if use_cache else None)
+        self._search_notes: Dict[str, str] = {}
         self.plan = Plan(fingerprint=self.fingerprint.digest())
         self._from_cache = set()
         if self.cache is not None and mode != "off":
@@ -241,8 +200,10 @@ class CollectivePlanner:
             penalties[a] = max(penalties.get(a, 1.0), float(penalty))
         # fleet costing: the demoted link is priced as the slow cross-host
         # hop it behaves as; quant at accelerator rates, as with dcn_axes
+        self._assume_fleet = True
         self.cost = CostModel(self.fingerprint, block=self.block,
-                              assume_fleet=True, link_penalties=penalties)
+                              assume_fleet=True, link_penalties=penalties,
+                              overlap_credit=self.overlap_credit)
         drop = {sig for sig in self.plan.decisions
                 if sig.split(":", 1)[0] in set(consumers)}
         self.plan = Plan(
@@ -322,15 +283,34 @@ class CollectivePlanner:
 
     def _candidates(self, site: CollectiveSite):
         """Cost-ranked, margin-pruned ``(impl, est_s, program)`` candidates:
-        the single-impl menu (``CostModel.prune``) PLUS every synthesized
-        multi-phase program, priced on the same alpha-beta scale. Stable
-        sort keeps synthesis order on ties (int8-outer before its bidir
-        variant)."""
+        the single-impl menu (``CostModel.prune``) PLUS the compiled program
+        beam (``compiler.compile_programs`` — groupings x shapes x wires x
+        chunking, slot-pruned), priced on the same alpha-beta scale. Stable
+        sort keeps emission order on ties, with singles listed first so a
+        program that merely MATCHES a flat impl can never displace it.
+
+        Program candidates only survive at sites whose wiring can execute
+        a program decision (``compiler.PROGRAM_CAPABLE`` — today the
+        engine's dp-grad reduction). Elsewhere the beam is still compiled
+        and the outcome recorded (``program_search`` in the plan table),
+        but handing "program" to a wiring that dispatches on impl flags
+        would silently run the exact path under a quantized-plan label —
+        the planner keeps the best executable impl instead."""
         cands = [(impl, est, None)
                  for impl, est in self.cost.prune(site, margin=self.margin)]
-        for prog in synthesize_programs(site, self.cost, block=self.block):
-            cands.append(("program", self.cost.estimate_program(site, prog),
-                          prog))
+        beam = compile_programs(site, self.cost, block=self.block,
+                                beam_width=self.beam_width)
+        note = None
+        if beam and program_capable(site):
+            cands.extend(("program", est, prog) for prog, est in beam)
+            note = f"beam:{len(beam)}"
+        elif beam:
+            note = ("skipped:foreign-axis" if site.axis_size is not None
+                    else "skipped:wiring")
+        elif site.axis_size is not None:
+            note = "skipped:foreign-axis"
+        if note is not None:
+            self._search_notes[site.signature()] = note
         cands.sort(key=lambda t: t[1])
         best = cands[0][1]
         cut = best * self.margin if best > 0 else float("inf")
@@ -375,6 +355,46 @@ class CollectivePlanner:
         return self._finish(site, impl, est_s=t, source="measured",
                             program=prog)
 
+    def calibrate_overlap_credit(self, site: CollectiveSite, *,
+                                 reps: Optional[int] = None
+                                 ) -> Optional[float]:
+        """Measure the fused-matmul overlap credit instead of trusting the
+        0.55 default: time a fused-hierarchical program against its
+        sequenced twin (same phases, ``via="xla"``, no compute binding)
+        through the real executor, set ``CostModel.overlap_credit`` to the
+        observed hidden fraction ``(t_seq - t_fused) / t_seq`` (clamped to
+        [0.05, 0.95] — no transfer hides completely, and a noisy negative
+        sample must not zero the credit), and return it. Returns None —
+        cost model untouched — when the site admits no fused program or a
+        probe fails; subsequent ``resolve`` calls price candidates with the
+        calibrated credit."""
+        fused = next((p for p in legacy_menu_programs(site, self.cost,
+                                                      block=self.block)
+                      if any(s.via == "fused_matmul" for s in p)), None)
+        if fused is None:
+            return None
+        seq = tuple(dataclasses.replace(s, via="xla", compute=None)
+                    if s.via == "fused_matmul" else s for s in fused)
+        reps = int(reps or self.measure_reps)
+        try:
+            t_fused = benchmark_site(site, "program", block=self.block,
+                                     program=fused, reps=reps,
+                                     max_elems=self.measure_max_elems)
+            t_seq = benchmark_site(site, "program", block=self.block,
+                                   program=seq, reps=reps,
+                                   max_elems=self.measure_max_elems)
+        except Exception:
+            return None
+        if not (t_seq > 0.0 and t_fused > 0.0):
+            return None
+        credit = min(0.95, max(0.05, (t_seq - t_fused) / t_seq))
+        self.overlap_credit = credit
+        self.cost = CostModel(self.fingerprint, block=self.block,
+                              assume_fleet=self._assume_fleet,
+                              link_penalties=self.cost.link_penalties,
+                              overlap_credit=credit)
+        return credit
+
     def _finish(self, site: CollectiveSite, impl: str, *, est_s: float,
                 source: str, program=None) -> PlanDecision:
         block = self.block if impl in ("int8", "int8_sr", "hierarchical",
@@ -402,6 +422,14 @@ class CollectivePlanner:
             "block": decision.block, "source": decision.source,
             "est_us": decision.est_us, "mode": self.mode,
         }
+        note = self._search_notes.get(sig)
+        if note is not None:
+            # what the program compiler did here: "beam:N" (N candidates
+            # competed) or an explicit skip — "skipped:foreign-axis" /
+            # "skipped:wiring" (programs compiled but the site's wiring
+            # can't execute a program decision; silent degradation is the
+            # one thing this column exists to rule out)
+            info["program_search"] = note
         if decision.program is not None:
             info["program"] = program_summary(decision.program)
             # the structured per-phase dicts ride beside the summary so
@@ -472,4 +500,6 @@ def configure_from_config(config, topology=None) -> CollectivePlanner:
                              measure_reps=pl.measure_reps,
                              measure_max_elems=pl.measure_max_elems,
                              block=cc.block, dcn_axes=pl.dcn_axes,
+                             beam_width=pl.beam_width,
+                             overlap_credit=pl.overlap_credit,
                              topology=topology)

@@ -50,11 +50,22 @@ _QUANT_DEFAULT = 1.0 / 5e10
 # term that keeps tiny alpha-dominated messages on the exact path
 QUANT_FIXED = 5e-6
 # fraction of the wire time a ring-chunked transfer hides behind compute
-# (T3-style overlap); the credit the fused/chunked impls get over xla
+# (T3-style overlap); the credit the fused/chunked impls get over xla.
+# This module constant is the DEFAULT — CostModel carries it as a field so
+# the ``comm_planner.overlap_credit`` knob (or a measured fused-vs-sequenced
+# probe pair, ``planner.calibrate_overlap_credit``) can track the real mesh
 OVERLAP_CREDIT = 0.55
 # extra per-chunk scheduling overhead of an explicit ppermute ring vs the
 # fused XLA collective
 RING_HOP_PENALTY = 1.5
+# per-round scheduling overhead of a recursive-doubling/halving butterfly
+# round vs one fused-collective alpha: each of the log2(p) rounds is a
+# full-vector ppermute exchange that XLA schedules as an exposed step, so a
+# round costs noticeably more than a pipelined ring hop. Calibrated so the
+# tree wins the alpha-dominated DCN regime (log2(p) rounds beat 2(p-1) ring
+# hops once p >= 4) without stealing the bandwidth-bound regime from the
+# quantized xla path
+TREE_ROUND_PENALTY = 2.8
 
 # --- decode-shape regime (serving decode_attn) -----------------------------
 # HBM streaming rates, bytes/s: decode attention moves no link traffic —
@@ -137,13 +148,19 @@ class CostModel:
 
     def __init__(self, fingerprint: MeshFingerprint,
                  block: int = _DEFAULT_BLOCK, assume_fleet: bool = False,
-                 link_penalties: Optional[Dict[str, float]] = None):
+                 link_penalties: Optional[Dict[str, float]] = None,
+                 overlap_credit: Optional[float] = None):
         self.fp = fingerprint
         self.block = block
         platform = "tpu" if assume_fleet else fingerprint.platform
         self.platform = platform
         self.quant_cost = QUANT_COST_PER_BYTE.get(platform, _QUANT_DEFAULT)
         self.quant_fixed = QUANT_FIXED
+        # the fused/chunked overlap credit: config- or measurement-settable
+        # (clamped away from 1.0 — no transfer hides completely)
+        if overlap_credit is None:
+            overlap_credit = OVERLAP_CREDIT
+        self.overlap_credit = min(0.95, max(0.0, float(overlap_credit)))
         # per-axis cost multipliers (alpha AND beta): the control plane's
         # straggler re-plan marks the slow host's link here so every
         # candidate that touches it is priced at its OBSERVED slowness,
@@ -279,10 +296,10 @@ class CostModel:
                 return hops * lp.alpha + hops * n * lp.beta
             if impl == "ring":
                 return (hops * lp.alpha * RING_HOP_PENALTY
-                        + hops * n * lp.beta * (1 - OVERLAP_CREDIT))
+                        + hops * n * lp.beta * (1 - self.overlap_credit))
             if impl == "bidir_ring":
                 return (-(-hops // 2) * lp.alpha * RING_HOP_PENALTY
-                        + hops * n * lp.beta * (1 - OVERLAP_CREDIT))
+                        + hops * n * lp.beta * (1 - self.overlap_credit))
             if impl == "int8":
                 return (hops * lp.alpha + hops * n * q * lp.beta
                         + n * self.quant_cost * p + self.quant_fixed)
@@ -293,7 +310,7 @@ class CostModel:
                 # tiny alpha-dominated sites to exact xla (ring penalty +
                 # quant_fixed)
                 return (hops * lp.alpha * RING_HOP_PENALTY
-                        + hops * n * q * lp.beta * (1 - OVERLAP_CREDIT)
+                        + hops * n * q * lp.beta * (1 - self.overlap_credit)
                         + n * self.quant_cost * p + self.quant_fixed)
         elif site.op == "reduce_scatter":
             # site.shape is the full local input; (p-1)/p*n bytes per rank
@@ -302,7 +319,7 @@ class CostModel:
                 return hops * lp.alpha + frac * lp.beta
             if impl == "ring":
                 return (hops * lp.alpha * RING_HOP_PENALTY
-                        + frac * lp.beta * (1 - OVERLAP_CREDIT))
+                        + frac * lp.beta * (1 - self.overlap_credit))
             if impl in ("int8", "int8_sr"):
                 t = hops * lp.alpha + frac * q * lp.beta \
                     + n * self.quant_cost + self.quant_fixed
@@ -312,7 +329,7 @@ class CostModel:
                 # one re-quantization round per hop (the shard-sized
                 # accumulator), hops hidden behind the tiles
                 return (hops * lp.alpha * RING_HOP_PENALTY
-                        + frac * q * lp.beta * (1 - OVERLAP_CREDIT)
+                        + frac * q * lp.beta * (1 - self.overlap_credit)
                         + n * self.quant_cost + hops * self.quant_fixed)
         elif site.op == "all_to_all":
             frac = n * hops / p
@@ -328,8 +345,89 @@ class CostModel:
                 return hops * lp.alpha + hops * n * lp.beta
             if impl == "fused_matmul":
                 return (hops * lp.alpha * RING_HOP_PENALTY
-                        + hops * n * lp.beta * (1 - OVERLAP_CREDIT))
+                        + hops * n * lp.beta * (1 - self.overlap_credit))
         return float("inf")
+
+    def phase_span(self, site: CollectiveSite, st: PhaseStep) -> Optional[int]:
+        """Rank count of one phase of a program at ``site``. A foreign-mesh
+        site (explicit ``axis_size``) is one flat axis the fingerprint knows
+        nothing about: only phases spanning exactly the site's own axes are
+        estimable there (span = the override); any other phase axes make
+        the program un-costable (None -> inf)."""
+        if site.axis_size is not None:
+            if tuple(st.axes) == tuple(site.axes):
+                return int(site.axis_size)
+            return None
+        return self.fp.axis_size(st.axes)
+
+    def estimate_phase(self, site: CollectiveSite, st: PhaseStep,
+                       n: float) -> Tuple[float, float]:
+        """(seconds, per-rank payload bytes AFTER the phase) for one phase
+        of a program at ``site``, entered with ``n`` payload bytes.
+
+        Via arms: ``xla`` pays one fused-collective alpha per hop; ``ring``
+        / ``bidir_ring`` pay :data:`RING_HOP_PENALTY` per hop (bidir halves
+        the hop count); ``fused_matmul`` additionally earns the overlap
+        credit on bandwidth (hops hidden behind the bound matmul's tiles);
+        ``tree`` is the recursive-doubling/halving butterfly — ceil(log2 p)
+        rounds at :data:`TREE_ROUND_PENALTY` each instead of O(p) hops, the
+        alpha-dominated DCN shape, at ring-equivalent bandwidth for
+        reduce_scatter/all_gather but log2(p)/2x the ring's bandwidth for
+        all_reduce (every round moves the full vector). ``chunks`` = K > 1
+        pipelines an xla phase: K alphas, but the next phase starts on
+        chunk 1 while this one streams chunk 2 — the bandwidth term earns
+        ``overlap_credit x (K-1)/K`` (only the first chunk is exposed)."""
+        p = self.phase_span(site, st)
+        if p is None:
+            return float("inf"), n
+        if p <= 1:
+            return 0.0, n
+        lp = self.link_params(st.link, st.axes)
+        hops = p - 1
+        rounds = max(1, int(np.ceil(np.log2(p))))
+        k = max(1, int(st.chunks))
+        q = self._wire_ratio(site.dtype) if st.quantized else 1.0
+        overlap = 1.0
+        if st.via in ("ring", "fused_matmul"):
+            alpha_t = hops * RING_HOP_PENALTY * lp.alpha
+            if st.via == "fused_matmul":
+                overlap = 1 - self.overlap_credit
+        elif st.via == "bidir_ring":
+            alpha_t = -(-hops // 2) * RING_HOP_PENALTY * lp.alpha
+        elif st.via == "tree":
+            alpha_t = rounds * TREE_ROUND_PENALTY * lp.alpha
+        else:
+            alpha_t = hops * lp.alpha * k
+            if k > 1:
+                overlap = 1 - self.overlap_credit * (k - 1) / k
+        t = 0.0
+        if st.phase_op == "reduce_scatter":
+            # recursive halving moves the same n(p-1)/p bytes as the ring
+            t += alpha_t + n * hops / p * q * lp.beta * overlap
+            if st.quantized:
+                t += n * self.quant_cost + k * self.quant_fixed
+            n = n / p
+        elif st.phase_op == "all_reduce":
+            if st.via == "tree":
+                # recursive doubling: every round exchanges the FULL vector
+                t += alpha_t + rounds * n * q * lp.beta
+            else:
+                t += 2 * alpha_t + 2 * n * q * hops / p * lp.beta * overlap
+            if st.quantized:
+                t += 2 * n * self.quant_cost + 2 * k * self.quant_fixed
+        elif st.phase_op == "all_gather":
+            t += alpha_t + hops * n * q * lp.beta * overlap
+            if st.quantized:
+                t += n * p * self.quant_cost + k * self.quant_fixed
+            n = n * p
+        elif st.phase_op == "all_to_all":
+            t += alpha_t + n * hops / p * q * lp.beta * overlap
+            if st.quantized:
+                t += 2 * n * self.quant_cost + 2 * k * self.quant_fixed
+        if st.via == "tree" and st.quantized:
+            # each butterfly round re-quantizes its sent piece
+            t += (rounds - 1) * self.quant_fixed
+        return t, n
 
     def estimate_program(self, site: CollectiveSite,
                          program: Tuple[PhaseStep, ...]) -> float:
@@ -340,44 +438,20 @@ class CostModel:
         slice boundary enters the span) and the per-rank payload tracks
         the phase algebra: a reduce-scatter shrinks it by the axis span, an
         all-gather grows it back. Fused phases (``via="fused_matmul"``)
-        take the ring alpha penalty but earn :data:`OVERLAP_CREDIT` on the
+        take the ring alpha penalty but earn the overlap credit on the
         bandwidth term — their hops ride behind the bound matmul's tiles,
         the term that lets a fused-hierarchical program beat its sequenced
-        twin on the same cost scale."""
-        if site.axis_size is not None:
-            return float("inf")  # foreign-mesh sites are one flat axis
+        twin on the same cost scale. Foreign-mesh sites (explicit
+        ``axis_size``) are estimable only for programs whose every phase
+        spans exactly the site's axes (the compiler's single-phase
+        tree/chunked shapes); anything else prices to inf."""
         n = float(site.nbytes)
         t = 0.0
         for st in program:
-            p = self.fp.axis_size(st.axes)
-            if p <= 1:
-                continue
-            lp = self.link_params(st.link, st.axes)
-            hops = p - 1
-            q = self._wire_ratio(site.dtype) if st.quantized else 1.0
-            overlap = 1.0
-            if st.via in ("ring", "fused_matmul"):
-                alpha_t = hops * RING_HOP_PENALTY * lp.alpha
-                if st.via == "fused_matmul":
-                    overlap = 1 - OVERLAP_CREDIT
-            elif st.via == "bidir_ring":
-                alpha_t = -(-hops // 2) * RING_HOP_PENALTY * lp.alpha
-            else:
-                alpha_t = hops * lp.alpha
-            if st.phase_op == "reduce_scatter":
-                t += alpha_t + n * hops / p * q * lp.beta * overlap
-                if st.quantized:
-                    t += n * self.quant_cost + self.quant_fixed
-                n = n / p
-            elif st.phase_op == "all_reduce":
-                t += 2 * alpha_t + 2 * n * q * hops / p * lp.beta
-                if st.quantized:
-                    t += 2 * n * self.quant_cost + 2 * self.quant_fixed
-            elif st.phase_op == "all_gather":
-                t += alpha_t + hops * n * q * lp.beta * overlap
-                if st.quantized:
-                    t += n * p * self.quant_cost + self.quant_fixed
-                n = n * p
+            dt, n = self.estimate_phase(site, st, n)
+            t += dt
+            if not np.isfinite(t):
+                return float("inf")
         return t
 
     def _split_axes(self, site: CollectiveSite) -> Tuple[int, int]:

@@ -117,20 +117,21 @@ def probe_collective_programs(n_elems: int, *, axes: Sequence[str],
                               reps: int = 2, repeats: int = 2,
                               max_elems: int = 1 << 14
                               ) -> Optional[Dict[str, Any]]:
-    """Time the DP-grad site's flat implementations against every
-    synthesized multi-phase program through the planner's OWN
-    microbenchmark executor (``comm/planner/microbench.benchmark_site`` —
-    measure mode's ground truth, so the autotuner's program verdicts and
-    the planner's agree by construction). Returns ``{winner, timings_us}``
-    or None when the fingerprint has no cross-slice axes to synthesize
-    over."""
-    from ..comm.planner import (benchmark_site, get_planner, make_site,
-                                program_summary, synthesize_programs)
+    """Time the DP-grad site's flat implementations against the program
+    compiler's searched beam through the planner's OWN microbenchmark
+    executor (``comm/planner/microbench.benchmark_site`` — measure mode's
+    ground truth, so the autotuner's program verdicts and the planner's
+    agree by construction). Returns ``{winner, timings_us}`` or None when
+    the fingerprint has no cross-slice axes to compile programs over."""
+    from ..comm.planner import (benchmark_site, compile_programs,
+                                get_planner, make_site, program_summary)
 
     planner = get_planner()
     site = make_site(op="all_reduce", shape=(int(n_elems),), dtype="float32",
                      axes=axes, consumer="dp-grad")
-    programs = synthesize_programs(site, planner.cost, block=planner.block)
+    programs = [prog for prog, _ in
+                compile_programs(site, planner.cost, block=planner.block,
+                                 beam_width=planner.beam_width)]
     if not programs:
         return None
     cands: List[Tuple[str, Optional[tuple]]] = [("xla", None),

@@ -291,6 +291,15 @@ class CommPlannerConfig(ConfigModel):
     measure_reps: int = 4        # chained executions per timed probe
     measure_max_elems: int = 1 << 16  # probe tensor cap (elements)
     dcn_axes: Optional[List[str]] = None  # force-mark axes as DCN (simulation)
+    # program-compiler beam width: how many searched multi-phase programs
+    # survive slot pruning to compete with the flat impls (and, in measure
+    # mode, get microbenched). None = compiler default.
+    beam_width: Optional[int] = None
+    # fused/chunked overlap credit override (0..0.95): the fraction of a
+    # phase's wire time hidden behind the bound matmul tiles / the next
+    # chunk's compute. None = the calibrated/compiled-in default; planners
+    # can also measure it (CollectivePlanner.calibrate_overlap_credit).
+    overlap_credit: Optional[float] = None
 
 
 @register_config

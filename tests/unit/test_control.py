@@ -338,7 +338,8 @@ def test_replan_cache_reused_across_planner_instances(tmp_path):
     """A restart that repeats the demotion resolves the cached replanned
     plan (stored under the demoted fingerprint digest) instead of
     re-deciding from scratch; the organic cache entry stays untouched."""
-    from deepspeed_tpu.comm.planner import CollectivePlanner, make_site
+    from deepspeed_tpu.comm.planner import (SEARCH_SPACE, CollectivePlanner,
+                                            make_site)
 
     topo = Topology(TopologySpec(ep=2))
     site = make_site(op="all_reduce", shape=(1 << 20,), dtype="float32",
@@ -350,9 +351,9 @@ def test_replan_cache_reused_across_planner_instances(tmp_path):
     d1 = p1.resolve(site)                  # stored under the demoted digest
     assert d1.impl == "program"
     demoted_digest = p1.fingerprint.digest()
-    assert {f"plan_{organic_digest}.json", f"plan_{demoted_digest}.json"} \
-        <= set(os.listdir(tmp_path)) - {f"plan_{organic_digest}.json.lock",
-                                        f"plan_{demoted_digest}.json.lock"}
+    tag = f"_s{SEARCH_SPACE}"   # planner caches carry the search-space tag
+    assert {f"plan_{organic_digest}{tag}.json",
+            f"plan_{demoted_digest}{tag}.json"} <= set(os.listdir(tmp_path))
     # fresh planner (a restarted process), same demotion: the replanned
     # decision comes back from the cache
     p2 = CollectivePlanner("static", cache_dir=str(tmp_path), topology=topo)
