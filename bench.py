@@ -2380,6 +2380,60 @@ def chaos_soak_bench():
         _shutil.rmtree(work, ignore_errors=True)
 
 
+def model_family_bench():
+    """Rung mf (model-family AutoTP ladder, deepspeed_tpu/sharding/): the
+    PR 18 acceptance as a measured rung — each built-in rule pack's family
+    (llama / mistral / gpt_neox / mixtral) goes from a raw HF-layout
+    checkpoint through ``autotp_initialize`` to a tp=2 × ZeRO-3 engine with
+    ZERO model-specific code, trains three steps, and its compiled train
+    step is audited against the planner's plan records. The headline value
+    is the number of families that audit clean (zero errors AND zero
+    unplanned gather-class collectives) — deterministic, gated tight: a
+    rules/packs/planner-registration regression that lets GSPMD slip an
+    unplanned gather into ANY family must fail CI, not just slow it down.
+    Per-family train-step wall time and finding counts ride along."""
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.analysis import AuditOptions, audit_step
+    from deepspeed_tpu.sharding.audit_entry import FAMILIES, family_engine
+
+    per_family = {}
+    clean = 0
+    for fam in FAMILIES:
+        engine, b = family_engine(fam, tp=2, zero_stage=3)
+        step_rng = jax.random.PRNGKey(0)
+        losses = [float(engine.train_batch(b)) for _ in range(3)]
+        best_step = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(engine.train_batch(b))
+            best_step = min(best_step, time.perf_counter() - t0)
+        traced = engine._train_step.trace(engine.state, b, step_rng)
+        exe = traced.lower().compile()
+        ledger = dist.get_comms_logger()
+        axis_sizes = {str(k): int(v)
+                      for k, v in dict(engine.topo.mesh.shape).items()}
+        rep = audit_step(traced, compiled=exe, label=f"autotp-{fam}",
+                         options=AuditOptions(), axis_sizes=axis_sizes,
+                         plan_records=ledger.plan_records, ledger=ledger)
+        counts = rep.counts()
+        unplanned = int(rep.context.get("unplanned_collectives") or 0)
+        ok = counts.get("error", 0) == 0 and unplanned == 0
+        clean += int(ok)
+        per_family[fam] = {
+            "clean": ok, "unplanned": unplanned,
+            "errors": counts.get("error", 0),
+            "warnings": counts.get("warning", 0),
+            "hlo_collectives": rep.context.get("hlo_collectives"),
+            "train_step_ms": round(best_step * 1e3, 2),
+            "loss_first": round(losses[0], 4),
+            "loss_last": round(losses[-1], 4),
+            "loss_decreased": losses[-1] < losses[0]}
+    return {"metric": "autotp_families_clean", "value": clean,
+            "unit": f"families/{len(FAMILIES)}", "vs_baseline": None,
+            "families": per_family,
+            "device": jax.devices()[0].platform}
+
+
 RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "3b": rung3b_big_model,
          "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses,
@@ -2392,7 +2446,7 @@ RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "cp": program_compiler_bench,
          "ob": telemetry_bench, "mem": memory_telemetry_bench,
          "sa": static_audit_bench, "at": control_bench,
-         "cz": chaos_soak_bench}
+         "cz": chaos_soak_bench, "mf": model_family_bench}
 
 
 # ---------------------------------------------------------------------------
@@ -2426,6 +2480,7 @@ GATE_SPECS = {
     # largely cancels, but the arms are wall-clock — keep the default slack
     "serving_prefix_reuse_speedup": ("higher", 0.5),
     "chaos_soak_fault_classes": ("higher", 0.05),  # seeded count: deterministic
+    "autotp_families_clean": ("higher", 0.05),  # family count: deterministic
 }
 
 
@@ -2585,7 +2640,12 @@ def run_ladder(gate: bool = False):
             # cz soaks the chaos engine: seeded full-stack fault schedule
             # over serving + training drills with the survival invariants
             # asserted in-process (one CPU device is the substrate)
-            ("cz", cpu1)]
+            ("cz", cpu1),
+            # mf auto-shards every built-in rule-pack family (llama,
+            # mistral, gpt_neox, mixtral) at tp=2 x ZeRO-3 via
+            # autotp_initialize and audits each compiled step to zero
+            # unplanned gather-class collectives
+            ("mf", cpu8)]
     results = []
     for rung, env_over in plan:
         env = dict(os.environ)
@@ -2654,7 +2714,7 @@ if __name__ == "__main__":
 
         flags_preset = ("--xla_force_host_platform_device_count"
                         in os.environ.get("XLA_FLAGS", ""))
-        needs_cpu8 = args.rung in ("4", "5", "ds", "t3", "at")
+        needs_cpu8 = args.rung in ("4", "5", "ds", "t3", "at", "mf")
         if args.rung == "cp" and not flags_preset:
             # cp needs the 32-device virtual mesh (3-axis search substrate)
             os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")

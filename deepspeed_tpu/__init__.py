@@ -9,6 +9,7 @@ profiler / monitor toolchain.
 """
 
 from . import comm
+from . import sharding
 from . import telemetry
 from .accelerator import get_accelerator
 from .runtime import activation_checkpointing as checkpointing
@@ -19,6 +20,8 @@ from .runtime.engine import DeepSpeedTPUEngine, TrainState, initialize
 from .version import __version__
 
 init_distributed = comm.init_distributed
+# AutoTP v2: any HF-shaped checkpoint → TP×ZeRO-3 engine (sharding/autotp.py)
+autotp_initialize = sharding.autotp_initialize
 # reference name for the engine class (deepspeed/__init__.py:24)
 DeepSpeedEngine = DeepSpeedTPUEngine
 

@@ -21,6 +21,14 @@ R4 **silent error swallows in failure-handling code** — a bare
    surface (a swallowed transport error is an invisible dead host).
    Deliberate sites carry a ``# swallow-ok: <reason>`` comment naming why;
    anything unannotated fails.
+R5 **raw PartitionSpec literals outside the sharding subsystem** — every
+   inline ``P(...)`` is a sharding decision hidden from the declarative
+   rules layer (``deepspeed_tpu/sharding/``): it cannot be audited,
+   renamed with the mesh, or overridden by a rule pack.  Construct specs
+   through ``sharding.sites`` / ``sharding.rules`` instead.  The few
+   mechanical survivors (per-leaf spec *surgery* like ZeRO free-dim
+   claiming, not layout *choices*) carry a ``# spec-ok: <reason>``
+   comment; anything unannotated fails.
 
 Stdlib-only (ast + tokenize); no jax import, so the lint test runs even
 where jax is broken.
@@ -46,6 +54,11 @@ SYNC_OK_MARKER = "sync-ok:"
 SWALLOW_SCOPED = ("runtime/resilience/", "serving/", "control/")
 #: the annotation that blesses one deliberate swallow: `# swallow-ok: <why>`
 SWALLOW_OK_MARKER = "swallow-ok:"
+#: the one package allowed to construct PartitionSpec directly: the
+#: declarative sharding subsystem, the single source of layout truth
+SPEC_EXEMPT = ("sharding/",)
+#: the annotation that blesses one deliberate raw-spec line: `# spec-ok: <why>`
+SPEC_OK_MARKER = "spec-ok:"
 
 _HOST_SYNC_NAMES = ("block_until_ready", "device_get")
 _MUTABLE_DEFAULTS = (ast.List, ast.Dict, ast.Set)
@@ -54,7 +67,8 @@ _BROAD_EXC_NAMES = ("Exception", "BaseException")
 
 @dataclasses.dataclass(frozen=True)
 class LintFinding:
-    rule: str        # 'raw-shard-map' | 'host-sync' | 'mutable-default' | 'swallow'
+    rule: str        # 'raw-shard-map' | 'host-sync' | 'mutable-default'
+                     # | 'swallow' | 'raw-partition-spec'
     path: str        # repo-relative
     line: int
     message: str
@@ -176,6 +190,38 @@ def _lint_swallows(tree: ast.AST, rel: str, source: str,
             f"annotate '# {SWALLOW_OK_MARKER} <why>' if deliberate"))
 
 
+def _lint_partition_specs(tree: ast.AST, rel: str, source: str,
+                          findings: List[LintFinding]) -> None:
+    if any(rel.startswith(p) or f"/{p}" in rel for p in SPEC_EXEMPT):
+        return
+    # local names bound to PartitionSpec by imports (P, PSpec, ...)
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "PartitionSpec":
+                    aliases.add(a.asname or a.name)
+    blessed = _annotated_lines(source, SPEC_OK_MARKER)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        raw = (isinstance(f, ast.Name) and f.id in aliases) or (
+            isinstance(f, ast.Attribute)
+            and _call_name_chain(f)[-1:] == ["PartitionSpec"])
+        if not raw:
+            continue
+        if (node.lineno in blessed or (node.end_lineno or 0) in blessed
+                or node.lineno - 1 in blessed):
+            continue
+        findings.append(LintFinding(
+            "raw-partition-spec", rel, node.lineno,
+            "raw PartitionSpec literal outside deepspeed_tpu/sharding/ "
+            "hides a layout decision from the rules layer; use "
+            "sharding.sites / a RuleSet, or annotate "
+            f"'# {SPEC_OK_MARKER} <why>' if it is mechanical spec surgery"))
+
+
 def _lint_mutable_defaults(tree: ast.AST, rel: str,
                            findings: List[LintFinding]) -> None:
     for node in ast.walk(tree):
@@ -213,6 +259,7 @@ def lint_source(source: str, rel_path: str) -> List[LintFinding]:
     _lint_shard_map(tree, rel_path, findings)
     _lint_host_sync(tree, rel_path, source, findings)
     _lint_swallows(tree, rel_path, source, findings)
+    _lint_partition_specs(tree, rel_path, source, findings)
     _lint_mutable_defaults(tree, rel_path, findings)
     return findings
 

@@ -81,7 +81,7 @@ def _build_demo(which: str):
         return jnp.mean(y.astype(jnp.float32) ** 2)
 
     def sh(*spec):
-        return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P(*spec))  # spec-ok: demo harness sharding for a synthetic program
 
     if which == "clean":
         # Megatron pairing: col-parallel w1, row-parallel w2 — the only

@@ -79,27 +79,27 @@ def megatron_specs(tree: Any, axis: str = "tp", *, strict: bool = True) -> Any:
         nd = getattr(leaf, "ndim", np.asarray(leaf).ndim)
         low = path.lower()
         if _meg_match(low, _MEG_REPLICATED):
-            specs.append(P())
+            specs.append(P())  # spec-ok: megatron import table: names the layout being read, not chosen
         elif _meg_match(low, _MEG_ROW):
             # row-parallel: weight shards the input dim (1 in [out, in]);
             # its bias is a full output vector -> replicated
-            specs.append(P(None, axis) if nd == 2 else P())
+            specs.append(P(None, axis) if nd == 2 else P())  # spec-ok: megatron import table row-parallel entry
         elif _meg_match(low, _MEG_COL):
             # col-parallel: weight shards the output dim (0); bias too
-            specs.append(P(axis) if nd >= 1 else P())
+            specs.append(P(axis) if nd >= 1 else P())  # spec-ok: megatron import table col-parallel entry
         elif _meg_match(low, _MEG_VOCAB):
             # vocab-parallel shards dim 0 for the embedding matrix AND for a
             # 1-D output-layer bias (Megatron shards lm_head.bias along vocab
             # too — replicating it here would merge it by the wrong rule)
-            specs.append(P(axis) if nd >= 1 else P())
+            specs.append(P(axis) if nd >= 1 else P())  # spec-ok: megatron import table vocab-parallel entry
         elif nd >= 2:
             if strict:
                 raise ValueError(
                     f"megatron_specs: unmatched 2-D leaf {path!r} — add it to "
                     "the layout table or pass strict=False (replicates it)")
-            specs.append(P())
+            specs.append(P())  # spec-ok: megatron import fallback: replicate unmatched leaves
         else:
-            specs.append(P())
+            specs.append(P())  # spec-ok: megatron import fallback: replicate 1-D leaves
     return jax.tree_util.tree_unflatten(treedef, specs)
 
 

@@ -103,9 +103,11 @@ OP_MENU: Dict[str, Tuple[str, ...]] = {
 
 # the wired consumers (PR 3's five + the PR 6 embedding site + the
 # serving decode tier: decode_attn and the decode-TP projections'
-# gather_matmul both resolve under "decode")
+# gather_matmul both resolve under "decode"; "autotp" is the sharding
+# subsystem's load-time registration of the gather-class collectives a
+# rule-sharded foreign param tree implies — sharding/autotp.py)
 CONSUMERS = ("tp-linear", "ulysses", "moe-a2a", "dp-grad", "zeropp", "embed",
-             "decode")
+             "decode", "autotp")
 
 # consumers whose payload is a gradient: stochastic rounding is admissible
 # (unbiased compression matters there); activation exchanges keep nearest

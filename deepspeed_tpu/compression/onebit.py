@@ -233,11 +233,11 @@ def onebit_train_step_factory(loss_fn, tx, mesh, dp_axis: str = "dp",
                     new_se[None],
                     lax.pmean(loss, dp_axis))
 
-        rep = P()
-        err_spec = P(dp_axis)  # leading axis = one error slice per dp shard
+        rep = P()  # spec-ok: shard_map wiring: replicated operand
+        err_spec = P(dp_axis)  # leading axis = one error slice per dp shard  # spec-ok: shard_map wiring: per-dp error-feedback slice
         grads, new_error, new_server, loss = _sm(
             per_shard, mesh,
-            in_specs=(rep, err_spec, err_spec, P(dp_axis)),
+            in_specs=(rep, err_spec, err_spec, P(dp_axis)),  # spec-ok: shard_map wiring for the 1-bit reduce body
             out_specs=(rep, err_spec, err_spec, rep))(
                 state.params, state.error, state.server_error, batch)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)

@@ -130,7 +130,7 @@ class InferenceEngine:
     def _batch_sharding(self, b: int) -> NamedSharding:
         """Shard batch over dp when it divides; replicate tiny batches."""
         dp = self.topo.axis_size(*self.topo.dp_axes)
-        spec = P(self.topo.dp_axes) if dp > 1 and b % dp == 0 else P()
+        spec = P(self.topo.dp_axes) if dp > 1 and b % dp == 0 else P()  # spec-ok: batch split/replicate fallback keyed on divisibility
         return NamedSharding(self.topo.mesh, spec)
 
     def _cache_shardings(self, b: int):

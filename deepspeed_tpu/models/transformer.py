@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
-from jax.sharding import PartitionSpec as P
+
+from ..sharding import sites
 
 
 @dataclasses.dataclass(frozen=True)
@@ -679,19 +680,19 @@ class Attention(nn.Module):
         params = self.variables["params"]
         wq, wk, wv = (params[n]["kernel"].astype(dt)
                       for n in ("q_proj", "k_proj", "v_proj"))
-        w_spec = P(None, TP_AXIS, None)
+        w_spec = sites.col_kernel3(TP_AXIS)
         args = [x.astype(dt), wq, wk, wv]
-        specs = [P(dp, TP_AXIS, None), w_spec, w_spec, w_spec]
+        specs = [sites.seq_sharded_act(dp, TP_AXIS), w_spec, w_spec, w_spec]
         if cfg.qkv_bias:
             args += [params[n]["bias"].astype(dt)
                      for n in ("q_proj", "k_proj", "v_proj")]
-            specs += [P(TP_AXIS, None)] * 3
+            specs += [sites.col_bias2(TP_AXIS)] * 3
 
         def body(x_, wq_, wk_, wv_, *bs):
             return fused_qkv_all_gather_matmul(x_, wq_, wk_, wv_, bs, dh,
                                                TP_AXIS)
 
-        head_spec = P(dp, None, TP_AXIS, None)
+        head_spec = sites.heads_sharded_act(dp, TP_AXIS)
         return shard_map_nocheck(body, topo.mesh, tuple(specs),
                                  (head_spec, head_spec, head_spec))(*args)
 
@@ -717,9 +718,10 @@ class Attention(nn.Module):
                                          wo_.reshape(hl * dhl, -1), TP_AXIS)
 
         y = shard_map_nocheck(body, topo.mesh,
-                              (P(dp, None, TP_AXIS, None),
-                               P(TP_AXIS, None, None)),
-                              P(dp, TP_AXIS, None))(out.astype(dt), wo)
+                              (sites.heads_sharded_act(dp, TP_AXIS),
+                               sites.row_kernel3(TP_AXIS)),
+                              sites.seq_sharded_act(dp, TP_AXIS))(
+                                  out.astype(dt), wo)
         if cfg.out_bias:
             y = y + params["bias"].astype(dt)
         return y
@@ -777,16 +779,16 @@ class MLP(nn.Module):
         has_bias = "bias" in params[col_names[0]]
         dp = topo.dp_axes
         args = [x.astype(dt)]
-        specs = [P(dp, TP_AXIS, None)]
+        specs = [sites.seq_sharded_act(dp, TP_AXIS)]
         for name in col_names:
             args.append(params[name]["kernel"].astype(dt))
-            specs.append(P(None, TP_AXIS))
+            specs.append(sites.col_kernel2(TP_AXIS))
         args.append(params["down_proj"]["kernel"].astype(dt))
-        specs.append(P(TP_AXIS, None))
+        specs.append(sites.row_kernel2(TP_AXIS))
         if has_bias:
             for name in col_names:
                 args.append(params[name]["bias"].astype(dt))
-                specs.append(P(TP_AXIS))
+                specs.append(sites.col_bias1(TP_AXIS))
 
         def body(x_, *rest):
             cols, wd_ = rest[:n_col], rest[n_col]
@@ -803,7 +805,7 @@ class MLP(nn.Module):
             return matmul_reduce_scatter(h, wd_, TP_AXIS)
 
         out = shard_map_nocheck(body, topo.mesh, tuple(specs),
-                                P(dp, TP_AXIS, None))(*args)
+                                sites.seq_sharded_act(dp, TP_AXIS))(*args)
         if has_bias:
             out = out + params["down_proj"]["bias"].astype(dt)
         return out
@@ -956,8 +958,9 @@ class TransformerLM(nn.Module):
             return ring_embedding_gather(tok, tab, TP_AXIS)
 
         return shard_map_nocheck(body, topo.mesh,
-                                 (P(dp, None), P(TP_AXIS, None)),
-                                 P(dp, None, None))(
+                                 (sites.tokens_act(dp),
+                                  sites.vocab_sharded_table(TP_AXIS)),
+                                 sites.embed_act(dp))(
                                      tokens, table.astype(cfg.dtype))
 
     def _tied_head_ring(self, x):
@@ -981,8 +984,9 @@ class TransformerLM(nn.Module):
 
         # operands in cfg.dtype — nn.Embed.attend's promote_dtype convention
         return shard_map_nocheck(body, topo.mesh,
-                                 (P(dp, None, None), P(TP_AXIS, None)),
-                                 P(dp, None, None))(
+                                 (sites.embed_act(dp),
+                                  sites.vocab_sharded_table(TP_AXIS)),
+                                 sites.embed_act(dp))(
                                      x.astype(cfg.dtype),
                                      table.astype(cfg.dtype))
 
@@ -1000,7 +1004,7 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: Optional[int] = N
 
 def kv_cache_specs(cfg: TransformerConfig, tp_axis: str = "tp", dp_axis=None):
     """PartitionSpecs for the v1 cache: batch over dp, kv heads over tp."""
-    spec = P(dp_axis, None, tp_axis, None)
+    spec = sites.kv_cache_entry(dp_axis, tp_axis)
     return {f"layer_{i}": {"k": spec, "v": spec} for i in range(cfg.num_layers)}
 
 
@@ -1089,6 +1093,9 @@ def make_loss_fn(model: TransformerLM):
         out = model.apply({"params": params}, tokens, deterministic=deterministic, **kwargs)
         return _ce(out, params, tokens, mask, headless)
 
+    # TransformerLM's wiring reads the topology itself (TP fast paths, ring
+    # overlaps); the engine must not demand explicit specs for it
+    loss_fn._sharding_native = True
     return loss_fn
 
 
@@ -1190,50 +1197,18 @@ def param_specs(params, tp_axis: str = "tp") -> Any:
     ``module_inject/auto_tp.py:189`` infers the same split from layer names):
     q/k/v/gate/up column-parallel (shard output dim), o/down row-parallel
     (shard input dim), embeddings sharded over vocab/hidden, experts over 'ep'.
+
+    Delegates to the declarative generic rule pack
+    (``sharding/packs.py::generic_pack``) — the pack is this function's
+    historical if/elif ladder made explicit, and stays bitwise-identical
+    to it (pinned by ``tests/unit/test_sharding_rules.py``).
     """
+    from ..sharding.packs import generic_pack
 
-    def spec_for(path_keys, leaf):
-        path = "/".join(path_keys)
-        is_bias = path_keys[-1] == "bias"
-        nd = leaf.ndim
-        if "expert" in path:  # MoE expert stacks: [E, ...] over ep
-            if "down_proj" in path:
-                return P("ep", tp_axis, None)
-            if nd >= 3:
-                return P("ep", None, tp_axis)
-            return P("ep")
-        if any(k in path for k in ("q_proj", "k_proj", "v_proj")):
-            if is_bias:  # [H, Dh]: shard heads like the kernel
-                return P(tp_axis, None) if nd == 2 else P(tp_axis)
-            # DenseGeneral kernel [D, H, Dh]: shard heads (column-parallel)
-            return P(None, tp_axis, None) if nd == 3 else P(None, tp_axis)
-        if "gate_proj" in path or "up_proj" in path:
-            if is_bias:  # [F]: shards with the column-parallel output dim
-                return P(tp_axis)
-            return P(None, tp_axis) if nd == 2 else P(tp_axis)
-        if "o_proj" in path:
-            if is_bias:  # [D]: row-parallel output is replicated
-                return P(None)
-            # DenseGeneral kernel [H, Dh, D]: shard heads (row-parallel)
-            return P(tp_axis, None, None) if nd == 3 else P(tp_axis, None)
-        if "down_proj" in path:
-            if is_bias:
-                return P(None)
-            return P(tp_axis, None) if nd == 2 else P()
-        if not is_bias and "embed" in path and nd == 2:
-            return P(None, tp_axis)
-        if not is_bias and "lm_head" in path and nd == 2:
-            return P(None, tp_axis)
-        if is_bias and "lm_head" in path:
-            return P(tp_axis)  # shards with the vocab-sharded kernel output
-        return P(*([None] * nd))
-
-    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-    specs = []
-    for kp, leaf in flat:
-        keys = [str(getattr(e, "key", getattr(e, "name", e))) for e in kp]
-        specs.append(spec_for(keys, leaf))
-    return jax.tree_util.tree_unflatten(treedef, specs)
+    pack = generic_pack()
+    if tp_axis != "tp":
+        pack = pack.renamed({"tp": tp_axis})
+    return pack.match(params)
 
 
 # ---------------------------------------------------------------------------

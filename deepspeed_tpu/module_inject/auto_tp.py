@@ -75,10 +75,10 @@ class AutoTPResult:
 
     def spec(self, ndim: int, axis: str = "tp") -> P:
         if self.shard_dim is None:
-            return P(*([None] * ndim))
+            return P(*([None] * ndim))  # spec-ok: AutoTP inference bridge: replicated when no shard dim
         dims: List[Optional[str]] = [None] * ndim
         dims[self.shard_dim] = axis
-        return P(*dims)
+        return P(*dims)  # spec-ok: AutoTP inference bridge: shard_dim -> spec, wrapped by sharding.derive
 
 
 # ---------------------------------------------------------------------------

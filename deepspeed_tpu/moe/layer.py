@@ -16,8 +16,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
-from jax.sharding import PartitionSpec as P
 
+from ..sharding import sites
 from .sharded_moe import (compute_capacity, dropless_moe, expert_ffn,
                           load_balance_aux, moe_combine, moe_dispatch,
                           quantized_ep_moe, quantized_ep_ready, topk_gating)
@@ -181,7 +181,7 @@ class MoEBlock(nn.Module):
             if used_token is not None:  # padding tokens contribute nothing
                 y = y * used_token.astype(y.dtype)[..., None]
             y = add_shared(y.astype(x.dtype))
-            y = _constrain(y, P(("dp_outer", "ep"), None, None), skip)
+            y = _constrain(y, sites.moe_batch_act(3), skip)
             return y.astype(x.dtype), aux * cfg.moe_aux_loss_weight
 
         dispatch, combine, aux = topk_gating(
@@ -194,7 +194,7 @@ class MoEBlock(nn.Module):
         # dp, S over sp): leaving it unconstrained made the partitioner
         # replicate-and-repartition the dispatch collective-permute
         # ("involuntary full rematerialization", spmd_partitioner.cc:652)
-        tok_mask_spec = P(("dp_outer", "ep"), "sp", None, None)
+        tok_mask_spec = sites.moe_batch_act(4, sp_axis="sp")
         dispatch = _constrain(dispatch, tok_mask_spec, skip)
         combine = _constrain(combine, tok_mask_spec, skip)
 
@@ -213,13 +213,13 @@ class MoEBlock(nn.Module):
         else:
             # expert-major dispatch: [E, G, C, D], experts over the ep axis
             expert_in = moe_dispatch(x, dispatch)
-            expert_in = _constrain(expert_in, P("ep", ("dp_outer",), None, None), skip)
+            expert_in = _constrain(expert_in, sites.moe_expert_major_act(4), skip)
             out = expert_ffn(expert_in, w_up, w_down, w_gate=w_gate,
                              b_up=b_up, b_down=b_down, b_gate=b_gate,
                              activation=cfg.activation)
-            out = _constrain(out, P("ep", ("dp_outer",), None, None), skip)
+            out = _constrain(out, sites.moe_expert_major_act(4), skip)
 
             y = moe_combine(out, combine)
         y = add_shared(y.astype(x.dtype))
-        y = _constrain(y, P(("dp_outer", "ep"), "sp", None), skip)
+        y = _constrain(y, sites.moe_batch_act(3, sp_axis="sp"), skip)
         return y.astype(x.dtype), aux * cfg.moe_aux_loss_weight

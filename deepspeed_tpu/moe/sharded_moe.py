@@ -229,16 +229,15 @@ def quantized_ep_moe(x, dispatch, combine, w_up, w_down, *, w_gate=None,
     Backward rides the exchanges' straight-through vjp (exact transposed
     all-to-alls). Callers check :func:`quantized_ep_ready` first.
     """
-    from jax.sharding import PartitionSpec as P
-
     from ..comm.compressed import quantized_all_to_all
     from ..parallel.topology import EP_AXIS, get_topology
+    from ..sharding import sites
     from ..utils.shard_map_compat import shard_map_nocheck
 
     topo = get_topology()
-    tok = P(("dp_outer", EP_AXIS), None, None)
-    tok4 = P(("dp_outer", EP_AXIS), None, None, None)
-    exp_w = P(EP_AXIS)  # leading E dim sharded; trailing dims replicated
+    tok = sites.moe_batch_act(3, ep_axis=EP_AXIS)
+    tok4 = sites.moe_batch_act(4, ep_axis=EP_AXIS)
+    exp_w = sites.moe_expert_weight(EP_AXIS)
     args = [x, dispatch, combine, w_up, w_down]
     specs = [tok, tok4, tok4, exp_w, exp_w]
     flags = []

@@ -119,7 +119,7 @@ class Topology:
             names = entry if isinstance(entry, tuple) else (entry,)
             return shape[i] % self.axis_size(*names) == 0
 
-        return P(*[e if ok(i, e) else None for i, e in enumerate(entries)])
+        return P(*[e if ok(i, e) else None for i, e in enumerate(entries)])  # spec-ok: mechanical surgery: drop axes that do not divide the dim
 
     def filter_spec_tree(self, spec_tree, tree):
         """``filter_spec`` over a pytree of PartitionSpecs + matching arrays."""
@@ -127,7 +127,7 @@ class Topology:
                             is_leaf=lambda x: isinstance(x, P))
 
     def replicated(self) -> NamedSharding:
-        return NamedSharding(self.mesh, P())
+        return NamedSharding(self.mesh, P())  # spec-ok: replicated() helper, the trivial spec
 
     def __repr__(self):
         return (f"Topology(pp={self.pp_size}, dp={self.dp_size} (outer={self.dp_outer_size},"

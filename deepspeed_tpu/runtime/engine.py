@@ -129,13 +129,13 @@ def _struct_congruent_specs(state_shapes, params, param_spec_tree):
 
     def spec_for(path, leaf):
         if not hasattr(leaf, "shape") or leaf.shape == ():
-            return P()
+            return P()  # spec-ok: scalar leaves replicate
         keys = tuple(_path_key(e) for e in path)
         for take in range(min(len(keys), max_plen), 0, -1):
             spec = lookup.get((keys[-take:], leaf.shape))
             if spec is not None:
                 return spec
-        return P()
+        return P()  # spec-ok: lookup fallback: replicate unknown leaves
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(state_shapes)
     return jax.tree_util.tree_unflatten(treedef, [spec_for(p, l) for p, l in flat])
@@ -242,6 +242,28 @@ class DeepSpeedTPUEngine:
 
         zc = config.zero_optimization
         self.rules = ZeroShardingRules(zc.stage, self.topo, mics_shard_size=zc.mics_shard_size)
+        from ..sharding.rules import (ForeignModelShardingError, RuleSet,
+                                      spec_tree_axis_sizes)
+        if isinstance(param_specs, RuleSet):
+            # declarative sharding: match the rule set over the (possibly
+            # lazy) param tree; axis_sizes validates mesh membership and
+            # downgrades indivisible dims instead of failing at compile
+            param_specs = param_specs.match(
+                _abstract_params(params),
+                axis_sizes=spec_tree_axis_sizes(self.topo))
+        if (param_specs is None and self.topo.tp_size > 1
+                and not getattr(loss_fn, "_sharding_native", False)):
+            # a foreign apply_fn + param tree at tp>1 with no specs would
+            # silently replicate every parameter over the tp axis — dense
+            # compute on every rank, none of the TP fast paths. Refuse.
+            raise ForeignModelShardingError(
+                "tp_size={} with no param_specs and a non-TransformerLM "
+                "model: parameters would silently replicate over the tp "
+                "axis. Pass param_specs='auto' (AutoTP inference), a "
+                "sharding.RuleSet (e.g. sharding.get_pack(...) or "
+                "sharding.derive_rules(...)), an explicit spec tree, or "
+                "load the checkpoint through "
+                "sharding.autotp_initialize().".format(self.topo.tp_size))
         if isinstance(param_specs, str) and param_specs == "auto":
             # AutoTP (reference module_inject/auto_tp.py:189): infer TP
             # PartitionSpecs from the param tree. With an example batch the
@@ -566,9 +588,9 @@ class DeepSpeedTPUEngine:
         dp_axes = topo.dp_axes
         if batch_spec is None:
             if topo.sp_size > 1:
-                batch_spec = P(dp_axes, "sp")
+                batch_spec = P(dp_axes, "sp")  # spec-ok: default batch layout when none configured (dp x sp)
             else:
-                batch_spec = P(dp_axes)
+                batch_spec = P(dp_axes)  # spec-ok: default batch layout when none configured (dp)
         self.batch_spec = batch_spec
         self.batch_sharding = NamedSharding(topo.mesh, batch_spec)
         self.grad_spec_tree = self.rules.grad_spec_tree(self.state.params, self.param_specs_base)
@@ -737,7 +759,7 @@ class DeepSpeedTPUEngine:
             per_rank = program_feedback_init(n_elems, dp_grad_impl[2],
                                              dict(topo.mesh.shape))
             if per_rank is not None:
-                fb_sh = NamedSharding(topo.mesh, P(topo.dp_axes))
+                fb_sh = NamedSharding(topo.mesh, P(topo.dp_axes))  # spec-ok: comm-feedback state is per-dp-rank
                 fb = type(per_rank)(
                     worker_error=jax.device_put(
                         jnp.zeros((topo.dp_size,)
@@ -884,12 +906,12 @@ class DeepSpeedTPUEngine:
             return grads, metrics
 
         state_sh = TrainState(
-            step=NamedSharding(topo.mesh, P()),
+            step=NamedSharding(topo.mesh, P()),  # spec-ok: step counter replicates
             params=self._param_shardings,
             opt_state=self._opt_shardings,
-            loss_scale=jax.tree.map(lambda _: NamedSharding(topo.mesh, P()), self.state.loss_scale),
+            loss_scale=jax.tree.map(lambda _: NamedSharding(topo.mesh, P()), self.state.loss_scale),  # spec-ok: loss scale replicates
             comm_feedback=jax.tree.map(
-                lambda _: NamedSharding(topo.mesh, P(topo.dp_axes)),
+                lambda _: NamedSharding(topo.mesh, P(topo.dp_axes)),  # spec-ok: comm-feedback state is per-dp-rank
                 self.state.comm_feedback))
 
         if self._host_adam is not None:
@@ -978,11 +1000,11 @@ class DeepSpeedTPUEngine:
 
             grads, losses = shard_map_nocheck(
                 per_shard, topo.mesh,
-                in_specs=(P(), P(None, dpaxes), P(), P()),
-                out_specs=(P(), P()))(params, batch, rngs, sr_key)
+                in_specs=(P(), P(None, dpaxes), P(), P()),  # spec-ok: shard_map wiring for the quantized-grad body
+                out_specs=(P(), P()))(params, batch, rngs, sr_key)  # spec-ok: shard_map wiring for the quantized-grad body
             return grads, losses, None
 
-        fb_spec = jax.tree.map(lambda _: P(dpaxes), fb_in)
+        fb_spec = jax.tree.map(lambda _: P(dpaxes), fb_in)  # spec-ok: comm-feedback slices are per-dp-rank
 
         def per_shard_fb(p, b_l, rngs_l, k, fb_l):
             acc, losses = accumulate(p, b_l, rngs_l)
@@ -993,8 +1015,8 @@ class DeepSpeedTPUEngine:
 
         return shard_map_nocheck(
             per_shard_fb, topo.mesh,
-            in_specs=(P(), P(None, dpaxes), P(), P(), fb_spec),
-            out_specs=(P(), P(), fb_spec))(params, batch, rngs, sr_key, fb_in)
+            in_specs=(P(), P(None, dpaxes), P(), P(), fb_spec),  # spec-ok: shard_map wiring for the feedback-carrying body
+            out_specs=(P(), P(), fb_spec))(params, batch, rngs, sr_key, fb_in)  # spec-ok: shard_map wiring for the feedback-carrying body
 
     def _quantized_grad_reduce(self, grads, sr_key, feedback=None):
         """Flatten a per-shard fp32 grad tree into ONE vector (the
@@ -1505,8 +1527,8 @@ class DeepSpeedTPUEngine:
 
         return shard_map_nocheck(
             per_shard, self.topo.mesh,
-            in_specs=(P(), P(dpaxes), P()),
-            out_specs=(P(), P()))(params, mb, rng)
+            in_specs=(P(), P(dpaxes), P()),  # spec-ok: shard_map wiring for the eval body
+            out_specs=(P(), P()))(params, mb, rng)  # spec-ok: shard_map wiring for the eval body
 
     def forward(self, batch):
         """Compute the loss for one microbatch (reference ``engine.forward:1848``).
@@ -2011,7 +2033,7 @@ def _host_memory_jit_supported(mesh) -> bool:
            tuple(d.id for d in mesh.devices.flat))
     if key not in _HOST_JIT_PROBE:
         try:
-            sh = NamedSharding(mesh, P()).with_memory_kind("pinned_host")
+            sh = NamedSharding(mesh, P()).with_memory_kind("pinned_host")  # spec-ok: pinned-host capability probe, single scalar
             x = jax.device_put(jnp.zeros((1,), jnp.float32), sh)
             jax.jit(lambda v: v + 1, in_shardings=sh, out_shardings=sh)(x)
             _HOST_JIT_PROBE[key] = True
@@ -2106,6 +2128,12 @@ def initialize(args=None,
         def loss_fn(params, batch, rng=None):
             kw = {"rngs": {"dropout": rng}} if rng is not None else {}
             return mod.apply({"params": params}, batch, **kw)
+
+        from ..models.transformer import TransformerLM
+        # TransformerLM reads the topology itself; any other flax module is
+        # a foreign model and must bring specs when tp > 1 (the engine
+        # raises ForeignModelShardingError instead of replicating densely)
+        loss_fn._sharding_native = isinstance(mod, TransformerLM)
 
     engine = DeepSpeedTPUEngine(loss_fn=loss_fn, params=model_parameters, config=cfg,
                                 topology=topology, param_specs=param_specs,

@@ -311,10 +311,10 @@ def make_pipeline_loss_fn(embed_fn: Callable, block_fn: Callable, head_loss_fn: 
             # (the grads' dp reduction follows from reverse-mode of this pmean)
             return lax.pmean(losses, dp)
 
-        blocks_spec = jax.tree.map(lambda _: P(PP_AXIS), blocks)
-        rep = jax.tree.map(lambda _: P(), params["embed"])
-        rep_h = jax.tree.map(lambda _: P(), params["head"])
-        mb_spec = jax.tree.map(lambda _: P(None, dp), mbs)
+        blocks_spec = jax.tree.map(lambda _: P(PP_AXIS), blocks)  # spec-ok: pipeline shard_map wiring: stage-major blocks
+        rep = jax.tree.map(lambda _: P(), params["embed"])  # spec-ok: pipeline shard_map wiring: embed replicates
+        rep_h = jax.tree.map(lambda _: P(), params["head"])  # spec-ok: pipeline shard_map wiring: head replicates
+        mb_spec = jax.tree.map(lambda _: P(None, dp), mbs)  # spec-ok: pipeline shard_map wiring: microbatch over dp
         # ALL mesh axes manual: grad-of-checkpoint inside a partial shard_map
         # emits residual specs over the auto axes and trips the out_specs
         # check; unused axes (sp/tp here) just see replicated values
@@ -323,7 +323,7 @@ def make_pipeline_loss_fn(embed_fn: Callable, block_fn: Callable, head_loss_fn: 
         losses = shard_map_nocheck_manual(
             pipe_body, mesh,
             in_specs=(blocks_spec, rep, rep_h, mb_spec),
-            out_specs=P(),
+            out_specs=P(),  # spec-ok: pipeline shard_map wiring: scalar loss out
             axis_names=set(mesh.axis_names))(
                 blocks, params["embed"], params["head"], mbs)
         return jnp.mean(losses)
@@ -379,7 +379,7 @@ def pipeline_param_specs(params, topo=None) -> Any:
             raise ValueError(f"{n_layers} layers not divisible by pp={topo.pp_size}")
     return {
         "embed": jax.tree.map(lambda _: None, params["embed"]),
-        "blocks": jax.tree.map(lambda p: P(PP_AXIS) if p.ndim >= 1 else P(),
+        "blocks": jax.tree.map(lambda p: P(PP_AXIS) if p.ndim >= 1 else P(),  # spec-ok: pipeline base specs: stage-major blocks else replicated
                                params["blocks"]),
         "head": jax.tree.map(lambda _: None, params["head"]),
     }

@@ -64,20 +64,20 @@ def shard_param_spec(shape: Sequence[int],
     ndim = len(shape)
     base = _spec_tuple(base_spec, ndim)
     if axis_size == 1 or int(np.prod(shape or (1,))) < min_size_to_shard:
-        return P(*base)
+        return P(*base)  # spec-ok: ZeRO free-dim surgery: below-threshold leaves keep the base spec
     used = _axes_in_spec(base)
     if set(shard_axes) & used:
-        return P(*base)  # already sharded over (some of) these axes by the model
+        return P(*base)  # already sharded over (some of) these axes by the model  # spec-ok: ZeRO free-dim surgery: model already claimed these axes
     best = -1
     best_size = 0
     for d in range(ndim):
         if base[d] is None and shape[d] % axis_size == 0 and shape[d] > best_size:
             best, best_size = d, shape[d]
     if best < 0:
-        return P(*base)
+        return P(*base)  # spec-ok: ZeRO free-dim surgery: no divisible free dim
     new = list(base)
     new[best] = shard_axes if len(shard_axes) > 1 else shard_axes[0]
-    return P(*new)
+    return P(*new)  # spec-ok: ZeRO free-dim surgery: claim the best free dim
 
 
 class ZeroShardingRules:
@@ -105,19 +105,19 @@ class ZeroShardingRules:
         if self.stage >= 3:
             return shard_param_spec(shape, base_spec, self.fsdp_axes, self.fsdp_size,
                                     self.min_size_to_shard)
-        return P(*_spec_tuple(base_spec, len(shape)))
+        return P(*_spec_tuple(base_spec, len(shape)))  # spec-ok: stage<3 params keep the model-parallel base spec
 
     def opt_state_spec(self, shape, base_spec: Optional[P]) -> P:
         if self.stage >= 1:
             return shard_param_spec(shape, base_spec, self.fsdp_axes, self.fsdp_size,
                                     self.min_size_to_shard)
-        return P(*_spec_tuple(base_spec, len(shape)))
+        return P(*_spec_tuple(base_spec, len(shape)))  # spec-ok: stage 0 optimizer state keeps the base spec
 
     def grad_accum_spec(self, shape, base_spec: Optional[P]) -> P:
         if self.stage >= 2:
             return shard_param_spec(shape, base_spec, self.fsdp_axes, self.fsdp_size,
                                     self.min_size_to_shard)
-        return P(*_spec_tuple(base_spec, len(shape)))
+        return P(*_spec_tuple(base_spec, len(shape)))  # spec-ok: stage<2 grad accumulators keep the base spec
 
     # -- tree-level helpers ----------------------------------------------
     def param_spec_tree(self, params, base_specs=None):

@@ -28,9 +28,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from ...comm.compressed import quantized_all_gather, quantized_reduce_scatter
+from ...sharding import sites
 from ...utils.shard_map_compat import shard_map_nocheck as _sm
 
 _PAD_QUANTUM = 128  # quantized_reduce_scatter block alignment
@@ -172,8 +173,9 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
 
     def shard_spec_tree(tree):
         return jax.tree.map(
-            lambda l: P(dp_axis) if getattr(l, "ndim", 0) >= 1 and
-            l.shape[:1] == (dp,) else P(), tree)
+            lambda l: sites.zero_flat_shard(dp_axis)
+            if getattr(l, "ndim", 0) >= 1 and l.shape[:1] == (dp,)
+            else sites.replicated(), tree)
 
     def init(params):
         flat, treedef = jax.tree.flatten(params)
@@ -205,7 +207,9 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
                 kn["ring_s"] = ds_.impl == "ring"
                 kn["fused_s"] = ds_.impl == "fused_matmul"
         shards = jax.device_put(
-            shards, jax.tree.map(lambda s: NamedSharding(mesh, P(dp_axis)), shards))
+            shards, jax.tree.map(
+                lambda s: NamedSharding(mesh, sites.zero_flat_shard(dp_axis)),
+                shards))
         opt_state = tx.init(shards)
         return ZeroPPState(step=jnp.zeros([], jnp.int32), shards=shards,
                            opt_state=opt_state)
@@ -359,8 +363,9 @@ def zeropp_train_step_factory(loss_fn: Callable, tx, mesh: Mesh,
         opt_spec = shard_spec_tree(state.opt_state)
         new_shards, new_opt, loss = _sm(
             body, mesh,
-            in_specs=(sh_spec, opt_spec, P(dp_axis), P()),
-            out_specs=(sh_spec, opt_spec, P()))(
+            in_specs=(sh_spec, opt_spec, sites.zero_flat_shard(dp_axis),
+                      sites.replicated()),
+            out_specs=(sh_spec, opt_spec, sites.replicated()))(
                 state.shards, state.opt_state, batch, state.step)
         return ZeroPPState(step=state.step + 1, shards=new_shards,
                            opt_state=new_opt), loss

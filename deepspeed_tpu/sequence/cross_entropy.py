@@ -139,8 +139,8 @@ def vocab_sequence_parallel_cross_entropy(logits, targets, *, z_loss: float = 0.
         return nll + z_loss * jnp.square(logz) if z_loss > 0 else nll
 
     dp = topo.dp_axes
-    lg_spec = P(dp, SP_AXIS, TP_AXIS)
-    tg_spec = P(dp, SP_AXIS)
+    lg_spec = P(dp, SP_AXIS, TP_AXIS)  # spec-ok: vocab-parallel CE shard_map wiring: logit grid
+    tg_spec = P(dp, SP_AXIS)  # spec-ok: vocab-parallel CE shard_map wiring: target grid
 
     def body(lg, tg):
         return vocab_parallel_cross_entropy(lg, tg, axis_name=TP_AXIS,
@@ -236,9 +236,9 @@ def _vocab_sharded_head_nll(hidden, head_kernel, targets, *, head_bias,
                             z_loss, topo, logit_dtype):
     """shard_map body: local head matmul fused with the sharded CE."""
     dp = topo.dp_axes
-    h_spec = P(dp, SP_AXIS, None)
-    k_spec = P(None, TP_AXIS)
-    tg_spec = P(dp, SP_AXIS)
+    h_spec = P(dp, SP_AXIS, None)  # spec-ok: fused-head CE shard_map wiring: hidden grid
+    k_spec = P(None, TP_AXIS)  # spec-ok: fused-head CE shard_map wiring: vocab-sharded kernel
+    tg_spec = P(dp, SP_AXIS)  # spec-ok: fused-head CE shard_map wiring: target grid
 
     def body(h, k, b, tg):
         lg = h.astype(logit_dtype) @ k.astype(logit_dtype)
@@ -256,7 +256,7 @@ def _vocab_sharded_head_nll(hidden, head_kernel, targets, *, head_bias,
                                  out_specs=tg_spec)(
                                      hidden, head_kernel, targets)
     return shard_map_nocheck(body, topo.mesh,
-                             in_specs=(h_spec, k_spec, P(TP_AXIS), tg_spec),
+                             in_specs=(h_spec, k_spec, P(TP_AXIS), tg_spec),  # spec-ok: fused-head CE shard_map wiring: vocab-sharded bias
                              out_specs=tg_spec)(
                                  hidden, head_kernel, head_bias, targets)
 
@@ -283,9 +283,9 @@ def _fused_lm_loss(hidden, head_kernel, tokens, *, loss_mask, z_loss, topo):
 
     dp = topo.dp_axes
     tp = topo.tp_size
-    h_spec = P(dp, SP_AXIS, None)
-    tg_spec = P(dp, SP_AXIS)
-    k_spec = P(None, TP_AXIS) if tp > 1 else P(None, None)
+    h_spec = P(dp, SP_AXIS, None)  # spec-ok: fused-head CE shard_map wiring: hidden grid
+    tg_spec = P(dp, SP_AXIS)  # spec-ok: fused-head CE shard_map wiring: target grid
+    k_spec = P(None, TP_AXIS) if tp > 1 else P(None, None)  # spec-ok: fused-head CE shard_map wiring: kernel, tp-gated
     axis = TP_AXIS if tp > 1 else None
 
     def body(h, k, tg):

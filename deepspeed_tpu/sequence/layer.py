@@ -29,9 +29,9 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
 from ..parallel.topology import SP_AXIS, TP_AXIS, get_topology
+from ..sharding import sites
 
 
 def _a2a_quantized(x, sp: int, split_dim: int) -> bool:
@@ -146,8 +146,8 @@ def ulysses_attention(local_attn: Callable, q, k, v):
     tp = topo.tp_size
     q_axis = "tp" if (tp > 1 and h % (sp * tp) == 0) else None
     kv_axis = "tp" if (q_axis is not None and hk % tp == 0) else None
-    q_spec = P(dp, SP_AXIS, q_axis, None)
-    kv_spec = P(dp, SP_AXIS, kv_axis, None)
+    q_spec = sites.ulysses_act(dp, SP_AXIS, q_axis)
+    kv_spec = sites.ulysses_act(dp, SP_AXIS, kv_axis)
     h_pad = h if q_axis else -(-h // sp) * sp
     if h_pad != h:
         q = jnp.pad(q, ((0, 0), (0, 0), (0, h_pad - h), (0, 0)))
@@ -219,13 +219,13 @@ def ulysses_matmul_attention(local_attn, x, q_params, k_params, v_params,
                   for p in (q_params, k_params, v_params))
     wo = o_params["kernel"].astype(dt)
     dh = wq.shape[2]
-    w_spec = P(None, SP_AXIS, None)
+    w_spec = sites.col_kernel3(SP_AXIS)
     args = [x.astype(dt), wq, wk, wv, wo]
-    specs = [P(dp, SP_AXIS, None), w_spec, w_spec, w_spec,
-             P(SP_AXIS, None, None)]
+    specs = [sites.seq_sharded_act(dp, SP_AXIS), w_spec, w_spec, w_spec,
+             sites.row_kernel3(SP_AXIS)]
     if "bias" in q_params:
         args += [p["bias"].astype(dt) for p in (q_params, k_params, v_params)]
-        specs += [P(SP_AXIS, None)] * 3
+        specs += [sites.col_bias2(SP_AXIS)] * 3
 
     def body(x_, wq_, wk_, wv_, wo_, *bs):
         q_, k_, v_ = fused_qkv_all_gather_matmul(x_, wq_, wk_, wv_, bs, dh,
@@ -236,7 +236,7 @@ def ulysses_matmul_attention(local_attn, x, q_params, k_params, v_params,
                                      wo_.reshape(hl * dh, -1), SP_AXIS)
 
     out = shard_map_nocheck(body, topo.mesh, tuple(specs),
-                            P(dp, SP_AXIS, None))(*args)
+                            sites.seq_sharded_act(dp, SP_AXIS))(*args)
     if "bias" in o_params:
         out = out + o_params["bias"].astype(dt)
     return out

@@ -115,7 +115,7 @@ def ring_attention(q, k, v, *, apply_pos: Optional[Callable] = None,
     h, hk = q.shape[2], k.shape[2]
     tp = topo.tp_size
     heads_axis = "tp" if (tp > 1 and h % tp == 0 and hk % tp == 0) else None
-    io_spec = P(topo.dp_axes, SP_AXIS, heads_axis, None)
+    io_spec = P(topo.dp_axes, SP_AXIS, heads_axis, None)  # spec-ok: ring attention shard_map wiring: heads over tp when divisible
 
     def body(q_, k_, v_):
         if apply_pos is not None:

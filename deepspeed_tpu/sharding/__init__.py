@@ -1,0 +1,24 @@
+"""Declarative sharding subsystem: the one source of sharding truth.
+
+``rules``  — regex-path → PartitionSpec engine (precedence, overlap and
+             axis validation, versioned JSON serialization).
+``packs``  — built-in rule packs for the HF model-family tree shapes.
+``derive`` — the AutoTP bridge: jaxpr/name inference → explicit rules.
+``sites``  — named activation-layout specs (the former inline literals).
+``autotp`` — ``autotp_initialize``: checkpoint → sharded engine, end to end.
+
+Everything else in the repo consumes specs from here; ``analysis/lint.py``
+rule R5 rejects raw ``PartitionSpec`` construction outside this package.
+"""
+
+from . import sites  # noqa: F401
+from .autotp import (autotp_initialize, register_param_collectives,  # noqa: F401
+                     resolve_rules, shard_checkpoint_tree)
+from .derive import derive_rules, derived_matches_parser  # noqa: F401
+from .packs import (PACKS, generic_pack, get_pack,  # noqa: F401
+                    gpt2_pack, gpt_neox_pack, llama_pack, mistral_pack,
+                    mixtral_pack, pack_for_config)
+from .rules import (RULES_FORMAT, AmbiguousRuleError,  # noqa: F401
+                    ForeignModelShardingError, Rule, RuleSet,
+                    RulesFormatError, ShardingRuleError, UnknownAxisError,
+                    UnmatchedParamError, spec_tree_axis_sizes)

@@ -1,0 +1,115 @@
+"""``derive_rules``: the AutoTP bridge — opaque inference → explicit rules.
+
+``module_inject/auto_tp.py`` infers a spec *tree*: jaxpr dataflow finds the
+Megatron col→row pairing from the program, the reference name vocabulary
+decides the rest.  That tree is correct but opaque — you cannot diff it,
+serialize it, or audit *why* a leaf sharded.  This bridge runs the same
+inference and compresses the result into a named :class:`RuleSet`:
+
+* per-layer duplicates collapse — numeric path segments generalize to a
+  ``\\d+`` pattern, so ``layer_0 … layer_31`` become one rule;
+* a generalized pattern whose leaves disagree (different specs at the same
+  shape class) stays exact — one anchored rule per conflicting path, never
+  a silent majority vote;
+* every rule carries its provenance note (``autotp:jaxpr`` when dataflow
+  classified the leaf, ``autotp:name`` otherwise).
+
+The round-trip is bitwise: ``derive_rules(params, ...).match(params)``
+equals ``tp_parser(params, ...)`` leaf for leaf
+(``tests/unit/test_sharding_rules.py`` pins it).
+"""
+
+from __future__ import annotations
+
+import re
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+from .rules import Rule, RuleSet
+
+_NUM_SEG = re.compile(r"(?:(?<=[/_.])|^)\d+(?=[/_.]|$)")
+
+
+def _generalize(path: str) -> str:
+    """Anchored pattern with numeric segments widened: ``layer_0/attn`` →
+    ``^layer_\\d+/attn$`` — the repeated-block compressor.  Widening runs
+    on the raw path and escaping on the literal stretches between, so
+    dotted raw-HF keys (``model.layers.0...``) generalize too."""
+    out, last = [], 0
+    for m in _NUM_SEG.finditer(path):
+        out.append(re.escape(path[last:m.start()]))
+        out.append(r"\d+")
+        last = m.end()
+    out.append(re.escape(path[last:]))
+    return "^" + "".join(out) + "$"
+
+
+def _exact(path: str) -> str:
+    return "^" + re.escape(path) + "$"
+
+
+def derive_rules(params, apply_fn=None, example_inputs: Tuple = (),
+                 *, axis: str = "tp", tp_size: Optional[int] = None,
+                 name: str = "autotp-derived") -> RuleSet:
+    """Run AutoTP inference over ``params`` and return it as an explicit,
+    serializable rule set (same signature vocabulary as ``tp_parser``)."""
+    from ..module_inject.auto_tp import (flatten_with_paths, infer_tp_roles,
+                                         tp_parser)
+
+    spec_tree = tp_parser(params, apply_fn=apply_fn,
+                          example_inputs=example_inputs, axis=axis,
+                          tp_size=tp_size)
+    # provenance: which paths the jaxpr dataflow pass classified
+    jaxpr_paths = set()
+    if apply_fn is not None and example_inputs:
+        try:
+            jaxpr_paths = set(
+                infer_tp_roles(apply_fn, params, *example_inputs))
+        except Exception:  # inference already fell back inside tp_parser
+            jaxpr_paths = set()
+
+    from jax.sharding import PartitionSpec
+    paths, leaves, _ = flatten_with_paths(params)
+    flat_specs = jax.tree_util.tree_flatten(
+        spec_tree, is_leaf=lambda x: isinstance(x, PartitionSpec))[0]
+    # group identical (generalized pattern, ndim) decisions
+    groups: Dict[Tuple[str, int], List[Tuple[str, Tuple]]] = defaultdict(list)
+    order: List[Tuple[str, int]] = []
+    for path, leaf, spec in zip(paths, leaves, flat_specs):
+        nd = len(getattr(leaf, "shape", ()))
+        key = (_generalize(path), nd)
+        if key not in groups:
+            order.append(key)
+        groups[key].append((path, tuple(spec)))
+
+    rules: List[Rule] = []
+    for key in order:
+        pat, nd = key
+        members = groups[key]
+        src = ("autotp:jaxpr" if any(p in jaxpr_paths for p, _ in members)
+               else "autotp:name")
+        distinct = {s for _, s in members}
+        if len(distinct) == 1:
+            spec = members[0][1]
+            if any(e is not None for e in spec):
+                rules.append(Rule(pat, spec, ndim=nd, note=src))
+        else:
+            # same generalized shape class, different decisions (e.g. one
+            # indivisible layer downgraded): keep each path exact
+            for path, spec in members:
+                if any(e is not None for e in spec):
+                    rules.append(Rule(_exact(path), spec, ndim=nd, note=src))
+    return RuleSet(rules, name=name, axes=(axis,))
+
+
+def derived_matches_parser(params, ruleset: RuleSet, spec_tree) -> bool:
+    """Bitwise equality check between a derived rule set's match and a
+    reference spec tree (the acceptance predicate the tests assert)."""
+    from jax.sharding import PartitionSpec
+    got = ruleset.match(params)
+    eq = jax.tree_util.tree_map(
+        lambda a, b: a == b, got, spec_tree,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+    return all(jax.tree_util.tree_leaves(eq))

@@ -29,6 +29,13 @@ def simple_loss(params, batch):
     return jnp.mean((pred - y.astype(pred.dtype)) ** 2)
 
 
+# The toy model deliberately ignores tp — replication over a tp-carved
+# mesh is part of the tested engine contract (test_topology_tp_axis_free,
+# cross-topology checkpoint loads). Opt out of the foreign-model guard
+# explicitly instead of passing specs everywhere.
+simple_loss._sharding_native = True
+
+
 def random_batches(n, batch_size, hidden=64, seed=0):
     rng = np.random.default_rng(seed)
     w_true = rng.normal(size=(hidden, 1)).astype(np.float32)

@@ -1,7 +1,8 @@
 """Repo-invariant linter (analysis/lint.py) — rule units + the tier-1
 enforcement pass over the real tree: a patch that re-introduces a raw
-shard_map import, an unannotated host sync in a default-on path, or a
-mutable default arg in a public API fails CI here."""
+shard_map import, an unannotated host sync in a default-on path, a
+mutable default arg in a public API, or a raw PartitionSpec literal
+outside deepspeed_tpu/sharding/ fails CI here."""
 
 import os
 
@@ -162,6 +163,56 @@ def test_swallow_narrow_exception_not_flagged():
            "    except KeyError:\n"
            "        pass\n")
     assert lint_source(src, "serving/server.py") == []
+
+
+def test_raw_partition_spec_flagged():
+    src = ("from jax.sharding import PartitionSpec as P\n"
+           "spec = P('tp', None)\n")
+    fs = lint_source(src, "runtime/engine.py")
+    assert any(f.rule == "raw-partition-spec" for f in fs)
+
+
+def test_raw_partition_spec_attribute_flagged():
+    src = ("import jax\n"
+           "spec = jax.sharding.PartitionSpec('tp')\n")
+    fs = lint_source(src, "moe/layer.py")
+    assert any(f.rule == "raw-partition-spec" for f in fs)
+
+
+def test_partition_spec_sharding_package_exempt():
+    src = ("from jax.sharding import PartitionSpec as P\n"
+           "spec = P('tp', None)\n")
+    assert lint_source(src, "sharding/rules.py") == []
+    assert lint_source(src, "sharding/sites.py") == []
+
+
+def test_partition_spec_annotation_blesses():
+    src = ("from jax.sharding import PartitionSpec as P\n"
+           "spec = P('tp')  # spec-ok: test fixture\n")
+    assert lint_source(src, "runtime/engine.py") == []
+
+
+def test_partition_spec_annotation_line_above():
+    src = ("from jax.sharding import PartitionSpec as P\n"
+           "# spec-ok: long literal annotated above\n"
+           "spec = P('tp', None,\n"
+           "         None)\n")
+    assert lint_source(src, "runtime/engine.py") == []
+
+
+def test_partition_spec_import_alone_not_flagged():
+    # importing the name (e.g. for isinstance checks) is fine; only
+    # constructing a literal is a hidden layout decision
+    src = ("from jax.sharding import PartitionSpec as P\n"
+           "def is_spec(x):\n"
+           "    return isinstance(x, P)\n")
+    assert lint_source(src, "parallel/topology.py") == []
+
+
+def test_partition_spec_via_sites_is_clean():
+    src = ("from ..sharding import sites\n"
+           "spec = sites.seq_sharded_act('dp_outer', 'tp')\n")
+    assert lint_source(src, "models/transformer.py") == []
 
 
 def test_finding_renders_path_and_rule():

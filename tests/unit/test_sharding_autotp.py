@@ -1,0 +1,161 @@
+"""AutoTP v2 end to end: a raw HF-layout checkpoint — NOT the toy
+``TransformerLM`` init — auto-shards under TP×ZeRO-3 with zero
+model-specific code, trains, and its compiled step audits to zero
+unplanned gather-class collectives against the planner's records.
+
+Reference analogue: the AutoTP inference tests in the reference repo's
+``tests/unit/`` module-injection suite, promoted to a training-path
+acceptance gate."""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.sharding import (ForeignModelShardingError,
+                                    shard_checkpoint_tree)
+from deepspeed_tpu.sharding.audit_entry import (FAMILIES, family_audit_report,
+                                                family_engine,
+                                                toy_hf_checkpoint)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class TestForeignModelTrains:
+    def test_llama_checkpoint_trains_tp_zero3(self):
+        """The headline acceptance: a raw llama-layout state dict (transposed
+        torch weights, dotted keys) trains at tp=2 × ZeRO-3 with decreasing
+        loss and planner-resolved collectives."""
+        engine, b = family_engine("llama", tp=2, zero_stage=3)
+        losses = [float(engine.train_batch(b)) for _ in range(3)]
+        assert all(np.isfinite(losses)), losses
+        assert losses[-1] < losses[0], losses
+
+    def test_param_actually_tp_sharded(self):
+        """The q_proj kernel must live sharded over tp — dense replication
+        is exactly the silent failure AutoTP v2 exists to kill."""
+        engine, _ = family_engine("llama", tp=2, zero_stage=3)
+        qkern = engine.state.params["layer_0"]["attn"]["q_proj"]["kernel"]
+        spec = qkern.sharding.spec
+        assert "tp" in [a for e in spec if e is not None
+                        for a in ((e,) if isinstance(e, str) else e)], spec
+
+    def test_apply_fn_path_shards_and_trains(self):
+        """Second input shape: normalized params + a caller loss fn."""
+        rng = np.random.default_rng(0)
+        params = {"up_proj": {"kernel": jnp.asarray(
+                      rng.normal(0, 0.02, (16, 64)), jnp.float32)},
+                  "down_proj": {"kernel": jnp.asarray(
+                      rng.normal(0, 0.02, (64, 16)), jnp.float32)}}
+
+        def loss_fn(p, batch, rng=None):
+            h = jnp.tanh(batch["x"] @ p["up_proj"]["kernel"])
+            y = h @ p["down_proj"]["kernel"]
+            return jnp.mean((y - batch["y"]) ** 2)
+
+        engine, *_ = ds.autotp_initialize(
+            params, apply_fn=loss_fn,
+            config={"train_micro_batch_size_per_gpu": 8,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "tensor_parallel": {"enabled": True, "tp_size": 2},
+                    "zero_optimization": {"stage": 0},
+                    "steps_per_print": 10**9})
+        b = engine._shape_batch(
+            {"x": jnp.ones((8, 16), jnp.float32),
+             "y": jnp.zeros((8, 16), jnp.float32)})
+        assert np.isfinite(float(engine.train_batch(b)))
+        spec = engine.state.params["up_proj"]["kernel"].sharding.spec
+        assert tuple(spec) == (None, "tp")
+
+
+class TestForeignModelGuard:
+    def test_unspecced_foreign_model_refused_at_tp(self):
+        """tp_size>1 + no param_specs + a non-TransformerLM loss fn must be
+        a named refusal, not silent dense replication."""
+        def loss_fn(p, batch, rng=None):
+            return jnp.mean((batch["x"] @ p["w"]) ** 2)
+
+        with pytest.raises(ForeignModelShardingError, match="autotp"):
+            ds.initialize(
+                model=loss_fn,
+                model_parameters={"w": jnp.zeros((8, 8), jnp.float32)},
+                config={"train_micro_batch_size_per_gpu": 8,
+                        "optimizer": {"type": "adamw",
+                                      "params": {"lr": 1e-3}},
+                        "tensor_parallel": {"enabled": True, "tp_size": 2},
+                        "steps_per_print": 10**9})
+
+    def test_foreign_model_fine_without_tp(self):
+        def loss_fn(p, batch, rng=None):
+            return jnp.mean((batch["x"] @ p["w"]) ** 2)
+
+        engine, *_ = ds.initialize(
+            model=loss_fn,
+            model_parameters={"w": jnp.zeros((8, 8), jnp.float32)},
+            config={"train_micro_batch_size_per_gpu": 8,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "steps_per_print": 10**9})
+        assert engine is not None
+
+
+class TestShardCheckpointTree:
+    def test_per_rank_flow_matches_global_slices(self):
+        """axis_index=i returns rank i's numpy slice — leaf-for-leaf the
+        ``shard_checkpoint_leaf`` / state_dict_factory split contract."""
+        val = np.arange(32, dtype=np.float32).reshape(4, 8)
+        params = {"w": val}
+        specs = {"w": P(None, "tp")}
+        r0 = shard_checkpoint_tree(params, specs, axis="tp", axis_index=0,
+                                   axis_size=2)
+        r1 = shard_checkpoint_tree(params, specs, axis="tp", axis_index=1,
+                                   axis_size=2)
+        np.testing.assert_array_equal(r0["w"], val[:, :4])
+        np.testing.assert_array_equal(r1["w"], val[:, 4:])
+
+    def test_leaf_count_mismatch_refused(self):
+        from deepspeed_tpu.sharding import ShardingRuleError
+        with pytest.raises(ShardingRuleError, match="leaves"):
+            shard_checkpoint_tree({"a": np.zeros(4), "b": np.zeros(4)},
+                                  {"a": P(None)}, axis_index=0, axis_size=2)
+
+
+class TestFamilyAudits:
+    """ISSUE acceptance: each built-in pack's family compiles under
+    TP×ZeRO-3 and audits to zero unplanned gather-class collectives."""
+
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_family_audits_clean(self, family):
+        rep = family_audit_report(family)
+        assert rep.counts().get("error", 0) == 0, rep.findings
+        assert rep.context.get("unplanned_collectives") == 0, [
+            f.summary for f in rep.findings
+            if "implicit resharding" in f.summary]
+
+
+@pytest.mark.slow
+class TestAuditCli:
+    def test_audit_cli_entry_exits_clean(self):
+        """`python -m deepspeed_tpu.audit --entry ...:llama` exits 0."""
+        env = dict(os.environ,
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8",
+                   JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.audit", "--entry",
+             "deepspeed_tpu.sharding.audit_entry:llama"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_toy_checkpoints_cover_all_families():
+    for fam in FAMILIES:
+        sd, cfg = toy_hf_checkpoint(fam)
+        assert sd and cfg["hidden_size"] == 32
+        # raw torch layout: dotted keys, [out, in] weights
+        assert any("." in k for k in sd)

@@ -1,0 +1,280 @@
+"""Declarative sharding rules (deepspeed_tpu/sharding/): the regex-path ->
+PartitionSpec engine — precedence, overlap/ambiguity detection, mesh-axis
+validation, versioned JSON round-trips — plus the two bitwise acceptance
+predicates: ``derive_rules`` reproduces ``tp_parser`` and the built-in packs
+reproduce the hand-written ``param_specs`` ladder."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.sharding import (RULES_FORMAT, AmbiguousRuleError, Rule,
+                                    RuleSet, RulesFormatError,
+                                    ShardingRuleError, UnknownAxisError,
+                                    UnmatchedParamError, derive_rules,
+                                    derived_matches_parser, get_pack,
+                                    pack_for_config)
+
+
+def toy_params():
+    return {
+        "layers_0": {
+            "attn": {
+                "q_proj": {"kernel": jnp.zeros((8, 8)),
+                           "bias": jnp.zeros((8,))},
+                "o_proj": {"kernel": jnp.zeros((8, 8)),
+                           "bias": jnp.zeros((8,))},
+            },
+            "mlp": {
+                "dense_h_to_4h": {"kernel": jnp.zeros((8, 32))},
+                "dense_4h_to_h": {"kernel": jnp.zeros((32, 8))},
+            },
+            "input_layernorm": {"scale": jnp.zeros((8,))},
+        },
+        "embed_tokens": {"embedding": jnp.zeros((64, 8))},
+    }
+
+
+# ---------------------------------------------------------------------------
+# precedence
+# ---------------------------------------------------------------------------
+
+
+class TestPrecedence:
+    def test_higher_priority_wins(self):
+        rs = RuleSet([Rule(r"kernel", (None, "tp"), priority=1),
+                      Rule(r"q_proj/kernel", ("tp", None), priority=5)])
+        assert rs.match_path("attn/q_proj/kernel", 2).spec == ("tp", None)
+        assert rs.match_path("mlp/up/kernel", 2).spec == (None, "tp")
+
+    def test_ndim_specific_beats_generic(self):
+        rs = RuleSet([Rule(r"proj", (None, "tp"), ndim=2),
+                      Rule(r"proj", ("tp",), ndim=1),
+                      Rule(r"proj", (None,))])
+        assert rs.match_path("q_proj", 2).spec == (None, "tp")
+        assert rs.match_path("q_proj", 1).spec == ("tp",)
+        # no ndim-conditioned candidate at rank 3: the generic rule wins
+        assert rs.match_path("q_proj", 3).spec == (None,)
+
+    def test_equal_priority_same_spec_is_fine(self):
+        rs = RuleSet([Rule(r"q_proj", (None, "tp")),
+                      Rule(r"proj", (None, "tp"))])
+        assert rs.match_path("q_proj/kernel", 2).spec == (None, "tp")
+
+    def test_ambiguity_raises(self):
+        rs = RuleSet([Rule(r"q_proj", (None, "tp")),
+                      Rule(r"proj", ("tp", None))])
+        with pytest.raises(AmbiguousRuleError, match="q_proj"):
+            rs.match_path("attn/q_proj/kernel", 2)
+
+    def test_overlap_report_lists_survivors(self):
+        rs = RuleSet([Rule(r"kernel", (None, "tp")),
+                      Rule(r"q_proj/kernel", ("tp", None), priority=5)])
+        report = rs.overlap_report(toy_params())
+        paths = [row["path"] for row in report]
+        assert "layers_0/attn/q_proj/kernel" in paths
+        row = report[paths.index("layers_0/attn/q_proj/kernel")]
+        assert len(row["rules"]) == 2
+
+    def test_bad_regex_refused(self):
+        with pytest.raises(ShardingRuleError, match="regex"):
+            Rule(r"q_proj(", (None, "tp"))
+
+
+# ---------------------------------------------------------------------------
+# axis validation + divisibility
+# ---------------------------------------------------------------------------
+
+
+class TestValidation:
+    def test_unknown_axis_rejected(self):
+        rs = RuleSet([Rule(r"kernel", (None, "model"))])
+        with pytest.raises(UnknownAxisError, match="model"):
+            rs.validate(("dp_outer", "tp", "ep"))
+
+    def test_declared_axes_checked_at_construction(self):
+        with pytest.raises(UnknownAxisError):
+            RuleSet([Rule(r"kernel", (None, "model"))], axes=("tp",))
+
+    def test_match_validates_against_axis_sizes(self):
+        rs = RuleSet([Rule(r"kernel", (None, "model"))])
+        with pytest.raises(UnknownAxisError):
+            rs.match(toy_params(), axis_sizes={"tp": 2})
+
+    def test_indivisible_dim_downgrades_to_replicated(self):
+        rs = RuleSet([Rule(r"kernel", (None, "tp"))])
+        params = {"a": {"kernel": jnp.zeros((8, 30))},
+                  "b": {"kernel": jnp.zeros((8, 32))}}
+        specs = rs.match(params, axis_sizes={"tp": 4})
+        assert specs["a"]["kernel"] == P(None, None)
+        assert specs["b"]["kernel"] == P(None, "tp")
+
+    def test_unmatched_replicates_at_leaf_rank(self):
+        rs = RuleSet([Rule(r"nothing_matches_this", ("tp",))])
+        specs = rs.match({"w": jnp.zeros((4, 4)), "b": jnp.zeros((4,))})
+        assert specs["w"] == P(None, None)
+        assert specs["b"] == P(None)
+
+    def test_strict_raises_on_unmatched(self):
+        rs = RuleSet([Rule(r"kernel", (None, "tp"))], name="toy")
+        with pytest.raises(UnmatchedParamError, match="bias"):
+            rs.match({"bias": jnp.zeros((4,))}, strict=True)
+
+    def test_renamed_rewrites_axes(self):
+        rs = RuleSet([Rule(r"kernel", (None, "tp"))], axes=("tp",))
+        out = rs.renamed({"tp": "model"})
+        assert out.rules[0].spec == (None, "model")
+        assert out.axes == frozenset({"model"})
+
+
+# ---------------------------------------------------------------------------
+# serialization
+# ---------------------------------------------------------------------------
+
+
+class TestSerialization:
+    def test_json_round_trip(self):
+        rs = get_pack("llama")
+        back = RuleSet.from_json(rs.to_json())
+        assert back == rs
+        assert back.format_version == RULES_FORMAT
+
+    def test_round_trip_preserves_match(self):
+        params = toy_params()
+        rs = get_pack("generic")
+        back = RuleSet.from_json(rs.to_json())
+        a = jax.tree_util.tree_leaves(
+            rs.match(params), is_leaf=lambda x: isinstance(x, P))
+        b = jax.tree_util.tree_leaves(
+            back.match(params), is_leaf=lambda x: isinstance(x, P))
+        assert a == b
+
+    def test_future_format_refused(self):
+        d = get_pack("llama").to_dict()
+        d["format"] = RULES_FORMAT + 1
+        with pytest.raises(RulesFormatError, match="understands"):
+            RuleSet.from_dict(d)
+
+    def test_future_format_refused_at_construction(self):
+        with pytest.raises(RulesFormatError):
+            RuleSet([], format_version=RULES_FORMAT + 1)
+
+    def test_tuple_entries_survive_json(self):
+        rs = RuleSet([Rule(r"w", (("dp_outer", "ep"), None))])
+        back = RuleSet.from_json(rs.to_json())
+        assert back.rules[0].spec == (("dp_outer", "ep"), None)
+
+
+# ---------------------------------------------------------------------------
+# packs
+# ---------------------------------------------------------------------------
+
+
+class TestPacks:
+    def test_unknown_pack_name(self):
+        with pytest.raises(KeyError, match="unknown"):
+            get_pack("nope")
+
+    def test_generic_pack_matches_canonical_vocabulary(self):
+        # the vocabulary params_from_hf normalizes every family into
+        params = {
+            "layers_0": {
+                "attn": {
+                    "q_proj": {"kernel": jnp.zeros((8, 8)),
+                               "bias": jnp.zeros((8,))},
+                    "o_proj": {"kernel": jnp.zeros((8, 8)),
+                               "bias": jnp.zeros((8,))},
+                },
+                "mlp": {
+                    "up_proj": {"kernel": jnp.zeros((8, 32))},
+                    "down_proj": {"kernel": jnp.zeros((32, 8))},
+                },
+                "input_layernorm": {"scale": jnp.zeros((8,))},
+            },
+            "embed_tokens": {"embedding": jnp.zeros((64, 8))},
+        }
+        specs = get_pack("generic").match(params)
+        l0 = specs["layers_0"]
+        assert l0["attn"]["q_proj"]["kernel"] == P(None, "tp")
+        assert l0["attn"]["q_proj"]["bias"] == P("tp")
+        assert l0["attn"]["o_proj"]["kernel"] == P("tp", None)
+        assert l0["attn"]["o_proj"]["bias"] == P(None)
+        assert l0["mlp"]["up_proj"]["kernel"] == P(None, "tp")
+        assert l0["mlp"]["down_proj"]["kernel"] == P("tp", None)
+        assert l0["input_layernorm"]["scale"] == P(None)
+        assert specs["embed_tokens"]["embedding"] == P(None, "tp")
+
+    def test_pack_matches_param_specs_bitwise(self):
+        """The generic pack IS the hand-written param_specs ladder."""
+        from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                      TransformerLM,
+                                                      param_specs)
+        cfg = TransformerConfig(vocab_size=64, hidden_size=32,
+                                intermediate_size=64, num_layers=2,
+                                num_heads=4, num_kv_heads=2, max_seq_len=32)
+        params = TransformerLM(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+        want = param_specs(params)
+        got = get_pack("generic").match(params)
+        eq = jax.tree_util.tree_map(lambda a, b: a == b, got, want,
+                                    is_leaf=lambda x: isinstance(x, P))
+        assert all(jax.tree_util.tree_leaves(eq))
+
+    def test_pack_for_config_structural(self):
+        class Cfg:
+            num_experts = 0
+            position = "rope"
+            norm = "rmsnorm"
+            tie_embeddings = False
+            num_heads = 8
+            num_kv_heads = 8
+
+        cfg = Cfg()
+        assert pack_for_config(cfg).name == get_pack("llama").name
+        cfg.num_kv_heads = 2
+        assert pack_for_config(cfg).name == get_pack("mistral").name
+        cfg.num_experts = 4
+        assert pack_for_config(cfg).name == get_pack("mixtral").name
+
+
+# ---------------------------------------------------------------------------
+# derive: AutoTP inference -> explicit rules
+# ---------------------------------------------------------------------------
+
+
+class TestDerive:
+    def test_derive_matches_tp_parser_bitwise(self):
+        from deepspeed_tpu.module_inject import tp_parser
+        params = toy_params()
+        rs = derive_rules(params)
+        assert derived_matches_parser(params, rs, tp_parser(params))
+
+    def test_derive_matches_parser_on_toy_transformer(self):
+        from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                      TransformerLM)
+        from deepspeed_tpu.module_inject import tp_parser
+        cfg = TransformerConfig(vocab_size=64, hidden_size=32,
+                                intermediate_size=64, num_layers=2,
+                                num_heads=4, num_kv_heads=4, max_seq_len=32)
+        params = TransformerLM(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+        rs = derive_rules(params)
+        assert derived_matches_parser(params, rs, tp_parser(params))
+
+    def test_derived_rules_serialize(self):
+        params = toy_params()
+        rs = derive_rules(params)
+        back = RuleSet.from_json(rs.to_json())
+        assert derived_matches_parser(
+            params, back,
+            __import__("deepspeed_tpu").module_inject.tp_parser(params))
+
+    def test_derive_generalizes_layer_indices(self):
+        """Numbered layers collapse to one pattern, so the rule set stays
+        depth-independent."""
+        params = {f"layers_{i}": {"q_proj": {"kernel": jnp.zeros((8, 8))}}
+                  for i in range(4)}
+        rs = derive_rules(params)
+        assert len(rs) < 4
