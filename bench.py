@@ -2380,6 +2380,302 @@ def chaos_soak_bench():
         _shutil.rmtree(work, ignore_errors=True)
 
 
+def fleet_serving_bench():
+    """Rung fs (fleet tier, ISSUE 19): a chaos-soaked elastic-serving soak —
+    a FleetManager-run replica fleet under bursty multi-tenant open-loop
+    traffic. Mid-burst a seeded ``replica_kill`` takes out a JOINED replica
+    (the router requeue-resumes its work onto the survivor, preserving
+    tenant identity); the survivor's SLA-violation rate then trips the
+    ControlSupervisor's ``rule_sla``, whose registered ``scale_fn`` IS
+    ``FleetManager.scale_out`` — the joining replica walks SPAWNING →
+    WARMING → JOINED applying the cached autotune winner with ZERO probes
+    (a ``replica_slow_warm`` drill stalls its bring-up to prove the warm
+    gate holds), and once the burst drains, sustained under-utilization
+    scales the fleet back in through the flap guard. The row VALUE is the
+    fleet's delivered tok/s in the post-join window; the hard gates ride
+    in-process: ZERO lost requests across the kill, the kill preceding a
+    measurable tok/s rise at join, a zero-probe joiner, bounded p99 TTFT,
+    and a doctor report that names the kill and both scale events."""
+    import random as _random
+    import shutil as _shutil
+    import tempfile
+
+    from deepspeed_tpu import doctor
+    from deepspeed_tpu.control.ledger import ControlLedger
+    from deepspeed_tpu.control.supervisor import ControlSupervisor
+    from deepspeed_tpu.fleet import JOINED, FleetManager, SLAClass, TenancyMap
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM)
+    from deepspeed_tpu.runtime.config import (ControlConfig,
+                                              ControlGuardConfig,
+                                              ControlSupervisorConfig)
+    from deepspeed_tpu.runtime.resilience import (ChaosEvent, ChaosSchedule,
+                                                  configure_chaos)
+    from deepspeed_tpu.runtime.resilience.heartbeat import (
+        ObjectStoreHeartbeatTransport)
+    from deepspeed_tpu.serving import (FINISH_EOS, FINISH_LENGTH, LLMServer,
+                                       Request, ServerClosed, ServerOverloaded)
+
+    SEED = 4119
+    rng = _random.Random(SEED)
+    prng = np.random.default_rng(SEED)
+    work = tempfile.mkdtemp(prefix="dstpu_fs_")
+    artifacts = os.path.join(work, "artifacts")
+    os.makedirs(artifacts)
+    t_start = time.perf_counter()
+    configure_chaos(None)
+    mgr = None
+    try:
+        cfg = TransformerConfig(vocab_size=97, hidden_size=48,
+                                intermediate_size=96, num_layers=2,
+                                num_heads=4, num_kv_heads=2, max_seq_len=256,
+                                dtype=jnp.float32, norm="rmsnorm",
+                                activation="swiglu")
+        model = TransformerLM(cfg)
+        mparams = model.init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, 8), jnp.int32))["params"]
+
+        def make_engine():
+            return InferenceEngineV2(model, mparams,
+                                     RaggedInferenceEngineConfig(
+                                         token_budget=32,
+                                         max_ragged_sequence_count=4,
+                                         max_chunk_size=16, num_kv_blocks=96,
+                                         kv_block_size=8,
+                                         max_blocks_per_seq=16,
+                                         dtype="float32"))
+
+        # multi-tenant SLA ladder: bronze/silver deadlines sit BELOW the
+        # latency a kill imposes (queue wait at the victim + stale-beacon
+        # detection + requeue + re-serve), so the post-kill survivor's
+        # finishes deterministically violate them — the signal rule_sla
+        # scales out on. Gold stays loose: the premium class should ride
+        # through the kill without a violation
+        tenancy = TenancyMap([SLAClass("gold", weight=4.0, deadline_s=8.0),
+                              SLAClass("silver", weight=2.0, deadline_s=0.9),
+                              SLAClass("bronze", weight=1.0, deadline_s=0.45)])
+
+        def factory(rid):
+            return LLMServer(make_engine(), replica_id=rid,
+                             policy="deadline", tenancy=tenancy,
+                             heartbeat_interval_s=0.02,
+                             resume_checkpoint_tokens=8)
+
+        ledger = ControlLedger(max_entries=512)
+        sup = ControlSupervisor(ControlConfig(
+            enabled=True,
+            supervisor=ControlSupervisorConfig(
+                interval_steps=1, sla_guard=True,
+                sla_violation_rate=0.25, sla_min_tracked=2,
+                straggler_replan=False, memory_guard=False,
+                rollback_degrade=False),
+            # trigger_streak=1: serving finishes arrive in fused-chunk
+            # bursts, so consecutive 6-step ticks can straddle a burst and
+            # see dt < sla_min_tracked — a 2-streak would reset right in
+            # the middle of real pressure; the cooldown still stops flaps
+            # cooldown 0.5s: if pressure fired once pre-kill (rejected at
+            # capacity), the reconcile re-arms the rule and the refire
+            # must land inside the few-second post-kill burst window
+            guard=ControlGuardConfig(trigger_streak=1, clear_streak=2,
+                                     cooldown_s=0.5, budget=64,
+                                     budget_window_s=3600.0)),
+            ledger=ledger)
+        # max_replicas=2: after the kill the fleet is 1, the SLA scale-out
+        # restores 2 (= capacity) — further pressure exercises the
+        # at-capacity shed fallback instead of unbounded growth. The
+        # manager gets its OWN guard: scale-in should take sustained
+        # under-utilization (3 consecutive low-load polls), not inherit
+        # the deliberately hair-triggered SLA guard above
+        from deepspeed_tpu.control.guard import FlapGuard
+        mgr = FleetManager(factory, supervisor=sup, min_replicas=1,
+                           max_replicas=2, scale_in_low_watermark=0.5,
+                           drain_timeout_s=600.0,
+                           guard=FlapGuard(trigger_streak=3, clear_streak=2,
+                                           cooldown_s=2.0, budget=64),
+                           autotune_cache_dir=os.path.join(work, "winners"))
+
+        # seeded chaos: kill replica 0 mid-burst (armed on ITS engine-step
+        # count), and stall the future joiner's warm-up — the warm gate
+        # must keep traffic off it for the whole stall
+        schedule = ChaosSchedule([
+            ChaosEvent("replica_kill", "replica0", at=rng.randrange(10, 16)),
+            ChaosEvent("replica_slow_warm", "replica2", at=0, param=0.05),
+        ], seed=SEED)
+        configure_chaos(schedule)
+
+        transport = ObjectStoreHeartbeatTransport(os.path.join(work,
+                                                               "bucket"))
+        router = mgr.start(2, transport=transport, dead_after_s=0.6)
+        # replica 0 probed the serving winner and cached it; replica 1
+        # joined from cache — the scale-out joiner must too
+        for h in mgr.handles.values():
+            sup.attach_server(h.server, interval_steps=6,
+                              scale_fn=mgr.scale_out)
+
+        mnt = 12
+        tenants_cycle = ["gold", "bronze", "silver", "bronze"]
+        resps, resp_tenant, shed = [], [], 0
+        t_kill = t_join = scale_in_rid = None
+        after_join = 0
+        max_requests, tail_after_join = 240, 24
+
+        def submit_one(i):
+            nonlocal shed
+            t = tenants_cycle[i % len(tenants_cycle)]
+            req = Request(np.asarray(prng.integers(1, cfg.vocab_size, 8),
+                                     np.int32),
+                          max_new_tokens=mnt, tenant=t)
+            try:
+                r = router.submit(req, block=True, timeout=2.0)
+            except (ServerOverloaded, ServerClosed):
+                shed += 1       # shed by the tenant door, NOT lost: the
+                return          # client saw a synchronous rejection
+            resps.append(r)
+            resp_tenant.append(t)
+
+        i = 0
+        deadline = time.monotonic() + 900
+        while time.monotonic() < deadline:
+            if i < max_requests and (t_join is None
+                                     or after_join < tail_after_join):
+                for _ in range(3):      # open-loop burst: 3 per 20ms tick
+                    submit_one(i)
+                    i += 1
+                    if t_join is not None:
+                        after_join += 1
+            router.check()
+            # the takeover can also happen inside submit() (a shed/closed
+            # replica is taken over on the spot), so detect the kill from
+            # the router's dead book, not check()'s return value
+            if t_kill is None and router.dead_ids():
+                t_kill = time.monotonic()
+            # reconciles the kill; once the burst tail drains, sustained
+            # under-utilization fires the flap-guarded scale-in HERE
+            scale_in_rid = mgr.poll() or scale_in_rid
+            h2 = mgr.handles.get(2)
+            if t_join is None and h2 is not None and h2.state == JOINED:
+                t_join = time.monotonic()
+            if (all(r.done for r in resps)
+                    and (i >= max_requests
+                         or (t_join is not None
+                             and after_join >= tail_after_join))):
+                break
+            time.sleep(0.02)
+        t_done = time.monotonic()
+
+        # ---- hard gate: zero lost requests across the chaos kill --------
+        lost = [j for j, r in enumerate(resps) if not r.done]
+        failed = [j for j, r in enumerate(resps)
+                  if r.finish_reason not in (FINISH_EOS, FINISH_LENGTH)]
+        assert not lost, f"lost response handles: {lost}"
+        assert not failed, f"failed response handles: {failed}"
+        assert t_kill is not None, "the replica_kill drill never fired"
+        assert router.requeues > 0, "the kill never exercised the requeue path"
+
+        # ---- supervisor-driven scale-out, zero-probe warm join ----------
+        h2 = mgr.handles.get(2)
+        assert h2 is not None and t_join is not None, (
+            "rule_sla never scaled the fleet out; ledger="
+            + repr([(a["action"], a.get("outcome")) for a in
+                    ledger.snapshot()])
+            + "; survivor sla="
+            + repr([(h.replica_id, h.server.metrics.sla_violations,
+                     h.server.metrics.sla_tracked)
+                    for h in mgr.handles.values() if h.server is not None])
+            + "; e2e p50/p90/max="
+            + repr([round(q, 3) for q in (np.percentile(
+                [r.e2e_s for r in resps if r.e2e_s is not None] or [0.0],
+                [50, 90, 100])).tolist()]))
+        assert t_kill < t_join, "kill must precede the scale-out"
+        rep2 = h2.report
+        assert rep2.autotune_from_cache and rep2.zero_probe_join(), \
+            f"joiner ran probes: {rep2.to_params()}"
+
+        # ---- scale-out measurably raises fleet tok/s --------------------
+        def tok_s(a, b):
+            toks = sum(len(r.tokens) for r in resps
+                       if r.finish_time is not None and a <= r.finish_time < b)
+            return toks / max(1e-6, b - a)
+
+        tok_down = tok_s(t_kill, t_join)    # one survivor (+ joiner warming)
+        tok_up = tok_s(t_join, t_done)      # joiner taking traffic
+        assert tok_up > tok_down, \
+            f"scale-out did not raise fleet tok/s ({tok_down:.1f} -> " \
+            f"{tok_up:.1f})"
+
+        # ---- p99 TTFT held (bounded) under chaos ------------------------
+        ttfts = sorted(r.ttft_s for r in resps if r.ttft_s is not None)
+        assert ttfts, "no first tokens delivered"
+        p99_ttft = ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))]
+        assert p99_ttft < 30.0, f"p99 TTFT blew up: {p99_ttft:.1f}s"
+
+        # ---- flap-guarded scale-in once the burst drains ----------------
+        for _ in range(300):
+            if scale_in_rid is not None:
+                break
+            scale_in_rid = mgr.poll()
+            router.check()
+            time.sleep(0.02)
+        assert scale_in_rid is not None, "fleet never scaled back in"
+
+        acted = {a["action"] for a in ledger.snapshot()}
+        assert {"serving_scale", "replica_join", "replica_reap",
+                "serving_scale_in"} <= acted, f"ledger missing actions: {acted}"
+        assert schedule.all_fired(), "chaos schedule did not fully fire"
+
+        # ---- post-mortem: the doctor names the kill + both scale events -
+        schedule.dump(artifacts)
+        with open(os.path.join(artifacts, "flightdump-0.json"), "w") as f:
+            json.dump({"reason": "preempt_drain", "rank": 0,
+                       "pid": os.getpid(), "sequence": 1,
+                       "wall_time": time.time(), "last_phase": None,
+                       "open_spans": [], "inflight_spans": [], "steps": [],
+                       "retries": [], "control": ledger.snapshot()}, f)
+        report = doctor.diagnose(artifacts)
+        ev = report["evidence"]
+        for needle in ("chaos drill injected replica_kill",
+                       "chaos drill injected replica_slow_warm",
+                       "serving_scale", "serving_scale_in", "replica_join",
+                       "replica_reap"):
+            assert any(needle in e for e in ev), \
+                f"doctor evidence never names {needle!r}"
+
+        per_tenant = {}
+        for t in sorted(set(resp_tenant)):
+            tt = sorted(r.ttft_s for r, rt in zip(resps, resp_tenant)
+                        if rt == t and r.ttft_s is not None)
+            per_tenant[t] = {
+                "requests": resp_tenant.count(t),
+                "ttft_p99_ms": round(
+                    tt[min(len(tt) - 1, int(0.99 * len(tt)))] * 1e3, 1)
+                if tt else None}
+        sla_viol = sum(h.server.metrics.sla_violations
+                       for h in mgr.handles.values() if h.server is not None)
+        wall = time.perf_counter() - t_start
+        return {"metric": "fleet_elastic_tok_s", "value": round(tok_up, 2),
+                "unit": "tok/s", "vs_baseline": None, "seed": SEED,
+                "requests": len(resps), "shed": shed,
+                "tokens_per_request": mnt, "requeues": router.requeues,
+                "lost_handles": len(lost), "failed_handles": len(failed),
+                "tok_s_one_replica": round(tok_down, 2),
+                "tok_s_post_join": round(tok_up, 2),
+                "scale_out_replica": 2, "scale_in_replica": scale_in_rid,
+                "zero_probe_join": rep2.zero_probe_join(),
+                "joiner_warm_s": round(rep2.warm_s, 3),
+                "p99_ttft_s": round(p99_ttft, 3),
+                "per_tenant": per_tenant, "sla_violations": sla_viol,
+                "doctor_verdict": report["verdict"],
+                "wall_s": round(wall, 2),
+                "device": jax.devices()[0].platform}
+    finally:
+        configure_chaos(None)
+        if mgr is not None:
+            mgr.close()
+        _shutil.rmtree(work, ignore_errors=True)
+
+
 def model_family_bench():
     """Rung mf (model-family AutoTP ladder, deepspeed_tpu/sharding/): the
     PR 18 acceptance as a measured rung — each built-in rule pack's family
@@ -2446,7 +2742,8 @@ RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "cp": program_compiler_bench,
          "ob": telemetry_bench, "mem": memory_telemetry_bench,
          "sa": static_audit_bench, "at": control_bench,
-         "cz": chaos_soak_bench, "mf": model_family_bench}
+         "cz": chaos_soak_bench, "mf": model_family_bench,
+         "fs": fleet_serving_bench}
 
 
 # ---------------------------------------------------------------------------
@@ -2481,6 +2778,11 @@ GATE_SPECS = {
     "serving_prefix_reuse_speedup": ("higher", 0.5),
     "chaos_soak_fault_classes": ("higher", 0.05),  # seeded count: deterministic
     "autotp_families_clean": ("higher", 0.05),  # family count: deterministic
+    # fleet post-join tok/s: wall-clock on a shared box, keep the default
+    # slack — the rung's REAL gates (zero lost requests, zero-probe join,
+    # kill->join tok/s rise, bounded p99 TTFT, doctor naming every event)
+    # are in-process asserts, so any violation errors the rung and gates
+    "fleet_elastic_tok_s": ("higher", 0.5),
 }
 
 
@@ -2641,6 +2943,11 @@ def run_ladder(gate: bool = False):
             # over serving + training drills with the survival invariants
             # asserted in-process (one CPU device is the substrate)
             ("cz", cpu1),
+            # fs soaks the fleet tier: chaos replica kill mid-burst, SLA
+            # scale-out through the supervisor (zero-probe warm join),
+            # flap-guarded scale-in — elastic-serving invariants asserted
+            # in-process (one CPU device is the substrate)
+            ("fs", cpu1),
             # mf auto-shards every built-in rule-pack family (llama,
             # mistral, gpt_neox, mixtral) at tp=2 x ZeRO-3 via
             # autotp_initialize and audits each compiled step to zero

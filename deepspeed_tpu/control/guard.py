@@ -115,6 +115,28 @@ class FlapGuard:
             return True
 
     # ------------------------------------------------------------------
+    def rearm(self, prefix: str = "") -> int:
+        """Forcibly re-arm latched rules whose name starts with ``prefix``
+        (all rules for ""). Returns how many were re-armed.
+
+        The latch-until-clear hysteresis assumes the world the rule fired
+        in still exists: a signal that never clears keeps the rule latched
+        because re-firing would just repeat the same actuation. After a
+        TOPOLOGY change — a replica died, capacity freed up — that memory
+        is stale: an ``sla_pressure`` rule that latched on a scale-out
+        attempt rejected at capacity must not block the first scale-out of
+        the new, smaller fleet. Cooldown and budget still apply to the
+        next firing; only the clear-streak requirement is waived."""
+        n = 0
+        with self._lock:
+            for name, st in self._rules.items():
+                if name.startswith(prefix) and st.latched:
+                    st.latched = False
+                    st.assert_streak = 0
+                    n += 1
+        return n
+
+    # ------------------------------------------------------------------
     def fires(self, rule: str) -> int:
         with self._lock:
             st = self._rules.get(rule)

@@ -38,7 +38,11 @@ class ControlSupervisor:
                  clock: Callable[[], float] = time.monotonic):
         self.cfg = cfg                      # runtime.config.ControlConfig
         gc = cfg.guard
-        self.ledger = ledger or ControlLedger(max_entries=cfg.ledger_size)
+        # None-check, not truthiness: a caller-supplied EMPTY ledger (it
+        # has __len__) must not be silently replaced — the caller shares
+        # it with other recorders (e.g. a FleetManager) and reads it back
+        self.ledger = (ControlLedger(max_entries=cfg.ledger_size)
+                       if ledger is None else ledger)
         self.guard = guard or FlapGuard(
             trigger_streak=gc.trigger_streak, clear_streak=gc.clear_streak,
             cooldown_s=gc.cooldown_s, budget=gc.budget,

@@ -449,6 +449,15 @@ def diagnose(directory: str, *, world: Optional[int] = None,
             f"the supervisor acted {len(acted)}x before this state "
             f"(last: rank {last.get('rank')} {_describe_action(last)}) — "
             "see the supervisor-action lines")
+    # fleet elasticity is load-bearing context for any serving post-mortem:
+    # name every scale event (out, in, join, reap) individually — "the
+    # fleet changed shape mid-run" must never hide inside a generic count
+    for a in supervisor_actions:
+        if a.get("action") in ("serving_scale", "serving_scale_in",
+                               "replica_join", "replica_reap"):
+            evidence.append(
+                f"fleet scale event: rank {a.get('rank')} "
+                f"{_describe_action(a)}")
     # transport-retry trail: a dead verdict that was PRECEDED by a retry
     # storm points at the store, not the host — say so (reusing the
     # per-rank summaries already folded into `ranks`)

@@ -1,0 +1,31 @@
+"""Importable server factories for subprocess-replica tests and drills.
+
+``SubprocessReplica`` launches ``python -m deepspeed_tpu.fleet.subproc
+--factory module:callable`` — the factory must be importable from a fresh
+interpreter, so it cannot live in a pytest module. This is that module:
+one tiny CPU-sized server, matching the serving test fixtures.
+"""
+
+
+def make_tiny_server(replica_id: int):
+    """A serving-test-sized LLMServer (2-layer toy model, 64 KV blocks)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..inference.v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+    from ..models.transformer import TransformerConfig, TransformerLM
+    from ..serving.server import LLMServer
+
+    cfg = TransformerConfig(vocab_size=97, hidden_size=48,
+                            intermediate_size=96, num_layers=2, num_heads=4,
+                            num_kv_heads=2, max_seq_len=128,
+                            dtype=jnp.float32, norm="rmsnorm",
+                            activation="swiglu")
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        token_budget=16, max_ragged_sequence_count=4, max_chunk_size=8,
+        num_kv_blocks=64, kv_block_size=8, max_blocks_per_seq=8,
+        dtype="float32"))
+    return LLMServer(engine, replica_id=replica_id)

@@ -522,7 +522,8 @@ class ChaosConfig(ConfigModel):
     across the transport layer (object-store heartbeat PUT/GET errors,
     torn beacons, plan-cache read errors, snapshot-commit I/O errors), the
     serving layer (replica kill, KV-pool exhaustion, slow prefill, dropped
-    token delivery), and the control layer (stale health rows, flapping
+    token delivery, fleet replica spawn failure, slow replica warm-up),
+    and the control layer (stale health rows, flapping
     straggler verdicts) — drill/test use only. Disabled by default:
     nothing is constructed, every injection site is a single None check,
     and the stack is bitwise identical to a tree without the subsystem."""
@@ -802,6 +803,14 @@ class ServingConfig(ConfigModel):
     heartbeat_dir: Optional[str] = None  # shared dir for replica beacons
     heartbeat_interval_s: float = 2.0
     dead_after_s: float = 10.0           # beacon staler than this = dead
+    # multi-tenant SLA classes (``deepspeed_tpu/fleet/tenancy.py``
+    # TenancyMap.from_config; see docs/fleet_serving.md):
+    #   {"classes": {"gold": {"weight": 4, "deadline_s": 2.0}, "bronze": 1},
+    #    "tenants": {"acme": "gold"}, "default": "bronze"}
+    # With the deadline policy, admission sorts by arrival + deadline/weight
+    # and the control-plane shed door scales per class (low classes shed
+    # first). None = tenancy off (single-tenant behavior unchanged).
+    tenancy: Optional[Dict[str, Any]] = None
     engine: Dict[str, Any] = field(default_factory=dict)
 
 

@@ -23,7 +23,12 @@ serving layer
     resume its work); ``kv_exhaustion`` — the admission pool reads dry for
     a few cycles; ``slow_prefill`` — a stalled/slow prefill step;
     ``drop_token`` — a sampled token's stream delivery is lost (the
-    delivered-token dedup cursor must re-deliver it exactly once).
+    delivered-token dedup cursor must re-deliver it exactly once);
+    ``replica_spawn_fail`` — a fleet scale-out's replica bring-up fails
+    before the server exists (the FleetManager must reap the half-spawned
+    handle, never leak a WARMING router entry); ``replica_slow_warm`` — a
+    joining replica's warm-up stalls ``param`` seconds (the router's warm
+    gate must keep traffic off it the whole time).
 
 control layer
     ``stale_health`` — a health-table refresh returns the previous rows
@@ -79,6 +84,8 @@ FAULT_CLASSES: Dict[str, str] = {
     "kv_exhaustion": "serving",
     "slow_prefill": "serving",
     "drop_token": "serving",
+    "replica_spawn_fail": "serving",
+    "replica_slow_warm": "serving",
     "stale_health": "control",
     "flap_straggler": "control",
 }
@@ -94,6 +101,8 @@ _GENERATE_DEFAULTS: Dict[str, Any] = {
     "kv_exhaustion": (3, 0.0),
     "slow_prefill": (1, 0.05),
     "drop_token": (1, 0.0),
+    "replica_spawn_fail": (1, 0.0),
+    "replica_slow_warm": (1, 0.05),
     "stale_health": (1, 0.0),
     "flap_straggler": (4, 0.0),
 }

@@ -8,11 +8,14 @@ priority preemption (:mod:`scheduler`), a background-stepping
 :class:`LLMServer` with a bounded ingress queue and graceful drain
 (:mod:`server`), TTFT/TPOT/e2e latency metrics bridged to the monitor tier
 (:mod:`metrics`), a multi-replica router on the PR 5 heartbeat health table
-(:mod:`replica`), and a seedable open-loop traffic generator for the
-``bench.py --rung sv`` latency bench (:mod:`traffic`).
+with a warm gate for joining replicas (:mod:`replica`), and a seedable
+open-loop traffic generator for the ``bench.py --rung sv`` latency bench
+(:mod:`traffic`). Fleet-level concerns — replica lifecycle, elastic
+scaling, multi-tenant SLA classes — live one package up in
+:mod:`deepspeed_tpu.fleet`.
 """
 
-from .metrics import LatencyHistogram, ServingMetrics
+from .metrics import LatencyHistogram, ServingMetrics, TenantStats
 from .replica import ReplicaRouter
 from .request import (FINISH_CANCELLED, FINISH_EOS, FINISH_FAILED,
                       FINISH_LENGTH, Request, ServedResponse)
@@ -24,6 +27,6 @@ __all__ = [
     "Request", "ServedResponse",
     "FINISH_EOS", "FINISH_LENGTH", "FINISH_CANCELLED", "FINISH_FAILED",
     "ContinuousBatchScheduler", "LLMServer", "ServerClosed",
-    "ServerOverloaded", "ServingMetrics", "LatencyHistogram",
+    "ServerOverloaded", "ServingMetrics", "LatencyHistogram", "TenantStats",
     "ReplicaRouter", "TrafficConfig", "LengthDist", "OpenLoopTraffic",
 ]

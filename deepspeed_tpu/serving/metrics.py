@@ -71,6 +71,37 @@ class LatencyHistogram:
                 "mean_ms": ms(self.mean), "count": self.count}
 
 
+class TenantStats:
+    """Per-tenant slice of the serving counters (fleet/tenancy.py SLA
+    classes). Deliberately lean — counters plus TTFT/e2e histograms —
+    because one row exists per tenant label and telemetry cardinality
+    is bounded by the tenants actually seen, not a config."""
+
+    def __init__(self):
+        self.submitted = 0
+        self.completed = 0
+        self.cancelled = 0
+        self.failed = 0
+        self.rejected = 0
+        self.sla_violations = 0
+        self.sla_tracked = 0
+        self.tokens_out = 0
+        self.ttft = LatencyHistogram(cap=8192)
+        self.e2e = LatencyHistogram(cap=8192)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "submitted": self.submitted, "completed": self.completed,
+            "cancelled": self.cancelled, "failed": self.failed,
+            "rejected": self.rejected,
+            "sla_violations": self.sla_violations,
+            "sla_tracked": self.sla_tracked,
+            "tokens_out": self.tokens_out,
+            "ttft": self.ttft.snapshot_ms(),
+            "e2e": self.e2e.snapshot_ms(),
+        }
+
+
 class ServingMetrics:
     """Aggregated serving-tier metrics for one server (or one router)."""
 
@@ -114,6 +145,26 @@ class ServingMetrics:
         # readers must know which decode path produced a latency row
         self.attn_impl: Optional[str] = None
         self.decode_attn_impl: Optional[str] = None
+        # per-tenant slices, lazily created on first sighting of a tenant
+        # name (requests with tenant=None aggregate only into the fleet
+        # totals above — no phantom "None" tenant row)
+        self.tenants: Dict[str, TenantStats] = {}
+
+    def tenant(self, name: str) -> TenantStats:
+        """The (lazily created) per-tenant slice for ``name``."""
+        ts = self.tenants.get(name)
+        if ts is None:
+            ts = self.tenants[name] = TenantStats()
+        return ts
+
+    def _tenant_of(self, obj) -> Optional[TenantStats]:
+        """Per-tenant slice for a ServedResponse OR a bare Request (the
+        door-shed reject path has no response yet); None when untenanted."""
+        if obj is None:
+            return None
+        req = getattr(obj, "request", obj)
+        name = getattr(req, "tenant", None)
+        return None if name is None else self.tenant(name)
 
     def stamp_impls(self, attn_impl: Optional[str] = None,
                     decode_attn_impl: Optional[str] = None) -> None:
@@ -127,16 +178,30 @@ class ServingMetrics:
     # ------------------------------------------------------------------
     def on_submit(self, resp: ServedResponse) -> None:
         self.submitted += 1
+        ts = self._tenant_of(resp)
+        if ts is not None:
+            ts.submitted += 1
 
-    def on_reject(self) -> None:
+    def on_reject(self, resp=None) -> None:
+        """An overload/shed rejection. ``resp`` (optional, back-compat: a
+        ServedResponse or the bare Request) attributes the rejection to
+        its tenant's slice."""
         self.rejected += 1
+        ts = self._tenant_of(resp)
+        if ts is not None:
+            ts.rejected += 1
 
     def on_finish(self, resp: ServedResponse) -> None:
+        ts = self._tenant_of(resp)
         if resp.finish_reason == FINISH_CANCELLED:
             self.cancelled += 1
+            if ts is not None:
+                ts.cancelled += 1
             return
         if resp.finish_reason == FINISH_FAILED:
             self.failed += 1
+            if ts is not None:
+                ts.failed += 1
             return
         if resp.finish_reason in (FINISH_EOS, FINISH_LENGTH):
             self.completed += 1
@@ -154,6 +219,16 @@ class ServingMetrics:
             if v is not None:
                 self.sla_tracked += 1
                 self.sla_violations += int(v)
+            if ts is not None:
+                ts.completed += 1
+                ts.tokens_out += len(resp.tokens)
+                if resp.ttft_s is not None:
+                    ts.ttft.record(resp.ttft_s)
+                if resp.e2e_s is not None:
+                    ts.e2e.record(resp.e2e_s)
+                if v is not None:
+                    ts.sla_tracked += 1
+                    ts.sla_violations += int(v)
 
     def sample(self, *, queue_depth: int, inflight: int,
                kv_free_blocks: int, kv_total_blocks: int) -> None:
@@ -243,6 +318,8 @@ class ServingMetrics:
             "spec_acceptance_rate": (None
                                      if (ar := self.spec_acceptance_rate())
                                      is None else round(ar, 4)),
+            "tenants": {name: ts.snapshot()
+                        for name, ts in sorted(self.tenants.items())},
         }
 
     def monitor_events(self, step: int, prefix: str = "Serving") -> List[Event]:
@@ -272,4 +349,8 @@ class ServingMetrics:
         put("prefix_blocks_shared", self.prefix_blocks_shared)
         put("cow_forks", self.cow_forks)
         put("spec_acceptance_rate", self.spec_acceptance_rate())
+        for name, ts in sorted(self.tenants.items()):
+            put(f"tenant/{name}/completed", ts.completed)
+            put(f"tenant/{name}/rejected", ts.rejected)
+            put(f"tenant/{name}/sla_violations", ts.sla_violations)
         return events

@@ -70,6 +70,15 @@ class ReplicaRouter:
             {rid: {} for rid in self.replicas}
         self._draining: set = set()
         self._dead: set = set()
+        # warm gate: replicas joined via add_replica whose engine has not
+        # yet compiled a step. A WARMING replica is registered (heartbeat,
+        # health, takeover all cover it) but receives NO dispatch until it
+        # reports warm — its first step may be an XLA compile tens of
+        # seconds long, and routing a storm into it would park real
+        # requests behind that compile. Constructor-passed replicas are
+        # bootstrap capacity and are not gated (there is no older replica
+        # to prefer — day-one behavior is unchanged).
+        self._warming: set = set()
         self._closed = False
         self.requeues = 0
 
@@ -84,8 +93,17 @@ class ReplicaRouter:
         # copy under the lock: check()/_take_over()/drain_replica() mutate
         # these sets from an operator thread while client submits read them
         with self._lock:
+            # lazy warm-gate promotion: the engine thread flips
+            # server.warmed after its first completed step; the next
+            # routing decision (here) observes it — no callback plumbing
+            # through the engine loop
+            for rid in list(self._warming):
+                srv = self.replicas.get(rid)
+                if srv is None or getattr(srv, "warmed", True):
+                    self._warming.discard(rid)
             dead = set(self._dead)
             draining = set(self._draining)
+            warming = set(self._warming)
         if self.health is not None:
             beacons = {row.rank: row for row in self.health.read()}
             for rid in self.replicas:
@@ -95,6 +113,7 @@ class ReplicaRouter:
                     dead.add(rid)
         return [rid for rid in self.replicas
                 if rid not in dead and rid not in draining
+                and rid not in warming
                 and self.replicas[rid].error is None]
 
     def _pick(self, exclude=()) -> LLMServer:
@@ -301,13 +320,26 @@ class ReplicaRouter:
         self._track(target.replica_id, resp)
 
     # ------------------------------------------------------------------
-    def add_replica(self, server: LLMServer) -> None:
-        """Scale-out: register (and start) a new replica so the next
-        dispatch can land on it — the control plane's ``serving_scale``
-        actuator (``control/policy.py rule_sla``) calls this from its
-        ``scale_fn``. The new replica joins the heartbeat transport when
-        the router has one, so health verdicts cover it immediately."""
+    def add_replica(self, server: LLMServer, *,
+                    ready: Optional[bool] = None) -> None:
+        """Scale-out: register (and start) a new replica — the control
+        plane's ``serving_scale`` actuator (``control/policy.py
+        rule_sla``) reaches this through its ``scale_fn`` (now normally
+        the fleet tier's :class:`~..fleet.manager.FleetManager`). The new
+        replica joins the heartbeat transport when the router has one, so
+        health verdicts cover it immediately.
+
+        Warm gate: ``ready`` says whether the replica may take traffic
+        NOW. ``None`` (default) reads the server's own ``warmed`` flag —
+        an ``LLMServer`` is warm after its first completed engine step, a
+        fleet-warmed replica (fleet/lifecycle.py) joins pre-warmed, and
+        an object without the flag is assumed ready (pre-gate servers).
+        A not-ready replica is registered but excluded from dispatch
+        until ``server.warmed`` flips (observed lazily by
+        :meth:`alive_ids`) or :meth:`mark_ready` is called."""
         rid = int(server.replica_id)
+        ready = (bool(getattr(server, "warmed", True)) if ready is None
+                 else bool(ready))
         with self._lock:
             if rid in self.replicas:
                 raise ValueError(f"replica id {rid} already registered")
@@ -315,12 +347,54 @@ class ReplicaRouter:
             self._assigned[rid] = {}
             self._dead.discard(rid)
             self._draining.discard(rid)
+            if not ready:
+                self._warming.add(rid)
         if self.health is not None and server.heartbeat is None:
             server.heartbeat = HeartbeatWriter(self.health.transport, rid,
                                                clock=self.clock)
         server.start()
         logger.info(f"serving: replica {rid} added to the router "
+                    f"({len(self.replicas)} total"
+                    f"{', warming' if not ready else ''})")
+
+    def mark_ready(self, rid: int) -> None:
+        """Promote a WARMING replica to dispatchable (the lifecycle's
+        explicit join step; ``alive_ids`` also promotes lazily once the
+        server's own ``warmed`` flag flips)."""
+        with self._lock:
+            self._warming.discard(rid)
+
+    def remove_replica(self, rid: int) -> LLMServer:
+        """Unregister a replica that never carried work — the
+        FleetManager's reap path for a scale-out that failed mid-warm. A
+        replica with tracked in-flight assignments must go through
+        ``drain_replica`` or the dead-takeover instead: silently dropping
+        its book would strand those clients forever."""
+        with self._lock:
+            server = self.replicas.get(rid)
+            if server is None:
+                raise KeyError(f"replica id {rid} not registered")
+            if self._assigned.get(rid):
+                raise RuntimeError(
+                    f"replica {rid} has {len(self._assigned[rid])} tracked "
+                    f"request(s); drain it instead of removing it")
+            del self.replicas[rid]
+            self._assigned.pop(rid, None)
+            self._warming.discard(rid)
+            self._draining.discard(rid)
+            self._dead.discard(rid)
+        server.halt()
+        logger.info(f"serving: replica {rid} removed from the router "
                     f"({len(self.replicas)} total)")
+        return server
+
+    def dead_ids(self) -> List[int]:
+        """Replica ids this router has declared dead (takeover complete,
+        their in-flight work already requeued). The FleetManager reads
+        this to reconcile its handle states after a chaos kill / process
+        loss it did not itself initiate."""
+        with self._lock:
+            return sorted(self._dead)
 
     def drain_replica(self, rid: int, timeout: Optional[float] = None) -> bool:
         """Graceful maintenance drain: stop dispatching to ``rid``, let its

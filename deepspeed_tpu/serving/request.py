@@ -43,6 +43,12 @@ class Request:
     # (the response's delivered-token cursor dedups replays).
     stream: Optional[Callable[[int, "ServedResponse"], None]] = None
     request_id: Optional[str] = None   # client-side correlation id
+    # multi-tenancy: which tenant submitted this request. Resolved to an
+    # SLA class (weight / default deadline / shed watermark) by the
+    # server's TenancyMap (fleet/tenancy.py); None = the default class.
+    # The tenant rides the Request object itself, so replica-loss
+    # requeues across the fleet preserve tenant identity for free.
+    tenant: Optional[str] = None
     # replica-loss requeue budget: after this many router requeues the next
     # one fails the handle (FINISH_FAILED) instead of bouncing it between
     # dying replicas forever; scheduler preemptions don't count

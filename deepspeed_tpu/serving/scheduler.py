@@ -13,7 +13,11 @@ Policies
 ``priority``  higher ``Request.priority`` first; lower-priority *prefill*
               sequences are preempted-and-requeued when the pool runs dry.
 ``deadline``  earliest SLA deadline first (EDF); a later-deadline prefill
-              can be preempted for a tighter one.
+              can be preempted for a tighter one. With a ``tenancy`` map
+              installed (fleet/tenancy.py) the sort deadline is *weighted*
+              — ``arrival + deadline_s / class_weight`` — so high-class
+              tenants are admitted sooner and low-class tenants are the
+              preemption victims, under the SAME EDF machinery.
 
 Backpressure is exact, not heuristic: admission goes through the engine's
 ``can_schedule`` (worst-case block commitment over the WHOLE pool including
@@ -38,13 +42,14 @@ POLICIES = ("fcfs", "priority", "deadline")
 class ContinuousBatchScheduler:
     def __init__(self, engine, policy: str = "fcfs", *, preempt: bool = True,
                  max_inflight: Optional[int] = None, metrics=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 tenancy=None, clock: Callable[[], float] = time.monotonic):
         if policy not in POLICIES:
             raise ValueError(f"unknown scheduling policy {policy!r}; "
                              f"choose from {POLICIES}")
         self.engine = engine
         self.policy = policy
         self.metrics = metrics       # ServingMetrics.on_finish sink (optional)
+        self.tenancy = tenancy       # TenancyMap (duck-typed; optional)
         self.preempt = bool(preempt) and policy != "fcfs"
         # cap concurrently-admitted sequences at the engine's ragged slot
         # count: admitting more only moves queueing INSIDE the engine, where
@@ -69,13 +74,20 @@ class ContinuousBatchScheduler:
         return bool(self.pending or self.inflight)
 
     # ------------------------------------------------------------------
+    def _sort_deadline(self, resp: ServedResponse) -> Optional[float]:
+        """The deadline EDF sorts by: the response's own when no tenancy
+        map is installed, the tenant-weighted one when it is."""
+        if self.tenancy is not None:
+            return self.tenancy.effective_deadline_time(resp)
+        return resp.deadline_time
+
     def _key(self, resp: ServedResponse) -> Tuple:
         """Sort key: smaller = admitted sooner. The (arrival, uid) tail keeps
         every policy a stable FCFS tie-break."""
         if self.policy == "priority":
             return (-resp.request.priority, resp.arrival_time, resp.uid)
         if self.policy == "deadline":
-            d = resp.deadline_time
+            d = self._sort_deadline(resp)
             return (d if d is not None else float("inf"),
                     resp.arrival_time, resp.uid)
         return (resp.arrival_time, resp.uid)
@@ -86,7 +98,7 @@ class ContinuousBatchScheduler:
         if self.policy == "priority":
             return cand.request.priority > other.request.priority
         if self.policy == "deadline":
-            cd, od = cand.deadline_time, other.deadline_time
+            cd, od = self._sort_deadline(cand), self._sort_deadline(other)
             return cd is not None and (od is None or cd < od)
         return False
 

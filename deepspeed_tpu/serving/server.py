@@ -58,12 +58,25 @@ class LLMServer:
                  default_deadline_s: Optional[float] = None,
                  fused_decode_chunk: int = 0,
                  resume_checkpoint_tokens: Optional[int] = None,
+                 tenancy=None,
                  clock: Callable[[], float] = time.monotonic):
         self.engine = engine
         self.replica_id = int(replica_id)
         self.clock = clock
         self.idle_s = float(idle_s)
         self.default_deadline_s = default_deadline_s
+        # multi-tenancy (fleet/tenancy.py TenancyMap, duck-typed so the
+        # serving tier never imports the fleet package): weights the
+        # deadline scheduler's admission order and the control-plane shed
+        # door per tenant, and stamps class-default deadlines. None =
+        # tenancy off, every path identical to the single-tenant server.
+        self.tenancy = tenancy
+        # warm gate (fleet/lifecycle.py contract): False until this
+        # replica has completed one engine step (or a fleet warm-up set it
+        # explicitly). ReplicaRouter.add_replica reads it to keep traffic
+        # off a WARMING replica whose first step may still be an XLA
+        # compile tens of seconds long.
+        self.warmed = False
         # resumable requests: every N generated tokens a response
         # checkpoints its generation state, so a replica-loss requeue
         # resumes from the last checkpoint (one prefill over
@@ -93,6 +106,7 @@ class LLMServer:
         self.scheduler = ContinuousBatchScheduler(engine, policy,
                                                   preempt=preempt,
                                                   metrics=self.metrics,
+                                                  tenancy=tenancy,
                                                   clock=clock)
         self._ingress: "queue.Queue[ServedResponse]" = queue.Queue(max_queue)
         self._uid = itertools.count()
@@ -196,6 +210,11 @@ class LLMServer:
 
             heartbeat = HeartbeatWriter(FileHeartbeatTransport(sv.heartbeat_dir),
                                         rank=rid)
+        tenancy = None
+        if getattr(sv, "tenancy", None) is not None:
+            from ..fleet.tenancy import TenancyMap
+
+            tenancy = TenancyMap.from_config(sv.tenancy)
         return cls(engine, policy=sv.policy, preempt=sv.preempt,
                    max_queue=sv.max_queue, idle_s=sv.idle_s,
                    monitor=monitor,
@@ -205,7 +224,8 @@ class LLMServer:
                    default_deadline_s=sv.default_deadline_s,
                    fused_decode_chunk=getattr(sv, "fused_decode_chunk", 0),
                    resume_checkpoint_tokens=getattr(
-                       sv, "resume_checkpoint_tokens", None))
+                       sv, "resume_checkpoint_tokens", None),
+                   tenancy=tenancy)
 
     # ------------------------------------------------------------------
     # client side
@@ -241,19 +261,31 @@ class LLMServer:
         load, not stack it. ``_response`` re-enqueues an existing handle
         (router requeue path): the response keeps its arrival time/SLA clock
         but gets a fresh engine uid on this replica."""
-        if _response is None and self.control_max_queue is not None \
-                and self._ingress.qsize() >= self.control_max_queue:
+        if _response is None and self.control_max_queue is not None:
             # control-plane shedding: sustained SLA violations tightened
             # admission below the ingress bound — reject at the door like
-            # an overload, so upstream backpressure works unchanged
-            self.metrics.on_reject()
-            raise ServerOverloaded(
-                f"control plane shed: admission tightened to "
-                f"{self.control_max_queue} queued request(s)")
+            # an overload, so upstream backpressure works unchanged. With
+            # tenancy, the door is per-class: a low-weight tenant's
+            # watermark is a fraction of the base, so bronze sheds first
+            # while gold keeps landing under the same supervisor actuator.
+            wm = self.control_max_queue
+            if self.tenancy is not None:
+                wm = self.tenancy.shed_watermark(
+                    wm, getattr(request, "tenant", None))
+            if self._ingress.qsize() >= wm:
+                self.metrics.on_reject(request)
+                raise ServerOverloaded(
+                    f"control plane shed: admission tightened to "
+                    f"{wm} queued request(s)"
+                    + (f" for tenant {request.tenant!r}"
+                       if self.tenancy is not None and request.tenant else ""))
         with self._flags:
             if not (self._accepting and not self._draining):
                 raise ServerClosed(f"server replica={self.replica_id} is not "
                                    "accepting requests")
+            if request.deadline_s is None and self.tenancy is not None:
+                request.deadline_s = self.tenancy.default_deadline_s(
+                    getattr(request, "tenant", None))
             if request.deadline_s is None and self.default_deadline_s is not None:
                 request.deadline_s = self.default_deadline_s
             uid = next(self._uid)
@@ -269,7 +301,7 @@ class LLMServer:
         try:
             self._ingress.put(resp, block=block, timeout=timeout)
         except queue.Full:
-            self.metrics.on_reject()
+            self.metrics.on_reject(request)
             raise ServerOverloaded(
                 f"ingress queue full ({self._ingress.maxsize}); "
                 f"request rejected") from None
@@ -410,6 +442,9 @@ class LLMServer:
                             out = self.engine.step()
                     self._last_step_time = self.clock() - t0
                     self._steps += 1
+                    # first completed step = the engine's programs exist;
+                    # the router's warm gate may now route traffic here
+                    self.warmed = True
                     with span("serve/deliver"):
                         if mode == "step":
                             self._deliver(out)

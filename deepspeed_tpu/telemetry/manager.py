@@ -485,7 +485,33 @@ def serving_metrics_samples(metrics, labels: Dict[str, str]) -> List[Sample]:
         gauge_rows.append(("dstpu_serving_spec_acceptance_rate", "gauge",
                            "fraction of drafted tokens accepted by verify",
                            [("", lab, float(ar))]))
-    return out + gauge_rows
+    # per-tenant SLA-class slices (fleet/tenancy.py): the SAME family
+    # names with a tenant label added, so dstpu_serving_*{tenant="acme"}
+    # sits next to the untenanted fleet total. Cardinality is bounded by
+    # tenants actually seen — no row exists until a tenant submits.
+    tenant_counters = [
+        ("dstpu_serving_requests_total", "submitted"),
+        ("dstpu_serving_completed_total", "completed"),
+        ("dstpu_serving_cancelled_total", "cancelled"),
+        ("dstpu_serving_failed_total", "failed"),
+        ("dstpu_serving_rejected_total", "rejected"),
+        ("dstpu_serving_sla_violations_total", "sla_violations"),
+        ("dstpu_serving_tokens_out_total", "tokens_out"),
+    ]
+    tenant_rows: List[Sample] = []
+    for tname, ts in sorted(getattr(metrics, "tenants", {}).items()):
+        tlab = {**lab, "tenant": str(tname)}
+        for name, attr in tenant_counters:
+            tenant_rows.append((name, "counter", f"serving {attr}",
+                                [("", tlab, float(getattr(ts, attr)))]))
+        for hname, h in (("ttft", ts.ttft), ("e2e", ts.e2e)):
+            for p in (50, 99):
+                v = h.percentile(p)
+                if v is not None:
+                    tenant_rows.append(
+                        (f"dstpu_serving_{hname}_p{p}_seconds", "gauge",
+                         f"exact p{p} of {hname}", [("", tlab, float(v))]))
+    return out + gauge_rows + tenant_rows
 
 
 def register_serving_metrics(metrics, replica_id: int = 0) -> None:

@@ -643,6 +643,8 @@ def _chaos_dump_set(d, kind):
              "kv_exhaustion": "scheduler.admit",
              "slow_prefill": "replica0",
              "drop_token": "replica0",
+             "replica_spawn_fail": "replica2",
+             "replica_slow_warm": "replica2",
              "stale_health": "health.read",
              "flap_straggler": "health.read"}
     site = sites[kind]

@@ -1,0 +1,710 @@
+"""Fleet tier tests (`deepspeed_tpu/fleet/`).
+
+Coverage:
+
+* tenancy units: SLA class validation, tenant resolution, weighted EDF
+  deadlines, per-class shed watermarks, from_config (no jax);
+* the deadline scheduler's tenant-weighted admission order and preemption
+  victim choice against the fake engine (no jax);
+* tenant-weighted shed order at the server door — bronze sheds first,
+  per-tenant counters diverge, requeues bypass the door;
+* the router's warm gate: a cold add_replica takes no dispatch during a
+  submit storm, lazy promotion on `warmed`, explicit mark_ready;
+* the replica lifecycle state machine on stub servers, including the
+  `replica_spawn_fail` / `replica_slow_warm` chaos drills and the
+  FleetManager's reap-on-failure contract (satellite 6);
+* flap-guarded scale-in via FleetManager.poll;
+* the warm-join zero-probe contract on real tiny engines sharing a
+  WinnerCache dir (first replica probes, second applies with 0 probes);
+* doctor evidence naming fleet scale events and the fleet chaos drills;
+* per-tenant dstpu_serving_* telemetry rows;
+* a `slow`-marked subprocess-replica round trip (own process + engine).
+"""
+
+import json
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.control.guard import FlapGuard
+from deepspeed_tpu.control.ledger import ControlLedger
+from deepspeed_tpu.fleet import (DEAD, DRAINING, JOINED, SPAWNING, WARMING,
+                                 DEFAULT_CLASSES, FleetAtCapacity,
+                                 FleetManager, ReplicaHandle,
+                                 ReplicaSpawnError, SLAClass, TenancyMap)
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.models.transformer import TransformerConfig, TransformerLM
+from deepspeed_tpu.runtime.resilience.chaos import (ChaosEvent, ChaosSchedule,
+                                                    configure_chaos)
+from deepspeed_tpu.serving import (ContinuousBatchScheduler, LLMServer,
+                                   ReplicaRouter, Request, ServedResponse,
+                                   ServerOverloaded, ServingMetrics)
+
+
+# ---------------------------------------------------------------------------
+# fixtures / fakes
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = TransformerConfig(vocab_size=97, hidden_size=48, intermediate_size=96,
+                            num_layers=2, num_heads=4, num_kv_heads=2,
+                            max_seq_len=128, dtype=jnp.float32,
+                            norm="rmsnorm", activation="swiglu")
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _engine(tiny_model, **over):
+    model, params = tiny_model
+    kw = dict(token_budget=16, max_ragged_sequence_count=4, max_chunk_size=8,
+              num_kv_blocks=64, kv_block_size=8, max_blocks_per_seq=8,
+              dtype="float32")
+    kw.update(over)
+    return InferenceEngineV2(model, params, RaggedInferenceEngineConfig(**kw))
+
+
+class _FakeEngine:
+    """Same scheduler-facing surface as test_serving's fake: exact
+    worst-case block accounting, no jax."""
+
+    def __init__(self, num_blocks=8, block_size=4, max_seqs=8,
+                 max_seq_len=1024, max_blocks_per_seq=64):
+        self.config = SimpleNamespace(max_ragged_sequence_count=max_seqs,
+                                      kv_block_size=block_size,
+                                      max_blocks_per_seq=max_blocks_per_seq)
+        self.cfg = SimpleNamespace(max_seq_len=max_seq_len)
+        self.kv = SimpleNamespace(num_blocks=num_blocks + 1)
+        self.free = num_blocks
+        self.seqs = {}
+        self.put_order = []
+        self.state_manager = SimpleNamespace(get=self.seqs.get)
+
+    def _need(self, plen, mnt):
+        return -(-(plen + mnt) // self.config.kv_block_size)
+
+    def can_schedule(self, plen, mnt):
+        if plen + mnt > self.cfg.max_seq_len:
+            return False, "exceeds the model's max_seq_len"
+        need = self._need(plen, mnt)
+        if need > self.config.max_blocks_per_seq:
+            return False, f"needs {need} blocks > max_blocks_per_seq"
+        if need > self.free:
+            return False, f"KV pool has {self.free} uncommitted free blocks"
+        return True, ""
+
+    def put(self, uids, prompts, max_new_tokens=256, eos_token_id=None):
+        for uid, p in zip(uids, prompts):
+            need = self._need(len(p), max_new_tokens)
+            self.free -= need
+            self.seqs[uid] = SimpleNamespace(done=False, in_prefill=True,
+                                             blocks=need)
+            self.put_order.append(uid)
+
+    def flush(self, uid):
+        seq = self.seqs.pop(uid, None)
+        if seq is not None:
+            self.free += seq.blocks
+
+    @property
+    def uncommitted_free_blocks(self):
+        return self.free
+
+
+class _StubServer:
+    """The protocol surface the router + lifecycle touch, with no engine:
+    warm() skips generation/probing and the stub records halt/drain."""
+
+    def __init__(self, replica_id):
+        self.replica_id = int(replica_id)
+        self.engine = None
+        self.error = None
+        self.heartbeat = None
+        self.warmed = False
+        self.metrics = ServingMetrics()
+        self._thread = None
+        self._steps = 0
+        self.outstanding = 0
+        self.halted = False
+        self.drained = False
+
+    def start(self):
+        return self
+
+    def halt(self):
+        self.halted = True
+
+    def drain(self, timeout=None):
+        self.drained = True
+        return True
+
+    def steal_unfinished(self):
+        return []
+
+
+class _FakeRouter:
+    def __init__(self):
+        self.added = []
+
+    def add_replica(self, server, **kw):
+        self.added.append(server)
+
+
+def _req(plen=4, mnt=4, tenant=None, deadline=None):
+    return Request(np.arange(1, plen + 1, dtype=np.int32),
+                   max_new_tokens=mnt, deadline_s=deadline, tenant=tenant)
+
+
+def _resp(uid, *, arrival=0.0, tenant=None, deadline=None, plen=4, mnt=4):
+    return ServedResponse(_req(plen, mnt, tenant, deadline), uid, arrival)
+
+
+# ---------------------------------------------------------------------------
+# tenancy units
+# ---------------------------------------------------------------------------
+
+
+def test_sla_class_validation():
+    with pytest.raises(ValueError, match="weight"):
+        SLAClass("x", weight=0)
+    with pytest.raises(ValueError, match="deadline_s"):
+        SLAClass("x", weight=1, deadline_s=-2.0)
+
+
+def test_tenancy_resolution_and_defaults():
+    ten = TenancyMap(tenants={"acme": "gold"})
+    assert ten.cls_for("acme").name == "gold"
+    assert ten.cls_for("silver").name == "silver"   # direct class name
+    assert ten.cls_for("unknown").name == "bronze"  # lowest weight = default
+    assert ten.cls_for(None).name == "bronze"
+    assert ten.weight("acme") == 4.0 and ten.weight(None) == 1.0
+    with pytest.raises(ValueError, match="unknown"):
+        TenancyMap(tenants={"acme": "platinum"})
+    with pytest.raises(ValueError, match="duplicate"):
+        TenancyMap([SLAClass("a"), SLAClass("a")])
+
+
+def test_tenancy_from_config():
+    assert TenancyMap.from_config(None) is None
+    ten = TenancyMap()
+    assert TenancyMap.from_config(ten) is ten
+    ten = TenancyMap.from_config({
+        "classes": {"gold": {"weight": 4, "deadline_s": 2.0}, "bronze": 1},
+        "tenants": {"acme": "gold"},
+        "default": "bronze"})
+    assert ten.cls_for("acme").deadline_s == 2.0
+    assert ten.default == "bronze" and ten.max_weight == 4.0
+    # classes omitted -> the default gold/silver/bronze ladder
+    ten = TenancyMap.from_config({"tenants": {"acme": "gold"}})
+    assert set(ten.classes) == {c.name for c in DEFAULT_CLASSES}
+
+
+def test_tenancy_weighted_deadline_and_shed_watermark():
+    ten = TenancyMap()
+    gold = _resp(1, arrival=10.0, tenant="gold", deadline=8.0)
+    bronze = _resp(2, arrival=10.0, tenant="bronze", deadline=8.0)
+    # same nominal SLA; gold's sort deadline is 4x tighter
+    assert ten.effective_deadline_time(gold) == pytest.approx(12.0)
+    assert ten.effective_deadline_time(bronze) == pytest.approx(18.0)
+    assert ten.effective_deadline_time(_resp(3)) is None   # no deadline
+    assert ten.shed_watermark(8, "gold") == 8
+    assert ten.shed_watermark(8, "silver") == 4
+    assert ten.shed_watermark(8, None) == 2
+    assert ten.shed_watermark(1, None) == 1   # never below 1
+
+
+# ---------------------------------------------------------------------------
+# tenant-weighted scheduling (deadline policy, fake engine)
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_weighted_admission_order():
+    eng = _FakeEngine()
+    sched = ContinuousBatchScheduler(eng, "deadline", tenancy=TenancyMap())
+    sched.add(_resp(1, tenant="bronze", deadline=8.0))
+    sched.add(_resp(2, tenant="gold", deadline=8.0))
+    sched.admit(now=0.0)
+    # same nominal deadline and arrival: gold admitted first by weight
+    assert eng.put_order == [2, 1]
+
+
+def test_scheduler_preempts_low_class_prefill():
+    eng = _FakeEngine(num_blocks=2, block_size=4)
+    sched = ContinuousBatchScheduler(eng, "deadline", tenancy=TenancyMap())
+    bronze = _resp(1, tenant="bronze", deadline=8.0)
+    sched.add(bronze)
+    sched.admit(now=0.0)
+    assert eng.put_order == [1] and eng.free == 0
+    gold = _resp(2, tenant="gold", deadline=8.0)
+    sched.add(gold)
+    sched.admit(now=0.1)
+    # pool dry: the bronze prefill is the preemption victim, gold lands
+    assert eng.put_order == [1, 2]
+    assert sched.preemptions == 1
+    assert bronze in sched.pending and gold.uid in sched.inflight
+
+
+# ---------------------------------------------------------------------------
+# tenant-weighted shed order at the server door (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+def test_server_door_sheds_low_class_first():
+    ten = TenancyMap([SLAClass("gold", 4.0, deadline_s=2.0),
+                      SLAClass("bronze", 1.0)])
+    srv = LLMServer(_FakeEngine(), max_queue=64, tenancy=ten, replica_id=0)
+    srv.start = lambda: srv          # keep the ingress queued: door test only
+    srv.control_max_queue = 4        # gold door 4, bronze door 1
+    b1 = srv.submit(_req(tenant="bronze"))
+    assert b1.replica_id == 0
+    with pytest.raises(ServerOverloaded, match="tenant 'bronze'"):
+        srv.submit(_req(tenant="bronze"))      # depth 1 >= bronze door 1
+    g1 = srv.submit(_req(tenant="gold"))
+    assert g1.request.deadline_s == 2.0        # class-default SLA stamped
+    srv.submit(_req(tenant="gold"))
+    srv.submit(_req(tenant="gold"))
+    with pytest.raises(ServerOverloaded, match="tenant 'gold'"):
+        srv.submit(_req(tenant="gold"))        # depth 4 >= gold door 4
+    # per-tenant SLA counters diverge: bronze shed at depth 1, gold at 4
+    m = srv.metrics
+    assert m.tenants["bronze"].submitted == 1
+    assert m.tenants["bronze"].rejected == 1
+    assert m.tenants["gold"].submitted == 3
+    assert m.tenants["gold"].rejected == 1
+    assert m.rejected == 2
+    assert m.snapshot()["tenants"]["gold"]["submitted"] == 3
+    # a router requeue (_response path) bypasses the shed door and keeps
+    # its tenant identity across replicas
+    requeued = _resp(99, tenant="bronze", deadline=8.0)
+    out = srv.submit(requeued.request, _response=requeued)
+    assert out is requeued and out.request.tenant == "bronze"
+    assert m.requeues == 1
+    assert srv.scheduler._sort_deadline(out) == pytest.approx(
+        out.arrival_time + 8.0)   # bronze weight 1.0
+
+
+def test_tenant_telemetry_rows():
+    from deepspeed_tpu.telemetry.manager import serving_metrics_samples
+
+    m = ServingMetrics()
+    resp = _resp(0, tenant="gold", deadline=8.0)
+    m.on_submit(resp)
+    resp._on_token(5, 0.1)
+    resp._on_finish("length", 0.2)
+    m.on_finish(resp)
+    m.on_reject(_req(tenant="bronze"))
+    rows = serving_metrics_samples(m, {"replica": "0"})
+    by_tenant = {}
+    for name, _kind, _help, samples in rows:
+        for _suffix, labels, value in samples:
+            if "tenant" in labels:
+                by_tenant[(name, labels["tenant"])] = value
+    assert by_tenant[("dstpu_serving_completed_total", "gold")] == 1.0
+    assert by_tenant[("dstpu_serving_rejected_total", "bronze")] == 1.0
+    assert by_tenant[("dstpu_serving_tokens_out_total", "gold")] == 1.0
+    assert ("dstpu_serving_ttft_p99_seconds", "gold") in by_tenant
+    # the per-tenant rows carry the base labels too (same family names)
+    assert all(lbl.get("replica") == "0"
+               for _n, _k, _h, ss in rows for _s, lbl, _v in ss)
+
+
+# ---------------------------------------------------------------------------
+# router warm gate (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_warm_gate_blocks_dispatch_until_ready():
+    router = ReplicaRouter([_StubServer(0)])
+    cold = _StubServer(1)
+    router.add_replica(cold)                  # warmed=False -> gated
+    assert router.alive_ids() == [0]
+    router.mark_ready(1)                      # explicit promotion
+    assert sorted(router.alive_ids()) == [0, 1]
+    lazy = _StubServer(2)
+    router.add_replica(lazy)
+    assert sorted(router.alive_ids()) == [0, 1]
+    lazy.warmed = True                        # first engine step / fleet warm
+    assert sorted(router.alive_ids()) == [0, 1, 2]
+    # explicit ready=True overrides a cold flag (operator escape hatch)
+    forced = _StubServer(3)
+    router.add_replica(forced, ready=True)
+    assert 3 in router.alive_ids()
+
+
+def test_warm_gate_submit_storm_races_a_join(tiny_model):
+    a = LLMServer(_engine(tiny_model), replica_id=0)
+    b = LLMServer(_engine(tiny_model), replica_id=1)
+    router = ReplicaRouter([a])
+    try:
+        router.add_replica(b)                 # cold LLMServer: warmed=False
+        assert router.alive_ids() == [0]
+        resps = [router.submit(_req(mnt=4)) for _ in range(6)]
+        # every storm request landed on the warm replica, none on WARMING b
+        assert all(r.replica_id == 0 for r in resps)
+        for r in resps:
+            assert r.wait(60), "storm request did not finish"
+        # b never received work, so its idle engine thread must NOT have
+        # flipped the flag: it is still gated
+        assert router.alive_ids() == [0]
+        b.warmed = True                       # the fleet warm contract
+        assert sorted(router.alive_ids()) == [0, 1]
+    finally:
+        router.close()
+
+
+def test_remove_replica_guards_tracked_work():
+    router = ReplicaRouter([_StubServer(0), _StubServer(1)])
+    with pytest.raises(KeyError):
+        router.remove_replica(7)
+    sentinel = _resp(0)
+    router._assigned[1][id(sentinel)] = sentinel
+    with pytest.raises(RuntimeError, match="drain it instead"):
+        router.remove_replica(1)
+    router._assigned[1].clear()
+    gone = router.remove_replica(1)
+    assert gone.halted and 1 not in router.replicas
+    assert router.alive_ids() == [0]
+
+
+# ---------------------------------------------------------------------------
+# lifecycle state machine (stub servers)
+# ---------------------------------------------------------------------------
+
+
+def test_lifecycle_walk_and_illegal_transitions():
+    h = ReplicaHandle(0, lambda rid: _StubServer(rid))
+    assert h.state == SPAWNING
+    with pytest.raises(RuntimeError, match="illegal transition"):
+        h._set_state(JOINED)
+    srv = h.spawn()
+    assert h.state == WARMING and srv.replica_id == 0
+    report = h.warm()
+    assert srv.warmed is True
+    assert report.zero_probe_join()           # no engine: nothing probed
+    router = _FakeRouter()
+    h.join(router)
+    assert h.state == JOINED and router.added == [srv]
+    assert h.drain() is True                  # no router: drains the server
+    assert h.state == DEAD and srv.drained
+    assert [s for s, _ in h.transitions] == [SPAWNING, WARMING, JOINED,
+                                             DRAINING, DEAD]
+
+
+def test_lifecycle_replica_id_mismatch_and_kill():
+    h = ReplicaHandle(5, lambda rid: _StubServer(99))
+    with pytest.raises(ReplicaSpawnError, match="replica_id=99"):
+        h.spawn()
+    assert h.state == DEAD
+    h2 = ReplicaHandle(6, lambda rid: _StubServer(rid))
+    h2.spawn()
+    h2.kill()                                 # kill is legal from any state
+    assert h2.state == DEAD and h2.server.halted
+
+
+def test_chaos_slow_warm_stalls_bring_up():
+    sched = ChaosSchedule([ChaosEvent(kind="replica_slow_warm",
+                                      site="replica0", at=0, param=0.05)])
+    configure_chaos(sched)
+    try:
+        h = ReplicaHandle(0, lambda rid: _StubServer(rid))
+        h.spawn()
+        t0 = time.monotonic()
+        h.warm()
+        assert time.monotonic() - t0 >= 0.05
+    finally:
+        configure_chaos(None)
+    assert any(e["kind"] == "replica_slow_warm" for e in sched.fired)
+
+
+# ---------------------------------------------------------------------------
+# FleetManager: scale-out, reap (satellite 6), scale-in
+# ---------------------------------------------------------------------------
+
+
+def test_manager_start_and_scale_out():
+    mgr = FleetManager(lambda rid: _StubServer(rid), max_replicas=3)
+    router = mgr.start(1)
+    assert router is mgr.router and set(router.replicas) == {0}
+    assert mgr.handles[0].state == JOINED
+    rid = mgr.scale_out()
+    assert rid == 1 and mgr.handles[1].state == JOINED
+    # warmed pre-join: the new replica is dispatchable immediately
+    assert sorted(router.alive_ids()) == [0, 1]
+    joins = mgr.ledger.actions("replica_join")
+    assert [e.rule for e in joins] == ["fleet_start", "fleet_scale_out"]
+    assert joins[-1].params["zero_probe"] == "True"
+    mgr.scale_out()
+    with pytest.raises(FleetAtCapacity):
+        mgr.scale_out()
+    mgr.close()
+
+
+def test_manager_reaps_failed_spawn():
+    mgr = FleetManager(lambda rid: _StubServer(rid), max_replicas=4)
+    router = mgr.start(1)
+    sched = ChaosSchedule([ChaosEvent(kind="replica_spawn_fail",
+                                      site="replica1", at=0)])
+    configure_chaos(sched)
+    try:
+        with pytest.raises(ReplicaSpawnError):
+            mgr.scale_out()
+    finally:
+        configure_chaos(None)
+    # satellite 6: nothing leaked — no router entry, no WARMING residue
+    assert set(router.replicas) == {0}
+    assert not router._warming
+    assert mgr.handles[1].state == DEAD
+    reaps = mgr.ledger.actions("replica_reap")
+    assert len(reaps) == 1
+    assert reaps[0].outcome == "failed:ReplicaSpawnError"
+    assert any(e["kind"] == "replica_spawn_fail" for e in sched.fired)
+    # the fleet recovers: the next scale-out takes a fresh id and joins
+    assert mgr.scale_out() == 2
+    mgr.close()
+
+
+def test_manager_reaps_failure_after_registration():
+    """A failure AFTER add_replica (join succeeded, then the caller's
+    bring-up blew up) must still remove the router entry."""
+    mgr = FleetManager(lambda rid: _StubServer(rid))
+    router = mgr.start(1)
+    h = mgr._new_handle()
+    mgr.handles[h.replica_id] = h
+    h.spawn()
+    h.warm()
+    h.join(router)
+    assert h.replica_id in router.replicas
+    mgr._reap(h, during="scale_out", error=RuntimeError("post-join failure"))
+    assert h.replica_id not in router.replicas
+    assert h.state == DEAD and h.server.halted
+    assert mgr.ledger.actions("replica_reap")[-1].outcome == \
+        "failed:RuntimeError"
+    mgr.close()
+
+
+def test_manager_flap_guarded_scale_in():
+    guard = FlapGuard(trigger_streak=2, cooldown_s=0.0)
+    mgr = FleetManager(lambda rid: _StubServer(rid), guard=guard,
+                       min_replicas=1, scale_in_low_watermark=0.5)
+    router = mgr.start(2)
+    assert mgr.poll() is None                 # hysteresis: streak 1 of 2
+    rid = mgr.poll()                          # streak 2 -> fires
+    assert rid is not None
+    assert mgr.handles[rid].state == DEAD
+    assert rid in router._draining
+    entries = mgr.ledger.actions("serving_scale_in")
+    assert len(entries) == 1 and entries[0].outcome == "ok"
+    assert entries[0].rule == "fleet_scale_in"
+    # at min_replicas the rule never asserts again
+    for _ in range(5):
+        assert mgr.poll() is None
+    assert len(mgr._joined()) == 1
+    mgr.close()
+
+
+def test_manager_poll_reconciles_router_declared_deaths():
+    guard = FlapGuard(trigger_streak=1, cooldown_s=0.0)
+    mgr = FleetManager(lambda rid: _StubServer(rid), guard=guard,
+                       min_replicas=1)
+    router = mgr.start(2)
+    # a chaos kill the manager did not initiate: router declares 0 dead
+    with router._lock:
+        router._dead.add(0)
+    assert mgr.poll() is None        # reconcile only: joined==[1]==min
+    assert mgr.handles[0].state == DEAD
+    entries = mgr.ledger.actions("replica_reap")
+    assert len(entries) == 1 and entries[0].rule == "fleet_reconcile"
+    assert "died outside the fleet's control" in entries[0].reason
+    # the dead replica is never picked as a scale-in victim afterwards
+    assert all(h.replica_id != 0 for h in mgr._joined())
+    mgr.close()
+
+
+def test_guard_rearm_waives_clear_streak_only():
+    t = [0.0]
+    g = FlapGuard(trigger_streak=1, clear_streak=2, cooldown_s=10.0,
+                  clock=lambda: t[0])
+    assert g.should_fire("sla_pressure:1", True)
+    # latched: sustained pressure cannot refire
+    assert not g.should_fire("sla_pressure:1", True)
+    assert g.rearm("sla_pressure") == 1
+    # re-armed but the cooldown still applies
+    assert not g.should_fire("sla_pressure:1", True)
+    t[0] = 11.0
+    assert g.should_fire("sla_pressure:1", True)
+    # prefix filter: re-arming sla rules leaves other latched rules alone
+    assert g.should_fire("mem_pressure:0", True)
+    assert g.rearm("sla_pressure") == 1   # the refired sla rule re-latched
+    assert not g.should_fire("mem_pressure:0", True)   # mem still latched
+
+
+def test_manager_reconcile_rearms_latched_sla_rules():
+    from deepspeed_tpu.control.supervisor import ControlSupervisor
+    from deepspeed_tpu.runtime.config import ControlConfig
+
+    sup = ControlSupervisor(ControlConfig(enabled=True),
+                            guard=FlapGuard(trigger_streak=1, cooldown_s=0.0))
+    # a scale-out that was rejected at capacity latched the rule in the
+    # old 2-replica world
+    assert sup.guard.should_fire("sla_pressure:1", True)
+    assert sup.guard.snapshot()["sla_pressure:1"]["latched"]
+    mgr = FleetManager(lambda rid: _StubServer(rid), supervisor=sup,
+                       min_replicas=1,
+                       guard=FlapGuard(trigger_streak=1, cooldown_s=0.0))
+    router = mgr.start(2)
+    with router._lock:
+        router._dead.add(0)
+    mgr.poll()
+    assert mgr.handles[0].state == DEAD
+    # the death freed capacity: the latched rule is re-armed so sustained
+    # pressure can scale the NEW fleet out
+    assert not sup.guard.snapshot()["sla_pressure:1"]["latched"]
+    assert sup.guard.should_fire("sla_pressure:1", True)
+    mgr.close()
+
+
+def test_manager_scale_in_keeps_loaded_replicas():
+    mgr = FleetManager(lambda rid: _StubServer(rid))
+    mgr.start(3)
+    mgr.handles[0].server.outstanding = 4
+    mgr.handles[1].server.outstanding = 0     # least loaded -> the victim
+    mgr.handles[2].server.outstanding = 2
+    assert mgr.scale_in() == 1
+    assert mgr.handles[1].state == DEAD
+    assert {h.replica_id for h in mgr._joined()} == {0, 2}
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# warm-join zero-probe contract (satellite 3; real engines)
+# ---------------------------------------------------------------------------
+
+
+def test_warm_join_zero_probe_via_winner_cache(tiny_model, tmp_path):
+    cache_dir = str(tmp_path / "winners")
+    made = {}
+
+    def factory(rid):
+        made[rid] = LLMServer(_engine(tiny_model), replica_id=rid)
+        return made[rid]
+
+    h0 = ReplicaHandle(0, factory, autotune_cache_dir=cache_dir)
+    h0.spawn()
+    r0 = h0.warm()
+    # first replica on this mesh: probes every candidate once, stores
+    assert r0.autotune_from_cache is False
+    assert r0.autotune_probes == 2
+    assert r0.winner_name in ("fd0", "fd8")
+    assert not r0.zero_probe_join()
+    assert made[0].fused_decode_chunk == r0.fused_decode_chunk
+    assert r0.warm_tokens > 0
+
+    h1 = ReplicaHandle(1, factory, autotune_cache_dir=cache_dir)
+    h1.spawn()
+    r1 = h1.warm()
+    # second replica: cached winner applied, ZERO probes of either kind
+    assert r1.autotune_from_cache is True
+    assert r1.autotune_probes == 0
+    assert r1.probes_built == 0
+    assert r1.zero_probe_join()
+    assert r1.winner_name == r0.winner_name
+    assert made[1].fused_decode_chunk == r0.fused_decode_chunk
+    assert r1.to_params()["zero_probe"] == "True"
+    for srv in made.values():
+        srv.halt()
+
+
+# ---------------------------------------------------------------------------
+# doctor evidence (satellite 2 + tentpole observability)
+# ---------------------------------------------------------------------------
+
+
+def test_doctor_names_fleet_scale_events_and_drills(tmp_path):
+    from deepspeed_tpu.doctor import diagnose
+
+    led = ControlLedger()
+    led.record("replica_join", step=3, rule="fleet_scale_out",
+               signal="fleet 1 -> 2 replica(s)",
+               reason="replica 1 warmed and joined (cached winners, "
+                      "zero probes)",
+               params={"replica": "1", "zero_probe": "True"})
+    led.record("replica_reap", step=5, rule="fleet_scale_out",
+               reason="reaped half-spawned replica 2: ReplicaSpawnError",
+               outcome="failed:ReplicaSpawnError")
+    led.record("serving_scale_in", step=9, rule="fleet_scale_in",
+               reason="drained least-loaded replica 1",
+               params={"replica": "1"})
+    dump = {"reason": "manual", "rank": 0, "pid": 1, "sequence": 1,
+            "wall_time": time.time(), "last_phase": "serve/step",
+            "open_spans": [], "inflight_spans": [], "steps": [],
+            "retries": [], "control": led.snapshot()}
+    (tmp_path / "flightdump-0.json").write_text(json.dumps(dump))
+    # a fired fleet drill in the chaos manifest is named as evidence too
+    sched = ChaosSchedule([ChaosEvent(kind="replica_spawn_fail",
+                                      site="replica2", at=0)])
+    configure_chaos(sched)
+    try:
+        h = ReplicaHandle(2, lambda rid: _StubServer(rid))
+        with pytest.raises(ReplicaSpawnError):
+            h.spawn()
+    finally:
+        configure_chaos(None)
+    sched.dump(str(tmp_path))
+
+    report = diagnose(str(tmp_path))
+    ev = "\n".join(report["evidence"])
+    assert "fleet scale event" in ev
+    assert "replica_join" in ev
+    assert "serving_scale_in" in ev
+    assert "replica_reap" in ev          # failed outcomes are named too
+    assert "chaos drill injected replica_spawn_fail" in ev
+
+
+# ---------------------------------------------------------------------------
+# subprocess replica (own process + engine; too slow for tier-1)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_subprocess_replica_round_trip():
+    from deepspeed_tpu.fleet.subproc import SubprocessReplica
+
+    rep = SubprocessReplica(
+        0, "deepspeed_tpu.fleet._testing:make_tiny_server",
+        hello_timeout_s=600.0)
+    try:
+        assert rep.warmed                 # hello implies the child warmed
+        assert rep.warm_params.get("replica") == "0"
+        resp = rep.submit(_req(mnt=4, tenant="gold"))
+        assert resp.wait(120), "subprocess completion did not land"
+        assert len(resp.tokens) == 4
+        assert resp.finish_reason == "length"
+        assert rep.metrics.completed == 1
+        assert rep.outstanding == 0
+    finally:
+        assert rep.drain(60.0)
+        rep.halt()
+
+
+def test_supervisor_keeps_caller_supplied_empty_ledger():
+    # regression: ControlLedger has __len__, so `ledger or ControlLedger()`
+    # silently replaced a caller's EMPTY ledger — the fleet bench shares
+    # one ledger between the supervisor and the FleetManager and reads it
+    # back for the doctor's flight dump
+    from deepspeed_tpu.control.ledger import ControlLedger
+    from deepspeed_tpu.control.supervisor import ControlSupervisor
+    from deepspeed_tpu.runtime.config import ControlConfig
+
+    led = ControlLedger()
+    sup = ControlSupervisor(ControlConfig(), ledger=led)
+    assert sup.ledger is led
+    mgr = FleetManager(lambda rid: _StubServer(rid), supervisor=sup)
+    assert mgr.ledger is led
