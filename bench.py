@@ -2730,6 +2730,222 @@ def model_family_bench():
             "device": jax.devices()[0].platform}
 
 
+def integrity_bench():
+    """Rung si (silent-corruption integrity tier, runtime/resilience/
+    integrity.py + control/policy.py's integrity rule): two halves.
+
+    (1) Armed fingerprint overhead — the cost the tier rides on EVERY
+    step when enabled: the in-jit digest issue, the pre-step retention
+    copy on fingerprint steps, and the one-step-delayed 8-word harvest.
+    Measured as best-of-3 mean step time armed (world=1: the compute-side
+    contract; the store publish is a per-interval KB-sized JSON write off
+    the hot loop) vs integrity-off on the same model, and ASSERTED under
+    1% — the tier's whole design premise is that detection is cheap
+    enough to leave on.
+
+    (2) The gated e2e SDC drill, both chaos classes: three in-process
+    engines share a fingerprint store; a bit flip lands on rank 1
+    (sticky from step 7 / one-shot transient AT fingerprint step 8). The
+    invariants are asserted in-process — detection at the next
+    fingerprint step, shadow-replay verdict correct, quarantine for
+    sticky only, rollback to a verified snapshot, and final loss BITWISE
+    equal to a fault-free reference — so any violation errors the rung
+    and gates. The headline is the number of SDC classes fully healed."""
+    import shutil as _shutil
+    import tempfile
+
+    import deepspeed_tpu as ds
+
+    def make_params(hidden, nlayers=3, seed=0):
+        rng = np.random.default_rng(seed)
+        p = {}
+        for i in range(nlayers):
+            p[f"layer_{i}"] = {
+                "w": jnp.asarray(rng.normal(0, 0.05, size=(hidden, hidden)),
+                                 jnp.float32),
+                "b": jnp.zeros((hidden,), jnp.float32)}
+        p["head"] = {"w": jnp.asarray(rng.normal(0, 0.05, size=(hidden, 1)),
+                                      jnp.float32)}
+        return p
+
+    def mlp_loss(params, batch):
+        x, y = batch["x"], batch["y"]
+        h = x
+        n = len([k for k in params if k.startswith("layer_")])
+        for i in range(n):
+            h = jnp.tanh(h @ params[f"layer_{i}"]["w"]
+                         + params[f"layer_{i}"]["b"])
+        pred = h @ params["head"]["w"]
+        return jnp.mean((pred - y.astype(pred.dtype)) ** 2)
+
+    mlp_loss._sharding_native = True
+
+    def mk_batches(n, hidden, bs, seed=0):
+        rng = np.random.default_rng(seed)
+        w = rng.normal(size=(hidden, 1)).astype(np.float32)
+        out = []
+        for _ in range(n):
+            x = rng.normal(size=(bs, hidden)).astype(np.float32)
+            y = x @ w + 0.01 * rng.normal(size=(bs, 1)).astype(np.float32)
+            out.append({"x": jnp.asarray(x), "y": jnp.asarray(y)})
+        return out
+
+    work = tempfile.mkdtemp(prefix="dstpu_si_")
+    try:
+        # -- (1) armed overhead on a step big enough to be the signal ----
+        HIDDEN, BATCH, FP_EVERY, MEASURE = 512, 128, 32, 64
+
+        def build(name, armed):
+            cfg = {"train_micro_batch_size_per_gpu": BATCH,
+                   "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                   "steps_per_print": 10**9, "seed": 11,
+                   "resilience": {"enabled": True,
+                                  "snapshot_dir": os.path.join(work, name),
+                                  "snapshot_interval": 10**9,
+                                  "async_snapshot": False}}
+            if armed:
+                cfg["resilience"]["integrity"] = {
+                    "enabled": True, "interval_steps": FP_EVERY, "world": 1,
+                    "dir": os.path.join(work, name, "fp")}
+            e, *_ = ds.initialize(model=mlp_loss,
+                                  model_parameters=make_params(HIDDEN),
+                                  config=cfg)
+            return e
+
+        bs = mk_batches(4, HIDDEN, BATCH, seed=3)
+
+        def run_arm(e):
+            for i in range(8):      # warm: train-step + fingerprint compiles
+                e.train_batch(bs[i % 4])
+            jax.block_until_ready(e.state)
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for i in range(MEASURE):
+                    e.train_batch(bs[i % 4])
+                jax.block_until_ready(e.state)
+                best = min(best, time.perf_counter() - t0)
+            return best / MEASURE
+
+        off_s = run_arm(build("off", False))
+        armed_eng = build("armed", True)
+        armed_s = run_arm(armed_eng)
+        overhead_pct = (armed_s - off_s) / off_s * 100.0
+        assert overhead_pct < 1.0, (
+            f"armed integrity overhead {overhead_pct:.2f}% of step time "
+            f"breaches the <1% design budget")
+        # raw digest latency (full issue+fetch round trip, no amortization)
+        fp_fn = armed_eng.resilience.integrity._fp_fn
+        np.asarray(fp_fn(armed_eng.state))
+        t0 = time.perf_counter()
+        np.asarray(fp_fn(armed_eng.state))
+        fp_ms = (time.perf_counter() - t0) * 1e3
+
+        # -- (2) the gated drill, one pass per SDC class -----------------
+        D_HIDDEN, D_BATCH, D_STEPS, SNAP_IVL, FP_IVL = 32, 4, 14, 4, 2
+
+        def drill_engine(kind, rank, faults):
+            cfg = {"train_micro_batch_size_per_gpu": D_BATCH,
+                   "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+                   "steps_per_print": 10**9, "seed": 7,
+                   "control": {"enabled": True,
+                               "supervisor": {"interval_steps": 1,
+                                              "straggler_replan": False,
+                                              "memory_guard": False,
+                                              "rollback_degrade": False},
+                               "guard": {"trigger_streak": 1,
+                                         "clear_streak": 1,
+                                         "cooldown_s": 0.0, "budget": 100}},
+                   "resilience": {
+                       "enabled": True,
+                       "snapshot_dir": os.path.join(
+                           work, f"drill-{kind}-snap-{rank}"),
+                       "snapshot_interval": SNAP_IVL,
+                       "async_snapshot": False,
+                       "integrity": {"enabled": True,
+                                     "interval_steps": FP_IVL,
+                                     "rank": rank, "world": 3,
+                                     "dir": os.path.join(work,
+                                                         f"drill-{kind}-fp"),
+                                     "resolve_timeout_steps": 6}}}
+            if faults is not None and rank == 1:
+                cfg["resilience"]["faults"] = faults
+            e, *_ = ds.initialize(model=mlp_loss,
+                                  model_parameters=make_params(D_HIDDEN),
+                                  config=cfg)
+            return e
+
+        d_batches = mk_batches(D_STEPS + 4, D_HIDDEN, D_BATCH, seed=0)
+        ref = drill_engine("ref", 0, None)
+        ref.resilience.integrity.cfg.interval_steps = 10**9  # ref: fp off
+        ref_losses = {}
+        while ref.global_steps < D_STEPS:
+            gs = ref.global_steps
+            ref_losses[gs + 1] = float(np.asarray(
+                ref.train_batch(d_batches[gs])))
+
+        drill = {}
+        cases = (("sticky", {"enabled": True, "sdc_sticky_from_step": 7,
+                             "sdc_rank": 1}),
+                 ("transient", {"enabled": True,
+                                "sdc_transient_at_steps": [8],
+                                "sdc_rank": 1}))
+        for kind, faults in cases:
+            engines = [drill_engine(kind, r, faults) for r in range(3)]
+            alive = {0, 1, 2}
+            finals = {}
+            for _ in range(200):
+                if not any(engines[r].global_steps < D_STEPS for r in alive):
+                    break
+                for r in sorted(alive):
+                    e = engines[r]
+                    if e.global_steps >= D_STEPS:
+                        continue
+                    gs = e.global_steps
+                    loss = float(np.asarray(e.train_batch(d_batches[gs])))
+                    if gs + 1 == D_STEPS:
+                        finals[r] = loss
+                for r in sorted(alive):
+                    mon = engines[r].resilience.integrity
+                    if mon.quarantined and r in mon.quarantined:
+                        alive.discard(r)       # fleet acts on the verdict
+            else:
+                raise AssertionError(f"{kind} drill did not converge")
+            healthy = sorted(alive)
+            mon0 = engines[healthy[0]].resilience.integrity
+            assert mon0.divergences, f"{kind}: divergence never detected"
+            first = mon0.divergences[0]
+            assert first["step"] == 8 and first["minority"] == [1], first
+            led = engines[healthy[0]].control.ledger.snapshot()
+            quarantined = any(a["action"] == "sdc_quarantine"
+                              and 1 in a["params"]["ranks"] for a in led)
+            assert quarantined == (kind == "sticky"), (
+                f"{kind}: quarantine={quarantined}")
+            assert any(a["action"] == "integrity_rollback"
+                       and a["outcome"] == "ok" for a in led), kind
+            bitwise = all(finals[r] == ref_losses[D_STEPS] for r in healthy)
+            assert bitwise, (
+                f"{kind}: healed losses not bitwise equal to fault-free ref")
+            drill[kind] = {"detected_step": first["step"],
+                           "verdict": first["verdict"],
+                           "quarantined": quarantined,
+                           "healthy_ranks": healthy,
+                           "bitwise_recovery": bitwise}
+        classes = len(drill)
+    finally:
+        _shutil.rmtree(work, ignore_errors=True)
+
+    return {"metric": "integrity_sdc_classes_healed", "value": classes,
+            "unit": "classes/2", "vs_baseline": None,
+            "armed_overhead_pct": round(overhead_pct, 3),
+            "off_step_ms": round(off_s * 1e3, 3),
+            "armed_step_ms": round(armed_s * 1e3, 3),
+            "fingerprint_ms": round(fp_ms, 3),
+            "fp_interval_steps": FP_EVERY,
+            "drill": drill,
+            "device": jax.devices()[0].platform}
+
+
 RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "3b": rung3b_big_model,
          "4": rung4_pipeline_bubble, "5": rung5_moe_ulysses,
@@ -2743,7 +2959,7 @@ RUNGS = {"1": rung1_simple_zero0, "2": rung2_gpt2_zero1,
          "ob": telemetry_bench, "mem": memory_telemetry_bench,
          "sa": static_audit_bench, "at": control_bench,
          "cz": chaos_soak_bench, "mf": model_family_bench,
-         "fs": fleet_serving_bench}
+         "fs": fleet_serving_bench, "si": integrity_bench}
 
 
 # ---------------------------------------------------------------------------
@@ -2783,6 +2999,10 @@ GATE_SPECS = {
     # kill->join tok/s rise, bounded p99 TTFT, doctor naming every event)
     # are in-process asserts, so any violation errors the rung and gates
     "fleet_elastic_tok_s": ("higher", 0.5),
+    # SDC classes healed end-to-end: deterministic drill count, and the
+    # <1% armed-overhead budget is an in-process assert that errors the
+    # rung — wall-clock noise never rides the gated value itself
+    "integrity_sdc_classes_healed": ("higher", 0.05),
 }
 
 
@@ -2948,6 +3168,12 @@ def run_ladder(gate: bool = False):
             # flap-guarded scale-in — elastic-serving invariants asserted
             # in-process (one CPU device is the substrate)
             ("fs", cpu1),
+            # si arms the integrity tier's cross-rank fingerprints: armed
+            # step overhead vs off (asserted <1%), then the gated SDC
+            # drill — sticky and transient bit flips detected, classified
+            # by shadow replay, quarantined/rolled back to bitwise
+            # recovery (one CPU device is the substrate)
+            ("si", cpu1),
             # mf auto-shards every built-in rule-pack family (llama,
             # mistral, gpt_neox, mixtral) at tp=2 x ZeRO-3 via
             # autotp_initialize and audits each compiled step to zero

@@ -45,8 +45,12 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 #: modules allowed to touch raw shard_map (the version shim itself)
 SHARD_MAP_EXEMPT = ("utils/shard_map_compat.py",)
 #: path prefixes where host syncs are forbidden unless annotated: the
-#: engine hot path and the (default-off but attach-everywhere) telemetry
-HOST_SYNC_SCOPED = ("runtime/engine.py", "telemetry/")
+#: engine hot path, the (default-off but attach-everywhere) telemetry,
+#: and the integrity tier — whose whole design contract is "no hot-path
+#: host sync" (digests are fetched one step delayed; only the harvest
+#: and the off-path shadow replay may sync, each with a sync-ok blessing)
+HOST_SYNC_SCOPED = ("runtime/engine.py", "telemetry/",
+                    "runtime/resilience/integrity.py")
 #: the annotation that blesses one host-sync line: `# sync-ok: <why>`
 SYNC_OK_MARKER = "sync-ok:"
 #: path prefixes where silent `except Exception: pass` is forbidden: the

@@ -48,6 +48,10 @@ POLICY_TABLE: List[Tuple[str, str, str, str]] = [
     ("rollback_degrade", "sentinel rollbacks",
      ">= supervisor.rollback_threshold within rollback_window_s",
      "enter_degraded (exact collectives)"),
+    ("integrity", "cross-rank fingerprint divergence (integrity tier)",
+     "any unactioned divergence verdict",
+     "integrity_rollback (newest VERIFIED snapshot); sticky minority -> "
+     "sdc_quarantine (replan around the corrupt host)"),
 ]
 
 RULE_NAMES = tuple(r[0] for r in POLICY_TABLE)
@@ -218,6 +222,71 @@ def rule_rollbacks(sup, step: int) -> None:
     sup.ledger.record(
         "enter_degraded", step=step, rule="rollback_degrade", signal=sig,
         reason=f"fell back to exact collectives after {sig}")
+
+
+def rule_integrity(sup, step: int) -> None:
+    """Fingerprint-divergence verdicts (ISSUE 20 integrity tier) ->
+    rollback to the newest VERIFIED snapshot; a ``sticky`` minority rank is
+    additionally quarantined — ledger-recorded as ``sdc_quarantine`` and,
+    when the planner can, the DP-grad collective is re-planned around it
+    (the straggler re-plan actuator: a corrupt host and a slow host both
+    need traffic routed away). Transient flips only roll back: the host is
+    fine, the state is not. The verdict queue is drained ONLY when the
+    guard fires, so hysteresis sees a steady asserted signal, and a
+    rollback clears the queue either way (restored state moots stale
+    verdicts)."""
+    rz = getattr(sup.engine, "resilience", None)
+    mon = getattr(rz, "integrity", None) if rz is not None else None
+    if mon is None:
+        return  # integrity off: not even a clear observation to feed
+    verdicts = mon.pending_verdicts()
+    if not sup.guard.should_fire("integrity", bool(verdicts)):
+        return
+    verdicts = mon.drain_verdicts()
+    if not verdicts:  # raced note_rollback
+        return
+    steps = sorted(v["step"] for v in verdicts)
+    sticky = sorted({r for v in verdicts if v.get("verdict") == "sticky"
+                     for r in v.get("minority", ())})
+    kinds = sorted({str(v.get("verdict")) for v in verdicts})
+    sig = (f"fingerprint divergence at step(s) {steps}, "
+           f"verdict(s) {kinds}, minority "
+           f"{sorted({r for v in verdicts for r in v.get('minority', ())})}")
+    ic = rz.cfg.integrity
+    if sticky and ic.quarantine:
+        fresh = [r for r in sticky if r not in mon.quarantined]
+        mon.quarantined.extend(fresh)
+        axes = sup.slow_link_axes()
+        replanned = None
+        if axes and sup.can_replan():
+            replanned = sup.engine.replan_dp_grad(
+                axes, penalty=float(sup.cfg.supervisor.straggler_penalty))
+        sup.ledger.record(
+            "sdc_quarantine", step=step, rule="integrity", signal=sig,
+            reason=f"quarantined sticky-SDC rank(s) {sticky}: shadow "
+                   "replay reproduced the corruption, so the host — not "
+                   "the state — is bad; routed collectives around it "
+                   + ("(re-planned)" if replanned else
+                      "(no re-plannable site; demotion recorded for the "
+                      "scheduler/operator)"),
+            params={"ranks": sticky, "steps": steps,
+                    "replanned": bool(replanned)})
+    if ic.rollback:
+        ok = rz.integrity_rollback()
+        sup.ledger.record(
+            "integrity_rollback", step=step, rule="integrity", signal=sig,
+            reason=("restored the newest verified snapshot (corrupt state "
+                    "discarded)" if ok else
+                    "no verified snapshot available — training continues "
+                    "on suspect state, loudly"),
+            params={"steps": steps,
+                    "max_step": mon.last_clean_step},
+            outcome="ok" if ok else "skipped:no-verified-snapshot")
+    else:
+        sup.ledger.record(
+            "integrity_detected", step=step, rule="integrity", signal=sig,
+            reason="integrity.rollback disabled — verdict recorded only",
+            params={"steps": steps}, outcome="skipped:rollback-disabled")
 
 
 # ---------------------------------------------------------------------------

@@ -226,6 +226,8 @@ class ControlSupervisor:
             policy.rule_memory(self, step)
         if sc.rollback_degrade:
             policy.rule_rollbacks(self, step)
+        if sc.integrity_guard:
+            policy.rule_integrity(self, step)
         self._note_budget(step)
 
     def on_serving_tick(self, server) -> None:

@@ -318,6 +318,75 @@ def health_verdicts(beacons: Dict[int, dict], *, dead_after_s: float = 60.0,
 
 
 # ---------------------------------------------------------------------------
+# integrity (SDC) evidence: per-rank fingerprint blocks off the flight dumps
+# ---------------------------------------------------------------------------
+
+
+def analyze_integrity(dumps: Dict[int, dict]) -> Optional[dict]:
+    """Join the per-rank ``integrity`` blocks (``IntegrityMonitor.snapshot``
+    riding each flight dump) into one corruption timeline: the first
+    divergent fingerprint step, the minority rank(s) the cross-rank vote
+    named, the replay verdict(s) (transient / sticky), and any quarantines.
+
+    Two evidence sources, merged by step: divergences the live monitors
+    recorded (each carries the full ``rank -> fp`` signature set it read
+    from the store), and — when the run died before any monitor compared —
+    the doctor's OWN vote over the ranks' last published fingerprints."""
+    blocks = {r: doc.get("integrity") for r, doc in dumps.items()
+              if isinstance(doc.get("integrity"), dict)}
+    if not blocks:
+        return None
+    by_step: Dict[int, dict] = {}
+    for r, blk in sorted(blocks.items()):
+        for div in blk.get("divergences") or []:
+            step = div.get("step")
+            if step is None:
+                continue
+            row = by_step.setdefault(int(step), {
+                "sigs": {}, "minority": set(), "verdicts": set()})
+            for rk, fp in (div.get("sigs") or {}).items():
+                row["sigs"][str(rk)] = fp
+            row["minority"].update(int(x) for x in div.get("minority") or [])
+            if div.get("verdict"):
+                row["verdicts"].add(str(div["verdict"]))
+    last_by_step: Dict[int, Dict[int, str]] = {}
+    for r, blk in sorted(blocks.items()):
+        if blk.get("last_fp") and blk.get("last_fp_step") is not None:
+            last_by_step.setdefault(int(blk["last_fp_step"]), {})[r] = \
+                blk["last_fp"]
+    for step, sigs in sorted(last_by_step.items()):
+        if (step in by_step or len(sigs) < 2
+                or len(set(sigs.values())) == 1):
+            continue
+        freq: Dict[str, int] = {}
+        for s in sigs.values():
+            freq[s] = freq.get(s, 0) + 1
+        maj = max(freq, key=lambda k: freq[k])
+        minority = (sorted(r for r, s in sigs.items() if s != maj)
+                    if freq[maj] > len(sigs) - freq[maj] else sorted(sigs))
+        by_step[step] = {"sigs": {str(r): s for r, s in sigs.items()},
+                         "minority": set(minority),
+                         "verdicts": {"unreported"}}
+    quarantined = sorted({int(x) for blk in blocks.values()
+                          for x in blk.get("quarantined") or []})
+    if not by_step and not quarantined:
+        return None
+    rows = [{"step": step, "sigs": by_step[step]["sigs"],
+             "minority": sorted(by_step[step]["minority"]),
+             "verdicts": sorted(by_step[step]["verdicts"])}
+            for step in sorted(by_step)]
+    return {
+        "ranks": sorted(blocks),
+        "divergences": rows,
+        "first_divergent_step": rows[0]["step"] if rows else None,
+        "minority_ranks": sorted({r for row in rows
+                                  for r in row["minority"]}),
+        "verdicts": sorted({v for row in rows for v in row["verdicts"]}),
+        "quarantined": quarantined,
+    }
+
+
+# ---------------------------------------------------------------------------
 # diagnosis
 # ---------------------------------------------------------------------------
 
@@ -438,9 +507,10 @@ def diagnose(directory: str, *, world: Optional[int] = None,
                                            e.get("seq", 0)))
 
     audit = load_audit_report(directory)
+    integrity = analyze_integrity(dumps)
     verdict, evidence = _classify(dumps, missing, desync, plan_mismatch,
                                   health, phases, expected, hangs,
-                                  audit=audit)
+                                  audit=audit, integrity=integrity)
     acted = [a for a in supervisor_actions
              if (a.get("outcome") or "ok") == "ok"]
     if acted:
@@ -491,6 +561,7 @@ def diagnose(directory: str, *, world: Optional[int] = None,
         "health": health,
         "phases": phases,
         "audit": audit,
+        "integrity": integrity,
         "chaos": chaos,
         "supervisor_actions": supervisor_actions,
         "verdict": verdict,
@@ -549,9 +620,11 @@ def load_audit_report(directory: str) -> Optional[dict]:
 
 
 def _classify(dumps, missing, desync, plan_mismatch, health, phases,
-              expected, hangs=None, audit=None) -> Tuple[str, List[str]]:
+              expected, hangs=None, audit=None,
+              integrity=None) -> Tuple[str, List[str]]:
     """The decision tree (docs/observability.md reproduces it): desync
-    beats dead-host beats straggler beats genuine-hang beats crash."""
+    beats sdc beats dead-host beats straggler beats genuine-hang beats
+    crash."""
     evidence: List[str] = []
     reasons = {doc.get("reason") for doc in dumps.values()}
     if desync is not None:
@@ -594,6 +667,25 @@ def _classify(dumps, missing, desync, plan_mismatch, health, phases,
             "plan than their peers (plans are rank-0-broadcast: this alone "
             "desynchronizes the fleet)")
         return "desync", evidence
+    if integrity and integrity.get("divergences"):
+        who = integrity.get("minority_ranks") or []
+        vs = ", ".join(integrity.get("verdicts") or []) or "unclassified"
+        evidence.append(
+            "cross-rank state fingerprints diverge first at step "
+            f"{integrity['first_divergent_step']}"
+            + (f" — minority rank(s) {who} hold(s) the corrupt state"
+               if who else " — no localizable minority (tie / 2-rank world)")
+            + f"; shadow-replay verdict(s): {vs}")
+        if integrity.get("quarantined"):
+            evidence.append(
+                f"rank(s) {integrity['quarantined']} quarantined by the "
+                "control supervisor (see the sdc_quarantine action line)")
+        if dumps:
+            evidence.append(
+                "collective streams are CONSISTENT across ranks — the "
+                "corruption is in replicated DATA (silent data corruption),"
+                " not in control flow")
+        return "sdc", evidence
     dead = set(health["dead"]) | set(missing)
     if dead:
         if missing:
@@ -689,6 +781,13 @@ def render_report(report: dict) -> str:
             f"static audit ({a.get('label')}): {c.get('error', 0)} error / "
             f"{c.get('warning', 0)} warning; "
             f"{len(a.get('unplanned') or [])} unplanned collective(s)")
+    ig = report.get("integrity")
+    if ig:
+        lines.append(
+            f"integrity: first fingerprint divergence at step "
+            f"{ig.get('first_divergent_step')}; minority rank(s) "
+            f"{ig.get('minority_ranks')}; verdict(s) {ig.get('verdicts')}; "
+            f"quarantined {ig.get('quarantined')}")
     ch = report.get("chaos")
     if ch:
         kinds = sorted({e.get("kind") for e in ch.get("fired") or []})

@@ -512,6 +512,14 @@ class FaultInjectionConfig(ConfigModel):
     slow_rank: Optional[int] = None         # steady straggler rank
     slow_step_s: float = 0.25               # per-step sleep on slow_rank
     heartbeat_loss_at_steps: List[int] = field(default_factory=list)
+    # silent-data-corruption drills (chaos classes sdc_bitflip_transient /
+    # sdc_bitflip_sticky): flip ``sdc_bit`` of one param element on rank
+    # ``sdc_rank`` — once at each listed step (transient) or on every step
+    # from ``sdc_sticky_from_step`` (sticky host)
+    sdc_transient_at_steps: List[int] = field(default_factory=list)
+    sdc_sticky_from_step: Optional[int] = None
+    sdc_rank: int = -1                      # -1 = every rank (single-rank tests)
+    sdc_bit: int = 17                       # bit index flipped in the leaf
 
 
 @register_config
@@ -587,6 +595,28 @@ class DegradedModeConfig(ConfigModel):
 
 @register_config
 @dataclass
+class IntegrityConfig(ConfigModel):
+    """Silent-corruption integrity tier
+    (``runtime/resilience/integrity.py``, see ``docs/fleet_robustness.md``):
+    periodic cross-rank fingerprints of DP-replicated state, shadow-step
+    replay to call transient-vs-sticky, verified snapshot stamping, and SDC
+    quarantine through the control supervisor's ``integrity`` rule.
+    Disabled by default — nothing is constructed and stepping is bitwise
+    identical to a tree without the subsystem."""
+    enabled: bool = False
+    interval_steps: int = 32        # fingerprint cadence (detection latency)
+    chunks: int = 8                 # digest words; more = finer localization
+    shadow_replay: bool = True      # replay-classify divergences
+    resolve_timeout_steps: int = 8  # quorum / peer-verdict wait, in steps
+    dir: Optional[str] = None       # fp exchange dir; default <snapshot_dir>/integrity
+    rank: int = -1                  # -1 = engine artifact rank
+    world: int = 0                  # voters expected; <2 = detect-only (no vote)
+    quarantine: bool = True         # demote/replan around a sticky minority
+    rollback: bool = True           # roll back to newest VERIFIED snapshot
+
+
+@register_config
+@dataclass
 class ResilienceConfig(ConfigModel):
     """Resilience subsystem (``runtime/resilience/``): async snapshots,
     divergence sentinel with rollback, preemption drain, restore-on-restart.
@@ -606,6 +636,7 @@ class ResilienceConfig(ConfigModel):
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     heartbeat: HeartbeatConfig = field(default_factory=HeartbeatConfig)
     degraded_mode: DegradedModeConfig = field(default_factory=DegradedModeConfig)
+    integrity: IntegrityConfig = field(default_factory=IntegrityConfig)
 
 
 @register_config
@@ -740,6 +771,7 @@ class ControlSupervisorConfig(ConfigModel):
     rollback_degrade: bool = True
     rollback_threshold: int = 2
     rollback_window_s: float = 600.0
+    integrity_guard: bool = True      # act on fingerprint-divergence verdicts
 
 
 @register_config
@@ -811,6 +843,17 @@ class ServingConfig(ConfigModel):
     # and the control-plane shed door scales per class (low classes shed
     # first). None = tenancy off (single-tenant behavior unchanged).
     tenancy: Optional[Dict[str, Any]] = None
+    # integrity canary probe (ISSUE 20, see docs/fleet_robustness.md):
+    # every ``canary_interval_steps`` engine steps the replica runs a
+    # seeded greedy canary request through its own admission path and
+    # hashes the generated tokens. A hash that differs from the recorded
+    # expectation (``canary_expect``, or the first probe's result when
+    # unset) marks the replica failed via the router health path — a
+    # replica that silently computes wrong bits stops taking traffic.
+    canary_interval_steps: int = 0       # 0 = canary off
+    canary_prompt: List[int] = field(default_factory=lambda: [3, 1, 4, 1, 5])
+    canary_max_tokens: int = 8
+    canary_expect: Optional[str] = None  # known-good token hash (hex)
     engine: Dict[str, Any] = field(default_factory=dict)
 
 

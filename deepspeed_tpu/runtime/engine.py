@@ -1300,6 +1300,26 @@ class DeepSpeedTPUEngine:
             metrics=self._metrics_host)
         return out
 
+    def state_fingerprint(self, chunks: int = 8) -> str:
+        """Hex digest of the full TrainState (params + optimizer state) via
+        the integrity tier's jitted fingerprint kernel
+        (``runtime/resilience/integrity.py``). DP-replicated state must
+        agree BITWISE across ranks, so equal digests mean equal state.
+        This is the synchronous forensic entry point for drills, tests,
+        and operator debugging — the ``resilience.integrity:`` block runs
+        the same kernel on a cadence with a one-step-delayed fetch
+        instead, keeping the hot path sync-free."""
+        from .resilience.integrity import (fingerprint_hex,
+                                           make_fingerprint_fn)
+
+        fns = getattr(self, "_fp_fns", None)
+        if fns is None:
+            fns = self._fp_fns = {}
+        fn = fns.get(chunks)
+        if fn is None:
+            fn = fns[chunks] = make_fingerprint_fn(chunks)
+        return fingerprint_hex(np.asarray(fn(self.state)))
+
     def _train_batch_inner(self, batch):
         """The body of ``train_batch`` from batch shaping through the
         resilience post-step hook; runs with the step watchdog armed when
@@ -1319,12 +1339,17 @@ class DeepSpeedTPUEngine:
             ltd_keep = self.random_ltd_scheduler.update(self.global_steps)
         self._last_batch = batch  # reference only; sliced lazily by flops_profile
         self._rng, step_rng = jax.random.split(self._rng)
+        # the integrity tier's shadow-step replay re-executes THIS step from
+        # a retained pre-step state; the exact rng and step-fn cache key are
+        # the rest of the recipe (runtime/resilience/integrity.py)
+        self._last_step_rng = step_rng
         moq_bits = self.moq.update(self.global_steps) if self.moq else None
         if moq_bits is not None and moq_bits >= 16:
             moq_bits = None  # schedule_offset warmup: unquantized program
         executing_step = self.global_steps  # pre-increment: the N every
         # other post-mortem surface (spans, flight ring, watchdog) stamps
         key = (ltd_keep, moq_bits)
+        self._last_step_key = key
         step_fn = self._train_steps.get(key)
         if step_fn is None:
             step_fn = self._train_steps[key] = self._make_train_step(

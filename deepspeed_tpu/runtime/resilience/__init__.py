@@ -29,6 +29,11 @@ the same reason — production training happens on preemptible capacity):
   restart instead of an eternal silent stall.
 - :mod:`heartbeat` — per-host beacons in a shared dir; readers derive
   dead-host and straggler verdicts (step-time vs fleet median).
+- :mod:`integrity` — silent-corruption tier: cadenced cross-rank
+  fingerprints of DP-replicated state (bitwise-equal by construction, so
+  any divergence is corruption), shadow-step replay to call transient vs
+  sticky SDC, verified snapshot stamping, and quarantine verdicts for the
+  control supervisor's ``integrity`` rule.
 
 Everything is gated behind the ``resilience:`` config block; with it off
 (the default) no hook exists and engine stepping is bit-identical.
@@ -39,6 +44,8 @@ from .chaos import (FAULT_CLASSES, ChaosEvent, ChaosInjectedError,
 from .faults import FaultPlan, InjectedCrash
 from .heartbeat import (FileHeartbeatTransport, HealthTable, HeartbeatWriter,
                         HostHealth, ObjectStoreHeartbeatTransport)
+from .integrity import (FingerprintStore, IntegrityMonitor, fingerprint_hex,
+                        flip_bit, make_fingerprint_fn)
 from .preempt import PreemptionWatcher
 from .sentinel import Sentinel, SentinelEvent, SentinelHalt
 from .snapshot import SnapshotManager
@@ -52,4 +59,6 @@ __all__ = ["SnapshotManager", "Sentinel", "SentinelEvent", "SentinelHalt",
            "HealthTable", "HostHealth", "FileHeartbeatTransport",
            "ObjectStoreHeartbeatTransport",
            "ChaosSchedule", "ChaosEvent", "ChaosInjectedError",
-           "FAULT_CLASSES", "configure_chaos", "get_chaos", "chaos_active"]
+           "FAULT_CLASSES", "configure_chaos", "get_chaos", "chaos_active",
+           "IntegrityMonitor", "FingerprintStore", "make_fingerprint_fn",
+           "fingerprint_hex", "flip_bit"]

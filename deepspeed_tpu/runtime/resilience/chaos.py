@@ -88,6 +88,12 @@ FAULT_CLASSES: Dict[str, str] = {
     "replica_slow_warm": "serving",
     "stale_health": "control",
     "flap_straggler": "control",
+    # silent-data-corruption drills: ride the nested training FaultPlan
+    # (sdc_transient_at_steps / sdc_sticky_from_step), not poll() sites —
+    # registered here so the taxonomy, manifest validation, and the
+    # doctor's named-fault evidence cover them like every other class
+    "sdc_bitflip_transient": "training",
+    "sdc_bitflip_sticky": "training",
 }
 
 #: per-class defaults for seeded generation: (count, param)
@@ -327,7 +333,8 @@ def _training_identity(plan: Optional[FaultPlan]):
             plan.spike_magnitude, plan.preempt_at_step,
             plan.torn_write_at_steps, plan.crash_before_commit_at_steps,
             plan.hang_at_step, plan.slow_rank, plan.slow_step_s,
-            plan.heartbeat_loss_at_steps)
+            plan.heartbeat_loss_at_steps, plan.sdc_transient_at_steps,
+            plan.sdc_sticky_from_step, plan.sdc_rank, plan.sdc_bit)
 
 
 def install_chaos_from_config(cfg) -> ChaosSchedule:

@@ -22,7 +22,13 @@ event so the suite can prove end-to-end recovery:
   steady straggler the heartbeat table must call out);
 - ``heartbeat_loss_at_steps`` — the host's beacon write is suppressed at
   those steps (peers must derive a dead-host verdict once the beacon ages
-  past the threshold).
+  past the threshold);
+- ``sdc_transient_at_steps`` / ``sdc_sticky_from_step`` — a seeded bit
+  flip in ``sdc_rank``'s params (one-shot at the listed steps, or on EVERY
+  step from the sticky threshold — a broken host stays broken); the
+  integrity tier's cross-rank fingerprints must detect it, the shadow
+  replay must call transient vs sticky, and the supervisor must quarantine
+  (chaos classes ``sdc_bitflip_transient`` / ``sdc_bitflip_sticky``).
 
 Loss/grad injections rewrite the *observed* metrics fed to the sentinel,
 not the device state — the rollback that follows is the real code path
@@ -63,6 +69,10 @@ class FaultPlan:
     slow_rank: Optional[int] = None
     slow_step_s: float = 0.25
     heartbeat_loss_at_steps: Tuple[int, ...] = ()
+    sdc_transient_at_steps: Tuple[int, ...] = ()
+    sdc_sticky_from_step: Optional[int] = None
+    sdc_rank: int = -1
+    sdc_bit: int = 17
 
     fired: list = field(default_factory=list)  # (step, kind) audit trail
     _spent: Set[Tuple[int, str]] = field(default_factory=set)
@@ -84,6 +94,11 @@ class FaultPlan:
             slow_step_s=float(getattr(cfg, "slow_step_s", 0.25)),
             heartbeat_loss_at_steps=_steps(
                 getattr(cfg, "heartbeat_loss_at_steps", ())),
+            sdc_transient_at_steps=_steps(
+                getattr(cfg, "sdc_transient_at_steps", ())),
+            sdc_sticky_from_step=getattr(cfg, "sdc_sticky_from_step", None),
+            sdc_rank=int(getattr(cfg, "sdc_rank", -1)),
+            sdc_bit=int(getattr(cfg, "sdc_bit", 17)),
         )
 
     def _fire(self, step: int, kind: str, scheduled) -> bool:
@@ -123,6 +138,31 @@ class FaultPlan:
             self._spent.add(("slow", "slow"))
             self.fired.append((step, "slow"))
         return float(self.slow_step_s)
+
+    def _sdc_rank_match(self, rank: int) -> bool:
+        return self.sdc_rank < 0 or int(rank) == int(self.sdc_rank)
+
+    def sdc_transient_now(self, step: int, rank: int) -> bool:
+        """One-shot bit flip in this rank's post-step state (chaos class
+        ``sdc_bitflip_transient``): the hardware glitched once; the flipped
+        bit persists in params until a rollback heals it."""
+        return self._sdc_rank_match(rank) and self._fire(
+            step, "sdc_bitflip_transient", self.sdc_transient_at_steps)
+
+    def sdc_sticky_now(self, step: int, rank: int) -> bool:
+        """Sticky-host SDC (chaos class ``sdc_bitflip_sticky``): from the
+        scheduled step onward EVERY step on ``sdc_rank`` computes a flipped
+        bit. Deliberately NOT one-shot — a broken ALU stays broken and a
+        shadow replay on the same host must reproduce the corruption (the
+        sticky verdict); only the first firing is audited."""
+        if (self.sdc_sticky_from_step is None
+                or not self._sdc_rank_match(rank)
+                or int(step) < int(self.sdc_sticky_from_step)):
+            return False
+        if ("sdc_sticky", "sdc_sticky") not in self._spent:
+            self._spent.add(("sdc_sticky", "sdc_sticky"))
+            self.fired.append((step, "sdc_bitflip_sticky"))
+        return True
 
     def heartbeat_lost(self, step: int) -> bool:
         """One-shot per scheduled step: suppress this step's beacon write."""

@@ -1,0 +1,576 @@
+"""Silent-corruption integrity tier: fingerprints, replay, quarantine.
+
+Every failure the resilience stack handles elsewhere is *loud* — crashes,
+hangs, stragglers, torn writes. A host that computes wrong bits without
+crashing is worse: the corruption lands in replicated params, gets
+snapshotted as "valid", and poisons every later restore. This module is
+the detection tier for that failure class (SDC — silent data corruption),
+default-off behind ``resilience.integrity:`` and bitwise invisible when
+off.
+
+Three mechanisms, cheapest first:
+
+1. **cross-rank fingerprints** — every ``interval_steps`` the engine's
+   DP-replicated state is folded to a tiny ``uint32[chunks]`` digest by a
+   jitted, position-weighted modular reduction (:func:`make_fingerprint_fn`).
+   Replicated leaves MUST be bitwise identical across data-parallel ranks,
+   so ANY digest divergence is corruption (or lost determinism — equally
+   fatal). The digest stays on device at issue time and is fetched one step
+   later (the PR 4 sentinel-metrics contract), so the hot path never
+   host-syncs. Ranks exchange digests through a :class:`FingerprintStore`
+   (shared-dir JSON, the heartbeat-transport idiom) and a doctor-style
+   majority vote names the minority rank.
+2. **shadow-step replay** — on divergence (or a periodic audit cadence) the
+   last fingerprinted step is re-executed from the retained pre-step state,
+   optionally on a rotated device, and re-fingerprinted. A replay that
+   matches the majority means the live execution suffered a one-shot flip
+   (``transient``); a replay that still diverges means the corruption is in
+   the input state or the host computes wrong repeatedly (``sticky``) —
+   that host gets quarantined, not retried.
+3. **verified snapshots** — :class:`IntegrityMonitor.snapshot_stamp` is the
+   commit-time callable the :class:`~.snapshot.SnapshotManager` consults:
+   manifest entries gain ``{"fingerprint": ..., "verified": bool}`` and a
+   snapshot taken inside the taint window (divergence detected but not yet
+   rolled back) — or after the last known-clean fingerprint step once a
+   divergence IS known — is never stamped verified, so
+   ``latest_valid(prefer_verified=True)`` cannot resurrect poisoned state.
+
+Actuation is NOT here: the monitor only *publishes* verdicts
+(:meth:`IntegrityMonitor.pending_verdicts`); the flap-guarded control
+supervisor's ``integrity`` rule (``control/policy.py``) decides rollback /
+quarantine, so SDC response obeys the same hysteresis, cooldown, and
+budget as every other automated action.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.fs import fsync_write_json
+from ...utils.logging import log_dist, logger
+
+__all__ = ["make_fingerprint_fn", "fingerprint_hex", "flip_bit",
+           "FingerprintStore", "IntegrityMonitor"]
+
+# multiplier folding per-leaf digests into the running chunk accumulator;
+# odd (invertible mod 2^32) so no leaf's contribution can be erased
+_FOLD = np.uint32(1000003)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint kernel
+# ---------------------------------------------------------------------------
+
+def _leaf_digest(x: jnp.ndarray, chunks: int) -> jnp.ndarray:
+    """``uint32[chunks]`` position-weighted modular digest of one leaf.
+
+    The leaf is bitcast to a matching-width unsigned int (so the digest
+    sees the exact bit pattern, not float semantics — ``-0.0`` vs ``0.0``
+    and NaN payloads all count), widened to uint32, padded to a multiple of
+    ``chunks``, and reduced per chunk as ``sum(w_i * v_i) mod 2^32`` with
+    odd weights ``w_i = 2*i + 1``. An odd weight times any nonzero delta is
+    nonzero mod 2^32, so every single-bit flip anywhere in the leaf changes
+    its chunk's digest — the property the whole tier rests on."""
+    if x.dtype == jnp.bool_:
+        u = x.astype(jnp.uint32)
+    elif jnp.issubdtype(x.dtype, jnp.integer) or jnp.issubdtype(
+            x.dtype, jnp.floating):
+        nbits = x.dtype.itemsize * 8
+        u = jax.lax.bitcast_convert_type(
+            x, jnp.dtype(f"uint{nbits}")).astype(jnp.uint32)
+    else:  # complex etc.: view through float32 pairs is overkill; sum bits
+        u = jnp.abs(x).astype(jnp.uint32)
+    flat = u.reshape(-1)
+    n = flat.shape[0]
+    cols = -(-n // chunks)  # ceil
+    pad = chunks * cols - n
+    flat = jnp.pad(flat, (0, pad))
+    mat = flat.reshape(chunks, cols)
+    w = (jnp.arange(cols, dtype=jnp.uint32) * jnp.uint32(2)
+         + jnp.uint32(1))
+    return jnp.sum(mat * w[None, :], axis=1, dtype=jnp.uint32)
+
+
+def make_fingerprint_fn(chunks: int = 8) -> Callable[[Any], jnp.ndarray]:
+    """Jitted ``pytree -> uint32[chunks]`` digest (device-resident result).
+
+    Call it, keep the device array, and fetch it a step later — issuing is
+    async like any other jitted computation, so the hot path pays only the
+    dispatch."""
+
+    def fp(tree) -> jnp.ndarray:
+        acc = jnp.zeros((chunks,), jnp.uint32)
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if not hasattr(leaf, "dtype") or leaf.size == 0:
+                continue
+            acc = acc * _FOLD + _leaf_digest(jnp.asarray(leaf), chunks)
+        return acc
+
+    return jax.jit(fp)
+
+
+def fingerprint_hex(fp_host: np.ndarray) -> str:
+    """Canonical wire form of a fetched digest (8 hex chars per chunk)."""
+    return "".join(f"{int(v):08x}" for v in np.asarray(fp_host, np.uint32))
+
+
+def flip_bit(tree, *, bit: int = 17, leaf_index: int = 0):
+    """Flip one bit of one element of the ``leaf_index``-th array leaf —
+    the seeded SDC the chaos classes inject and the drills assert on.
+    Pure function of the tree; returns a new tree."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrays = [i for i, l in enumerate(leaves)
+              if hasattr(l, "dtype") and getattr(l, "size", 0)
+              and jnp.issubdtype(l.dtype, jnp.floating)]
+    if not arrays:
+        return tree
+    i = arrays[leaf_index % len(arrays)]
+    leaf = leaves[i]
+    nbits = leaf.dtype.itemsize * 8
+    udt = jnp.dtype(f"uint{nbits}")
+    flat = jax.lax.bitcast_convert_type(leaf, udt).reshape(-1)
+    mask = jnp.zeros_like(flat).at[0].set(
+        jnp.asarray(1 << (bit % nbits), udt))
+    flipped = jax.lax.bitcast_convert_type(
+        (flat ^ mask).reshape(leaf.shape), leaf.dtype)
+    leaves[i] = flipped
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# cross-rank exchange
+# ---------------------------------------------------------------------------
+
+class FingerprintStore:
+    """Shared-directory fingerprint exchange: one ``fp-<rank>.json`` per
+    rank (atomic replace, bounded history), readable by every peer and by
+    the doctor. The object-store heartbeat idiom, minus the liveness
+    semantics: records are append-mostly and re-published only to attach a
+    replay verdict."""
+
+    KEEP = 64  # records retained per rank file
+
+    def __init__(self, root: str, rank: int, world: int):
+        self.root = root
+        self.rank = int(rank)
+        self.world = int(world)
+        self._records: Dict[int, dict] = {}  # own records by step
+        self._lock = threading.Lock()
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, rank: int) -> str:
+        return os.path.join(self.root, f"fp-{rank}.json")
+
+    def publish(self, step: int, fp_hex: str, *,
+                verdict: Optional[str] = None) -> None:
+        """Write (or revise, when attaching a verdict) our step record."""
+        with self._lock:
+            rec = self._records.setdefault(
+                int(step), {"step": int(step), "fp": fp_hex})
+            rec["fp"] = fp_hex
+            if verdict is not None:
+                rec["verdict"] = verdict
+            keep = sorted(self._records)[-self.KEEP:]
+            self._records = {s: self._records[s] for s in keep}
+            body = {"rank": self.rank, "world": self.world,
+                    "records": [self._records[s] for s in keep]}
+        try:
+            fsync_write_json(self._path(self.rank), body)
+        except OSError as e:  # a torn publish is a missed vote, not a crash
+            logger.warning(f"integrity: fingerprint publish failed: {e}")
+
+    def read(self, step: int) -> Dict[int, dict]:
+        """``rank -> record`` for every peer that has published ``step``."""
+        out: Dict[int, dict] = {}
+        for r in range(max(1, self.world)):
+            try:
+                with open(self._path(r)) as f:
+                    body = json.load(f)
+            except (OSError, ValueError):
+                continue
+            for rec in body.get("records", []):
+                if rec.get("step") == int(step):
+                    out[r] = rec
+                    break
+        return out
+
+
+def vote(sigs: Dict[int, str]) -> Tuple[Optional[str], List[int]]:
+    """Doctor-style majority vote over ``rank -> fp``: returns
+    ``(majority_fp or None, minority_ranks)``. No strict majority (a tie,
+    or a single rank) yields ``(None, [])`` — corruption cannot be
+    localized without a quorum, only detected."""
+    if len(sigs) < 2:
+        return None, []
+    freq: Dict[str, int] = {}
+    for s in sigs.values():
+        freq[s] = freq.get(s, 0) + 1
+    majority = max(freq, key=lambda k: freq[k])
+    if freq[majority] <= len(sigs) - freq[majority]:
+        return None, []
+    return majority, sorted(r for r, s in sigs.items() if s != majority)
+
+
+# ---------------------------------------------------------------------------
+# the monitor
+# ---------------------------------------------------------------------------
+
+class IntegrityMonitor:
+    """Owned by :class:`~.supervisor.ResilienceManager`; all hooks run on
+    the training thread. Detection only — verdicts are queued for the
+    control supervisor's ``integrity`` rule, and the snapshot stamp is a
+    pure read of the taint state."""
+
+    def __init__(self, engine, cfg, *, store: Optional[FingerprintStore] = None,
+                 emit: Optional[Callable[[dict], None]] = None,
+                 replay_corrupt_fn: Optional[Callable] = None):
+        self.engine = engine
+        self.cfg = cfg
+        ar = getattr(engine, "artifact_rank", 0)
+        self.rank = (int(cfg.rank) if int(cfg.rank) >= 0
+                     else int(ar() if callable(ar) else (ar or 0)))
+        self.world = int(cfg.world)
+        root = cfg.dir
+        if not root:
+            base = getattr(getattr(engine, "resilience", None),
+                           "snapshot_dir", None) or "."
+            root = os.path.join(base, "integrity")
+        self.store = store or FingerprintStore(root, self.rank, self.world)
+        self._emit = emit or (lambda ev: None)
+        self._replay_corrupt_fn = replay_corrupt_fn
+        self._fp_fn = make_fingerprint_fn(int(cfg.chunks))
+        # pending device digest awaiting its one-step-delayed fetch:
+        # (step, device uint32[chunks])
+        self._pending: Optional[Tuple[int, Any]] = None
+        # steps published but not yet quorum-compared: step -> publish step_i
+        self._unresolved: Dict[int, int] = {}
+        # divergences awaiting the minority rank's replay verdict
+        self._unclassified: Dict[int, dict] = {}
+        self._verdicts: List[dict] = []   # drained by the control rule
+        self.divergences: List[dict] = []  # full history (flight dumps)
+        self._recipes: Dict[int, dict] = {}  # step -> replay recipe
+        self._steps_seen = 0
+        self.last_fp: Optional[str] = None
+        self.last_fp_step: Optional[int] = None
+        self.last_clean_step: Optional[int] = None
+        self.tainted_since: Optional[int] = None
+        self.checks = 0
+        self.replays = 0
+        self.quarantined: List[int] = []  # ranks the supervisor demoted
+        # True from the moment a divergence is DETECTED until a rollback
+        # restores verified state: the window in which a committed
+        # snapshot may hold corruption newer than the last clean
+        # fingerprint and must not be stamped verified
+        self._dirty = False
+        self._counters = self._bind_counters()
+
+    # -- wiring ---------------------------------------------------------
+    def _bind_counters(self):
+        try:
+            from ...telemetry import get_registry, telemetry_active
+
+            if telemetry_active():
+                reg = get_registry()
+                return {
+                    "checks": reg.counter(
+                        "dstpu_integrity_checks_total",
+                        "cross-rank fingerprint comparisons performed"),
+                    "divergence": reg.counter(
+                        "dstpu_integrity_divergence_total",
+                        "fingerprint divergences detected"),
+                    "replays": reg.counter(
+                        "dstpu_integrity_replays_total",
+                        "shadow-step replays executed"),
+                }
+        except Exception:
+            pass  # swallow-ok: telemetry is optional; detection must not depend on it
+        return {}
+
+    def _count(self, key: str, **labels) -> None:
+        c = self._counters.get(key)
+        if c is not None:
+            try:
+                c.inc(**labels) if labels else c.inc()
+            except TypeError:
+                c.inc()
+
+    # -- cadence --------------------------------------------------------
+    def due(self, step: int) -> bool:
+        n = max(1, int(self.cfg.interval_steps))
+        return step % n == 0
+
+    @property
+    def tainted(self) -> bool:
+        return self.tainted_since is not None
+
+    # -- hooks ----------------------------------------------------------
+    def pre_step(self, step: int) -> None:
+        """Retain a pre-step state copy when ``step`` will be fingerprinted
+        — the replay recipe's input. One live retention at a time (plus any
+        pinned by an unresolved divergence); the copy is device-resident
+        and freed as soon as its step resolves clean."""
+        if not self.due(step):
+            return
+        try:
+            pre = jax.tree_util.tree_map(
+                lambda x: jnp.copy(x) if hasattr(x, "dtype") else x,
+                self.engine.state)
+        except Exception as e:
+            logger.warning(f"integrity: pre-step retention failed: {e}")
+            return
+        self._recipes[step] = {"pre_state": pre}
+        self._gc_recipes(keep=step)
+
+    def post_step(self, step: int) -> None:
+        """Called once per executed step ``step`` (post-state is live in
+        ``engine.state``): harvest last round's digest, re-poll unresolved
+        votes, and issue this round's digest if due. Only the harvest
+        touches the host, and only for a ``chunks``-word array issued a
+        full step earlier."""
+        self._steps_seen += 1
+        self._harvest()
+        self._poll_unresolved()
+        if self.due(step):
+            try:
+                dev = self._fp_fn(self.engine.state)
+                if hasattr(dev, "copy_to_host_async"):
+                    dev.copy_to_host_async()
+                self._pending = (step, dev)
+            except Exception as e:
+                logger.warning(f"integrity: fingerprint issue failed: {e}")
+                self._pending = None
+            rec = self._recipes.get(step)
+            if rec is not None:
+                rec["batch"] = getattr(self.engine, "_last_batch", None)
+                rec["rng"] = getattr(self.engine, "_last_step_rng", None)
+                rec["key"] = getattr(self.engine, "_last_step_key", None)
+
+    def note_rollback(self, step: int) -> None:
+        """The actuation that ends a taint window: state was restored from
+        a verified snapshot, so divergence bookkeeping resets."""
+        if self.tainted:
+            self._emit({"Train/Integrity/rollback_clear": step})
+        self.tainted_since = None
+        self._dirty = False
+        self._unclassified.clear()
+        self._verdicts.clear()
+        self._recipes.clear()
+        self._pending = None
+        self._unresolved.clear()
+
+    # -- verdict queue (control rule reads) -----------------------------
+    def pending_verdicts(self) -> List[dict]:
+        return list(self._verdicts)
+
+    def drain_verdicts(self) -> List[dict]:
+        out, self._verdicts = self._verdicts, []
+        return out
+
+    # -- snapshot stamping ----------------------------------------------
+    def snapshot_stamp(self, step: int) -> dict:
+        """Commit-time integrity stamp for a snapshot of post-``step``
+        state. NOT verified when (a) a divergence is live (taint window),
+        (b) a vote for some step <= ``step`` is still unresolved (the
+        snapshot may hold exactly the corruption we have not finished
+        checking), or (c) we have diverged before and ``step`` is past the
+        last known-clean fingerprint."""
+        unresolved = [s for s in self._unresolved if s <= step]
+        unresolved += [s for s in self._unclassified if s <= step]
+        verified = not self.tainted and not unresolved
+        if verified and self._dirty:
+            # detected-but-not-yet-rolled-back: only steps at or before the
+            # last KNOWN-clean fingerprint may still be stamped (the
+            # corruption may predate its detection by up to an interval)
+            verified = (self.last_clean_step is not None
+                        and step <= self.last_clean_step)
+        if verified and self.rank in self.quarantined:
+            # a quarantined rank no longer votes, so its own digests can
+            # never be re-proven clean — nothing it writes is verified
+            verified = False
+        return {"fingerprint": self.last_fp, "fingerprint_step":
+                self.last_fp_step, "verified": bool(verified)}
+
+    # -- internals ------------------------------------------------------
+    def _harvest(self) -> None:
+        if self._pending is None:
+            return
+        step, dev = self._pending
+        self._pending = None
+        try:
+            host = np.asarray(dev)  # sync-ok: one-step-delayed 8-word digest fetch, the sentinel-metrics contract
+        except Exception as e:
+            logger.warning(f"integrity: fingerprint fetch failed: {e}")
+            return
+        fp = fingerprint_hex(host)
+        self.last_fp, self.last_fp_step = fp, step
+        self._emit({"Train/Integrity/fingerprint_step": step})
+        if self.world >= 2:
+            self.store.publish(step, fp)
+            self._unresolved[step] = self._steps_seen
+        else:
+            # single-rank world: nothing to vote against; the digest still
+            # rides snapshots and flight dumps as forensic evidence
+            self.last_clean_step = step
+            self._recipes.pop(step, None)
+
+    def _poll_unresolved(self) -> None:
+        # quarantined ranks' fingerprints no longer count: a demoted host's
+        # stale (or still-corrupt) store records must not re-taint the
+        # survivors replaying steps after the post-quarantine rollback
+        quarantined = set(self.quarantined)
+        eff_world = sum(1 for r in range(max(1, self.world))
+                        if r not in quarantined)
+        for step in sorted(self._unresolved):
+            sigs = {r: rec for r, rec in self.store.read(step).items()
+                    if r not in quarantined}
+            timeout = (self._steps_seen - self._unresolved[step]
+                       >= max(1, int(self.cfg.resolve_timeout_steps)))
+            if len(sigs) < eff_world and not (timeout and len(sigs) >= 2):
+                continue
+            del self._unresolved[step]
+            if len(sigs) < 2:
+                # nobody left to vote against (quarantine shrank the
+                # electorate): the digest stays forensic evidence only
+                self.last_clean_step = step
+                self._recipes.pop(step, None)
+                continue
+            self._compare(step, sigs)
+        for step in sorted(self._unclassified):
+            self._classify_peer(step)
+
+    def _compare(self, step: int, recs: Dict[int, dict]) -> None:
+        self.checks += 1
+        self._count("checks")
+        sigs = {r: rec["fp"] for r, rec in recs.items()}
+        if len(set(sigs.values())) == 1:
+            self.last_clean_step = step
+            self._recipes.pop(step, None)
+            return
+        majority, minority = vote(sigs)
+        if majority is None or not minority:
+            # divergence without a localizable minority (tie / 2-world)
+            minority = sorted(sigs)
+            majority = None
+        div = {"step": step, "sigs": {str(r): s for r, s in sigs.items()},
+               "minority": minority, "majority_fp": majority,
+               "self_minority": self.rank in minority, "verdict": None}
+        self._count("divergence")
+        self._dirty = True
+        self.tainted_since = (step if self.tainted_since is None
+                              else min(self.tainted_since, step))
+        log_dist(f"integrity: fingerprint divergence at step {step}: "
+                 f"minority rank(s) {minority} vs {len(sigs)} voters")
+        self._emit({"Train/Integrity/divergence_step": step})
+        if div["self_minority"] and majority is not None:
+            div["verdict"] = self._replay_verdict(step, majority)
+            self.store.publish(step, sigs[self.rank],
+                              verdict=div["verdict"])
+            self._finish_divergence(div)
+        elif majority is not None:
+            # wait (bounded) for the minority rank's replay verdict
+            self._unclassified[step] = div
+            div["_deadline"] = self._steps_seen + max(
+                1, int(self.cfg.resolve_timeout_steps))
+            self._classify_peer(step)
+        else:
+            div["verdict"] = "unlocalized"
+            self._finish_divergence(div)
+
+    def _classify_peer(self, step: int) -> None:
+        div = self._unclassified.get(step)
+        if div is None:
+            return
+        recs = self.store.read(step)
+        for r in div["minority"]:
+            v = recs.get(r, {}).get("verdict")
+            if v:
+                div["verdict"] = v
+                break
+        else:
+            if self._steps_seen < div["_deadline"]:
+                return
+            # a host too corrupt to publish its own verdict is sticky
+            div["verdict"] = "sticky"
+        del self._unclassified[step]
+        div.pop("_deadline", None)
+        self._finish_divergence(div)
+
+    def _finish_divergence(self, div: dict) -> None:
+        self.divergences.append(div)
+        self._verdicts.append(div)
+        self._emit({"Train/Integrity/verdict": div})
+
+    def _replay_verdict(self, step: int, majority_fp: str) -> str:
+        """Shadow-step replay: re-execute ``step`` from the retained
+        pre-step state and bitwise-compare the digest with the majority.
+        Match -> the live run suffered a one-shot flip (``transient``);
+        mismatch -> the corruption is in the inputs or the host repeats it
+        (``sticky``). Best-effort: a replay that cannot run classifies
+        conservatively as sticky."""
+        if not self.cfg.shadow_replay:
+            return "sticky"
+        rec = self._recipes.get(step)
+        if not rec or rec.get("batch") is None or rec.get("rng") is None:
+            return "sticky"
+        try:
+            step_fn = self.engine._train_steps.get(rec.get("key"))
+            if step_fn is None:
+                return "sticky"
+            pre = self._rotate(rec["pre_state"])
+            out_state, _ = step_fn(pre, rec["batch"], rec["rng"])
+            if self._replay_corrupt_fn is not None:
+                out_state = self._replay_corrupt_fn(step, out_state)
+            self.replays += 1
+            self._count("replays")
+            host = np.asarray(self._fp_fn(out_state))  # sync-ok: off-hot-path divergence forensics, not the step loop
+            replay_fp = fingerprint_hex(host)
+            return "transient" if replay_fp == majority_fp else "sticky"
+        except Exception as e:
+            logger.warning(f"integrity: shadow replay failed: {e}")
+            return "sticky"
+
+    def _rotate(self, tree):
+        """Re-home the replay input on a different local device when the
+        state is single-device and the host has spares — a flip pinned to
+        one core then cannot reproduce. On sharded state this is a
+        documented no-op: rotation would need a cross-host reshard, and the
+        sticky/transient call falls back to pure re-execution."""
+        try:
+            devs = jax.local_devices()
+            if len(devs) < 2:
+                return tree
+            leaves = jax.tree_util.tree_leaves(tree)
+            homes = {d for l in leaves if hasattr(l, "devices")
+                     for d in l.devices()}
+            if len(homes) != 1:
+                return tree
+            (home,) = homes
+            alt = devs[(devs.index(home) + 1) % len(devs)]
+            return jax.device_put(tree, alt)
+        except Exception:
+            return tree  # swallow-ok: rotation is opportunistic; replay still classifies without it
+
+    def _gc_recipes(self, keep: int) -> None:
+        pinned = set(self._unresolved) | set(self._unclassified) | {keep}
+        for s in [s for s in self._recipes if s not in pinned]:
+            del self._recipes[s]
+
+    # -- forensic surfaces ----------------------------------------------
+    def snapshot(self) -> dict:
+        """Rides flight dumps (``extra["integrity"]``) and the doctor."""
+        return {"enabled": True, "rank": self.rank, "world": self.world,
+                "interval_steps": int(self.cfg.interval_steps),
+                "checks": self.checks, "replays": self.replays,
+                "last_fp": self.last_fp, "last_fp_step": self.last_fp_step,
+                "last_clean_step": self.last_clean_step,
+                "tainted_since": self.tainted_since,
+                "quarantined": list(self.quarantined),
+                "divergences": list(self.divergences[-16:])}

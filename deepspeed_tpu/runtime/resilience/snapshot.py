@@ -81,13 +81,20 @@ class SnapshotManager:
 
     def __init__(self, directory: str, keep: int = 2, use_async: bool = True,
                  shard_mb: int = 256,
-                 fault_hook: Optional[Callable[[str, int], Optional[str]]] = None):
+                 fault_hook: Optional[Callable[[str, int], Optional[str]]] = None,
+                 integrity_stamp: Optional[Callable[[int], dict]] = None):
         self.dir = os.path.abspath(directory)
         os.makedirs(self.dir, exist_ok=True)
         self.keep = max(1, int(keep))
         self.use_async = bool(use_async)
         self.shard_bytes = max(1, int(shard_mb)) << 20
         self.fault_hook = fault_hook
+        # commit-time integrity stamp (integrity.IntegrityMonitor
+        # .snapshot_stamp): consulted on the WRITER thread at manifest
+        # commit, so a divergence detected while the write was queued still
+        # denies the `verified` stamp. None (integrity off) leaves the
+        # manifest byte-identical to the pre-integrity format.
+        self.integrity_stamp = integrity_stamp
         self.stats: Dict[str, float] = {"snapshots": 0, "bytes": 0,
                                         "d2h_ms": 0.0, "write_ms": 0.0}
         self._err: Optional[BaseException] = None
@@ -261,6 +268,22 @@ class SnapshotManager:
 
         entry = {"tag": tag, "step": step, "meta": meta, "shards": shards,
                  "bytes": total, "wall_time": time.time()}
+        # the non-finite scan above catches loud divergence; this catches
+        # the SILENT kind — a fingerprint divergence detected but not yet
+        # rolled back must deny the `verified` stamp, or the corrupt state
+        # resurrects as the preferred restore target (ISSUE 20 bugfix)
+        if self.integrity_stamp is not None:
+            try:
+                stamp = dict(self.integrity_stamp(step) or {})
+            except Exception as e:
+                logger.warning(f"snapshot {tag}: integrity stamp failed: {e}")
+                stamp = {"verified": False, "error": str(e)}
+            entry["integrity"] = stamp
+            if not stamp.get("verified", False):
+                logger.warning(
+                    f"snapshot {tag}: committed UNVERIFIED (divergence "
+                    "detected or unresolved at commit time) — "
+                    "latest_valid() will prefer older verified entries")
         man = self.manifest()
         man["entries"] = [e for e in man.get("entries", [])
                           if e["tag"] != tag] + [entry]
@@ -305,10 +328,40 @@ class SnapshotManager:
                 return False
         return True
 
-    def latest_valid(self) -> Optional[dict]:
-        """Newest manifest entry whose shards all exist and hash clean."""
-        for entry in reversed(self.manifest().get("entries", [])):
+    def latest_valid(self, *, prefer_verified: bool = True,
+                     max_step: Optional[int] = None) -> Optional[dict]:
+        """Newest manifest entry whose shards all exist and hash clean.
+
+        Two passes when the manifest carries integrity stamps: first the
+        newest entry that is BOTH checksum-clean and stamped
+        ``verified`` (its in-HBM source had a clean cross-rank fingerprint
+        — checksums only prove the *write* landed intact, not that the
+        state written was worth keeping), then — only if no verified entry
+        survives — any checksum-clean entry, so restore still works for
+        manifests written before the integrity tier existed. ``max_step``
+        (the rollback-on-corruption path passes the last known-clean
+        fingerprint step) additionally excludes entries taken after the
+        corruption window opened."""
+        entries = [e for e in reversed(self.manifest().get("entries", []))
+                   if max_step is None or e.get("step", 0) <= max_step]
+        if prefer_verified:
+            for entry in entries:
+                if not entry.get("integrity", {}).get("verified", False):
+                    continue
+                if self._entry_valid(entry):
+                    return entry
+                logger.warning(
+                    f"snapshot {entry['tag']} fails checksum validation "
+                    "(torn write?) — falling back to the previous entry")
+        for entry in entries:
             if self._entry_valid(entry):
+                if (prefer_verified
+                        and entry.get("integrity", {}).get("verified")
+                        is False):
+                    logger.warning(
+                        f"snapshot {entry['tag']} restores UNVERIFIED "
+                        "state (no verified entry survives) — treat the "
+                        "resumed run as suspect")
                 return entry
             logger.warning(
                 f"snapshot {entry['tag']} fails checksum validation "

@@ -173,6 +173,28 @@ class ResilienceManager:
         # transport read
         self.last_health = None
         self.degraded = False
+        # -- silent-corruption integrity tier (ISSUE 20) -------------------
+        ic = cfg.integrity
+        self.integrity = None
+        if ic.enabled:
+            from .integrity import FingerprintStore, IntegrityMonitor
+
+            irank = int(ic.rank) if int(ic.rank) >= 0 else int(self._rank)
+            root = ic.dir or os.path.join(cfg.snapshot_dir, "integrity")
+            store = FingerprintStore(root, irank, int(ic.world))
+
+            def _int_emit(ev: dict) -> None:
+                step = self.engine.global_steps
+                self._emit([(k, v if isinstance(v, (int, float)) else 1.0,
+                             step) for k, v in ev.items()])
+
+            self.integrity = IntegrityMonitor(
+                engine, ic, store=store, emit=_int_emit,
+                replay_corrupt_fn=self._replay_corrupt)
+            # commit-time verified stamping: the snapshot writer consults
+            # the monitor's taint view at manifest commit, so a divergence
+            # detected while a write sat queued still denies the stamp
+            self.snap.integrity_stamp = self.integrity.snapshot_stamp
         # set by TelemetryManager.attach_resilience: flight dumps ride the
         # watchdog expiry / rollback / drain paths, resilience events land
         # in the metrics registry. None = telemetry off, zero overhead.
@@ -215,6 +237,60 @@ class ResilienceManager:
                             final: bool) -> None:
         self._emit([(f"Resilience/retry/{site}", float(attempt),
                      self.engine.global_steps)])
+
+    # ------------------------------------------------------------------
+    # silent-data-corruption drills (chaos classes sdc_bitflip_*)
+    # ------------------------------------------------------------------
+    def _sdc_rank(self) -> int:
+        """SDC drills target the integrity-tier rank when one is configured
+        (in-process multi-engine drills give each engine its own virtual
+        rank), else the process rank."""
+        if self.integrity is not None:
+            return self.integrity.rank
+        ic = self.cfg.integrity
+        return int(ic.rank) if int(ic.rank) >= 0 else int(self._rank)
+
+    def _maybe_inject_sdc(self, step: int) -> None:
+        f = self.faults
+        if f is None or (not f.sdc_transient_at_steps
+                         and f.sdc_sticky_from_step is None):
+            return
+        rank = self._sdc_rank()
+        t = f.sdc_transient_now(step, rank)
+        s = f.sdc_sticky_now(step, rank)
+        if t or s:
+            from .integrity import flip_bit
+
+            self.engine.state = flip_bit(self.engine.state, bit=f.sdc_bit)
+            if t:
+                self._emit([("Resilience/fault/sdc_bitflip_transient",
+                             1.0, step)])
+
+    def _replay_corrupt(self, step: int, state):
+        """Re-apply a STICKY chaos flip to a shadow-replay output: a broken
+        host corrupts the replay too, which is exactly how the monitor
+        tells sticky from transient (a one-shot transient flip is already
+        spent and does NOT reproduce)."""
+        f = self.faults
+        if (f is not None and f.sdc_sticky_from_step is not None
+                and f._sdc_rank_match(self._sdc_rank())
+                and int(step) >= int(f.sdc_sticky_from_step)):
+            from .integrity import flip_bit
+
+            return flip_bit(state, bit=f.sdc_bit)
+        return state
+
+    def integrity_rollback(self) -> bool:
+        """Control-plane actuator (``policy.rule_integrity``): roll back to
+        the newest VERIFIED snapshot taken at or before the last
+        known-clean fingerprint step. Returns True when a restore actually
+        happened."""
+        mx = (self.integrity.last_clean_step
+              if self.integrity is not None else None)
+        n = self.rollbacks
+        with span("resilience/rollback"):
+            self._rollback(max_step=mx, reason="integrity")
+        return self.rollbacks > n
 
     # ------------------------------------------------------------------
     # engine hooks
@@ -270,6 +346,10 @@ class ResilienceManager:
         performs — exactly the window a wedged collective hangs in)."""
         if self.watchdog is not None:
             self.watchdog.arm(self.engine.global_steps)
+        if self.integrity is not None:
+            # +1 pairs the pre-step retention with post_step's numbering
+            # (the engine increments global_steps between the two hooks)
+            self.integrity.pre_step(self.engine.global_steps + 1)
         self._step_t0 = time.monotonic()
 
     def abort_step(self) -> None:
@@ -328,6 +408,13 @@ class ResilienceManager:
         if self.watcher is not None and self.watcher.requested():
             self.drain()
             return
+
+        # SDC drills corrupt the post-step state BEFORE the fingerprint is
+        # issued — detection sees exactly what a flipped ALU would leave
+        self._maybe_inject_sdc(step)
+        if self.integrity is not None:
+            with span("integrity/check"):
+                self.integrity.post_step(step)
 
         prev, self._pending_metrics = self._pending_metrics, \
             (step, engine._metrics_dev)
@@ -593,7 +680,8 @@ class ResilienceManager:
             engine._lr_scale = saved_scale
             self._invalidate_compiled_steps()
 
-    def _rollback(self) -> None:
+    def _rollback(self, *, max_step: Optional[int] = None,
+                  reason: str = "sentinel") -> None:
         engine = self.engine
         tripped_at = engine.global_steps
         if self._telemetry is not None:
@@ -605,16 +693,19 @@ class ResilienceManager:
             # restore + retrace legitimately exceed a per-step deadline
             self.watchdog.disarm(record=False)
         self.snap.wait()  # an in-flight async write may BE the last-good
-        entry = self.snap.latest_valid()
+        entry = self.snap.latest_valid(max_step=max_step)
         if entry is None:
             logger.warning(
-                "sentinel rollback requested but no valid snapshot exists "
+                f"{reason} rollback requested but no valid snapshot exists "
                 "yet — continuing without rollback (raise "
                 "snapshot_interval coverage or pre-seed with a snapshot)")
             if self.sentinel is not None:
                 self.sentinel.reset()
             return
         self._restore(entry)
+        if self.integrity is not None:
+            # a restore from a verified snapshot ends the taint window
+            self.integrity.note_rollback(tripped_at)
         self._pending_metrics = None  # metrics of the rolled-away step
         drop = float(self.cfg.sentinel.lr_drop_factor)
         if drop != 1.0:

@@ -122,6 +122,11 @@ class ServingMetrics:
         self.requeues = 0          # replica-loss / drain requeues
         self.sla_violations = 0
         self.sla_tracked = 0
+        # integrity canary (ISSUE 20): periodic self-submitted seeded
+        # greedy probes whose token hash must match a known-good value —
+        # a fail means this replica decodes WRONG BITS while looking alive
+        self.canary_probes = 0
+        self.canary_fails = 0
         self.tokens_out = 0
         self.prompt_tokens = 0
         # last-sampled gauges
@@ -297,6 +302,8 @@ class ServingMetrics:
             "requeues": self.requeues,
             "sla_violations": self.sla_violations,
             "sla_tracked": self.sla_tracked,
+            "canary_probes": self.canary_probes,
+            "canary_fails": self.canary_fails,
             "tokens_out": self.tokens_out,
             "prompt_tokens": self.prompt_tokens,
             "tokens_per_sec": round(self.tokens_per_sec(), 2),
@@ -344,6 +351,8 @@ class ServingMetrics:
         put("requeues", self.requeues)
         put("rejected", self.rejected)
         put("sla_violations", self.sla_violations)
+        put("canary_probes", self.canary_probes)
+        put("canary_fails", self.canary_fails)
         put("prefix_hit_rate", self.prefix_hit_rate())
         put("prefix_tokens_reused", self.prefix_tokens_reused)
         put("prefix_blocks_shared", self.prefix_blocks_shared)

@@ -59,6 +59,10 @@ class LLMServer:
                  fused_decode_chunk: int = 0,
                  resume_checkpoint_tokens: Optional[int] = None,
                  tenancy=None,
+                 canary_interval_steps: int = 0,
+                 canary_prompt: Optional[Sequence[int]] = None,
+                 canary_max_tokens: int = 8,
+                 canary_expect: Optional[str] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.engine = engine
         self.replica_id = int(replica_id)
@@ -138,6 +142,25 @@ class LLMServer:
         self.control_interval_steps = 25
         self.control_max_queue: Optional[int] = None
         self._last_control_step = 0
+        # integrity canary (ISSUE 20's serving-side SDC probe): every
+        # canary_interval_steps engine steps the server self-submits a
+        # fixed prompt under greedy decode and hashes the tokens. A hash
+        # that stops matching means this replica computes WRONG BITS while
+        # passing every liveness check — the canary fails the engine
+        # thread so the router's existing dead-replica takeover (error !=
+        # None -> excluded from alive_ids, work requeued) quarantines it.
+        # canary_expect pins the known-good hash; None learns it from the
+        # first probe (valid only if the replica is healthy at warm-up).
+        # Determinism requires greedy decode — with sampling on, the very
+        # first mismatch would kill a healthy replica.
+        self.canary_interval_steps = int(canary_interval_steps)
+        self._canary_prompt = np.asarray(
+            list(canary_prompt) if canary_prompt is not None
+            else [3, 1, 4, 1, 5], np.int32)
+        self.canary_max_tokens = int(canary_max_tokens)
+        self.canary_expect = canary_expect
+        self._canary_inflight: Optional[ServedResponse] = None
+        self._last_canary_step = 0
         self.heartbeat = heartbeat          # resilience.HeartbeatWriter
         self.heartbeat_interval_s = float(heartbeat_interval_s)
         self.suppress_heartbeat = False     # FaultPlan-style drill hook
@@ -225,7 +248,12 @@ class LLMServer:
                    fused_decode_chunk=getattr(sv, "fused_decode_chunk", 0),
                    resume_checkpoint_tokens=getattr(
                        sv, "resume_checkpoint_tokens", None),
-                   tenancy=tenancy)
+                   tenancy=tenancy,
+                   canary_interval_steps=getattr(
+                       sv, "canary_interval_steps", 0),
+                   canary_prompt=getattr(sv, "canary_prompt", None),
+                   canary_max_tokens=getattr(sv, "canary_max_tokens", 8),
+                   canary_expect=getattr(sv, "canary_expect", None))
 
     # ------------------------------------------------------------------
     # client side
@@ -456,6 +484,7 @@ class LLMServer:
                 self._sample_gauges()
                 self._maybe_emit()
                 self._maybe_control_tick()
+                self._maybe_canary()
                 if self._draining and not self.scheduler.has_work():
                     # under the flags lock, with no submit between its
                     # admission check and its enqueue (_submitting == 0),
@@ -678,6 +707,60 @@ class LLMServer:
                 self.control.on_serving_tick(self)
             except Exception as e:  # control must never stall serving
                 logger.warning(f"serving: control tick failed: {e!r}")
+
+    def _maybe_canary(self) -> None:
+        """Integrity canary, engine-thread only: reap a finished probe
+        (hash-compare, fail the replica on mismatch) and launch the next
+        one when due. The probe bypasses ingress/shedding — it goes
+        straight to the scheduler: a canary a busy door rejects is no
+        canary, and an already-admitted request must land anyway."""
+        if self.canary_interval_steps <= 0:
+            return
+        c = self._canary_inflight
+        if c is not None and c.done:
+            self._canary_inflight = None
+            self._check_canary(c)        # raises on mismatch -> loop fails
+            c = None
+        if (c is not None or not self._steps or self._draining
+                or self._steps == self._last_canary_step
+                or self._steps % self.canary_interval_steps):
+            return
+        self._last_canary_step = self._steps
+        req = Request(np.asarray(self._canary_prompt, np.int32),
+                      max_new_tokens=self.canary_max_tokens)
+        resp = ServedResponse(req, next(self._uid), self.clock())
+        resp.replica_id = self.replica_id
+        resp.is_canary = True            # post-mortem / metrics marker
+        self.metrics.canary_probes += 1
+        self.metrics.on_submit(resp)     # probes count as served traffic
+        self.scheduler.add(resp)
+        self._canary_inflight = resp
+
+    def _check_canary(self, resp: ServedResponse) -> None:
+        """Compare a finished probe's token hash with the expectation.
+        First probe with no configured expectation LEARNS it (trust on
+        first use — the replica just warmed and served it). A mismatch
+        raises: the engine loop's failure path marks ``self.error``, fails
+        outstanding requests, and the router takeover does the rest."""
+        import hashlib
+
+        if resp.finish_reason in (FINISH_CANCELLED, FINISH_FAILED):
+            return                        # shutdown races are not verdicts
+        got = hashlib.sha1(
+            np.asarray(resp.tokens, np.int64).tobytes()).hexdigest()[:16]
+        if self.canary_expect is None:
+            self.canary_expect = got
+            logger.info(f"serving: replica {self.replica_id} canary "
+                        f"expectation learned: {got}")
+            return
+        if got != self.canary_expect:
+            # the registered serving collector exports this as
+            # dstpu_serving_canary_fail_total{replica=...} on next scrape
+            self.metrics.canary_fails += 1
+            raise RuntimeError(
+                f"integrity canary failed on replica {self.replica_id}: "
+                f"token hash {got} != expected {self.canary_expect} "
+                f"(step {self._steps}) — replica output is corrupt")
 
     def _maybe_emit(self) -> None:
         if self.monitor is None or self.metrics_interval_steps <= 0:

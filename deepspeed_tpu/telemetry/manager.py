@@ -215,6 +215,7 @@ class TelemetryManager:
 
     def attach_resilience(self, manager) -> None:
         manager._telemetry = self
+        self._resilience = manager
         if self.flight is not None and manager.watchdog is not None:
             # route through flight_dump (not flight.dump) so the plan table
             # rides the watchdog post-mortem too — but with sample_mem off:
@@ -260,6 +261,12 @@ class TelemetryManager:
                 # the control ledger: which knobs the supervisor moved and
                 # why — the doctor prints these beside its verdicts
                 extra.setdefault("control", self._control.ledger.snapshot())
+            mon = getattr(getattr(self, "_resilience", None),
+                          "integrity", None)
+            if mon is not None:
+                # per-rank fingerprint history: the doctor cross-votes
+                # these across dumps to NAME the corrupt rank
+                extra.setdefault("integrity", mon.snapshot())
             mem = self.sample_memory() if sample_mem else None
             if mem:
                 extra.setdefault("mem", mem)
@@ -439,6 +446,8 @@ def serving_metrics_samples(metrics, labels: Dict[str, str]) -> List[Sample]:
         ("dstpu_serving_preemptions_total", "preemptions"),
         ("dstpu_serving_requeues_total", "requeues"),
         ("dstpu_serving_sla_violations_total", "sla_violations"),
+        ("dstpu_serving_canary_probes_total", "canary_probes"),
+        ("dstpu_serving_canary_fail_total", "canary_fails"),
         ("dstpu_serving_tokens_out_total", "tokens_out"),
         # prefix KV cache / speculative decoding (mirrored off the
         # engine's ReuseStats by the server loop)

@@ -170,8 +170,9 @@ def test_ledger_records_counter_and_monitor_events():
 
 def test_policy_table_covers_fired_rules():
     assert set(RULE_NAMES) == {"straggler_replan", "mem_pressure",
-                               "sla_pressure", "rollback_degrade"}
-    assert len(POLICY_TABLE) == 4
+                               "sla_pressure", "rollback_degrade",
+                               "integrity"}
+    assert len(POLICY_TABLE) == 5
 
 
 # ---------------------------------------------------------------------------

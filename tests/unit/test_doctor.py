@@ -646,7 +646,9 @@ def _chaos_dump_set(d, kind):
              "replica_spawn_fail": "replica2",
              "replica_slow_warm": "replica2",
              "stale_health": "health.read",
-             "flap_straggler": "health.read"}
+             "flap_straggler": "health.read",
+             "sdc_bitflip_transient": "training",
+             "sdc_bitflip_sticky": "training"}
     site = sites[kind]
     schedule = ChaosSchedule([ChaosEvent(kind=kind, site=site, at=1)])
     assert schedule.fire(kind, site) is False and schedule.fire(kind, site)
@@ -664,6 +666,26 @@ def _chaos_dump_set(d, kind):
             _write_dump(d, r, list(_BASE), reason="preempt_drain", phase=None)
             _write_beacon(d, r, 1000.0, step_time=1.0 if r == 0 else 0.1)
         return "straggler", f"chaos drill injected {kind}"
+    if kind in ("sdc_bitflip_transient", "sdc_bitflip_sticky"):
+        # integrity-monitor snapshots riding the dumps: rank 1 is the
+        # fingerprint minority at step 8, classified by shadow replay
+        verdict = "transient" if kind.endswith("transient") else "sticky"
+        quarantined = [1] if verdict == "sticky" else []
+        for r in range(3):
+            integ = {"enabled": True, "rank": r, "world": 3,
+                     "interval_steps": 2, "checks": 4,
+                     "replays": int(r == 1),
+                     "last_fp": ("bb" if r == 1 else "aa") * 8,
+                     "last_fp_step": 8, "last_clean_step": 6,
+                     "tainted_since": 8, "quarantined": quarantined,
+                     "divergences": [{"step": 8,
+                                      "sigs": {"0": "aa" * 8, "1": "bb" * 8,
+                                               "2": "aa" * 8},
+                                      "minority": [1], "verdict": verdict}]}
+            _write_dump(d, r, list(_BASE), reason="rollback", phase=None,
+                        extra={"integrity": integ})
+            _write_beacon(d, r, 1000.0)
+        return "sdc", f"chaos drill injected {kind}"
     if kind in ("transport_put_error", "transport_get_error",
                 "plan_cache_error", "snapshot_io_error"):
         retries = [{"site": site, "attempt": a, "error": "OSError('x')",

@@ -1,0 +1,447 @@
+"""Silent-corruption integrity tier tests (``runtime/resilience/integrity``
++ its control/doctor/serving wiring — ISSUE 20).
+
+Coverage:
+
+* fingerprint kernel units: single-bit and position sensitivity,
+  determinism, bitwise restore after an un-flip;
+* FingerprintStore publish/read/verdict-revision + the majority vote
+  (strict quorum: ties and single ranks only detect, never localize);
+* the off-identity contract: integrity disabled (or absent) leaves the
+  loss stream bitwise identical; ARMED on a single-rank world is also
+  loss-identical (the digest is compute-only, fetched off the step path);
+* SnapshotManager integrity stamps: ``latest_valid`` prefers an OLDER
+  verified entry over a newer unverified one, falls back to any
+  checksum-clean entry when nothing verified survives, and honors
+  ``max_step`` (the rollback-on-corruption cap);
+* the sticky e2e drill (chaos-driven, 3 in-process engines): a sticky
+  bit flip on rank 1 from step 7 is detected at the next fingerprint
+  step, shadow replay calls it sticky, the control supervisor
+  quarantines rank 1 and rolls the survivors back to a verified
+  snapshot, and the healed run's final loss is BITWISE equal to a
+  fault-free reference — then the doctor, from artifacts alone, returns
+  verdict ``sdc`` naming rank 1, the step, and the chaos injection;
+* the transient drill: a one-shot flip at a fingerprinted step is
+  classified ``transient`` by the replay, heals by rollback with NO
+  quarantine, and recovery is again bitwise;
+* the serving canary probe: trust-on-first-use hash learning on a
+  healthy replica, and a pinned wrong hash failing the replica through
+  the engine-thread error path the router take-over keys on;
+* lint: the integrity tier is host-sync-scoped — an unannotated
+  ``block_until_ready`` in it is flagged, a ``# sync-ok:`` blessed one
+  is not.
+"""
+
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu import doctor
+from deepspeed_tpu.analysis.lint import lint_source
+from deepspeed_tpu.runtime.resilience.chaos import configure_chaos, get_chaos
+from deepspeed_tpu.runtime.resilience.integrity import (FingerprintStore,
+                                                        fingerprint_hex,
+                                                        flip_bit,
+                                                        make_fingerprint_fn,
+                                                        vote)
+from deepspeed_tpu.runtime.resilience.snapshot import SnapshotManager
+from tests.unit.simple_model import (make_simple_params, random_batches,
+                                     simple_loss)
+
+HIDDEN = 32
+STEPS = 14
+SNAP_IVL = 4
+FP_IVL = 2
+STICKY_AT = 7       # between fingerprint steps: detected at the NEXT one (8)
+TRANSIENT_AT = 8    # AT a fingerprint step: the retained pre-state is clean,
+                    # so the shadow replay matches the majority -> transient
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    yield
+    configure_chaos(None)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint kernel
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_single_bit_and_position_sensitivity():
+    fp = make_fingerprint_fn()
+    params = make_simple_params(HIDDEN)
+    a = fingerprint_hex(np.asarray(fp(params)))
+    assert len(a) == 8 * 8 and a == fingerprint_hex(np.asarray(fp(params)))
+    flipped = flip_bit(params, bit=17)
+    assert fingerprint_hex(np.asarray(fp(flipped))) != a
+    # un-flipping restores the exact digest (xor is an involution)
+    assert fingerprint_hex(np.asarray(fp(flip_bit(flipped, bit=17)))) == a
+    # position-weighted sum: a value SWAP (same multiset of bits) differs
+    x = {"w": jnp.asarray([1.0, 2.0, 3.0], jnp.float32)}
+    y = {"w": jnp.asarray([3.0, 2.0, 1.0], jnp.float32)}
+    assert (fingerprint_hex(np.asarray(fp(x)))
+            != fingerprint_hex(np.asarray(fp(y))))
+
+
+def test_store_publish_read_verdict_and_vote(tmp_path):
+    stores = [FingerprintStore(str(tmp_path), r, 3) for r in range(3)]
+    stores[0].publish(4, "aa")
+    stores[1].publish(4, "bb")
+    assert set(stores[2].read(4)) == {0, 1}
+    stores[2].publish(4, "aa")
+    recs = stores[0].read(4)
+    sigs = {r: recs[r]["fp"] for r in recs}
+    assert vote(sigs) == ("aa", [1])
+    # the minority revises its record with the replay verdict in place
+    stores[1].publish(4, "bb", verdict="sticky")
+    assert stores[0].read(4)[1]["verdict"] == "sticky"
+    # no strict majority -> detection without localization
+    assert vote({0: "aa"}) == (None, [])
+    assert vote({0: "aa", 1: "bb"}) == (None, [])
+    assert vote({0: "aa", 1: "bb", 2: "cc", 3: "aa"}) == (None, [])
+
+
+# ---------------------------------------------------------------------------
+# off-identity + single-rank-armed identity
+# ---------------------------------------------------------------------------
+
+
+def _run_losses(tmp_path, name, *, resilience=None, n=6):
+    cfg = {"train_micro_batch_size_per_gpu": 4,
+           "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 1000, "seed": 7}
+    if resilience is not None:
+        resilience = dict(resilience)
+        resilience.setdefault("snapshot_dir", os.path.join(str(tmp_path), name))
+        cfg["resilience"] = resilience
+    e, *_ = ds.initialize(model=simple_loss,
+                          model_parameters=make_simple_params(HIDDEN),
+                          config=cfg)
+    batches = random_batches(n, 4, HIDDEN)
+    return e, [float(np.asarray(e.train_batch(b))) for b in batches]
+
+
+def test_integrity_off_is_bitwise_identical(tmp_path):
+    base_r = {"enabled": True, "snapshot_interval": 3, "async_snapshot": False}
+    _, plain = _run_losses(tmp_path, "plain", resilience=base_r)
+    _, off = _run_losses(tmp_path, "off", resilience=dict(
+        base_r, integrity={"enabled": False}))
+    assert plain == off                               # bitwise, float repr
+    _, bare = _run_losses(tmp_path, "bare")           # no resilience at all
+    assert plain == bare
+
+
+def test_integrity_armed_single_rank_is_loss_identical(tmp_path):
+    base_r = {"enabled": True, "snapshot_interval": 3, "async_snapshot": False}
+    _, plain = _run_losses(tmp_path, "plain", resilience=base_r)
+    e, armed = _run_losses(tmp_path, "armed", resilience=dict(
+        base_r, integrity={"enabled": True, "interval_steps": 2, "world": 1,
+                           "dir": os.path.join(str(tmp_path), "fp")}))
+    assert plain == armed
+    mon = e.resilience.integrity
+    # forensic digests were still computed and fetched one step delayed
+    assert mon.last_fp is not None and mon.last_fp_step is not None
+    assert mon.last_clean_step is not None and not mon.divergences
+
+
+# ---------------------------------------------------------------------------
+# verified snapshots (satellite: the taint-window stamp regression)
+# ---------------------------------------------------------------------------
+
+
+def test_latest_valid_prefers_verified_and_honors_max_step(tmp_path):
+    stamp_state = {"verified": True}
+    sm = SnapshotManager(str(tmp_path), keep=8, use_async=False,
+                         integrity_stamp=lambda step: dict(stamp_state))
+    tree = {"w": jnp.arange(8, dtype=jnp.float32)}
+    sm.snapshot(tree, step=2)                       # verified
+    stamp_state["verified"] = False                 # taint window opens
+    sm.snapshot(tree, step=4)                       # committed UNVERIFIED
+    man = {e["step"]: e for e in sm.manifest()["entries"]}
+    assert man[2]["integrity"]["verified"] is True
+    assert man[4]["integrity"]["verified"] is False
+    # newer-but-unverified loses to older-verified...
+    assert sm.latest_valid()["tag"] == "step_2"
+    # ...unless verification is not requested
+    assert sm.latest_valid(prefer_verified=False)["tag"] == "step_4"
+    # rollback cap: nothing verified at/below step 1
+    assert sm.latest_valid(max_step=1) is None
+    # nothing verified at all -> checksum-clean fallback still restores
+    sm2 = SnapshotManager(str(tmp_path / "none"), keep=8, use_async=False,
+                          integrity_stamp=lambda step: {"verified": False})
+    sm2.snapshot(tree, step=3)
+    assert sm2.latest_valid()["tag"] == "step_3"
+    # stamp-less manifests (pre-integrity format) are untouched
+    sm3 = SnapshotManager(str(tmp_path / "bare"), keep=8, use_async=False)
+    sm3.snapshot(tree, step=5)
+    entry = sm3.latest_valid()
+    assert entry["tag"] == "step_5" and "integrity" not in entry
+
+
+# ---------------------------------------------------------------------------
+# the e2e drills: 3 lockstep in-process engines sharing a fingerprint dir
+# ---------------------------------------------------------------------------
+
+
+def _drill_engine(work, fp_dir, rank, *, faults=None, chaos=None):
+    cfg = {"train_micro_batch_size_per_gpu": 4,
+           "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 1000, "seed": 7,
+           "control": {"enabled": True,
+                       "supervisor": {"interval_steps": 1,
+                                      "straggler_replan": False,
+                                      "memory_guard": False,
+                                      "rollback_degrade": False},
+                       "guard": {"trigger_streak": 1, "clear_streak": 1,
+                                 "cooldown_s": 0.0, "budget": 100}},
+           "resilience": {"enabled": True,
+                          "snapshot_dir": os.path.join(work, f"snap-{rank}"),
+                          "snapshot_interval": SNAP_IVL,
+                          "async_snapshot": False,
+                          "integrity": {"enabled": True,
+                                        "interval_steps": FP_IVL,
+                                        "rank": rank, "world": 3,
+                                        "dir": fp_dir,
+                                        "resolve_timeout_steps": 6}}}
+    if faults is not None:
+        cfg["resilience"]["faults"] = faults
+    if chaos is not None:
+        cfg["chaos"] = chaos
+    e, *_ = ds.initialize(model=simple_loss,
+                          model_parameters=make_simple_params(HIDDEN),
+                          config=cfg)
+    return e
+
+
+def _reference_losses(batches):
+    cfg = {"train_micro_batch_size_per_gpu": 4,
+           "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 1000, "seed": 7}
+    ref, *_ = ds.initialize(model=simple_loss,
+                            model_parameters=make_simple_params(HIDDEN),
+                            config=cfg)
+    out = {}
+    while ref.global_steps < STEPS:
+        gs = ref.global_steps
+        out[gs + 1] = float(np.asarray(ref.train_batch(batches[gs])))
+    return out
+
+
+def _drive(engines, batches):
+    """Round-robin the engines to STEPS, keyed by each engine's OWN
+    global_steps so a rolled-back engine replays the same batches. A rank
+    whose monitor quarantined it is halted (the in-process stand-in for
+    the fleet acting on the ``sdc_quarantine`` ledger line)."""
+    alive = set(range(len(engines)))
+    losses = {r: {} for r in alive}
+    for _ in range(200):
+        if not any(engines[r].global_steps < STEPS for r in alive):
+            return losses, alive
+        for r in sorted(alive):
+            e = engines[r]
+            if e.global_steps >= STEPS:
+                continue
+            gs = e.global_steps
+            losses[r][gs + 1] = float(np.asarray(e.train_batch(batches[gs])))
+        for r in sorted(alive):
+            mon = engines[r].resilience.integrity
+            if mon.quarantined and r in mon.quarantined:
+                alive.discard(r)
+    raise AssertionError("drill did not converge in 200 rounds")
+
+
+def test_sticky_sdc_drill_quarantine_rollback_bitwise_and_doctor(tmp_path):
+    work = str(tmp_path)
+    fp_dir = os.path.join(work, "integrity")
+    batches = random_batches(STEPS + 4, 4, HIDDEN)
+    ref = _reference_losses(batches)     # built BEFORE chaos is installed
+    chaos = {"enabled": True,
+             "training": {"enabled": True, "sdc_sticky_from_step": STICKY_AT,
+                          "sdc_rank": 1}}
+    engines = [_drill_engine(work, fp_dir, r, chaos=chaos) for r in range(3)]
+    losses, alive = _drive(engines, batches)
+
+    assert alive == {0, 2}, "rank 1 must have been quarantined and halted"
+    for r in (0, 2):
+        mon = engines[r].resilience.integrity
+        assert mon.divergences, f"rank {r} saw no divergence"
+        first = mon.divergences[0]
+        # corruption starts at step 7; the next fingerprint step is 8 —
+        # detection within one interval, minority correctly localized
+        assert first["step"] == STICKY_AT + 1
+        assert first["minority"] == [1]
+        assert first["verdict"] == "sticky"
+        led = engines[r].control.ledger.snapshot()
+        assert any(a["action"] == "sdc_quarantine"
+                   and 1 in a["params"]["ranks"] for a in led)
+        roll = [a for a in led if a["action"] == "integrity_rollback"]
+        assert roll and roll[0]["outcome"] == "ok"
+        # the rollback was capped at the last clean fingerprint step (6 ->
+        # restores step_4 with snapshot_interval 4; keep=2 prunes it later)
+        assert roll[0]["params"]["max_step"] == STICKY_AT - 1
+        assert engines[r].resilience.rollbacks >= 1
+        assert 1 in mon.quarantined
+        # healed run is BITWISE equal to the fault-free reference
+        assert losses[r][STEPS] == ref[STEPS]
+        # post-heal snapshots regain the verified stamp (taint cleared)
+        entry = engines[r].resilience.snap.latest_valid()
+        assert entry["integrity"]["verified"] is True
+    # the corrupt rank classified ITSELF sticky via its own shadow replay
+    mon1 = engines[1].resilience.integrity
+    assert mon1.replays >= 1
+    assert any(d["verdict"] == "sticky" and d["self_minority"]
+               for d in mon1.divergences)
+    assert 1 in mon1.quarantined
+
+    # -- the post-mortem: doctor names the rank from artifacts alone -----
+    ddir = os.path.join(work, "post-mortem")
+    os.makedirs(ddir)
+    get_chaos().dump(ddir)               # chaos-schedule.json w/ training rows
+    for r in range(3):
+        doc = {"reason": "rollback", "rank": r, "pid": 100 + r, "sequence": 1,
+               "wall_time": 1000.0, "last_phase": None, "open_spans": [],
+               "inflight_spans": [], "steps": [], "collectives": [],
+               "integrity": engines[r].resilience.integrity.snapshot()}
+        json.dump(doc, open(os.path.join(ddir, f"flightdump-{r}.json"), "w"))
+        json.dump({"rank": r, "step": STEPS, "step_time_s": 0.1,
+                   "wall_time": 1000.0},
+                  open(os.path.join(ddir, f"hb-{r}.json"), "w"))
+    rep = doctor.diagnose(ddir)
+    assert rep["verdict"] == "sdc"
+    ig = rep["integrity"]
+    assert ig["first_divergent_step"] == STICKY_AT + 1
+    assert ig["minority_ranks"] == [1]
+    assert "sticky" in ig["verdicts"]
+    assert ig["quarantined"] == [1]
+    assert any("minority rank(s) [1]" in e for e in rep["evidence"])
+    assert any("chaos drill injected sdc_bitflip_sticky" in e
+               for e in rep["evidence"])
+    text = doctor.render_report(rep)
+    assert "SDC" in text.upper() and "sdc_bitflip_sticky" in text
+
+
+def test_transient_sdc_drill_heals_without_quarantine(tmp_path):
+    work = str(tmp_path)
+    fp_dir = os.path.join(work, "integrity")
+    batches = random_batches(STEPS + 4, 4, HIDDEN)
+    ref = _reference_losses(batches)
+    faults = {"enabled": True, "sdc_transient_at_steps": [TRANSIENT_AT],
+              "sdc_rank": 1}
+    engines = [_drill_engine(work, fp_dir, r,
+                             faults=faults if r == 1 else None)
+               for r in range(3)]
+    losses, alive = _drive(engines, batches)
+
+    assert alive == {0, 1, 2}, "a transient flip must not quarantine anyone"
+    for r in range(3):
+        mon = engines[r].resilience.integrity
+        assert mon.divergences, f"rank {r} saw no divergence"
+        assert mon.divergences[0]["step"] == TRANSIENT_AT
+        assert mon.divergences[0]["minority"] == [1]
+        assert "transient" in {d["verdict"] for d in mon.divergences}
+        assert mon.quarantined == []
+        led = engines[r].control.ledger.snapshot()
+        assert not any(a["action"] == "sdc_quarantine" for a in led)
+        assert any(a["action"] == "integrity_rollback"
+                   and a["outcome"] == "ok" for a in led)
+        # one-shot flip + rollback -> bitwise recovery on EVERY rank,
+        # including the one that glitched
+        assert losses[r][STEPS] == ref[STEPS]
+    # the glitched rank ran the shadow replay that proved transience
+    assert engines[1].resilience.integrity.replays >= 1
+
+
+# ---------------------------------------------------------------------------
+# serving canary (satellite: the inference-side SDC probe)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def _canary_model():
+    import jax
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM)
+    cfg = TransformerConfig(vocab_size=97, hidden_size=48,
+                            intermediate_size=96, num_layers=2, num_heads=4,
+                            num_kv_heads=2, max_seq_len=128,
+                            dtype=jnp.float32, norm="rmsnorm",
+                            activation="swiglu")
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _canary_engine(_canary_model):
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    model, params = _canary_model
+    return InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        token_budget=16, max_ragged_sequence_count=4, max_chunk_size=8,
+        num_kv_blocks=64, kv_block_size=8, max_blocks_per_seq=8,
+        dtype="float32"))
+
+
+def test_canary_learns_expectation_and_stays_healthy(_canary_model):
+    from deepspeed_tpu.serving import LLMServer, Request
+
+    server = LLMServer(_canary_engine(_canary_model),
+                       canary_interval_steps=1, canary_max_tokens=4).start()
+    server.submit(Request(np.array([5, 6, 7], np.int32), max_new_tokens=4))
+    deadline = time.monotonic() + 120
+    while server.canary_expect is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert server.canary_expect is not None, "expectation never learned"
+    # let at least one MORE probe complete and hash-match the learned value
+    want = server.metrics.canary_probes + 1
+    while (server.metrics.canary_probes < want and server.error is None
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    assert server.drain(timeout=300)
+    assert server.error is None
+    assert server.metrics.canary_fails == 0
+    assert server.metrics.canary_probes >= 2
+    snap = server.metrics.snapshot()
+    assert snap["canary_probes"] == server.metrics.canary_probes
+    assert snap["canary_fails"] == 0
+
+
+def test_canary_mismatch_fails_the_replica(_canary_model):
+    from deepspeed_tpu.serving import LLMServer, Request
+
+    server = LLMServer(_canary_engine(_canary_model),
+                       canary_interval_steps=1, canary_max_tokens=4,
+                       canary_expect="0" * 16).start()
+    server.submit(Request(np.array([5, 6, 7], np.int32), max_new_tokens=4))
+    deadline = time.monotonic() + 120
+    while server.error is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    # the probe hash cannot match the pinned garbage -> the engine thread
+    # dies with the canary error, which is exactly the state the router's
+    # dead-replica takeover keys on (error != None -> not in alive_ids)
+    assert server.error is not None
+    assert "canary" in str(server.error)
+    assert server.metrics.canary_fails == 1
+
+
+# ---------------------------------------------------------------------------
+# lint scope: the integrity tier is a host-sync-forbidden path
+# ---------------------------------------------------------------------------
+
+
+def test_lint_flags_unannotated_host_sync_in_integrity_tier():
+    rel = "deepspeed_tpu/runtime/resilience/integrity.py"
+    bad = "import jax\n\ndef f(x):\n    return x.block_until_ready()\n"
+    assert any(f.rule == "host-sync" for f in lint_source(bad, rel))
+    ok = ("import jax\n\ndef f(x):\n"
+          "    return x.block_until_ready()  # sync-ok: test blessing\n")
+    assert not any(f.rule == "host-sync" for f in lint_source(ok, rel))
+    # outside the scoped prefixes the same code is fine
+    assert not any(f.rule == "host-sync"
+                   for f in lint_source(bad, "deepspeed_tpu/autotune/run.py"))
